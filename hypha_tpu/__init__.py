@@ -1,0 +1,23 @@
+"""hypha_tpu — a TPU-native decentralized DiLoCo training framework.
+
+A ground-up re-design of the capabilities of hypha-space/hypha (Rust/libp2p/torch)
+for TPU hardware: the inner optimizer loop is a jit/pjit-compiled JAX step sharded
+over a TPU slice's ICI mesh; intra-slice aggregation lowers to XLA collectives;
+the control plane (auction, leases, job bridge, slice scheduling, discovery) is an
+asyncio/C++ runtime speaking CBOR-typed protocols.
+
+Layer map (mirrors reference SURVEY.md §1):
+  L0 security/PKI     -> hypha_tpu.certs
+  L1 p2p networking   -> hypha_tpu.network   (transport fabric: rpc/pubsub/streams/discovery)
+  L2 protocol vocab   -> hypha_tpu.messages, hypha_tpu.resources, hypha_tpu.leases
+  L3 node runtimes    -> hypha_tpu.gateway / .scheduler / .worker / .data
+  L4 execution layer  -> hypha_tpu.worker.executors + job bridge
+  L5 ML executors     -> hypha_tpu.executor (JAX train + aggregate)
+  L6 observability    -> hypha_tpu.telemetry
+  L7 config           -> hypha_tpu.config
+
+TPU compute path: hypha_tpu.models (flax), hypha_tpu.ops (pallas kernels),
+hypha_tpu.parallel (mesh/sharding/collectives, ring attention context parallelism).
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,203 @@
+"""Minimal CBOR (RFC 8949) codec.
+
+The reference serializes every wire message as CBOR via ciborium
+(reference: crates/messages/src/lib.rs:15-44 — all three request-response
+protocols use CBOR codecs). This module provides a dependency-free CBOR
+subset sufficient for the framework's wire vocabulary: unsigned/negative
+integers, byte strings, text strings, arrays, maps, floats, bool, and null.
+
+Encoding is canonical-ish: definite lengths only, shortest integer heads,
+f64 for all floats. Decoding additionally accepts f16/f32 and indefinite
+strings/arrays/maps for interop.
+"""
+
+from __future__ import annotations
+
+import struct
+from io import BytesIO
+from typing import Any
+
+__all__ = ["dumps", "loads", "CBORDecodeError", "MAX_DEPTH"]
+
+_BREAK = object()
+
+# Nesting bound for untrusted input: a deeply nested frame must fail with a
+# decode error, not blow the interpreter stack.
+MAX_DEPTH = 128
+
+
+class CBORDecodeError(ValueError):
+    pass
+
+
+def _head(fp: BytesIO, major: int, value: int) -> None:
+    if value < 24:
+        fp.write(bytes([(major << 5) | value]))
+    elif value < 0x100:
+        fp.write(bytes([(major << 5) | 24, value]))
+    elif value < 0x10000:
+        fp.write(bytes([(major << 5) | 25]) + struct.pack(">H", value))
+    elif value < 0x100000000:
+        fp.write(bytes([(major << 5) | 26]) + struct.pack(">I", value))
+    else:
+        fp.write(bytes([(major << 5) | 27]) + struct.pack(">Q", value))
+
+
+def _encode(fp: BytesIO, obj: Any) -> None:
+    if obj is None:
+        fp.write(b"\xf6")
+    elif obj is True:
+        fp.write(b"\xf5")
+    elif obj is False:
+        fp.write(b"\xf4")
+    elif isinstance(obj, int):
+        if not (-(2**64) <= obj < 2**64):
+            raise TypeError(f"integer out of CBOR 64-bit range: {obj}")
+        if obj >= 0:
+            _head(fp, 0, obj)
+        else:
+            _head(fp, 1, -1 - obj)
+    elif isinstance(obj, float):
+        fp.write(b"\xfb" + struct.pack(">d", obj))
+    elif isinstance(obj, (bytes, bytearray, memoryview)):
+        b = bytes(obj)
+        _head(fp, 2, len(b))
+        fp.write(b)
+    elif isinstance(obj, str):
+        b = obj.encode("utf-8")
+        _head(fp, 3, len(b))
+        fp.write(b)
+    elif isinstance(obj, (list, tuple)):
+        _head(fp, 4, len(obj))
+        for item in obj:
+            _encode(fp, item)
+    elif isinstance(obj, dict):
+        _head(fp, 5, len(obj))
+        for k, v in obj.items():
+            _encode(fp, k)
+            _encode(fp, v)
+    else:
+        raise TypeError(f"cannot CBOR-encode {type(obj).__name__}")
+
+
+def dumps(obj: Any) -> bytes:
+    fp = BytesIO()
+    _encode(fp, obj)
+    return fp.getvalue()
+
+
+def _read(fp: BytesIO, n: int) -> bytes:
+    b = fp.read(n)
+    if len(b) != n:
+        raise CBORDecodeError("truncated input")
+    return b
+
+
+def _read_uint(fp: BytesIO, info: int) -> int:
+    if info < 24:
+        return info
+    if info == 24:
+        return _read(fp, 1)[0]
+    if info == 25:
+        return struct.unpack(">H", _read(fp, 2))[0]
+    if info == 26:
+        return struct.unpack(">I", _read(fp, 4))[0]
+    if info == 27:
+        return struct.unpack(">Q", _read(fp, 8))[0]
+    raise CBORDecodeError(f"invalid additional info {info}")
+
+
+def _decode_f16(b: bytes) -> float:
+    # Decode IEEE 754 half precision without numpy.
+    h = struct.unpack(">H", b)[0]
+    sign = -1.0 if h & 0x8000 else 1.0
+    exp = (h >> 10) & 0x1F
+    frac = h & 0x3FF
+    if exp == 0:
+        return sign * frac * 2.0**-24
+    if exp == 31:
+        return sign * (float("inf") if frac == 0 else float("nan"))
+    return sign * (1 + frac * 2.0**-10) * 2.0 ** (exp - 15)
+
+
+def _decode(fp: BytesIO, depth: int = 0) -> Any:
+    if depth > MAX_DEPTH:
+        raise CBORDecodeError(f"nesting deeper than {MAX_DEPTH}")
+    ib = _read(fp, 1)[0]
+    major, info = ib >> 5, ib & 0x1F
+    if major == 0:
+        return _read_uint(fp, info)
+    if major == 1:
+        return -1 - _read_uint(fp, info)
+    if major in (2, 3):
+        if info == 31:  # indefinite string: concatenate chunks
+            chunks = []
+            while True:
+                item = _decode(fp, depth + 1)
+                if item is _BREAK:
+                    break
+                chunks.append(item)
+            joined: Any = b"".join(chunks) if major == 2 else "".join(chunks)
+            return joined
+        n = _read_uint(fp, info)
+        b = _read(fp, n)
+        return b if major == 2 else b.decode("utf-8")
+    if major == 4:
+        if info == 31:
+            out = []
+            while True:
+                item = _decode(fp, depth + 1)
+                if item is _BREAK:
+                    break
+                out.append(item)
+            return out
+        return [_decode(fp, depth + 1) for _ in range(_read_uint(fp, info))]
+    if major == 5:
+        if info == 31:
+            d = {}
+            while True:
+                k = _decode(fp, depth + 1)
+                if k is _BREAK:
+                    break
+                d[k] = _decode(fp, depth + 1)
+            return d
+        return {_decode(fp, depth + 1): _decode(fp, depth + 1) for _ in range(_read_uint(fp, info))}
+    if major == 6:  # tag: decode and discard the tag number
+        _read_uint(fp, info)
+        return _decode(fp, depth + 1)
+    # major == 7: simple values / floats
+    if info == 20:
+        return False
+    if info == 21:
+        return True
+    if info in (22, 23):
+        return None
+    if info == 25:
+        return _decode_f16(_read(fp, 2))
+    if info == 26:
+        return struct.unpack(">f", _read(fp, 4))[0]
+    if info == 27:
+        return struct.unpack(">d", _read(fp, 8))[0]
+    if info == 31:
+        return _BREAK
+    if info < 24 or info == 24:
+        _read_uint(fp, info)  # unassigned simple value: skip payload
+        return None
+    raise CBORDecodeError(f"unsupported simple/float info {info}")
+
+
+def loads(data: bytes) -> Any:
+    fp = BytesIO(data)
+    try:
+        obj = _decode(fp)
+    except CBORDecodeError:
+        raise
+    except (TypeError, UnicodeDecodeError, struct.error) as e:
+        # Malformed untrusted input (mixed-type indefinite chunks, invalid
+        # UTF-8, unhashable map keys) must surface as a decode error.
+        raise CBORDecodeError(f"malformed CBOR: {e}") from e
+    if obj is _BREAK:
+        raise CBORDecodeError("unexpected break")
+    if fp.read(1):
+        raise CBORDecodeError("trailing bytes")
+    return obj

@@ -1,0 +1,657 @@
+"""Wire vocabulary: every typed message the framework's protocols speak.
+
+Behavioral parity with the reference's ``hypha-messages`` crate
+(reference: crates/messages/src/lib.rs). Three protocols, all CBOR:
+
+  * ``/hypha-api/0.0.1``      — envelope over WorkerOffer / RenewLease /
+    JobStatus / DispatchJob / ParameterPull / ParameterPush / Data
+    (crates/messages/src/lib.rs:15-44, 137-214, 699-757);
+  * ``/hypha-health/0.0.1``   — ``{} -> {healthy}`` (:47-63);
+  * ``/hypha-progress/0.0.1`` — the DiLoCo control channel (:66-119).
+
+Gossipsub carries one message type: ``RequestWorker`` on topic
+``hypha/worker`` (:122-134; crates/scheduler/src/allocator.rs:24).
+
+Serialization: every dataclass below carries a ``_t`` tag in its wire dict so
+decoding is self-describing; enums serialize as tagged strings. Bytes go
+through :mod:`hypha_tpu.codec` (CBOR), mirroring the reference's ciborium.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+import uuid
+from dataclasses import dataclass, field
+from typing import Any, ClassVar
+
+from . import codec
+from .resources import Resources
+
+__all__ = [
+    "PROTOCOL_API",
+    "PROTOCOL_HEALTH",
+    "PROTOCOL_PROGRESS",
+    "TOPIC_WORKER",
+    "encode",
+    "decode",
+    "register",
+    # api
+    "WorkerOffer",
+    "RenewLease",
+    "RenewLeaseResponse",
+    "JobStatus",
+    "DispatchJob",
+    "DispatchJobResponse",
+    "DataRequest",
+    "DataResponse",
+    "ParameterPull",
+    "ParameterPush",
+    "Ack",
+    # health
+    "HealthRequest",
+    "HealthResponse",
+    # progress
+    "Progress",
+    "ProgressKind",
+    "ProgressResponse",
+    "ProgressResponseKind",
+    # gossip
+    "RequestWorker",
+    "PriceRange",
+    # value vocabulary
+    "ExecutorDescriptor",
+    "WorkerSpec",
+    "JobSpec",
+    "Executor",
+    "TrainExecutorConfig",
+    "AggregateExecutorConfig",
+    "Reference",
+    "Fetch",
+    "Send",
+    "Receive",
+    "TransferStrategy",
+    "ModelType",
+    "Preprocessor",
+    "Adam",
+    "Nesterov",
+    "LRScheduler",
+    "LRSchedulerKind",
+    "Loss",
+    "DataRecord",
+    "DataSlice",
+]
+
+PROTOCOL_API = "/hypha-api/0.0.1"
+PROTOCOL_HEALTH = "/hypha-health/0.0.1"
+PROTOCOL_PROGRESS = "/hypha-progress/0.0.1"
+TOPIC_WORKER = "hypha/worker"
+
+# --------------------------------------------------------------------------
+# Self-describing serialization: registry of tagged dataclasses.
+# --------------------------------------------------------------------------
+
+_REGISTRY: dict[str, type] = {}
+
+
+def register(cls):
+    """Class decorator: make a dataclass wire-serializable under its name."""
+    _REGISTRY[cls.__name__] = cls
+    return cls
+
+
+def _to_plain(obj: Any) -> Any:
+    if dataclasses.is_dataclass(obj) and not isinstance(obj, type):
+        d: dict[str, Any] = {"_t": type(obj).__name__}
+        for f in dataclasses.fields(obj):
+            v = getattr(obj, f.name)
+            if v is None and f.default is None:
+                continue  # omit optional-None for compactness
+            d[f.name] = _to_plain(v)
+        return d
+    if isinstance(obj, enum.Enum):
+        return {"_e": type(obj).__name__, "v": obj.value}
+    if isinstance(obj, (list, tuple)):
+        return [_to_plain(v) for v in obj]
+    if isinstance(obj, dict):
+        return {k: _to_plain(v) for k, v in obj.items()}
+    return obj
+
+
+_ENUMS: dict[str, type] = {}
+
+
+def _from_plain(obj: Any) -> Any:
+    if isinstance(obj, dict):
+        if "_t" in obj:
+            tag = obj["_t"]
+            if tag == "Resources":
+                return Resources.from_wire({k: v for k, v in obj.items() if k != "_t"})
+            cls = _REGISTRY.get(tag)
+            if cls is None:
+                raise ValueError(f"unknown wire tag {tag!r}")
+            kwargs = {k: _from_plain(v) for k, v in obj.items() if k != "_t"}
+            return cls(**kwargs)
+        if "_e" in obj:
+            ecls = _ENUMS.get(obj["_e"])
+            if ecls is None:
+                raise ValueError(f"unknown enum tag {obj['_e']!r}")
+            return ecls(obj["v"])
+        return {k: _from_plain(v) for k, v in obj.items()}
+    if isinstance(obj, list):
+        return [_from_plain(v) for v in obj]
+    return obj
+
+
+def encode(msg: Any) -> bytes:
+    return codec.dumps(_to_plain(msg))
+
+
+def decode(data: bytes) -> Any:
+    return _from_plain(codec.loads(data))
+
+
+def _enum(cls):
+    _ENUMS[cls.__name__] = cls
+    return cls
+
+
+# --------------------------------------------------------------------------
+# Value vocabulary (crates/messages/src/lib.rs:217-775)
+# --------------------------------------------------------------------------
+
+
+@_enum
+class ModelType(enum.Enum):
+    """Model head selector (crates/messages/src/lib.rs:421-460: 38 HF Auto
+    classes). The TPU framework resolves these against hypha_tpu.models
+    (native JAX definitions) first, falling back to HF flax/torch conversion."""
+
+    # generation / language modeling
+    CAUSAL_LM = "causal-lm"
+    MASKED_LM = "masked-lm"
+    SEQ2SEQ_LM = "seq2seq-lm"
+    # classification / regression heads
+    SEQUENCE_CLASSIFICATION = "sequence-classification"
+    TOKEN_CLASSIFICATION = "token-classification"
+    QUESTION_ANSWERING = "question-answering"
+    MULTIPLE_CHOICE = "multiple-choice"
+    NEXT_SENTENCE_PREDICTION = "next-sentence-prediction"
+    # speech
+    AUDIO_CLASSIFICATION = "audio-classification"
+    CTC = "ctc"
+    SPEECH_SEQ2SEQ = "speech-seq2seq"
+    AUDIO_FRAME_CLASSIFICATION = "audio-frame-classification"
+    AUDIO_XVECTOR = "audio-xvector"
+    TEXT_TO_WAVEFORM = "text-to-waveform"
+    TEXT_TO_SPECTROGRAM = "text-to-spectrogram"
+    # vision
+    IMAGE_CLASSIFICATION = "image-classification"
+    VIDEO_CLASSIFICATION = "video-classification"
+    IMAGE_SEGMENTATION = "image-segmentation"
+    SEMANTIC_SEGMENTATION = "semantic-segmentation"
+    INSTANCE_SEGMENTATION = "instance-segmentation"
+    UNIVERSAL_SEGMENTATION = "universal-segmentation"
+    OBJECT_DETECTION = "object-detection"
+    ZERO_SHOT_OBJECT_DETECTION = "zero-shot-object-detection"
+    ZERO_SHOT_IMAGE_CLASSIFICATION = "zero-shot-image-classification"
+    DEPTH_ESTIMATION = "depth-estimation"
+    MASKED_IMAGE_MODELING = "masked-image-modeling"
+    IMAGE_TO_IMAGE = "image-to-image"
+    KEYPOINT_DETECTION = "keypoint-detection"
+    # multimodal
+    VISION2SEQ = "vision2seq"
+    IMAGE_TEXT_TO_TEXT = "image-text-to-text"
+    DOCUMENT_QUESTION_ANSWERING = "document-question-answering"
+    VISUAL_QUESTION_ANSWERING = "visual-question-answering"
+    TABLE_QUESTION_ANSWERING = "table-question-answering"
+    # representation / misc
+    FEATURE_EXTRACTION = "feature-extraction"
+    IMAGE_FEATURE_EXTRACTION = "image-feature-extraction"
+    MASK_GENERATION = "mask-generation"
+    TIME_SERIES_PREDICTION = "time-series-prediction"
+    PRETRAINING = "pretraining"
+
+
+@_enum
+class Preprocessor(enum.Enum):
+    """HF Auto preprocessor selector (crates/messages/src/lib.rs:473-488)."""
+
+    TOKENIZER = "tokenizer"
+    IMAGE_PROCESSOR = "image-processor"
+    FEATURE_EXTRACTOR = "feature-extractor"
+    PROCESSOR = "processor"
+    VIDEO_PROCESSOR = "video-processor"
+
+
+@_enum
+class Loss(enum.Enum):
+    """Loss selector (crates/messages/src/lib.rs:662-670)."""
+
+    CROSS_ENTROPY = "cross-entropy"
+    MSE = "mse"
+    MAE = "mae"
+    BCE_WITH_LOGITS = "bce-with-logits"
+    NLL = "nll"
+
+
+@_enum
+class LRSchedulerKind(enum.Enum):
+    """LR schedule selector (crates/messages/src/lib.rs:674-687)."""
+
+    CONSTANT = "constant"
+    COSINE_WITH_WARMUP = "cosine-with-warmup"
+    LINEAR_WITH_WARMUP = "linear-with-warmup"
+    WSD = "wsd"
+
+
+@register
+@dataclass(slots=True)
+class LRScheduler:
+    kind: LRSchedulerKind = LRSchedulerKind.CONSTANT
+    warmup_steps: int = 0
+    total_steps: int = 0
+    # WSD split (fractions of total): stable phase ends at decay_start.
+    decay_start: float = 0.9
+
+
+@register
+@dataclass(slots=True)
+class Adam:
+    """Inner optimizer (crates/messages/src/lib.rs:645-652)."""
+
+    lr: float = 1e-3
+    betas: tuple | None = None  # defaults to (0.9, 0.999) at use site
+    epsilon: float | None = None  # defaults to 1e-8 at use site
+    weight_decay: float = 0.0
+
+    def __post_init__(self) -> None:
+        # Normalize so decode(encode(x)) == x: CBOR arrays decode as lists.
+        if self.betas is not None:
+            self.betas = tuple(self.betas)
+
+
+@register
+@dataclass(slots=True)
+class Nesterov:
+    """Outer optimizer (crates/messages/src/lib.rs:654-658)."""
+
+    lr: float = 0.7
+    momentum: float = 0.9
+
+
+@_enum
+class TransferStrategy(enum.Enum):
+    """Peer transfer strategy for Reference.PEERS (lib.rs:241-273)."""
+
+    ALL = "all"  # send to / accept from every listed peer
+    ANY = "any"  # first peer that works
+
+
+@register
+@dataclass(slots=True)
+class Reference:
+    """Fetch/send/receive addressing (crates/messages/src/lib.rs:241-273).
+
+    Exactly one of the variant field groups is populated:
+      * ``uri``                          — Uri variant,
+      * ``repo/revision/filenames/token``— HuggingFace variant,
+      * ``peers/strategy/resource``      — Peers variant,
+      * ``scheduler_peer/dataset``       — Scheduler variant.
+    """
+
+    uri: str | None = None
+    repo: str | None = None
+    revision: str | None = None
+    filenames: list | None = None
+    token: str | None = None
+    peers: list | None = None
+    strategy: TransferStrategy | None = None
+    resource: str | None = None
+    scheduler_peer: str | None = None
+    dataset: str | None = None
+
+    def variant(self) -> str:
+        if self.uri is not None:
+            return "uri"
+        if self.repo is not None:
+            return "huggingface"
+        if self.peers is not None:
+            return "peers"
+        if self.scheduler_peer is not None or self.dataset is not None:
+            return "scheduler"
+        raise ValueError("empty Reference")
+
+    # Constructors mirroring the reference's enum variants.
+    @classmethod
+    def from_uri(cls, uri: str) -> "Reference":
+        return cls(uri=uri)
+
+    @classmethod
+    def hugging_face(
+        cls, repo: str, filenames: list, revision: str = "main", token: str | None = None
+    ) -> "Reference":
+        if not repo or not filenames:
+            raise ValueError("HuggingFace reference needs repo and filenames")
+        return cls(repo=repo, revision=revision, filenames=list(filenames), token=token)
+
+    @classmethod
+    def from_peers(
+        cls, peers: list, resource: str, strategy: TransferStrategy = TransferStrategy.ALL
+    ) -> "Reference":
+        return cls(peers=list(peers), strategy=strategy, resource=resource)
+
+    @classmethod
+    def from_scheduler(cls, peer: str, dataset: str) -> "Reference":
+        return cls(scheduler_peer=peer, dataset=dataset)
+
+
+def _newtype_ref(name: str, allowed: frozenset):
+    """Reference newtype wrappers enforcing valid variants (lib.rs:277-417)."""
+
+    @dataclass(slots=True)
+    class _Wrapper:
+        ref: Reference
+
+        _ALLOWED: ClassVar[frozenset] = allowed
+
+        def __post_init__(self) -> None:
+            v = self.ref.variant()
+            if v not in self._ALLOWED:
+                raise ValueError(f"{name} does not allow Reference variant {v!r}")
+
+    _Wrapper.__name__ = _Wrapper.__qualname__ = name
+    _REGISTRY[name] = _Wrapper
+    return _Wrapper
+
+
+# Valid variants per wrapper follow lib.rs:277-417: fetch from anywhere;
+# send targets peers; receive accepts from peers.
+Fetch = _newtype_ref("Fetch", frozenset({"uri", "huggingface", "peers", "scheduler"}))
+Send = _newtype_ref("Send", frozenset({"peers"}))
+Receive = _newtype_ref("Receive", frozenset({"peers"}))
+
+
+@register
+@dataclass(slots=True)
+class ExecutorDescriptor:
+    """Names an executor class+implementation a worker supports.
+
+    Reference: crates/worker/src/config.rs:114-191 (class train|aggregate plus
+    a name such as ``diloco-transformer`` / ``parameter-server``)."""
+
+    executor_class: str  # "train" | "aggregate"
+    name: str
+
+
+@register
+@dataclass(slots=True)
+class WorkerSpec:
+    """What the scheduler wants (crates/messages/src/lib.rs:225-230)."""
+
+    resources: Resources
+    executor: list  # list[ExecutorDescriptor]
+
+
+@register
+@dataclass(slots=True)
+class TrainExecutorConfig:
+    """crates/messages/src/lib.rs:491-505."""
+
+    model: dict  # {"model_type": ModelType, "source": Fetch, "config": {...}}
+    data: Fetch
+    updates: Send
+    results: Receive
+    optimizer: Adam
+    batch_size: int
+    preprocessor: dict | None = None  # {"kind": Preprocessor, "source": Fetch, ...}
+    scheduler: LRScheduler | None = None
+    loss: Loss | None = None
+    # TPU-native extension: intra-replica sharding of the inner loop
+    # (SURVEY.md §2.8 "TPU-native equivalents"). Axis sizes over the replica's
+    # slice mesh; {} means single-chip.
+    sharding: dict | None = None  # {"dp": n, "fsdp": n, "tp": n, "sp": n, "ep": n}
+
+
+@register
+@dataclass(slots=True)
+class AggregateExecutorConfig:
+    """crates/messages/src/lib.rs:508-515."""
+
+    updates: Receive
+    results: Send
+    optimizer: Nesterov
+    num_workers: int = 0  # how many pseudo-gradients form one round
+
+
+@register
+@dataclass(slots=True)
+class Executor:
+    """Tagged union Train|Aggregate (crates/messages/src/lib.rs JobSpec)."""
+
+    kind: str  # "train" | "aggregate"
+    name: str  # executor implementation name, e.g. "diloco-transformer"
+    train: TrainExecutorConfig | None = None
+    aggregate: AggregateExecutorConfig | None = None
+
+    def __post_init__(self) -> None:
+        if self.kind not in ("train", "aggregate"):
+            raise ValueError(f"unknown executor kind {self.kind!r}")
+        if self.kind == "train" and self.train is None:
+            raise ValueError("train executor needs train config")
+        if self.kind == "aggregate" and self.aggregate is None:
+            raise ValueError("aggregate executor needs aggregate config")
+
+
+@register
+@dataclass(slots=True)
+class JobSpec:
+    """crates/messages/src/lib.rs:217-221."""
+
+    job_id: str
+    executor: Executor
+
+
+@register
+@dataclass(slots=True)
+class DataRecord:
+    """DHT record a data node announces (lib.rs:767-770)."""
+
+    num_slices: int
+
+
+@register
+@dataclass(slots=True)
+class DataSlice:
+    """Pull-stream resource header (lib.rs:772-775)."""
+
+    dataset: str
+    index: int
+
+
+# --------------------------------------------------------------------------
+# /hypha-api/0.0.1 envelope (lib.rs:15-44)
+# --------------------------------------------------------------------------
+
+
+@register
+@dataclass(slots=True)
+class PriceRange:
+    """Auction pricing (crates/scheduler/src/scheduler_config.rs PriceRange)."""
+
+    bid: float
+    max: float
+
+
+@register
+@dataclass(slots=True)
+class WorkerOffer:
+    """Worker -> scheduler auction counter-offer (lib.rs:137-157)."""
+
+    request_id: str
+    lease_id: str
+    peer_id: str
+    resources: Resources
+    price: float
+    expires_at: float  # wall-clock seconds; scheduler tightens deadlines by it
+    executors: list = field(default_factory=list)  # list[ExecutorDescriptor]
+
+
+@register
+@dataclass(slots=True)
+class RenewLease:
+    """Scheduler -> worker lease renewal; first renewal = acceptance
+    (lib.rs:160-179; rfc/2025-08-04 'Lease Renewal')."""
+
+    lease_id: str
+
+
+@register
+@dataclass(slots=True)
+class RenewLeaseResponse:
+    lease_id: str
+    timeout: float  # seconds of validity granted
+
+
+@register
+@dataclass(slots=True)
+class JobStatus:
+    """Worker -> scheduler job lifecycle event (lib.rs:203-214)."""
+
+    job_id: str
+    state: str  # "dispatched" | "running" | "completed" | "failed" | "cancelled"
+    message: str = ""
+
+
+@register
+@dataclass(slots=True)
+class DispatchJob:
+    """Scheduler -> worker (lib.rs:181-201)."""
+
+    lease_id: str
+    spec: JobSpec
+
+
+@register
+@dataclass(slots=True)
+class DispatchJobResponse:
+    accepted: bool
+    message: str = ""
+
+
+@register
+@dataclass(slots=True)
+class DataRequest:
+    """Worker -> scheduler: assign me the next slice (lib.rs:741-757)."""
+
+    dataset: str
+    peer_id: str = ""
+
+
+@register
+@dataclass(slots=True)
+class DataResponse:
+    data_provider: str
+    index: int
+
+
+@register
+@dataclass(slots=True)
+class ParameterPull:
+    """Defined-for-parity RPC (lib.rs:699-717; unused in the reference flow —
+    here it backs inference-serving weight fetch)."""
+
+    job_id: str
+    keys: list = field(default_factory=list)
+
+
+@register
+@dataclass(slots=True)
+class ParameterPush:
+    """lib.rs:720-739; see ParameterPull."""
+
+    job_id: str
+    round: int = 0
+
+
+@register
+@dataclass(slots=True)
+class Ack:
+    ok: bool = True
+    message: str = ""
+
+
+# --------------------------------------------------------------------------
+# /hypha-health/0.0.1 (lib.rs:47-63)
+# --------------------------------------------------------------------------
+
+
+@register
+@dataclass(slots=True)
+class HealthRequest:
+    pass
+
+
+@register
+@dataclass(slots=True)
+class HealthResponse:
+    healthy: bool
+
+
+# --------------------------------------------------------------------------
+# /hypha-progress/0.0.1 — the DiLoCo control channel (lib.rs:66-119)
+# --------------------------------------------------------------------------
+
+
+@_enum
+class ProgressKind(enum.Enum):
+    STATUS = "status"  # per-batch heartbeat carrying batch_size + timing
+    METRICS = "metrics"  # {round, metrics} for the metrics bridge
+    UPDATE = "update"  # worker entered the update phase (sent its delta)
+    UPDATED = "updated"  # parameter server finished an outer step
+    UPDATE_RECEIVED = "update-received"  # worker merged the broadcast update
+
+
+@register
+@dataclass(slots=True)
+class Progress:
+    kind: ProgressKind
+    job_id: str = ""
+    batch_size: int = 0
+    round: int = 0
+    metrics: dict = field(default_factory=dict)
+
+
+@_enum
+class ProgressResponseKind(enum.Enum):
+    OK = "ok"
+    CONTINUE = "continue"
+    SCHEDULE_UPDATE = "schedule-update"
+    DONE = "done"
+    ERROR = "error"
+
+
+@register
+@dataclass(slots=True, frozen=True)
+class ProgressResponse:
+    # Frozen: the batch scheduler returns shared singleton instances.
+    kind: ProgressResponseKind
+    counter: int = 0  # inner steps left before the update (SCHEDULE_UPDATE)
+    message: str = ""
+
+
+# --------------------------------------------------------------------------
+# Gossip: worker request ad (lib.rs:122-134)
+# --------------------------------------------------------------------------
+
+
+@register
+@dataclass(slots=True)
+class RequestWorker:
+    """Priced task-ad broadcast on topic ``hypha/worker``."""
+
+    id: str = field(default_factory=lambda: str(uuid.uuid4()))
+    spec: WorkerSpec | None = None
+    timeout: float = 0.2  # offer window seconds
+    bid: float = 0.0
+    reply_to: str = ""  # scheduler peer id to send WorkerOffer to

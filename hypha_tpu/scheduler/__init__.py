@@ -1,0 +1,19 @@
+"""Scheduler: DiLoCo orchestration — allocation, data/batch scheduling, tracking.
+
+Mirrors the reference's ``hypha-scheduler`` crate (SURVEY.md §2.4) with
+TPU-aware extensions (a leased TPU slice is one DiLoCo replica)."""
+
+from .statistics import RunningMean, RuntimeStatistic
+from .simulation import Projection, WorkerSim, project
+from .trackers import ProgressTracker, SliceTracker, WorkerState
+
+__all__ = [
+    "RunningMean",
+    "RuntimeStatistic",
+    "Projection",
+    "WorkerSim",
+    "project",
+    "ProgressTracker",
+    "SliceTracker",
+    "WorkerState",
+]

@@ -1,0 +1,148 @@
+"""The DiLoCo control-plane state machine.
+
+Reference: crates/scheduler/src/scheduling/batch_scheduler.rs:42-163.
+Per-worker lifecycle (mermaid at :45-52):
+
+    TRAINING --(projection says round reachable)--> UPDATE_SCHEDULED
+    UPDATE_SCHEDULED --(worker sent delta: Update)--> UPDATING
+    UPDATING --(worker merged broadcast: UpdateReceived)--> TRAINING | DONE
+
+The parameter server's ``Updated`` advances the round. On every worker
+``Status`` the scheduler records timing, decrements the round's sample
+counter, and runs the synchronization simulation with hard caps
+time_cap=10_000 ms / updates_cap=3 (:87-89); when the projection reaches the
+target uncapped it replies ``ScheduleUpdate{counter}`` telling that worker how
+many more batches to run before shipping its pseudo-gradient. The job is
+complete when every worker is DONE.
+
+This module is pure logic: the network layer feeds it decoded Progress
+messages and returns its ProgressResponse to the peer.
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+from ..messages import (
+    Progress,
+    ProgressKind,
+    ProgressResponse,
+    ProgressResponseKind,
+)
+from .simulation import WorkerSim, project
+from .trackers import ProgressTracker, WorkerState
+
+__all__ = ["BatchScheduler", "TIME_CAP_MS", "UPDATES_CAP"]
+
+# Hard simulation caps (batch_scheduler.rs:87-89).
+TIME_CAP_MS = 10_000.0
+UPDATES_CAP = 3
+
+_CONTINUE = ProgressResponse(kind=ProgressResponseKind.CONTINUE)
+_OK = ProgressResponse(kind=ProgressResponseKind.OK)
+_DONE = ProgressResponse(kind=ProgressResponseKind.DONE)
+
+
+class BatchScheduler:
+    def __init__(
+        self,
+        tracker: ProgressTracker,
+        on_metrics: Callable[[str, int, dict], None] | None = None,
+        on_complete: Callable[[], None] | None = None,
+        time_cap_ms: float = TIME_CAP_MS,
+        updates_cap: int = UPDATES_CAP,
+    ) -> None:
+        self.tracker = tracker
+        self._on_metrics = on_metrics
+        self._on_complete = on_complete
+        self.time_cap_ms = time_cap_ms
+        self.updates_cap = updates_cap
+        self.completed = False
+
+    # ------------------------------------------------------------------
+    def on_progress(self, peer: str, progress: Progress) -> ProgressResponse:
+        kind = progress.kind
+        if kind == ProgressKind.STATUS:
+            return self._on_status(peer, progress)
+        if kind == ProgressKind.METRICS:
+            if self._on_metrics is not None:
+                self._on_metrics(peer, progress.round, dict(progress.metrics))
+            return _OK
+        if kind == ProgressKind.UPDATE:
+            # Worker finished its countdown and shipped its pseudo-gradient.
+            if peer in self.tracker.peers:
+                self.tracker.set_state(peer, WorkerState.UPDATING)
+            return _OK
+        if kind == ProgressKind.UPDATED:
+            # Parameter server applied the outer step and broadcast weights.
+            # Only the designated PS peer may advance the round.
+            if peer != self.tracker.parameter_server:
+                return ProgressResponse(
+                    kind=ProgressResponseKind.ERROR, message="not the parameter server"
+                )
+            self.tracker.advance_round()
+            return _OK
+        if kind == ProgressKind.UPDATE_RECEIVED:
+            return self._on_update_received(peer)
+        return ProgressResponse(
+            kind=ProgressResponseKind.ERROR, message=f"unknown progress kind {kind}"
+        )
+
+    # ------------------------------------------------------------------
+    def _on_status(self, peer: str, progress: Progress) -> ProgressResponse:
+        if peer not in self.tracker.peers:
+            return ProgressResponse(
+                kind=ProgressResponseKind.ERROR, message="unknown worker"
+            )
+        state = self.tracker.state(peer)
+        if state == WorkerState.DONE:
+            return _DONE
+        self.tracker.update(peer, progress.batch_size)
+        if state != WorkerState.TRAINING:
+            # Already counting down / mid-update: keep going.
+            return _CONTINUE
+
+        # Simulate all workers still producing batches this round.
+        sim_peers = [
+            p
+            for p, s in zip(self.tracker.peers, self.tracker.states)
+            if s in (WorkerState.TRAINING, WorkerState.UPDATE_SCHEDULED)
+        ]
+        workers = [
+            WorkerSim(
+                batch_size=self.tracker.batch_sizes[self.tracker.index_of(p)],
+                mean_batch_ms=self.tracker.stats[self.tracker.index_of(p)].mean(),
+                elapsed_ms=self.tracker.elapsed_ms(p),
+            )
+            for p in sim_peers
+        ]
+        projection = project(
+            self.tracker.counter, workers, self.time_cap_ms, self.updates_cap
+        )
+        if projection.capped or projection.left > 0:
+            return _CONTINUE
+        # Round target reachable: schedule this worker's sync point.
+        counter = projection.updates[sim_peers.index(peer)]
+        self.tracker.set_state(peer, WorkerState.UPDATE_SCHEDULED)
+        return ProgressResponse(
+            kind=ProgressResponseKind.SCHEDULE_UPDATE, counter=counter
+        )
+
+    # ------------------------------------------------------------------
+    def _on_update_received(self, peer: str) -> ProgressResponse:
+        if peer not in self.tracker.peers:
+            return ProgressResponse(
+                kind=ProgressResponseKind.ERROR, message="unknown worker"
+            )
+        if self.tracker.round >= self.tracker.update_epochs:
+            self.tracker.set_state(peer, WorkerState.DONE)
+            if self.tracker.all_in(WorkerState.DONE) and not self.completed:
+                self.completed = True
+                if self._on_complete is not None:
+                    self._on_complete()
+            return _DONE
+        # Next round: back to training with a fresh timing baseline.
+        self.tracker.set_state(peer, WorkerState.TRAINING)
+        i = self.tracker.index_of(peer)
+        self.tracker.last_update[i] = self.tracker._clock()
+        return _CONTINUE

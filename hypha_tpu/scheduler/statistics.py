@@ -1,0 +1,60 @@
+"""Per-worker runtime statistics.
+
+Reference: crates/scheduler/src/statistics.rs:1-44 — a ``RuntimeStatistic``
+trait plus ``RunningMean``, the incremental mean of per-batch milliseconds
+that feeds the synchronization simulation.
+"""
+
+from __future__ import annotations
+
+__all__ = ["RuntimeStatistic", "RunningMean", "EwmaMean"]
+
+
+class RuntimeStatistic:
+    """Accumulates per-batch wall-clock samples; yields an expected value."""
+
+    def record(self, value_ms: float) -> None:
+        raise NotImplementedError
+
+    def mean(self) -> float | None:
+        """Expected per-batch ms, or None before any sample."""
+        raise NotImplementedError
+
+
+class RunningMean(RuntimeStatistic):
+    """Incremental arithmetic mean (crates/scheduler/src/statistics.rs)."""
+
+    def __init__(self) -> None:
+        self._count = 0
+        self._mean = 0.0
+
+    def record(self, value_ms: float) -> None:
+        self._count += 1
+        self._mean += (value_ms - self._mean) / self._count
+
+    def mean(self) -> float | None:
+        return self._mean if self._count else None
+
+    @property
+    def count(self) -> int:
+        return self._count
+
+
+class EwmaMean(RuntimeStatistic):
+    """Exponentially weighted mean — tracks drifting worker speed faster than
+    RunningMean (net-new; useful under preemption/elasticity)."""
+
+    def __init__(self, alpha: float = 0.2) -> None:
+        if not 0 < alpha <= 1:
+            raise ValueError("alpha in (0, 1]")
+        self._alpha = alpha
+        self._mean: float | None = None
+
+    def record(self, value_ms: float) -> None:
+        if self._mean is None:
+            self._mean = value_ms
+        else:
+            self._mean += self._alpha * (value_ms - self._mean)
+
+    def mean(self) -> float | None:
+        return self._mean

@@ -1,0 +1,191 @@
+"""Progress, worker and slice trackers.
+
+Reference: crates/scheduler/src/tracker/{progress.rs,worker.rs,slice.rs}
+(SURVEY.md §2.4). Pure logic with an injectable clock for deterministic tests.
+"""
+
+from __future__ import annotations
+
+import enum
+import time
+from typing import Callable
+
+from .statistics import RunningMean, RuntimeStatistic
+
+__all__ = ["WorkerState", "ProgressTracker", "SliceTracker"]
+
+
+class WorkerState(enum.Enum):
+    """Per-worker DiLoCo round state
+    (crates/scheduler/src/tracker/worker.rs:7-114; mermaid in
+    scheduling/batch_scheduler.rs:45-52)."""
+
+    TRAINING = "training"
+    UPDATE_SCHEDULED = "update-scheduled"
+    UPDATING = "updating"
+    UPDATE_RECEIVED = "update-received"
+    DONE = "done"
+
+
+class ProgressTracker:
+    """Round bookkeeping: a global sample counter plus per-worker timing stats.
+
+    Reference: crates/scheduler/src/tracker/progress.rs:9-67 and
+    tracker/worker.rs — per-worker parallel arrays of peer id, batch size,
+    time of last status, runtime statistic and state. ``update()`` decrements
+    the global counter by the reported batch and feeds the elapsed
+    milliseconds into that worker's statistic.
+    """
+
+    def __init__(
+        self,
+        parameter_server: str,
+        update_target: int,
+        update_epochs: int,
+        stat_factory: Callable[[], RuntimeStatistic] = RunningMean,
+        clock: Callable[[], float] = time.monotonic,
+    ) -> None:
+        self.parameter_server = parameter_server
+        self.update_target = update_target  # avg_samples_between_updates
+        self.update_epochs = update_epochs  # number of outer rounds
+        self.counter = update_target  # samples left in the current round
+        self.round = 0
+        self._clock = clock
+        self._stat_factory = stat_factory
+        self.round_start = clock()
+        # parallel arrays
+        self.peers: list[str] = []
+        self.batch_sizes: list[int] = []
+        self.last_update: list[float] = []  # clock() of last completed batch
+        self.stats: list[RuntimeStatistic] = []
+        self.states: list[WorkerState] = []
+
+    # -- membership ---------------------------------------------------------
+    def add_worker(self, peer: str, batch_size: int) -> None:
+        if peer in self.peers:
+            raise ValueError(f"worker {peer!r} already tracked")
+        self.peers.append(peer)
+        self.batch_sizes.append(batch_size)
+        self.last_update.append(self._clock())
+        self.stats.append(self._stat_factory())
+        self.states.append(WorkerState.TRAINING)
+
+    def index_of(self, peer: str) -> int:
+        return self.peers.index(peer)
+
+    def remove_worker(self, peer: str) -> None:
+        i = self.peers.index(peer)
+        for arr in (self.peers, self.batch_sizes, self.last_update, self.stats, self.states):
+            del arr[i]
+
+    # -- round progress -----------------------------------------------------
+    def update(self, peer: str, batch_size: int) -> None:
+        """A worker completed one batch of ``batch_size`` samples."""
+        i = self.index_of(peer)
+        now = self._clock()
+        elapsed_ms = (now - self.last_update[i]) * 1000.0
+        self.stats[i].record(elapsed_ms)
+        self.last_update[i] = now
+        self.counter -= batch_size
+
+    def elapsed_ms(self, peer: str) -> float:
+        i = self.index_of(peer)
+        return (self._clock() - self.last_update[i]) * 1000.0
+
+    def set_state(self, peer: str, state: WorkerState) -> None:
+        self.states[self.index_of(peer)] = state
+
+    def state(self, peer: str) -> WorkerState:
+        return self.states[self.index_of(peer)]
+
+    def all_in(self, *states: WorkerState) -> bool:
+        allowed = set(states)
+        return bool(self.states) and all(s in allowed for s in self.states)
+
+    def advance_round(self) -> None:
+        """Parameter server reported Updated: reset the sample counter."""
+        self.round += 1
+        self.counter = self.update_target
+        self.round_start = self._clock()
+
+    @property
+    def rounds_left(self) -> int:
+        return max(0, self.update_epochs - self.round)
+
+    def is_last_round(self) -> bool:
+        # During round k (0-based), k+1 rounds will have completed after the
+        # pending update; the job is done when that reaches update_epochs.
+        return self.round + 1 >= self.update_epochs
+
+
+class SliceTracker:
+    """Dataset slice assignment with peer affinity, work stealing and epochs.
+
+    Reference: crates/scheduler/src/tracker/slice.rs:35-114 — ``next(peer)``
+    prefers unprocessed slices previously assigned to the same peer (cache
+    reuse), then steals from the peer with the fewest remaining slices (the
+    slowest worker is the one still holding work late in the round), then
+    starts a new epoch resetting every slice to available.
+    """
+
+    def __init__(self, num_slices: int) -> None:
+        if num_slices <= 0:
+            raise ValueError("num_slices must be positive")
+        self.num_slices = num_slices
+        self._assigned: dict[int, str] = {}  # slice -> peer currently assigned
+        self._processed: set[int] = set()
+        self.epoch = 0
+
+    # -- queries ------------------------------------------------------------
+    def available(self) -> list[int]:
+        return [
+            i
+            for i in range(self.num_slices)
+            if i not in self._processed and i not in self._assigned
+        ]
+
+    def remaining_of(self, peer: str) -> list[int]:
+        return [i for i, p in self._assigned.items() if p == peer]
+
+    # -- assignment ---------------------------------------------------------
+    def next(self, peer: str) -> int:
+        """Pick the next slice for ``peer`` (slice.rs:65-100)."""
+        # 1. peer-affine: a slice this peer was already assigned (cache reuse)
+        mine = self.remaining_of(peer)
+        if mine:
+            return mine[0]
+        # 2. fresh available slice
+        avail = self.available()
+        if avail:
+            idx = avail[0]
+            self._assigned[idx] = peer
+            return idx
+        # 3. steal from the slowest peer = fewest remaining slices (slice.rs:65-90)
+        by_peer: dict[str, list[int]] = {}
+        for i, p in self._assigned.items():
+            by_peer.setdefault(p, []).append(i)
+        victims = [(len(v), p) for p, v in by_peer.items() if p != peer]
+        if victims:
+            _, victim = min(victims)
+            idx = min(by_peer[victim])
+            self._assigned[idx] = peer
+            return idx
+        # 4. everything processed: new epoch, reset all (slice.rs:91-100)
+        self.new_epoch()
+        idx = 0
+        self._assigned[idx] = peer
+        return idx
+
+    def mark_processed(self, index: int) -> None:
+        self._assigned.pop(index, None)
+        self._processed.add(index)
+
+    def new_epoch(self) -> None:
+        self.epoch += 1
+        self._assigned.clear()
+        self._processed.clear()
+
+    def remove_worker(self, peer: str) -> None:
+        """Reclaim a dead worker's slices (slice.rs:105-114)."""
+        for i in [i for i, p in self._assigned.items() if p == peer]:
+            del self._assigned[i]

@@ -1,0 +1,15 @@
+"""Test harness: force JAX onto a virtual 8-device CPU mesh.
+
+Mirrors the reference's testing philosophy (SURVEY.md §4): no real cluster in
+CI — multi-chip behavior is exercised on host-platform virtual devices, the
+distributed control plane on paused/injected clocks, and protocol logic on an
+in-process fake transport.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+os.environ.setdefault("JAX_ENABLE_X64", "0")

@@ -1,0 +1,307 @@
+"""Tests for resources, leases, CBOR codec and wire messages.
+
+Mirrors the reference's pure-logic unit layer (SURVEY.md §4)."""
+
+import math
+import time
+
+import pytest
+
+from hypha_tpu import codec, messages
+from hypha_tpu.leases import LeaseNotFound, Ledger
+from hypha_tpu.resources import InsufficientResources, Resources, WeightedResourceEvaluator
+
+
+# -- resources (crates/resources/src/lib.rs behaviors) -----------------------
+
+
+def test_resources_arithmetic():
+    a = Resources(gpu=2, cpu=8, memory=1024, storage=100)
+    b = Resources(gpu=1, cpu=4, memory=512, storage=50)
+    assert a + b == Resources(gpu=3, cpu=12, memory=1536, storage=150)
+    assert a - b == b
+    with pytest.raises(InsufficientResources):
+        _ = b - a
+    assert b.checked_sub(a) is None
+
+
+def test_resources_partial_order():
+    small = Resources(gpu=1, cpu=2)
+    big = Resources(gpu=2, cpu=4)
+    sideways = Resources(gpu=4, cpu=1)
+    assert small <= big and small < big
+    assert not (big <= small)
+    # incomparable pair: neither <= holds
+    assert not (big <= sideways) and not (sideways <= big)
+    assert small.fits_within(big)
+
+
+def test_resources_negative_rejected():
+    with pytest.raises(ValueError):
+        Resources(gpu=-1)
+
+
+def test_weighted_evaluator_reference_weights():
+    # Default weights gpu=25, cpu=1, mem=0.1, storage=0.01
+    # (crates/resources/src/lib.rs:180-189); tpu priced like gpu.
+    ev = WeightedResourceEvaluator()
+    r = Resources(gpu=2, cpu=10, memory=100, storage=1000)
+    units = 25 * 2 + 10 + 0.1 * 100 + 0.01 * 1000
+    assert math.isclose(ev.weighted_units(r), units)
+    assert math.isclose(ev.evaluate(80.0, r), 80.0 / units)
+    assert ev.evaluate(1.0, Resources()) == float("inf")
+    # lower score wins: cheaper per-unit offer scores lower
+    assert ev.evaluate(10.0, r) < ev.evaluate(20.0, r)
+
+
+def test_weighted_evaluator_tpu_axis():
+    ev = WeightedResourceEvaluator()
+    assert math.isclose(ev.weighted_units(Resources(tpu=4)), 100.0)
+
+
+# -- leases (crates/leases/src/lib.rs behaviors) ------------------------------
+
+
+def test_ledger_insert_get_remove():
+    led = Ledger()
+    lease = led.insert("payload", duration=10.0)
+    assert led.get(lease.id).leasable == "payload"
+    assert len(led) == 1
+    led.remove(lease.id)
+    with pytest.raises(LeaseNotFound):
+        led.get(lease.id)
+
+
+def test_ledger_renew_resets_from_now():
+    # renew = now + duration, not old expiry + duration (lib.rs:103-114)
+    now = [1000.0]
+    led = Ledger(clock=lambda: now[0])
+    lease = led.insert("x", duration=10.0)
+    assert lease.timeout == 1010.0
+    now[0] = 1009.0
+    led.renew(lease.id, 10.0)
+    assert led.get(lease.id).timeout == 1019.0
+
+
+def test_ledger_expiry_and_prune():
+    now = [0.0]
+    led = Ledger(clock=lambda: now[0])
+    a = led.insert("a", duration=5.0)
+    b = led.insert("b", duration=50.0)
+    now[0] = 6.0
+    expired = led.list_expired()
+    assert [l.id for l in expired] == [a.id]
+    popped = led.remove_expired()
+    assert [l.id for l in popped] == [a.id]
+    assert len(led) == 1 and led.get(b.id)
+
+
+def test_lease_wall_clock():
+    led = Ledger()
+    lease = led.insert("x", duration=100.0)
+    assert lease.timeout > time.time() + 50
+    assert not lease.is_expired()
+    assert lease.remaining() > 50
+
+
+# -- CBOR codec ---------------------------------------------------------------
+
+
+@pytest.mark.parametrize(
+    "obj",
+    [
+        0,
+        23,
+        24,
+        255,
+        256,
+        65535,
+        65536,
+        2**32,
+        -1,
+        -24,
+        -25,
+        -(2**31),
+        1.5,
+        -0.0,
+        True,
+        False,
+        None,
+        "",
+        "hello",
+        "ünïcodé",
+        b"",
+        b"\x00\xff",
+        [],
+        [1, [2, [3]]],
+        {},
+        {"a": 1, "b": [True, None]},
+        {"nested": {"x": b"bytes", "y": -7.25}},
+    ],
+)
+def test_cbor_roundtrip(obj):
+    assert codec.loads(codec.dumps(obj)) == obj
+
+
+def test_cbor_canonical_heads():
+    # shortest-form integer heads per RFC 8949
+    assert codec.dumps(0) == b"\x00"
+    assert codec.dumps(23) == b"\x17"
+    assert codec.dumps(24) == b"\x18\x18"
+    assert codec.dumps(500) == b"\x19\x01\xf4"
+    assert codec.dumps(-1) == b"\x20"
+    assert codec.dumps(None) == b"\xf6"
+    assert codec.dumps(True) == b"\xf5"
+
+
+def test_cbor_decode_interop_floats():
+    # f16 / f32 decode (encoders elsewhere may emit them)
+    import struct
+
+    assert codec.loads(b"\xf9\x3c\x00") == 1.0  # f16 1.0
+    assert codec.loads(b"\xfa" + struct.pack(">f", 2.5)) == 2.5
+
+
+def test_cbor_errors():
+    with pytest.raises(codec.CBORDecodeError):
+        codec.loads(b"\x18")  # truncated
+    with pytest.raises(codec.CBORDecodeError):
+        codec.loads(codec.dumps(1) + b"\x00")  # trailing
+    with pytest.raises(TypeError):
+        codec.dumps(object())
+
+
+# -- wire messages ------------------------------------------------------------
+
+
+def test_worker_offer_roundtrip():
+    offer = messages.WorkerOffer(
+        request_id="req-1",
+        lease_id="lease-1",
+        peer_id="peer-a",
+        resources=Resources(tpu=8, cpu=16, memory=2048),
+        price=42.5,
+        expires_at=123.0,
+        executors=[messages.ExecutorDescriptor("train", "diloco-transformer")],
+    )
+    out = messages.decode(messages.encode(offer))
+    assert out == offer
+    assert out.resources.tpu == 8
+
+
+def test_progress_roundtrip():
+    p = messages.Progress(
+        kind=messages.ProgressKind.METRICS, job_id="j", round=3, metrics={"loss": 0.5}
+    )
+    out = messages.decode(messages.encode(p))
+    assert out == p and out.kind is messages.ProgressKind.METRICS
+    r = messages.ProgressResponse(
+        kind=messages.ProgressResponseKind.SCHEDULE_UPDATE, counter=7
+    )
+    assert messages.decode(messages.encode(r)) == r
+
+
+def test_reference_newtype_validation():
+    # Send/Receive only allow the Peers variant (lib.rs:277-417)
+    peers_ref = messages.Reference.from_peers(["p1"], resource="updates")
+    messages.Send(peers_ref)
+    messages.Receive(peers_ref)
+    uri_ref = messages.Reference.from_uri("https://example.com/model.safetensors")
+    messages.Fetch(uri_ref)
+    with pytest.raises(ValueError):
+        messages.Send(uri_ref)
+    with pytest.raises(ValueError):
+        messages.Receive(uri_ref)
+    with pytest.raises(ValueError):
+        messages.Reference().variant()
+
+
+def test_hugging_face_reference_validation():
+    with pytest.raises(ValueError):
+        messages.Reference.hugging_face("", ["f"])
+    with pytest.raises(ValueError):
+        messages.Reference.hugging_face("repo", [])
+    ref = messages.Reference.hugging_face("gpt2", ["model.safetensors"])
+    assert ref.variant() == "huggingface"
+
+
+def test_dispatch_job_roundtrip():
+    cfg = messages.TrainExecutorConfig(
+        model={"model_type": messages.ModelType.CAUSAL_LM, "config": {"n_layer": 2}},
+        data=messages.Fetch(messages.Reference.from_scheduler("sched", "ds")),
+        updates=messages.Send(messages.Reference.from_peers(["ps"], "updates")),
+        results=messages.Receive(messages.Reference.from_peers(["ps"], "results")),
+        optimizer=messages.Adam(lr=1e-3),
+        batch_size=32,
+        scheduler=messages.LRScheduler(
+            kind=messages.LRSchedulerKind.COSINE_WITH_WARMUP, warmup_steps=10, total_steps=100
+        ),
+        sharding={"dp": 2, "tp": 4},
+    )
+    job = messages.DispatchJob(
+        lease_id="l1",
+        spec=messages.JobSpec(
+            job_id="job-1",
+            executor=messages.Executor(kind="train", name="diloco-transformer", train=cfg),
+        ),
+    )
+    out = messages.decode(messages.encode(job))
+    assert out == job
+    assert out.spec.executor.train.sharding == {"dp": 2, "tp": 4}
+
+
+def test_executor_union_validation():
+    with pytest.raises(ValueError):
+        messages.Executor(kind="train", name="x")
+    with pytest.raises(ValueError):
+        messages.Executor(kind="aggregate", name="x")
+
+
+def test_unknown_tag_rejected():
+    bad = codec.dumps({"_t": "NoSuchMessage"})
+    with pytest.raises(ValueError):
+        messages.decode(bad)
+
+
+def test_cbor_nesting_bomb_rejected():
+    # untrusted input: deep nesting must be a decode error, not RecursionError
+    with pytest.raises(codec.CBORDecodeError):
+        codec.loads(b"\x81" * 3000 + b"\x00")
+    deep = obj = []
+    for _ in range(100):
+        obj.append([])
+        obj = obj[0]
+    assert codec.loads(codec.dumps(deep)) == deep
+
+
+def test_cbor_malformed_input_typed_errors():
+    # mixed-type indefinite chunks, invalid UTF-8, unhashable map key, and
+    # out-of-range ints all surface as typed errors (code-review findings)
+    for frame in (b"\x5f\x00\xff", b"\x62\xc3\x28", b"\xa1\x80\x00"):
+        with pytest.raises(codec.CBORDecodeError):
+            codec.loads(frame)
+    with pytest.raises(TypeError):
+        codec.dumps(2**64)
+    with pytest.raises(TypeError):
+        codec.dumps(-(2**64) - 1)
+
+
+def test_adam_betas_roundtrip_equality():
+    a = messages.Adam(lr=1e-3, betas=(0.9, 0.999))
+    assert messages.decode(messages.encode(a)) == a
+
+
+def test_executor_unknown_kind_rejected():
+    with pytest.raises(ValueError):
+        messages.Executor(kind="Train", name="x")
+
+
+def test_stale_wrapper_tag_rejected():
+    with pytest.raises(ValueError):
+        messages.decode(codec.dumps({"_t": "_Wrapper"}))
+
+
+def test_progress_response_frozen():
+    r = messages.ProgressResponse(kind=messages.ProgressResponseKind.OK)
+    with pytest.raises(Exception):
+        r.message = "mutated"
