@@ -1,0 +1,90 @@
+"""Benchmark: tokens/sec/chip of the jitted DiLoCo inner train step on the
+flagship model (GPT-2-small, bf16), the metric BASELINE.md asks this repo to
+establish. Prints ONE JSON line.
+
+The reference publishes no model-level numbers (BASELINE.json published={}),
+so ``vs_baseline`` is measured against the reference-stack estimate recorded
+in BENCH_BASELINE.json when present, else reported as 1.0 alongside the
+absolute number.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+
+def main() -> None:
+    import jax
+    import jax.numpy as jnp
+
+    platform = jax.devices()[0].platform
+    on_accel = platform not in ("cpu",)
+
+    from hypha_tpu.executor.train import TrainState, build_optimizer, make_train_step
+    from hypha_tpu.messages import Adam
+    from hypha_tpu.models import GPT2, GPT2Config
+
+    if on_accel:
+        cfg = GPT2Config.small()  # 124M params, bf16 activations
+        B, S = 8, 1024
+        steps, warmup = 20, 3
+    else:  # CPU smoke fallback so the script always emits a line
+        cfg = GPT2Config(vocab_size=512, n_positions=256, n_embd=128, n_layer=2, n_head=4)
+        B, S = 2, 128
+        steps, warmup = 3, 1
+
+    model = GPT2(cfg)
+    ids = jax.random.randint(jax.random.key(1), (B, S), 0, cfg.vocab_size)
+    params = model.init(jax.random.key(0), ids)
+    state = TrainState.create(params, build_optimizer(Adam(lr=1e-4)))
+    step = make_train_step(model.apply)
+    batch = {"input_ids": ids}
+
+    for _ in range(warmup):
+        state, metrics = step(state, batch)
+    jax.block_until_ready(metrics["loss"])
+
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        state, metrics = step(state, batch)
+    jax.block_until_ready(metrics["loss"])
+    dt = time.perf_counter() - t0
+
+    tokens_per_sec = B * S * steps / dt
+    n_chips = 1  # single-chip inner loop benchmark
+    value = tokens_per_sec / n_chips
+
+    baseline = None
+    try:
+        with open(os.path.join(os.path.dirname(__file__), "BENCH_BASELINE.json")) as f:
+            baseline = json.load(f).get("tokens_per_sec_per_chip")
+    except Exception:
+        pass
+    vs = value / baseline if baseline else 1.0
+
+    print(
+        json.dumps(
+            {
+                "metric": "gpt2s_train_tokens_per_sec_per_chip",
+                "value": round(value, 1),
+                "unit": "tokens/s/chip",
+                "vs_baseline": round(vs, 3),
+                "platform": platform,
+                "batch": B,
+                "seq": S,
+                "steps": steps,
+                "loss": float(metrics["loss"]),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    try:
+        main()
+    except Exception as e:  # always emit a parseable line
+        print(json.dumps({"metric": "error", "value": 0, "unit": "", "vs_baseline": 0, "error": str(e)}))
+        sys.exit(1)

@@ -151,7 +151,13 @@ def _decode(fp: BytesIO, depth: int = 0) -> Any:
                     break
                 out.append(item)
             return out
-        return [_decode(fp, depth + 1) for _ in range(_read_uint(fp, info))]
+        out = []
+        for _ in range(_read_uint(fp, info)):
+            item = _decode(fp, depth + 1)
+            if item is _BREAK:
+                raise CBORDecodeError("break inside definite-length array")
+            out.append(item)
+        return out
     if major == 5:
         if info == 31:
             d = {}
@@ -161,7 +167,14 @@ def _decode(fp: BytesIO, depth: int = 0) -> Any:
                     break
                 d[k] = _decode(fp, depth + 1)
             return d
-        return {_decode(fp, depth + 1): _decode(fp, depth + 1) for _ in range(_read_uint(fp, info))}
+        d = {}
+        for _ in range(_read_uint(fp, info)):
+            mk = _decode(fp, depth + 1)
+            mv = _decode(fp, depth + 1)
+            if mk is _BREAK or mv is _BREAK:
+                raise CBORDecodeError("break inside definite-length map")
+            d[mk] = mv
+        return d
     if major == 6:  # tag: decode and discard the tag number
         _read_uint(fp, info)
         return _decode(fp, depth + 1)

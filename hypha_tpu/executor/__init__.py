@@ -1,0 +1,25 @@
+"""JAX execution layer: the inner training loop, DiLoCo delta algebra and the
+outer (aggregate) optimizer — the TPU-native replacement for the reference's
+``executors/accelerate`` Python package and the parameter-server executor's
+tensor math (SURVEY.md §2.6, §2.9)."""
+
+from .diloco import extract_delta, merge_update, nesterov_init, nesterov_outer_step
+from .train import (
+    TrainState,
+    build_optimizer,
+    compute_loss,
+    make_lr_schedule,
+    make_train_step,
+)
+
+__all__ = [
+    "extract_delta",
+    "merge_update",
+    "nesterov_init",
+    "nesterov_outer_step",
+    "TrainState",
+    "build_optimizer",
+    "compute_loss",
+    "make_lr_schedule",
+    "make_train_step",
+]

@@ -1,0 +1,81 @@
+"""DiLoCo delta algebra and the outer Nesterov optimizer, as jitted tree ops.
+
+Reference semantics being reproduced:
+  * pseudo-gradient: Δθ = θ_t − θ_0 against the round's anchor snapshot
+    (executors/accelerate/.../utils.py:119-124);
+  * worker merge: θ_new = θ_old + update — the update already contains
+    lr·(μ·m + ḡ), sign convention per utils.py:112-116;
+  * outer step (parameter server): m ← μ·m + ḡ;  update = lr·(μ·m + ḡ)
+    with ḡ = mean of worker pseudo-gradients
+    (crates/worker/src/executor/parameter_server.rs:386-446, verified there
+    against torch SGD(nesterov=True) — our golden test does the same);
+  * averaging is a single (optionally sample-weighted) mean, fixing the
+    reference's pairwise-average mis-weighting TODO (parameter_server.rs:192).
+
+On TPU all of these are jit-compiled pytree ops; across co-located replicas
+the mean lowers to an ICI collective (parallel.collectives).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+__all__ = [
+    "extract_delta",
+    "merge_update",
+    "average_deltas",
+    "nesterov_init",
+    "nesterov_outer_step",
+]
+
+
+@jax.jit
+def extract_delta(params, anchor):
+    """Pseudo-gradient Δθ = θ_t − θ_0 (both trees same structure)."""
+    return jax.tree.map(lambda p, a: (p - a).astype(jnp.float32), params, anchor)
+
+
+@jax.jit
+def merge_update(params, update):
+    """θ_new = θ + update, preserving each leaf's dtype."""
+    return jax.tree.map(lambda p, u: (p + u.astype(p.dtype)).astype(p.dtype), params, update)
+
+
+def average_deltas(deltas: list, weights=None):
+    """Mean of worker pseudo-gradients; ``weights`` = per-worker sample counts
+    for the sample-weighted fix."""
+    if not deltas:
+        raise ValueError("no deltas")
+    if weights is None:
+        w = jnp.full((len(deltas),), 1.0 / len(deltas), jnp.float32)
+    else:
+        w = jnp.asarray(weights, jnp.float32)
+        w = w / jnp.maximum(w.sum(), 1e-20)
+
+    def leaf_mean(*leaves):
+        stacked = jnp.stack([l.astype(jnp.float32) for l in leaves])
+        return jnp.tensordot(w, stacked, axes=1)
+
+    return jax.tree.map(leaf_mean, *deltas)
+
+
+def nesterov_init(params):
+    """Zero momentum buffers shaped like the param tree (f32)."""
+    return jax.tree.map(lambda p: jnp.zeros(p.shape, jnp.float32), params)
+
+
+@jax.jit
+def _nesterov(momentum, mean_delta, lr, mu):
+    m_new = jax.tree.map(lambda m, g: mu * m + g.astype(jnp.float32), momentum, mean_delta)
+    update = jax.tree.map(lambda m, g: lr * (mu * m + g.astype(jnp.float32)), m_new, mean_delta)
+    return m_new, update
+
+
+def nesterov_outer_step(momentum, mean_delta, lr: float, mu: float):
+    """One outer step: returns (new_momentum, update_to_broadcast).
+
+    Matches torch SGD(nesterov=True) on the ascent-direction pseudo-gradient:
+    buf ← μ·buf + ḡ; update = lr·(ḡ + μ·buf); θ ← θ + update.
+    """
+    return _nesterov(momentum, mean_delta, jnp.float32(lr), jnp.float32(mu))

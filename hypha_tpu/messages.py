@@ -114,7 +114,12 @@ def _to_plain(obj: Any) -> Any:
     if isinstance(obj, (list, tuple)):
         return [_to_plain(v) for v in obj]
     if isinstance(obj, dict):
-        return {k: _to_plain(v) for k, v in obj.items()}
+        plain = {k: _to_plain(v) for k, v in obj.items()}
+        # Escape user dicts that collide with the tagging scheme so they
+        # round-trip as data instead of materializing registry objects.
+        if any(k in plain for k in ("_t", "_e", "_d")):
+            return {"_d": plain}
+        return plain
     return obj
 
 
@@ -123,6 +128,8 @@ _ENUMS: dict[str, type] = {}
 
 def _from_plain(obj: Any) -> Any:
     if isinstance(obj, dict):
+        if "_d" in obj:  # escaped user dict (see _to_plain)
+            return {k: _from_plain(v) for k, v in obj["_d"].items()}
         if "_t" in obj:
             tag = obj["_t"]
             if tag == "Resources":
@@ -130,7 +137,12 @@ def _from_plain(obj: Any) -> Any:
             cls = _REGISTRY.get(tag)
             if cls is None:
                 raise ValueError(f"unknown wire tag {tag!r}")
-            kwargs = {k: _from_plain(v) for k, v in obj.items() if k != "_t"}
+            # Drop unknown fields: a newer peer may add optional fields and
+            # must not crash older decoders (serde-default behavior).
+            known = {f.name for f in dataclasses.fields(cls)}
+            kwargs = {
+                k: _from_plain(v) for k, v in obj.items() if k != "_t" and k in known
+            }
             return cls(**kwargs)
         if "_e" in obj:
             ecls = _ENUMS.get(obj["_e"])

@@ -1,0 +1,27 @@
+"""Native JAX/flax model families for the BASELINE configs.
+
+The reference loads models through 38 HF ``AutoModelFor*`` classes
+(executors/accelerate/.../model.py:48-123). TPU-native equivalents: the
+flagship families are defined natively here (static shapes, bf16 activations,
+MXU-sized matmuls, sharding-friendly param trees); anything else resolves
+through the registry's HF-conversion fallback (hypha_tpu.models.registry).
+"""
+
+from .lenet import LeNet, LeNetConfig
+from .gpt2 import GPT2, GPT2Config
+from .llama import Llama, LlamaConfig
+from .mixtral import Mixtral, MixtralConfig
+from .registry import build_model, resolve_model_type
+
+__all__ = [
+    "LeNet",
+    "LeNetConfig",
+    "GPT2",
+    "GPT2Config",
+    "Llama",
+    "LlamaConfig",
+    "Mixtral",
+    "MixtralConfig",
+    "build_model",
+    "resolve_model_type",
+]

@@ -1,0 +1,86 @@
+"""GPT-2 decoder (BASELINE configs 1-2: GPT-2-small DiLoCo).
+
+Native flax definition with an HF-compatible architecture (learned position
+embeddings, pre-LayerNorm blocks, gelu MLP, tied LM head) so HF ``gpt2``
+checkpoints convert 1:1 (hypha_tpu.models.registry). Activations run in a
+configurable dtype (bf16 on TPU); layer norms and softmax in f32.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from ..ops.attention import dot_product_attention
+
+__all__ = ["GPT2", "GPT2Config"]
+
+
+@dataclass(frozen=True)
+class GPT2Config:
+    vocab_size: int = 50257
+    n_positions: int = 1024
+    n_embd: int = 768
+    n_layer: int = 12
+    n_head: int = 12
+    dtype: str = "bfloat16"
+
+    @classmethod
+    def small(cls) -> "GPT2Config":
+        return cls()
+
+    @classmethod
+    def tiny(cls) -> "GPT2Config":
+        """CI-sized config for CPU tests."""
+        return cls(vocab_size=256, n_positions=128, n_embd=64, n_layer=2, n_head=4)
+
+
+class _Block(nn.Module):
+    config: GPT2Config
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
+        cfg = self.config
+        dtype = jnp.dtype(cfg.dtype)
+        B, S, E = x.shape
+        h = nn.LayerNorm(dtype=jnp.float32, name="ln_1")(x).astype(dtype)
+        qkv = nn.Dense(3 * E, dtype=dtype, name="c_attn")(h)
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        hd = E // cfg.n_head
+        q = q.reshape(B, S, cfg.n_head, hd)
+        k = k.reshape(B, S, cfg.n_head, hd)
+        v = v.reshape(B, S, cfg.n_head, hd)
+        attn = dot_product_attention(q, k, v, causal=True)
+        attn = attn.reshape(B, S, E)
+        x = x + nn.Dense(E, dtype=dtype, name="c_proj")(attn)
+
+        h = nn.LayerNorm(dtype=jnp.float32, name="ln_2")(x).astype(dtype)
+        h = nn.Dense(4 * E, dtype=dtype, name="c_fc")(h)
+        h = nn.gelu(h)
+        x = x + nn.Dense(E, dtype=dtype, name="mlp_proj")(h)
+        return x
+
+
+class GPT2(nn.Module):
+    config: GPT2Config = GPT2Config()
+
+    @nn.compact
+    def __call__(self, input_ids: jnp.ndarray) -> jnp.ndarray:
+        """input_ids [B, S] -> logits [B, S, vocab] (f32)."""
+        cfg = self.config
+        dtype = jnp.dtype(cfg.dtype)
+        B, S = input_ids.shape
+        wte = self.param(
+            "wte", nn.initializers.normal(0.02), (cfg.vocab_size, cfg.n_embd), jnp.float32
+        )
+        wpe = self.param(
+            "wpe", nn.initializers.normal(0.01), (cfg.n_positions, cfg.n_embd), jnp.float32
+        )
+        x = (wte[input_ids] + wpe[None, :S]).astype(dtype)
+        for i in range(cfg.n_layer):
+            x = _Block(cfg, name=f"h_{i}")(x)
+        x = nn.LayerNorm(dtype=jnp.float32, name="ln_f")(x)
+        # tied LM head: logits against the embedding matrix, f32 for the loss
+        return jnp.einsum("bse,ve->bsv", x.astype(jnp.float32), wte)

@@ -1,0 +1,37 @@
+"""LeNet-5 — the reference's default training job is LeNet/MNIST
+(crates/scheduler/src/scheduler_config.rs:79-102)."""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+__all__ = ["LeNet", "LeNetConfig"]
+
+
+@dataclass(frozen=True)
+class LeNetConfig:
+    num_classes: int = 10
+    dtype: str = "float32"
+
+
+class LeNet(nn.Module):
+    config: LeNetConfig = LeNetConfig()
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
+        # x: [B, 28, 28, 1]
+        dtype = jnp.dtype(self.config.dtype)
+        x = x.astype(dtype)
+        x = nn.Conv(6, (5, 5), padding="SAME", dtype=dtype, name="conv1")(x)
+        x = nn.relu(x)
+        x = nn.avg_pool(x, (2, 2), strides=(2, 2))
+        x = nn.Conv(16, (5, 5), padding="VALID", dtype=dtype, name="conv2")(x)
+        x = nn.relu(x)
+        x = nn.avg_pool(x, (2, 2), strides=(2, 2))
+        x = x.reshape((x.shape[0], -1))
+        x = nn.relu(nn.Dense(120, dtype=dtype, name="fc1")(x))
+        x = nn.relu(nn.Dense(84, dtype=dtype, name="fc2")(x))
+        return nn.Dense(self.config.num_classes, dtype=dtype, name="head")(x)

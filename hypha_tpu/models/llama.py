@@ -1,0 +1,150 @@
+"""Llama-2 family (BASELINE configs 3-4: Llama-2-7B DiLoCo fine-tune and
+inference serving).
+
+Native flax definition: RMSNorm, rotary embeddings, SwiGLU MLP,
+grouped-query attention. Param tree names are chosen to map 1:1 onto HF
+``LlamaForCausalLM`` checkpoints for conversion (registry). Long-context runs
+shard the sequence axis and swap the attention core for the ring kernel
+(hypha_tpu.ops.ring_attention) — the model takes an ``attn_impl`` hook so the
+executor can lower attention onto the mesh without redefining the model.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Callable
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from ..ops.attention import dot_product_attention
+from ..ops.rmsnorm import rms_norm
+from ..ops.rope import apply_rope, rope_frequencies
+
+__all__ = ["Llama", "LlamaConfig"]
+
+
+@dataclass(frozen=True)
+class LlamaConfig:
+    vocab_size: int = 32_000
+    hidden_size: int = 4096
+    intermediate_size: int = 11_008
+    num_layers: int = 32
+    num_heads: int = 32
+    num_kv_heads: int = 32
+    max_seq_len: int = 4096
+    rope_theta: float = 10_000.0
+    rms_eps: float = 1e-5
+    dtype: str = "bfloat16"
+
+    @classmethod
+    def llama2_7b(cls) -> "LlamaConfig":
+        return cls()
+
+    @classmethod
+    def tiny(cls) -> "LlamaConfig":
+        """CI-sized config for CPU tests (GQA exercised: 4 q heads, 2 kv)."""
+        return cls(
+            vocab_size=256,
+            hidden_size=64,
+            intermediate_size=128,
+            num_layers=2,
+            num_heads=4,
+            num_kv_heads=2,
+            max_seq_len=128,
+        )
+
+    @property
+    def head_dim(self) -> int:
+        return self.hidden_size // self.num_heads
+
+
+class _RMSNorm(nn.Module):
+    eps: float
+
+    @nn.compact
+    def __call__(self, x):
+        w = self.param("weight", nn.initializers.ones, (x.shape[-1],), jnp.float32)
+        return rms_norm(x, w, self.eps)
+
+
+class _Attention(nn.Module):
+    config: LlamaConfig
+    attn_impl: Callable | None = None
+
+    @nn.compact
+    def __call__(self, x, cos, sin):
+        cfg = self.config
+        dtype = jnp.dtype(cfg.dtype)
+        B, S, E = x.shape
+        hd = cfg.head_dim
+        q = nn.Dense(cfg.num_heads * hd, use_bias=False, dtype=dtype, name="q_proj")(x)
+        k = nn.Dense(cfg.num_kv_heads * hd, use_bias=False, dtype=dtype, name="k_proj")(x)
+        v = nn.Dense(cfg.num_kv_heads * hd, use_bias=False, dtype=dtype, name="v_proj")(x)
+        q = q.reshape(B, S, cfg.num_heads, hd)
+        k = k.reshape(B, S, cfg.num_kv_heads, hd)
+        v = v.reshape(B, S, cfg.num_kv_heads, hd)
+        q = apply_rope(q, cos, sin)
+        k = apply_rope(k, cos, sin)
+        attn = (self.attn_impl or dot_product_attention)(q, k, v, causal=True)
+        attn = attn.reshape(B, S, cfg.num_heads * hd)
+        return nn.Dense(E, use_bias=False, dtype=dtype, name="o_proj")(attn)
+
+
+class _MLP(nn.Module):
+    config: LlamaConfig
+
+    @nn.compact
+    def __call__(self, x):
+        cfg = self.config
+        dtype = jnp.dtype(cfg.dtype)
+        gate = nn.Dense(cfg.intermediate_size, use_bias=False, dtype=dtype, name="gate_proj")(x)
+        up = nn.Dense(cfg.intermediate_size, use_bias=False, dtype=dtype, name="up_proj")(x)
+        return nn.Dense(x.shape[-1], use_bias=False, dtype=dtype, name="down_proj")(
+            nn.silu(gate) * up
+        )
+
+
+class _Block(nn.Module):
+    config: LlamaConfig
+    attn_impl: Callable | None = None
+
+    @nn.compact
+    def __call__(self, x, cos, sin):
+        cfg = self.config
+        x = x + _Attention(cfg, self.attn_impl, name="self_attn")(
+            _RMSNorm(cfg.rms_eps, name="input_layernorm")(x), cos, sin
+        )
+        x = x + _MLP(cfg, name="mlp")(
+            _RMSNorm(cfg.rms_eps, name="post_attention_layernorm")(x)
+        )
+        return x
+
+
+class Llama(nn.Module):
+    config: LlamaConfig = LlamaConfig()
+    attn_impl: Callable | None = None  # e.g. a ring-attention closure
+
+    @nn.compact
+    def __call__(self, input_ids: jnp.ndarray) -> jnp.ndarray:
+        """input_ids [B, S] -> logits [B, S, vocab] (f32)."""
+        cfg = self.config
+        dtype = jnp.dtype(cfg.dtype)
+        embed = self.param(
+            "embed_tokens",
+            nn.initializers.normal(0.02),
+            (cfg.vocab_size, cfg.hidden_size),
+            jnp.float32,
+        )
+        x = embed[input_ids].astype(dtype)
+        cos, sin = rope_frequencies(cfg.head_dim, cfg.max_seq_len, cfg.rope_theta)
+        for i in range(cfg.num_layers):
+            x = _Block(cfg, self.attn_impl, name=f"layers_{i}")(x, cos, sin)
+        x = _RMSNorm(cfg.rms_eps, name="norm")(x)
+        lm_head = self.param(
+            "lm_head",
+            nn.initializers.normal(0.02),
+            (cfg.vocab_size, cfg.hidden_size),
+            jnp.float32,
+        )
+        return jnp.einsum("bse,ve->bsv", x.astype(jnp.float32), lm_head)

@@ -1,0 +1,76 @@
+"""Model registry: resolve a job's model spec to a flax module.
+
+The reference maps 38 ``ModelType`` variants to HF ``AutoModelFor*`` classes
+(executors/accelerate/.../model.py:48-123). Here the flagship families
+(GPT-2, Llama, Mixtral, LeNet) are native JAX definitions; other model types
+resolve through the HF-transformers fallback (converted torch weights) when
+``transformers`` is importable, and raise a clear error otherwise.
+
+A model spec is the ``model`` dict of a TrainExecutorConfig:
+  {"model_type": ModelType, "family": "gpt2"|"llama"|"mixtral"|"lenet"|"hf",
+   "config": {...family config overrides...}, "preset": "tiny"|"small"|...}
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+from ..messages import ModelType
+from .gpt2 import GPT2, GPT2Config
+from .lenet import LeNet, LeNetConfig
+from .llama import Llama, LlamaConfig
+from .mixtral import Mixtral, MixtralConfig
+
+__all__ = ["build_model", "resolve_model_type", "FAMILIES"]
+
+_PRESETS = {
+    "gpt2": {"tiny": GPT2Config.tiny, "small": GPT2Config.small},
+    "llama": {"tiny": LlamaConfig.tiny, "llama2-7b": LlamaConfig.llama2_7b},
+    "mixtral": {"tiny": MixtralConfig.tiny, "8x7b": MixtralConfig.mixtral_8x7b},
+    "lenet": {"default": LeNetConfig},
+}
+
+FAMILIES = {
+    "gpt2": (GPT2, GPT2Config),
+    "llama": (Llama, LlamaConfig),
+    "mixtral": (Mixtral, MixtralConfig),
+    "lenet": (LeNet, LeNetConfig),
+}
+
+
+def resolve_model_type(model_type: ModelType | str) -> ModelType:
+    if isinstance(model_type, ModelType):
+        return model_type
+    return ModelType(model_type)
+
+
+def build_model(spec: dict[str, Any], attn_impl=None):
+    """Build (module, config) from a job's model spec."""
+    family = spec.get("family")
+    if family is None:
+        mt = resolve_model_type(spec.get("model_type", ModelType.CAUSAL_LM))
+        family = {
+            ModelType.CAUSAL_LM: "gpt2",
+            ModelType.IMAGE_CLASSIFICATION: "lenet",
+        }.get(mt, "hf")
+    if family == "hf":
+        raise NotImplementedError(
+            "HF-converted model types are resolved by the executor's weight "
+            "converter; native families: " + ", ".join(FAMILIES)
+        )
+    if family not in FAMILIES:
+        raise ValueError(f"unknown model family {family!r}")
+    module_cls, config_cls = FAMILIES[family]
+    preset = spec.get("preset")
+    if preset is not None:
+        cfg = _PRESETS[family][preset]()
+    else:
+        cfg = config_cls()
+    overrides = spec.get("config") or {}
+    if overrides:
+        import dataclasses
+
+        cfg = dataclasses.replace(cfg, **overrides)
+    if family == "lenet":
+        return module_cls(cfg), cfg
+    return module_cls(cfg, attn_impl), cfg

@@ -1,0 +1,16 @@
+"""TPU compute ops: attention (XLA + pallas flash + ring/context-parallel),
+RoPE, RMSNorm. The reference has no custom kernels (its math lives inside
+torch/Accelerate — SURVEY.md §2.9); these are the TPU-native equivalents of
+that compute path, built MXU-first (large batched matmuls, bf16, static
+shapes)."""
+
+from .attention import dot_product_attention
+from .rope import apply_rope, rope_frequencies
+from .rmsnorm import rms_norm
+
+__all__ = [
+    "dot_product_attention",
+    "apply_rope",
+    "rope_frequencies",
+    "rms_norm",
+]

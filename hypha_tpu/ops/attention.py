@@ -1,0 +1,58 @@
+"""Multi-head attention core (GQA-aware), XLA path.
+
+Shapes follow the [batch, seq, heads, head_dim] convention throughout the
+framework. This is the reference XLA implementation: one fused softmax(QK^T)V
+that XLA tiles onto the MXU; the pallas flash kernel (ops/flash_attention.py)
+and the ring/context-parallel path (ops/ring_attention.py) are numerically
+checked against it.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+from einops import repeat
+
+__all__ = ["dot_product_attention"]
+
+
+def dot_product_attention(
+    q: jnp.ndarray,  # [B, Sq, H, D]
+    k: jnp.ndarray,  # [B, Sk, Hkv, D]
+    v: jnp.ndarray,  # [B, Sk, Hkv, D]
+    *,
+    causal: bool = True,
+    mask: jnp.ndarray | None = None,  # [B, 1, Sq, Sk] or broadcastable, bool
+    softmax_scale: float | None = None,
+    q_offset: int = 0,
+) -> jnp.ndarray:
+    """Scaled dot-product attention with grouped-query support.
+
+    ``q_offset`` shifts the causal diagonal — used for decoding (queries start
+    at position ``q_offset`` of the kv sequence) and by the ring-attention
+    blocks.
+    """
+    B, Sq, H, D = q.shape
+    _, Sk, Hkv, _ = k.shape
+    if H != Hkv:
+        if H % Hkv:
+            raise ValueError(f"query heads {H} not a multiple of kv heads {Hkv}")
+        k = repeat(k, "b s h d -> b s (h g) d", g=H // Hkv)
+        v = repeat(v, "b s h d -> b s (h g) d", g=H // Hkv)
+
+    scale = softmax_scale if softmax_scale is not None else D**-0.5
+    # [B, H, Sq, Sk]
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
+    logits = logits.astype(jnp.float32)  # softmax in f32 for stability
+
+    if causal:
+        qi = jnp.arange(Sq)[:, None] + q_offset
+        ki = jnp.arange(Sk)[None, :]
+        causal_mask = qi >= ki
+        logits = jnp.where(causal_mask[None, None], logits, -jnp.inf)
+    if mask is not None:
+        logits = jnp.where(mask, logits, -jnp.inf)
+
+    weights = jnp.nan_to_num(jnp.exp(logits - logits.max(-1, keepdims=True)))
+    weights = weights / jnp.maximum(weights.sum(-1, keepdims=True), 1e-20)
+    weights = weights.astype(v.dtype)
+    return jnp.einsum("bhqk,bkhd->bqhd", weights, v)

@@ -1,0 +1,16 @@
+"""RMSNorm — computed in f32, cast back (bf16-safe); XLA fuses this into the
+surrounding matmuls so a pallas kernel is not needed on the forward path."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+__all__ = ["rms_norm"]
+
+
+def rms_norm(x: jnp.ndarray, weight: jnp.ndarray, eps: float = 1e-5) -> jnp.ndarray:
+    dtype = x.dtype
+    x32 = x.astype(jnp.float32)
+    var = jnp.mean(x32 * x32, axis=-1, keepdims=True)
+    normed = x32 * jnp.reciprocal(jnp.sqrt(var + eps))
+    return (normed * weight.astype(jnp.float32)).astype(dtype)

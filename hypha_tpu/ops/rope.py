@@ -1,0 +1,33 @@
+"""Rotary position embeddings (Llama/Mixtral position encoding)."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+__all__ = ["rope_frequencies", "apply_rope"]
+
+
+def rope_frequencies(head_dim: int, max_len: int, theta: float = 10_000.0) -> tuple:
+    """Precompute (cos, sin) tables of shape [max_len, head_dim//2]."""
+    inv = 1.0 / (theta ** (jnp.arange(0, head_dim, 2, dtype=jnp.float32) / head_dim))
+    t = jnp.arange(max_len, dtype=jnp.float32)
+    freqs = jnp.outer(t, inv)  # [max_len, head_dim//2]
+    return jnp.cos(freqs), jnp.sin(freqs)
+
+
+def apply_rope(
+    x: jnp.ndarray,  # [B, S, H, D]
+    cos: jnp.ndarray,  # [max_len, D//2]
+    sin: jnp.ndarray,
+    positions: jnp.ndarray | None = None,  # [B, S] absolute positions
+) -> jnp.ndarray:
+    B, S, H, D = x.shape
+    if positions is None:
+        c = cos[:S][None, :, None, :]  # [1, S, 1, D/2]
+        s = sin[:S][None, :, None, :]
+    else:
+        c = cos[positions][:, :, None, :]  # [B, S, 1, D/2]
+        s = sin[positions][:, :, None, :]
+    x1, x2 = jnp.split(x.astype(jnp.float32), 2, axis=-1)
+    out = jnp.concatenate([x1 * c - x2 * s, x2 * c + x1 * s], axis=-1)
+    return out.astype(x.dtype)

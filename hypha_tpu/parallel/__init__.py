@@ -1,0 +1,25 @@
+"""Intra-replica parallelism: device mesh, sharding rules, collectives.
+
+The reference's only intra-worker parallelism is "whatever HF Accelerate
+does" (SURVEY.md §2.8); everything cross-worker is N streams into a
+parameter server. TPU-native design: one replica = one TPU slice = one
+``jax.sharding.Mesh`` with axes (dp, fsdp, ep, tp, sp); the inner loop is a
+pjit-compiled step whose shardings make XLA insert the collectives over ICI.
+The DiLoCo outer step stays on the control-plane network across replicas and
+lowers to a psum when replicas are co-located on one slice.
+"""
+
+from .mesh import MESH_AXES, create_mesh, local_mesh
+from .sharding import batch_spec, param_sharding, shard_params
+from .collectives import cross_replica_mean, tree_psum
+
+__all__ = [
+    "MESH_AXES",
+    "create_mesh",
+    "local_mesh",
+    "batch_spec",
+    "param_sharding",
+    "shard_params",
+    "cross_replica_mean",
+    "tree_psum",
+]

@@ -1,0 +1,52 @@
+"""Device mesh construction.
+
+Axes (in fixed order, outer to inner — outer axes map to slower links):
+  dp    data parallel (pure replication of params)
+  fsdp  fully-sharded data parallel (params sharded, gathered per layer)
+  ep    expert parallel (MoE expert axis)
+  tp    tensor parallel (attention heads / mlp hidden)
+  sp    sequence/context parallel (ring attention)
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import jax
+from jax.sharding import Mesh
+
+__all__ = ["MESH_AXES", "create_mesh", "local_mesh"]
+
+MESH_AXES = ("dp", "fsdp", "ep", "tp", "sp")
+
+
+def create_mesh(axis_sizes: dict[str, int] | None = None, devices=None) -> Mesh:
+    """Build a Mesh over ``devices`` with the given axis sizes.
+
+    Missing axes default to 1; one axis may be -1 to absorb the remaining
+    devices. The total must equal the device count.
+    """
+    devices = list(devices if devices is not None else jax.devices())
+    sizes = {a: 1 for a in MESH_AXES}
+    sizes.update(axis_sizes or {})
+    unknown = set(sizes) - set(MESH_AXES)
+    if unknown:
+        raise ValueError(f"unknown mesh axes {sorted(unknown)}; valid: {MESH_AXES}")
+    wild = [a for a, s in sizes.items() if s == -1]
+    if len(wild) > 1:
+        raise ValueError("at most one axis may be -1")
+    fixed = int(np.prod([s for s in sizes.values() if s != -1]))
+    if wild:
+        if len(devices) % fixed:
+            raise ValueError(f"{len(devices)} devices not divisible by {fixed}")
+        sizes[wild[0]] = len(devices) // fixed
+    total = int(np.prod(list(sizes.values())))
+    if total != len(devices):
+        raise ValueError(f"mesh size {total} != device count {len(devices)}")
+    shape = tuple(sizes[a] for a in MESH_AXES)
+    arr = np.asarray(devices).reshape(shape)
+    return Mesh(arr, MESH_AXES)
+
+
+def local_mesh(**axis_sizes: int) -> Mesh:
+    """Convenience: mesh over all local devices, e.g. local_mesh(dp=2, tp=4)."""
+    return create_mesh(axis_sizes)

@@ -1,0 +1,115 @@
+"""Sharding rules: parameter-path regex -> PartitionSpec.
+
+Externalized (t5x-style) so model definitions stay annotation-free. Rules
+cover the native families (GPT-2, Llama, Mixtral, LeNet). Conventions:
+
+  * weight matrices shard their *input* features over ``fsdp`` and *output*
+    features over ``tp`` for up-projections, and the reverse for
+    down-projections, so each matmul's collective is a single
+    all-gather/reduce-scatter pair over ICI;
+  * vocab/embedding tables shard vocab over ``tp`` and hidden over ``fsdp``;
+  * MoE stacked expert tensors put the leading expert axis on ``ep``;
+  * biases/norms replicate;
+  * the batch axis of inputs shards over (dp, fsdp); sequence over ``sp``.
+"""
+
+from __future__ import annotations
+
+import re
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+__all__ = ["PARAM_RULES", "batch_spec", "spec_for_path", "param_sharding", "shard_params"]
+
+# Ordered (regex, PartitionSpec factory) — first match wins. Paths are
+# '/'-joined flattened param-tree keys.
+PARAM_RULES: list[tuple[str, P]] = [
+    # --- MoE stacked experts [E, D, F] / [E, F, D] -------------------------
+    (r".*moe/w_gate$", P("ep", "fsdp", "tp")),
+    (r".*moe/w_up$", P("ep", "fsdp", "tp")),
+    (r".*moe/w_down$", P("ep", "tp", "fsdp")),
+    (r".*moe/gate/kernel$", P("fsdp", None)),  # router stays small
+    # --- Llama/Mixtral attention ------------------------------------------
+    (r".*(q_proj|k_proj|v_proj)/kernel$", P("fsdp", "tp")),
+    (r".*o_proj/kernel$", P("tp", "fsdp")),
+    (r".*(gate_proj|up_proj)/kernel$", P("fsdp", "tp")),
+    (r".*down_proj/kernel$", P("tp", "fsdp")),
+    (r".*(embed_tokens|lm_head)$", P("tp", "fsdp")),
+    # --- GPT-2 -------------------------------------------------------------
+    (r".*c_attn/kernel$", P("fsdp", "tp")),
+    (r".*c_proj/kernel$", P("tp", "fsdp")),
+    (r".*c_fc/kernel$", P("fsdp", "tp")),
+    (r".*mlp_proj/kernel$", P("tp", "fsdp")),
+    (r".*wte$", P("tp", "fsdp")),
+    (r".*wpe$", P(None, "fsdp")),
+    # --- LeNet (tiny: replicate) ------------------------------------------
+    (r".*conv\d/kernel$", P()),
+    # --- dense biases shard with their output axis when tp-sharded --------
+    (r".*(c_attn|c_fc)/bias$", P("tp")),
+    (r".*(c_proj|mlp_proj)/bias$", P("fsdp")),
+]
+
+_DEFAULT = P()  # replicate anything unmatched (norms, scalars, small heads)
+
+
+def batch_spec(seq_sharded: bool = False) -> P:
+    """Sharding of [B, S, ...] activations/inputs."""
+    return P(("dp", "fsdp"), "sp" if seq_sharded else None)
+
+
+def spec_for_path(path: str) -> P:
+    for pattern, spec in PARAM_RULES:
+        if re.match(pattern, path):
+            return spec
+    return _DEFAULT
+
+
+def _flat_paths(tree) -> list[tuple[tuple, str]]:
+    flat, _ = jax.tree_util.tree_flatten_with_path(tree)
+    out = []
+    for keypath, _leaf in flat:
+        parts = []
+        for k in keypath:
+            if hasattr(k, "key"):
+                parts.append(str(k.key))
+            elif hasattr(k, "idx"):
+                parts.append(str(k.idx))
+            else:
+                parts.append(str(k))
+        out.append((keypath, "/".join(parts)))
+    return out
+
+
+def _clamp_spec(spec: P, shape: tuple, mesh: Mesh) -> P:
+    """Drop sharding on axes that don't divide evenly (tiny test models)."""
+    entries = list(spec) + [None] * (len(shape) - len(spec))
+    out = []
+    for dim, entry in zip(shape, entries[: len(shape)]):
+        if entry is None:
+            out.append(None)
+            continue
+        axes = entry if isinstance(entry, tuple) else (entry,)
+        size = 1
+        for a in axes:
+            size *= mesh.shape[a]
+        out.append(entry if size > 0 and dim % size == 0 else None)
+    while out and out[-1] is None:
+        out.pop()
+    return P(*out)
+
+
+def param_sharding(params, mesh: Mesh):
+    """Tree of NamedSharding matching ``params``' structure."""
+    flat, treedef = jax.tree_util.tree_flatten_with_path(params)
+    paths = _flat_paths(params)
+    shardings = []
+    for (_, leaf), (_, path) in zip(flat, paths):
+        spec = _clamp_spec(spec_for_path(path), getattr(leaf, "shape", ()), mesh)
+        shardings.append(NamedSharding(mesh, spec))
+    return jax.tree_util.tree_unflatten(treedef, shardings)
+
+
+def shard_params(params, mesh: Mesh):
+    """Place ``params`` onto the mesh according to the rules."""
+    return jax.device_put(params, param_sharding(params, mesh))

@@ -4,12 +4,31 @@ Mirrors the reference's testing philosophy (SURVEY.md §4): no real cluster in
 CI — multi-chip behavior is exercised on host-platform virtual devices, the
 distributed control plane on paused/injected clocks, and protocol logic on an
 in-process fake transport.
+
+This environment registers a remote-TPU PJRT plugin ("axon") from
+sitecustomize before conftest runs; initializing it dials a network relay and
+can block for minutes. Tests must never touch it, so we both select the CPU
+platform and drop the remote factories from the registry.
 """
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["JAX_PLATFORMS"] = "cpu"
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
 os.environ.setdefault("JAX_ENABLE_X64", "0")
+
+# jax captured jax_platforms from the env at import time (sitecustomize
+# imports jax before conftest runs); override the live config first — this is
+# the load-bearing step that keeps tests off the remote backend.
+import jax as _jax
+
+_jax.config.update("jax_platforms", "cpu")
+
+try:  # best-effort: drop the remote factory too (private API, may churn)
+    from jax._src import xla_bridge as _xb
+
+    _xb._backend_factories.pop("axon", None)
+except Exception:
+    pass

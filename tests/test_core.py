@@ -305,3 +305,25 @@ def test_progress_response_frozen():
     r = messages.ProgressResponse(kind=messages.ProgressResponseKind.OK)
     with pytest.raises(Exception):
         r.message = "mutated"
+
+
+def test_cbor_break_inside_definite_rejected():
+    for frame in (b"\x81\xff", b"\xa1\x00\xff"):
+        with pytest.raises(codec.CBORDecodeError):
+            codec.loads(frame)
+
+
+def test_decode_drops_unknown_fields():
+    # forward compat: newer peers may add optional fields
+    out = messages.decode(codec.dumps({"_t": "Ack", "ok": True, "new_field": 7}))
+    assert out == messages.Ack(ok=True)
+
+
+def test_reserved_keys_in_user_dicts_roundtrip():
+    p = messages.Progress(
+        kind=messages.ProgressKind.METRICS,
+        metrics={"_t": "Ack", "_e": "x", "_d": 1, "loss": 0.5},
+    )
+    out = messages.decode(messages.encode(p))
+    assert out.metrics == {"_t": "Ack", "_e": "x", "_d": 1, "loss": 0.5}
+    assert isinstance(out.metrics, dict)  # no registry object materialized
