@@ -1,0 +1,52 @@
+"""L1 networking: the framework's communication fabric.
+
+A ground-up asyncio re-design of the role the reference's ``hypha-network``
+crate plays (reference: crates/network/src/lib.rs:37-47): typed CBOR RPC with
+fluent handler registration, gossip pub/sub, record/provider discovery, and
+raw push/pull byte streams for tensor data — over pluggable transports
+(in-process memory fabric for tests, TCP(+mTLS) for deployments).
+
+Where the reference composes libp2p behaviours driven by one event loop
+(Action/Driver/Interface triads, crates/network/src/gossipsub.rs:51-232), this
+fabric keeps the same load-bearing property — a single owner task per node
+processing every wire event, with typed async interfaces for applications —
+expressed natively in asyncio: the :class:`~hypha_tpu.network.node.Node`
+accept-loop is the driver; its methods are the interface; transports replace
+the swarm.
+
+Design notes (TPU-first):
+  * Every logical stream is its own transport stream (the reference found
+    parallel streams outperform multiplexing: rfc/2025-03-25 ~1 GB/s with
+    parallel streams); tensor payloads are raw bytes after a bounded header.
+  * Discovery is gateway-anchored (the reference's Kademlia is likewise
+    anchored on gateway bootstrap nodes in ``Mode::Server``,
+    crates/gateway/src/network.rs:152); records/providers live on gateways,
+    clients cache.
+  * Gossip is flood-with-dedup over the connected mesh — behaviorally
+    equivalent to gossipsub for the single topic the product uses
+    (``hypha/worker`` auction ads) at datacenter scale.
+"""
+
+from .fabric import (
+    FrameError,
+    MemoryTransport,
+    Stream,
+    TcpTransport,
+    Transport,
+    read_frame,
+    write_frame,
+)
+from .node import HandlerRegistration, Node, RequestError
+
+__all__ = [
+    "Node",
+    "RequestError",
+    "HandlerRegistration",
+    "Transport",
+    "MemoryTransport",
+    "TcpTransport",
+    "Stream",
+    "FrameError",
+    "read_frame",
+    "write_frame",
+]

@@ -1,0 +1,882 @@
+"""The Node: one identity on the fabric, with typed services.
+
+This is the framework's equivalent of a composed libp2p swarm + the
+Action/Driver/Interface triads of the reference's ``hypha-network``
+(reference: crates/network/src/lib.rs:37-47). One asyncio accept-loop per
+node owns every inbound stream (the "driver"); the public async methods are
+the "interfaces":
+
+  * typed CBOR RPC with fluent, first-wins handler registration
+    (reference: crates/network/src/request_response.rs:44-55 fluent API,
+    :503-519 first-wins matching, auto-unregister on drop :492-500);
+  * gossip pub/sub with flood + message-id dedup
+    (reference: crates/network/src/gossipsub.rs);
+  * record/provider discovery anchored on gateway registry servers
+    (reference: crates/network/src/kad.rs — Kademlia anchored on gateways);
+  * raw push/pull tensor byte streams with bounded headers and inbound
+    accept limits (reference: crates/network/src/stream_push.rs:16-89,
+    stream_pull.rs:21-146).
+
+Wire handshake (every stream): dialer sends one frame
+``{from, proto, addr}`` — ``addr`` is the dialer's primary listen address so
+the responder can dial back (the identify role). Under mTLS the responder
+verifies ``from`` equals the certificate-derived peer id.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import time
+import uuid
+from collections import OrderedDict
+from dataclasses import dataclass, field
+from typing import Any, AsyncIterator, Awaitable, Callable
+
+from .. import messages
+from .fabric import MAX_FRAME, FrameError, Stream, Transport, copy_stream
+
+__all__ = [
+    "Node",
+    "RequestError",
+    "HandlerRegistration",
+    "Subscription",
+    "PushStream",
+    "PROTOCOL_GOSSIP",
+    "PROTOCOL_REGISTRY",
+    "PROTOCOL_PUSH",
+    "PROTOCOL_PULL",
+]
+
+log = logging.getLogger("hypha.network")
+
+PROTOCOL_GOSSIP = "/hypha-gossip/0.0.1"
+PROTOCOL_REGISTRY = "/hypha-registry/0.0.1"
+# Tensor stream protocol ids follow the reference names
+# (crates/network/src/stream_push.rs:16, stream_pull.rs:21).
+PROTOCOL_PUSH = "/hypha-tensor-stream/push"
+PROTOCOL_PULL = "/hypha-tensor-stream/pull"
+
+# Header frames on tensor streams are capped at 1 MiB
+# (reference: crates/network/src/stream_pull.rs:28).
+MAX_STREAM_HEADER = 1024 * 1024
+# Inbound tensor streams accepted concurrently per protocol
+# (reference: accept_with_limit(.., 8), stream_push.rs:56).
+ACCEPT_LIMIT = 8
+# Providers age out unless re-announced (clients refresh every 30 s).
+PROVIDER_TTL = 90.0
+
+_SEEN_CAP = 4096  # gossip dedup cache entries
+
+
+class RequestError(RuntimeError):
+    """Remote handler failed or RPC transport failed."""
+
+
+@dataclass(slots=True)
+class _Handler:
+    protocol: str
+    msg_type: type | None
+    fn: Callable[[str, Any], Awaitable[Any]]
+    semaphore: asyncio.Semaphore
+    registration: "HandlerRegistration"
+
+    def matches(self, msg: Any) -> bool:
+        return self.msg_type is None or isinstance(msg, self.msg_type)
+
+
+class HandlerRegistration:
+    """Handle returned by ``respond_with``; unregister via close()/ctx-mgr.
+
+    Mirrors the reference's auto-unregister-on-drop handler streams
+    (crates/network/src/request_response.rs:492-500).
+    """
+
+    def __init__(self, node: "Node") -> None:
+        self._node = node
+        self._handler: _Handler | None = None
+        self.closed = False
+
+    def close(self) -> None:
+        if not self.closed:
+            self.closed = True
+            self._node._unregister(self._handler)
+
+    def __enter__(self) -> "HandlerRegistration":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+
+class HandlerBuilder:
+    """Fluent RPC handler registration: ``node.on(proto, Type)
+    .concurrency(8).respond_with(handler)`` — reference fluent API shape
+    (crates/network/src/request_response.rs:44-55)."""
+
+    def __init__(self, node: "Node", protocol: str, msg_type: type | None) -> None:
+        self._node = node
+        self._protocol = protocol
+        self._msg_type = msg_type
+        self._concurrency = 16
+
+    def concurrency(self, n: int) -> "HandlerBuilder":
+        self._concurrency = n
+        return self
+
+    def respond_with(
+        self, fn: Callable[[str, Any], Awaitable[Any]]
+    ) -> HandlerRegistration:
+        """fn(peer_id, msg) -> response message (raised errors become
+        RequestError at the caller)."""
+        reg = HandlerRegistration(self._node)
+        handler = _Handler(
+            protocol=self._protocol,
+            msg_type=self._msg_type,
+            fn=fn,
+            semaphore=asyncio.Semaphore(self._concurrency),
+            registration=reg,
+        )
+        reg._handler = handler
+        self._node._register(handler)
+        return reg
+
+    def into_stream(self, buffer: int = 64) -> "RequestStream":
+        """Async iterator of (peer, msg, respond) triples."""
+        stream = RequestStream(buffer)
+
+        async def fn(peer: str, msg: Any) -> Any:
+            fut: asyncio.Future = asyncio.get_running_loop().create_future()
+            await stream._queue.put((peer, msg, fut))
+            return await fut
+
+        stream.registration = self.respond_with(fn)
+        return stream
+
+
+class RequestStream:
+    def __init__(self, buffer: int) -> None:
+        self._queue: asyncio.Queue = asyncio.Queue(maxsize=buffer)
+        self.registration: HandlerRegistration | None = None
+
+    def __aiter__(self) -> "RequestStream":
+        return self
+
+    async def __anext__(self) -> tuple[str, Any, Callable[[Any], None]]:
+        peer, msg, fut = await self._queue.get()
+
+        def respond(response: Any) -> None:
+            if not fut.done():
+                fut.set_result(response)
+
+        return peer, msg, respond
+
+    def close(self) -> None:
+        if self.registration:
+            self.registration.close()
+
+
+class Subscription:
+    """A live gossip subscription; async-iterate (from_peer, msg)."""
+
+    def __init__(self, node: "Node", topic: str, buffer: int = 256) -> None:
+        self._node = node
+        self.topic = topic
+        self._queue: asyncio.Queue = asyncio.Queue(maxsize=buffer)
+        self.closed = False
+
+    def _deliver(self, from_peer: str, msg: Any) -> None:
+        if self.closed:
+            return
+        try:
+            self._queue.put_nowait((from_peer, msg))
+        except asyncio.QueueFull:
+            log.warning("gossip subscriber slow; dropping message on %s", self.topic)
+
+    def __aiter__(self) -> "Subscription":
+        return self
+
+    async def __anext__(self) -> tuple[str, Any]:
+        if self.closed:
+            raise StopAsyncIteration
+        item = await self._queue.get()
+        if item is None:  # close() sentinel
+            raise StopAsyncIteration
+        return item
+
+    async def close(self) -> None:
+        if not self.closed:
+            self.closed = True
+            await self._node._unsubscribe(self)
+            # Wake a consumer already blocked in __anext__.
+            try:
+                self._queue.put_nowait(None)
+            except asyncio.QueueFull:
+                pass
+
+
+@dataclass(slots=True)
+class PushStream:
+    """An accepted inbound push: header + raw byte reader."""
+
+    peer: str
+    resource: Any
+    stream: Stream
+    _done: Callable[[], None] = field(default=lambda: None)
+
+    async def read_all(self, chunk: int = 1 << 20) -> bytes:
+        parts = []
+        while True:
+            data = await self.stream.read(chunk)
+            if not data:
+                break
+            parts.append(data)
+        self.finish()
+        return b"".join(parts)
+
+    async def save_to(self, path, chunk: int = 1 << 20) -> int:
+        """Stream to disk without buffering the whole payload (the reference
+        file-mediates all tensor transfers, bridge.rs:392-504). File writes
+        run in a thread so the event loop is never stalled."""
+        loop = asyncio.get_running_loop()
+        total = 0
+        with open(path, "wb") as f:
+            while True:
+                data = await self.stream.read(chunk)
+                if not data:
+                    break
+                await loop.run_in_executor(None, f.write, data)
+                total += len(data)
+        self.finish()
+        return total
+
+    def finish(self) -> None:
+        """Release the accept slot and let the transport close the stream.
+        Called automatically by read_all/save_to at EOF."""
+        self._done()
+
+
+class _CountingStream(Stream):
+    """Wraps a stream, crediting reads to the node's inbound byte counter
+    (the reference's bandwidth-instrumented muxer role,
+    crates/telemetry/src/bandwidth.rs:30-62)."""
+
+    def __init__(self, inner: Stream, node: "Node") -> None:
+        self._inner = inner
+        self._node = node
+
+    async def read(self, n: int = 65536) -> bytes:
+        data = await self._inner.read(n)
+        self._node.bytes_in += len(data)
+        return data
+
+    async def write(self, data: bytes) -> None:
+        await self._inner.write(data)
+        self._node.bytes_out += len(data)
+
+    async def close(self) -> None:
+        await self._inner.close()
+
+    async def abort(self) -> None:
+        await self._inner.abort()
+
+
+class Node:
+    """One fabric identity: listen addresses, peerstore, typed services."""
+
+    def __init__(
+        self,
+        transport: Transport,
+        peer_id: str | None = None,
+        bootstrap: list[str] | None = None,
+        registry_server: bool = False,
+        expected_peer_id: Callable[[Stream], str | None] | None = None,
+    ) -> None:
+        self.transport = transport
+        self.peer_id = peer_id or f"peer-{uuid.uuid4().hex[:16]}"
+        self.listen_addrs: list[str] = []
+        self.external_addrs: list[str] = []
+        self._bootstrap_addrs = list(bootstrap or [])
+        self._bootstrap_peers: set[str] = set()
+        self._bootstrapped = asyncio.Event()
+        self._registry_server = registry_server
+        self._expected_peer_id = expected_peer_id
+        # peerstore: peer_id -> ordered unique addrs
+        self._peers: dict[str, list[str]] = {}
+        # RPC handlers, first-wins in registration order per protocol
+        self._handlers: dict[str, list[_Handler]] = {}
+        # gossip state
+        self._subs: dict[str, list[Subscription]] = {}
+        self._gossip_peers: set[str] = set()
+        self._seen: OrderedDict[str, None] = OrderedDict()
+        # registry server state (gateway role)
+        self._records: dict[str, bytes] = {}
+        self._providers: dict[str, dict[str, float]] = {}  # key -> peer -> ts
+        self._addr_book: dict[str, list[str]] = {}  # registered peer addrs
+        self._provided: set[str] = set()  # keys this node announces (client)
+        # tensor streams
+        self._push_queue: asyncio.Queue = asyncio.Queue()
+        self._push_sem = asyncio.Semaphore(ACCEPT_LIMIT)
+        self._pull_sem = asyncio.Semaphore(ACCEPT_LIMIT)
+        self._pull_handler: Callable[[str, Any, Stream], Awaitable[None]] | None = None
+        self._tasks: set[asyncio.Task] = set()
+        self._closed = False
+        # inbound/outbound byte counters (telemetry bandwidth role,
+        # reference crates/telemetry/src/bandwidth.rs)
+        self.bytes_in = 0
+        self.bytes_out = 0
+
+    # ------------------------------------------------------------------ core
+
+    def _spawn(self, coro) -> asyncio.Task:
+        task = asyncio.create_task(coro)
+        self._tasks.add(task)
+        task.add_done_callback(self._tasks.discard)
+        return task
+
+    async def start(self, listen: list[str] | None = None) -> None:
+        for addr in listen or ["", ]:
+            bound = await self.transport.listen(addr, self._on_stream)
+            self.listen_addrs.append(bound)
+        if self._bootstrap_addrs:
+            self._spawn(self._bootstrap_loop())
+        else:
+            self._bootstrapped.set()  # self-anchored (tests / gateway itself)
+
+    async def stop(self) -> None:
+        self._closed = True
+        # Wake consumers blocked on push_streams()/next_push().
+        self._push_queue.put_nowait(None)
+        for sub_list in self._subs.values():
+            for sub in list(sub_list):
+                sub.closed = True
+                try:
+                    sub._queue.put_nowait(None)
+                except asyncio.QueueFull:
+                    pass
+        for task in list(self._tasks):
+            task.cancel()
+        for task in list(self._tasks):
+            try:
+                await task
+            except (asyncio.CancelledError, Exception):
+                pass
+        await self.transport.close()
+
+    def add_peer_addr(self, peer_id: str, addr: str) -> None:
+        addrs = self._peers.setdefault(peer_id, [])
+        if addr and addr not in addrs:
+            addrs.append(addr)
+
+    def primary_addr(self) -> str:
+        if self.external_addrs:
+            return self.external_addrs[0]
+        return self.listen_addrs[0] if self.listen_addrs else ""
+
+    async def dial(self, addr: str, proto: str = PROTOCOL_REGISTRY) -> str:
+        """Dial an address to learn/verify the peer behind it (identify)."""
+        stream = await self._open_raw(addr, proto)
+        try:
+            await stream.write_frame({"t": "identify"})
+            reply = await stream.read_frame()
+            peer = reply.get("peer", "")
+            if peer:
+                self.add_peer_addr(peer, addr)
+            return peer
+        finally:
+            await stream.close()
+
+    # -------------------------------------------------------------- accepting
+
+    async def _on_stream(self, stream: Stream) -> None:
+        try:
+            hello = await stream.read_frame(MAX_STREAM_HEADER)
+            peer = hello.get("from", "")
+            proto = hello.get("proto", "")
+            addr = hello.get("addr", "")
+        except (FrameError, Exception) as e:
+            log.debug("bad handshake: %s", e)
+            await stream.abort()
+            return
+        if self._expected_peer_id is not None:
+            expected = self._expected_peer_id(stream)
+            if expected is not None and expected != peer:
+                log.warning("peer id %s does not match certificate %s", peer, expected)
+                await stream.abort()
+                return
+        if peer and addr:
+            self.add_peer_addr(peer, addr)
+        owned = True  # push streams hand ownership to the consumer
+        try:
+            if proto == PROTOCOL_GOSSIP:
+                await self._handle_gossip(peer, stream)
+            elif proto == PROTOCOL_REGISTRY:
+                await self._handle_registry(peer, stream)
+            elif proto == PROTOCOL_PUSH:
+                await self._handle_push(peer, stream)
+                owned = False
+            elif proto == PROTOCOL_PULL:
+                await self._handle_pull(peer, stream)
+            else:
+                await self._handle_rpc(peer, proto, stream)
+        except asyncio.CancelledError:
+            raise
+        except Exception as e:
+            log.debug("stream error (%s from %s): %s", proto, peer, e)
+        finally:
+            if owned:
+                await stream.close()
+
+    # ------------------------------------------------------------------- rpc
+
+    def on(self, protocol: str, msg_type: type | None = None) -> HandlerBuilder:
+        return HandlerBuilder(self, protocol, msg_type)
+
+    def _register(self, handler: _Handler) -> None:
+        self._handlers.setdefault(handler.protocol, []).append(handler)
+
+    def _unregister(self, handler: _Handler | None) -> None:
+        if handler is None:
+            return
+        lst = self._handlers.get(handler.protocol, [])
+        if handler in lst:
+            lst.remove(handler)
+
+    async def _handle_rpc(self, peer: str, proto: str, stream: Stream) -> None:
+        body = await stream.read_frame()
+        try:
+            msg = messages.decode(body)
+        except Exception as e:
+            await stream.write_frame({"ok": False, "error": f"decode: {e}"})
+            return
+        handler = next(
+            (h for h in self._handlers.get(proto, []) if h.matches(msg)), None
+        )
+        if handler is None:
+            await stream.write_frame(
+                {"ok": False, "error": f"no handler for {type(msg).__name__} on {proto}"}
+            )
+            return
+        async with handler.semaphore:
+            try:
+                response = await handler.fn(peer, msg)
+            except asyncio.CancelledError:
+                raise
+            except Exception as e:
+                log.debug("handler error on %s: %s", proto, e)
+                await stream.write_frame({"ok": False, "error": str(e)})
+                return
+        await stream.write_frame({"ok": True, "body": messages.encode(response)})
+
+    async def request(
+        self, peer_id: str, protocol: str, msg: Any, timeout: float = 30.0
+    ) -> Any:
+        """Typed RPC to a peer; raises RequestError on failure."""
+        try:
+            return await asyncio.wait_for(
+                self._request_inner(peer_id, protocol, msg), timeout
+            )
+        except asyncio.TimeoutError:
+            raise RequestError(
+                f"request {type(msg).__name__} to {peer_id} timed out"
+            ) from None
+
+    async def _request_inner(self, peer_id: str, protocol: str, msg: Any) -> Any:
+        stream = await self._stream_to(peer_id, protocol)
+        try:
+            await stream.write_frame(messages.encode(msg))
+            reply = await stream.read_frame()
+        except (FrameError, ConnectionError, OSError) as e:
+            raise RequestError(f"rpc to {peer_id} failed: {e}") from e
+        finally:
+            await stream.close()
+        if not isinstance(reply, dict) or "ok" not in reply:
+            raise RequestError(f"malformed rpc reply from {peer_id}")
+        if not reply["ok"]:
+            raise RequestError(reply.get("error", "remote error"))
+        return messages.decode(reply["body"])
+
+    # ---------------------------------------------------------------- dialing
+
+    async def _open_raw(self, addr: str, proto: str) -> Stream:
+        stream = await self.transport.dial(addr)
+        await stream.write_frame(
+            {"from": self.peer_id, "proto": proto, "addr": self.primary_addr()}
+        )
+        return stream
+
+    async def _stream_to(self, peer_id: str, proto: str) -> Stream:
+        addrs = list(self._peers.get(peer_id, []))
+        if not addrs:
+            found = await self._lookup_peer(peer_id)
+            addrs = list(found)
+        last_err: Exception | None = None
+        for addr in addrs:
+            try:
+                return await self._open_raw(addr, proto)
+            except (ConnectionError, OSError) as e:
+                last_err = e
+        raise RequestError(f"no route to {peer_id}: {last_err}")
+
+    # ---------------------------------------------------------------- gossip
+
+    def add_gossip_peer(self, peer_id: str) -> None:
+        if peer_id != self.peer_id:
+            self._gossip_peers.add(peer_id)
+
+    async def subscribe(self, topic: str, buffer: int = 256) -> Subscription:
+        sub = Subscription(self, topic, buffer)
+        self._subs.setdefault(topic, []).append(sub)
+        return sub
+
+    async def _unsubscribe(self, sub: Subscription) -> None:
+        lst = self._subs.get(sub.topic, [])
+        if sub in lst:
+            lst.remove(sub)
+
+    async def publish(self, topic: str, msg: Any) -> None:
+        msg_id = uuid.uuid4().hex
+        self._mark_seen(msg_id)
+        body = messages.encode(msg)
+        self._deliver_local(topic, self.peer_id, body)
+        await self._gossip_fanout(topic, msg_id, self.peer_id, body, exclude=set())
+
+    def _mark_seen(self, msg_id: str) -> bool:
+        """Returns True if this id is new."""
+        if msg_id in self._seen:
+            return False
+        self._seen[msg_id] = None
+        while len(self._seen) > _SEEN_CAP:
+            self._seen.popitem(last=False)
+        return True
+
+    def _deliver_local(self, topic: str, origin: str, body: bytes) -> None:
+        subs = self._subs.get(topic)
+        if not subs:
+            return
+        try:
+            msg = messages.decode(body)
+        except Exception as e:
+            log.debug("dropping undecodable gossip on %s: %s", topic, e)
+            return
+        for sub in list(subs):
+            sub._deliver(origin, msg)
+
+    async def _gossip_fanout(
+        self, topic: str, msg_id: str, origin: str, body: bytes, exclude: set[str]
+    ) -> None:
+        frame = {
+            "t": "pub",
+            "topic": topic,
+            "id": msg_id,
+            "origin": origin,
+            "data": body,
+        }
+        targets = [p for p in self._gossip_peers if p not in exclude]
+        # Fire in parallel; unreachable peers are dropped from the mesh.
+        results = await asyncio.gather(
+            *(self._send_gossip(p, frame) for p in targets), return_exceptions=True
+        )
+        for peer, res in zip(targets, results):
+            if isinstance(res, Exception):
+                log.debug("gossip peer %s unreachable: %s", peer, res)
+                self._gossip_peers.discard(peer)
+
+    async def _send_gossip(self, peer_id: str, frame: dict) -> None:
+        stream = await self._stream_to(peer_id, PROTOCOL_GOSSIP)
+        try:
+            await stream.write_frame(frame)
+        finally:
+            await stream.close()
+
+    async def _handle_gossip(self, peer: str, stream: Stream) -> None:
+        frame = await stream.read_frame()
+        # Any peer speaking gossip to us joins our mesh (bidirectional flood).
+        if peer:
+            self.add_gossip_peer(peer)
+        t = frame.get("t")
+        if t == "pub":
+            msg_id = frame.get("id", "")
+            if not self._mark_seen(msg_id):
+                return
+            topic = frame.get("topic", "")
+            origin = frame.get("origin", peer)
+            body = frame.get("data", b"")
+            self._deliver_local(topic, origin, body)
+            self._spawn(
+                self._gossip_fanout(topic, msg_id, origin, body, exclude={peer})
+            )
+        # "sub"/"unsub" frames are accepted for forward-compat; flood
+        # forwarding does not require remote subscription state.
+
+    # -------------------------------------------------------------- discovery
+
+    async def _bootstrap_loop(self) -> None:
+        """Dial every gateway until at least one registration succeeds; keep
+        registrations and provider announcements fresh (the reference's kad
+        bootstrap + identify role). Unreachable gateways back off
+        exponentially (250 ms → 5 s)."""
+        backoff = 0.25
+        while not self._closed:
+            ok = False
+            for addr in self._bootstrap_addrs:
+                try:
+                    peer = await self._register_with_gateway(addr)
+                    if peer:
+                        self._bootstrap_peers.add(peer)
+                        self.add_gossip_peer(peer)
+                        ok = True
+                except (ConnectionError, OSError, FrameError, RequestError) as e:
+                    log.debug("bootstrap dial %s failed: %s", addr, e)
+            if ok:
+                backoff = 0.25
+                self._bootstrapped.set()
+                for key in list(self._provided):  # refresh provider TTLs
+                    try:
+                        await self.provide(key)
+                    except RequestError:
+                        pass
+                await asyncio.sleep(30.0)  # refresh registration
+            else:
+                await asyncio.sleep(backoff)
+                backoff = min(backoff * 2, 5.0)
+
+    async def _register_with_gateway(self, addr: str) -> str:
+        stream = await self._open_raw(addr, PROTOCOL_REGISTRY)
+        try:
+            await stream.write_frame(
+                {"t": "register", "peer": self.peer_id, "addrs": self._my_addrs()}
+            )
+            reply = await stream.read_frame()
+            peer = reply.get("peer", "")
+            if peer:
+                self.add_peer_addr(peer, addr)
+            return peer
+        finally:
+            await stream.close()
+
+    def _my_addrs(self) -> list[str]:
+        return list(dict.fromkeys(self.external_addrs + self.listen_addrs))
+
+    async def wait_for_bootstrap(self, timeout: float = 60.0) -> None:
+        await asyncio.wait_for(self._bootstrapped.wait(), timeout)
+
+    async def _registry_call(self, frame: dict) -> dict:
+        """Run a registry op against gateways (or locally if self-anchored)."""
+        if self._registry_server or not self._bootstrap_addrs:
+            return self._registry_apply("", frame)
+        last: Exception | None = None
+        for addr in self._bootstrap_addrs:
+            try:
+                stream = await self._open_raw(addr, PROTOCOL_REGISTRY)
+                try:
+                    await stream.write_frame(frame)
+                    return await stream.read_frame()
+                finally:
+                    await stream.close()
+            except (ConnectionError, OSError, FrameError) as e:
+                last = e
+        raise RequestError(f"no gateway reachable: {last}")
+
+    async def put_record(self, key: str, value: bytes) -> None:
+        reply = await self._registry_call({"t": "put", "key": key, "value": value})
+        if not reply.get("ok", False):
+            raise RequestError(reply.get("error", "put failed"))
+
+    async def get_record(self, key: str) -> bytes | None:
+        reply = await self._registry_call({"t": "get", "key": key})
+        return reply.get("value") if reply.get("ok", False) else None
+
+    async def provide(self, key: str) -> None:
+        self._provided.add(key)  # re-announced by the bootstrap refresh loop
+        reply = await self._registry_call(
+            {"t": "provide", "key": key, "peer": self.peer_id, "addrs": self._my_addrs()}
+        )
+        if not reply.get("ok", False):
+            raise RequestError(reply.get("error", "provide failed"))
+
+    async def find_providers(self, key: str) -> list[str]:
+        reply = await self._registry_call({"t": "find", "key": key})
+        providers = reply.get("providers", [])
+        for p in providers:
+            for a in p.get("addrs", []):
+                self.add_peer_addr(p["peer"], a)
+        return [p["peer"] for p in providers]
+
+    async def _lookup_peer(self, peer_id: str) -> list[str]:
+        try:
+            reply = await self._registry_call({"t": "lookup", "peer": peer_id})
+        except RequestError:
+            return []
+        addrs = reply.get("addrs", []) if reply.get("ok", False) else []
+        for a in addrs:
+            self.add_peer_addr(peer_id, a)
+        return addrs
+
+    def _registry_apply(self, from_peer: str, frame: dict) -> dict:
+        """Server-side registry ops (gateway role, kad Mode::Server)."""
+        t = frame.get("t")
+        if t == "identify":
+            return {"ok": True, "peer": self.peer_id}
+        if t == "register":
+            peer, addrs = frame.get("peer", ""), frame.get("addrs", [])
+            if peer:
+                self._addr_book[peer] = list(addrs)
+                self.add_gossip_peer(peer)
+                for a in addrs:
+                    self.add_peer_addr(peer, a)
+            return {"ok": True, "peer": self.peer_id}
+        if t == "put":
+            self._records[frame.get("key", "")] = frame.get("value", b"")
+            return {"ok": True}
+        if t == "get":
+            key = frame.get("key", "")
+            if key in self._records:
+                return {"ok": True, "value": self._records[key]}
+            return {"ok": False, "error": f"no record {key!r}"}
+        if t == "provide":
+            key, peer = frame.get("key", ""), frame.get("peer", "")
+            self._providers.setdefault(key, {})[peer] = time.time()
+            if frame.get("addrs"):
+                self._addr_book[peer] = list(frame["addrs"])
+            return {"ok": True}
+        if t == "find":
+            # Drop providers that stopped refreshing (crashed data nodes must
+            # age out; clients re-announce every 30 s from _bootstrap_loop).
+            entries = self._providers.get(frame.get("key", ""), {})
+            cutoff = time.time() - PROVIDER_TTL
+            for p in [p for p, ts in entries.items() if ts < cutoff]:
+                del entries[p]
+            out = [
+                {"peer": p, "addrs": self._addr_book.get(p, [])} for p in entries
+            ]
+            return {"ok": True, "providers": out}
+        if t == "lookup":
+            peer = frame.get("peer", "")
+            addrs = self._addr_book.get(peer)
+            if addrs is None:
+                return {"ok": False, "error": f"unknown peer {peer}"}
+            return {"ok": True, "addrs": addrs}
+        return {"ok": False, "error": f"unknown registry op {t!r}"}
+
+    async def _handle_registry(self, peer: str, stream: Stream) -> None:
+        frame = await stream.read_frame()
+        if not self._registry_server and frame.get("t") not in ("identify",):
+            await stream.write_frame({"ok": False, "error": "not a registry server"})
+            return
+        await stream.write_frame(self._registry_apply(peer, frame))
+
+    # --------------------------------------------------------- tensor streams
+
+    async def push(self, peer_id: str, resource: Any, source) -> int:
+        """Open a push stream: header frame, then raw bytes from ``source``
+        (bytes | file path | async byte iterator). Returns bytes sent."""
+        stream = await self._stream_to(peer_id, PROTOCOL_PUSH)
+        try:
+            await stream.write_frame(messages.encode(resource))
+            n = await self._write_source(stream, source)
+            self.bytes_out += n
+            return n
+        finally:
+            await stream.close()
+
+    async def _write_source(self, stream: Stream, source) -> int:
+        """Stream bytes | file path | async iterator | Stream into ``stream``."""
+        if isinstance(source, (bytes, bytearray, memoryview)):
+            data = bytes(source)
+            await stream.write(data)
+            return len(data)
+        if isinstance(source, str) or hasattr(source, "__fspath__"):
+            loop = asyncio.get_running_loop()
+            total = 0
+            with open(source, "rb") as f:
+                while True:
+                    chunk = await loop.run_in_executor(None, f.read, 1 << 20)
+                    if not chunk:
+                        break
+                    await stream.write(chunk)
+                    total += len(chunk)
+            return total
+        return await copy_stream(source, stream)
+
+    async def _handle_push(self, peer: str, stream: Stream) -> None:
+        header = await stream.read_frame(MAX_STREAM_HEADER)
+        resource = messages.decode(header)
+        await self._push_sem.acquire()
+        finished = asyncio.Event()
+
+        def done() -> None:
+            if not finished.is_set():
+                finished.set()
+                self._push_sem.release()
+
+        await self._push_queue.put(
+            PushStream(
+                peer=peer,
+                resource=resource,
+                stream=_CountingStream(stream, self),
+                _done=done,
+            )
+        )
+        # Keep the transport connection alive until the consumer drains it
+        # (TCP closes the socket when the accept callback returns).
+        await finished.wait()
+
+    async def push_streams(self) -> AsyncIterator[PushStream]:
+        """Async iterator over accepted inbound pushes; terminates on node
+        stop. ``read_all``/``save_to`` release the accept slot at EOF."""
+        while not self._closed:
+            item = await self._push_queue.get()
+            if item is None:  # stop() sentinel; re-arm for other consumers
+                self._push_queue.put_nowait(None)
+                return
+            yield item
+
+    async def next_push(self, timeout: float | None = None) -> PushStream:
+        getter = self._push_queue.get()
+        item = await (getter if timeout is None else asyncio.wait_for(getter, timeout))
+        if item is None:
+            self._push_queue.put_nowait(None)
+            raise RequestError("node stopped")
+        return item
+
+    def on_pull(self, handler: Callable[[str, Any], Awaitable[Any]]) -> None:
+        """Register the pull server: handler(peer, resource) returns the
+        payload source (bytes | file path | async iterator). A status frame
+        precedes the payload on the wire, so handler failures surface as
+        RequestError at the puller instead of an empty payload
+        (reference: data node serve loop, hypha-data.rs:187-209)."""
+        self._pull_handler = handler
+
+    async def _handle_pull(self, peer: str, stream: Stream) -> None:
+        header = await stream.read_frame(MAX_STREAM_HEADER)
+        resource = messages.decode(header)
+        async with self._pull_sem:
+            if self._pull_handler is None:
+                await stream.write_frame({"ok": False, "error": "no pull handler"})
+                return
+            try:
+                source = await self._pull_handler(peer, resource)
+            except asyncio.CancelledError:
+                raise
+            except Exception as e:
+                await stream.write_frame({"ok": False, "error": str(e)})
+                return
+            await stream.write_frame({"ok": True})
+            self.bytes_out += await self._write_source(stream, source)
+
+    async def pull(self, peer_id: str, resource: Any) -> Stream:
+        """Open a pull stream: send the bounded resource header, check the
+        status frame, return the byte stream of the payload (reference:
+        stream_pull.rs:66-103 — 8-byte LE length + bounded header)."""
+        stream = await self._stream_to(peer_id, PROTOCOL_PULL)
+        try:
+            await stream.write_frame(messages.encode(resource))
+            status = await stream.read_frame()
+        except (FrameError, ConnectionError, OSError) as e:
+            await stream.abort()
+            raise RequestError(f"pull from {peer_id} failed: {e}") from e
+        if not status.get("ok", False):
+            await stream.abort()
+            raise RequestError(status.get("error", "pull refused"))
+        return _CountingStream(stream, self)

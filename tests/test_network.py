@@ -1,0 +1,470 @@
+"""Network fabric tests.
+
+Mirrors the reference's in-process multi-swarm integration suite
+(reference: crates/network/tests/{gossipsub,kad,request_response}_test.rs via
+libp2p-swarm-test): real concurrent nodes on the in-memory fabric, no
+sockets, plus TCP transport smoke tests on localhost.
+"""
+
+from __future__ import annotations
+
+import asyncio
+
+import pytest
+
+from hypha_tpu.messages import (
+    PROTOCOL_API,
+    PROTOCOL_HEALTH,
+    Ack,
+    DataSlice,
+    HealthRequest,
+    HealthResponse,
+    RenewLease,
+    RenewLeaseResponse,
+)
+from hypha_tpu.network import MemoryTransport, Node, RequestError, TcpTransport
+
+
+def run(coro):
+    return asyncio.run(asyncio.wait_for(coro, timeout=30))
+
+
+async def make_nodes(n: int, **kwargs) -> list[Node]:
+    hub = MemoryTransport()
+    nodes = []
+    for i in range(n):
+        node = Node(hub.shared(), peer_id=f"n{i}", **kwargs)
+        await node.start()
+        nodes.append(node)
+    return nodes
+
+
+async def connect(a: Node, b: Node) -> None:
+    """Teach a about b and vice versa (swarm connect role)."""
+    peer = await a.dial(b.listen_addrs[0])
+    assert peer == b.peer_id
+    b.add_peer_addr(a.peer_id, a.listen_addrs[0])
+
+
+# ---------------------------------------------------------------------------
+# RPC (request_response_test.rs role)
+# ---------------------------------------------------------------------------
+
+
+def test_rpc_roundtrip():
+    async def main():
+        a, b = await make_nodes(2)
+        await connect(a, b)
+
+        async def handler(peer, msg):
+            assert peer == "n0"
+            return RenewLeaseResponse(lease_id=msg.lease_id, timeout=10.0)
+
+        b.on(PROTOCOL_API, RenewLease).respond_with(handler)
+        resp = await a.request(b.peer_id, PROTOCOL_API, RenewLease(lease_id="L1"))
+        assert isinstance(resp, RenewLeaseResponse)
+        assert resp.lease_id == "L1" and resp.timeout == 10.0
+        await a.stop(); await b.stop()
+
+    run(main())
+
+
+def test_rpc_no_handler_errors():
+    async def main():
+        a, b = await make_nodes(2)
+        await connect(a, b)
+        with pytest.raises(RequestError, match="no handler"):
+            await a.request(b.peer_id, PROTOCOL_API, RenewLease(lease_id="x"))
+        await a.stop(); await b.stop()
+
+    run(main())
+
+
+def test_rpc_handler_error_propagates():
+    async def main():
+        a, b = await make_nodes(2)
+        await connect(a, b)
+
+        async def bad(peer, msg):
+            raise ValueError("lease unknown")
+
+        b.on(PROTOCOL_API, RenewLease).respond_with(bad)
+        with pytest.raises(RequestError, match="lease unknown"):
+            await a.request(b.peer_id, PROTOCOL_API, RenewLease(lease_id="x"))
+        await a.stop(); await b.stop()
+
+    run(main())
+
+
+def test_rpc_first_wins_and_unregister():
+    """First matching handler wins; closing a registration unregisters it
+    (reference: request_response.rs:503-519 first-wins, :492-500 drop)."""
+
+    async def main():
+        a, b = await make_nodes(2)
+        await connect(a, b)
+
+        async def h1(peer, msg):
+            return Ack(ok=True, message="first")
+
+        async def h2(peer, msg):
+            return Ack(ok=True, message="second")
+
+        reg1 = b.on(PROTOCOL_API, RenewLease).respond_with(h1)
+        b.on(PROTOCOL_API, RenewLease).respond_with(h2)
+        r = await a.request(b.peer_id, PROTOCOL_API, RenewLease(lease_id="x"))
+        assert r.message == "first"
+        reg1.close()
+        r = await a.request(b.peer_id, PROTOCOL_API, RenewLease(lease_id="x"))
+        assert r.message == "second"
+        await a.stop(); await b.stop()
+
+    run(main())
+
+
+def test_rpc_typed_dispatch_two_types_one_protocol():
+    async def main():
+        a, b = await make_nodes(2)
+        await connect(a, b)
+
+        async def health(peer, msg):
+            return HealthResponse(healthy=True)
+
+        async def renew(peer, msg):
+            return RenewLeaseResponse(lease_id=msg.lease_id, timeout=1.0)
+
+        b.on(PROTOCOL_HEALTH, HealthRequest).respond_with(health)
+        b.on(PROTOCOL_API, RenewLease).respond_with(renew)
+        h = await a.request(b.peer_id, PROTOCOL_HEALTH, HealthRequest())
+        assert h.healthy is True
+        r = await a.request(b.peer_id, PROTOCOL_API, RenewLease(lease_id="z"))
+        assert r.lease_id == "z"
+        await a.stop(); await b.stop()
+
+    run(main())
+
+
+def test_rpc_into_stream():
+    async def main():
+        a, b = await make_nodes(2)
+        await connect(a, b)
+        stream = b.on(PROTOCOL_API, RenewLease).into_stream()
+
+        async def serve_one():
+            peer, msg, respond = await anext(stream)
+            respond(RenewLeaseResponse(lease_id=msg.lease_id, timeout=5.0))
+
+        serve = asyncio.create_task(serve_one())
+        resp = await a.request(b.peer_id, PROTOCOL_API, RenewLease(lease_id="s"))
+        assert resp.timeout == 5.0
+        await serve
+        stream.close()
+        await a.stop(); await b.stop()
+
+    run(main())
+
+
+# ---------------------------------------------------------------------------
+# Gossip (gossipsub_test.rs role)
+# ---------------------------------------------------------------------------
+
+
+def test_gossip_fanout_via_hub():
+    """Publisher → hub → two subscribers that never met the publisher."""
+
+    async def main():
+        hub_node, pub, sub1, sub2 = await make_nodes(4)
+        for n in (pub, sub1, sub2):
+            await n.dial(hub_node.listen_addrs[0])
+            n.add_gossip_peer(hub_node.peer_id)
+            hub_node.add_peer_addr(n.peer_id, n.listen_addrs[0])
+            hub_node.add_gossip_peer(n.peer_id)
+
+        s1 = await sub1.subscribe("hypha/worker")
+        s2 = await sub2.subscribe("hypha/worker")
+        await pub.publish("hypha/worker", Ack(ok=True, message="ad"))
+
+        for s in (s1, s2):
+            origin, msg = await asyncio.wait_for(anext(s), 5)
+            assert origin == pub.peer_id
+            assert isinstance(msg, Ack) and msg.message == "ad"
+        for n in (hub_node, pub, sub1, sub2):
+            await n.stop()
+
+    run(main())
+
+
+def test_gossip_dedup_no_echo():
+    """A message flooding a cycle is delivered exactly once per subscriber."""
+
+    async def main():
+        nodes = await make_nodes(3)
+        # full mesh — worst case for duplicate floods
+        for x in nodes:
+            for y in nodes:
+                if x is not y:
+                    x.add_peer_addr(y.peer_id, y.listen_addrs[0])
+                    x.add_gossip_peer(y.peer_id)
+        sub = await nodes[2].subscribe("t")
+        await nodes[0].publish("t", Ack(message="once"))
+        origin, msg = await asyncio.wait_for(anext(sub), 5)
+        assert msg.message == "once"
+        await asyncio.sleep(0.1)
+        assert sub._queue.empty(), "duplicate delivery through the mesh cycle"
+        for n in nodes:
+            await n.stop()
+
+    run(main())
+
+
+def test_gossip_local_delivery_to_own_subscription():
+    async def main():
+        (a,) = await make_nodes(1)
+        sub = await a.subscribe("t")
+        await a.publish("t", Ack(message="self"))
+        origin, msg = await asyncio.wait_for(anext(sub), 5)
+        assert origin == a.peer_id and msg.message == "self"
+        await a.stop()
+
+    run(main())
+
+
+# ---------------------------------------------------------------------------
+# Discovery (kad_test.rs role)
+# ---------------------------------------------------------------------------
+
+
+def test_records_store_and_get_via_gateway():
+    async def main():
+        hub = MemoryTransport()
+        gw = Node(hub.shared(), peer_id="gw", registry_server=True)
+        await gw.start()
+        a = Node(hub.shared(), peer_id="a", bootstrap=[gw.listen_addrs[0]])
+        b = Node(hub.shared(), peer_id="b", bootstrap=[gw.listen_addrs[0]])
+        await a.start(); await b.start()
+        await a.wait_for_bootstrap(5); await b.wait_for_bootstrap(5)
+
+        await a.put_record("dataset-1", b"\x01\x02")
+        assert await b.get_record("dataset-1") == b"\x01\x02"
+        assert await b.get_record("missing") is None
+        for n in (a, b, gw):
+            await n.stop()
+
+    run(main())
+
+
+def test_providers_and_peer_routing():
+    """Provider announce + find_providers resolves addresses so the finder
+    can open streams to a provider it never dialed (kad provider role)."""
+
+    async def main():
+        hub = MemoryTransport()
+        gw = Node(hub.shared(), peer_id="gw", registry_server=True)
+        await gw.start()
+        data = Node(hub.shared(), peer_id="data", bootstrap=[gw.listen_addrs[0]])
+        w = Node(hub.shared(), peer_id="w", bootstrap=[gw.listen_addrs[0]])
+        await data.start(); await w.start()
+        await data.wait_for_bootstrap(5); await w.wait_for_bootstrap(5)
+
+        await data.provide("mnist")
+
+        async def health(peer, msg):
+            return HealthResponse(healthy=True)
+
+        data.on(PROTOCOL_HEALTH, HealthRequest).respond_with(health)
+
+        providers = await w.find_providers("mnist")
+        assert providers == ["data"]
+        # route to the provider without ever dialing it explicitly
+        resp = await w.request("data", PROTOCOL_HEALTH, HealthRequest())
+        assert resp.healthy
+        for n in (data, w, gw):
+            await n.stop()
+
+    run(main())
+
+
+def test_wait_for_bootstrap_blocks_until_gateway_up():
+    async def main():
+        hub = MemoryTransport()
+        gw_transport = hub.shared()
+        a = Node(hub.shared(), peer_id="a", bootstrap=["mem:gw"])
+        await a.start()
+        assert not a._bootstrapped.is_set()
+        gw = Node(gw_transport, peer_id="gw", registry_server=True)
+        await gw.start(listen=["mem:gw"])
+        await a.wait_for_bootstrap(10)
+        await a.stop(); await gw.stop()
+
+    run(main())
+
+
+# ---------------------------------------------------------------------------
+# Push/pull tensor streams (stream_push/stream_pull role)
+# ---------------------------------------------------------------------------
+
+
+def test_push_stream_roundtrip():
+    async def main():
+        a, b = await make_nodes(2)
+        await connect(a, b)
+        payload = bytes(range(256)) * 1000
+
+        async def receive():
+            push = await b.next_push(timeout=5)
+            assert push.peer == "n0"
+            assert isinstance(push.resource, DataSlice)
+            assert push.resource.dataset == "grads"
+            return await push.read_all()
+
+        recv = asyncio.create_task(receive())
+        sent = await a.push(b.peer_id, DataSlice(dataset="grads", index=0), payload)
+        got = await recv
+        assert sent == len(payload) and got == payload
+        await a.stop(); await b.stop()
+
+    run(main())
+
+
+def test_push_stream_from_file(tmp_path):
+    async def main():
+        a, b = await make_nodes(2)
+        await connect(a, b)
+        src = tmp_path / "delta.safetensors"
+        src.write_bytes(b"tensorbytes" * 5000)
+
+        async def receive():
+            push = await b.next_push(timeout=5)
+            dst = tmp_path / "received.safetensors"
+            n = await push.save_to(dst)
+            return dst, n
+
+        recv = asyncio.create_task(receive())
+        await a.push(b.peer_id, DataSlice(dataset="d", index=1), src)
+        dst, n = await recv
+        assert dst.read_bytes() == src.read_bytes()
+        await a.stop(); await b.stop()
+
+    run(main())
+
+
+def test_pull_stream_roundtrip():
+    async def main():
+        a, b = await make_nodes(2)
+        await connect(a, b)
+        slices = {0: b"slice-zero" * 100, 1: b"slice-one" * 100}
+
+        async def serve(peer, resource):
+            assert isinstance(resource, DataSlice)
+            return slices[resource.index]
+
+        b.on_pull(serve)
+        for idx, expected in slices.items():
+            stream = await a.pull(b.peer_id, DataSlice(dataset="d", index=idx))
+            got = b""
+            while True:
+                chunk = await stream.read()
+                if not chunk:
+                    break
+                got += chunk
+            assert got == expected
+            await stream.close()
+        assert a.bytes_in == sum(len(v) for v in slices.values())
+        await a.stop(); await b.stop()
+
+    run(main())
+
+
+def test_pull_missing_slice_is_an_error_not_empty():
+    """A failing pull handler must surface as RequestError at the puller,
+    never as a silently-empty payload (off-by-one guarded: the reference's
+    data node had `>` where `>=` was needed, hypha-data.rs:195)."""
+
+    async def main():
+        a, b = await make_nodes(2)
+        await connect(a, b)
+        files = [b"only-slice"]
+
+        async def serve(peer, resource):
+            if resource.index >= len(files):  # fixed bounds check
+                raise IndexError(f"slice {resource.index} out of range")
+            return files[resource.index]
+
+        b.on_pull(serve)
+        with pytest.raises(RequestError, match="out of range"):
+            await a.pull(b.peer_id, DataSlice(dataset="d", index=1))
+        # no handler registered at all -> also an error
+        with pytest.raises(RequestError, match="no pull handler"):
+            await b.pull(a.peer_id, DataSlice(dataset="d", index=0))
+        await a.stop(); await b.stop()
+
+    run(main())
+
+
+def test_push_consumer_wakes_on_stop():
+    async def main():
+        (a,) = await make_nodes(1)
+
+        async def consume():
+            async for _push in a.push_streams():
+                pass
+            return "done"
+
+        consumer = asyncio.create_task(consume())
+        await asyncio.sleep(0.05)
+        await a.stop()
+        assert await asyncio.wait_for(consumer, 5) == "done"
+
+    run(main())
+
+
+def test_subscription_close_wakes_blocked_consumer():
+    async def main():
+        (a,) = await make_nodes(1)
+        sub = await a.subscribe("t")
+
+        async def consume():
+            out = [msg async for _peer, msg in sub]
+            return out
+
+        consumer = asyncio.create_task(consume())
+        await asyncio.sleep(0.05)
+        await sub.close()
+        assert await asyncio.wait_for(consumer, 5) == []
+        await a.stop()
+
+    run(main())
+
+
+# ---------------------------------------------------------------------------
+# TCP transport
+# ---------------------------------------------------------------------------
+
+
+def test_tcp_rpc_and_push():
+    async def main():
+        a = Node(TcpTransport(), peer_id="tcp-a")
+        b = Node(TcpTransport(), peer_id="tcp-b")
+        await a.start(listen=["127.0.0.1:0"])
+        await b.start(listen=["127.0.0.1:0"])
+        await connect(a, b)
+
+        async def health(peer, msg):
+            return HealthResponse(healthy=True)
+
+        b.on(PROTOCOL_HEALTH, HealthRequest).respond_with(health)
+        resp = await a.request(b.peer_id, PROTOCOL_HEALTH, HealthRequest())
+        assert resp.healthy
+
+        payload = b"x" * (1 << 20)
+
+        async def receive():
+            push = await b.next_push(timeout=5)
+            return await push.read_all()
+
+        recv = asyncio.create_task(receive())
+        await a.push(b.peer_id, DataSlice(dataset="g", index=0), payload)
+        assert await recv == payload
+        await a.stop(); await b.stop()
+
+    run(main())
