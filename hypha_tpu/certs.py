@@ -1,0 +1,369 @@
+"""L0 security: PKI generation, loading, and mTLS contexts.
+
+Behavioral parity with the reference's ``hypha-certutil`` crate and
+``crates/network/src/cert.rs``:
+
+  * three-tier Ed25519 hierarchy — root CA → org CA → node certificates
+    with SANs (reference: crates/certutil/src/main.rs:20-87);
+  * **PeerID = hash of the certificate public key** so transport identity
+    and cryptographic identity coincide (reference:
+    crates/network/src/cert.rs:30-79; rfc/2025-05-30_mtls.md:1-60);
+  * PEM loading for cert chains, private keys and CRLs
+    (cert.rs: load_certs_from_pem/load_private_key_from_pem/
+    load_crls_from_pem);
+  * mutual TLS where both sides require and verify the peer chain against
+    the root of trust, with optional CRL checking (the reference forks
+    libp2p-tls to swap self-signed certs for WebPKI mTLS with CRLs).
+
+CRLs are loaded at context-build time only, matching the reference's
+"CRLs are only loaded from disk during node initialization" limitation —
+rotating a CRL requires a node restart (documented reference behavior).
+"""
+
+from __future__ import annotations
+
+import datetime
+import hashlib
+import ssl
+from pathlib import Path
+
+from cryptography import x509
+from cryptography.hazmat.primitives import hashes, serialization
+from cryptography.hazmat.primitives.asymmetric import ed25519
+from cryptography.x509.oid import ExtendedKeyUsageOID, NameOID
+
+__all__ = [
+    "generate_root_ca",
+    "generate_org_ca",
+    "generate_node_cert",
+    "generate_crl",
+    "peer_id_from_cert_pem",
+    "peer_id_from_cert_der",
+    "load_certs_from_pem",
+    "load_private_key_from_pem",
+    "load_crls_from_pem",
+    "make_server_context",
+    "make_client_context",
+    "write_node_dir",
+]
+
+_ONE_DAY = datetime.timedelta(days=1)
+
+
+def _name(common_name: str, org: str | None = None) -> x509.Name:
+    attrs = [x509.NameAttribute(NameOID.COMMON_NAME, common_name)]
+    if org:
+        attrs.append(x509.NameAttribute(NameOID.ORGANIZATION_NAME, org))
+    return x509.Name(attrs)
+
+
+def _validity(days: int) -> tuple[datetime.datetime, datetime.datetime]:
+    now = datetime.datetime.now(datetime.timezone.utc)
+    return now - _ONE_DAY, now + datetime.timedelta(days=days)
+
+
+def generate_root_ca(
+    common_name: str = "hypha-root", days: int = 3650
+) -> tuple[bytes, bytes]:
+    """Self-signed Ed25519 root CA. Returns (cert_pem, key_pem)."""
+    key = ed25519.Ed25519PrivateKey.generate()
+    name = _name(common_name)
+    not_before, not_after = _validity(days)
+    cert = (
+        x509.CertificateBuilder()
+        .subject_name(name)
+        .issuer_name(name)
+        .public_key(key.public_key())
+        .serial_number(x509.random_serial_number())
+        .not_valid_before(not_before)
+        .not_valid_after(not_after)
+        .add_extension(x509.BasicConstraints(ca=True, path_length=1), critical=True)
+        .add_extension(
+            x509.KeyUsage(
+                digital_signature=True,
+                key_cert_sign=True,
+                crl_sign=True,
+                content_commitment=False,
+                key_encipherment=False,
+                data_encipherment=False,
+                key_agreement=False,
+                encipher_only=False,
+                decipher_only=False,
+            ),
+            critical=True,
+        )
+        .add_extension(
+            x509.SubjectKeyIdentifier.from_public_key(key.public_key()), critical=False
+        )
+        .sign(key, algorithm=None)  # Ed25519 signs without a separate digest
+    )
+    return _pem(cert), _key_pem(key)
+
+
+def generate_org_ca(
+    common_name: str, root_cert_pem: bytes, root_key_pem: bytes, days: int = 1825
+) -> tuple[bytes, bytes]:
+    """Org-level intermediate CA signed by the root."""
+    root_cert = x509.load_pem_x509_certificate(root_cert_pem)
+    root_key = load_private_key_from_pem(root_key_pem)
+    key = ed25519.Ed25519PrivateKey.generate()
+    not_before, not_after = _validity(days)
+    cert = (
+        x509.CertificateBuilder()
+        .subject_name(_name(common_name))
+        .issuer_name(root_cert.subject)
+        .public_key(key.public_key())
+        .serial_number(x509.random_serial_number())
+        .not_valid_before(not_before)
+        .not_valid_after(not_after)
+        .add_extension(x509.BasicConstraints(ca=True, path_length=0), critical=True)
+        .add_extension(
+            x509.KeyUsage(
+                digital_signature=True,
+                key_cert_sign=True,
+                crl_sign=True,
+                content_commitment=False,
+                key_encipherment=False,
+                data_encipherment=False,
+                key_agreement=False,
+                encipher_only=False,
+                decipher_only=False,
+            ),
+            critical=True,
+        )
+        .add_extension(
+            x509.SubjectKeyIdentifier.from_public_key(key.public_key()), critical=False
+        )
+        .add_extension(
+            x509.AuthorityKeyIdentifier.from_issuer_public_key(
+                root_cert.public_key()
+            ),
+            critical=False,
+        )
+        .sign(root_key, algorithm=None)
+    )
+    return _pem(cert), _key_pem(key)
+
+
+def generate_node_cert(
+    common_name: str,
+    org_cert_pem: bytes,
+    org_key_pem: bytes,
+    sans: list[str] | None = None,
+    days: int = 825,
+) -> tuple[bytes, bytes]:
+    """Leaf certificate for one node, usable as both TLS client and server
+    (every peer both dials and listens). SANs default to localhost."""
+    org_cert = x509.load_pem_x509_certificate(org_cert_pem)
+    org_key = load_private_key_from_pem(org_key_pem)
+    key = ed25519.Ed25519PrivateKey.generate()
+    not_before, not_after = _validity(days)
+    san_entries: list[x509.GeneralName] = []
+    for san in sans or ["localhost", "127.0.0.1"]:
+        try:
+            import ipaddress
+
+            san_entries.append(x509.IPAddress(ipaddress.ip_address(san)))
+        except ValueError:
+            san_entries.append(x509.DNSName(san))
+    cert = (
+        x509.CertificateBuilder()
+        .subject_name(_name(common_name))
+        .issuer_name(org_cert.subject)
+        .public_key(key.public_key())
+        .serial_number(x509.random_serial_number())
+        .not_valid_before(not_before)
+        .not_valid_after(not_after)
+        .add_extension(x509.BasicConstraints(ca=False, path_length=None), critical=True)
+        .add_extension(x509.SubjectAlternativeName(san_entries), critical=False)
+        .add_extension(
+            x509.ExtendedKeyUsage(
+                [ExtendedKeyUsageOID.SERVER_AUTH, ExtendedKeyUsageOID.CLIENT_AUTH]
+            ),
+            critical=False,
+        )
+        .add_extension(
+            x509.AuthorityKeyIdentifier.from_issuer_public_key(org_cert.public_key()),
+            critical=False,
+        )
+        .sign(org_key, algorithm=None)
+    )
+    return _pem(cert), _key_pem(key)
+
+
+def generate_crl(
+    org_cert_pem: bytes,
+    org_key_pem: bytes,
+    revoked_cert_pems: list[bytes],
+    days: int = 30,
+) -> bytes:
+    """Certificate revocation list signed by the org CA."""
+    org_cert = x509.load_pem_x509_certificate(org_cert_pem)
+    org_key = load_private_key_from_pem(org_key_pem)
+    now = datetime.datetime.now(datetime.timezone.utc)
+    builder = (
+        x509.CertificateRevocationListBuilder()
+        .issuer_name(org_cert.subject)
+        .last_update(now - _ONE_DAY)
+        .next_update(now + datetime.timedelta(days=days))
+    )
+    for pem in revoked_cert_pems:
+        revoked = x509.load_pem_x509_certificate(pem)
+        builder = builder.add_revoked_certificate(
+            x509.RevokedCertificateBuilder()
+            .serial_number(revoked.serial_number)
+            .revocation_date(now - _ONE_DAY)
+            .build()
+        )
+    crl = builder.sign(org_key, algorithm=None)
+    return crl.public_bytes(serialization.Encoding.PEM)
+
+
+# ---------------------------------------------------------------------------
+# Identity: PeerID = multihash-style digest of the SubjectPublicKeyInfo
+# ---------------------------------------------------------------------------
+
+
+def peer_id_from_cert_der(der: bytes) -> str:
+    cert = x509.load_der_x509_certificate(der)
+    spki = cert.public_key().public_bytes(
+        serialization.Encoding.DER, serialization.PublicFormat.SubjectPublicKeyInfo
+    )
+    return "12H" + hashlib.sha256(spki).hexdigest()[:40]
+
+
+def peer_id_from_cert_pem(pem: bytes) -> str:
+    cert = x509.load_pem_x509_certificate(pem)
+    return peer_id_from_cert_der(
+        cert.public_bytes(serialization.Encoding.DER)
+    )
+
+
+# ---------------------------------------------------------------------------
+# Loading (cert.rs parity)
+# ---------------------------------------------------------------------------
+
+
+def load_certs_from_pem(path: str | Path) -> list[x509.Certificate]:
+    return x509.load_pem_x509_certificates(Path(path).read_bytes())
+
+
+def load_private_key_from_pem(pem_or_path: bytes | str | Path):
+    data = (
+        pem_or_path
+        if isinstance(pem_or_path, bytes)
+        else Path(pem_or_path).read_bytes()
+    )
+    return serialization.load_pem_private_key(data, password=None)
+
+
+def load_crls_from_pem(path: str | Path) -> list[x509.CertificateRevocationList]:
+    data = Path(path).read_bytes()
+    crls = []
+    start = 0
+    marker = b"-----BEGIN X509 CRL-----"
+    while True:
+        i = data.find(marker, start)
+        if i < 0:
+            break
+        j = data.find(b"-----END X509 CRL-----", i)
+        block = data[i : j + len(b"-----END X509 CRL-----")]
+        crls.append(x509.load_pem_x509_crl(block))
+        start = j + 1
+    return crls
+
+
+# ---------------------------------------------------------------------------
+# mTLS contexts
+# ---------------------------------------------------------------------------
+
+
+def _mtls_context(
+    purpose: ssl.Purpose,
+    cert_file: str | Path,
+    key_file: str | Path,
+    trust_file: str | Path,
+    crl_file: str | Path | None = None,
+) -> ssl.SSLContext:
+    ctx = ssl.SSLContext(
+        ssl.PROTOCOL_TLS_SERVER
+        if purpose is ssl.Purpose.CLIENT_AUTH
+        else ssl.PROTOCOL_TLS_CLIENT
+    )
+    ctx.minimum_version = ssl.TLSVersion.TLSv1_3
+    ctx.load_cert_chain(str(cert_file), str(key_file))
+    ctx.load_verify_locations(str(trust_file))
+    ctx.verify_mode = ssl.CERT_REQUIRED
+    # Identity is the cert-key hash (peer id), not a DNS name.
+    ctx.check_hostname = False
+    if crl_file is not None:
+        ctx.load_verify_locations(str(crl_file))
+        ctx.verify_flags |= ssl.VERIFY_CRL_CHECK_LEAF
+    return ctx
+
+
+def make_server_context(
+    cert_file: str | Path,
+    key_file: str | Path,
+    trust_file: str | Path,
+    crl_file: str | Path | None = None,
+) -> ssl.SSLContext:
+    """Server side of mTLS: presents the node chain, requires client certs."""
+    return _mtls_context(ssl.Purpose.CLIENT_AUTH, cert_file, key_file, trust_file, crl_file)
+
+
+def make_client_context(
+    cert_file: str | Path,
+    key_file: str | Path,
+    trust_file: str | Path,
+    crl_file: str | Path | None = None,
+) -> ssl.SSLContext:
+    """Client side of mTLS: presents the node chain, verifies the server."""
+    return _mtls_context(ssl.Purpose.SERVER_AUTH, cert_file, key_file, trust_file, crl_file)
+
+
+def write_node_dir(
+    out_dir: str | Path,
+    node_name: str,
+    org_cert_pem: bytes,
+    org_key_pem: bytes,
+    root_cert_pem: bytes,
+    sans: list[str] | None = None,
+) -> dict[str, Path]:
+    """Generate and lay out one node's credentials:
+
+      <out>/<name>.crt   — node cert + org CA (the chain the node presents)
+      <out>/<name>.key   — node private key (0600)
+      <out>/trust.crt    — root CA (what the node trusts)
+
+    Returns the paths plus the node's derived peer id under key "peer_id".
+    """
+    out = Path(out_dir)
+    out.mkdir(parents=True, exist_ok=True)
+    cert_pem, key_pem = generate_node_cert(node_name, org_cert_pem, org_key_pem, sans)
+    cert_path = out / f"{node_name}.crt"
+    key_path = out / f"{node_name}.key"
+    trust_path = out / "trust.crt"
+    cert_path.write_bytes(cert_pem + org_cert_pem)
+    key_path.write_bytes(key_pem)
+    key_path.chmod(0o600)
+    if not trust_path.exists():
+        trust_path.write_bytes(root_cert_pem)
+    return {
+        "cert": cert_path,
+        "key": key_path,
+        "trust": trust_path,
+        "peer_id": peer_id_from_cert_pem(cert_pem),  # type: ignore[dict-item]
+    }
+
+
+def _pem(cert: x509.Certificate) -> bytes:
+    return cert.public_bytes(serialization.Encoding.PEM)
+
+
+def _key_pem(key: ed25519.Ed25519PrivateKey) -> bytes:
+    return key.private_bytes(
+        serialization.Encoding.PEM,
+        serialization.PrivateFormat.PKCS8,
+        serialization.NoEncryption(),
+    )

@@ -257,12 +257,20 @@ class TcpTransport(Transport):
         self._server_ssl = server_ssl
         self._client_ssl = client_ssl
         self._servers: list[asyncio.base_events.Server] = []
+        self._conn_tasks: set[asyncio.Task] = set()
 
     async def listen(self, addr: str, on_stream: AcceptCallback) -> str:
         host, _, port = addr.rpartition(":")
         host = host or "127.0.0.1"
 
         async def handle(reader: asyncio.StreamReader, writer: asyncio.StreamWriter):
+            # Track the handler task: since Python 3.12 Server.wait_closed()
+            # blocks until every handler returns, so close() must be able to
+            # cancel handlers parked on idle reads or undrained pushes.
+            task = asyncio.current_task()
+            if task is not None:
+                self._conn_tasks.add(task)
+                task.add_done_callback(self._conn_tasks.discard)
             stream = _TcpStream(reader, writer)
             try:
                 await on_stream(stream)
@@ -295,10 +303,12 @@ class TcpTransport(Transport):
     async def close(self) -> None:
         for server in self._servers:
             server.close()
+        for task in list(self._conn_tasks):
+            task.cancel()
         for server in self._servers:
             try:
                 await server.wait_closed()
-            except ConnectionError:
+            except (ConnectionError, asyncio.CancelledError):
                 pass
         self._servers.clear()
 

@@ -394,7 +394,7 @@ class Node:
             peer = hello.get("from", "")
             proto = hello.get("proto", "")
             addr = hello.get("addr", "")
-        except (FrameError, Exception) as e:
+        except Exception as e:
             log.debug("bad handshake: %s", e)
             await stream.abort()
             return
@@ -513,9 +513,24 @@ class Node:
         last_err: Exception | None = None
         for addr in addrs:
             try:
-                return await self._open_raw(addr, proto)
+                stream = await self._open_raw(addr, proto)
             except (ConnectionError, OSError) as e:
                 last_err = e
+                continue
+            # Under mTLS, the server's certificate must prove the peer id we
+            # meant to reach (PeerID = cert-key-hash; rfc/2025-05-30_mtls.md).
+            if self._expected_peer_id is not None:
+                actual = self._expected_peer_id(stream)
+                if actual is not None and actual != peer_id:
+                    await stream.abort()
+                    known = self._peers.get(peer_id, [])
+                    if addr in known:  # a concurrent call may have removed it
+                        known.remove(addr)
+                    last_err = RequestError(
+                        f"{addr} presented certificate of {actual}, wanted {peer_id}"
+                    )
+                    continue
+            return stream
         raise RequestError(f"no route to {peer_id}: {last_err}")
 
     # ---------------------------------------------------------------- gossip
@@ -535,6 +550,12 @@ class Node:
             lst.remove(sub)
 
     async def publish(self, topic: str, msg: Any) -> None:
+        """Flood ``msg`` to the mesh. NOTE on attribution: the ``origin``
+        delivered to subscribers is relay-supplied and advisory — gossip
+        carries only discovery/auction ads in a permissioned (mTLS) network,
+        and every security-relevant follow-up (offers, leases, dispatch)
+        happens over cert-verified RPC. Do not authorize based on gossip
+        origin; message signing is tracked as future hardening."""
         msg_id = uuid.uuid4().hex
         self._mark_seen(msg_id)
         body = messages.encode(msg)
@@ -719,7 +740,10 @@ class Node:
         if t == "identify":
             return {"ok": True, "peer": self.peer_id}
         if t == "register":
-            peer, addrs = frame.get("peer", ""), frame.get("addrs", [])
+            # Identity comes from the handshake (cert-verified under mTLS),
+            # never from the frame body — a trusted-but-malicious peer must
+            # not be able to overwrite another peer's address book entry.
+            peer, addrs = from_peer or frame.get("peer", ""), frame.get("addrs", [])
             if peer:
                 self._addr_book[peer] = list(addrs)
                 self.add_gossip_peer(peer)
@@ -735,7 +759,7 @@ class Node:
                 return {"ok": True, "value": self._records[key]}
             return {"ok": False, "error": f"no record {key!r}"}
         if t == "provide":
-            key, peer = frame.get("key", ""), frame.get("peer", "")
+            key, peer = frame.get("key", ""), from_peer or frame.get("peer", "")
             self._providers.setdefault(key, {})[peer] = time.time()
             if frame.get("addrs"):
                 self._addr_book[peer] = list(frame["addrs"])

@@ -1,0 +1,189 @@
+"""PKI + mTLS tests.
+
+Reference roles covered: certutil hierarchy generation
+(crates/certutil/src/main.rs), PEM loading (crates/network/src/cert.rs),
+PeerID = cert-key-hash identity, mTLS handshake enforcement and CRL
+rejection (rfc/2025-05-30_mtls.md).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import ssl
+
+import pytest
+
+from hypha_tpu import certs, certutil
+from hypha_tpu.messages import PROTOCOL_HEALTH, HealthRequest, HealthResponse
+from hypha_tpu.network.secure import secure_node
+
+
+def run(coro):
+    return asyncio.run(asyncio.wait_for(coro, timeout=30))
+
+
+@pytest.fixture(scope="module")
+def pki(tmp_path_factory):
+    """root -> org -> {alice, bob, mallory-from-other-org} via the CLI."""
+    out = tmp_path_factory.mktemp("pki")
+    assert certutil.main(["root", "--out", str(out)]) == 0
+    assert certutil.main(["org", "--out", str(out), "--name", "org-a"]) == 0
+    for name in ("alice", "bob", "eve"):
+        assert (
+            certutil.main(["node", "--out", str(out), "--org", "org-a", "--name", name])
+            == 0
+        )
+    # a parallel, untrusted hierarchy for mallory
+    other = tmp_path_factory.mktemp("pki-other")
+    assert certutil.main(["root", "--out", str(other)]) == 0
+    assert certutil.main(["org", "--out", str(other), "--name", "org-x"]) == 0
+    assert (
+        certutil.main(
+            ["node", "--out", str(other), "--org", "org-x", "--name", "mallory"]
+        )
+        == 0
+    )
+    return out, other
+
+
+def _node(out, name, **kw):
+    return secure_node(
+        out / f"{name}.crt", out / f"{name}.key", out / "trust.crt", **kw
+    )
+
+
+def test_peer_id_is_cert_key_hash(pki):
+    out, _ = pki
+    pid = certs.peer_id_from_cert_pem((out / "alice.crt").read_bytes())
+    assert pid.startswith("12H") and len(pid) == 43
+    # deterministic
+    assert pid == certs.peer_id_from_cert_pem((out / "alice.crt").read_bytes())
+    # distinct keys -> distinct ids
+    assert pid != certs.peer_id_from_cert_pem((out / "bob.crt").read_bytes())
+
+
+def test_loaders(pki):
+    out, _ = pki
+    chain = certs.load_certs_from_pem(out / "alice.crt")
+    assert len(chain) == 2  # node + org CA
+    key = certs.load_private_key_from_pem(out / "alice.key")
+    assert key is not None
+
+
+def test_mtls_rpc_roundtrip(pki):
+    out, _ = pki
+
+    async def main():
+        alice = _node(out, "alice")
+        bob = _node(out, "bob")
+        await alice.start(listen=["127.0.0.1:0"])
+        await bob.start(listen=["127.0.0.1:0"])
+
+        async def health(peer, msg):
+            # the caller's identity is certificate-derived
+            assert peer == alice.peer_id
+            return HealthResponse(healthy=True)
+
+        bob.on(PROTOCOL_HEALTH, HealthRequest).respond_with(health)
+        peer = await alice.dial(bob.listen_addrs[0])
+        assert peer == bob.peer_id
+        resp = await alice.request(bob.peer_id, PROTOCOL_HEALTH, HealthRequest())
+        assert resp.healthy
+        await alice.stop(); await bob.stop()
+
+    run(main())
+
+
+def test_untrusted_hierarchy_rejected(pki):
+    out, other = pki
+
+    async def main():
+        alice = _node(out, "alice")
+        mallory = _node(other, "mallory")
+        await alice.start(listen=["127.0.0.1:0"])
+        await mallory.start(listen=["127.0.0.1:0"])
+        with pytest.raises((ssl.SSLError, ConnectionError, OSError)):
+            await mallory.dial(alice.listen_addrs[0])
+        await alice.stop(); await mallory.stop()
+
+    run(main())
+
+
+def test_identity_spoof_rejected(pki):
+    """A trusted peer claiming another's peer id in the handshake is cut off
+    (PeerID must match the TLS certificate)."""
+    out, _ = pki
+
+    async def main():
+        alice = _node(out, "alice")
+        bob = _node(out, "bob")
+        eve = _node(out, "eve")
+        await alice.start(listen=["127.0.0.1:0"])
+        await bob.start(listen=["127.0.0.1:0"])
+        await eve.start(listen=["127.0.0.1:0"])
+
+        async def health(peer, msg):
+            return HealthResponse(healthy=True)
+
+        bob.on(PROTOCOL_HEALTH, HealthRequest).respond_with(health)
+
+        # eve lies about being alice in the handshake 'from' field
+        eve.peer_id = alice.peer_id
+        from hypha_tpu.network import RequestError
+
+        eve.add_peer_addr(bob.peer_id, bob.listen_addrs[0])
+        with pytest.raises(RequestError):
+            await eve.request(bob.peer_id, PROTOCOL_HEALTH, HealthRequest())
+
+        # client-side check: alice dials an address she believes is bob's,
+        # but eve answers -> certificate mismatch aborts
+        honest_eve = _node(out, "eve")
+        await honest_eve.start(listen=["127.0.0.1:0"])
+        alice.add_peer_addr(bob.peer_id, honest_eve.listen_addrs[0])
+        with pytest.raises(RequestError):
+            await alice.request(bob.peer_id, PROTOCOL_HEALTH, HealthRequest())
+        for n in (alice, bob, eve, honest_eve):
+            await n.stop()
+
+    run(main())
+
+
+def test_crl_revocation(pki, tmp_path):
+    out, _ = pki
+    # revoke eve via the CLI, then build nodes that load the CRL
+    assert (
+        certutil.main(
+            [
+                "revoke",
+                "--out",
+                str(out),
+                "--org",
+                "org-a",
+                "--cert",
+                str(out / "eve.crt"),
+            ]
+        )
+        == 0
+    )
+    crl = out / "org-a.crl"
+
+    async def main():
+        alice = _node(out, "alice", crl_file=crl)
+        eve = _node(out, "eve")
+        await alice.start(listen=["127.0.0.1:0"])
+        await eve.start(listen=["127.0.0.1:0"])
+        # TLS 1.3: the server rejects the revoked client cert after the
+        # client's handshake completes, so the client sees either an SSL
+        # alert or an immediate EOF (FrameError) on first read.
+        from hypha_tpu.network import FrameError
+
+        with pytest.raises((ssl.SSLError, ConnectionError, OSError, FrameError)):
+            await eve.dial(alice.listen_addrs[0])
+        # bob (not revoked) still connects fine against the same CRL config
+        bob = _node(out, "bob", crl_file=crl)
+        await bob.start(listen=["127.0.0.1:0"])
+        assert await bob.dial(alice.listen_addrs[0]) == alice.peer_id
+        for n in (alice, eve, bob):
+            await n.stop()
+
+    run(main())
