@@ -195,9 +195,19 @@ def generate_crl(
     org_cert_pem: bytes,
     org_key_pem: bytes,
     revoked_cert_pems: list[bytes],
-    days: int = 30,
+    days: int = 365,
+    extra_revoked_serials: list[int] | None = None,
 ) -> bytes:
-    """Certificate revocation list signed by the org CA."""
+    """Certificate revocation list signed by the org CA.
+
+    AVAILABILITY NOTE: with ``VERIFY_CRL_CHECK_LEAF`` OpenSSL hard-fails
+    *all* verification once the CRL's next_update passes — an expired CRL
+    cuts the node off from every peer, not just revoked ones. ``days`` is
+    therefore a re-issuance deadline; regenerate CRLs well before it.
+
+    ``extra_revoked_serials`` carries forward serials from a previous CRL
+    so re-issuing never silently un-revokes certificates.
+    """
     org_cert = x509.load_pem_x509_certificate(org_cert_pem)
     org_key = load_private_key_from_pem(org_key_pem)
     now = datetime.datetime.now(datetime.timezone.utc)
@@ -207,11 +217,15 @@ def generate_crl(
         .last_update(now - _ONE_DAY)
         .next_update(now + datetime.timedelta(days=days))
     )
-    for pem in revoked_cert_pems:
-        revoked = x509.load_pem_x509_certificate(pem)
+    serials = {
+        x509.load_pem_x509_certificate(pem).serial_number
+        for pem in revoked_cert_pems
+    }
+    serials.update(extra_revoked_serials or [])
+    for serial in sorted(serials):
         builder = builder.add_revoked_certificate(
             x509.RevokedCertificateBuilder()
-            .serial_number(revoked.serial_number)
+            .serial_number(serial)
             .revocation_date(now - _ONE_DAY)
             .build()
         )
@@ -347,8 +361,9 @@ def write_node_dir(
     cert_path.write_bytes(cert_pem + org_cert_pem)
     key_path.write_bytes(key_pem)
     key_path.chmod(0o600)
-    if not trust_path.exists():
-        trust_path.write_bytes(root_cert_pem)
+    # Always (re)write: a regenerated root must not leave a stale trust
+    # anchor behind, or every later handshake fails inscrutably.
+    trust_path.write_bytes(root_cert_pem)
     return {
         "cert": cert_path,
         "key": key_path,

@@ -67,14 +67,27 @@ def _cmd_revoke(args) -> int:
     out = Path(args.out)
     crl_path = out / f"{args.org}.crl"
     revoked = [Path(c).read_bytes() for c in args.cert]
-    crl = certs.generate_crl(
+    # Carry forward serials already revoked: re-issuing the CRL must never
+    # silently un-revoke certificates from earlier invocations.
+    prior_serials: list[int] = []
+    if crl_path.exists():
+        for crl in certs.load_crls_from_pem(crl_path):
+            prior_serials.extend(rc.serial_number for rc in crl)
+    crl_pem = certs.generate_crl(
         (out / f"{args.org}.crt").read_bytes(),
         (out / f"{args.org}.key").read_bytes(),
         revoked,
+        days=args.days,
+        extra_revoked_serials=prior_serials,
     )
-    crl_path.write_bytes(crl)
-    print(f"CRL written to {crl_path} ({len(revoked)} certificates)")
+    crl_path.write_bytes(crl_pem)
+    total = len(set(prior_serials)) + len(revoked)
+    print(f"CRL written to {crl_path} ({len(revoked)} new, {total} total entries)")
     print("note: nodes load CRLs at startup only; restart nodes to apply")
+    print(
+        f"note: CRL expires in {args.days} days — an expired CRL blocks ALL "
+        "peers on CRL-checking nodes; re-issue before then"
+    )
     return 0
 
 
@@ -105,6 +118,7 @@ def main(argv: list[str] | None = None) -> int:
     p.add_argument("--out", default="pki")
     p.add_argument("--org", required=True)
     p.add_argument("--cert", action="append", required=True)
+    p.add_argument("--days", type=int, default=365, help="CRL validity (re-issuance deadline)")
     p.set_defaults(fn=_cmd_revoke)
 
     args = parser.parse_args(argv)

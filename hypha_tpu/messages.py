@@ -163,6 +163,17 @@ def decode(data: bytes) -> Any:
     return _from_plain(codec.loads(data))
 
 
+def to_json_dict(msg: Any) -> Any:
+    """JSON-safe plain form (for JOB_JSON handed to executor processes —
+    reference passes the job spec as JSON, crates/worker/src/executor/
+    process.rs:124-137). Bytes are not representable; job specs carry none."""
+    return _to_plain(msg)
+
+
+def from_json_dict(obj: Any) -> Any:
+    return _from_plain(obj)
+
+
 def _enum(cls):
     _ENUMS[cls.__name__] = cls
     return cls

@@ -1,0 +1,137 @@
+"""ctypes bindings for the native (C++) tensor kernels, with numpy fallback.
+
+The runtime's numerical hot spot outside JAX is the parameter server's
+outer step (SURVEY.md §2.9: the reference's only native math is Rust
+candle-core averaging + Nesterov). The C++ source lives in
+``native/hypha_ps.cpp``; it is compiled on first use with the system g++
+into ``native/build/libhypha_ps.so`` and cached. Environments without a
+toolchain transparently fall back to numpy — results are identical, the
+C++ path just fuses the passes.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import logging
+import subprocess
+from pathlib import Path
+
+import numpy as np
+
+__all__ = ["weighted_sum", "nesterov_update", "fused_mean_nesterov", "native_available"]
+
+log = logging.getLogger("hypha.native")
+
+_REPO = Path(__file__).resolve().parent.parent
+_SRC = _REPO / "native" / "hypha_ps.cpp"
+_SO = _REPO / "native" / "build" / "libhypha_ps.so"
+
+_lib: ctypes.CDLL | None = None
+_tried = False
+
+_F32P = ctypes.POINTER(ctypes.c_float)
+
+
+def _load() -> ctypes.CDLL | None:
+    global _lib, _tried
+    if _lib is not None or _tried:
+        return _lib
+    _tried = True
+    try:
+        if not _SO.exists() or _SO.stat().st_mtime < _SRC.stat().st_mtime:
+            _SO.parent.mkdir(parents=True, exist_ok=True)
+            subprocess.run(
+                [
+                    "g++", "-O3", "-march=native", "-shared", "-fPIC",
+                    str(_SRC), "-o", str(_SO),
+                ],
+                check=True,
+                capture_output=True,
+                timeout=120,
+            )
+        lib = ctypes.CDLL(str(_SO))
+        lib.weighted_sum_f32.argtypes = [
+            ctypes.POINTER(_F32P), _F32P, ctypes.c_int64, _F32P, ctypes.c_int64,
+        ]
+        lib.nesterov_update_f32.argtypes = [
+            _F32P, _F32P, _F32P, ctypes.c_int64, ctypes.c_float, ctypes.c_float,
+        ]
+        lib.fused_mean_nesterov_f32.argtypes = [
+            ctypes.POINTER(_F32P), _F32P, ctypes.c_int64,
+            _F32P, _F32P, ctypes.c_int64, ctypes.c_float, ctypes.c_float,
+        ]
+        _lib = lib
+    except (subprocess.SubprocessError, OSError, FileNotFoundError) as e:
+        log.info("native kernels unavailable (%s); using numpy", e)
+        _lib = None
+    return _lib
+
+
+def native_available() -> bool:
+    return _load() is not None
+
+
+def _as_f32(a: np.ndarray) -> np.ndarray:
+    out = np.ascontiguousarray(a, dtype=np.float32)
+    return out
+
+
+def _ptr(a: np.ndarray) -> "ctypes._Pointer":
+    return a.ctypes.data_as(_F32P)
+
+
+def weighted_sum(srcs: list[np.ndarray], weights: np.ndarray) -> np.ndarray:
+    """sum_k w[k] * srcs[k]; pass normalized weights for a weighted mean."""
+    srcs = [_as_f32(s).ravel() for s in srcs]
+    w = _as_f32(np.asarray(weights)).ravel()
+    n = srcs[0].size
+    lib = _load()
+    if lib is None:
+        return sum(wk * s for wk, s in zip(w, srcs)).astype(np.float32)
+    dst = np.empty(n, np.float32)
+    arr_type = _F32P * len(srcs)
+    lib.weighted_sum_f32(
+        arr_type(*(_ptr(s) for s in srcs)), _ptr(w), len(srcs), _ptr(dst), n
+    )
+    return dst
+
+
+def nesterov_update(
+    momentum: np.ndarray, grad: np.ndarray, lr: float, mu: float
+) -> tuple[np.ndarray, np.ndarray]:
+    """m <- mu*m + g; update <- lr*(mu*m + g). Returns (momentum, update)."""
+    m = _as_f32(momentum).ravel().copy()
+    g = _as_f32(grad).ravel()
+    lib = _load()
+    if lib is None:
+        m = mu * m + g
+        return m, (lr * (mu * m + g)).astype(np.float32)
+    upd = np.empty_like(g)
+    lib.nesterov_update_f32(_ptr(m), _ptr(g), _ptr(upd), g.size, lr, mu)
+    return m, upd
+
+
+def fused_mean_nesterov(
+    srcs: list[np.ndarray],
+    weights: np.ndarray,
+    momentum: np.ndarray,
+    lr: float,
+    mu: float,
+) -> tuple[np.ndarray, np.ndarray]:
+    """Weighted mean of ``srcs`` then Nesterov, one pass.
+    Returns (momentum, update)."""
+    srcs = [_as_f32(s).ravel() for s in srcs]
+    w = _as_f32(np.asarray(weights)).ravel()
+    m = _as_f32(momentum).ravel().copy()
+    lib = _load()
+    if lib is None:
+        g = sum(wk * s for wk, s in zip(w, srcs)).astype(np.float32)
+        m = mu * m + g
+        return m, (lr * (mu * m + g)).astype(np.float32)
+    upd = np.empty_like(m)
+    arr_type = _F32P * len(srcs)
+    lib.fused_mean_nesterov_f32(
+        arr_type(*(_ptr(s) for s in srcs)), _ptr(w), len(srcs),
+        _ptr(m), _ptr(upd), m.size, lr, mu,
+    )
+    return m, upd

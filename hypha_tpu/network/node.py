@@ -374,12 +374,19 @@ class Node:
         return self.listen_addrs[0] if self.listen_addrs else ""
 
     async def dial(self, addr: str, proto: str = PROTOCOL_REGISTRY) -> str:
-        """Dial an address to learn/verify the peer behind it (identify)."""
+        """Dial an address to learn/verify the peer behind it (identify).
+        Under mTLS the claimed id must match the certificate-derived one."""
         stream = await self._open_raw(addr, proto)
         try:
             await stream.write_frame({"t": "identify"})
             reply = await stream.read_frame()
             peer = reply.get("peer", "")
+            if peer and self._expected_peer_id is not None:
+                actual = self._expected_peer_id(stream)
+                if actual is not None and actual != peer:
+                    raise RequestError(
+                        f"{addr} claims {peer} but presents certificate of {actual}"
+                    )
             if peer:
                 self.add_peer_addr(peer, addr)
             return peer

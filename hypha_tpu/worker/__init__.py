@@ -1,0 +1,30 @@
+"""L3/L4 worker runtime: resources, leases, auction arbiter, job execution.
+
+The worker is the node type that sells compute into the dRAP auction and
+runs training/aggregation jobs (reference: crates/worker — SURVEY.md §2.5).
+
+Composition (mirrors hypha-worker's Arbiter wiring,
+crates/worker/src/bin/hypha-worker.rs:219-233):
+
+    StaticResourceManager — capacity minus live reservations
+    LeaseManager          — atomic reserve + ledger insert, renewal, expiry
+    Arbiter               — windows auction ads, scores, offers, leases,
+                            renews, prunes, dispatches
+    JobManager            — routes train -> ProcessExecutor,
+                            aggregate -> ParameterServerExecutor
+"""
+
+from .arbiter import Arbiter, OfferConfig
+from .job_manager import JobManager
+from .lease_manager import LeaseManager, ResourceLease
+from .resources_mgr import ResourceManager, StaticResourceManager
+
+__all__ = [
+    "Arbiter",
+    "OfferConfig",
+    "JobManager",
+    "LeaseManager",
+    "ResourceLease",
+    "ResourceManager",
+    "StaticResourceManager",
+]

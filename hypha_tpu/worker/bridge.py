@@ -1,0 +1,288 @@
+"""The Job Bridge: the executor-facing API, HTTP over a per-job unix socket.
+
+Reference: crates/worker/src/executor/bridge.rs — an HTTP server on a
+0600 unix socket inside the job's work dir, giving the out-of-process
+executor exactly four capabilities and nothing else:
+
+  * ``POST /resources/fetch``   — materialize a Fetch reference under
+    ``work_dir/artifacts`` (:216-248);
+  * ``POST /resources/send``    — stream a work-dir file to peers in the
+    background (:256-327);
+  * ``POST /resources/receive`` — SSE stream of ``{path,size,from_peer}``
+    pointers as files land in ``work_dir/incoming`` (:392-504);
+  * ``POST /status/send``       — proxy a Progress message to the scheduler
+    over the progress protocol, returning its response (:506-523);
+  * ``GET /openapi.json``       — self-description.
+
+Path safety: no absolute paths, no ``..`` traversal (:330-346).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import logging
+from pathlib import Path
+
+from .. import messages
+from ..messages import PROTOCOL_PROGRESS, Fetch, Progress, Receive, Send
+from ..network.node import Node
+from .connectors import Connector
+
+__all__ = ["Bridge", "BridgeError"]
+
+log = logging.getLogger("hypha.worker.bridge")
+
+MAX_BODY = 8 * 1024 * 1024
+
+_OPENAPI = {
+    "openapi": "3.0.0",
+    "info": {"title": "hypha job bridge", "version": "0.0.1"},
+    "paths": {
+        "/resources/fetch": {"post": {}},
+        "/resources/send": {"post": {}},
+        "/resources/receive": {"post": {}},
+        "/status/send": {"post": {}},
+    },
+}
+
+
+class BridgeError(ValueError):
+    pass
+
+
+def safe_rel(work_dir: Path, rel: str) -> Path:
+    """Resolve a client-supplied relative path inside the work dir
+    (bridge.rs:330-346: reject absolute and traversal)."""
+    p = Path(rel)
+    if p.is_absolute():
+        raise BridgeError(f"absolute path not allowed: {rel}")
+    if ".." in p.parts:
+        raise BridgeError(f"path traversal not allowed: {rel}")
+    return work_dir / p
+
+
+class Bridge:
+    def __init__(
+        self,
+        node: Node,
+        work_dir: Path,
+        job_id: str,
+        scheduler_peer: str,
+        connector: Connector | None = None,
+    ) -> None:
+        self.node = node
+        self.work_dir = Path(work_dir)
+        self.job_id = job_id
+        self.scheduler_peer = scheduler_peer
+        self.connector = connector or Connector(node, scheduler_peer)
+        self.socket_path = self.work_dir / "bridge.sock"
+        self._server: asyncio.base_events.Server | None = None
+        self._send_tasks: set[asyncio.Task] = set()
+
+    async def start(self) -> Path:
+        self.work_dir.mkdir(parents=True, exist_ok=True)
+        self._server = await asyncio.start_unix_server(
+            self._handle, path=str(self.socket_path)
+        )
+        self.socket_path.chmod(0o600)
+        return self.socket_path
+
+    async def stop(self) -> None:
+        for task in list(self._send_tasks):
+            task.cancel()
+        if self._server is not None:
+            self._server.close()
+            try:
+                await self._server.wait_closed()
+            except asyncio.CancelledError:
+                pass
+        self.socket_path.unlink(missing_ok=True)
+
+    # ------------------------------------------------------------- server
+
+    async def _handle(
+        self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter
+    ) -> None:
+        # HTTP/1.1 keep-alive: the executor's per-batch status heartbeats
+        # ride one connection (the reference's httpx Session does the same).
+        try:
+            while True:
+                request_line = await reader.readline()
+                if not request_line:
+                    return  # client closed
+                parts = request_line.decode("latin-1").split()
+                if len(parts) < 2:
+                    return
+                method, path = parts[0], parts[1]
+                headers: dict[str, str] = {}
+                while True:
+                    line = await reader.readline()
+                    if line in (b"\r\n", b"\n", b""):
+                        break
+                    k, _, v = line.decode("latin-1").partition(":")
+                    headers[k.strip().lower()] = v.strip()
+                length = int(headers.get("content-length", "0"))
+                if length > MAX_BODY:
+                    await self._respond(writer, 413, {"error": "body too large"})
+                    return
+                body = await reader.readexactly(length) if length else b""
+                if method == "POST" and path == "/resources/receive":
+                    # SSE takes over the connection until the client leaves.
+                    await self._receive(json.loads(body or b"{}"), reader, writer)
+                    return
+                await self._route(method, path, body, reader, writer)
+                if headers.get("connection", "").lower() == "close":
+                    return
+        except (asyncio.IncompleteReadError, ConnectionError):
+            pass
+        except Exception as e:
+            log.warning("bridge request failed: %s", e)
+            try:
+                await self._respond(writer, 500, {"error": str(e)})
+            except (ConnectionError, RuntimeError):
+                pass
+        finally:
+            try:
+                writer.close()
+            except ConnectionError:
+                pass
+
+    async def _respond(
+        self, writer: asyncio.StreamWriter, status: int, payload: dict
+    ) -> None:
+        body = json.dumps(payload).encode()
+        reason = {200: "OK", 202: "Accepted", 400: "Bad Request", 404: "Not Found",
+                  413: "Payload Too Large", 500: "Internal Server Error"}.get(
+            status, "?"
+        )
+        writer.write(
+            f"HTTP/1.1 {status} {reason}\r\n"
+            f"content-type: application/json\r\n"
+            f"content-length: {len(body)}\r\n\r\n".encode() + body
+        )
+        await writer.drain()
+
+    async def _route(
+        self,
+        method: str,
+        path: str,
+        body: bytes,
+        reader: asyncio.StreamReader,
+        writer: asyncio.StreamWriter,
+    ) -> None:
+        if method == "GET" and path == "/openapi.json":
+            await self._respond(writer, 200, _OPENAPI)
+        elif method == "POST" and path == "/resources/fetch":
+            await self._fetch(json.loads(body or b"{}"), writer)
+        elif method == "POST" and path == "/resources/send":
+            await self._send(json.loads(body or b"{}"), writer)
+        elif method == "POST" and path == "/status/send":
+            await self._status(json.loads(body or b"{}"), writer)
+        else:
+            await self._respond(writer, 404, {"error": f"no route {method} {path}"})
+
+    # ------------------------------------------------------------- routes
+
+    async def _fetch(self, body: dict, writer: asyncio.StreamWriter) -> None:
+        fetch = messages.from_json_dict(body.get("fetch"))
+        if not isinstance(fetch, Fetch):
+            await self._respond(writer, 400, {"error": "body.fetch must be a Fetch"})
+            return
+        dest = self.work_dir / "artifacts"
+        paths = await self.connector.fetch(fetch, dest)
+        await self._respond(
+            writer,
+            200,
+            {"paths": [str(p.relative_to(self.work_dir)) for p in paths]},
+        )
+
+    async def _send(self, body: dict, writer: asyncio.StreamWriter) -> None:
+        send = messages.from_json_dict(body.get("send"))
+        if not isinstance(send, Send):
+            await self._respond(writer, 400, {"error": "body.send must be a Send"})
+            return
+        path = safe_rel(self.work_dir, str(body.get("path", "")))
+        if not path.is_file():
+            await self._respond(writer, 400, {"error": f"no such file {body.get('path')}"})
+            return
+        resource = str(body.get("resource", "updates"))
+
+        # Background copy (bridge.rs:256-327): don't block the executor loop.
+        task = asyncio.create_task(self.connector.send(send, path, resource))
+        self._send_tasks.add(task)
+
+        def _log_done(t: asyncio.Task) -> None:
+            self._send_tasks.discard(t)
+            if not t.cancelled() and t.exception():
+                log.warning("background send failed: %s", t.exception())
+
+        task.add_done_callback(_log_done)
+        await self._respond(writer, 202, {"ok": True})
+
+    async def _receive(
+        self,
+        body: dict,
+        reader: asyncio.StreamReader,
+        writer: asyncio.StreamWriter,
+    ) -> None:
+        receive = messages.from_json_dict(body.get("receive"))
+        if not isinstance(receive, Receive):
+            await self._respond(writer, 400, {"error": "body.receive must be a Receive"})
+            return
+        # SSE stream of file pointers (bridge.rs:392-504).
+        writer.write(
+            b"HTTP/1.1 200 OK\r\ncontent-type: text/event-stream\r\n"
+            b"cache-control: no-cache\r\n\r\n"
+        )
+        await writer.drain()
+        incoming = self.work_dir / "incoming"
+        gen = self.connector.receive(receive, incoming)
+        # The client closing its connection must stop this loop — otherwise
+        # it would keep consuming the node's push queue (starving the next
+        # job) and block bridge shutdown.
+        client_gone = asyncio.create_task(reader.read())
+        try:
+            while True:
+                nxt = asyncio.create_task(anext(gen))
+                done, _ = await asyncio.wait(
+                    {nxt, client_gone}, return_when=asyncio.FIRST_COMPLETED
+                )
+                if nxt not in done:
+                    nxt.cancel()
+                    try:
+                        await nxt
+                    except (asyncio.CancelledError, StopAsyncIteration):
+                        pass
+                    break
+                try:
+                    rf = nxt.result()
+                except StopAsyncIteration:
+                    break
+                event = {
+                    "path": str(rf.path.relative_to(self.work_dir)),
+                    "size": rf.size,
+                    "from_peer": rf.from_peer,
+                    "resource": rf.resource,
+                }
+                try:
+                    writer.write(f"data: {json.dumps(event)}\n\n".encode())
+                    await writer.drain()
+                except (ConnectionError, RuntimeError):
+                    break
+        finally:
+            client_gone.cancel()
+            await gen.aclose()
+
+    async def _status(self, body: dict, writer: asyncio.StreamWriter) -> None:
+        progress = messages.from_json_dict(body.get("progress"))
+        if not isinstance(progress, Progress):
+            await self._respond(writer, 400, {"error": "body.progress must be Progress"})
+            return
+        progress.job_id = progress.job_id or self.job_id
+        response = await self.node.request(
+            self.scheduler_peer, PROTOCOL_PROGRESS, progress, timeout=30
+        )
+        await self._respond(
+            writer, 200, {"response": messages.to_json_dict(response)}
+        )

@@ -1,0 +1,211 @@
+"""Pluggable data-plane connectors: how job artifacts move.
+
+Reference: crates/worker/src/connector/mod.rs — ``FetchConnector`` /
+``SendConnector`` / ``ReceiveConnector`` traits (:65-87) with built-ins:
+
+  * ``HttpHfFetcher``      — http(s) URI streaming + HuggingFace Hub
+    downloads (:224-302); here also ``file://`` for local/offline runs;
+  * ``PeerStreamPushConnector`` — send/receive tensor files over fabric
+    push-streams, receivers filtered by allowed peers (:305-433);
+  * ``PeerStreamPullConnector`` — ask the scheduler for a slice assignment
+    (api::Data) then pull the slice from the data node (:436-507).
+
+Received file names are SHA-256-hashed before hitting the filesystem,
+matching the parameter server's path-injection defense
+(crates/worker/src/executor/parameter_server.rs:133-135).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import hashlib
+import logging
+import urllib.parse
+import urllib.request
+from pathlib import Path
+from typing import AsyncIterator
+
+from ..messages import (
+    PROTOCOL_API,
+    DataRequest,
+    DataResponse,
+    DataSlice,
+    Fetch,
+    Receive,
+    Reference,
+    Send,
+    TransferStrategy,
+)
+from ..network.node import Node, PushStream, RequestError
+
+__all__ = ["Connector", "ReceivedFile", "fetch_uri"]
+
+log = logging.getLogger("hypha.worker.connector")
+
+
+def _safe_name(name: str) -> str:
+    """Collapse any peer-supplied name to a flat digest-based filename."""
+    return hashlib.sha256(name.encode()).hexdigest()[:32]
+
+
+class ReceivedFile:
+    def __init__(self, path: Path, size: int, from_peer: str, resource: str) -> None:
+        self.path = path
+        self.size = size
+        self.from_peer = from_peer
+        self.resource = resource
+
+
+def fetch_uri(uri: str, dest_dir: Path) -> Path:
+    """Blocking URI download (run via to_thread): http(s) streamed to disk,
+    file:// hard-linked/copied. Scheme-validated (bridge.rs:350-377)."""
+    parsed = urllib.parse.urlparse(uri)
+    if parsed.scheme not in ("http", "https", "file"):
+        raise ValueError(f"unsupported URI scheme {parsed.scheme!r}")
+    dest_dir.mkdir(parents=True, exist_ok=True)
+    name = Path(parsed.path).name or "download"
+    dest = dest_dir / name
+    if parsed.scheme == "file":
+        src = Path(urllib.request.url2pathname(parsed.path))
+        dest.write_bytes(src.read_bytes())
+        return dest
+    with urllib.request.urlopen(uri) as resp, open(dest, "wb") as f:  # noqa: S310
+        while True:
+            chunk = resp.read(1 << 20)
+            if not chunk:
+                break
+            f.write(chunk)
+    return dest
+
+
+class Connector:
+    """Routes Reference variants to transports (connector/mod.rs router)."""
+
+    def __init__(self, node: Node, scheduler_peer: str = "") -> None:
+        self.node = node
+        self.scheduler_peer = scheduler_peer
+
+    # -------------------------------------------------------------- fetch
+
+    async def fetch(self, fetch: Fetch, dest_dir: Path) -> list[Path]:
+        ref = fetch.ref
+        variant = ref.variant()
+        if variant == "uri":
+            path = await asyncio.to_thread(fetch_uri, ref.uri, dest_dir)
+            return [path]
+        if variant == "huggingface":
+            return await asyncio.to_thread(self._fetch_hf, ref, dest_dir)
+        if variant == "scheduler":
+            return [await self._fetch_slice(ref, dest_dir)]
+        if variant == "peers":
+            raise ValueError("peers variant is receive-only for fetch")
+        raise ValueError(f"unknown fetch variant {variant}")
+
+    def _fetch_hf(self, ref: Reference, dest_dir: Path) -> list[Path]:
+        """HuggingFace Hub download via hf_hub (reference uses hf-hub crate)."""
+        from huggingface_hub import hf_hub_download  # lazy: not in hot path
+
+        dest_dir.mkdir(parents=True, exist_ok=True)
+        out = []
+        for filename in ref.filenames or []:
+            cached = hf_hub_download(
+                repo_id=ref.repo,
+                filename=filename,
+                revision=ref.revision or "main",
+                token=ref.token,
+            )
+            dest = dest_dir / Path(filename).name
+            dest.write_bytes(Path(cached).read_bytes())
+            out.append(dest)
+        return out
+
+    async def _fetch_slice(self, ref: Reference, dest_dir: Path) -> Path:
+        """Scheduler-mediated slice fetch: ask for an assignment, pull it
+        (connector/mod.rs:436-507 PeerStreamPullConnector)."""
+        scheduler = ref.scheduler_peer or self.scheduler_peer
+        if not scheduler:
+            raise ValueError("no scheduler peer for slice fetch")
+        resp = await self.node.request(
+            scheduler,
+            PROTOCOL_API,
+            DataRequest(dataset=ref.dataset or "", peer_id=self.node.peer_id),
+        )
+        if not isinstance(resp, DataResponse):
+            raise RequestError(f"unexpected data response {resp!r}")
+        stream = await self.node.pull(
+            resp.data_provider, DataSlice(dataset=ref.dataset or "", index=resp.index)
+        )
+        dest_dir.mkdir(parents=True, exist_ok=True)
+        dest = dest_dir / f"{_safe_name(ref.dataset or 'slice')}-{resp.index:06d}"
+        loop = asyncio.get_running_loop()
+        try:
+            with open(dest, "wb") as f:
+                while True:
+                    chunk = await stream.read(1 << 20)
+                    if not chunk:
+                        break
+                    await loop.run_in_executor(None, f.write, chunk)
+        finally:
+            await stream.close()
+        return dest
+
+    # --------------------------------------------------------------- send
+
+    async def send(self, send: Send, path: Path, resource: str) -> None:
+        """Push a local file to the reference's peers. ALL: every peer must
+        get it; ANY: first success wins (connector/mod.rs:305-433)."""
+        ref = send.ref
+        peers = ref.peers or []
+        strategy = ref.strategy or TransferStrategy.ALL
+        header = {"resource": resource, "name": path.name}
+        if strategy == TransferStrategy.ANY:
+            last: Exception | None = None
+            for peer in peers:
+                try:
+                    await self.node.push(peer, header, path)
+                    return
+                except RequestError as e:
+                    last = e
+            raise RequestError(f"no peer accepted {resource}: {last}")
+        failures = []
+        for peer in peers:
+            try:
+                await self.node.push(peer, header, path)
+            except RequestError as e:
+                failures.append((peer, e))
+        if failures:
+            raise RequestError(f"send failures: {failures}")
+
+    # ------------------------------------------------------------- receive
+
+    async def receive(
+        self, receive: Receive, dest_dir: Path
+    ) -> AsyncIterator[ReceivedFile]:
+        """Yield files as they land from allowed peers; unknown senders are
+        drained and dropped (connector/mod.rs:305-433 receiver filter)."""
+        allowed = set(receive.ref.peers or [])
+        dest_dir.mkdir(parents=True, exist_ok=True)
+        async for push in self.node.push_streams():
+            try:
+                if allowed and push.peer not in allowed:
+                    log.warning("dropping push from disallowed peer %s", push.peer)
+                    await push.read_all()  # drain to release the accept slot
+                    continue
+                resource, name = _push_names(push)
+                dest = dest_dir / f"{_safe_name(push.peer + '-' + name)}.bin"
+                size = await push.save_to(dest)
+            except asyncio.CancelledError:
+                # Consumer went away mid-transfer: release the accept slot so
+                # the sender's connection isn't pinned forever.
+                push.finish()
+                raise
+            yield ReceivedFile(dest, size, push.peer, resource)
+
+
+def _push_names(push: PushStream) -> tuple[str, str]:
+    res = push.resource
+    if isinstance(res, dict):
+        return str(res.get("resource", "")), str(res.get("name", "push"))
+    if isinstance(res, DataSlice):
+        return res.dataset, f"{res.dataset}-{res.index}"
+    return "", "push"

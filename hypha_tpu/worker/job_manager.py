@@ -1,0 +1,136 @@
+"""Job routing and lifecycle tracking.
+
+Reference: crates/worker/src/job_manager.rs:85-211 — routes
+``Executor::Train`` to the process executor and ``Executor::Aggregate`` to
+the in-runtime parameter-server executor, tracks active jobs, cancels jobs
+linked to an expired lease, reports ``JobStatus`` lifecycle events to the
+scheduler over the API protocol.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+from dataclasses import dataclass, field
+from typing import Any
+
+from ..messages import PROTOCOL_API, JobSpec, JobStatus
+from ..network.node import Node, RequestError
+
+__all__ = ["Execution", "JobExecutor", "JobManager"]
+
+log = logging.getLogger("hypha.worker.jobs")
+
+
+class Execution:
+    """A running job: await ``wait()`` for the terminal state, or cancel."""
+
+    def __init__(self, job_id: str) -> None:
+        self.job_id = job_id
+        self._result: asyncio.Future[JobStatus] = (
+            asyncio.get_event_loop().create_future()
+        )
+
+    async def wait(self) -> JobStatus:
+        return await asyncio.shield(self._result)
+
+    def finish(self, state: str, message: str = "") -> None:
+        if not self._result.done():
+            self._result.set_result(
+                JobStatus(job_id=self.job_id, state=state, message=message)
+            )
+
+    async def cancel(self) -> None:
+        self.finish("cancelled")
+
+
+class JobExecutor:
+    """Executor interface (crates/worker/src/executor/mod.rs)."""
+
+    async def execute(
+        self, job_id: str, spec: JobSpec, scheduler_peer: str
+    ) -> Execution:
+        raise NotImplementedError
+
+
+@dataclass(slots=True)
+class _ActiveJob:
+    execution: Execution
+    lease_id: str
+    monitor: asyncio.Task = field(default=None)  # type: ignore[assignment]
+
+
+class JobManager:
+    """Routes jobs to executors keyed by (class, name) and supervises them.
+
+    ``executors`` maps an executor-class ("train"/"aggregate") + name to a
+    JobExecutor instance, mirroring the worker config's executor table
+    (crates/worker/src/config.rs:114-191).
+    """
+
+    def __init__(self, node: Node, executors: dict[tuple[str, str], JobExecutor]) -> None:
+        self.node = node
+        self.executors = executors
+        self._active: dict[str, _ActiveJob] = {}
+
+    def supported(self) -> list[tuple[str, str]]:
+        return list(self.executors)
+
+    async def execute(
+        self, spec: JobSpec, lease_id: str, scheduler_peer: str
+    ) -> Execution:
+        key = (spec.executor.kind, spec.executor.name)
+        executor = self.executors.get(key)
+        if executor is None:
+            raise ValueError(f"no executor for {key}")
+        if spec.job_id in self._active:
+            raise ValueError(f"job {spec.job_id} already running")
+        execution = await executor.execute(spec.job_id, spec, scheduler_peer)
+        job = _ActiveJob(execution=execution, lease_id=lease_id)
+        job.monitor = asyncio.create_task(
+            self._monitor(spec.job_id, execution, scheduler_peer)
+        )
+        self._active[spec.job_id] = job
+        await self._report(
+            scheduler_peer, JobStatus(job_id=spec.job_id, state="running")
+        )
+        return execution
+
+    async def _monitor(
+        self, job_id: str, execution: Execution, scheduler_peer: str
+    ) -> None:
+        try:
+            status = await execution.wait()
+        except asyncio.CancelledError:
+            raise
+        finally:
+            self._active.pop(job_id, None)
+        await self._report(scheduler_peer, status)
+
+    async def _report(self, scheduler_peer: str, status: JobStatus) -> None:
+        try:
+            await self.node.request(scheduler_peer, PROTOCOL_API, status, timeout=10)
+        except RequestError as e:
+            log.warning("could not report %s for job %s: %s", status.state, status.job_id, e)
+
+    def jobs_for_lease(self, lease_id: str) -> list[str]:
+        return [jid for jid, j in self._active.items() if j.lease_id == lease_id]
+
+    async def cancel_for_lease(self, lease_id: str) -> None:
+        """Expired lease ⇒ its jobs die (crates/worker/src/arbiter.rs:96-141)."""
+        for jid in self.jobs_for_lease(lease_id):
+            log.info("cancelling job %s (lease %s expired)", jid, lease_id)
+            await self._active[jid].execution.cancel()
+
+    async def shutdown(self) -> None:
+        for job in list(self._active.values()):
+            await job.execution.cancel()
+        for job in list(self._active.values()):
+            if job.monitor is not None:
+                try:
+                    await asyncio.wait_for(job.monitor, 10)
+                except (asyncio.TimeoutError, asyncio.CancelledError):
+                    pass
+
+    def __len__(self) -> int:
+        return len(self._active)

@@ -1,0 +1,65 @@
+// Native tensor math for the parameter-server outer step.
+//
+// The reference implements its only native numerical component in Rust with
+// candle-core: streaming averaging of worker pseudo-gradients over mmapped
+// SafeTensors plus the Nesterov outer update
+// (reference: crates/worker/src/executor/parameter_server.rs:331-446).
+// This is the C++ equivalent: flat float32 kernels invoked via ctypes, with
+// Python owning SafeTensors metadata. Single pass, no temporaries beyond
+// the destination — the job is memory-bandwidth bound.
+//
+// Fixes folded in (reference TODO parameter_server.rs:192-194): the mean is
+// a single weighted sum over all N workers, not order-dependent pairwise
+// averaging.
+//
+// Build: g++ -O3 -march=native -shared -fPIC hypha_ps.cpp -o libhypha_ps.so
+
+#include <cstddef>
+#include <cstdint>
+
+extern "C" {
+
+// dst[i] = sum_k weights[k] * srcs[k][i]
+// Weights are expected pre-normalized (sum to 1) for a weighted mean.
+void weighted_sum_f32(const float *const *srcs, const float *weights,
+                      int64_t n_srcs, float *dst, int64_t n) {
+  for (int64_t i = 0; i < n; ++i) {
+    float acc = 0.0f;
+    for (int64_t k = 0; k < n_srcs; ++k) {
+      acc += weights[k] * srcs[k][i];
+    }
+    dst[i] = acc;
+  }
+}
+
+// Nesterov outer step, in place:
+//   m <- mu * m + g
+//   update <- lr * (mu * m + g)
+// matching torch SGD(nesterov=True) semantics the reference golden-tests
+// against (parameter_server.rs:448-524).
+void nesterov_update_f32(float *momentum, const float *grad, float *update_out,
+                         int64_t n, float lr, float mu) {
+  for (int64_t i = 0; i < n; ++i) {
+    float m = mu * momentum[i] + grad[i];
+    momentum[i] = m;
+    update_out[i] = lr * (mu * m + grad[i]);
+  }
+}
+
+// Fused: weighted mean of N gradients -> nesterov -> update, one pass.
+// Avoids materializing the averaged gradient for the common case.
+void fused_mean_nesterov_f32(const float *const *srcs, const float *weights,
+                             int64_t n_srcs, float *momentum,
+                             float *update_out, int64_t n, float lr, float mu) {
+  for (int64_t i = 0; i < n; ++i) {
+    float g = 0.0f;
+    for (int64_t k = 0; k < n_srcs; ++k) {
+      g += weights[k] * srcs[k][i];
+    }
+    float m = mu * momentum[i] + g;
+    momentum[i] = m;
+    update_out[i] = lr * (mu * m + g);
+  }
+}
+
+}  // extern "C"

@@ -1,0 +1,274 @@
+"""Job bridge + connectors + process executor tests.
+
+Reference roles: crates/worker/src/executor/bridge.rs (UDS HTTP API, path
+safety, SSE receive), connector/mod.rs (fetch/send/receive/pull routing),
+executor/process.rs (spawn/substitute/supervise/cancel).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import sys
+import textwrap
+from pathlib import Path
+
+import pytest
+
+from hypha_tpu.executor.bridge_client import Session
+from hypha_tpu.messages import (
+    PROTOCOL_API,
+    PROTOCOL_PROGRESS,
+    Ack,
+    DataRequest,
+    DataResponse,
+    DataSlice,
+    Fetch,
+    JobSpec,
+    Executor,
+    TrainExecutorConfig,
+    Adam,
+    Progress,
+    ProgressKind,
+    ProgressResponse,
+    ProgressResponseKind,
+    Receive,
+    Reference,
+    Send,
+)
+from hypha_tpu.network import MemoryTransport, Node
+from hypha_tpu.worker.bridge import Bridge, BridgeError, safe_rel
+from hypha_tpu.worker.connectors import Connector
+from hypha_tpu.worker.process_executor import ProcessExecutor
+
+
+def run(coro):
+    return asyncio.run(asyncio.wait_for(coro, timeout=60))
+
+
+async def _pair():
+    hub = MemoryTransport()
+    worker = Node(hub.shared(), peer_id="worker")
+    sched = Node(hub.shared(), peer_id="sched")
+    await worker.start(); await sched.start()
+    worker.add_peer_addr("sched", sched.listen_addrs[0])
+    sched.add_peer_addr("worker", worker.listen_addrs[0])
+    return hub, worker, sched
+
+
+def test_safe_rel_rejects_escape(tmp_path):
+    assert safe_rel(tmp_path, "artifacts/model.bin") == tmp_path / "artifacts/model.bin"
+    with pytest.raises(BridgeError):
+        safe_rel(tmp_path, "/etc/passwd")
+    with pytest.raises(BridgeError):
+        safe_rel(tmp_path, "../../secrets")
+
+
+def test_bridge_fetch_file_uri_and_status(tmp_path):
+    async def main():
+        hub, worker, sched = await _pair()
+        src = tmp_path / "model.safetensors"
+        src.write_bytes(b"weights" * 100)
+
+        # scheduler answers progress with SCHEDULE_UPDATE{3}
+        async def on_progress(peer, progress):
+            assert progress.kind == ProgressKind.STATUS
+            assert progress.job_id == "j1"
+            return ProgressResponse(
+                kind=ProgressResponseKind.SCHEDULE_UPDATE, counter=3
+            )
+
+        sched.on(PROTOCOL_PROGRESS, Progress).respond_with(on_progress)
+
+        work = tmp_path / "work"
+        bridge = Bridge(worker, work, "j1", "sched")
+        sock = await bridge.start()
+
+        def client_ops():
+            with Session(str(sock)) as s:
+                paths = s.fetch(Fetch(Reference.from_uri(src.as_uri())))
+                assert paths == ["artifacts/model.safetensors"]
+                assert (work / paths[0]).read_bytes() == src.read_bytes()
+                resp = s.send_status(
+                    Progress(kind=ProgressKind.STATUS, batch_size=8)
+                )
+                assert resp.kind == ProgressResponseKind.SCHEDULE_UPDATE
+                assert resp.counter == 3
+
+        await asyncio.to_thread(client_ops)
+        await bridge.stop()
+        await worker.stop(); await sched.stop()
+
+    run(main())
+
+
+def test_bridge_send_and_receive_roundtrip(tmp_path):
+    """worker A sends its delta; worker B receives it via SSE pointers,
+    with a disallowed sender filtered out."""
+
+    async def main():
+        hub = MemoryTransport()
+        a = Node(hub.shared(), peer_id="a")
+        b = Node(hub.shared(), peer_id="b")
+        eve = Node(hub.shared(), peer_id="eve")
+        for n in (a, b, eve):
+            await n.start()
+        for x in (a, b, eve):
+            for y in (a, b, eve):
+                if x is not y:
+                    x.add_peer_addr(y.peer_id, y.listen_addrs[0])
+
+        work_a, work_b = tmp_path / "wa", tmp_path / "wb"
+        bridge_a = Bridge(a, work_a, "j", "sched")
+        bridge_b = Bridge(b, work_b, "j", "sched")
+        sock_a = await bridge_a.start()
+        sock_b = await bridge_b.start()
+        (work_a / "delta.st").parent.mkdir(parents=True, exist_ok=True)
+        (work_a / "delta.st").write_bytes(b"D" * 12345)
+
+        received = []
+
+        def receiver():
+            with Session(str(sock_b)) as s:
+                ref = Reference.from_peers(["a"], "updates")
+                with s.receive(Receive(ref)) as events:
+                    for ev in events:
+                        received.append(ev)
+                        return
+
+        recv_task = asyncio.create_task(asyncio.to_thread(receiver))
+        await asyncio.sleep(0.2)
+        # eve pushes first — must be dropped (not from an allowed peer)
+        await eve.push("b", {"resource": "updates", "name": "evil"}, b"x" * 10)
+
+        def sender():
+            with Session(str(sock_a)) as s:
+                ref = Reference.from_peers(["b"], "updates")
+                s.send_resource(Send(ref), "delta.st", "updates")
+
+        await asyncio.to_thread(sender)
+        await asyncio.wait_for(recv_task, 10)
+        assert len(received) == 1
+        ev = received[0]
+        assert ev["from_peer"] == "a" and ev["size"] == 12345
+        assert (work_b / ev["path"]).stat().st_size == 12345
+        await bridge_a.stop(); await bridge_b.stop()
+        for n in (a, b, eve):
+            await n.stop()
+
+    run(main())
+
+
+def test_connector_slice_fetch_via_scheduler(tmp_path):
+    async def main():
+        hub = MemoryTransport()
+        worker = Node(hub.shared(), peer_id="worker")
+        sched = Node(hub.shared(), peer_id="sched")
+        data = Node(hub.shared(), peer_id="data")
+        for n in (worker, sched, data):
+            await n.start()
+        for x in (worker, sched, data):
+            for y in (worker, sched, data):
+                if x is not y:
+                    x.add_peer_addr(y.peer_id, y.listen_addrs[0])
+
+        # scheduler assigns slice 2 from "data"; data node serves it
+        async def on_data(peer, req):
+            assert req.dataset == "mnist" and peer == "worker"
+            return DataResponse(data_provider="data", index=2)
+
+        sched.on(PROTOCOL_API, DataRequest).respond_with(on_data)
+
+        async def serve(peer, res):
+            assert res == DataSlice(dataset="mnist", index=2)
+            return b"S2" * 500
+
+        data.on_pull(serve)
+
+        conn = Connector(worker, "sched")
+        ref = Reference.from_scheduler("sched", "mnist")
+        paths = await conn.fetch(Fetch(ref), tmp_path / "slices")
+        assert len(paths) == 1 and paths[0].read_bytes() == b"S2" * 500
+        for n in (worker, sched, data):
+            await n.stop()
+
+    run(main())
+
+
+EXECUTOR_SCRIPT = textwrap.dedent(
+    """
+    import json, os, sys
+    sys.path.insert(0, {repo!r})
+    from hypha_tpu.executor.bridge_client import Session
+    from hypha_tpu.messages import Progress, ProgressKind, ProgressResponseKind
+
+    job = json.loads(os.environ["JOB_JSON"])
+    assert job["_t"] == "JobSpec", job
+    with Session(os.environ["SOCKET_PATH"]) as s:
+        resp = s.send_status(Progress(kind=ProgressKind.STATUS, batch_size=4))
+        assert resp.kind == ProgressResponseKind.CONTINUE, resp
+    print("executor done")
+    """
+)
+
+
+def _train_spec(job_id="pj"):
+    uri = Reference.from_uri("file:///dev/null")
+    peers = Reference.from_peers(["ps"], "updates")
+    return JobSpec(
+        job_id=job_id,
+        executor=Executor(
+            kind="train",
+            name="diloco-jax",
+            train=TrainExecutorConfig(
+                model={"model_type": "causal-lm"},
+                data=Fetch(uri),
+                updates=Send(peers),
+                results=Receive(peers),
+                optimizer=Adam(lr=1e-3),
+                batch_size=4,
+            ),
+        ),
+    )
+
+
+def test_process_executor_runs_and_completes(tmp_path):
+    async def main():
+        hub, worker, sched = await _pair()
+
+        async def on_progress(peer, progress):
+            return ProgressResponse(kind=ProgressResponseKind.CONTINUE)
+
+        sched.on(PROTOCOL_PROGRESS, Progress).respond_with(on_progress)
+
+        script = tmp_path / "exec.py"
+        script.write_text(EXECUTOR_SCRIPT.format(repo=str(Path.cwd())))
+        pe = ProcessExecutor(
+            node=worker,
+            cmd=sys.executable,
+            args=[str(script)],
+            work_root=tmp_path,
+        )
+        execution = await pe.execute("pj", _train_spec(), "sched")
+        status = await asyncio.wait_for(execution.wait(), 30)
+        assert status.state == "completed", status
+        await worker.stop(); await sched.stop()
+
+    run(main())
+
+
+def test_process_executor_cancel_sigterm(tmp_path):
+    async def main():
+        hub, worker, sched = await _pair()
+        script = tmp_path / "sleep.py"
+        script.write_text("import time; time.sleep(300)\n")
+        pe = ProcessExecutor(
+            node=worker, cmd=sys.executable, args=[str(script)], work_root=tmp_path
+        )
+        execution = await pe.execute("cj", _train_spec("cj"), "sched")
+        await asyncio.sleep(0.3)
+        await execution.cancel()
+        status = await asyncio.wait_for(execution.wait(), 10)
+        assert status.state == "cancelled"
+        await worker.stop(); await sched.stop()
+
+    run(main())

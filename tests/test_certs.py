@@ -148,6 +148,26 @@ def test_identity_spoof_rejected(pki):
     run(main())
 
 
+def test_crl_reissue_keeps_prior_revocations(pki):
+    """Revoking B after A must keep A revoked (CRL serials merge)."""
+    out, _ = pki
+    assert certutil.main(
+        ["revoke", "--out", str(out), "--org", "org-a", "--cert", str(out / "alice.crt")]
+    ) == 0
+    assert certutil.main(
+        ["revoke", "--out", str(out), "--org", "org-a", "--cert", str(out / "bob.crt")]
+    ) == 0
+    crls = certs.load_crls_from_pem(out / "org-a.crl")
+    serials = {rc.serial_number for crl in crls for rc in crl}
+    from cryptography import x509 as _x509
+
+    a = _x509.load_pem_x509_certificate((out / "alice.crt").read_bytes())
+    b = _x509.load_pem_x509_certificate((out / "bob.crt").read_bytes())
+    assert {a.serial_number, b.serial_number} <= serials
+    # reset the CRL so later tests in this module see a clean slate
+    (out / "org-a.crl").unlink()
+
+
 def test_crl_revocation(pki, tmp_path):
     out, _ = pki
     # revoke eve via the CLI, then build nodes that load the CRL

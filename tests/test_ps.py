@@ -1,0 +1,266 @@
+"""Parameter-server executor tests: native kernels, golden Nesterov vs
+torch SGD(nesterov=True), and the full aggregate round over the fabric.
+
+Reference: crates/worker/src/executor/parameter_server.rs (golden test
+:448-524 uses torch SGD nesterov exactly like ours).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import io
+
+import numpy as np
+import pytest
+
+from hypha_tpu import native
+
+
+def test_weighted_sum_matches_numpy():
+    rng = np.random.default_rng(0)
+    srcs = [rng.standard_normal(1000).astype(np.float32) for _ in range(3)]
+    w = np.asarray([0.5, 0.3, 0.2], np.float32)
+    got = native.weighted_sum(srcs, w)
+    want = (0.5 * srcs[0] + 0.3 * srcs[1] + 0.2 * srcs[2]).astype(np.float32)
+    # -march=native may contract to FMA; bitwise equality is not expected
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-6)
+
+
+def test_native_kernel_compiles():
+    # The toolchain is baked into this image; the C++ path must be active.
+    assert native.native_available()
+
+
+def test_nesterov_golden_vs_torch():
+    """Outer step must match torch.optim.SGD(momentum=mu, nesterov=True):
+    the update applied to params equals our 'update' tensor."""
+    import torch
+
+    rng = np.random.default_rng(7)
+    lr, mu = 0.7, 0.9
+    theta0 = rng.standard_normal(64).astype(np.float32)
+    grads = [rng.standard_normal(64).astype(np.float32) for _ in range(5)]
+
+    p = torch.nn.Parameter(torch.from_numpy(theta0.copy()))
+    opt = torch.optim.SGD([p], lr=lr, momentum=mu, nesterov=True)
+    m = np.zeros(64, np.float32)
+    for g in grads:
+        before = p.detach().numpy().copy()
+        opt.zero_grad()
+        p.grad = torch.from_numpy(g.copy())
+        opt.step()
+        torch_update = before - p.detach().numpy()  # what SGD subtracted
+        m, update = native.nesterov_update(m, g, lr, mu)
+        np.testing.assert_allclose(update, torch_update, rtol=1e-5, atol=1e-6)
+
+
+def test_fused_equals_separate():
+    rng = np.random.default_rng(3)
+    srcs = [rng.standard_normal(256).astype(np.float32) for _ in range(4)]
+    w = np.asarray([4, 2, 1, 1], np.float32)
+    w = w / w.sum()
+    m0 = rng.standard_normal(256).astype(np.float32)
+    mean = native.weighted_sum(srcs, w)
+    m_a, upd_a = native.nesterov_update(m0, mean, 0.7, 0.9)
+    m_b, upd_b = native.fused_mean_nesterov(srcs, w, m0, 0.7, 0.9)
+    np.testing.assert_allclose(m_a, m_b, rtol=1e-6)
+    np.testing.assert_allclose(upd_a, upd_b, rtol=1e-6)
+
+
+# ---------------------------------------------------------------------------
+# Full aggregate round over the fabric
+# ---------------------------------------------------------------------------
+
+
+def run(coro):
+    return asyncio.run(asyncio.wait_for(coro, timeout=60))
+
+
+def test_ps_executor_round(tmp_path):
+    from safetensors.numpy import load_file, save_file
+
+    from hypha_tpu.messages import (
+        PROTOCOL_PROGRESS,
+        AggregateExecutorConfig,
+        Executor,
+        JobSpec,
+        Nesterov,
+        Progress,
+        ProgressKind,
+        ProgressResponse,
+        ProgressResponseKind,
+        Receive,
+        Reference,
+        Send,
+    )
+    from hypha_tpu.network import MemoryTransport, Node
+    from hypha_tpu.worker.ps_executor import ParameterServerExecutor
+
+    async def main():
+        hub = MemoryTransport()
+        ps = Node(hub.shared(), peer_id="ps")
+        w1 = Node(hub.shared(), peer_id="w1")
+        w2 = Node(hub.shared(), peer_id="w2")
+        sched = Node(hub.shared(), peer_id="sched")
+        for n in (ps, w1, w2, sched):
+            await n.start()
+        for x in (ps, w1, w2, sched):
+            for y in (ps, w1, w2, sched):
+                if x is not y:
+                    x.add_peer_addr(y.peer_id, y.listen_addrs[0])
+
+        updated_rounds = []
+
+        async def on_progress(peer, progress):
+            assert peer == "ps"
+            assert progress.kind == ProgressKind.UPDATED
+            updated_rounds.append(progress.round)
+            # run two outer rounds, then DONE
+            if progress.round >= 1:
+                return ProgressResponse(kind=ProgressResponseKind.DONE)
+            return ProgressResponse(kind=ProgressResponseKind.OK)
+
+        sched.on(PROTOCOL_PROGRESS, Progress).respond_with(on_progress)
+
+        peers_ref = Reference.from_peers(["w1", "w2"], "updates")
+        spec = JobSpec(
+            job_id="agg-1",
+            executor=Executor(
+                kind="aggregate",
+                name="parameter-server",
+                aggregate=AggregateExecutorConfig(
+                    updates=Receive(peers_ref),
+                    results=Send(peers_ref),
+                    optimizer=Nesterov(lr=0.7, momentum=0.9),
+                    num_workers=2,
+                ),
+            ),
+        )
+        pse = ParameterServerExecutor(ps, tmp_path)
+        execution = await pse.execute("agg-1", spec, "sched")
+
+        # each worker builds a delta and pushes it; w1 saw 3x the samples
+        d1 = {"w": np.ones(8, np.float32), "b": np.full(4, 2.0, np.float32)}
+        d2 = {"w": np.zeros(8, np.float32), "b": np.zeros(4, np.float32)}
+        f1, f2 = tmp_path / "d1.st", tmp_path / "d2.st"
+        save_file(d1, str(f1)); save_file(d2, str(f2))
+
+        async def worker_round(node, f, samples):
+            header = {"resource": "updates", "name": "delta", "num_samples": samples}
+            await node.push("ps", header, f)
+            push = await node.next_push(timeout=10)  # the broadcast update
+            dest = tmp_path / f"update-{node.peer_id}.st"
+            await push.save_to(dest)
+            return push.resource, dest
+
+        (h1, u1), (h2, u2) = await asyncio.gather(
+            worker_round(w1, f1, 300), worker_round(w2, f2, 100)
+        )
+        assert h1["round"] == 0 and h2["round"] == 0
+
+        # expected: weighted mean g = 0.75*d1 + 0.25*d2; m=g; upd=lr*(mu*m+g)
+        upd = load_file(str(u1))
+        g_w = 0.75 * d1["w"] + 0.25 * d2["w"]
+        expect_w = 0.7 * (0.9 * g_w + g_w)
+        np.testing.assert_allclose(upd["w"], expect_w, rtol=1e-5)
+
+        # round 2 -> scheduler says DONE -> execution completes
+        await asyncio.gather(
+            worker_round(w1, f1, 300), worker_round(w2, f2, 100)
+        )
+        status = await asyncio.wait_for(execution.wait(), 10)
+        assert status.state == "completed"
+        assert updated_rounds == [0, 1]
+        for n in (ps, w1, w2, sched):
+            await n.stop()
+
+    run(main())
+
+
+def test_ps_rejects_disallowed_and_replaces_duplicates(tmp_path):
+    from safetensors.numpy import save_file
+
+    from hypha_tpu.messages import (
+        PROTOCOL_PROGRESS,
+        AggregateExecutorConfig,
+        Executor,
+        JobSpec,
+        Nesterov,
+        Progress,
+        ProgressResponse,
+        ProgressResponseKind,
+        Receive,
+        Reference,
+        Send,
+    )
+    from hypha_tpu.network import MemoryTransport, Node
+    from hypha_tpu.worker.ps_executor import ParameterServerExecutor
+
+    async def main():
+        hub = MemoryTransport()
+        ps = Node(hub.shared(), peer_id="ps")
+        w1 = Node(hub.shared(), peer_id="w1")
+        w2 = Node(hub.shared(), peer_id="w2")
+        eve = Node(hub.shared(), peer_id="eve")
+        sched = Node(hub.shared(), peer_id="sched")
+        for n in (ps, w1, w2, eve, sched):
+            await n.start()
+        for n in (ps, w1, w2, eve, sched):
+            for m_ in (ps, w1, w2, eve, sched):
+                if n is not m_:
+                    n.add_peer_addr(m_.peer_id, m_.listen_addrs[0])
+
+        async def on_progress(peer, progress):
+            return ProgressResponse(kind=ProgressResponseKind.DONE)
+
+        sched.on(PROTOCOL_PROGRESS, Progress).respond_with(on_progress)
+
+        peers_ref = Reference.from_peers(["w1", "w2"], "updates")
+        spec = JobSpec(
+            job_id="agg-2",
+            executor=Executor(
+                kind="aggregate",
+                name="parameter-server",
+                aggregate=AggregateExecutorConfig(
+                    updates=Receive(peers_ref),
+                    results=Send(Reference.from_peers(["w1"], "results")),
+                    optimizer=Nesterov(),
+                    num_workers=2,
+                ),
+            ),
+        )
+        pse = ParameterServerExecutor(ps, tmp_path)
+        execution = await pse.execute("agg-2", spec, "sched")
+
+        ones = {"w": np.ones(4, np.float32)}
+        twos = {"w": np.full(4, 2.0, np.float32)}
+        f_ones, f_twos = tmp_path / "o.st", tmp_path / "t.st"
+        save_file(ones, str(f_ones)); save_file(twos, str(f_twos))
+
+        async def recv_update():
+            push = await w1.next_push(timeout=10)
+            dest = tmp_path / "u.st"
+            await push.save_to(dest)
+            return dest
+
+        recv = asyncio.create_task(recv_update())
+        # eve's push must be ignored
+        await eve.push("ps", {"resource": "updates", "name": "evil"}, f_ones)
+        # w1 double-sends: second replaces first
+        await w1.push("ps", {"resource": "updates", "name": "d"}, f_ones)
+        await w1.push("ps", {"resource": "updates", "name": "d"}, f_twos)
+        await w2.push("ps", {"resource": "updates", "name": "d"}, f_twos)
+
+        dest = await recv
+        from safetensors.numpy import load_file
+
+        upd = load_file(str(dest))
+        # mean of (2,2) = 2 -> update = lr*(mu*m+g) with m=g=2
+        expect = 0.7 * (0.9 * 2.0 + 2.0)
+        np.testing.assert_allclose(upd["w"], np.full(4, expect, np.float32), rtol=1e-5)
+        status = await asyncio.wait_for(execution.wait(), 10)
+        assert status.state == "completed"
+        for n in (ps, w1, w2, eve, sched):
+            await n.stop()
+
+    run(main())

@@ -1,0 +1,75 @@
+"""Batched stream adapter tests (reference: crates/network/src/utils.rs
+Batched — count limit OR time window)."""
+
+from __future__ import annotations
+
+import asyncio
+
+from hypha_tpu.network.utils import batched
+
+
+def run(coro):
+    return asyncio.run(asyncio.wait_for(coro, timeout=30))
+
+
+def test_count_limit_trips_first():
+    async def main():
+        async def src():
+            for i in range(7):
+                yield i
+
+        out = [b async for b in batched(src(), limit=3, window_s=10.0)]
+        assert out == [[0, 1, 2], [3, 4, 5], [6]]
+
+    run(main())
+
+
+def test_window_trips_and_stream_survives_quiet_window():
+    """Items separated by more than the window arrive in later batches —
+    the source generator must NOT be torn down by the window timeout
+    (regression: wait_for-cancel killed the auction ad stream after the
+    first quiet window, deafening the arbiter forever)."""
+
+    async def main():
+        queue: asyncio.Queue = asyncio.Queue()
+
+        async def src():
+            while True:
+                item = await queue.get()
+                if item is None:
+                    return
+                yield item
+
+        batches = []
+
+        async def consume():
+            async for b in batched(src(), limit=10, window_s=0.05):
+                batches.append(b)
+
+        task = asyncio.create_task(consume())
+        await queue.put(1)
+        await asyncio.sleep(0.2)  # > window: batch [1] must be out
+        assert batches == [[1]]
+        # the stream must still be alive after the quiet window
+        await queue.put(2)
+        await queue.put(3)
+        await asyncio.sleep(0.2)
+        assert batches == [[1], [2, 3]]
+        await queue.put(None)
+        await asyncio.wait_for(task, 5)
+
+    run(main())
+
+
+def test_batch_groups_items_within_window():
+    async def main():
+        async def src():
+            yield 1
+            yield 2
+            await asyncio.sleep(0.15)
+            yield 3
+
+        out = [b async for b in batched(src(), limit=10, window_s=0.05)]
+        assert out == [[1, 2], [3]]
+
+    run(main())
