@@ -516,7 +516,11 @@ class WorkerOffer:
     peer_id: str
     resources: Resources
     price: float
-    expires_at: float  # wall-clock seconds; scheduler tightens deadlines by it
+    # Relative validity in seconds (the backing temp lease's remaining TTL).
+    # Deliberately not an absolute timestamp: the scheduler stamps arrival
+    # with its own clock, so cross-host clock skew cannot corrupt auction
+    # deadlines (the reference compares worker wall clocks directly).
+    expires_in: float
     executors: list = field(default_factory=list)  # list[ExecutorDescriptor]
 
 
@@ -560,6 +564,17 @@ class DispatchJob:
 class DispatchJobResponse:
     accepted: bool
     message: str = ""
+
+
+@register
+@dataclass(slots=True)
+class CancelJob:
+    """Scheduler -> worker: roll back a dispatched job. Net-new vs the
+    reference, where a partially failed multi-worker dispatch leaks accepted
+    jobs until their lease lapses (task.rs has no rollback path)."""
+
+    lease_id: str
+    job_id: str
 
 
 @register

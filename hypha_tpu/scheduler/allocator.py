@@ -42,24 +42,27 @@ class Candidates:
 
     def __init__(self, capacity: int) -> None:
         self.capacity = capacity
-        self._by_peer: dict[str, tuple[float, WorkerOffer]] = {}
+        # peer -> (score, offer, local_expiry): expiry is this host's clock at
+        # offer arrival plus the offer's relative TTL — never a remote clock.
+        self._by_peer: dict[str, tuple[float, WorkerOffer, float]] = {}
 
-    def try_insert(self, score: float, offer: WorkerOffer) -> bool:
+    def try_insert(self, score: float, offer: WorkerOffer, local_expiry: float) -> bool:
+        entry = (score, offer, local_expiry)
         existing = self._by_peer.get(offer.peer_id)
         if existing is not None:
             if score < existing[0]:  # lower score = cheaper per unit = better
-                self._by_peer[offer.peer_id] = (score, offer)
+                self._by_peer[offer.peer_id] = entry
                 return True
             return False
         if len(self._by_peer) < self.capacity:
-            self._by_peer[offer.peer_id] = (score, offer)
+            self._by_peer[offer.peer_id] = entry
             return True
-        worst_peer, (worst_score, _) = max(
+        worst_peer, (worst_score, _, _) = max(
             self._by_peer.items(), key=lambda kv: kv[1][0]
         )
         if score < worst_score:
             del self._by_peer[worst_peer]
-            self._by_peer[offer.peer_id] = (score, offer)
+            self._by_peer[offer.peer_id] = entry
             return True
         return False
 
@@ -67,12 +70,12 @@ class Candidates:
         return len(self._by_peer)
 
     def best(self) -> list[WorkerOffer]:
-        return [o for _s, o in sorted(self._by_peer.values(), key=lambda so: so[0])]
+        return [o for _s, o, _e in sorted(self._by_peer.values(), key=lambda e: e[0])]
 
     def earliest_expiry(self) -> float | None:
         if not self._by_peer:
             return None
-        return min(o.expires_at for _s, o in self._by_peer.values())
+        return min(e for _s, _o, e in self._by_peer.values())
 
 
 class GreedyWorkerAllocator:
@@ -141,7 +144,7 @@ class GreedyWorkerAllocator:
                 log.debug("offer %.3f over cap %.3f", offer.price, price.max)
                 continue
             score = self.evaluator.evaluate(offer.price, offer.resources)
-            candidates.try_insert(score, offer)
+            candidates.try_insert(score, offer, time.time() + offer.expires_in)
             if len(candidates) >= num_workers:
                 break  # early return (allocator.rs:124-135)
         return candidates.best()

@@ -1,0 +1,71 @@
+"""DataScheduler: assign unique dataset slices to training workers.
+
+Reference: crates/scheduler/src/scheduling/data_scheduler.rs:28-103 — an RPC
+handler on the API protocol answering ``Data{dataset}`` requests with
+``{data_provider, index}``, backed by the :class:`SliceTracker`'s
+peer-affinity / work-stealing / epoch policy.
+
+The reference's tracker marks a slice processed the moment it is assigned;
+ours separates assignment from completion, so the handler retires a peer's
+previous slice when that peer asks for the next one — same observable
+behavior (every request returns a fresh slice; a dead worker's in-flight
+slice can be reclaimed via ``remove_worker``).
+"""
+
+from __future__ import annotations
+
+import logging
+
+from ..messages import PROTOCOL_API, DataRequest, DataResponse
+from ..network.node import Node
+from .trackers import SliceTracker
+
+__all__ = ["DataScheduler"]
+
+log = logging.getLogger("hypha.scheduler.data")
+
+
+class DataScheduler:
+    def __init__(
+        self, node: Node, data_provider: str, dataset: str, num_slices: int
+    ) -> None:
+        self.node = node
+        self.data_provider = data_provider
+        self.dataset = dataset
+        self.tracker = SliceTracker(num_slices)
+        self._last: dict[str, int] = {}  # peer -> slice currently held
+        self._registration = None
+
+    def start(self) -> None:
+        def matches(msg: DataRequest) -> bool:
+            return msg.dataset == self.dataset
+
+        async def on_data(peer: str, msg: DataRequest) -> DataResponse:
+            if not matches(msg):
+                raise ValueError(f"unknown dataset {msg.dataset!r}")
+            index = self.assign(peer)
+            log.debug("slice %d of %s -> %s", index, self.dataset, peer)
+            return DataResponse(data_provider=self.data_provider, index=index)
+
+        self._registration = (
+            self.node.on(PROTOCOL_API, DataRequest).respond_with(on_data)
+        )
+
+    def assign(self, peer: str) -> int:
+        """Retire the peer's previous slice and pick the next one."""
+        prev = self._last.pop(peer, None)
+        if prev is not None:
+            self.tracker.mark_processed(prev)
+        index = self.tracker.next(peer)
+        self._last[peer] = index
+        return index
+
+    def remove_worker(self, peer: str) -> None:
+        """Reclaim a dead worker's slices (tracker/slice.rs:105-114)."""
+        self._last.pop(peer, None)
+        self.tracker.remove_worker(peer)
+
+    def stop(self) -> None:
+        if self._registration is not None:
+            self._registration.close()
+            self._registration = None

@@ -14,6 +14,7 @@ import logging
 from ..messages import (
     PROTOCOL_API,
     Ack,
+    CancelJob,
     DispatchJob,
     DispatchJobResponse,
     JobSpec,
@@ -78,6 +79,7 @@ class Task:
         """Send DispatchJob to every worker; any rejection fails the task
         (task.rs:27-108)."""
         task = cls(router, spec)
+        accepted: list[WorkerHandle] = []
         try:
             for worker in workers:
                 resp = await node.request(
@@ -91,7 +93,23 @@ class Task:
                     raise DispatchError(
                         f"worker {worker.peer_id} rejected job {spec.job_id}: {msg}"
                     )
+                accepted.append(worker)
         except Exception:
+            # Roll back the workers that already accepted — without this they
+            # would run the half-dispatched job until their lease lapsed.
+            for worker in accepted:
+                try:
+                    await node.request(
+                        worker.peer_id,
+                        PROTOCOL_API,
+                        CancelJob(lease_id=worker.lease_id, job_id=spec.job_id),
+                        timeout=10,
+                    )
+                except Exception as e:  # best-effort; lease expiry backstops
+                    log.warning(
+                        "rollback of job %s on %s failed: %s",
+                        spec.job_id, worker.peer_id, e,
+                    )
             task.close()
             raise
         return task

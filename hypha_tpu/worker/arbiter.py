@@ -23,6 +23,8 @@ from dataclasses import dataclass, field
 from ..messages import (
     PROTOCOL_API,
     TOPIC_WORKER,
+    Ack,
+    CancelJob,
     DispatchJob,
     DispatchJobResponse,
     ExecutorDescriptor,
@@ -84,6 +86,9 @@ class Arbiter:
         )
         self._registrations.append(
             self.node.on(PROTOCOL_API, DispatchJob).respond_with(self._on_dispatch)
+        )
+        self._registrations.append(
+            self.node.on(PROTOCOL_API, CancelJob).respond_with(self._on_cancel)
         )
         self._subscription = await self.node.subscribe(TOPIC_WORKER)
         self._tasks.append(asyncio.create_task(self._auction_loop()))
@@ -162,7 +167,7 @@ class Arbiter:
             peer_id=self.node.peer_id,
             resources=resources,
             price=price,
-            expires_at=time.time() + OFFER_TIMEOUT_S,
+            expires_in=OFFER_TIMEOUT_S,
             executors=[
                 ExecutorDescriptor(executor_class=c, name=n)
                 for (c, n) in self.job_manager.supported()
@@ -210,3 +215,14 @@ class Arbiter:
         except Exception as e:
             return DispatchJobResponse(accepted=False, message=str(e))
         return DispatchJobResponse(accepted=True)
+
+    async def _on_cancel(self, peer: str, msg: CancelJob) -> Ack:
+        """Owner-checked job rollback (same lease validation as dispatch)."""
+        try:
+            lease = self.lease_manager.get(msg.lease_id)
+        except LeaseNotFound:
+            return Ack(ok=False, message="no such lease")
+        if lease.leasable.peer_id != peer:
+            return Ack(ok=False, message="lease not yours")
+        await self.job_manager.cancel_job(msg.job_id)
+        return Ack(ok=True)

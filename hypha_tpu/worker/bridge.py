@@ -22,6 +22,7 @@ from __future__ import annotations
 import asyncio
 import json
 import logging
+import socket
 from pathlib import Path
 
 from .. import messages
@@ -81,16 +82,25 @@ class Bridge:
         self._send_tasks: set[asyncio.Task] = set()
 
     async def start(self) -> Path:
-        self.work_dir.mkdir(parents=True, exist_ok=True)
-        self._server = await asyncio.start_unix_server(
-            self._handle, path=str(self.socket_path)
-        )
+        self.work_dir.mkdir(parents=True, exist_ok=True, mode=0o700)
+        # Bind + chmod before listen: the socket must never be connectable by
+        # other local users, even for an instant (the reference enforces 0600).
+        sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+        sock.bind(str(self.socket_path))
         self.socket_path.chmod(0o600)
+        sock.listen(16)
+        self._server = await asyncio.start_unix_server(self._handle, sock=sock)
         return self.socket_path
 
     async def stop(self) -> None:
-        for task in list(self._send_tasks):
-            task.cancel()
+        # Drain in-flight background sends first — the executor's final
+        # pseudo-gradient is typically still uploading when it exits.
+        pending = [t for t in self._send_tasks if not t.done()]
+        if pending:
+            done, still = await asyncio.wait(pending, timeout=60.0)
+            for task in still:
+                log.warning("bridge stop: abandoning unfinished send")
+                task.cancel()
         if self._server is not None:
             self._server.close()
             try:

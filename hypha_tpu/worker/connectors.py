@@ -20,6 +20,7 @@ from __future__ import annotations
 import asyncio
 import hashlib
 import logging
+import shutil
 import urllib.parse
 import urllib.request
 from pathlib import Path
@@ -67,7 +68,7 @@ def fetch_uri(uri: str, dest_dir: Path) -> Path:
     dest = dest_dir / name
     if parsed.scheme == "file":
         src = Path(urllib.request.url2pathname(parsed.path))
-        dest.write_bytes(src.read_bytes())
+        shutil.copyfile(src, dest)  # streams; checkpoints don't fit in RAM
         return dest
     with urllib.request.urlopen(uri) as resp, open(dest, "wb") as f:  # noqa: S310
         while True:
@@ -115,7 +116,7 @@ class Connector:
                 token=ref.token,
             )
             dest = dest_dir / Path(filename).name
-            dest.write_bytes(Path(cached).read_bytes())
+            shutil.copyfile(cached, dest)
             out.append(dest)
         return out
 

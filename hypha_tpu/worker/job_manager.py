@@ -116,6 +116,11 @@ class JobManager:
     def jobs_for_lease(self, lease_id: str) -> list[str]:
         return [jid for jid, j in self._active.items() if j.lease_id == lease_id]
 
+    async def cancel_job(self, job_id: str) -> None:
+        job = self._active.get(job_id)
+        if job is not None:
+            await job.execution.cancel()
+
     async def cancel_for_lease(self, lease_id: str) -> None:
         """Expired lease ⇒ its jobs die (crates/worker/src/arbiter.rs:96-141)."""
         for jid in self.jobs_for_lease(lease_id):

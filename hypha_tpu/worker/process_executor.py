@@ -48,7 +48,7 @@ class ProcessExecutor(JobExecutor):
         self, job_id: str, spec: JobSpec, scheduler_peer: str
     ) -> Execution:
         work_dir = Path(self.work_root) / f"hypha-{uuid.uuid4().hex[:12]}"
-        work_dir.mkdir(parents=True)
+        work_dir.mkdir(parents=True, mode=0o700)
         bridge = Bridge(
             self.node,
             work_dir,

@@ -170,10 +170,22 @@ class ParameterServerExecutor(JobExecutor):
         update: dict[str, np.ndarray] = {}
         for key in keys:
             srcs = [t[key] for t in trees]
-            shape = srcs[0].shape
+            shape, dtype = srcs[0].shape, srcs[0].dtype
+            # The native kernel trusts n = momentum.size; a short tensor from
+            # a buggy/malicious worker must fail here, not read out of bounds.
+            for t, s in zip(trees, srcs):
+                if s.shape != shape or s.dtype != dtype:
+                    raise ValueError(
+                        f"delta {key!r}: mismatched shape/dtype "
+                        f"{s.shape}/{s.dtype} vs {shape}/{dtype}"
+                    )
             m = momentum.get(key)
             if m is None:
                 m = np.zeros(srcs[0].size, np.float32)
+            elif m.size != srcs[0].size:
+                raise ValueError(
+                    f"delta {key!r}: size {srcs[0].size} != momentum {m.size}"
+                )
             new_m, upd = native.fused_mean_nesterov(srcs, weights, m, lr, mu)
             momentum[key] = new_m
             update[key] = upd.reshape(shape)

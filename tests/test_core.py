@@ -181,7 +181,7 @@ def test_worker_offer_roundtrip():
         peer_id="peer-a",
         resources=Resources(tpu=8, cpu=16, memory=2048),
         price=42.5,
-        expires_at=123.0,
+        expires_in=0.5,
         executors=[messages.ExecutorDescriptor("train", "diloco-transformer")],
     )
     out = messages.decode(messages.encode(offer))

@@ -1,0 +1,168 @@
+"""Gateway + data node runtime tests.
+
+Reference analog: the data-node serve loop and gateway composition
+(crates/data/src/bin/hypha-data.rs:153-209, crates/gateway/src/network.rs)
+exercised as in-process nodes on the memory fabric.
+"""
+
+from __future__ import annotations
+
+import asyncio
+
+import pytest
+
+from hypha_tpu import messages
+from hypha_tpu.data_node import DataNode
+from hypha_tpu.gateway import Gateway
+from hypha_tpu.health import probe
+from hypha_tpu.messages import DataRecord, DataSlice
+from hypha_tpu.network import MemoryTransport, Node, RequestError
+from hypha_tpu.scheduler.data_scheduler import DataScheduler
+
+
+def run(coro):
+    return asyncio.run(asyncio.wait_for(coro, timeout=30))
+
+
+def make_dataset(tmp_path, name="mnist", n=4):
+    d = tmp_path / name
+    d.mkdir()
+    for i in range(n):
+        (d / f"slice_{i:04d}.safetensors").write_bytes(bytes([i]) * (100 + i))
+    return d
+
+
+async def start_cluster(tmp_path, n_slices=4):
+    hub = MemoryTransport()
+    gw = Gateway(hub.shared(), peer_id="gw")
+    await gw.start()
+    data = DataNode(
+        hub.shared(),
+        {"mnist": make_dataset(tmp_path, n=n_slices)},
+        peer_id="data",
+        bootstrap=[gw.node.listen_addrs[0]],
+    )
+    await data.start()
+    return hub, gw, data
+
+
+def test_data_node_announces_record(tmp_path):
+    async def main():
+        hub, gw, data = await start_cluster(tmp_path)
+        client = Node(hub.shared(), peer_id="c", bootstrap=[gw.node.listen_addrs[0]])
+        await client.start()
+        await client.wait_for_bootstrap()
+        raw = await client.get_record("mnist")
+        rec = messages.decode(raw)
+        assert rec == DataRecord(num_slices=4)
+        providers = await client.find_providers("mnist")
+        assert providers == ["data"]
+        await client.stop(); await data.stop(); await gw.stop()
+
+    run(main())
+
+
+def test_data_node_serves_slices(tmp_path):
+    async def main():
+        hub, gw, data = await start_cluster(tmp_path)
+        client = Node(hub.shared(), peer_id="c", bootstrap=[gw.node.listen_addrs[0]])
+        await client.start()
+        await client.wait_for_bootstrap()
+        await client.find_providers("mnist")  # learns the data node's addrs
+        for i in range(4):
+            stream = await client.pull("data", DataSlice(dataset="mnist", index=i))
+            payload = b""
+            while chunk := await stream.read():
+                payload += chunk
+            assert payload == bytes([i]) * (100 + i)
+        await client.stop(); await data.stop(); await gw.stop()
+
+    run(main())
+
+
+def test_data_node_rejects_bad_requests(tmp_path):
+    """Bounds check includes index == num_slices (fixes the reference's
+    off-by-one, hypha-data.rs:195)."""
+
+    async def main():
+        hub, gw, data = await start_cluster(tmp_path)
+        client = Node(hub.shared(), peer_id="c", bootstrap=[gw.node.listen_addrs[0]])
+        await client.start()
+        await client.wait_for_bootstrap()
+        await client.find_providers("mnist")
+        with pytest.raises(RequestError, match="out of range"):
+            await client.pull("data", DataSlice(dataset="mnist", index=4))
+        with pytest.raises(RequestError, match="unknown dataset"):
+            await client.pull("data", DataSlice(dataset="cifar", index=0))
+        await client.stop(); await data.stop(); await gw.stop()
+
+    run(main())
+
+
+def test_gateway_health_probe(tmp_path):
+    async def main():
+        hub, gw, data = await start_cluster(tmp_path)
+        prober = Node(hub.shared(), peer_id="probe")
+        await prober.start()
+        assert await probe(prober, gw.node.listen_addrs[0])
+        assert await probe(prober, data.node.listen_addrs[0])
+        await prober.stop(); await data.stop(); await gw.stop()
+
+    run(main())
+
+
+def test_data_scheduler_assigns_unique_slices(tmp_path):
+    async def main():
+        hub, gw, data = await start_cluster(tmp_path)
+        sched = Node(hub.shared(), peer_id="sched", bootstrap=[gw.node.listen_addrs[0]])
+        await sched.start()
+        await sched.wait_for_bootstrap()
+        ds = DataScheduler(sched, "data", "mnist", num_slices=4)
+        ds.start()
+
+        worker = Node(hub.shared(), peer_id="w0", bootstrap=[gw.node.listen_addrs[0]])
+        await worker.start()
+        await worker.wait_for_bootstrap()
+        worker.add_peer_addr("sched", sched.listen_addrs[0])
+
+        seen = []
+        for _ in range(4):
+            resp = await worker.request(
+                "sched",
+                messages.PROTOCOL_API,
+                messages.DataRequest(dataset="mnist", peer_id="w0"),
+            )
+            assert resp.data_provider == "data"
+            seen.append(resp.index)
+        assert sorted(seen) == [0, 1, 2, 3]  # one epoch, no repeats
+
+        # unknown dataset is refused
+        with pytest.raises(RequestError):
+            await worker.request(
+                "sched",
+                messages.PROTOCOL_API,
+                messages.DataRequest(dataset="cifar", peer_id="w0"),
+            )
+        ds.stop()
+        await worker.stop(); await sched.stop(); await data.stop(); await gw.stop()
+
+    run(main())
+
+
+def test_data_scheduler_work_stealing():
+    """Two workers: when the fast worker exhausts fresh slices it steals the
+    slow worker's outstanding assignment (tracker/slice.rs:65-90)."""
+    ds = DataScheduler.__new__(DataScheduler)
+    from hypha_tpu.scheduler.trackers import SliceTracker
+
+    ds.tracker = SliceTracker(3)
+    ds._last = {}
+    a = [ds.assign("fast") for _ in range(2)]
+    b = ds.assign("slow")
+    assert sorted(a + [b]) == [0, 1, 2]
+    # fast retires its 2nd slice and must steal slow's outstanding slice
+    stolen = ds.assign("fast")
+    assert stolen == b
+    # slow died: reclaim
+    ds.remove_worker("slow")
+    assert "slow" not in ds._last
