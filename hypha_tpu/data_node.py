@@ -60,8 +60,9 @@ class DataNode:
         await self.node.start(listen)
         self.node.on_pull(self._serve_slice)
         self._health = serve_health(self.node, lambda: self._ready)
-        if self.node._bootstrap_addrs:
-            await self.node.wait_for_bootstrap()
+        # Node.start pre-sets the bootstrapped event for self-anchored nodes,
+        # so this returns immediately when there are no gateways.
+        await self.node.wait_for_bootstrap()
         # Announce one record per dataset (hypha-data.rs:176-185) and mark
         # this peer a provider so schedulers can resolve name -> peer.
         for name, files in self._slices.items():

@@ -48,14 +48,23 @@ class Session:
         r.raise_for_status()
         return r.json()["paths"]
 
-    def send_resource(self, send: Send, path: str, resource: str = "updates") -> None:
-        """Ship a work-dir file to peers (runs in the worker's background)."""
+    def send_resource(
+        self,
+        send: Send,
+        path: str,
+        resource: str = "updates",
+        meta: dict[str, Any] | None = None,
+    ) -> None:
+        """Ship a work-dir file to peers (runs in the worker's background).
+        ``meta`` rides the stream header (e.g. num_samples for the parameter
+        server's sample-weighted mean)."""
         r = self._client.post(
             "/resources/send",
             json={
                 "send": messages.to_json_dict(send),
                 "path": path,
                 "resource": resource,
+                "meta": meta or {},
             },
         )
         r.raise_for_status()

@@ -1,0 +1,78 @@
+"""Streaming dataset: scheduler-assigned SafeTensors slices → batches.
+
+Parity with the reference's ``IterableStreamDataSet`` + ``fetch_data``
+(executors/accelerate/.../dataset.py:10-41, utils.py:68-74): an infinite
+generator asks the bridge for the next slice path (the scheduler picks the
+slice index via its SliceTracker), loads the SafeTensors file, optionally
+applies a preprocessor to configured keys, and yields per-sample dicts;
+batching stacks ``batch_size`` consecutive samples.
+
+TPU-native difference: batches come out as device-ready stacked numpy
+arrays with static shapes (XLA recompiles on shape change, so ragged
+tails are dropped — the stream is infinite anyway).
+"""
+
+from __future__ import annotations
+
+import logging
+from pathlib import Path
+from typing import Any, Callable, Iterator
+
+import numpy as np
+from safetensors.numpy import load_file
+
+__all__ = ["slice_samples", "batches", "stream_batches"]
+
+log = logging.getLogger("hypha.executor.dataset")
+
+
+def slice_samples(
+    path: Path | str,
+    input_names: list[str] | None = None,
+    preprocessor: Callable[[dict[str, np.ndarray]], dict[str, np.ndarray]] | None = None,
+) -> Iterator[dict[str, np.ndarray]]:
+    """Yield per-sample dicts from one SafeTensors slice file
+    (dataset.py:10-41: each tensor's leading axis indexes samples)."""
+    tensors = load_file(str(path))
+    if preprocessor is not None:
+        tensors = preprocessor(tensors)
+    if input_names:
+        tensors = {k: tensors[k] for k in input_names}
+    if not tensors:
+        return
+    counts = {k: v.shape[0] for k, v in tensors.items()}
+    n = min(counts.values())
+    if len(set(counts.values())) > 1:
+        log.warning("slice %s: ragged sample counts %s; using %d", path, counts, n)
+    for i in range(n):
+        yield {k: v[i] for k, v in tensors.items()}
+
+
+def batches(
+    samples: Iterator[dict[str, np.ndarray]], batch_size: int
+) -> Iterator[dict[str, np.ndarray]]:
+    """Stack consecutive samples into static-shape batches."""
+    buf: list[dict[str, np.ndarray]] = []
+    for sample in samples:
+        buf.append(sample)
+        if len(buf) == batch_size:
+            yield {k: np.stack([s[k] for s in buf]) for k in buf[0]}
+            buf.clear()
+
+
+def stream_batches(
+    fetch_slice: Callable[[], str],
+    batch_size: int,
+    input_names: list[str] | None = None,
+    preprocessor: Callable | None = None,
+) -> Iterator[dict[str, np.ndarray]]:
+    """Infinite batch stream: ``fetch_slice()`` blocks until the scheduler
+    assigns the next slice and returns its local path (utils.py:68-74
+    fetch_data + dataset_wrapper's infinite epoch loop)."""
+
+    def samples() -> Iterator[dict[str, np.ndarray]]:
+        while True:
+            path = fetch_slice()
+            yield from slice_samples(path, input_names, preprocessor)
+
+    return batches(samples(), batch_size)

@@ -1,0 +1,95 @@
+"""Stable-named flat serialization of JAX param trees (SafeTensors).
+
+DiLoCo ships pseudo-gradients between processes as SafeTensors files
+(reference: executors/accelerate/.../training.py:131-141 saves Δθ;
+crates/worker/src/executor/parameter_server.rs mmaps them by tensor name).
+Key compatibility therefore matters: the same param tree must always
+flatten to the same names so a worker's Δθ file, the parameter server's
+momentum state and the broadcast update all line up tensor-by-tensor.
+
+Names are the tree path entries joined with ``/`` (flax param trees give
+``params/blocks_0/attn/c_attn/kernel``-style names, matching how torch
+state_dicts name the reference's tensors).
+"""
+
+from __future__ import annotations
+
+from pathlib import Path
+from typing import Any
+
+import jax
+import numpy as np
+from safetensors.numpy import load_file, save_file
+
+__all__ = [
+    "path_name",
+    "flatten_tree",
+    "unflatten_like",
+    "save_tree",
+    "load_flat",
+]
+
+
+def path_name(path: tuple) -> str:
+    """Join a jax key path into a stable '/'-separated name."""
+    parts = []
+    for entry in path:
+        if isinstance(entry, jax.tree_util.DictKey):
+            parts.append(str(entry.key))
+        elif isinstance(entry, jax.tree_util.SequenceKey):
+            parts.append(str(entry.idx))
+        elif isinstance(entry, jax.tree_util.GetAttrKey):
+            parts.append(str(entry.name))
+        elif isinstance(entry, jax.tree_util.FlattenedIndexKey):
+            parts.append(str(entry.key))
+        else:  # pragma: no cover - future key kinds
+            parts.append(str(entry))
+    return "/".join(parts)
+
+
+def flatten_tree(tree: Any) -> dict[str, np.ndarray]:
+    """Flatten a pytree of arrays to {stable_name: np.ndarray}."""
+    leaves = jax.tree_util.tree_flatten_with_path(tree)[0]
+    flat: dict[str, np.ndarray] = {}
+    for path, leaf in leaves:
+        name = path_name(path)
+        if name in flat:
+            raise ValueError(f"duplicate tensor name {name!r} in tree")
+        flat[name] = np.asarray(leaf)
+    return flat
+
+
+def unflatten_like(flat: dict[str, np.ndarray], like: Any) -> Any:
+    """Rebuild a tree shaped like ``like`` from a flat name->array dict."""
+    paths, treedef = jax.tree_util.tree_flatten_with_path(like)
+    leaves = []
+    for path, leaf in paths:
+        name = path_name(path)
+        if name not in flat:
+            raise KeyError(f"missing tensor {name!r} (have {len(flat)} tensors)")
+        arr = flat[name]
+        if tuple(arr.shape) != tuple(np.shape(leaf)):
+            raise ValueError(
+                f"tensor {name!r}: shape {arr.shape} != expected {np.shape(leaf)}"
+            )
+        leaves.append(arr)
+    return jax.tree_util.tree_unflatten(treedef, leaves)
+
+
+def save_tree(path: Path | str, tree: Any) -> Path:
+    """Save a param tree (or an already-flat dict) as SafeTensors."""
+    if isinstance(tree, dict) and all(
+        isinstance(v, np.ndarray) for v in tree.values()
+    ):
+        flat = dict(tree)
+    else:
+        flat = flatten_tree(tree)
+    # SafeTensors rejects non-contiguous / bf16-via-numpy edge cases; go
+    # through ascontiguousarray once here rather than at every call site.
+    flat = {k: np.ascontiguousarray(v) for k, v in flat.items()}
+    save_file(flat, str(path))
+    return Path(path)
+
+
+def load_flat(path: Path | str) -> dict[str, np.ndarray]:
+    return load_file(str(path))

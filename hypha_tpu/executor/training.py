@@ -1,0 +1,300 @@
+"""The training executor: the DiLoCo inner loop on JAX, driven by the bridge.
+
+Parity with the reference's accelerate executor
+(executors/accelerate/src/hypha/accelerate_executor/training.py:28-147):
+
+  * parse the job spec, open a bridge Session, fetch model artifacts;
+  * build model / AdamW / LR schedule / streaming slice dataset;
+  * snapshot the round anchor θ₀ (the reference's ``0_global_weights.pt``);
+  * loop: jitted train step → per-batch ``Status`` heartbeat → on
+    ``ScheduleUpdate{counter}`` run ``counter`` more batches → send
+    ``update`` status → save Δθ = θ_t − θ₀ SafeTensors → ship to the
+    parameter server (tagged with the round's sample count for the
+    weighted mean) → send round metrics → await the broadcast update →
+    merge (θ ← θ + update) → ``update-received`` → Continue | Done.
+
+TPU-native differences: the whole inner step is ONE jit-compiled function
+(forward+loss+backward+AdamW fused by XLA, bf16 activations on the MXU);
+optional intra-replica sharding lays the step out over a device mesh
+(dp/fsdp/tp/sp/ep axes) so collectives ride ICI; Δθ extraction and the
+merge are jitted tree ops (hypha_tpu.executor.diloco).
+
+Launch (the worker's process executor substitutes the placeholders —
+crates/worker/src/executor/process.rs:124-137):
+
+    python -m hypha_tpu.executor.training \
+        --socket {SOCKET_PATH} --work-dir {WORK_DIR} --job {JOB_JSON}
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import logging
+import math
+import time
+from pathlib import Path
+from typing import Any, Iterator
+
+import numpy as np
+
+from .. import messages
+from ..messages import (
+    JobSpec,
+    Loss,
+    ModelType,
+    Progress,
+    ProgressKind,
+    ProgressResponseKind,
+    TrainExecutorConfig,
+)
+from .diloco import extract_delta, merge_update
+from .serialization import load_flat, save_tree, unflatten_like
+from .train import TrainState, build_optimizer, make_train_step
+
+__all__ = ["run_training", "main", "TrainResult"]
+
+log = logging.getLogger("hypha.executor.training")
+
+_NON_CAUSAL = {
+    ModelType.IMAGE_CLASSIFICATION,
+    ModelType.SEQUENCE_CLASSIFICATION,
+    ModelType.TOKEN_CLASSIFICATION,
+}
+
+
+class TrainResult:
+    """What the loop did — surfaced for tests and the in-process executor."""
+
+    def __init__(self) -> None:
+        self.rounds = 0
+        self.batches = 0
+        self.losses: list[float] = []
+
+    @property
+    def last_loss(self) -> float:
+        return self.losses[-1] if self.losses else math.nan
+
+
+def _build_mesh(sharding: dict | None):
+    """Optional intra-replica mesh (TrainExecutorConfig.sharding extension)."""
+    if not sharding:
+        return None
+    import jax
+
+    from ..parallel import create_mesh
+
+    sizes = {a: int(sharding.get(a, 1)) for a in ("dp", "fsdp", "tp", "sp", "ep")}
+    total = math.prod(sizes.values())
+    if total <= 1:
+        return None
+    if total > len(jax.devices()):
+        log.warning(
+            "sharding %s needs %d devices, have %d; running unsharded",
+            sharding, total, len(jax.devices()),
+        )
+        return None
+    return create_mesh(sizes)
+
+
+def _init_model(cfg: TrainExecutorConfig, session, work_dir: Path, first_batch):
+    """Build the model and its initial params (fetched weights or seeded)."""
+    import jax
+
+    from ..models import Mixtral, build_model
+    from ..models.registry import resolve_model_type
+
+    model_spec = dict(cfg.model)
+    model, _mcfg = build_model(model_spec)
+    model_type = resolve_model_type(model_spec.get("model_type", ModelType.CAUSAL_LM))
+    causal_lm = model_type not in _NON_CAUSAL
+    has_aux = isinstance(model, Mixtral)
+
+    inputs = first_batch["input_ids"] if "input_ids" in first_batch else first_batch["inputs"]
+    seed = int(model_spec.get("seed", 0))
+    params = model.init(jax.random.key(seed), inputs)
+
+    source = model_spec.get("source")
+    if source is not None:
+        fetch = messages.from_json_dict(source) if isinstance(source, dict) else source
+        rels = session.fetch(fetch)
+        weight_files = [r for r in rels if r.endswith(".safetensors")]
+        if weight_files:
+            flat = load_flat(work_dir / weight_files[0])
+            params = unflatten_like(flat, params)
+            log.info("loaded %d initial tensors from %s", len(flat), weight_files[0])
+    return model, params, causal_lm, has_aux
+
+
+def run_training(
+    session,
+    work_dir: Path | str,
+    spec: JobSpec,
+    *,
+    max_batches: int | None = None,
+) -> TrainResult:
+    """Run the DiLoCo inner loop to completion over the given bridge session.
+
+    ``session`` implements the bridge client API (fetch / send_resource /
+    send_status / receive — hypha_tpu.executor.bridge_client.Session).
+    ``max_batches`` is a safety valve for tests.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    work_dir = Path(work_dir)
+    cfg = spec.executor.train
+    if cfg is None:
+        raise ValueError(f"job {spec.job_id} is not a train job")
+
+    from .dataset import stream_batches
+
+    def fetch_slice() -> str:
+        rels = session.fetch(cfg.data)
+        return str(work_dir / rels[0])
+
+    model_spec = dict(cfg.model)
+    input_names = model_spec.get("input_names")
+    stream = stream_batches(fetch_slice, cfg.batch_size, input_names)
+
+    first_batch = next(stream)
+    model, params, causal_lm, has_aux = _init_model(cfg, session, work_dir, first_batch)
+    mesh = _build_mesh(cfg.sharding)
+
+    tx = build_optimizer(cfg.optimizer, cfg.scheduler)
+    state = TrainState.create(params, tx)
+    loss_kind = cfg.loss or Loss.CROSS_ENTROPY
+    step = make_train_step(model.apply, loss_kind, causal_lm=causal_lm, has_aux=has_aux)
+
+    if mesh is not None:
+        from jax.sharding import NamedSharding
+
+        from ..parallel import param_sharding
+        from ..parallel.sharding import batch_spec
+
+        state = jax.device_put(state, param_sharding(state, mesh))
+        batch_sharding = NamedSharding(mesh, batch_spec())
+
+        def place(batch):
+            return {k: jax.device_put(v, batch_sharding) for k, v in batch.items()}
+    else:
+
+        def place(batch):
+            return batch
+
+    def snapshot(tree):
+        # A deep copy, NOT an alias: the jitted step donates its input state,
+        # so aliased buffers would be deleted on the next step.
+        return jax.tree.map(jnp.copy, tree)
+
+    anchor = snapshot(state.params)  # θ₀: the round anchor (training.py:58-60)
+    result = TrainResult()
+    countdown: int | None = None
+    round_num = 0
+    round_samples = 0
+    round_losses: list[float] = []
+
+    def batches() -> Iterator[Any]:
+        yield first_batch
+        yield from stream
+
+    def do_update() -> bool:
+        """Ship Δθ, wait for the PS broadcast, merge. True = next round."""
+        nonlocal state, anchor, round_num, round_samples
+        session.send_status(Progress(kind=ProgressKind.UPDATE, job_id=spec.job_id))
+        delta = extract_delta(state.params, anchor)
+        delta_path = work_dir / f"delta-{round_num}.safetensors"
+        save_tree(delta_path, jax.device_get(delta))
+        session.send_resource(
+            cfg.updates,
+            delta_path.name,
+            resource="updates",
+            meta={"num_samples": float(round_samples)},
+        )
+        mean_loss = float(np.mean(round_losses)) if round_losses else math.nan
+        session.send_status(
+            Progress(
+                kind=ProgressKind.METRICS,
+                job_id=spec.job_id,
+                round=round_num,
+                metrics={"loss": mean_loss, "samples": float(round_samples)},
+            )
+        )
+        with session.receive(cfg.results) as events:
+            event = next(events)
+        flat = load_flat(work_dir / event["path"])
+        update = unflatten_like(flat, state.params)
+        state = state.replace(params=merge_update(state.params, update))
+        anchor = snapshot(state.params)
+        delta_path.unlink(missing_ok=True)
+        resp = session.send_status(
+            Progress(kind=ProgressKind.UPDATE_RECEIVED, job_id=spec.job_id)
+        )
+        round_num += 1
+        result.rounds = round_num
+        round_samples = 0
+        round_losses.clear()
+        return resp.kind == ProgressResponseKind.CONTINUE
+
+    t0 = time.monotonic()
+    for batch in batches():
+        state, metrics = step(state, place(batch))
+        loss = float(metrics["loss"])
+        round_losses.append(loss)
+        result.losses.append(loss)
+        result.batches += 1
+        round_samples += cfg.batch_size
+
+        resp = session.send_status(
+            Progress(
+                kind=ProgressKind.STATUS,
+                job_id=spec.job_id,
+                batch_size=cfg.batch_size,
+            )
+        )
+        if resp.kind == ProgressResponseKind.DONE:
+            break
+        if resp.kind == ProgressResponseKind.SCHEDULE_UPDATE:
+            countdown = resp.counter
+        if countdown is not None:
+            if countdown <= 0:
+                countdown = None
+                if not do_update():
+                    break
+            else:
+                countdown -= 1
+        if max_batches is not None and result.batches >= max_batches:
+            log.warning("max_batches=%d reached; stopping", max_batches)
+            break
+    log.info(
+        "training done: %d rounds, %d batches, %.1fs, last loss %.4f",
+        result.rounds, result.batches, time.monotonic() - t0, result.last_loss,
+    )
+    return result
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = argparse.ArgumentParser(description="hypha-tpu DiLoCo training executor")
+    parser.add_argument("--socket", required=True, help="bridge unix socket path")
+    parser.add_argument("--work-dir", required=True)
+    parser.add_argument("--job", required=True, help="job spec JSON (inline or @file)")
+    parser.add_argument("--max-batches", type=int, default=None)
+    args = parser.parse_args(argv)
+    logging.basicConfig(level=logging.INFO, format="%(name)s %(message)s")
+
+    raw = args.job
+    if raw.startswith("@"):
+        raw = Path(raw[1:]).read_text()
+    spec = messages.from_json_dict(json.loads(raw))
+    if not isinstance(spec, JobSpec):
+        raise SystemExit(f"--job does not decode to a JobSpec: {type(spec)}")
+
+    from .bridge_client import Session
+
+    with Session(args.socket) as session:
+        run_training(session, args.work_dir, spec, max_batches=args.max_batches)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -80,9 +80,12 @@ class _Handler:
     fn: Callable[[str, Any], Awaitable[Any]]
     semaphore: asyncio.Semaphore
     registration: "HandlerRegistration"
+    predicate: Callable[[Any], bool] | None = None
 
     def matches(self, msg: Any) -> bool:
-        return self.msg_type is None or isinstance(msg, self.msg_type)
+        if self.msg_type is not None and not isinstance(msg, self.msg_type):
+            return False
+        return self.predicate is None or bool(self.predicate(msg))
 
 
 class HandlerRegistration:
@@ -119,9 +122,18 @@ class HandlerBuilder:
         self._protocol = protocol
         self._msg_type = msg_type
         self._concurrency = 16
+        self._predicate: Callable[[Any], bool] | None = None
 
     def concurrency(self, n: int) -> "HandlerBuilder":
         self._concurrency = n
+        return self
+
+    def match(self, predicate: Callable[[Any], bool]) -> "HandlerBuilder":
+        """Only dispatch messages the predicate accepts — handlers are
+        matched first-wins (request_response.rs:222-259), so predicates let
+        several handlers of the same type share a protocol (e.g. one
+        DataScheduler per dataset)."""
+        self._predicate = predicate
         return self
 
     def respond_with(
@@ -136,6 +148,7 @@ class HandlerBuilder:
             fn=fn,
             semaphore=asyncio.Semaphore(self._concurrency),
             registration=reg,
+            predicate=self._predicate,
         )
         reg._handler = handler
         self._node._register(handler)

@@ -81,6 +81,11 @@ class BatchScheduler:
                     kind=ProgressResponseKind.ERROR, message="not the parameter server"
                 )
             self.tracker.advance_round()
+            if self.tracker.round >= self.tracker.update_epochs:
+                # That was the final outer step: the PS's aggregation loop
+                # terminates on DONE (the workers' own DONE comes with their
+                # UpdateReceived).
+                return _DONE
             return _OK
         if kind == ProgressKind.UPDATE_RECEIVED:
             return self._on_update_received(peer)

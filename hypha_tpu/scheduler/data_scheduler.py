@@ -33,31 +33,33 @@ class DataScheduler:
         self.data_provider = data_provider
         self.dataset = dataset
         self.tracker = SliceTracker(num_slices)
-        self._last: dict[str, int] = {}  # peer -> slice currently held
+        # peer -> (epoch, slice currently held): the epoch guards retirement —
+        # a slice handed out before an epoch wrap must not be marked processed
+        # in the new epoch (it would silently never be served that epoch).
+        self._last: dict[str, tuple[int, int]] = {}
         self._registration = None
 
     def start(self) -> None:
-        def matches(msg: DataRequest) -> bool:
-            return msg.dataset == self.dataset
-
         async def on_data(peer: str, msg: DataRequest) -> DataResponse:
-            if not matches(msg):
-                raise ValueError(f"unknown dataset {msg.dataset!r}")
             index = self.assign(peer)
             log.debug("slice %d of %s -> %s", index, self.dataset, peer)
             return DataResponse(data_provider=self.data_provider, index=index)
 
+        # Predicate-routed: several DataSchedulers (one per dataset) can
+        # share the API protocol on one scheduler node.
         self._registration = (
-            self.node.on(PROTOCOL_API, DataRequest).respond_with(on_data)
+            self.node.on(PROTOCOL_API, DataRequest)
+            .match(lambda msg: msg.dataset == self.dataset)
+            .respond_with(on_data)
         )
 
     def assign(self, peer: str) -> int:
         """Retire the peer's previous slice and pick the next one."""
         prev = self._last.pop(peer, None)
-        if prev is not None:
-            self.tracker.mark_processed(prev)
+        if prev is not None and prev[0] == self.tracker.epoch:
+            self.tracker.mark_processed(prev[1])
         index = self.tracker.next(peer)
-        self._last[peer] = index
+        self._last[peer] = (self.tracker.epoch, index)
         return index
 
     def remove_worker(self, peer: str) -> None:

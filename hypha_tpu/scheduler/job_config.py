@@ -1,0 +1,72 @@
+"""The scheduler's job specification: what a DiLoCo run needs.
+
+Reference: crates/scheduler/src/scheduler_config.rs:18-180 —
+``Job::Diloco(DiLoCo{model, preprocessor?, dataset, rounds{update_rounds,
+avg_samples_between_updates, max_batch_size?}, inner_optimizer: Adam,
+outer_optimizer: Nesterov, resources{num_workers, worker,
+parameter_server, *_price}})``. Defaults follow the reference's
+(scheduler_config.rs:79-102: 2 workers, 100 rounds, 1200 samples/round,
+max batch 600).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+from ..messages import Adam, Loss, LRScheduler, Nesterov, PriceRange, register
+from ..resources import Resources
+
+__all__ = ["DiLoCoRounds", "JobResources", "DiLoCoJob"]
+
+
+@register
+@dataclass(slots=True)
+class DiLoCoRounds:
+    """Outer-loop shape (scheduler_config.rs Rounds)."""
+
+    update_rounds: int = 100
+    avg_samples_between_updates: int = 1200
+    max_batch_size: int | None = 600
+
+
+@register
+@dataclass(slots=True)
+class JobResources:
+    """What to buy at auction (scheduler_config.rs Resources)."""
+
+    num_workers: int = 2
+    worker: Resources = field(default_factory=lambda: Resources(gpu=1.0, cpu=1.0))
+    parameter_server: Resources = field(default_factory=lambda: Resources(cpu=1.0))
+    worker_price: PriceRange = field(default_factory=lambda: PriceRange(bid=1.0, max=10.0))
+    parameter_server_price: PriceRange = field(
+        default_factory=lambda: PriceRange(bid=1.0, max=10.0)
+    )
+
+
+@register
+@dataclass(slots=True)
+class DiLoCoJob:
+    """One DiLoCo training job, end to end."""
+
+    # Model spec dict as the executor's registry understands it:
+    # {"model_type": ModelType, "family": ..., "preset"/"config": ...,
+    #  "seed": int, "source": Fetch?, "input_names": [...]}.
+    model: dict
+    dataset: str
+    rounds: DiLoCoRounds = field(default_factory=DiLoCoRounds)
+    inner_optimizer: Adam = field(default_factory=lambda: Adam(lr=1e-4))
+    outer_optimizer: Nesterov = field(default_factory=Nesterov)
+    resources: JobResources = field(default_factory=JobResources)
+    preprocessor: dict | None = None
+    lr_scheduler: LRScheduler | None = None
+    loss: Loss | None = None
+    # TPU-native: intra-replica mesh axes for the inner loop ({} = one chip).
+    sharding: dict | None = None
+
+    def __post_init__(self) -> None:
+        if self.rounds.update_rounds <= 0:
+            raise ValueError("update_rounds must be positive")
+        if self.rounds.avg_samples_between_updates <= 0:
+            raise ValueError("avg_samples_between_updates must be positive")
+        if self.resources.num_workers <= 0:
+            raise ValueError("num_workers must be positive")

@@ -1,0 +1,368 @@
+"""The scheduler orchestrator: allocate → wire → dispatch → supervise.
+
+Reference call stack being reproduced (SURVEY.md §3.1,
+crates/scheduler/src/bin/hypha-scheduler.rs:54-432):
+
+  1. auction ``num_workers`` train workers + 1 parameter server
+     (GreedyWorkerAllocator over gossip);
+  2. accept offers by first lease renewal (WorkerHandle) and keep the
+     renewal loops alive — a renewal failure is the worker-failure signal;
+  3. per-worker batch size = floor(offered.gpu / required.gpu) clamped to
+     ``max_batch_size`` (hypha-scheduler.rs:320-322);
+  4. resolve the dataset's data provider from the discovery records;
+  5. spawn DataScheduler (slice assignment), ProgressTracker +
+     BatchScheduler (the DiLoCo control plane) and the MetricsBridge;
+  6. dispatch the aggregate job to the PS and a train job per worker;
+  7. supervise: job completes when the batch scheduler reports every
+     worker DONE; any worker failure or failed job status aborts the run
+     (automatic re-allocation is future work in the reference too,
+     rfc/2025-08-04 "Next Steps").
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import uuid
+
+from .. import messages
+from ..messages import (
+    PROTOCOL_PROGRESS,
+    DataRecord,
+    Executor,
+    ExecutorDescriptor,
+    AggregateExecutorConfig,
+    Fetch,
+    JobSpec,
+    Progress,
+    Receive,
+    Reference,
+    Send,
+    TrainExecutorConfig,
+    WorkerSpec,
+)
+from ..network.node import Node
+from .allocator import GreedyWorkerAllocator
+from .batch_scheduler import BatchScheduler
+from .data_scheduler import DataScheduler
+from .job_config import DiLoCoJob
+from .metrics_bridge import MetricsBridge, MetricsConnector
+from .task import StatusRouter, Task
+from .trackers import ProgressTracker
+from .worker_handle import WorkerHandle
+
+__all__ = ["Orchestrator", "JobResult", "JobFailed", "AllocationError"]
+
+log = logging.getLogger("hypha.scheduler.orchestrator")
+
+# Reference executor names (hypha-scheduler.rs:47-48).
+TRAIN_EXECUTOR_NAME = "diloco-transformer"
+AGGREGATE_EXECUTOR_NAME = "parameter-server"
+
+
+class AllocationError(RuntimeError):
+    pass
+
+
+class JobFailed(RuntimeError):
+    pass
+
+
+class JobResult:
+    def __init__(self, job_id: str, rounds: int, metrics: list) -> None:
+        self.job_id = job_id
+        self.rounds = rounds
+        self.metrics = metrics  # [(peer, round, {name: value})]
+
+
+class Orchestrator:
+    def __init__(
+        self,
+        node: Node,
+        metrics_connector: MetricsConnector | None = None,
+    ) -> None:
+        self.node = node
+        self.allocator = GreedyWorkerAllocator(node)
+        self.metrics_bridge = MetricsBridge(metrics_connector)
+
+    # ------------------------------------------------------------ allocation
+
+    async def _allocate_train(
+        self, job: DiLoCoJob, *, auction_timeout: float, attempts: int
+    ) -> list:
+        res = job.resources
+        train_spec = WorkerSpec(
+            resources=res.worker,
+            executor=[ExecutorDescriptor(executor_class="train", name=TRAIN_EXECUTOR_NAME)],
+        )
+        for attempt in range(attempts):
+            offers = await self.allocator.request(
+                train_spec, res.worker_price, auction_timeout, res.num_workers
+            )
+            if len(offers) >= res.num_workers:
+                return offers[: res.num_workers]
+            log.warning(
+                "auction %d/%d: %d/%d train offers",
+                attempt + 1, attempts, len(offers), res.num_workers,
+            )
+        raise AllocationError(f"could not allocate {res.num_workers} train workers")
+
+    async def _allocate_ps(
+        self, job: DiLoCoJob, taken: set, *, auction_timeout: float, attempts: int
+    ):
+        res = job.resources
+        ps_spec = WorkerSpec(
+            resources=res.parameter_server,
+            executor=[
+                ExecutorDescriptor(executor_class="aggregate", name=AGGREGATE_EXECUTOR_NAME)
+            ],
+        )
+        for _attempt in range(attempts):
+            offers = await self.allocator.request(
+                ps_spec, res.parameter_server_price, auction_timeout, 1 + len(taken)
+            )
+            # A peer already sold as a train worker can also host the PS if
+            # its capacity covers both leases; prefer a distinct peer.
+            distinct = [o for o in offers if o.peer_id not in taken]
+            if distinct:
+                return distinct[0]
+            if offers:
+                return offers[0]
+        raise AllocationError("could not allocate a parameter server")
+
+    @staticmethod
+    def batch_size_for(offered, required, max_batch: int | None) -> int:
+        """floor(offered/required) on the accelerator axis, clamped
+        (hypha-scheduler.rs:320-322 sizes by gpu; tpu chips when the job
+        asks for them)."""
+        if required.tpu > 0:
+            size = int(offered.tpu // required.tpu)
+        elif required.gpu > 0:
+            size = int(offered.gpu // required.gpu)
+        else:
+            size = max_batch or 1
+        size = max(1, size)
+        if max_batch is not None:
+            size = min(size, max_batch)
+        return size
+
+    # ------------------------------------------------------------------ run
+
+    async def run(
+        self,
+        job: DiLoCoJob,
+        *,
+        auction_timeout: float = 2.0,
+        allocation_attempts: int = 3,
+        status_timeout: float = 600.0,
+    ) -> JobResult:
+        worker_offers = await self._allocate_train(
+            job, auction_timeout=auction_timeout, attempts=allocation_attempts
+        )
+        handles: list[WorkerHandle] = []
+        ps_handle: WorkerHandle | None = None
+        router: StatusRouter | None = None
+        data_scheduler: DataScheduler | None = None
+        progress_reg = None
+        try:
+            # Acceptance: first renewal converts each temp lease — must happen
+            # within the 500 ms offer window, so BEFORE the PS auction runs
+            # (worker.rs:75; rfc/2025-08-04 "Lease Renewal").
+            for offer in worker_offers:
+                handles.append(await WorkerHandle.create(self.node, offer))
+            ps_offer = await self._allocate_ps(
+                job,
+                {h.peer_id for h in handles},
+                auction_timeout=auction_timeout,
+                attempts=allocation_attempts,
+            )
+            ps_handle = await WorkerHandle.create(self.node, ps_offer)
+
+            for handle in handles:
+                handle.batch_size = self.batch_size_for(
+                    handle.offer.resources,
+                    job.resources.worker,
+                    job.rounds.max_batch_size,
+                )
+
+            # Dataset discovery (hypha-scheduler.rs:269,435-457).
+            raw = await self.node.get_record(job.dataset)
+            if raw is None:
+                raise JobFailed(f"no data record for dataset {job.dataset!r}")
+            record = messages.decode(raw)
+            if not isinstance(record, DataRecord):
+                raise JobFailed(f"bad data record {record!r}")
+            providers = await self.node.find_providers(job.dataset)
+            if not providers:
+                raise JobFailed(f"no provider for dataset {job.dataset!r}")
+            provider = providers[0]
+
+            data_scheduler = DataScheduler(
+                self.node, provider, job.dataset, record.num_slices
+            )
+            data_scheduler.start()
+
+            tracker = ProgressTracker(
+                parameter_server=ps_handle.peer_id,
+                update_target=job.rounds.avg_samples_between_updates,
+                update_epochs=job.rounds.update_rounds,
+            )
+            for handle in handles:
+                tracker.add_worker(handle.peer_id, handle.batch_size)
+
+            complete = asyncio.Event()
+            collected: list = []
+
+            def on_metrics(peer: str, round_num: int, metrics: dict) -> None:
+                collected.append((peer, round_num, metrics))
+                self.metrics_bridge.on_metrics(peer, round_num, metrics)
+
+            batch_scheduler = BatchScheduler(
+                tracker, on_metrics=on_metrics, on_complete=complete.set
+            )
+
+            async def on_progress(peer: str, progress: Progress):
+                return batch_scheduler.on_progress(peer, progress)
+
+            progress_reg = self.node.on(PROTOCOL_PROGRESS, Progress).respond_with(
+                on_progress
+            )
+
+            router = StatusRouter(self.node)
+            base_id = str(uuid.uuid4())
+            worker_peers = [h.peer_id for h in handles]
+
+            ps_task = await Task.dispatch(
+                self.node,
+                router,
+                JobSpec(
+                    job_id=f"{base_id}-ps",
+                    executor=Executor(
+                        kind="aggregate",
+                        name=AGGREGATE_EXECUTOR_NAME,
+                        aggregate=AggregateExecutorConfig(
+                            updates=Receive(
+                                Reference.from_peers(worker_peers, "updates")
+                            ),
+                            results=Send(
+                                Reference.from_peers(worker_peers, "results")
+                            ),
+                            optimizer=job.outer_optimizer,
+                            num_workers=len(worker_peers),
+                        ),
+                    ),
+                ),
+                [ps_handle],
+            )
+            train_tasks: list[Task] = []
+            for i, handle in enumerate(handles):
+                spec = JobSpec(
+                    job_id=f"{base_id}-w{i}",
+                    executor=Executor(
+                        kind="train",
+                        name=TRAIN_EXECUTOR_NAME,
+                        train=TrainExecutorConfig(
+                            model=job.model,
+                            data=Fetch(
+                                Reference.from_scheduler(
+                                    self.node.peer_id, job.dataset
+                                )
+                            ),
+                            updates=Send(
+                                Reference.from_peers([ps_handle.peer_id], "updates")
+                            ),
+                            results=Receive(
+                                Reference.from_peers([ps_handle.peer_id], "results")
+                            ),
+                            optimizer=job.inner_optimizer,
+                            batch_size=handle.batch_size,
+                            preprocessor=job.preprocessor,
+                            scheduler=job.lr_scheduler,
+                            loss=job.loss,
+                            sharding=job.sharding,
+                        ),
+                    ),
+                )
+                train_tasks.append(
+                    await Task.dispatch(self.node, router, spec, [handle])
+                )
+
+            await self._supervise(
+                complete,
+                handles + [ps_handle],
+                train_tasks + [ps_task],
+                status_timeout,
+            )
+            return JobResult(base_id, tracker.round, collected)
+        finally:
+            if progress_reg is not None:
+                progress_reg.close()
+            if data_scheduler is not None:
+                data_scheduler.stop()
+            if router is not None:
+                router.close()
+            for handle in handles:
+                await handle.release()
+            if ps_handle is not None:
+                await ps_handle.release()
+            await self.metrics_bridge.close()
+
+    async def _supervise(
+        self,
+        complete: asyncio.Event,
+        handles: list[WorkerHandle],
+        tasks: list[Task],
+        status_timeout: float,
+    ) -> None:
+        """Wait for completion; abort on worker failure or failed status
+        (hypha-scheduler.rs:372-412 select loop)."""
+
+        async def watch_statuses() -> str:
+            async def one(task: Task) -> str:
+                while True:
+                    peer, status = await task.next_status()
+                    log.info("job %s on %s: %s %s",
+                             status.job_id, peer, status.state, status.message)
+                    if status.state == "failed":
+                        return f"{status.job_id} failed on {peer}: {status.message}"
+                    if status.state == "cancelled":
+                        return f"{status.job_id} cancelled on {peer}"
+
+            watchers = [asyncio.create_task(one(t)) for t in tasks]
+            try:
+                done, _ = await asyncio.wait(
+                    watchers, return_when=asyncio.FIRST_COMPLETED
+                )
+                return next(iter(done)).result()
+            finally:
+                for w in watchers:
+                    w.cancel()
+
+        waiters = {
+            asyncio.create_task(complete.wait(), name="complete"): "complete",
+            asyncio.create_task(watch_statuses(), name="status"): "status",
+        }
+        for handle in handles:
+            waiters[
+                asyncio.create_task(_await_failure(handle), name="worker")
+            ] = "worker"
+        try:
+            done, _ = await asyncio.wait(
+                waiters, timeout=status_timeout, return_when=asyncio.FIRST_COMPLETED
+            )
+            if not done:
+                raise JobFailed(f"job made no progress in {status_timeout}s")
+            first = next(iter(done))
+            kind = waiters[first]
+            if kind == "complete":
+                return
+            raise JobFailed(str(first.result()))
+        finally:
+            for t in waiters:
+                t.cancel()
+            await asyncio.gather(*waiters, return_exceptions=True)
+
+
+async def _await_failure(handle: WorkerHandle) -> str:
+    failure = await asyncio.shield(handle.failed)
+    return str(failure)

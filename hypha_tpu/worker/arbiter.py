@@ -224,5 +224,9 @@ class Arbiter:
             return Ack(ok=False, message="no such lease")
         if lease.leasable.peer_id != peer:
             return Ack(ok=False, message="lease not yours")
+        if msg.job_id not in self.job_manager.jobs_for_lease(msg.lease_id):
+            # A lease only authorizes cancelling its own jobs — another
+            # scheduler's lease must not be able to kill this one's job.
+            return Ack(ok=False, message="job not under this lease")
         await self.job_manager.cancel_job(msg.job_id)
         return Ack(ok=True)

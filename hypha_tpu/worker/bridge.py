@@ -93,20 +93,29 @@ class Bridge:
         return self.socket_path
 
     async def stop(self) -> None:
-        # Drain in-flight background sends first — the executor's final
-        # pseudo-gradient is typically still uploading when it exits.
-        pending = [t for t in self._send_tasks if not t.done()]
-        if pending:
-            done, still = await asyncio.wait(pending, timeout=60.0)
-            for task in still:
-                log.warning("bridge stop: abandoning unfinished send")
-                task.cancel()
+        # Stop accepting first, so no new sends can start behind the drain.
         if self._server is not None:
             self._server.close()
             try:
                 await self._server.wait_closed()
             except asyncio.CancelledError:
                 pass
+        # Drain in-flight background sends — the executor's final
+        # pseudo-gradient is typically still uploading when it exits.
+        # Re-snapshot each pass: a request already in-flight when the server
+        # closed may still have added a task after the first snapshot.
+        deadline = asyncio.get_running_loop().time() + 60.0
+        while True:
+            pending = [t for t in self._send_tasks if not t.done()]
+            if not pending:
+                break
+            remaining = deadline - asyncio.get_running_loop().time()
+            if remaining <= 0:
+                for task in pending:
+                    log.warning("bridge stop: abandoning unfinished send")
+                    task.cancel()
+                break
+            await asyncio.wait(pending, timeout=remaining)
         self.socket_path.unlink(missing_ok=True)
 
     # ------------------------------------------------------------- server
@@ -217,9 +226,13 @@ class Bridge:
             await self._respond(writer, 400, {"error": f"no such file {body.get('path')}"})
             return
         resource = str(body.get("resource", "updates"))
+        meta = body.get("meta") or {}
+        if not isinstance(meta, dict):
+            await self._respond(writer, 400, {"error": "body.meta must be an object"})
+            return
 
         # Background copy (bridge.rs:256-327): don't block the executor loop.
-        task = asyncio.create_task(self.connector.send(send, path, resource))
+        task = asyncio.create_task(self.connector.send(send, path, resource, meta))
         self._send_tasks.add(task)
 
         def _log_done(t: asyncio.Task) -> None:

@@ -152,13 +152,17 @@ class Connector:
 
     # --------------------------------------------------------------- send
 
-    async def send(self, send: Send, path: Path, resource: str) -> None:
+    async def send(
+        self, send: Send, path: Path, resource: str, meta: dict | None = None
+    ) -> None:
         """Push a local file to the reference's peers. ALL: every peer must
-        get it; ANY: first success wins (connector/mod.rs:305-433)."""
+        get it; ANY: first success wins (connector/mod.rs:305-433).
+        ``meta`` keys ride the stream header (the parameter server reads
+        ``num_samples`` for its weighted mean); the reserved keys win."""
         ref = send.ref
         peers = ref.peers or []
         strategy = ref.strategy or TransferStrategy.ALL
-        header = {"resource": resource, "name": path.name}
+        header = {**(meta or {}), "resource": resource, "name": path.name}
         if strategy == TransferStrategy.ANY:
             last: Exception | None = None
             for peer in peers:

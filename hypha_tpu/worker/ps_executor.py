@@ -96,10 +96,16 @@ class ParameterServerExecutor(JobExecutor):
                 update_path = self._outer_step(
                     received, momentum, lr, mu, work_dir, round_num
                 )
+                # Notify BEFORE broadcasting: a worker can merge the update
+                # and send UpdateReceived the moment the broadcast lands, and
+                # the scheduler must already have advanced the round by then —
+                # otherwise the worker is told Continue instead of Done and
+                # starts a phantom extra round (the reference broadcasts
+                # first, parameter_server.rs:232-283, and carries this race).
+                response = await self._notify_updated(scheduler_peer, job_id, round_num)
                 await self._broadcast(cfg, update_path, round_num)
                 for path, _ in received.values():
                     path.unlink(missing_ok=True)
-                response = await self._notify_updated(scheduler_peer, job_id, round_num)
                 round_num += 1
                 if response.kind == ProgressResponseKind.DONE:
                     execution.finish("completed")
@@ -141,7 +147,14 @@ class ParameterServerExecutor(JobExecutor):
             await push.save_to(dest)
             samples = 1.0
             if isinstance(push.resource, dict):
-                samples = float(push.resource.get("num_samples", 1.0)) or 1.0
+                # Peer-supplied weight: a non-finite/zero/negative value must
+                # not poison the weighted mean (or crash the PS loop).
+                try:
+                    samples = float(push.resource.get("num_samples", 1.0))
+                except (TypeError, ValueError):
+                    samples = 1.0
+                if not np.isfinite(samples) or samples <= 0:
+                    samples = 1.0
             received[peer] = (dest, samples)
             log.info(
                 "ps %s: round %d delta %d/%d (from %s)",

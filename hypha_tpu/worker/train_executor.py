@@ -1,0 +1,101 @@
+"""In-process train executor: the DiLoCo inner loop without a process hop.
+
+The reference's only train runtime is ``runtime=process`` (spawn
+``accelerate launch``, crates/worker/src/config.rs:135-141); its only
+in-runtime executor is the parameter server. On TPU an in-process runtime
+is the natural default — the executor shares the worker's JAX context, so
+there is no double device grab, no model re-import cost per job, and no
+serialization across a UDS for control traffic.
+
+The loop itself is byte-identical to the process path: this executor
+starts the same Job Bridge on the job's unix socket and runs
+:func:`hypha_tpu.executor.training.run_training` with the same bridge
+client in a worker thread — exercising the full fetch/send/receive/status
+contract, just without the subprocess.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import shutil
+import uuid
+from dataclasses import dataclass, field
+from pathlib import Path
+
+from ..messages import JobSpec
+from ..network.node import Node
+from .bridge import Bridge
+from .connectors import Connector
+from .job_manager import Execution, JobExecutor
+
+__all__ = ["InProcessTrainExecutor"]
+
+log = logging.getLogger("hypha.worker.train")
+
+
+@dataclass(slots=True)
+class InProcessTrainExecutor(JobExecutor):
+    node: Node
+    work_root: Path = field(default_factory=lambda: Path("/tmp"))
+    keep_work_dir: bool = False
+    max_batches: int | None = None  # test safety valve
+
+    async def execute(
+        self, job_id: str, spec: JobSpec, scheduler_peer: str
+    ) -> Execution:
+        work_dir = Path(self.work_root) / f"hypha-{uuid.uuid4().hex[:12]}"
+        work_dir.mkdir(parents=True, mode=0o700)
+        bridge = Bridge(
+            self.node, work_dir, job_id, scheduler_peer,
+            Connector(self.node, scheduler_peer),
+        )
+        socket_path = await bridge.start()
+        execution = Execution(job_id)
+        runner = asyncio.create_task(
+            self._run(execution, spec, socket_path, work_dir, bridge)
+        )
+
+        async def cancel() -> None:
+            runner.cancel()
+            try:
+                await runner
+            except (asyncio.CancelledError, Exception):
+                pass
+            execution.finish("cancelled")
+
+        execution.cancel = cancel  # type: ignore[method-assign]
+        return execution
+
+    async def _run(
+        self,
+        execution: Execution,
+        spec: JobSpec,
+        socket_path: Path,
+        work_dir: Path,
+        bridge: Bridge,
+    ) -> None:
+        from ..executor.bridge_client import Session
+        from ..executor.training import run_training
+
+        def blocking() -> None:
+            with Session(str(socket_path)) as session:
+                run_training(
+                    session, work_dir, spec, max_batches=self.max_batches
+                )
+
+        try:
+            # The training loop is synchronous (jit dispatch + bridge HTTP);
+            # it runs in a worker thread while the bridge serves it from this
+            # event loop.
+            await asyncio.to_thread(blocking)
+            execution.finish("completed")
+        except asyncio.CancelledError:
+            raise
+        except Exception as e:
+            log.exception("in-process training job %s failed", spec.job_id)
+            execution.finish("failed", str(e))
+        finally:
+            await bridge.stop()
+            if not self.keep_work_dir:
+                shutil.rmtree(work_dir, ignore_errors=True)

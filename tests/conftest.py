@@ -32,3 +32,9 @@ try:  # best-effort: drop the remote factory too (private API, may churn)
     _xb._backend_factories.pop("axon", None)
 except Exception:
     pass
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers", "slow: multi-node end-to-end tests (tens of seconds)"
+    )

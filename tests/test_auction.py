@@ -290,3 +290,51 @@ def test_renewal_failure_surfaces_as_worker_failure():
         await sched.stop()
 
     run(main())
+
+
+def test_cancel_requires_job_under_lease():
+    """CancelJob is bound to the lease that dispatched the job: another
+    scheduler's valid lease must not be able to cancel this one's job."""
+
+    async def main():
+        hub = MemoryTransport()
+        s1 = Node(hub.shared(), peer_id="s1")
+        s2 = Node(hub.shared(), peer_id="s2")
+        await s1.start(); await s2.start()
+        node, lm, jm, arb, fake = await _mk_worker(hub, "w1")
+        await _mesh(hub, s1, [node])
+        await _mesh(hub, s2, [node])
+
+        from hypha_tpu.messages import PROTOCOL_API, CancelJob
+
+        offers1 = await GreedyWorkerAllocator(s1).request(
+            _spec(1.0), PriceRange(bid=1.0, max=5.0), timeout=1.0, num_workers=1
+        )
+        h1 = await WorkerHandle.create(s1, offers1[0])
+        router = StatusRouter(s1)
+        task = await Task.dispatch(s1, router, _job("job-a"), [h1])
+
+        offers2 = await GreedyWorkerAllocator(s2).request(
+            _spec(1.0), PriceRange(bid=1.0, max=5.0), timeout=1.0, num_workers=1
+        )
+        h2 = await WorkerHandle.create(s2, offers2[0])
+
+        # s2 holds a valid lease but job-a is not under it
+        resp = await s2.request(
+            "w1", PROTOCOL_API, CancelJob(lease_id=h2.lease_id, job_id="job-a")
+        )
+        assert not resp.ok and "not under this lease" in resp.message
+        assert len(jm) == 1  # job survived
+
+        # the owning lease can cancel it
+        resp = await s1.request(
+            "w1", PROTOCOL_API, CancelJob(lease_id=h1.lease_id, job_id="job-a")
+        )
+        assert resp.ok
+        await fake.executions[0].wait()  # cancelled
+
+        await h1.release(); await h2.release()
+        task.close(); router.close()
+        await arb.stop(); await node.stop(); await s1.stop(); await s2.stop()
+
+    run(main())

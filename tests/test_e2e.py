@@ -1,0 +1,186 @@
+"""End-to-end DiLoCo: gateway + scheduler + workers + data node, full job.
+
+The system-level test the reference only has as a manual quickstart
+(docs/quickstart.md: gateway + scheduler + 3 workers + data node as local
+processes): here the whole topology runs in-process on the memory fabric —
+auction, leases, dispatch, slice scheduling, the jitted JAX inner loop,
+pseudo-gradient push to the parameter server, Nesterov outer step,
+broadcast merge, round accounting, metrics — through the real protocols.
+"""
+
+from __future__ import annotations
+
+import asyncio
+
+import numpy as np
+import pytest
+from safetensors.numpy import save_file
+
+from hypha_tpu.data_node import DataNode
+from hypha_tpu.gateway import Gateway
+from hypha_tpu.messages import Adam, ModelType, Nesterov, PriceRange
+from hypha_tpu.network import MemoryTransport, Node
+from hypha_tpu.resources import Resources
+from hypha_tpu.scheduler.job_config import DiLoCoJob, DiLoCoRounds, JobResources
+from hypha_tpu.scheduler.metrics_bridge import CallbackConnector
+from hypha_tpu.scheduler.orchestrator import Orchestrator
+from hypha_tpu.worker.arbiter import OfferConfig
+from hypha_tpu.worker.runtime import WorkerNode
+
+VOCAB = 32
+SEQ = 16
+
+
+def run(coro, timeout=180):
+    return asyncio.run(asyncio.wait_for(coro, timeout=timeout))
+
+
+def make_dataset(tmp_path, name="toy", n_slices=4, samples_per_slice=8):
+    d = tmp_path / name
+    d.mkdir()
+    rng = np.random.default_rng(0)
+    for i in range(n_slices):
+        ids = rng.integers(0, VOCAB, (samples_per_slice, SEQ), dtype=np.int64).astype(
+            np.int32
+        )
+        save_file({"input_ids": ids}, str(d / f"slice_{i:04d}.safetensors"))
+    return d
+
+
+def tiny_model_spec() -> dict:
+    return {
+        "model_type": ModelType.CAUSAL_LM,
+        "family": "gpt2",
+        "config": {
+            "vocab_size": VOCAB,
+            "n_positions": SEQ,
+            "n_embd": 16,
+            "n_layer": 1,
+            "n_head": 2,
+        },
+        "seed": 7,
+    }
+
+
+async def start_cluster(tmp_path):
+    hub = MemoryTransport()
+    gw = Gateway(hub.shared(), peer_id="gw")
+    await gw.start()
+    boot = [gw.node.listen_addrs[0]]
+
+    data = DataNode(
+        hub.shared(), {"toy": make_dataset(tmp_path)}, peer_id="data", bootstrap=boot
+    )
+    await data.start()
+
+    workers = []
+    for name, tpu in (("w0", 4.0), ("w1", 2.0)):
+        w = WorkerNode(
+            hub.shared(),
+            resources=Resources(tpu=tpu, cpu=8, memory=1000),
+            peer_id=name,
+            offer=OfferConfig(price=1.0, strategy="whole"),
+            bootstrap=boot,
+            work_root=tmp_path / name,
+        )
+        await w.start()
+        workers.append(w)
+    ps = WorkerNode(
+        hub.shared(),
+        resources=Resources(cpu=2, memory=200),  # no tpu => never a train worker
+        peer_id="psw",
+        bootstrap=boot,
+        work_root=tmp_path / "psw",
+    )
+    await ps.start()
+    workers.append(ps)
+
+    sched = Node(hub.shared(), peer_id="sched", bootstrap=boot)
+    await sched.start()
+    await sched.wait_for_bootstrap()
+    return hub, gw, data, workers, sched
+
+
+def diloco_job(rounds=2) -> DiLoCoJob:
+    return DiLoCoJob(
+        model=tiny_model_spec(),
+        dataset="toy",
+        rounds=DiLoCoRounds(
+            update_rounds=rounds, avg_samples_between_updates=12, max_batch_size=4
+        ),
+        inner_optimizer=Adam(lr=1e-3),
+        outer_optimizer=Nesterov(lr=0.7, momentum=0.9),
+        resources=JobResources(
+            num_workers=2,
+            worker=Resources(tpu=1.0, cpu=1.0, memory=10),
+            parameter_server=Resources(cpu=1.0, memory=10),
+            worker_price=PriceRange(bid=1.0, max=10.0),
+            parameter_server_price=PriceRange(bid=1.0, max=10.0),
+        ),
+    )
+
+
+@pytest.mark.slow
+def test_full_diloco_job(tmp_path):
+    async def main():
+        hub, gw, data, workers, sched = await start_cluster(tmp_path)
+        tracked = []
+        orch = Orchestrator(
+            sched,
+            metrics_connector=CallbackConnector(
+                lambda w, r, n, v: tracked.append((w, r, n, v))
+            ),
+        )
+        try:
+            result = await orch.run(diloco_job(rounds=2), auction_timeout=1.5)
+        finally:
+            for w in workers:
+                await w.stop()
+            await data.stop()
+            await sched.stop()
+            await gw.stop()
+        return result, tracked
+
+    result, tracked = run(main())
+    assert result.rounds == 2
+    # Per-round loss metrics flowed from both train workers through the bridge.
+    losses = [(w, r, v) for (w, r, n, v) in tracked if n == "loss"]
+    worker_ids = {w for w, _, _ in losses}
+    assert worker_ids == {"w0", "w1"}, worker_ids
+    assert all(np.isfinite(v) for _, _, v in losses)
+    rounds_seen = {r for _, r, _ in losses}
+    assert rounds_seen == {0, 1}, rounds_seen
+
+
+@pytest.mark.slow
+def test_diloco_heterogeneous_batch_sizing(tmp_path):
+    """Batch sizes follow offered capacity: whole-strategy workers offer all
+    their chips, so w0 (4 tpu) gets batch 4, w1 (2 tpu) gets batch 2
+    (hypha-scheduler.rs:320-322 sizing rule)."""
+
+    async def main():
+        hub, gw, data, workers, sched = await start_cluster(tmp_path)
+        seen = {}
+        orch = Orchestrator(sched)
+
+        real_sizing = Orchestrator.batch_size_for
+
+        def spy(offered, required, max_batch):
+            size = real_sizing(offered, required, max_batch)
+            seen[offered.tpu] = size
+            return size
+
+        orch.batch_size_for = spy
+        try:
+            result = await orch.run(diloco_job(rounds=1), auction_timeout=1.5)
+        finally:
+            for w in workers:
+                await w.stop()
+            await data.stop()
+            await sched.stop()
+            await gw.stop()
+        return result, seen
+
+    result, seen = run(main())
+    assert result.rounds == 1
+    assert seen == {4.0: 4, 2.0: 2}, seen

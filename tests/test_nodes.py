@@ -166,3 +166,57 @@ def test_data_scheduler_work_stealing():
     # slow died: reclaim
     ds.remove_worker("slow")
     assert "slow" not in ds._last
+
+
+def test_data_scheduler_epoch_wrap_does_not_lose_slices():
+    """A slice handed out before an epoch wrap must not be retired into the
+    new epoch: with 2 slices and 2 workers, the stale assignment from the old
+    epoch would otherwise mark a fresh slice processed and starve it for the
+    whole epoch."""
+    from hypha_tpu.scheduler.trackers import SliceTracker
+
+    ds = DataScheduler.__new__(DataScheduler)
+    ds.tracker = SliceTracker(2)
+    ds._last = {}
+    assert ds.assign("a") == 0
+    assert ds.assign("b") == 1
+    # a retires 0, steals 1; a retires 1 -> everything processed -> new epoch
+    assert ds.assign("a") == 1
+    assert ds.assign("a") == 0
+    assert ds.tracker.epoch == 1
+    # b's stale slice 1 is from epoch 0: it must NOT be marked processed now
+    idx = ds.assign("b")
+    assert idx == 1, idx
+    assert 1 not in ds.tracker._processed
+
+
+def test_two_data_schedulers_route_by_dataset(tmp_path):
+    """Predicate routing: one scheduler node can serve several datasets
+    (handlers are first-wins per message type; .match() disambiguates)."""
+
+    async def main():
+        hub = MemoryTransport()
+        sched = Node(hub.shared(), peer_id="sched")
+        await sched.start()
+        client = Node(hub.shared(), peer_id="w0")
+        await client.start()
+        client.add_peer_addr("sched", sched.listen_addrs[0])
+
+        from hypha_tpu.messages import PROTOCOL_API, DataRequest
+
+        ds_a = DataScheduler(sched, "prov-a", "mnist", num_slices=2)
+        ds_b = DataScheduler(sched, "prov-b", "cifar", num_slices=2)
+        ds_a.start()
+        ds_b.start()
+        ra = await client.request(
+            "sched", PROTOCOL_API, DataRequest(dataset="mnist", peer_id="w0")
+        )
+        rb = await client.request(
+            "sched", PROTOCOL_API, DataRequest(dataset="cifar", peer_id="w0")
+        )
+        assert ra.data_provider == "prov-a"
+        assert rb.data_provider == "prov-b"
+        ds_a.stop(); ds_b.stop()
+        await client.stop(); await sched.stop()
+
+    run(main())

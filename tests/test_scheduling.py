@@ -225,9 +225,10 @@ def test_batch_scheduler_full_round():
     bs.on_progress("w0", Progress(kind=ProgressKind.METRICS, round=0, metrics={"loss": 1.0}))
     assert metrics_log == [("w0", 0, {"loss": 1.0})]
 
-    # PS applies outer step -> round advances
+    # PS applies outer step -> round advances; single-round job means that
+    # was the last outer step, so the PS is told DONE
     r = bs.on_progress("ps", Progress(kind=ProgressKind.UPDATED))
-    assert r.kind is ProgressResponseKind.OK
+    assert r.kind is ProgressResponseKind.DONE
     assert tracker.round == 1
 
     # workers merged: single-round job -> DONE for both, completion fires once
