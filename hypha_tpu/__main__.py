@@ -1,0 +1,5 @@
+"""``python -m hypha_tpu`` — the node CLI (see hypha_tpu.cli)."""
+
+from .cli import main
+
+raise SystemExit(main())

@@ -33,13 +33,18 @@ class DataNode:
 
     def __init__(
         self,
-        transport: Transport,
+        transport: Transport | None,
         datasets: dict[str, str | Path],
         peer_id: str | None = None,
         bootstrap: list[str] | None = None,
+        node: Node | None = None,
         **node_kwargs,
     ) -> None:
-        self.node = Node(transport, peer_id=peer_id, bootstrap=bootstrap, **node_kwargs)
+        # ``node`` injection lets the CLI hand in an mTLS-secured Node
+        # (network.secure) instead of building a plain one here.
+        self.node = node or Node(
+            transport, peer_id=peer_id, bootstrap=bootstrap, **node_kwargs
+        )
         self._slices: dict[str, list[Path]] = {}
         for name, directory in datasets.items():
             files = sorted(p for p in Path(directory).iterdir() if p.is_file())

@@ -34,7 +34,7 @@ import logging
 import math
 import time
 from pathlib import Path
-from typing import Any, Iterator
+from typing import Any, Callable, Iterator
 
 import numpy as np
 
@@ -132,12 +132,14 @@ def run_training(
     spec: JobSpec,
     *,
     max_batches: int | None = None,
+    should_stop: Callable[[], bool] | None = None,
 ) -> TrainResult:
     """Run the DiLoCo inner loop to completion over the given bridge session.
 
     ``session`` implements the bridge client API (fetch / send_resource /
     send_status / receive — hypha_tpu.executor.bridge_client.Session).
-    ``max_batches`` is a safety valve for tests.
+    ``max_batches`` is a safety valve for tests. ``should_stop`` is polled
+    between batches — the in-process executor's cooperative cancellation.
     """
     import jax
     import jax.numpy as jnp
@@ -208,7 +210,10 @@ def run_training(
         session.send_resource(
             cfg.updates,
             delta_path.name,
-            resource="updates",
+            # The Send reference's resource tag routes the stream to the
+            # right consumer on the PS node (job-unique, set by the
+            # scheduler's orchestrator).
+            resource=cfg.updates.ref.resource or "updates",
             meta={"num_samples": float(round_samples)},
         )
         mean_loss = float(np.mean(round_losses)) if round_losses else math.nan
@@ -222,11 +227,15 @@ def run_training(
         )
         with session.receive(cfg.results) as events:
             event = next(events)
-        flat = load_flat(work_dir / event["path"])
+        update_file = work_dir / event["path"]
+        flat = load_flat(update_file)
         update = unflatten_like(flat, state.params)
         state = state.replace(params=merge_update(state.params, update))
         anchor = snapshot(state.params)
         delta_path.unlink(missing_ok=True)
+        # The broadcast update is merged — drop it, or a long job accumulates
+        # one full-parameter-sized file per round under work_dir/incoming.
+        update_file.unlink(missing_ok=True)
         resp = session.send_status(
             Progress(kind=ProgressKind.UPDATE_RECEIVED, job_id=spec.job_id)
         )
@@ -238,6 +247,9 @@ def run_training(
 
     t0 = time.monotonic()
     for batch in batches():
+        if should_stop is not None and should_stop():
+            log.info("cooperative stop requested; ending training loop")
+            break
         state, metrics = step(state, place(batch))
         loss = float(metrics["loss"])
         round_losses.append(loss)

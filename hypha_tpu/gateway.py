@@ -30,11 +30,13 @@ class Gateway:
 
     def __init__(
         self,
-        transport: Transport,
+        transport: Transport | None,
         peer_id: str | None = None,
+        node: Node | None = None,
         **node_kwargs,
     ) -> None:
-        self.node = Node(
+        # ``node`` injection: the CLI passes an mTLS-secured registry Node.
+        self.node = node or Node(
             transport, peer_id=peer_id, registry_server=True, **node_kwargs
         )
         self._health = None

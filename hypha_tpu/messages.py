@@ -33,6 +33,8 @@ __all__ = [
     "PROTOCOL_HEALTH",
     "PROTOCOL_PROGRESS",
     "TOPIC_WORKER",
+    "TRAIN_EXECUTOR_NAME",
+    "AGGREGATE_EXECUTOR_NAME",
     "encode",
     "decode",
     "register",
@@ -86,6 +88,11 @@ PROTOCOL_API = "/hypha-api/0.0.1"
 PROTOCOL_HEALTH = "/hypha-health/0.0.1"
 PROTOCOL_PROGRESS = "/hypha-progress/0.0.1"
 TOPIC_WORKER = "hypha/worker"
+
+# Executor implementation names: what the scheduler asks for at auction and
+# what workers advertise (crates/scheduler/src/bin/hypha-scheduler.rs:47-48).
+TRAIN_EXECUTOR_NAME = "diloco-transformer"
+AGGREGATE_EXECUTOR_NAME = "parameter-server"
 
 # --------------------------------------------------------------------------
 # Self-describing serialization: registry of tagged dataclasses.

@@ -228,6 +228,42 @@ class Subscription:
                 pass
 
 
+class PushConsumer:
+    """A routed inbound-push subscription (see Node.consume_pushes)."""
+
+    def __init__(
+        self, node: "Node", predicate: Callable[["PushStream"], bool], buffer: int
+    ) -> None:
+        self._node = node
+        self.predicate = predicate
+        self._queue: asyncio.Queue = asyncio.Queue(maxsize=buffer)
+        self.closed = False
+
+    async def next(self, timeout: float | None = None) -> "PushStream":
+        getter = self._queue.get()
+        return await (getter if timeout is None else asyncio.wait_for(getter, timeout))
+
+    def __aiter__(self) -> "PushConsumer":
+        return self
+
+    async def __anext__(self) -> "PushStream":
+        return await self._queue.get()
+
+    def close(self) -> None:
+        """Stop routing to this consumer. Anything already buffered but
+        undrained is released so senders aren't pinned forever."""
+        if self.closed:
+            return
+        self.closed = True
+        try:
+            self._node._push_consumers.remove(self)
+        except ValueError:
+            pass
+        while not self._queue.empty():
+            push = self._queue.get_nowait()
+            push.finish()
+
+
 @dataclass(slots=True)
 class PushStream:
     """An accepted inbound push: header + raw byte reader."""
@@ -329,6 +365,7 @@ class Node:
         self._provided: set[str] = set()  # keys this node announces (client)
         # tensor streams
         self._push_queue: asyncio.Queue = asyncio.Queue()
+        self._push_consumers: list["PushConsumer"] = []
         self._push_sem = asyncio.Semaphore(ACCEPT_LIMIT)
         self._pull_sem = asyncio.Semaphore(ACCEPT_LIMIT)
         self._pull_handler: Callable[[str, Any, Stream], Awaitable[None]] | None = None
@@ -360,6 +397,8 @@ class Node:
         self._closed = True
         # Wake consumers blocked on push_streams()/next_push().
         self._push_queue.put_nowait(None)
+        for consumer in list(self._push_consumers):
+            consumer.close()
         for sub_list in self._subs.values():
             for sub in list(sub_list):
                 sub.closed = True
@@ -854,17 +893,60 @@ class Node:
                 finished.set()
                 self._push_sem.release()
 
-        await self._push_queue.put(
-            PushStream(
-                peer=peer,
-                resource=resource,
-                stream=_CountingStream(stream, self),
-                _done=done,
-            )
+        push = PushStream(
+            peer=peer,
+            resource=resource,
+            stream=_CountingStream(stream, self),
+            _done=done,
         )
+        # Route to the first registered consumer whose predicate matches;
+        # unmatched pushes land on the shared default queue. Predicate
+        # routing is what lets one node host several stream consumers at
+        # once (a parameter-server job AND a train job's receive, or two
+        # jobs' bridges) without eating each other's transfers.
+        target = self._push_queue
+        for consumer in self._push_consumers:
+            try:
+                matches = consumer.predicate(push)
+            except Exception:
+                matches = False
+            if matches:
+                target = consumer._queue
+                break
+        await target.put(push)
         # Keep the transport connection alive until the consumer drains it
         # (TCP closes the socket when the accept callback returns).
         await finished.wait()
+
+    def consume_pushes(
+        self, predicate: Callable[[PushStream], bool], buffer: int = 64
+    ) -> "PushConsumer":
+        """Register a routed push consumer (first registered, first matched).
+        Close it to unroute; buffered pushes can still be drained after.
+
+        Pushes that arrived BEFORE registration (e.g. a parameter-server
+        broadcast landing between two of the executor's receive windows) sit
+        on the default queue; reclaim the matching ones now.
+        """
+        consumer = PushConsumer(self, predicate, buffer)
+        self._push_consumers.append(consumer)
+        leftover = []
+        while not self._push_queue.empty():
+            item = self._push_queue.get_nowait()
+            if item is None:  # stop sentinel: keep for other consumers
+                leftover.append(item)
+                continue
+            try:
+                matched = predicate(item)
+            except Exception:
+                matched = False
+            if matched and not consumer._queue.full():
+                consumer._queue.put_nowait(item)
+            else:
+                leftover.append(item)
+        for item in leftover:
+            self._push_queue.put_nowait(item)
+        return consumer
 
     async def push_streams(self) -> AsyncIterator[PushStream]:
         """Async iterator over accepted inbound pushes; terminates on node

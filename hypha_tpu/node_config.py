@@ -1,0 +1,295 @@
+"""Per-node configuration schemas (the four binaries' Config structs).
+
+Reference: each binary's config module — crates/worker/src/config.rs (the
+richest: resources, offer pricing, executor table), crates/scheduler/src/
+scheduler_config.rs (the DiLoCo job), and the shared network/TLS/telemetry
+sections every binary carries. ``init`` emits these as documented TOML
+(config crate ``to_toml``); ``run`` layers TOML ← HYPHA_* env ← CLI.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any
+
+from .config import ConfigError, TLSConfig
+from .messages import Adam, LRScheduler, LRSchedulerKind, ModelType, Nesterov, PriceRange
+from .resources import Resources
+from .scheduler.job_config import DiLoCoJob, DiLoCoRounds, JobResources
+
+__all__ = [
+    "NetworkConfig",
+    "TelemetryConfig",
+    "GatewayConfig",
+    "DataNodeConfig",
+    "WorkerConfig",
+    "SchedulerConfig",
+    "ResourcesConfig",
+    "OfferConfigSection",
+    "ExecutorSection",
+    "JobSection",
+]
+
+
+@dataclass
+class NetworkConfig:
+    listen: list[str] = field(
+        default_factory=lambda: ["127.0.0.1:0"],
+        metadata={"doc": "addresses to listen on (host:port; port 0 = ephemeral)"},
+    )
+    external: list[str] = field(
+        default_factory=list,
+        metadata={"doc": "publicly reachable addresses to advertise"},
+    )
+    gateways: list[str] = field(
+        default_factory=list,
+        metadata={"doc": "gateway addresses to bootstrap from"},
+    )
+    exclude_cidrs: list[str] = field(
+        default_factory=list,
+        metadata={"doc": "CIDR ranges never dialed (scheduler network.rs CIDR exclusion)"},
+    )
+
+
+@dataclass
+class TelemetryConfig:
+    """OTLP export settings (crates/telemetry; OTEL_* env overrides win)."""
+
+    endpoint: str = field(default="", metadata={"doc": "OTLP endpoint; empty = disabled"})
+    protocol: str = field(default="http", metadata={"doc": "otlp protocol: http | grpc"})
+    service_name: str = field(default="", metadata={"doc": "service.name resource attribute"})
+    sample_ratio: float = field(default=1.0, metadata={"doc": "trace sampling ratio 0..1"})
+    attributes: dict = field(
+        default_factory=dict, metadata={"doc": "extra resource attributes (k = v)"}
+    )
+
+    def validate(self) -> None:
+        if self.protocol not in ("http", "grpc"):
+            raise ConfigError(f"telemetry.protocol: unknown {self.protocol!r}")
+        if not 0.0 <= self.sample_ratio <= 1.0:
+            raise ConfigError("telemetry.sample_ratio must be in [0, 1]")
+
+
+@dataclass
+class ResourcesConfig:
+    """Sellable capacity (crates/worker config resources section)."""
+
+    tpu: float = field(default=0.0, metadata={"doc": "TPU chips in this worker's slice"})
+    gpu: float = field(default=0.0, metadata={"doc": "GPUs (reference compatibility)"})
+    cpu: float = field(default=1.0, metadata={"doc": "CPU cores"})
+    memory: float = field(default=1024.0, metadata={"doc": "memory in MB"})
+    storage: float = field(default=0.0, metadata={"doc": "scratch storage in MB"})
+
+    def to_resources(self) -> Resources:
+        return Resources(
+            tpu=self.tpu, gpu=self.gpu, cpu=self.cpu,
+            memory=self.memory, storage=self.storage,
+        )
+
+
+@dataclass
+class OfferConfigSection:
+    """Auction pricing (crates/worker/src/config.rs:54-104)."""
+
+    price: float = field(default=1.0, metadata={"doc": "asking price per weighted unit"})
+    floor: float = field(default=0.0, metadata={"doc": "reject ads bidding below this"})
+    strategy: str = field(
+        default="flexible",
+        metadata={"doc": "flexible = offer what was asked; whole = offer everything"},
+    )
+
+    def validate(self) -> None:
+        if self.strategy not in ("flexible", "whole"):
+            raise ConfigError(f"offer.strategy: unknown {self.strategy!r}")
+
+
+@dataclass
+class ExecutorSection:
+    """Train-executor runtime (crates/worker/src/config.rs:114-191)."""
+
+    runtime: str = field(
+        default="in-process",
+        metadata={"doc": "in-process (JAX in the worker) | process (spawn cmd)"},
+    )
+    cmd: str = field(default="", metadata={"doc": "command for runtime=process"})
+    args: list[str] = field(
+        default_factory=list,
+        metadata={"doc": "args; {SOCKET_PATH} {WORK_DIR} {JOB_JSON} substituted"},
+    )
+
+    def validate(self) -> None:
+        if self.runtime not in ("in-process", "process"):
+            raise ConfigError(f"executor.runtime: unknown {self.runtime!r}")
+        if self.runtime == "process" and not self.cmd:
+            raise ConfigError("executor.runtime=process needs executor.cmd")
+
+
+@dataclass
+class GatewayConfig:
+    name: str = field(default="gateway", metadata={"doc": "node name (cert CN)"})
+    network: NetworkConfig = field(default_factory=NetworkConfig)
+    tls: TLSConfig = field(default_factory=TLSConfig)
+    telemetry: TelemetryConfig = field(default_factory=TelemetryConfig)
+
+    def validate(self) -> None:
+        self.tls.validate_files()
+        self.telemetry.validate()
+
+
+@dataclass
+class DataNodeConfig:
+    name: str = field(default="data", metadata={"doc": "node name (cert CN)"})
+    datasets: dict = field(
+        default_factory=dict,
+        metadata={"doc": "dataset name = directory of SafeTensors slice files"},
+    )
+    network: NetworkConfig = field(default_factory=NetworkConfig)
+    tls: TLSConfig = field(default_factory=TLSConfig)
+    telemetry: TelemetryConfig = field(default_factory=TelemetryConfig)
+
+    def validate(self) -> None:
+        if not self.datasets:
+            raise ConfigError("data node needs at least one [datasets] entry")
+        self.tls.validate_files()
+        self.telemetry.validate()
+
+
+@dataclass
+class WorkerConfig:
+    name: str = field(default="worker", metadata={"doc": "node name (cert CN)"})
+    work_root: str = field(default="/tmp", metadata={"doc": "per-job work dirs live here"})
+    resources: ResourcesConfig = field(default_factory=ResourcesConfig)
+    offer: OfferConfigSection = field(default_factory=OfferConfigSection)
+    executor: ExecutorSection = field(default_factory=ExecutorSection)
+    network: NetworkConfig = field(default_factory=NetworkConfig)
+    tls: TLSConfig = field(default_factory=TLSConfig)
+    telemetry: TelemetryConfig = field(default_factory=TelemetryConfig)
+
+    def validate(self) -> None:
+        self.offer.validate()
+        self.executor.validate()
+        self.tls.validate_files()
+        self.telemetry.validate()
+        if self.resources.to_resources().is_zero():
+            raise ConfigError("worker resources are all zero — nothing to sell")
+
+
+@dataclass
+class JobSection:
+    """The DiLoCo job (crates/scheduler/src/scheduler_config.rs:18-180)."""
+
+    # Default job mirrors the reference's (scheduler_config.rs:79-102:
+    # 2 workers, 100 rounds, 1200 samples/round, LeNet/MNIST).
+    dataset: str = field(
+        default="mnist", metadata={"doc": "dataset name announced by a data node"}
+    )
+    model_family: str = field(
+        default="lenet", metadata={"doc": "gpt2 | llama | mixtral | lenet"}
+    )
+    model_preset: str = field(default="", metadata={"doc": "named preset, e.g. small"})
+    model_config: dict = field(
+        default_factory=dict, metadata={"doc": "model config overrides"}
+    )
+    model_seed: int = field(default=0, metadata={"doc": "init seed (same on all workers)"})
+    model_type: str = field(
+        default="image-classification",
+        metadata={"doc": "ModelType selector (38 variants)"},
+    )
+    update_rounds: int = field(default=100, metadata={"doc": "outer rounds"})
+    avg_samples_between_updates: int = field(
+        default=1200, metadata={"doc": "round sample budget"}
+    )
+    max_batch_size: int = field(default=600, metadata={"doc": "per-worker batch cap"})
+    num_workers: int = field(default=2, metadata={"doc": "DiLoCo replicas to buy"})
+    inner_lr: float = field(default=1e-4, metadata={"doc": "AdamW learning rate"})
+    inner_weight_decay: float = field(default=0.0, metadata={"doc": "AdamW weight decay"})
+    outer_lr: float = field(default=0.7, metadata={"doc": "Nesterov outer LR"})
+    outer_momentum: float = field(default=0.9, metadata={"doc": "Nesterov momentum"})
+    lr_schedule: str = field(
+        default="constant",
+        metadata={"doc": "constant | cosine-with-warmup | linear-with-warmup | wsd"},
+    )
+    warmup_steps: int = field(default=0, metadata={"doc": "LR warmup steps"})
+    total_steps: int = field(default=0, metadata={"doc": "LR schedule horizon"})
+    worker_tpu: float = field(default=1.0, metadata={"doc": "chips required per replica"})
+    worker_cpu: float = field(default=1.0, metadata={"doc": "cores required per replica"})
+    worker_memory: float = field(default=100.0, metadata={"doc": "MB required per replica"})
+    ps_cpu: float = field(default=1.0, metadata={"doc": "cores for the parameter server"})
+    ps_memory: float = field(default=100.0, metadata={"doc": "MB for the parameter server"})
+    worker_bid: float = field(default=1.0, metadata={"doc": "auction bid per worker"})
+    worker_max_price: float = field(default=10.0, metadata={"doc": "auction price cap"})
+    sharding: dict = field(
+        default_factory=dict,
+        metadata={"doc": "intra-replica mesh axes: dp/fsdp/tp/sp/ep = n"},
+    )
+
+    def validate(self) -> None:
+        if not self.dataset:
+            raise ConfigError("job.dataset is required")
+        try:
+            ModelType(self.model_type)
+        except ValueError:
+            raise ConfigError(f"job.model_type: unknown {self.model_type!r}")
+        try:
+            LRSchedulerKind(self.lr_schedule)
+        except ValueError:
+            raise ConfigError(f"job.lr_schedule: unknown {self.lr_schedule!r}")
+
+    def to_job(self) -> DiLoCoJob:
+        model: dict[str, Any] = {
+            "model_type": ModelType(self.model_type),
+            "family": self.model_family,
+            "seed": self.model_seed,
+        }
+        if self.model_preset:
+            model["preset"] = self.model_preset
+        if self.model_config:
+            model["config"] = dict(self.model_config)
+        schedule = None
+        if self.lr_schedule != "constant":
+            schedule = LRScheduler(
+                kind=LRSchedulerKind(self.lr_schedule),
+                warmup_steps=self.warmup_steps,
+                total_steps=self.total_steps,
+            )
+        return DiLoCoJob(
+            model=model,
+            dataset=self.dataset,
+            rounds=DiLoCoRounds(
+                update_rounds=self.update_rounds,
+                avg_samples_between_updates=self.avg_samples_between_updates,
+                max_batch_size=self.max_batch_size,
+            ),
+            inner_optimizer=Adam(lr=self.inner_lr, weight_decay=self.inner_weight_decay),
+            outer_optimizer=Nesterov(lr=self.outer_lr, momentum=self.outer_momentum),
+            resources=JobResources(
+                num_workers=self.num_workers,
+                worker=Resources(
+                    tpu=self.worker_tpu, cpu=self.worker_cpu, memory=self.worker_memory
+                ),
+                parameter_server=Resources(cpu=self.ps_cpu, memory=self.ps_memory),
+                worker_price=PriceRange(bid=self.worker_bid, max=self.worker_max_price),
+                parameter_server_price=PriceRange(
+                    bid=self.worker_bid, max=self.worker_max_price
+                ),
+            ),
+            lr_scheduler=schedule,
+            sharding=dict(self.sharding) or None,
+        )
+
+
+@dataclass
+class SchedulerConfig:
+    name: str = field(default="scheduler", metadata={"doc": "node name (cert CN)"})
+    status_bridge: str = field(
+        default="", metadata={"doc": "AIM metrics sink host:port; empty = log only"}
+    )
+    job: JobSection = field(default_factory=JobSection)
+    network: NetworkConfig = field(default_factory=NetworkConfig)
+    tls: TLSConfig = field(default_factory=TLSConfig)
+    telemetry: TelemetryConfig = field(default_factory=TelemetryConfig)
+
+    def validate(self) -> None:
+        self.job.validate()
+        self.tls.validate_files()
+        self.telemetry.validate()

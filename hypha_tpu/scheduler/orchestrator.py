@@ -27,11 +27,13 @@ import uuid
 
 from .. import messages
 from ..messages import (
+    AGGREGATE_EXECUTOR_NAME,
     PROTOCOL_PROGRESS,
+    TRAIN_EXECUTOR_NAME,
+    AggregateExecutorConfig,
     DataRecord,
     Executor,
     ExecutorDescriptor,
-    AggregateExecutorConfig,
     Fetch,
     JobSpec,
     Progress,
@@ -54,10 +56,6 @@ from .worker_handle import WorkerHandle
 __all__ = ["Orchestrator", "JobResult", "JobFailed", "AllocationError"]
 
 log = logging.getLogger("hypha.scheduler.orchestrator")
-
-# Reference executor names (hypha-scheduler.rs:47-48).
-TRAIN_EXECUTOR_NAME = "diloco-transformer"
-AGGREGATE_EXECUTOR_NAME = "parameter-server"
 
 
 class AllocationError(RuntimeError):
@@ -212,6 +210,7 @@ class Orchestrator:
 
             complete = asyncio.Event()
             collected: list = []
+            activity = [asyncio.get_running_loop().time()]  # watchdog feed
 
             def on_metrics(peer: str, round_num: int, metrics: dict) -> None:
                 collected.append((peer, round_num, metrics))
@@ -222,6 +221,7 @@ class Orchestrator:
             )
 
             async def on_progress(peer: str, progress: Progress):
+                activity[0] = asyncio.get_running_loop().time()
                 return batch_scheduler.on_progress(peer, progress)
 
             progress_reg = self.node.on(PROTOCOL_PROGRESS, Progress).respond_with(
@@ -231,6 +231,11 @@ class Orchestrator:
             router = StatusRouter(self.node)
             base_id = str(uuid.uuid4())
             worker_peers = [h.peer_id for h in handles]
+            # Job-unique stream tags: push routing keys on these, so several
+            # jobs (or a PS colocated with a train job) can share worker
+            # nodes without consuming each other's tensor streams.
+            updates_tag = f"updates:{base_id}"
+            results_tag = f"results:{base_id}"
 
             ps_task = await Task.dispatch(
                 self.node,
@@ -242,10 +247,10 @@ class Orchestrator:
                         name=AGGREGATE_EXECUTOR_NAME,
                         aggregate=AggregateExecutorConfig(
                             updates=Receive(
-                                Reference.from_peers(worker_peers, "updates")
+                                Reference.from_peers(worker_peers, updates_tag)
                             ),
                             results=Send(
-                                Reference.from_peers(worker_peers, "results")
+                                Reference.from_peers(worker_peers, results_tag)
                             ),
                             optimizer=job.outer_optimizer,
                             num_workers=len(worker_peers),
@@ -269,10 +274,10 @@ class Orchestrator:
                                 )
                             ),
                             updates=Send(
-                                Reference.from_peers([ps_handle.peer_id], "updates")
+                                Reference.from_peers([ps_handle.peer_id], updates_tag)
                             ),
                             results=Receive(
-                                Reference.from_peers([ps_handle.peer_id], "results")
+                                Reference.from_peers([ps_handle.peer_id], results_tag)
                             ),
                             optimizer=job.inner_optimizer,
                             batch_size=handle.batch_size,
@@ -292,6 +297,7 @@ class Orchestrator:
                 handles + [ps_handle],
                 train_tasks + [ps_task],
                 status_timeout,
+                activity,
             )
             return JobResult(base_id, tracker.round, collected)
         finally:
@@ -313,9 +319,12 @@ class Orchestrator:
         handles: list[WorkerHandle],
         tasks: list[Task],
         status_timeout: float,
+        activity: list[float] | None = None,
     ) -> None:
         """Wait for completion; abort on worker failure or failed status
-        (hypha-scheduler.rs:372-412 select loop)."""
+        (hypha-scheduler.rs:372-412 select loop). ``status_timeout`` is a
+        no-PROGRESS watchdog: it resets on every progress message, so a
+        long but steadily-reporting job is never killed."""
 
         async def watch_statuses() -> str:
             async def one(task: Task) -> str:
@@ -346,17 +355,24 @@ class Orchestrator:
             waiters[
                 asyncio.create_task(_await_failure(handle), name="worker")
             ] = "worker"
+        loop = asyncio.get_running_loop()
         try:
-            done, _ = await asyncio.wait(
-                waiters, timeout=status_timeout, return_when=asyncio.FIRST_COMPLETED
-            )
-            if not done:
-                raise JobFailed(f"job made no progress in {status_timeout}s")
-            first = next(iter(done))
-            kind = waiters[first]
-            if kind == "complete":
-                return
-            raise JobFailed(str(first.result()))
+            while True:
+                last = activity[0] if activity else loop.time()
+                remaining = (last + status_timeout) - loop.time()
+                if remaining <= 0:
+                    raise JobFailed(f"no progress in {status_timeout}s")
+                done, _ = await asyncio.wait(
+                    waiters,
+                    timeout=min(remaining, 5.0),
+                    return_when=asyncio.FIRST_COMPLETED,
+                )
+                if not done:
+                    continue  # re-check the watchdog, keep waiting
+                first = next(iter(done))
+                if waiters[first] == "complete":
+                    return
+                raise JobFailed(str(first.result()))
         finally:
             for t in waiters:
                 t.cancel()

@@ -80,6 +80,7 @@ class Bridge:
         self.socket_path = self.work_dir / "bridge.sock"
         self._server: asyncio.base_events.Server | None = None
         self._send_tasks: set[asyncio.Task] = set()
+        self._conn_tasks: set[asyncio.Task] = set()
 
     async def start(self) -> Path:
         self.work_dir.mkdir(parents=True, exist_ok=True, mode=0o700)
@@ -96,9 +97,14 @@ class Bridge:
         # Stop accepting first, so no new sends can start behind the drain.
         if self._server is not None:
             self._server.close()
+        # Sever live connections (idle keep-alives, parked SSE receives):
+        # wait_closed would otherwise block on them forever.
+        for task in list(self._conn_tasks):
+            task.cancel()
+        if self._server is not None:
             try:
-                await self._server.wait_closed()
-            except asyncio.CancelledError:
+                await asyncio.wait_for(self._server.wait_closed(), 10.0)
+            except (asyncio.TimeoutError, asyncio.CancelledError):
                 pass
         # Drain in-flight background sends — the executor's final
         # pseudo-gradient is typically still uploading when it exits.
@@ -123,6 +129,13 @@ class Bridge:
     async def _handle(
         self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter
     ) -> None:
+        # Track the handler task: Python 3.12's Server.wait_closed() blocks
+        # until every handler returns, so stop() must be able to cancel
+        # handlers parked on an idle keep-alive read or a blocked SSE.
+        task = asyncio.current_task()
+        if task is not None:
+            self._conn_tasks.add(task)
+            task.add_done_callback(self._conn_tasks.discard)
         # HTTP/1.1 keep-alive: the executor's per-batch status heartbeats
         # ride one connection (the reference's httpx Session does the same).
         try:

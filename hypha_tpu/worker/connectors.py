@@ -187,24 +187,41 @@ class Connector:
         self, receive: Receive, dest_dir: Path
     ) -> AsyncIterator[ReceivedFile]:
         """Yield files as they land from allowed peers; unknown senders are
-        drained and dropped (connector/mod.rs:305-433 receiver filter)."""
+        drained and dropped (connector/mod.rs:305-433 receiver filter).
+
+        Routed: when the Receive reference carries a resource tag, only
+        pushes with that tag are consumed — other consumers on the same node
+        (another job's bridge, a parameter-server loop) keep theirs.
+        """
         allowed = set(receive.ref.peers or [])
+        tag = receive.ref.resource
+
+        def wants(push: PushStream) -> bool:
+            if tag is None:
+                return True  # untagged receive: legacy catch-all
+            r = push.resource
+            return isinstance(r, dict) and r.get("resource") == tag
+
         dest_dir.mkdir(parents=True, exist_ok=True)
-        async for push in self.node.push_streams():
-            try:
-                if allowed and push.peer not in allowed:
-                    log.warning("dropping push from disallowed peer %s", push.peer)
-                    await push.read_all()  # drain to release the accept slot
-                    continue
-                resource, name = _push_names(push)
-                dest = dest_dir / f"{_safe_name(push.peer + '-' + name)}.bin"
-                size = await push.save_to(dest)
-            except asyncio.CancelledError:
-                # Consumer went away mid-transfer: release the accept slot so
-                # the sender's connection isn't pinned forever.
-                push.finish()
-                raise
-            yield ReceivedFile(dest, size, push.peer, resource)
+        consumer = self.node.consume_pushes(wants)
+        try:
+            async for push in consumer:
+                try:
+                    if allowed and push.peer not in allowed:
+                        log.warning("dropping push from disallowed peer %s", push.peer)
+                        await push.read_all()  # drain to release the accept slot
+                        continue
+                    resource, name = _push_names(push)
+                    dest = dest_dir / f"{_safe_name(push.peer + '-' + name)}.bin"
+                    size = await push.save_to(dest)
+                except asyncio.CancelledError:
+                    # Consumer went away mid-transfer: release the accept slot
+                    # so the sender's connection isn't pinned forever.
+                    push.finish()
+                    raise
+                yield ReceivedFile(dest, size, push.peer, resource)
+        finally:
+            consumer.close()
 
 
 def _push_names(push: PushStream) -> tuple[str, str]:

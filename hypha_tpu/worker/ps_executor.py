@@ -88,10 +88,23 @@ class ParameterServerExecutor(JobExecutor):
         lr, mu = cfg.optimizer.lr, cfg.optimizer.momentum
         momentum: dict[str, np.ndarray] = {}
         round_num = 0
+        # Routed consumer: only this job's pseudo-gradients (matched on the
+        # Receive reference's resource tag) reach this loop, so a colocated
+        # train job's bridge — or another PS job — never eats our deltas.
+        tag = cfg.updates.ref.resource
+
+        def wants(push) -> bool:
+            r = push.resource
+            return (
+                isinstance(r, dict)
+                and (tag is None or r.get("resource") == tag)
+            )
+
+        consumer = self.node.consume_pushes(wants)
         try:
             while True:
                 received = await self._collect_round(
-                    job_id, allowed, num_workers, work_dir, round_num
+                    consumer, job_id, allowed, num_workers, work_dir, round_num
                 )
                 update_path = self._outer_step(
                     received, momentum, lr, mu, work_dir, round_num
@@ -116,10 +129,12 @@ class ParameterServerExecutor(JobExecutor):
             log.exception("parameter server job %s failed", job_id)
             execution.finish("failed", str(e))
         finally:
+            consumer.close()
             shutil.rmtree(work_dir, ignore_errors=True)
 
     async def _collect_round(
         self,
+        consumer,
         job_id: str,
         allowed: set[str],
         num_workers: int,
@@ -129,7 +144,7 @@ class ParameterServerExecutor(JobExecutor):
         """Gather one pseudo-gradient per worker: peer -> (path, samples)."""
         received: dict[str, tuple[Path, float]] = {}
         while len(received) < num_workers:
-            push = await self.node.next_push()
+            push = await consumer.next()
             peer = push.peer
             if allowed and peer not in allowed:
                 log.warning("ps %s: push from disallowed peer %s", job_id, peer)
@@ -211,7 +226,11 @@ class ParameterServerExecutor(JobExecutor):
         are tolerated — the worker can catch up next round (:265-268)."""
         peers = cfg.results.ref.peers or []
         strategy = cfg.results.ref.strategy or TransferStrategy.ALL
-        header = {"resource": "results", "name": update_path.name, "round": round_num}
+        header = {
+            "resource": cfg.results.ref.resource or "results",
+            "name": update_path.name,
+            "round": round_num,
+        }
         for peer in peers:
             try:
                 await self.node.push(peer, header, update_path)

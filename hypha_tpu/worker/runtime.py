@@ -25,6 +25,7 @@ import logging
 from pathlib import Path
 
 from ..health import serve_health
+from ..messages import AGGREGATE_EXECUTOR_NAME, TRAIN_EXECUTOR_NAME
 from ..network.fabric import Transport
 from ..network.node import Node
 from ..resources import Resources
@@ -39,10 +40,6 @@ from .train_executor import InProcessTrainExecutor
 __all__ = ["WorkerNode", "TRAIN_EXECUTOR_NAME", "AGGREGATE_EXECUTOR_NAME"]
 
 log = logging.getLogger("hypha.worker")
-
-# Reference executor names (crates/scheduler/src/bin/hypha-scheduler.rs:47-48).
-TRAIN_EXECUTOR_NAME = "diloco-transformer"
-AGGREGATE_EXECUTOR_NAME = "parameter-server"
 
 
 class WorkerNode:
@@ -59,9 +56,11 @@ class WorkerNode:
         train_args: list[str] | None = None,
         work_root: Path | str = "/tmp",
         max_batches: int | None = None,
+        node: Node | None = None,
         **node_kwargs,
     ) -> None:
-        self.node = Node(transport, peer_id=peer_id, **node_kwargs)
+        # ``node`` injection: the CLI passes an mTLS-secured Node.
+        self.node = node or Node(transport, peer_id=peer_id, **node_kwargs)
         self.resource_manager = StaticResourceManager(resources)
         self.lease_manager = LeaseManager(self.resource_manager)
         work_root = Path(work_root)

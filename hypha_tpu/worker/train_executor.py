@@ -19,6 +19,7 @@ from __future__ import annotations
 import asyncio
 import logging
 import shutil
+import threading
 import uuid
 from dataclasses import dataclass, field
 from pathlib import Path
@@ -52,15 +53,36 @@ class InProcessTrainExecutor(JobExecutor):
         )
         socket_path = await bridge.start()
         execution = Execution(job_id)
+        stop_flag = threading.Event()
         runner = asyncio.create_task(
-            self._run(execution, spec, socket_path, work_dir, bridge)
+            self._run(execution, spec, socket_path, work_dir, bridge, stop_flag)
         )
 
         async def cancel() -> None:
-            runner.cancel()
+            # Cooperative: the training thread polls the flag between
+            # batches. Cancelling the awaiting task alone would leave the
+            # thread computing while the work dir is deleted under it.
+            stop_flag.set()
             try:
-                await runner
-            except (asyncio.CancelledError, Exception):
+                await asyncio.wait_for(asyncio.shield(runner), timeout=5.0)
+            except asyncio.TimeoutError:
+                # The thread may be parked in a bridge call (e.g. the SSE
+                # receive awaiting a PS broadcast) where the flag is never
+                # polled; severing the bridge unblocks it with an error.
+                await bridge.stop()
+                try:
+                    await asyncio.wait_for(asyncio.shield(runner), timeout=55.0)
+                except asyncio.TimeoutError:
+                    log.warning(
+                        "job %s did not stop cooperatively; abandoning thread",
+                        spec.job_id,
+                    )
+                    runner.cancel()
+                    try:
+                        await runner
+                    except (asyncio.CancelledError, Exception):
+                        pass
+            except Exception:
                 pass
             execution.finish("cancelled")
 
@@ -74,6 +96,7 @@ class InProcessTrainExecutor(JobExecutor):
         socket_path: Path,
         work_dir: Path,
         bridge: Bridge,
+        stop_flag: threading.Event,
     ) -> None:
         from ..executor.bridge_client import Session
         from ..executor.training import run_training
@@ -81,7 +104,11 @@ class InProcessTrainExecutor(JobExecutor):
         def blocking() -> None:
             with Session(str(socket_path)) as session:
                 run_training(
-                    session, work_dir, spec, max_batches=self.max_batches
+                    session,
+                    work_dir,
+                    spec,
+                    max_batches=self.max_batches,
+                    should_stop=stop_flag.is_set,
                 )
 
         try:
@@ -89,12 +116,15 @@ class InProcessTrainExecutor(JobExecutor):
             # it runs in a worker thread while the bridge serves it from this
             # event loop.
             await asyncio.to_thread(blocking)
-            execution.finish("completed")
+            execution.finish("cancelled" if stop_flag.is_set() else "completed")
         except asyncio.CancelledError:
             raise
         except Exception as e:
-            log.exception("in-process training job %s failed", spec.job_id)
-            execution.finish("failed", str(e))
+            if stop_flag.is_set():
+                execution.finish("cancelled")
+            else:
+                log.exception("in-process training job %s failed", spec.job_id)
+                execution.finish("failed", str(e))
         finally:
             await bridge.stop()
             if not self.keep_work_dir:

@@ -1,0 +1,145 @@
+"""Config subsystem tests: layering precedence, provenance, validation,
+documented TOML emit (reference: crates/config test coverage, SURVEY.md §4)."""
+
+from __future__ import annotations
+
+import tomllib
+
+import pytest
+
+from hypha_tpu.config import (
+    ConfigError,
+    TLSConfig,
+    builder,
+    to_toml,
+)
+from hypha_tpu.node_config import (
+    DataNodeConfig,
+    GatewayConfig,
+    SchedulerConfig,
+    WorkerConfig,
+)
+
+
+def test_defaults_build_without_layers():
+    built = builder(WorkerConfig).build().validate()
+    assert built.value.offer.price == 1.0
+    assert built.find_metadata("offer.price").source == "default"
+
+
+def test_toml_layer_sets_values_with_provenance(tmp_path):
+    p = tmp_path / "w.toml"
+    p.write_text("name = 'w7'\n[offer]\nprice = 2.5\n")
+    built = builder(WorkerConfig).with_toml(p).build().validate()
+    assert built.value.name == "w7"
+    assert built.value.offer.price == 2.5
+    assert built.find_metadata("offer.price").source == f"file:{p}"
+    assert built.find_metadata("offer.floor").source == "default"
+
+
+def test_env_overrides_toml_and_cli_overrides_env(tmp_path, monkeypatch):
+    p = tmp_path / "w.toml"
+    p.write_text("[offer]\nprice = 2.5\nfloor = 0.5\n")
+    monkeypatch.setenv("HYPHA_OFFER__PRICE", "3.5")
+    built = (
+        builder(WorkerConfig)
+        .with_toml(p)
+        .with_env("HYPHA_")
+        .with_overrides({"offer.price": 9.0})
+        .build()
+        .validate()
+    )
+    assert built.value.offer.price == 9.0  # cli wins
+    assert built.value.offer.floor == 0.5  # toml survives
+    assert built.find_metadata("offer.price").source == "cli"
+
+    built2 = builder(WorkerConfig).with_toml(p).with_env("HYPHA_").build()
+    assert built2.value.offer.price == 3.5  # env beats toml
+    assert built2.find_metadata("offer.price").source == "env:HYPHA_OFFER__PRICE"
+
+
+def test_env_coercion_types(monkeypatch):
+    monkeypatch.setenv("HYPHA_RESOURCES__TPU", "8")
+    monkeypatch.setenv("HYPHA_NETWORK__GATEWAYS", "a:1,b:2")
+    built = builder(WorkerConfig).with_env("HYPHA_").build()
+    assert built.value.resources.tpu == 8.0
+    assert built.value.network.gateways == ["a:1", "b:2"]
+
+
+def test_unknown_key_rejected(tmp_path):
+    p = tmp_path / "w.toml"
+    p.write_text("turbo = true\n")
+    with pytest.raises(ConfigError, match="unknown config key"):
+        builder(WorkerConfig).with_toml(p).build()
+
+
+def test_bad_type_points_at_source(tmp_path):
+    p = tmp_path / "w.toml"
+    p.write_text("[offer]\nprice = 'cheap'\n")
+    with pytest.raises(ConfigError, match=r"offer\.price.*file:"):
+        builder(WorkerConfig).with_toml(p).build()
+
+
+def test_validate_hooks_fire():
+    built = builder(WorkerConfig).with_overrides({"offer.strategy": "greedy"}).build()
+    with pytest.raises(ConfigError, match="offer.strategy"):
+        built.validate()
+    built2 = builder(WorkerConfig).with_overrides(
+        {"executor.runtime": "process"}
+    ).build()
+    with pytest.raises(ConfigError, match="executor.cmd"):
+        built2.validate()
+
+
+def test_tls_validation_missing_files():
+    built = builder(GatewayConfig).with_overrides(
+        {"tls.cert": "/nope.crt", "tls.key": "/nope.key", "tls.trust": "/nope.ca"}
+    ).build()
+    with pytest.raises(ConfigError, match="no such file"):
+        built.validate()
+    assert TLSConfig().enabled() is False
+
+
+@pytest.mark.parametrize(
+    "schema", [GatewayConfig, WorkerConfig, SchedulerConfig, DataNodeConfig]
+)
+def test_to_toml_round_trips_through_builder(schema, tmp_path):
+    """init's emitted TOML must parse and rebuild to an equal config."""
+    conf = schema()
+    if schema is DataNodeConfig:
+        conf.datasets = {"mnist": str(tmp_path)}
+    text = to_toml(conf)
+    # valid TOML with comments
+    parsed = tomllib.loads(text)
+    assert parsed["name"] == conf.name
+    p = tmp_path / "emitted.toml"
+    p.write_text(text)
+    rebuilt = builder(schema).with_toml(p).build().value
+    assert rebuilt == conf
+    assert "#" in text  # doc comments present
+
+
+def test_scheduler_job_section_to_job():
+    built = builder(SchedulerConfig).with_overrides(
+        {
+            "job.dataset": "toy",
+            "job.model_family": "gpt2",
+            "job.model_type": "causal-lm",
+            "job.num_workers": 3,
+            "job.update_rounds": 5,
+            "job.lr_schedule": "wsd",
+            "job.total_steps": 100,
+        }
+    ).build().validate()
+    job = built.value.job.to_job()
+    assert job.dataset == "toy"
+    assert job.resources.num_workers == 3
+    assert job.rounds.update_rounds == 5
+    assert job.model["family"] == "gpt2"
+    assert job.lr_scheduler is not None and job.lr_scheduler.total_steps == 100
+
+
+def test_scheduler_job_validation():
+    built = builder(SchedulerConfig).with_overrides({"job.model_type": "bogus"}).build()
+    with pytest.raises(ConfigError, match="model_type"):
+        built.validate()

@@ -184,3 +184,51 @@ def test_diloco_heterogeneous_batch_sizing(tmp_path):
     result, seen = run(main())
     assert result.rounds == 1
     assert seen == {4.0: 4, 2.0: 2}, seen
+
+
+@pytest.mark.slow
+def test_diloco_ps_colocated_with_train_worker(tmp_path):
+    """No dedicated PS peer: the parameter server lands on a train worker.
+    Routed push consumers (job-unique resource tags) keep the colocated PS
+    loop and the train job's receive from eating each other's streams."""
+
+    async def main():
+        hub = MemoryTransport()
+        gw = Gateway(hub.shared(), peer_id="gw")
+        await gw.start()
+        boot = [gw.node.listen_addrs[0]]
+        data = DataNode(
+            hub.shared(), {"toy": make_dataset(tmp_path)}, peer_id="data",
+            bootstrap=boot,
+        )
+        await data.start()
+        workers = []
+        for name in ("w0", "w1"):
+            # flexible: each train lease takes only what the ad asked for,
+            # leaving capacity so one of them can also sell the PS lease.
+            w = WorkerNode(
+                hub.shared(),
+                resources=Resources(tpu=4, cpu=8, memory=1000),
+                peer_id=name,
+                offer=OfferConfig(strategy="flexible"),
+                bootstrap=boot,
+                work_root=tmp_path / name,
+            )
+            await w.start()
+            workers.append(w)
+        sched = Node(hub.shared(), peer_id="sched", bootstrap=boot)
+        await sched.start()
+        await sched.wait_for_bootstrap()
+        orch = Orchestrator(sched)
+        try:
+            result = await orch.run(diloco_job(rounds=1), auction_timeout=1.5)
+        finally:
+            for w in workers:
+                await w.stop()
+            await data.stop()
+            await sched.stop()
+            await gw.stop()
+        return result
+
+    result = run(main())
+    assert result.rounds == 1

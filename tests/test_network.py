@@ -468,3 +468,54 @@ def test_tcp_rpc_and_push():
         await a.stop(); await b.stop()
 
     run(main())
+
+
+def test_push_consumer_routing_and_reclaim():
+    """Routed push consumers: tagged pushes go to their consumer; pushes that
+    arrived before registration are reclaimed from the default queue."""
+    import asyncio
+
+    from hypha_tpu.network import MemoryTransport, Node
+
+    async def main():
+        hub = MemoryTransport()
+        a = Node(hub.shared(), peer_id="a")
+        b = Node(hub.shared(), peer_id="b")
+        await a.start()
+        await b.start()
+        b.add_peer_addr("a", a.listen_addrs[0])
+
+        # Pre-registration push lands on the default queue...
+        await b.push("a", {"resource": "updates:j1", "name": "x"}, b"early")
+        # ...and is reclaimed when the matching consumer registers.
+        c1 = a.consume_pushes(
+            lambda p: isinstance(p.resource, dict)
+            and p.resource.get("resource") == "updates:j1"
+        )
+        early = await asyncio.wait_for(c1.next(), 5)
+        assert (await early.read_all()) == b"early"
+
+        c2 = a.consume_pushes(
+            lambda p: isinstance(p.resource, dict)
+            and p.resource.get("resource") == "results:j1"
+        )
+        await b.push("a", {"resource": "results:j1", "name": "y"}, b"res")
+        await b.push("a", {"resource": "updates:j1", "name": "z"}, b"upd")
+        await b.push("a", {"resource": "untagged", "name": "w"}, b"other")
+        got_res = await asyncio.wait_for(c2.next(), 5)
+        assert (await got_res.read_all()) == b"res"
+        got_upd = await asyncio.wait_for(c1.next(), 5)
+        assert (await got_upd.read_all()) == b"upd"
+        # unmatched push falls through to the default queue
+        other = await a.next_push(timeout=5)
+        assert (await other.read_all()) == b"other"
+        c1.close()
+        c2.close()
+        # after close, tagged pushes fall back to the default queue
+        await b.push("a", {"resource": "updates:j1", "name": "q"}, b"late")
+        late = await a.next_push(timeout=5)
+        assert (await late.read_all()) == b"late"
+        await b.stop()
+        await a.stop()
+
+    asyncio.run(asyncio.wait_for(main(), 30))
