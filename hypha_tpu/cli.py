@@ -104,6 +104,22 @@ def _make_node(conf, *, registry_server: bool = False, peer_id: str | None = Non
     return node
 
 
+def _telemetry_for(conf, node=None):
+    """Provider bundle from the config's telemetry section; OTEL_* env wins
+    (reference wiring: hypha-scheduler.rs:55-94, docs/worker.md:188-218)."""
+    from .telemetry import init_telemetry, instrument_node
+
+    telemetry = init_telemetry(
+        service_name=conf.telemetry.service_name or f"hypha-{conf.name}",
+        endpoint=conf.telemetry.endpoint,
+        sample_ratio=conf.telemetry.sample_ratio,
+        attributes=conf.telemetry.attributes,
+    )
+    if node is not None:
+        instrument_node(telemetry.meter("hypha.node"), node)
+    return telemetry
+
+
 async def _serve_until_signal(*stoppables) -> None:
     stop = asyncio.Event()
     loop = asyncio.get_running_loop()
@@ -160,9 +176,13 @@ async def _run_gateway(conf: GatewayConfig) -> None:
     from .gateway import Gateway
 
     gw = Gateway(None, node=_make_node(conf, registry_server=True))
-    await gw.start(list(conf.network.listen))
-    print(f"gateway {gw.peer_id} on {gw.node.listen_addrs}", flush=True)
-    await _serve_until_signal(gw)
+    telemetry = _telemetry_for(conf, gw.node)
+    try:
+        await gw.start(list(conf.network.listen))
+        print(f"gateway {gw.peer_id} on {gw.node.listen_addrs}", flush=True)
+        await _serve_until_signal(gw)
+    finally:
+        telemetry.shutdown()
 
 
 async def _run_data(conf: DataNodeConfig) -> None:
@@ -173,9 +193,13 @@ async def _run_data(conf: DataNodeConfig) -> None:
         {name: Path(p) for name, p in conf.datasets.items()},
         node=_make_node(conf),
     )
-    await dn.start(list(conf.network.listen))
-    print(f"data node {dn.peer_id} on {dn.node.listen_addrs}", flush=True)
-    await _serve_until_signal(dn)
+    telemetry = _telemetry_for(conf, dn.node)
+    try:
+        await dn.start(list(conf.network.listen))
+        print(f"data node {dn.peer_id} on {dn.node.listen_addrs}", flush=True)
+        await _serve_until_signal(dn)
+    finally:
+        telemetry.shutdown()
 
 
 async def _run_worker(conf: WorkerConfig) -> None:
@@ -195,9 +219,13 @@ async def _run_worker(conf: WorkerConfig) -> None:
         work_root=conf.work_root,
         node=node,
     )
-    await worker.start(list(conf.network.listen))
-    print(f"worker {worker.peer_id} on {worker.node.listen_addrs}", flush=True)
-    await _serve_until_signal(worker)
+    telemetry = _telemetry_for(conf, worker.node)
+    try:
+        await worker.start(list(conf.network.listen))
+        print(f"worker {worker.peer_id} on {worker.node.listen_addrs}", flush=True)
+        await _serve_until_signal(worker)
+    finally:
+        telemetry.shutdown()
 
 
 async def _run_scheduler(conf: SchedulerConfig) -> None:
@@ -205,6 +233,8 @@ async def _run_scheduler(conf: SchedulerConfig) -> None:
     from .scheduler.orchestrator import Orchestrator
 
     node = _make_node(conf)
+    telemetry = _telemetry_for(conf, node)
+    tracer = telemetry.tracer("hypha.scheduler")
     await node.start(list(conf.network.listen))
     print(f"scheduler {node.peer_id} on {node.listen_addrs}", flush=True)
     try:
@@ -213,10 +243,12 @@ async def _run_scheduler(conf: SchedulerConfig) -> None:
             AimConnector(conf.status_bridge) if conf.status_bridge else NoOpConnector()
         )
         orch = Orchestrator(node, metrics_connector=connector)
-        result = await orch.run(conf.job.to_job())
+        with tracer.span("run_job", {"dataset": conf.job.dataset}):
+            result = await orch.run(conf.job.to_job())
         print(f"job {result.job_id} completed: {result.rounds} rounds", flush=True)
     finally:
         await node.stop()
+        telemetry.shutdown()
 
 
 _RUNNERS = {

@@ -1,0 +1,184 @@
+"""Checkpoint/resume: persist training state across executor restarts.
+
+The reference has NO system-level checkpointing (SURVEY.md §5: job state
+lives in tmp work dirs deleted on job end; scheduler restart loses the
+pool — called out as future work in rfc/2025-08-04). This module is the
+net-new capability BASELINE.md's preemption config requires:
+
+  * train side — params + optimizer state + round counter, written
+    atomically (tmp + rename) every N rounds; an executor re-dispatched
+    after preemption resumes from the last completed round instead of
+    θ₀;
+  * parameter-server side — the Nesterov momentum buffers, so the outer
+    optimizer's trajectory survives a PS restart (the reference keeps
+    momentum in a tmp file that dies with the job,
+    parameter_server.rs:392-397).
+
+Format: SafeTensors for tensors (stable tree-path names via
+executor.serialization) + a JSON manifest — readable by the C++ runtime
+and any SafeTensors tool, no pickle.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import tempfile
+from pathlib import Path
+from typing import Any
+
+import numpy as np
+
+from .serialization import flatten_tree, load_flat, save_tree, unflatten_like
+
+__all__ = [
+    "save_train_checkpoint",
+    "load_train_checkpoint",
+    "save_momentum",
+    "load_momentum",
+]
+
+log = logging.getLogger("hypha.executor.checkpoint")
+
+_MANIFEST = "manifest.json"
+_PARAMS = "params.safetensors"
+_OPT = "opt_state.safetensors"
+_MOMENTUM = "momentum.safetensors"
+_LATEST = "LATEST"
+_KEEP_VERSIONS = 2
+
+
+def _atomic_write(path: Path, write_fn) -> None:
+    """Write one file via tmp + rename so it is never observed torn."""
+    path.parent.mkdir(parents=True, exist_ok=True)
+    fd, tmp = tempfile.mkstemp(dir=path.parent, prefix=path.name + ".tmp")
+    os.close(fd)
+    try:
+        write_fn(Path(tmp))
+        os.replace(tmp, path)
+    except BaseException:
+        Path(tmp).unlink(missing_ok=True)
+        raise
+
+
+def save_train_checkpoint(
+    directory: str | Path,
+    params: Any,
+    opt_state: Any,
+    step: int,
+    round_num: int,
+    extra: dict | None = None,
+) -> Path:
+    """Persist one train checkpoint, atomically as a WHOLE.
+
+    The three files are staged into a fresh version subdir, the subdir is
+    renamed into place, and only then does the ``LATEST`` pointer flip —
+    so a crash at any instant leaves either the previous complete
+    checkpoint or the new complete one, never params from round N+1 paired
+    with round-N optimizer state.
+    """
+    import jax
+
+    directory = Path(directory)
+    directory.mkdir(parents=True, exist_ok=True)
+    version = f"v{round_num:08d}-{step}"
+    staging = Path(
+        tempfile.mkdtemp(dir=directory, prefix=".staging-")
+    )
+    try:
+        save_tree(staging / _PARAMS, jax.device_get(params))
+        save_tree(staging / _OPT, jax.device_get(opt_state))
+        manifest = {
+            "version": 1,
+            "step": int(step),
+            "round": int(round_num),
+            "extra": extra or {},
+        }
+        (staging / _MANIFEST).write_text(json.dumps(manifest))
+        target = directory / version
+        if target.exists():  # re-save of the same round: replace wholesale
+            _rmtree(target)
+        os.replace(staging, target)
+    except BaseException:
+        _rmtree(staging)
+        raise
+    _atomic_write(directory / _LATEST, lambda p: p.write_text(version))
+    _prune_versions(directory, keep=_KEEP_VERSIONS)
+    log.info("checkpoint saved to %s/%s (round %d)", directory, version, round_num)
+    return directory / version
+
+
+def _rmtree(path: Path) -> None:
+    import shutil
+
+    shutil.rmtree(path, ignore_errors=True)
+
+
+def _prune_versions(directory: Path, keep: int) -> None:
+    versions = sorted(
+        (p for p in directory.iterdir() if p.is_dir() and p.name.startswith("v")),
+        key=lambda p: p.name,
+    )
+    for old in versions[:-keep]:
+        _rmtree(old)
+
+
+def load_train_checkpoint(
+    directory: str | Path, params_template: Any, opt_template: Any
+) -> tuple[Any, Any, int, int, dict] | None:
+    """Restore (params, opt_state, step, round, extra) or None if absent.
+
+    Templates define tree structure and expected shapes; a checkpoint for
+    a different model fails loudly instead of silently mis-restoring.
+    """
+    directory = Path(directory)
+    pointer = directory / _LATEST
+    if not pointer.is_file():
+        return None
+    target = directory / pointer.read_text().strip()
+    manifest_path = target / _MANIFEST
+    if not manifest_path.is_file():
+        raise ValueError(f"checkpoint pointer {pointer} names missing {target}")
+    manifest = json.loads(manifest_path.read_text())
+    if manifest.get("version") != 1:
+        raise ValueError(f"unknown checkpoint version {manifest.get('version')}")
+    params = unflatten_like(load_flat(target / _PARAMS), params_template)
+    opt_state = unflatten_like(load_flat(target / _OPT), opt_template)
+    return (
+        params,
+        opt_state,
+        int(manifest["step"]),
+        int(manifest["round"]),
+        manifest.get("extra", {}),
+    )
+
+
+def save_momentum(directory: str | Path, momentum: dict[str, np.ndarray]) -> Path:
+    directory = Path(directory)
+    _atomic_write(directory / _MOMENTUM, lambda p: save_tree(p, dict(momentum)))
+    return directory
+
+
+def load_momentum(directory: str | Path) -> dict[str, np.ndarray] | None:
+    path = Path(directory) / _MOMENTUM
+    if not path.is_file():
+        return None
+    return dict(load_flat(path))
+
+
+def latest_manifest(directory: str | Path) -> dict | None:
+    """The LATEST version's manifest, or None (tooling/test helper)."""
+    directory = Path(directory)
+    pointer = directory / _LATEST
+    if not pointer.is_file():
+        return None
+    manifest = directory / pointer.read_text().strip() / _MANIFEST
+    if not manifest.is_file():
+        return None
+    return json.loads(manifest.read_text())
+
+
+def opt_state_template_names(opt_state: Any) -> list[str]:
+    """Stable names an optimizer state flattens to (debug/test helper)."""
+    return sorted(flatten_tree(opt_state))

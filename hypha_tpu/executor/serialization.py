@@ -68,10 +68,15 @@ def unflatten_like(flat: dict[str, np.ndarray], like: Any) -> Any:
         if name not in flat:
             raise KeyError(f"missing tensor {name!r} (have {len(flat)} tensors)")
         arr = flat[name]
-        if tuple(arr.shape) != tuple(np.shape(leaf)):
-            raise ValueError(
-                f"tensor {name!r}: shape {arr.shape} != expected {np.shape(leaf)}"
-            )
+        expected = tuple(np.shape(leaf))
+        if tuple(arr.shape) != expected:
+            # SafeTensors has no rank-0 tensors; scalars round-trip as (1,).
+            if arr.size == 1 and int(np.prod(expected, dtype=np.int64)) == 1:
+                arr = arr.reshape(expected)
+            else:
+                raise ValueError(
+                    f"tensor {name!r}: shape {arr.shape} != expected {expected}"
+                )
         leaves.append(arr)
     return jax.tree_util.tree_unflatten(treedef, leaves)
 
@@ -84,9 +89,10 @@ def save_tree(path: Path | str, tree: Any) -> Path:
         flat = dict(tree)
     else:
         flat = flatten_tree(tree)
-    # SafeTensors rejects non-contiguous / bf16-via-numpy edge cases; go
-    # through ascontiguousarray once here rather than at every call site.
-    flat = {k: np.ascontiguousarray(v) for k, v in flat.items()}
+    # SafeTensors rejects non-contiguous arrays and rank-0 tensors; normalize
+    # once here rather than at every call site (scalars restore via
+    # unflatten_like's shape-1 tolerance).
+    flat = {k: np.ascontiguousarray(np.atleast_1d(v)) for k, v in flat.items()}
     save_file(flat, str(path))
     return Path(path)
 

@@ -165,6 +165,30 @@ def run_training(
 
     tx = build_optimizer(cfg.optimizer, cfg.scheduler)
     state = TrainState.create(params, tx)
+
+    # Resume (net-new vs reference): a re-dispatched executor picks up the
+    # last completed round's params + optimizer state instead of θ₀.
+    ckpt_dir = None
+    ckpt_every = 1
+    round_offset = 0  # completed rounds restored from a checkpoint
+    if cfg.checkpoint and cfg.checkpoint.get("dir"):
+        from .checkpoint import load_train_checkpoint, save_train_checkpoint
+
+        ckpt_every = int(cfg.checkpoint.get("every_rounds", 1))
+        if ckpt_every > 0:  # <= 0 disables checkpointing
+            ckpt_dir = Path(cfg.checkpoint["dir"])
+            restored = load_train_checkpoint(ckpt_dir, state.params, state.opt_state)
+            if restored is not None:
+                r_params, r_opt, r_step, r_round, _extra = restored
+                state = state.replace(
+                    params=r_params, opt_state=r_opt, step=jnp.int32(r_step)
+                )
+                round_offset = r_round
+                log.info(
+                    "resumed from %s: step %d, %d completed rounds",
+                    ckpt_dir, r_step, r_round,
+                )
+
     loss_kind = cfg.loss or Loss.CROSS_ENTROPY
     step = make_train_step(model.apply, loss_kind, causal_lm=causal_lm, has_aux=has_aux)
 
@@ -243,6 +267,16 @@ def run_training(
         result.rounds = round_num
         round_samples = 0
         round_losses.clear()
+        if ckpt_dir is not None and round_num % ckpt_every == 0:
+            # Manifest round counts CUMULATIVE completed rounds across
+            # resumes, not just this execution's.
+            save_train_checkpoint(
+                ckpt_dir,
+                state.params,
+                state.opt_state,
+                int(state.step),
+                round_offset + round_num,
+            )
         return resp.kind == ProgressResponseKind.CONTINUE
 
     t0 = time.monotonic()

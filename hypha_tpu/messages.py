@@ -441,6 +441,9 @@ class TrainExecutorConfig:
     # (SURVEY.md §2.8 "TPU-native equivalents"). Axis sizes over the replica's
     # slice mesh; {} means single-chip.
     sharding: dict | None = None  # {"dp": n, "fsdp": n, "tp": n, "sp": n, "ep": n}
+    # Net-new vs reference (SURVEY.md §5 "Checkpoint/resume: none"):
+    # {"dir": str, "every_rounds": int} — resume across executor restarts.
+    checkpoint: dict | None = None
 
 
 @register
@@ -452,6 +455,9 @@ class AggregateExecutorConfig:
     results: Send
     optimizer: Nesterov
     num_workers: int = 0  # how many pseudo-gradients form one round
+    # Net-new: persist Nesterov momentum across PS restarts (the reference
+    # keeps it in a tmp file that dies with the job, parameter_server.rs:392).
+    checkpoint_dir: str | None = None
 
 
 @register

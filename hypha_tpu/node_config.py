@@ -64,8 +64,13 @@ class TelemetryConfig:
     )
 
     def validate(self) -> None:
-        if self.protocol not in ("http", "grpc"):
-            raise ConfigError(f"telemetry.protocol: unknown {self.protocol!r}")
+        if self.protocol != "http":
+            # Only OTLP/HTTP+JSON is implemented; accepting "grpc" here would
+            # silently export nothing (the exporter would POST JSON at a gRPC
+            # port and drop every failure).
+            raise ConfigError(
+                f"telemetry.protocol: only 'http' is supported, got {self.protocol!r}"
+            )
         if not 0.0 <= self.sample_ratio <= 1.0:
             raise ConfigError("telemetry.sample_ratio must be in [0, 1]")
 
@@ -222,6 +227,12 @@ class JobSection:
         default_factory=dict,
         metadata={"doc": "intra-replica mesh axes: dp/fsdp/tp/sp/ep = n"},
     )
+    checkpoint_dir: str = field(
+        default="", metadata={"doc": "resume checkpoints under this dir; empty = off"}
+    )
+    checkpoint_every: int = field(
+        default=1, metadata={"doc": "checkpoint every N completed rounds"}
+    )
 
     def validate(self) -> None:
         if not self.dataset:
@@ -275,6 +286,8 @@ class JobSection:
             ),
             lr_scheduler=schedule,
             sharding=dict(self.sharding) or None,
+            checkpoint_dir=self.checkpoint_dir or None,
+            checkpoint_every=self.checkpoint_every,
         )
 
 

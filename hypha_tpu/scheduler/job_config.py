@@ -62,6 +62,11 @@ class DiLoCoJob:
     loss: Loss | None = None
     # TPU-native: intra-replica mesh axes for the inner loop ({} = one chip).
     sharding: dict | None = None
+    # Net-new checkpoint/resume: workers save under
+    # <checkpoint_dir>/<peer_id>, the PS under <checkpoint_dir>/ps (paths are
+    # per-host). Unset checkpoint_dir — or checkpoint_every <= 0 — disables.
+    checkpoint_dir: str | None = None
+    checkpoint_every: int = 1
 
     def __post_init__(self) -> None:
         if self.rounds.update_rounds <= 0:

@@ -254,6 +254,11 @@ class Orchestrator:
                             ),
                             optimizer=job.outer_optimizer,
                             num_workers=len(worker_peers),
+                            checkpoint_dir=(
+                                f"{job.checkpoint_dir}/ps"
+                                if job.checkpoint_dir
+                                else None
+                            ),
                         ),
                     ),
                 ),
@@ -285,6 +290,14 @@ class Orchestrator:
                             scheduler=job.lr_scheduler,
                             loss=job.loss,
                             sharding=job.sharding,
+                            checkpoint=(
+                                {
+                                    "dir": f"{job.checkpoint_dir}/{handle.peer_id}",
+                                    "every_rounds": job.checkpoint_every,
+                                }
+                                if job.checkpoint_dir
+                                else None
+                            ),
                         ),
                     ),
                 )

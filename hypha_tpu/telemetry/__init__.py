@@ -1,0 +1,333 @@
+"""Telemetry: tracing spans, metrics and OTLP export — self-contained.
+
+Role parity with the reference's ``hypha-telemetry`` crate
+(crates/telemetry/src/{tracing,logging,metrics}.rs + bandwidth.rs):
+
+  * every binary wires providers at startup from config, with standard
+    ``OTEL_*`` environment variables taking precedence
+    (docs/worker.md:188-218; ``Env::prefixed("OTEL_")``);
+  * traces use a parent-based ratio sampler;
+  * metrics export on a 1-second interval (the binaries' setting);
+  * transport bandwidth is instrumented per node
+    (``hypha.bandwidth.inbound.bytes``/``outbound.bytes``).
+
+The OTEL SDK is not available in this environment, so the subsystem is
+implemented natively: spans/instruments record in-process and export over
+OTLP/HTTP+JSON (the standard ``/v1/traces`` / ``/v1/metrics`` endpoints)
+when an endpoint is configured; otherwise recording still works (tests
+read it back via an injected exporter) and export is a no-op.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import contextvars
+import logging as _pylog
+import os
+import random
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Any, Callable
+
+from .attributes import parse_attributes
+from .otlp import OtlpJsonExporter
+
+__all__ = [
+    "Telemetry",
+    "Tracer",
+    "Span",
+    "Meter",
+    "Counter",
+    "Histogram",
+    "init_telemetry",
+    "instrument_node",
+    "parse_attributes",
+    "OtlpJsonExporter",
+]
+
+log = _pylog.getLogger("hypha.telemetry")
+
+# Reference binaries export metrics every second
+# (crates/scheduler/src/bin/hypha-scheduler.rs metric reader interval).
+METRIC_EXPORT_INTERVAL_S = 1.0
+
+_current_span: contextvars.ContextVar["Span | None"] = contextvars.ContextVar(
+    "hypha_current_span", default=None
+)
+
+
+def _rand_id(nbytes: int) -> str:
+    return "".join(f"{random.getrandbits(8):02x}" for _ in range(nbytes))
+
+
+@dataclass
+class Span:
+    name: str
+    trace_id: str
+    span_id: str
+    parent_id: str | None
+    start_ns: int
+    attributes: dict[str, Any] = field(default_factory=dict)
+    end_ns: int | None = None
+    status_ok: bool = True
+    sampled: bool = True
+
+    def set_attribute(self, key: str, value: Any) -> None:
+        self.attributes[key] = value
+
+    def record_error(self, err: BaseException) -> None:
+        self.status_ok = False
+        self.attributes["error.type"] = type(err).__name__
+        self.attributes["error.message"] = str(err)
+
+
+class Tracer:
+    def __init__(self, scope: str, telemetry: "Telemetry") -> None:
+        self.scope = scope
+        self._telemetry = telemetry
+
+    @contextlib.contextmanager
+    def span(self, name: str, attributes: dict | None = None):
+        """Start a span as a child of the context's current span.
+
+        Sampling is parent-based with a configured ratio for roots
+        (docs/worker.md:195-199 ``parentbased_traceidratio``)."""
+        parent = _current_span.get()
+        if parent is not None:
+            trace_id = parent.trace_id
+            parent_id = parent.span_id
+            sampled = parent.sampled
+        else:
+            trace_id = _rand_id(16)
+            parent_id = None
+            sampled = random.random() < self._telemetry.sample_ratio
+        span = Span(
+            name=name,
+            trace_id=trace_id,
+            span_id=_rand_id(8),
+            parent_id=parent_id,
+            start_ns=time.time_ns(),
+            attributes=dict(attributes or {}),
+            sampled=sampled,
+        )
+        token = _current_span.set(span)
+        try:
+            yield span
+        except BaseException as e:
+            span.record_error(e)
+            raise
+        finally:
+            span.end_ns = time.time_ns()
+            _current_span.reset(token)
+            if span.sampled:
+                self._telemetry._record_span(self.scope, span)
+
+
+class Counter:
+    """Monotonic sum instrument."""
+
+    def __init__(self, name: str, unit: str = "") -> None:
+        self.name = name
+        self.unit = unit
+        self._value = 0.0
+        self._lock = threading.Lock()
+
+    def add(self, amount: float, **_attrs) -> None:
+        if amount < 0:
+            raise ValueError("counter increments must be non-negative")
+        with self._lock:
+            self._value += amount
+
+    def value(self) -> float:
+        with self._lock:
+            return self._value
+
+
+class Histogram:
+    """Fixed-boundary histogram instrument."""
+
+    DEFAULT_BOUNDS = (1, 5, 10, 50, 100, 500, 1000, 5000, 10000)
+
+    def __init__(self, name: str, unit: str = "", bounds: tuple = DEFAULT_BOUNDS):
+        self.name = name
+        self.unit = unit
+        self.bounds = tuple(bounds)
+        self._counts = [0] * (len(self.bounds) + 1)
+        self._sum = 0.0
+        self._count = 0
+        self._lock = threading.Lock()
+
+    def record(self, value: float, **_attrs) -> None:
+        with self._lock:
+            self._sum += value
+            self._count += 1
+            for i, b in enumerate(self.bounds):
+                if value <= b:
+                    self._counts[i] += 1
+                    return
+            self._counts[-1] += 1
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {
+                "sum": self._sum,
+                "count": self._count,
+                "bucket_counts": list(self._counts),
+                "bounds": list(self.bounds),
+            }
+
+
+class Meter:
+    def __init__(self, scope: str, telemetry: "Telemetry") -> None:
+        self.scope = scope
+        self._telemetry = telemetry
+
+    def counter(self, name: str, unit: str = "") -> Counter:
+        return self._telemetry._instrument(self.scope, name, lambda: Counter(name, unit))
+
+    def histogram(self, name: str, unit: str = "", bounds=Histogram.DEFAULT_BOUNDS) -> Histogram:
+        return self._telemetry._instrument(
+            self.scope, name, lambda: Histogram(name, unit, bounds)
+        )
+
+    def observable_gauge(self, name: str, callback: Callable[[], float], unit: str = "") -> None:
+        self._telemetry._gauges[(self.scope, name)] = (callback, unit)
+
+
+class Telemetry:
+    """Provider bundle: tracers, meters, the export loop, shutdown.
+
+    The reference initializes three OTLP providers per binary
+    (hypha-scheduler.rs:55-94); here one object owns all three concerns.
+    """
+
+    def __init__(
+        self,
+        service_name: str = "hypha",
+        endpoint: str = "",
+        sample_ratio: float = 1.0,
+        attributes: dict | None = None,
+        exporter=None,
+        export_interval: float = METRIC_EXPORT_INTERVAL_S,
+    ) -> None:
+        self.service_name = service_name
+        self.sample_ratio = sample_ratio
+        self.resource = {"service.name": service_name, **(attributes or {})}
+        self.exporter = exporter or (
+            OtlpJsonExporter(endpoint, self.resource) if endpoint else None
+        )
+        self._instruments: dict[tuple[str, str], Any] = {}
+        self._gauges: dict[tuple[str, str], tuple[Callable[[], float], str]] = {}
+        self._spans: list[tuple[str, Span]] = []
+        self._lock = threading.Lock()
+        self._export_interval = export_interval
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+        if self.exporter is not None:
+            self._thread = threading.Thread(
+                target=self._export_loop, name="hypha-telemetry", daemon=True
+            )
+            self._thread.start()
+
+    # -- factories ----------------------------------------------------------
+    def tracer(self, scope: str) -> Tracer:
+        return Tracer(scope, self)
+
+    def meter(self, scope: str) -> Meter:
+        return Meter(scope, self)
+
+    # -- recording ----------------------------------------------------------
+    def _instrument(self, scope: str, name: str, factory):
+        key = (scope, name)
+        with self._lock:
+            inst = self._instruments.get(key)
+            if inst is None:
+                inst = factory()
+                self._instruments[key] = inst
+            return inst
+
+    def _record_span(self, scope: str, span: Span) -> None:
+        with self._lock:
+            self._spans.append((scope, span))
+            # Bound memory if no exporter drains the buffer.
+            if len(self._spans) > 4096:
+                del self._spans[: len(self._spans) - 4096]
+
+    # -- export -------------------------------------------------------------
+    def _drain(self) -> tuple[list, dict, dict]:
+        with self._lock:
+            spans = self._spans
+            self._spans = []
+            instruments = dict(self._instruments)
+        gauges = {k: (cb(), unit) for k, (cb, unit) in list(self._gauges.items())}
+        return spans, instruments, gauges
+
+    def flush(self) -> None:
+        if self.exporter is None:
+            return
+        spans, instruments, gauges = self._drain()
+        try:
+            if spans:
+                self.exporter.export_spans(spans)
+            self.exporter.export_metrics(instruments, gauges)
+        except Exception as e:  # export must never break the node
+            log.warning("telemetry export failed: %s", e)
+
+    def _export_loop(self) -> None:
+        while not self._stop.wait(self._export_interval):
+            self.flush()
+
+    def shutdown(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+        self.flush()
+
+    # -- test/introspection --------------------------------------------------
+    def finished_spans(self) -> list[tuple[str, Span]]:
+        with self._lock:
+            return list(self._spans)
+
+
+def init_telemetry(
+    service_name: str = "hypha",
+    endpoint: str = "",
+    sample_ratio: float = 1.0,
+    attributes: str | dict | None = None,
+    exporter=None,
+) -> Telemetry:
+    """Build the provider bundle; standard ``OTEL_*`` env vars win over the
+    passed config (reference: ``Env::prefixed("OTEL_")`` layered last)."""
+    endpoint = os.environ.get("OTEL_EXPORTER_OTLP_ENDPOINT", endpoint)
+    service_name = os.environ.get("OTEL_SERVICE_NAME", service_name)
+    ratio_env = os.environ.get("OTEL_TRACES_SAMPLER_ARG")
+    if ratio_env:
+        try:
+            sample_ratio = float(ratio_env)
+        except ValueError:
+            log.warning("bad OTEL_TRACES_SAMPLER_ARG %r ignored", ratio_env)
+    attrs = parse_attributes(attributes) if isinstance(attributes, str) else dict(attributes or {})
+    env_attrs = os.environ.get("OTEL_RESOURCE_ATTRIBUTES")
+    if env_attrs:
+        attrs.update(parse_attributes(env_attrs))
+    return Telemetry(
+        service_name=service_name,
+        endpoint=endpoint,
+        sample_ratio=sample_ratio,
+        attributes=attrs,
+        exporter=exporter,
+    )
+
+
+def instrument_node(meter: Meter, node) -> None:
+    """Bandwidth instrumentation: observable counters over the node's
+    transport byte counters (the reference wraps the muxer —
+    crates/telemetry/src/bandwidth.rs:30-62; our fabric counts in
+    _CountingStream and the frame layer)."""
+    meter.observable_gauge(
+        "hypha.bandwidth.inbound.bytes", lambda: float(node.bytes_in), unit="By"
+    )
+    meter.observable_gauge(
+        "hypha.bandwidth.outbound.bytes", lambda: float(node.bytes_out), unit="By"
+    )

@@ -87,6 +87,14 @@ class ParameterServerExecutor(JobExecutor):
             return
         lr, mu = cfg.optimizer.lr, cfg.optimizer.momentum
         momentum: dict[str, np.ndarray] = {}
+        ckpt_dir = Path(cfg.checkpoint_dir) if cfg.checkpoint_dir else None
+        if ckpt_dir is not None:
+            from ..executor.checkpoint import load_momentum
+
+            saved = load_momentum(ckpt_dir)
+            if saved is not None:
+                momentum.update(saved)
+                log.info("ps %s: momentum restored from %s", job_id, ckpt_dir)
         round_num = 0
         # Routed consumer: only this job's pseudo-gradients (matched on the
         # Receive reference's resource tag) reach this loop, so a colocated
@@ -109,6 +117,10 @@ class ParameterServerExecutor(JobExecutor):
                 update_path = self._outer_step(
                     received, momentum, lr, mu, work_dir, round_num
                 )
+                if ckpt_dir is not None:
+                    from ..executor.checkpoint import save_momentum
+
+                    save_momentum(ckpt_dir, momentum)
                 # Notify BEFORE broadcasting: a worker can merge the update
                 # and send UpdateReceived the moment the broadcast lands, and
                 # the scheduler must already have advanced the round by then —
