@@ -24,8 +24,13 @@ log = logging.getLogger("hypha.aim_driver")
 
 class StatusSink:
     def __init__(self, out_path: str | Path | None = None) -> None:
+        from collections import deque
+
         self.out_path = Path(out_path) if out_path else None
-        self.received: list[dict] = []
+        # Bounded: a multi-day job posts metrics forever; keep the tail for
+        # introspection, count the rest.
+        self.received: "deque[dict]" = deque(maxlen=4096)
+        self.total = 0
         try:
             import aim  # type: ignore
 
@@ -34,7 +39,10 @@ class StatusSink:
             self._run = None
 
     def track(self, payload: dict) -> None:
+        if not isinstance(payload, dict):
+            raise TypeError(f"status payload must be an object, got {type(payload).__name__}")
         self.received.append(payload)
+        self.total += 1
         if self.out_path is not None:
             with open(self.out_path, "a") as f:
                 f.write(json.dumps(payload) + "\n")

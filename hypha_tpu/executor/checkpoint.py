@@ -9,9 +9,9 @@ net-new capability BASELINE.md's preemption config requires:
     atomically (tmp + rename) every N rounds; an executor re-dispatched
     after preemption resumes from the last completed round instead of
     θ₀;
-  * parameter-server side — the Nesterov momentum buffers, so the outer
-    optimizer's trajectory survives a PS restart (the reference keeps
-    momentum in a tmp file that dies with the job,
+  * parameter-server side — the PS persists its Nesterov momentum FILE
+    into the same checkpoint dir (ps_executor._checkpoint_momentum; the
+    reference keeps momentum in a tmp file that dies with the job,
     parameter_server.rs:392-397).
 
 Format: SafeTensors for tensors (stable tree-path names via
@@ -35,8 +35,7 @@ from .serialization import flatten_tree, load_flat, save_tree, unflatten_like
 __all__ = [
     "save_train_checkpoint",
     "load_train_checkpoint",
-    "save_momentum",
-    "load_momentum",
+    "latest_manifest",
 ]
 
 log = logging.getLogger("hypha.executor.checkpoint")
@@ -44,7 +43,6 @@ log = logging.getLogger("hypha.executor.checkpoint")
 _MANIFEST = "manifest.json"
 _PARAMS = "params.safetensors"
 _OPT = "opt_state.safetensors"
-_MOMENTUM = "momentum.safetensors"
 _LATEST = "LATEST"
 _KEEP_VERSIONS = 2
 
@@ -152,19 +150,6 @@ def load_train_checkpoint(
         int(manifest["round"]),
         manifest.get("extra", {}),
     )
-
-
-def save_momentum(directory: str | Path, momentum: dict[str, np.ndarray]) -> Path:
-    directory = Path(directory)
-    _atomic_write(directory / _MOMENTUM, lambda p: save_tree(p, dict(momentum)))
-    return directory
-
-
-def load_momentum(directory: str | Path) -> dict[str, np.ndarray] | None:
-    path = Path(directory) / _MOMENTUM
-    if not path.is_file():
-        return None
-    return dict(load_flat(path))
 
 
 def latest_manifest(directory: str | Path) -> dict | None:

@@ -1,12 +1,21 @@
-"""ctypes bindings for the native (C++) tensor kernels, with numpy fallback.
+"""ctypes bindings for the native (C++) runtime layer, with numpy fallback.
 
-The runtime's numerical hot spot outside JAX is the parameter server's
-outer step (SURVEY.md §2.9: the reference's only native math is Rust
-candle-core averaging + Nesterov). The C++ source lives in
-``native/hypha_ps.cpp``; it is compiled on first use with the system g++
-into ``native/build/libhypha_ps.so`` and cached. Environments without a
-toolchain transparently fall back to numpy — results are identical, the
-C++ path just fuses the passes.
+The reference's native layer is its Rust crates; the numerical hot spot is
+the parameter server's outer step (SURVEY.md §2.9: candle-core averaging +
+Nesterov over mmapped SafeTensors). The C++ equivalents live in
+``native/``:
+
+  * ``hypha_ps.cpp``          — flat f32 kernels (weighted sum, Nesterov,
+    fused mean+Nesterov);
+  * ``hypha_safetensors.cpp`` — mmap'd SafeTensors reader (own JSON header
+    parser), writer, and ``ps_outer_step``: the WHOLE outer step over the
+    delta files, zero-copy;
+  * ``hypha_io.cpp``          — sendfile(2) file→socket fast path for bulk
+    tensor serving (the data node's io::copy role, tensor_data.rs:8-16).
+
+Everything is compiled on first use with the system g++ into one shared
+library and cached. Environments without a toolchain transparently fall
+back to numpy/Python paths — results are identical.
 """
 
 from __future__ import annotations
@@ -18,13 +27,25 @@ from pathlib import Path
 
 import numpy as np
 
-__all__ = ["weighted_sum", "nesterov_update", "fused_mean_nesterov", "native_available"]
+__all__ = [
+    "weighted_sum",
+    "nesterov_update",
+    "fused_mean_nesterov",
+    "native_available",
+    "ps_outer_step",
+    "send_file_fd",
+    "SafeTensorsView",
+]
 
 log = logging.getLogger("hypha.native")
 
 _REPO = Path(__file__).resolve().parent.parent
-_SRC = _REPO / "native" / "hypha_ps.cpp"
-_SO = _REPO / "native" / "build" / "libhypha_ps.so"
+_SRCS = [
+    _REPO / "native" / "hypha_ps.cpp",
+    _REPO / "native" / "hypha_safetensors.cpp",
+    _REPO / "native" / "hypha_io.cpp",
+]
+_SO = _REPO / "native" / "build" / "libhypha_native.so"
 
 _lib: ctypes.CDLL | None = None
 _tried = False
@@ -38,16 +59,17 @@ def _load() -> ctypes.CDLL | None:
         return _lib
     _tried = True
     try:
-        if not _SO.exists() or _SO.stat().st_mtime < _SRC.stat().st_mtime:
+        newest_src = max(src.stat().st_mtime for src in _SRCS)
+        if not _SO.exists() or _SO.stat().st_mtime < newest_src:
             _SO.parent.mkdir(parents=True, exist_ok=True)
             subprocess.run(
                 [
-                    "g++", "-O3", "-march=native", "-shared", "-fPIC",
-                    str(_SRC), "-o", str(_SO),
+                    "g++", "-O3", "-march=native", "-std=c++17", "-shared",
+                    "-fPIC", *map(str, _SRCS), "-o", str(_SO),
                 ],
                 check=True,
                 capture_output=True,
-                timeout=120,
+                timeout=300,
             )
         lib = ctypes.CDLL(str(_SO))
         lib.weighted_sum_f32.argtypes = [
@@ -60,6 +82,27 @@ def _load() -> ctypes.CDLL | None:
             ctypes.POINTER(_F32P), _F32P, ctypes.c_int64,
             _F32P, _F32P, ctypes.c_int64, ctypes.c_float, ctypes.c_float,
         ]
+        lib.st_open.argtypes = [ctypes.c_char_p, ctypes.c_char_p, ctypes.c_int]
+        lib.st_open.restype = ctypes.c_void_p
+        lib.st_close.argtypes = [ctypes.c_void_p]
+        lib.st_count.argtypes = [ctypes.c_void_p]
+        lib.st_count.restype = ctypes.c_int64
+        lib.st_name.argtypes = [ctypes.c_void_p, ctypes.c_int64]
+        lib.st_name.restype = ctypes.c_char_p
+        lib.st_tensor.argtypes = [
+            ctypes.c_void_p, ctypes.c_char_p, ctypes.POINTER(ctypes.c_int64),
+            ctypes.c_char_p, ctypes.c_int, ctypes.POINTER(ctypes.c_int64),
+            ctypes.c_int, ctypes.POINTER(ctypes.c_int),
+        ]
+        lib.st_tensor.restype = ctypes.c_void_p
+        lib.ps_outer_step.argtypes = [
+            ctypes.POINTER(ctypes.c_char_p), ctypes.c_int64, _F32P,
+            ctypes.c_char_p, ctypes.c_char_p, ctypes.c_char_p,
+            ctypes.c_float, ctypes.c_float, ctypes.c_char_p, ctypes.c_int,
+        ]
+        lib.ps_outer_step.restype = ctypes.c_int64
+        lib.send_file_fd.argtypes = [ctypes.c_int, ctypes.c_char_p]
+        lib.send_file_fd.restype = ctypes.c_int64
         _lib = lib
     except (subprocess.SubprocessError, OSError, FileNotFoundError) as e:
         log.info("native kernels unavailable (%s); using numpy", e)
@@ -135,3 +178,131 @@ def fused_mean_nesterov(
         _ptr(m), _ptr(upd), m.size, lr, mu,
     )
     return m, upd
+
+
+# ---------------------------------------------------------------------------
+# Native SafeTensors + outer step + data-plane IO
+# ---------------------------------------------------------------------------
+
+_DTYPES = {
+    "F32": np.float32,
+    "F64": np.float64,
+    "F16": np.float16,
+    "I64": np.int64,
+    "I32": np.int32,
+    "I16": np.int16,
+    "I8": np.int8,
+    "U8": np.uint8,
+    "BOOL": np.bool_,
+}
+
+
+class SafeTensorsView:
+    """Zero-copy mmap'd SafeTensors reader over the native parser.
+
+    Tensors come back as numpy views into the mapping (read-only); the
+    mapping lives until close(). Raises OSError when the native library is
+    unavailable — callers fall back to safetensors.numpy.
+    """
+
+    def __init__(self, path: str | Path) -> None:
+        lib = _load()
+        if lib is None:
+            raise OSError("native library unavailable")
+        err = ctypes.create_string_buffer(256)
+        self._lib = lib
+        self._handle = lib.st_open(str(path).encode(), err, len(err))
+        if not self._handle:
+            raise ValueError(f"st_open({path}): {err.value.decode()}")
+
+    def keys(self) -> list[str]:
+        n = self._lib.st_count(self._handle)
+        return [self._lib.st_name(self._handle, i).decode() for i in range(n)]
+
+    def tensor(self, name: str) -> np.ndarray:
+        nbytes = ctypes.c_int64()
+        dtype_buf = ctypes.create_string_buffer(16)
+        shape = (ctypes.c_int64 * 16)()
+        ndim = ctypes.c_int()
+        ptr = self._lib.st_tensor(
+            self._handle, name.encode(), ctypes.byref(nbytes),
+            dtype_buf, len(dtype_buf), shape, 16, ctypes.byref(ndim),
+        )
+        if not ptr:
+            raise KeyError(name)
+        dtype = _DTYPES.get(dtype_buf.value.decode())
+        if dtype is None:
+            raise ValueError(f"unsupported dtype {dtype_buf.value!r} for {name}")
+        buf = (ctypes.c_char * nbytes.value).from_address(ptr)
+        arr = np.frombuffer(buf, dtype=dtype)
+        # The mapping is PROT_READ: an in-place write through a writable
+        # view would SIGSEGV, not raise. Make numpy enforce it.
+        arr.flags.writeable = False
+        dims = tuple(shape[i] for i in range(ndim.value))
+        return arr.reshape(dims)
+
+    def close(self) -> None:
+        if self._handle:
+            self._lib.st_close(self._handle)
+            self._handle = None
+
+    def __enter__(self) -> "SafeTensorsView":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+
+def ps_outer_step(
+    delta_paths: list[str | Path],
+    weights: np.ndarray,
+    momentum_in: str | Path | None,
+    momentum_out: str | Path,
+    update_out: str | Path,
+    lr: float,
+    mu: float,
+) -> int | None:
+    """The whole DiLoCo outer step in C++ over mmapped delta files.
+
+    Returns total elements processed, or None when the native library is
+    unavailable (caller falls back to the Python path). Raises ValueError
+    on malformed/mismatched inputs.
+    """
+    lib = _load()
+    if lib is None:
+        return None
+    paths = [str(p).encode() for p in delta_paths]
+    arr = (ctypes.c_char_p * len(paths))(*paths)
+    w = _as_f32(np.asarray(weights)).ravel()
+    if w.size != len(paths):
+        raise ValueError("one weight per delta file required")
+    err = ctypes.create_string_buffer(256)
+    total = lib.ps_outer_step(
+        arr,
+        len(paths),
+        _ptr(w),
+        str(momentum_in).encode() if momentum_in else b"",
+        str(momentum_out).encode(),
+        str(update_out).encode(),
+        lr,
+        mu,
+        err,
+        len(err),
+    )
+    if total < 0:
+        raise ValueError(f"ps_outer_step failed: {err.value.decode()}")
+    return int(total)
+
+
+def send_file_fd(fd: int, path: str | Path) -> int | None:
+    """sendfile(2) loop: file -> connected socket fd. Returns bytes sent,
+    None if the native library is unavailable. Raises OSError on errno."""
+    lib = _load()
+    if lib is None:
+        return None
+    n = lib.send_file_fd(fd, str(path).encode())
+    if n < 0:
+        import os
+
+        raise OSError(-n, os.strerror(-n), str(path))
+    return int(n)

@@ -241,6 +241,14 @@ class _TcpStream(Stream):
         except ConnectionError:
             pass
 
+    def sendfile_transport(self):
+        """The underlying transport, for ``loop.sendfile`` (kernel zero-copy
+        file→socket on plain TCP; asyncio falls back internally under TLS)."""
+        return self._writer.transport
+
+    async def drain(self) -> None:
+        await self._writer.drain()
+
     def peer_certificate(self) -> dict | None:
         ssl_obj = self._writer.get_extra_info("ssl_object")
         return ssl_obj.getpeercert() if ssl_obj else None

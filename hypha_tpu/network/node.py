@@ -871,6 +871,16 @@ class Node:
             return len(data)
         if isinstance(source, str) or hasattr(source, "__fspath__"):
             loop = asyncio.get_running_loop()
+            # Zero-copy fast path (the data node's hot serve loop, reference
+            # tensor_data.rs:8-16 io::copy): kernel sendfile on plain TCP;
+            # asyncio streams the fallback itself under TLS.
+            transport = getattr(stream, "sendfile_transport", lambda: None)()
+            if transport is not None:
+                try:
+                    with open(source, "rb") as f:
+                        return await loop.sendfile(transport, f, fallback=True)
+                except (AttributeError, NotImplementedError, RuntimeError):
+                    pass  # transport without sendfile support: chunked copy
             total = 0
             with open(source, "rb") as f:
                 while True:

@@ -260,7 +260,14 @@ class Telemetry:
             spans = self._spans
             self._spans = []
             instruments = dict(self._instruments)
-        gauges = {k: (cb(), unit) for k, (cb, unit) in list(self._gauges.items())}
+        gauges = {}
+        for key, (cb, unit) in list(self._gauges.items()):
+            try:
+                gauges[key] = (cb(), unit)
+            except Exception as e:
+                # A raising gauge callback (e.g. reading state mid-teardown)
+                # must not kill the export thread or mask shutdown errors.
+                log.warning("observable gauge %s raised: %s", key, e)
         return spans, instruments, gauges
 
     def flush(self) -> None:

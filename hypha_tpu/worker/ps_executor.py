@@ -26,6 +26,7 @@ from __future__ import annotations
 import asyncio
 import hashlib
 import logging
+import os
 import shutil
 import uuid
 from pathlib import Path
@@ -86,15 +87,16 @@ class ParameterServerExecutor(JobExecutor):
             execution.finish("failed", "aggregate config names no workers")
             return
         lr, mu = cfg.optimizer.lr, cfg.optimizer.momentum
-        momentum: dict[str, np.ndarray] = {}
+        # Momentum lives as a SafeTensors FILE (like the reference,
+        # parameter_server.rs:392-397) so the native C++ outer step can mmap
+        # it; the checkpoint dir keeps a copy across PS restarts (net-new).
+        momentum_file = work_dir / "momentum.safetensors"
         ckpt_dir = Path(cfg.checkpoint_dir) if cfg.checkpoint_dir else None
         if ckpt_dir is not None:
-            from ..executor.checkpoint import load_momentum
-
-            saved = load_momentum(ckpt_dir)
-            if saved is not None:
-                momentum.update(saved)
-                log.info("ps %s: momentum restored from %s", job_id, ckpt_dir)
+            saved = ckpt_dir / "momentum.safetensors"
+            if saved.is_file():
+                shutil.copyfile(saved, momentum_file)
+                log.info("ps %s: momentum restored from %s", job_id, saved)
         round_num = 0
         # Routed consumer: only this job's pseudo-gradients (matched on the
         # Receive reference's resource tag) reach this loop, so a colocated
@@ -115,12 +117,10 @@ class ParameterServerExecutor(JobExecutor):
                     consumer, job_id, allowed, num_workers, work_dir, round_num
                 )
                 update_path = self._outer_step(
-                    received, momentum, lr, mu, work_dir, round_num
+                    received, momentum_file, lr, mu, work_dir, round_num
                 )
                 if ckpt_dir is not None:
-                    from ..executor.checkpoint import save_momentum
-
-                    save_momentum(ckpt_dir, momentum)
+                    self._checkpoint_momentum(momentum_file, ckpt_dir)
                 # Notify BEFORE broadcasting: a worker can merge the update
                 # and send UpdateReceived the moment the broadcast lands, and
                 # the scheduler must already have advanced the round by then —
@@ -192,16 +192,41 @@ class ParameterServerExecutor(JobExecutor):
     def _outer_step(
         self,
         received: dict[str, tuple[Path, float]],
-        momentum: dict[str, np.ndarray],
+        momentum_file: Path,
         lr: float,
         mu: float,
         work_dir: Path,
         round_num: int,
     ) -> Path:
-        """Sample-weighted mean + Nesterov, per tensor, on the C++ kernels."""
+        """Sample-weighted mean + Nesterov over the received delta files.
+
+        Fast path: the whole step runs in C++ over mmapped SafeTensors
+        (native.ps_outer_step — zero copies into Python). Fallback: per-
+        tensor numpy/kernels with the same validation and results.
+        """
         paths = [p for p, _ in received.values()]
         weights = np.asarray([s for _, s in received.values()], np.float32)
         weights = weights / max(weights.sum(), 1e-20)
+        out = work_dir / f"update-{round_num}.safetensors"
+        momentum_tmp = work_dir / "momentum.next.safetensors"
+
+        total = native.ps_outer_step(
+            paths,
+            weights,
+            momentum_file if momentum_file.is_file() else None,
+            momentum_tmp,
+            out,
+            lr,
+            mu,
+        )
+        if total is not None:
+            os.replace(momentum_tmp, momentum_file)
+            return out
+
+        # ---- Python fallback (no native toolchain) ----------------------
+        momentum: dict[str, np.ndarray] = {}
+        if momentum_file.is_file():
+            momentum = dict(load_file(str(momentum_file)))
         trees = [load_file(str(p)) for p in paths]
         keys = list(trees[0])
         for t in trees[1:]:
@@ -211,7 +236,7 @@ class ParameterServerExecutor(JobExecutor):
         for key in keys:
             srcs = [t[key] for t in trees]
             shape, dtype = srcs[0].shape, srcs[0].dtype
-            # The native kernel trusts n = momentum.size; a short tensor from
+            # The flat kernel trusts n = momentum.size; a short tensor from
             # a buggy/malicious worker must fail here, not read out of bounds.
             for t, s in zip(trees, srcs):
                 if s.shape != shape or s.dtype != dtype:
@@ -227,11 +252,22 @@ class ParameterServerExecutor(JobExecutor):
                     f"delta {key!r}: size {srcs[0].size} != momentum {m.size}"
                 )
             new_m, upd = native.fused_mean_nesterov(srcs, weights, m, lr, mu)
-            momentum[key] = new_m
+            momentum[key] = new_m.reshape(shape)
             update[key] = upd.reshape(shape)
-        out = work_dir / f"update-{round_num}.safetensors"
         save_file(update, str(out))
+        save_file(momentum, str(momentum_tmp))
+        os.replace(momentum_tmp, momentum_file)
         return out
+
+    @staticmethod
+    def _checkpoint_momentum(momentum_file: Path, ckpt_dir: Path) -> None:
+        """Atomic copy of the momentum file into the checkpoint dir."""
+        if not momentum_file.is_file():
+            return
+        ckpt_dir.mkdir(parents=True, exist_ok=True)
+        tmp = ckpt_dir / ".momentum.tmp"
+        shutil.copyfile(momentum_file, tmp)
+        os.replace(tmp, ckpt_dir / "momentum.safetensors")
 
     async def _broadcast(self, cfg, update_path: Path, round_num: int) -> None:
         """Push the update tensor to every worker (:232-269). Send failures

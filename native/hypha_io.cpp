@@ -1,0 +1,84 @@
+// Native data-plane IO: zero-copy file -> socket for bulk tensor transfer.
+//
+// The data node's serve loop is a raw file copy into a stream (reference:
+// crates/data/src/tensor_data.rs:8-16 io::copy — the hot IO path). On a
+// plain TCP stream the kernel can do this without bouncing bytes through
+// userspace: sendfile(2), falling back to a read/write loop where sendfile
+// is unsupported (or the fd is not a socket). TLS streams cannot use this
+// path (bytes must pass through the SSL layer) — the caller guards that.
+
+#include <cerrno>
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+
+#include <fcntl.h>
+#include <sys/sendfile.h>
+#include <sys/socket.h>
+#include <sys/stat.h>
+#include <unistd.h>
+
+extern "C" {
+
+// Returns bytes sent, or -errno on failure.
+int64_t send_file_fd(int out_fd, const char *path) {
+  int in_fd = ::open(path, O_RDONLY);
+  if (in_fd < 0) return -errno;
+  struct stat st{};
+  if (fstat(in_fd, &st) != 0) {
+    int e = errno;
+    ::close(in_fd);
+    return -e;
+  }
+  int64_t remaining = st.st_size;
+  int64_t total = 0;
+  off_t offset = 0;
+  bool use_sendfile = true;
+  char buf[1 << 16];
+  while (remaining > 0) {
+    ssize_t n;
+    if (use_sendfile) {
+      n = ::sendfile(out_fd, in_fd, &offset,
+                     static_cast<size_t>(remaining > (1 << 20) ? (1 << 20) : remaining));
+      if (n < 0 && errno == EINTR) continue;
+      if (n < 0 && (errno == EINVAL || errno == ENOSYS)) {
+        use_sendfile = false;  // e.g. out_fd is a pipe on an old kernel
+        continue;
+      }
+    } else {
+      ssize_t r;
+      do {
+        r = ::read(in_fd, buf, sizeof buf);
+      } while (r < 0 && errno == EINTR);
+      if (r <= 0) {
+        n = r;
+      } else {
+        // Write the WHOLE buffer, retrying EINTR mid-buffer — dropping the
+        // unwritten remainder would silently corrupt the transfer.
+        ssize_t w = 0;
+        while (w < r) {
+          ssize_t rc = ::write(out_fd, buf + w, static_cast<size_t>(r - w));
+          if (rc < 0) {
+            if (errno == EINTR) continue;
+            w = -1;
+            break;
+          }
+          w += rc;
+        }
+        n = w;
+      }
+    }
+    if (n < 0) {
+      int e = errno;
+      ::close(in_fd);
+      return -e;
+    }
+    if (n == 0) break;  // truncated file: report what we sent
+    remaining -= n;
+    total += n;
+  }
+  ::close(in_fd);
+  return total;
+}
+
+}  // extern "C"

@@ -1,0 +1,455 @@
+// Native SafeTensors access + the parameter-server outer step, end to end.
+//
+// The reference's only native numerical component streams worker
+// pseudo-gradients from mmapped SafeTensors files and applies the Nesterov
+// outer update (reference: crates/worker/src/executor/parameter_server.rs:
+// 331-446, Rust + candle-core). This is the C++ equivalent, self-contained:
+// a minimal JSON header parser for the SafeTensors tensor table, mmap'd
+// zero-copy reads, the fused weighted-mean + Nesterov kernel, and a writer
+// for the update/momentum files. One pass over each tensor; the job is
+// memory-bandwidth bound.
+//
+// SafeTensors layout: 8-byte LE u64 header length, JSON header
+// {"name": {"dtype": "F32", "shape": [...], "data_offsets": [s, e]}, ...},
+// then the data section. Offsets are relative to the data section start.
+//
+// Build: g++ -O3 -march=native -shared -fPIC ... (see hypha_tpu/native.py)
+
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <string>
+#include <vector>
+
+#include <fcntl.h>
+#include <sys/mman.h>
+#include <sys/stat.h>
+#include <unistd.h>
+
+namespace {
+
+void set_err(char *err, int errlen, const std::string &msg) {
+  if (err != nullptr && errlen > 0) {
+    std::snprintf(err, static_cast<size_t>(errlen), "%s", msg.c_str());
+  }
+}
+
+// ---------------------------------------------------------------------------
+// Minimal JSON parser: just the SafeTensors header subset — objects, strings,
+// arrays of integers, integers. No floats/bools/null/nesting beyond spec.
+// ---------------------------------------------------------------------------
+
+struct TensorInfo {
+  std::string name;
+  std::string dtype;
+  std::vector<int64_t> shape;
+  int64_t begin = 0;
+  int64_t end = 0;
+};
+
+struct Parser {
+  const char *p;
+  const char *limit;
+  std::string error;
+
+  bool fail(const std::string &msg) {
+    if (error.empty()) error = msg;
+    return false;
+  }
+  void ws() {
+    while (p < limit && (*p == ' ' || *p == '\t' || *p == '\n' || *p == '\r')) ++p;
+  }
+  bool expect(char c) {
+    ws();
+    if (p >= limit || *p != c) return fail(std::string("expected '") + c + "'");
+    ++p;
+    return true;
+  }
+  bool peek(char c) {
+    ws();
+    return p < limit && *p == c;
+  }
+  bool string(std::string *out) {
+    ws();
+    if (p >= limit || *p != '"') return fail("expected string");
+    ++p;
+    out->clear();
+    while (p < limit && *p != '"') {
+      if (*p == '\\') {
+        ++p;
+        if (p >= limit) return fail("bad escape");
+        switch (*p) {
+          case '"': out->push_back('"'); break;
+          case '\\': out->push_back('\\'); break;
+          case '/': out->push_back('/'); break;
+          case 'n': out->push_back('\n'); break;
+          case 't': out->push_back('\t'); break;
+          case 'r': out->push_back('\r'); break;
+          case 'b': out->push_back('\b'); break;
+          case 'f': out->push_back('\f'); break;
+          case 'u': {  // \uXXXX: keep ASCII, reject surrogates (names are
+                       // tree paths; exotic escapes mean a hostile file)
+            if (limit - p < 5) return fail("bad \\u escape");
+            int v = 0;
+            for (int i = 1; i <= 4; ++i) {
+              char c = p[i];
+              v <<= 4;
+              if (c >= '0' && c <= '9') v |= c - '0';
+              else if (c >= 'a' && c <= 'f') v |= c - 'a' + 10;
+              else if (c >= 'A' && c <= 'F') v |= c - 'A' + 10;
+              else return fail("bad \\u escape");
+            }
+            if (v > 0x7f) return fail("non-ascii \\u escape unsupported");
+            out->push_back(static_cast<char>(v));
+            p += 4;
+            break;
+          }
+          default: return fail("unknown escape");
+        }
+        ++p;
+      } else {
+        out->push_back(*p++);
+      }
+    }
+    if (p >= limit) return fail("unterminated string");
+    ++p;  // closing quote
+    return true;
+  }
+  bool integer(int64_t *out) {
+    ws();
+    bool neg = false;
+    if (p < limit && *p == '-') { neg = true; ++p; }
+    if (p >= limit || *p < '0' || *p > '9') return fail("expected integer");
+    int64_t v = 0;
+    while (p < limit && *p >= '0' && *p <= '9') {
+      v = v * 10 + (*p - '0');
+      ++p;
+    }
+    *out = neg ? -v : v;
+    return true;
+  }
+  bool int_array(std::vector<int64_t> *out) {
+    if (!expect('[')) return false;
+    out->clear();
+    if (peek(']')) { ++p; return true; }
+    while (true) {
+      int64_t v;
+      if (!integer(&v)) return false;
+      out->push_back(v);
+      ws();
+      if (p < limit && *p == ',') { ++p; continue; }
+      return expect(']');
+    }
+  }
+  // Skip any value (for __metadata__): strings or flat objects of strings.
+  bool skip_value() {
+    ws();
+    if (p >= limit) return fail("eof in value");
+    if (*p == '"') { std::string s; return string(&s); }
+    if (*p == '{') {
+      ++p;
+      if (peek('}')) { ++p; return true; }
+      while (true) {
+        std::string k, v;
+        if (!string(&k) || !expect(':') || !skip_value()) return false;
+        ws();
+        if (p < limit && *p == ',') { ++p; continue; }
+        return expect('}');
+      }
+    }
+    if (*p == '[') { std::vector<int64_t> a; return int_array(&a); }
+    int64_t i;
+    return integer(&i);
+  }
+};
+
+bool parse_header(const char *json, int64_t len, std::vector<TensorInfo> *out,
+                  std::string *error) {
+  Parser ps{json, json + len, {}};
+  out->clear();
+  if (!ps.expect('{')) { *error = ps.error; return false; }
+  if (ps.peek('}')) return true;
+  while (true) {
+    TensorInfo info;
+    if (!ps.string(&info.name) || !ps.expect(':')) { *error = ps.error; return false; }
+    if (info.name == "__metadata__") {
+      if (!ps.skip_value()) { *error = ps.error; return false; }
+    } else {
+      if (!ps.expect('{')) { *error = ps.error; return false; }
+      while (true) {
+        std::string key;
+        if (!ps.string(&key) || !ps.expect(':')) { *error = ps.error; return false; }
+        bool ok;
+        if (key == "dtype") ok = ps.string(&info.dtype);
+        else if (key == "shape") ok = ps.int_array(&info.shape);
+        else if (key == "data_offsets") {
+          std::vector<int64_t> offs;
+          ok = ps.int_array(&offs) && offs.size() == 2;
+          if (ok) { info.begin = offs[0]; info.end = offs[1]; }
+        } else ok = ps.skip_value();
+        if (!ok) { *error = ps.error.empty() ? "bad tensor entry" : ps.error; return false; }
+        ps.ws();
+        if (ps.p < ps.limit && *ps.p == ',') { ++ps.p; continue; }
+        if (!ps.expect('}')) { *error = ps.error; return false; }
+        break;
+      }
+      out->push_back(std::move(info));
+    }
+    ps.ws();
+    if (ps.p < ps.limit && *ps.p == ',') { ++ps.p; continue; }
+    if (!ps.expect('}')) { *error = ps.error; return false; }
+    return true;
+  }
+}
+
+// ---------------------------------------------------------------------------
+// mmap'd SafeTensors file
+// ---------------------------------------------------------------------------
+
+struct StFile {
+  void *map = nullptr;
+  int64_t size = 0;
+  const char *data = nullptr;  // data section start
+  int64_t data_size = 0;
+  std::vector<TensorInfo> tensors;
+
+  bool open(const char *path, std::string *error) {
+    int fd = ::open(path, O_RDONLY);
+    if (fd < 0) { *error = std::string("open failed: ") + path; return false; }
+    struct stat st{};
+    if (fstat(fd, &st) != 0 || st.st_size < 8) {
+      ::close(fd);
+      *error = std::string("stat failed or too small: ") + path;
+      return false;
+    }
+    size = st.st_size;
+    map = mmap(nullptr, static_cast<size_t>(size), PROT_READ, MAP_PRIVATE, fd, 0);
+    ::close(fd);
+    if (map == MAP_FAILED) { map = nullptr; *error = "mmap failed"; return false; }
+    uint64_t hlen;
+    std::memcpy(&hlen, map, 8);  // little-endian hosts only (x86/arm64)
+    if (8 + static_cast<int64_t>(hlen) > size) { *error = "header overruns file"; return false; }
+    const char *json = static_cast<const char *>(map) + 8;
+    data = json + hlen;
+    data_size = size - 8 - static_cast<int64_t>(hlen);
+    if (!parse_header(json, static_cast<int64_t>(hlen), &tensors, error)) return false;
+    for (const TensorInfo &t : tensors) {
+      if (t.begin < 0 || t.end < t.begin || t.end > data_size) {
+        *error = "tensor offsets out of bounds: " + t.name;
+        return false;
+      }
+    }
+    return true;
+  }
+
+  const TensorInfo *find(const std::string &name) const {
+    for (const TensorInfo &t : tensors)
+      if (t.name == name) return &t;
+    return nullptr;
+  }
+
+  ~StFile() {
+    if (map != nullptr) munmap(map, static_cast<size_t>(size));
+  }
+};
+
+bool write_safetensors_f32(const char *path,
+                           const std::vector<TensorInfo> &infos,
+                           const std::vector<const float *> &ptrs,
+                           std::string *error) {
+  std::string header = "{";
+  int64_t offset = 0;
+  std::vector<int64_t> begins;
+  for (size_t i = 0; i < infos.size(); ++i) {
+    const TensorInfo &t = infos[i];
+    int64_t nbytes = t.end - t.begin;
+    if (i) header += ",";
+    header += "\"" + t.name + "\":{\"dtype\":\"F32\",\"shape\":[";
+    for (size_t d = 0; d < t.shape.size(); ++d) {
+      if (d) header += ",";
+      header += std::to_string(t.shape[d]);
+    }
+    header += "],\"data_offsets\":[" + std::to_string(offset) + "," +
+              std::to_string(offset + nbytes) + "]}";
+    begins.push_back(offset);
+    offset += nbytes;
+  }
+  header += "}";
+  // Pad to 8 so the data section is aligned (spec allows trailing spaces).
+  while (header.size() % 8 != 0) header += ' ';
+
+  FILE *f = std::fopen(path, "wb");
+  if (f == nullptr) { *error = std::string("cannot write ") + path; return false; }
+  uint64_t hlen = header.size();
+  bool ok = std::fwrite(&hlen, 8, 1, f) == 1 &&
+            std::fwrite(header.data(), 1, header.size(), f) == header.size();
+  for (size_t i = 0; ok && i < infos.size(); ++i) {
+    size_t nbytes = static_cast<size_t>(infos[i].end - infos[i].begin);
+    ok = std::fwrite(ptrs[i], 1, nbytes, f) == nbytes;
+  }
+  if (std::fclose(f) != 0) ok = false;
+  if (!ok) *error = std::string("short write to ") + path;
+  return ok;
+}
+
+}  // namespace
+
+// ---------------------------------------------------------------------------
+// C API
+// ---------------------------------------------------------------------------
+
+extern "C" {
+
+// Opaque mmap'd reader -----------------------------------------------------
+
+void *st_open(const char *path, char *err, int errlen) {
+  auto *f = new StFile();
+  std::string error;
+  if (!f->open(path, &error)) {
+    set_err(err, errlen, error);
+    delete f;
+    return nullptr;
+  }
+  return f;
+}
+
+void st_close(void *handle) { delete static_cast<StFile *>(handle); }
+
+int64_t st_count(void *handle) {
+  return static_cast<int64_t>(static_cast<StFile *>(handle)->tensors.size());
+}
+
+const char *st_name(void *handle, int64_t i) {
+  auto *f = static_cast<StFile *>(handle);
+  if (i < 0 || i >= static_cast<int64_t>(f->tensors.size())) return nullptr;
+  return f->tensors[static_cast<size_t>(i)].name.c_str();
+}
+
+// Returns data pointer; fills nbytes, dtype (short string), ndim and shape.
+const void *st_tensor(void *handle, const char *name, int64_t *nbytes,
+                      char *dtype, int dtype_len, int64_t *shape,
+                      int max_dims, int *ndim) {
+  auto *f = static_cast<StFile *>(handle);
+  const TensorInfo *t = f->find(name);
+  if (t == nullptr) return nullptr;
+  *nbytes = t->end - t->begin;
+  set_err(dtype, dtype_len, t->dtype);
+  *ndim = static_cast<int>(t->shape.size());
+  for (int d = 0; d < *ndim && d < max_dims; ++d) shape[d] = t->shape[static_cast<size_t>(d)];
+  return f->data + t->begin;
+}
+
+// The whole outer step, native (parameter_server.rs:331-446 equivalent) ----
+//
+//   ḡ = Σ_k w_k · Δθ_k   (single weighted pass — fixes the reference's
+//                         order-dependent pairwise averaging TODO :192-194)
+//   m ← μ·m + ḡ;  update = lr·(μ·m + ḡ)
+//
+// delta_paths: n_files SafeTensors files with identical tensor tables (F32).
+// momentum_in: prior momentum file ("" or missing tensors → zeros).
+// Writes update_out and momentum_out (both SafeTensors F32).
+// Returns total elements processed, or -1 with err set.
+int64_t ps_outer_step(const char *const *delta_paths, int64_t n_files,
+                      const float *weights, const char *momentum_in,
+                      const char *momentum_out, const char *update_out,
+                      float lr, float mu, char *err, int errlen) {
+  if (n_files <= 0) {
+    set_err(err, errlen, "no delta files");
+    return -1;
+  }
+  std::string error;
+  std::vector<StFile> files(static_cast<size_t>(n_files));
+  for (int64_t k = 0; k < n_files; ++k) {
+    if (!files[static_cast<size_t>(k)].open(delta_paths[k], &error)) {
+      set_err(err, errlen, error);
+      return -1;
+    }
+  }
+  const StFile &first = files[0];
+  // Validate identical tables.
+  for (int64_t k = 1; k < n_files; ++k) {
+    const StFile &f = files[static_cast<size_t>(k)];
+    if (f.tensors.size() != first.tensors.size()) {
+      set_err(err, errlen, "delta files have different tensor counts");
+      return -1;
+    }
+  }
+  StFile momentum;
+  bool have_momentum = false;
+  if (momentum_in != nullptr && momentum_in[0] != '\0') {
+    // A supplied-but-unreadable momentum file is an error, NOT "no
+    // momentum": silently zeroing resets the outer optimizer trajectory —
+    // the exact state checkpointing exists to preserve.
+    if (!momentum.open(momentum_in, &error)) {
+      set_err(err, errlen, "momentum file unreadable: " + error);
+      return -1;
+    }
+    have_momentum = true;
+  }
+
+  std::vector<std::vector<float>> new_momentum;
+  std::vector<std::vector<float>> updates;
+  new_momentum.reserve(first.tensors.size());
+  updates.reserve(first.tensors.size());
+  int64_t total = 0;
+
+  for (const TensorInfo &t : first.tensors) {
+    if (t.dtype != "F32") {
+      set_err(err, errlen, "non-F32 delta tensor: " + t.name);
+      return -1;
+    }
+    int64_t nbytes = t.end - t.begin;
+    int64_t n = nbytes / 4;
+    std::vector<const float *> srcs;
+    srcs.reserve(static_cast<size_t>(n_files));
+    for (int64_t k = 0; k < n_files; ++k) {
+      const StFile &f = files[static_cast<size_t>(k)];
+      const TensorInfo *tk = f.find(t.name);
+      if (tk == nullptr || tk->end - tk->begin != nbytes || tk->dtype != "F32") {
+        set_err(err, errlen, "delta mismatch for tensor: " + t.name);
+        return -1;
+      }
+      srcs.push_back(reinterpret_cast<const float *>(f.data + tk->begin));
+    }
+    const float *m_in = nullptr;
+    if (have_momentum) {
+      const TensorInfo *tm = momentum.find(t.name);
+      if (tm != nullptr) {
+        // Present but mismatched momentum = wrong model/corruption: fail
+        // loudly (matches the Python fallback's size validation). A tensor
+        // absent from the momentum file starts at zero, like a fresh key.
+        if (tm->end - tm->begin != nbytes || tm->dtype != "F32") {
+          set_err(err, errlen, "momentum mismatch for tensor: " + t.name);
+          return -1;
+        }
+        m_in = reinterpret_cast<const float *>(momentum.data + tm->begin);
+      }
+    }
+    std::vector<float> m_new(static_cast<size_t>(n));
+    std::vector<float> upd(static_cast<size_t>(n));
+    for (int64_t i = 0; i < n; ++i) {
+      float g = 0.0f;
+      for (int64_t k = 0; k < n_files; ++k) g += weights[k] * srcs[static_cast<size_t>(k)][i];
+      float m = mu * (m_in != nullptr ? m_in[i] : 0.0f) + g;
+      m_new[static_cast<size_t>(i)] = m;
+      upd[static_cast<size_t>(i)] = lr * (mu * m + g);
+    }
+    new_momentum.push_back(std::move(m_new));
+    updates.push_back(std::move(upd));
+    total += n;
+  }
+
+  std::vector<const float *> upd_ptrs, mom_ptrs;
+  for (size_t i = 0; i < updates.size(); ++i) {
+    upd_ptrs.push_back(updates[i].data());
+    mom_ptrs.push_back(new_momentum[i].data());
+  }
+  if (!write_safetensors_f32(update_out, first.tensors, upd_ptrs, &error) ||
+      !write_safetensors_f32(momentum_out, first.tensors, mom_ptrs, &error)) {
+    set_err(err, errlen, error);
+    return -1;
+  }
+  return total;
+}
+
+}  // extern "C"

@@ -12,9 +12,7 @@ import pytest
 
 from hypha_tpu.executor.checkpoint import (
     latest_manifest,
-    load_momentum,
     load_train_checkpoint,
-    save_momentum,
     save_train_checkpoint,
 )
 from hypha_tpu.executor.train import TrainState, build_optimizer
@@ -69,13 +67,23 @@ def test_checkpoint_shape_mismatch_fails_loudly(tmp_path):
         load_train_checkpoint(tmp_path / "ck", other_state.params, other_state.opt_state)
 
 
-def test_momentum_round_trip(tmp_path):
+def test_ps_momentum_checkpoint_copy(tmp_path):
+    """The PS copies its momentum file into the checkpoint dir atomically
+    (ps_executor._checkpoint_momentum) and restores it on restart."""
+    from safetensors.numpy import load_file, save_file
+
+    from hypha_tpu.worker.ps_executor import ParameterServerExecutor
+
     m = {"a/w": np.arange(4, dtype=np.float32), "b": np.ones(2, np.float32)}
-    save_momentum(tmp_path, m)
-    got = load_momentum(tmp_path)
-    assert set(got) == set(m)
+    momentum_file = tmp_path / "momentum.safetensors"
+    save_file(m, str(momentum_file))
+    ckpt = tmp_path / "ckpt"
+    ParameterServerExecutor._checkpoint_momentum(momentum_file, ckpt)
+    got = load_file(str(ckpt / "momentum.safetensors"))
     np.testing.assert_array_equal(got["a/w"], m["a/w"])
-    assert load_momentum(tmp_path / "empty") is None
+    assert not [p for p in ckpt.iterdir() if p.name.startswith(".momentum")]
+    # absent momentum file is a no-op
+    ParameterServerExecutor._checkpoint_momentum(tmp_path / "nope", ckpt)
 
 
 def test_versioned_save_updates_pointer_and_prunes(tmp_path):

@@ -1,0 +1,150 @@
+"""Native (C++) runtime layer tests: SafeTensors mmap reader/writer parity
+with the Python safetensors library, the full native outer step vs the
+Python path, sendfile data plane, and malformed-input rejection."""
+
+from __future__ import annotations
+
+import os
+import socket
+import threading
+
+import numpy as np
+import pytest
+from safetensors.numpy import load_file, save_file
+
+from hypha_tpu import native
+
+
+pytestmark = pytest.mark.skipif(
+    not native.native_available(), reason="no native toolchain"
+)
+
+
+def _write_st(path, tensors):
+    save_file(tensors, str(path))
+    return path
+
+
+def test_safetensors_view_parity(tmp_path):
+    tensors = {
+        "a/w": np.arange(12, dtype=np.float32).reshape(3, 4),
+        "b/count": np.asarray([7], np.int64),
+        "c": np.random.default_rng(0).standard_normal((2, 2, 2)).astype(np.float32),
+    }
+    p = _write_st(tmp_path / "t.safetensors", tensors)
+    with native.SafeTensorsView(p) as view:
+        assert sorted(view.keys()) == sorted(tensors)
+        for name, want in tensors.items():
+            got = view.tensor(name)
+            assert got.shape == want.shape and got.dtype == want.dtype
+            np.testing.assert_array_equal(got, want)
+        with pytest.raises(KeyError):
+            view.tensor("missing")
+
+
+def test_safetensors_view_rejects_garbage(tmp_path):
+    bad = tmp_path / "bad.safetensors"
+    bad.write_bytes(b"\xff" * 64)
+    with pytest.raises(ValueError):
+        native.SafeTensorsView(bad)
+    # header length overrunning the file
+    import struct
+
+    trunc = tmp_path / "trunc.safetensors"
+    trunc.write_bytes(struct.pack("<Q", 1 << 40) + b"{}")
+    with pytest.raises(ValueError):
+        native.SafeTensorsView(trunc)
+
+
+def test_native_outer_step_matches_python_kernels(tmp_path):
+    rng = np.random.default_rng(5)
+    shapes = {"x/w": (8, 4), "y/b": (16,)}
+    n_workers = 3
+    paths = []
+    deltas = []
+    for k in range(n_workers):
+        t = {n: rng.standard_normal(s).astype(np.float32) for n, s in shapes.items()}
+        deltas.append(t)
+        paths.append(_write_st(tmp_path / f"d{k}.safetensors", t))
+    w = np.asarray([3.0, 1.0, 2.0], np.float32)
+    w = w / w.sum()
+    lr, mu = 0.7, 0.9
+
+    m_out = tmp_path / "m.safetensors"
+    u_out = tmp_path / "u.safetensors"
+    total = native.ps_outer_step(paths, w, None, m_out, u_out, lr, mu)
+    assert total == sum(int(np.prod(s)) for s in shapes.values())
+
+    update = load_file(str(u_out))
+    momentum = load_file(str(m_out))
+    for name in shapes:
+        srcs = [d[name] for d in deltas]
+        m_ref, u_ref = native.fused_mean_nesterov(
+            srcs, w, np.zeros(srcs[0].size, np.float32), lr, mu
+        )
+        np.testing.assert_allclose(update[name].ravel(), u_ref, rtol=1e-5)
+        np.testing.assert_allclose(momentum[name].ravel(), m_ref, rtol=1e-5)
+
+    # Second round consumes the momentum file
+    total2 = native.ps_outer_step(paths, w, m_out, m_out, u_out, lr, mu)
+    assert total2 == total
+    momentum2 = load_file(str(m_out))
+    for name in shapes:
+        srcs = [d[name] for d in deltas]
+        m1, _ = native.fused_mean_nesterov(
+            srcs, w, np.zeros(srcs[0].size, np.float32), lr, mu
+        )
+        m2_ref, _ = native.fused_mean_nesterov(srcs, w, m1, lr, mu)
+        np.testing.assert_allclose(momentum2[name].ravel(), m2_ref, rtol=1e-5)
+
+
+def test_native_outer_step_rejects_mismatch(tmp_path):
+    a = _write_st(tmp_path / "a.safetensors", {"x": np.zeros((4,), np.float32)})
+    b = _write_st(tmp_path / "b.safetensors", {"x": np.zeros((5,), np.float32)})
+    with pytest.raises(ValueError, match="mismatch"):
+        native.ps_outer_step(
+            [a, b], np.asarray([0.5, 0.5], np.float32),
+            None, tmp_path / "m", tmp_path / "u", 0.7, 0.9,
+        )
+    c = _write_st(tmp_path / "c.safetensors", {"x": np.zeros((4,), np.int64)})
+    with pytest.raises(ValueError, match="F32"):
+        native.ps_outer_step(
+            [c], np.asarray([1.0], np.float32),
+            None, tmp_path / "m", tmp_path / "u", 0.7, 0.9,
+        )
+
+
+def test_send_file_fd_socketpair(tmp_path):
+    payload = os.urandom(1 << 20) + b"tail"
+    src = tmp_path / "blob.bin"
+    src.write_bytes(payload)
+    a, b = socket.socketpair()
+    received = bytearray()
+
+    def reader():
+        while True:
+            chunk = b.recv(1 << 16)
+            if not chunk:
+                return
+            received.extend(chunk)
+
+    t = threading.Thread(target=reader)
+    t.start()
+    try:
+        sent = native.send_file_fd(a.fileno(), src)
+        assert sent == len(payload)
+    finally:
+        a.close()
+        t.join(10)
+        b.close()
+    assert bytes(received) == payload
+
+
+def test_send_file_fd_missing_file(tmp_path):
+    a, b = socket.socketpair()
+    try:
+        with pytest.raises(OSError):
+            native.send_file_fd(a.fileno(), tmp_path / "nope")
+    finally:
+        a.close()
+        b.close()

@@ -180,7 +180,7 @@ def test_aim_driver_sink_and_connector(tmp_path):
         return sink.received
 
     received = asyncio.run(asyncio.wait_for(main(), 30))
-    assert received == [
+    assert list(received) == [
         {"worker_id": "w0", "round": 3, "metric_name": "loss", "value": 1.25}
     ]
     lines = (tmp_path / "m.jsonl").read_text().strip().splitlines()
