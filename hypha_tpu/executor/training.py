@@ -105,7 +105,15 @@ def _init_model(cfg: TrainExecutorConfig, session, work_dir: Path, first_batch):
     from ..models.registry import resolve_model_type
 
     model_spec = dict(cfg.model)
-    model, _mcfg = build_model(model_spec)
+    # On TPU the pluggable-attention families run the pallas flash kernel by
+    # default (sequence-parallel jobs swap in the ring kernel instead, via
+    # _build_mesh); off-TPU the XLA dense path is faster than interpret mode.
+    attn_impl = None
+    if jax.default_backend() == "tpu" and not cfg.sharding:
+        from ..ops.flash_attention import flash_attention
+
+        attn_impl = flash_attention
+    model, _mcfg = build_model(model_spec, attn_impl)
     model_type = resolve_model_type(model_spec.get("model_type", ModelType.CAUSAL_LM))
     causal_lm = model_type not in _NON_CAUSAL
     has_aux = isinstance(model, Mixtral)
@@ -118,11 +126,21 @@ def _init_model(cfg: TrainExecutorConfig, session, work_dir: Path, first_batch):
     if source is not None:
         fetch = messages.from_json_dict(source) if isinstance(source, dict) else source
         rels = session.fetch(fetch)
-        weight_files = [r for r in rels if r.endswith(".safetensors")]
+        weight_files = [
+            r for r in rels if r.endswith((".safetensors", ".bin", ".pt", ".pth"))
+        ]
         if weight_files:
-            flat = load_flat(work_dir / weight_files[0])
-            params = unflatten_like(flat, params)
-            log.info("loaded %d initial tensors from %s", len(flat), weight_files[0])
+            from ..models.convert import convert_state_dict, load_checkpoint_files
+
+            state = load_checkpoint_files([work_dir / r for r in weight_files])
+            try:
+                # Native flat names (our own checkpoints/exports)…
+                params = unflatten_like(state, params)
+            except KeyError:
+                # …or an HF-format state dict for this family.
+                family = model_spec.get("family", "gpt2")
+                params = convert_state_dict(family, state, params)
+            log.info("loaded %d initial tensors from %s", len(state), weight_files)
     return model, params, causal_lm, has_aux
 
 

@@ -1,0 +1,150 @@
+"""HF checkpoint interop: torch state dicts → native JAX param trees.
+
+The reference loads models through HF ``AutoModelFor*`` classes
+(executors/accelerate/.../model.py:48-123) and trains them with torch; the
+TPU framework defines the flagship families natively in flax. This module
+bridges the two worlds so a user can point a job at an HF checkpoint
+(``gpt2``, Llama-format repos) and get the same weights in the native
+model — with stable flat names, so Δθ SafeTensors stay key-compatible
+through the whole DiLoCo pipeline.
+
+Conventions handled:
+  * GPT-2 uses Conv1D ([in, out] — flax kernel orientation, no transpose);
+  * Llama/Mixtral use torch Linear ([out, in] — transposed to flax);
+  * LayerNorm weight/bias → flax scale/bias;
+  * tied LM heads (GPT-2) are dropped, untied heads map through.
+"""
+
+from __future__ import annotations
+
+import logging
+import re
+from pathlib import Path
+from typing import Any
+
+import numpy as np
+
+from ..executor.serialization import unflatten_like
+
+__all__ = ["convert_state_dict", "load_checkpoint_files", "HF_CONVERTERS"]
+
+log = logging.getLogger("hypha.models.convert")
+
+
+def _gpt2_key(key: str) -> tuple[str, bool] | None:
+    """HF gpt2 name -> (our flat name, transpose?) or None to skip."""
+    key = key.removeprefix("transformer.")
+    if key in ("wte.weight", "wpe.weight"):
+        return f"params/{key.removesuffix('.weight')}", False
+    if key in ("ln_f.weight", "ln_f.bias"):
+        suffix = "scale" if key.endswith("weight") else "bias"
+        return f"params/ln_f/{suffix}", False
+    if key.startswith("lm_head."):
+        return None  # tied to wte
+    m = re.fullmatch(r"h\.(\d+)\.(.+)", key)
+    if m is None:
+        return None
+    i, rest = m.group(1), m.group(2)
+    table = {
+        "ln_1.weight": ("ln_1/scale", False),
+        "ln_1.bias": ("ln_1/bias", False),
+        "ln_2.weight": ("ln_2/scale", False),
+        "ln_2.bias": ("ln_2/bias", False),
+        # GPT-2 Conv1D stores [in, out]: flax kernel orientation already.
+        "attn.c_attn.weight": ("c_attn/kernel", False),
+        "attn.c_attn.bias": ("c_attn/bias", False),
+        "attn.c_proj.weight": ("c_proj/kernel", False),
+        "attn.c_proj.bias": ("c_proj/bias", False),
+        "mlp.c_fc.weight": ("c_fc/kernel", False),
+        "mlp.c_fc.bias": ("c_fc/bias", False),
+        "mlp.c_proj.weight": ("mlp_proj/kernel", False),
+        "mlp.c_proj.bias": ("mlp_proj/bias", False),
+    }
+    entry = table.get(rest)
+    if entry is None:
+        if rest.endswith((".attn.bias", "attn.masked_bias")) or rest in (
+            "attn.bias",
+            "attn.masked_bias",
+        ):
+            return None  # HF's causal-mask buffers, not weights
+        raise KeyError(f"unmapped gpt2 tensor {key!r}")
+    name, transpose = entry
+    return f"params/h_{i}/{name}", transpose
+
+
+def _llama_key(key: str) -> tuple[str, bool] | None:
+    """HF Llama name -> (our flat name, transpose?) or None to skip."""
+    key = key.removeprefix("model.")
+    if key == "embed_tokens.weight":
+        return "params/embed_tokens", False
+    if key == "norm.weight":
+        return "params/norm/weight", False
+    if key == "lm_head.weight":
+        return "params/lm_head", False  # torch Linear [V, E] == our [V, E]
+    if key.endswith("rotary_emb.inv_freq"):
+        return None  # recomputed
+    m = re.fullmatch(r"layers\.(\d+)\.(.+)", key)
+    if m is None:
+        return None
+    i, rest = m.group(1), m.group(2)
+    if rest in ("input_layernorm.weight", "post_attention_layernorm.weight"):
+        return f"params/layers_{i}/{rest.removesuffix('.weight')}/weight", False
+    proj = re.fullmatch(r"(self_attn|mlp)\.(\w+_proj)\.weight", rest)
+    if proj is not None:
+        # torch Linear stores [out, in]; flax kernels are [in, out].
+        return f"params/layers_{i}/{proj.group(1)}/{proj.group(2)}/kernel", True
+    raise KeyError(f"unmapped llama tensor {key!r}")
+
+
+HF_CONVERTERS = {
+    "gpt2": _gpt2_key,
+    "llama": _llama_key,
+}
+
+
+def convert_state_dict(
+    family: str, state_dict: dict[str, np.ndarray], params_template: Any
+) -> Any:
+    """Convert an HF state dict to a param tree shaped like the template.
+
+    Missing tensors (or shape mismatches against the template) fail loudly
+    via unflatten_like — a half-converted model must never train silently.
+    """
+    mapper = HF_CONVERTERS.get(family)
+    if mapper is None:
+        raise ValueError(
+            f"no HF converter for family {family!r} (have {sorted(HF_CONVERTERS)})"
+        )
+    flat: dict[str, np.ndarray] = {}
+    for key, value in state_dict.items():
+        mapped = mapper(key)
+        if mapped is None:
+            continue
+        name, transpose = mapped
+        arr = np.asarray(value)
+        if transpose:
+            arr = np.ascontiguousarray(arr.T)
+        flat[name] = arr.astype(np.float32, copy=False)
+    return unflatten_like(flat, params_template)
+
+
+def load_checkpoint_files(paths: list[str | Path]) -> dict[str, np.ndarray]:
+    """Load tensors from HF checkpoint files (.safetensors preferred,
+    torch .bin supported) into one numpy state dict."""
+    state: dict[str, np.ndarray] = {}
+    for path in paths:
+        path = Path(path)
+        if path.suffix == ".safetensors":
+            from safetensors.numpy import load_file
+
+            state.update(load_file(str(path)))
+        elif path.suffix in (".bin", ".pt", ".pth"):
+            import torch
+
+            loaded = torch.load(path, map_location="cpu", weights_only=True)
+            state.update(
+                {k: v.numpy() for k, v in loaded.items() if hasattr(v, "numpy")}
+            )
+        else:
+            log.debug("skipping non-checkpoint artifact %s", path)
+    return state

@@ -25,6 +25,7 @@ class GPT2Config:
     n_embd: int = 768
     n_layer: int = 12
     n_head: int = 12
+    layer_norm_epsilon: float = 1e-5  # HF gpt2 parity
     dtype: str = "bfloat16"
 
     @classmethod
@@ -45,7 +46,7 @@ class _Block(nn.Module):
         cfg = self.config
         dtype = jnp.dtype(cfg.dtype)
         B, S, E = x.shape
-        h = nn.LayerNorm(dtype=jnp.float32, name="ln_1")(x).astype(dtype)
+        h = nn.LayerNorm(epsilon=cfg.layer_norm_epsilon, dtype=jnp.float32, name="ln_1")(x).astype(dtype)
         qkv = nn.Dense(3 * E, dtype=dtype, name="c_attn")(h)
         q, k, v = jnp.split(qkv, 3, axis=-1)
         hd = E // cfg.n_head
@@ -56,7 +57,7 @@ class _Block(nn.Module):
         attn = attn.reshape(B, S, E)
         x = x + nn.Dense(E, dtype=dtype, name="c_proj")(attn)
 
-        h = nn.LayerNorm(dtype=jnp.float32, name="ln_2")(x).astype(dtype)
+        h = nn.LayerNorm(epsilon=cfg.layer_norm_epsilon, dtype=jnp.float32, name="ln_2")(x).astype(dtype)
         h = nn.Dense(4 * E, dtype=dtype, name="c_fc")(h)
         h = nn.gelu(h)
         x = x + nn.Dense(E, dtype=dtype, name="mlp_proj")(h)
@@ -81,6 +82,6 @@ class GPT2(nn.Module):
         x = (wte[input_ids] + wpe[None, :S]).astype(dtype)
         for i in range(cfg.n_layer):
             x = _Block(cfg, name=f"h_{i}")(x)
-        x = nn.LayerNorm(dtype=jnp.float32, name="ln_f")(x)
+        x = nn.LayerNorm(epsilon=cfg.layer_norm_epsilon, dtype=jnp.float32, name="ln_f")(x)
         # tied LM head: logits against the embedding matrix, f32 for the loss
         return jnp.einsum("bse,ve->bsv", x.astype(jnp.float32), wte)

@@ -5,11 +5,13 @@ that compute path, built MXU-first (large batched matmuls, bf16, static
 shapes)."""
 
 from .attention import dot_product_attention
+from .flash_attention import flash_attention
 from .rope import apply_rope, rope_frequencies
 from .rmsnorm import rms_norm
 
 __all__ = [
     "dot_product_attention",
+    "flash_attention",
     "apply_rope",
     "rope_frequencies",
     "rms_norm",

@@ -43,6 +43,14 @@ int64_t send_file_fd(int out_fd, const char *path) {
       if (n < 0 && errno == EINTR) continue;
       if (n < 0 && (errno == EINVAL || errno == ENOSYS)) {
         use_sendfile = false;  // e.g. out_fd is a pipe on an old kernel
+        // sendfile advanced `offset` without moving in_fd's file position;
+        // the fallback reads from the position, so align it or the
+        // already-sent prefix goes out twice.
+        if (::lseek(in_fd, offset, SEEK_SET) < 0) {
+          int e = errno;
+          ::close(in_fd);
+          return -e;
+        }
         continue;
       }
     } else {

@@ -26,6 +26,12 @@
 #include <sys/stat.h>
 #include <unistd.h>
 
+// Shared flat kernel from hypha_ps.cpp (same shared library).
+extern "C" void fused_mean_nesterov_f32(const float *const *srcs,
+                                        const float *weights, int64_t n_srcs,
+                                        float *momentum, float *update_out,
+                                        int64_t n, float lr, float mu);
+
 namespace {
 
 void set_err(char *err, int errlen, const std::string &msg) {
@@ -122,7 +128,11 @@ struct Parser {
     if (p >= limit || *p < '0' || *p > '9') return fail("expected integer");
     int64_t v = 0;
     while (p < limit && *p >= '0' && *p <= '9') {
-      v = v * 10 + (*p - '0');
+      int digit = *p - '0';
+      // Overflow is UB and a wrapped offset could pass the bounds check —
+      // a hostile header must be rejected, not reinterpreted.
+      if (v > (INT64_MAX - digit) / 10) return fail("integer overflow");
+      v = v * 10 + digit;
       ++p;
     }
     *out = neg ? -v : v;
@@ -253,6 +263,29 @@ struct StFile {
   }
 };
 
+std::string json_escape(const std::string &s) {
+  std::string out;
+  out.reserve(s.size());
+  for (char c : s) {
+    switch (c) {
+      case '"': out += "\\\""; break;
+      case '\\': out += "\\\\"; break;
+      case '\n': out += "\\n"; break;
+      case '\t': out += "\\t"; break;
+      case '\r': out += "\\r"; break;
+      default:
+        if (static_cast<unsigned char>(c) < 0x20) {
+          char buf[8];
+          std::snprintf(buf, sizeof buf, "\\u%04x", c);
+          out += buf;
+        } else {
+          out += c;
+        }
+    }
+  }
+  return out;
+}
+
 bool write_safetensors_f32(const char *path,
                            const std::vector<TensorInfo> &infos,
                            const std::vector<const float *> &ptrs,
@@ -264,7 +297,10 @@ bool write_safetensors_f32(const char *path,
     const TensorInfo &t = infos[i];
     int64_t nbytes = t.end - t.begin;
     if (i) header += ",";
-    header += "\"" + t.name + "\":{\"dtype\":\"F32\",\"shape\":[";
+    // Escape the (peer-supplied) tensor name: a raw quote would terminate
+    // the JSON string early and let a crafted name inject entries whose
+    // data_offsets alias other tensors.
+    header += "\"" + json_escape(t.name) + "\":{\"dtype\":\"F32\",\"shape\":[";
     for (size_t d = 0; d < t.shape.size(); ++d) {
       if (d) header += ",";
       header += std::to_string(t.shape[d]);
@@ -425,15 +461,15 @@ int64_t ps_outer_step(const char *const *delta_paths, int64_t n_files,
         m_in = reinterpret_cast<const float *>(momentum.data + tm->begin);
       }
     }
-    std::vector<float> m_new(static_cast<size_t>(n));
+    std::vector<float> m_new(static_cast<size_t>(n), 0.0f);
     std::vector<float> upd(static_cast<size_t>(n));
-    for (int64_t i = 0; i < n; ++i) {
-      float g = 0.0f;
-      for (int64_t k = 0; k < n_files; ++k) g += weights[k] * srcs[static_cast<size_t>(k)][i];
-      float m = mu * (m_in != nullptr ? m_in[i] : 0.0f) + g;
-      m_new[static_cast<size_t>(i)] = m;
-      upd[static_cast<size_t>(i)] = lr * (mu * m + g);
+    if (m_in != nullptr) {
+      std::memcpy(m_new.data(), m_in, static_cast<size_t>(n) * 4);
     }
+    // One source of truth for the outer-optimizer math: the shared kernel
+    // from hypha_ps.cpp (linked into the same library), in-out on m_new.
+    fused_mean_nesterov_f32(srcs.data(), weights, n_files, m_new.data(),
+                            upd.data(), n, lr, mu);
     new_momentum.push_back(std::move(m_new));
     updates.push_back(std::move(upd));
     total += n;

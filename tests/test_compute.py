@@ -340,3 +340,39 @@ def test_diloco_two_replicas_equal_one_big_batch_first_round():
         jnp.sqrt(sum(jnp.sum(x**2) for x in jax.tree.leaves(avg)))
     )
     assert norm > 0  # deltas flow end-to-end
+
+
+def test_flash_attention_matches_xla_reference():
+    """Pallas flash kernel (interpret mode on CPU) vs the dense XLA path:
+    causal, non-causal, and GQA shapes."""
+    import jax
+    import jax.numpy as jnp
+
+    from hypha_tpu.ops.attention import dot_product_attention
+    from hypha_tpu.ops.flash_attention import flash_attention
+
+    rng = jax.random.key(0)
+    B, S, H, D = 2, 256, 4, 64
+    kq, kk, kv = jax.random.split(rng, 3)
+    q = jax.random.normal(kq, (B, S, H, D), jnp.float32)
+    k = jax.random.normal(kk, (B, S, H, D), jnp.float32)
+    v = jax.random.normal(kv, (B, S, H, D), jnp.float32)
+
+    for causal in (True, False):
+        want = dot_product_attention(q, k, v, causal=causal)
+        got = flash_attention(q, k, v, causal=causal, block_q=128, block_k=128)
+        assert jnp.allclose(got, want, rtol=2e-3, atol=2e-3), (
+            causal, float(jnp.abs(got - want).max()))
+
+    # GQA: 4 query heads over 2 kv heads
+    kg = jax.random.normal(kk, (B, S, 2, D), jnp.float32)
+    vg = jax.random.normal(kv, (B, S, 2, D), jnp.float32)
+    want = dot_product_attention(q, kg, vg, causal=True)
+    got = flash_attention(q, kg, vg, causal=True)
+    assert jnp.allclose(got, want, rtol=2e-3, atol=2e-3)
+
+    # non-tiling shape falls back to the XLA path (still correct)
+    q3 = q[:, :100]
+    want = dot_product_attention(q3, k[:, :100], v[:, :100], causal=True)
+    got = flash_attention(q3, k[:, :100], v[:, :100], causal=True)
+    assert jnp.allclose(got, want, rtol=2e-3, atol=2e-3)

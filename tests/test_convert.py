@@ -1,0 +1,108 @@
+"""HF checkpoint conversion: converted native models must reproduce the HF
+torch models' logits (the contract that makes ``gpt2`` / Llama-format
+repos usable as job model sources)."""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import numpy as np
+import pytest
+
+from hypha_tpu.models import GPT2, GPT2Config, Llama, LlamaConfig
+from hypha_tpu.models.convert import convert_state_dict, load_checkpoint_files
+
+transformers = pytest.importorskip("transformers")
+import torch  # noqa: E402
+
+
+def test_gpt2_conversion_matches_hf_logits():
+    hf_cfg = transformers.GPT2Config(
+        vocab_size=96, n_positions=32, n_embd=32, n_layer=2, n_head=2
+    )
+    torch.manual_seed(0)
+    hf = transformers.GPT2LMHeadModel(hf_cfg).eval()
+    ids = np.random.default_rng(0).integers(0, 96, (2, 16))
+    with torch.no_grad():
+        want = hf(torch.from_numpy(ids)).logits.numpy()
+
+    cfg = GPT2Config(
+        vocab_size=96, n_positions=32, n_embd=32, n_layer=2, n_head=2, dtype="float32"
+    )
+    model = GPT2(cfg)
+    template = model.init(jax.random.key(0), ids.astype(np.int32))
+    state = {k: v.numpy() for k, v in hf.state_dict().items()}
+    params = convert_state_dict("gpt2", state, template)
+    got = np.asarray(model.apply(params, ids.astype(np.int32)))
+    np.testing.assert_allclose(got, want, rtol=2e-4, atol=2e-4)
+
+
+def test_llama_conversion_matches_hf_logits():
+    hf_cfg = transformers.LlamaConfig(
+        vocab_size=96,
+        hidden_size=32,
+        intermediate_size=64,
+        num_hidden_layers=2,
+        num_attention_heads=4,
+        num_key_value_heads=2,
+        max_position_embeddings=64,
+        rms_norm_eps=1e-5,
+        rope_theta=10000.0,
+        attention_bias=False,
+        tie_word_embeddings=False,
+    )
+    torch.manual_seed(1)
+    hf = transformers.LlamaForCausalLM(hf_cfg).eval()
+    ids = np.random.default_rng(1).integers(0, 96, (2, 12))
+    with torch.no_grad():
+        want = hf(torch.from_numpy(ids)).logits.numpy()
+
+    cfg = LlamaConfig(
+        vocab_size=96,
+        hidden_size=32,
+        intermediate_size=64,
+        num_layers=2,
+        num_heads=4,
+        num_kv_heads=2,
+        max_seq_len=64,
+        rms_eps=1e-5,
+        dtype="float32",
+    )
+    model = Llama(cfg)
+    template = model.init(jax.random.key(0), ids.astype(np.int32))
+    state = {k: v.numpy() for k, v in hf.state_dict().items()}
+    params = convert_state_dict("llama", state, template)
+    got = np.asarray(model.apply(params, ids.astype(np.int32)))
+    np.testing.assert_allclose(got, want, rtol=2e-4, atol=2e-4)
+
+
+def test_unmapped_tensor_fails_loudly():
+    with pytest.raises(KeyError, match="unmapped"):
+        convert_state_dict(
+            "gpt2", {"h.0.attn.c_weird.weight": np.zeros((2, 2))}, {"params": {}}
+        )
+    with pytest.raises(ValueError, match="no HF converter"):
+        convert_state_dict("resnet", {}, {})
+
+
+def test_missing_tensor_fails_loudly():
+    cfg = GPT2Config(
+        vocab_size=16, n_positions=8, n_embd=8, n_layer=1, n_head=2, dtype="float32"
+    )
+    model = GPT2(cfg)
+    template = model.init(jax.random.key(0), np.zeros((1, 8), np.int32))
+    with pytest.raises(KeyError):
+        convert_state_dict("gpt2", {"wte.weight": np.zeros((16, 8), np.float32)}, template)
+
+
+def test_load_checkpoint_files_formats(tmp_path):
+    from safetensors.numpy import save_file
+
+    save_file({"a": np.ones(2, np.float32)}, str(tmp_path / "x.safetensors"))
+    torch.save({"b": torch.ones(3)}, tmp_path / "y.bin")
+    state = load_checkpoint_files(
+        [tmp_path / "x.safetensors", tmp_path / "y.bin", tmp_path / "z.json"]
+    )
+    assert set(state) == {"a", "b"}
+    assert state["b"].shape == (3,)
