@@ -1,0 +1,127 @@
+"""Parameter-server outer step: native C++ (mmap) vs Python safetensors.
+
+The PS outer step is the runtime's numerical hot spot outside JAX
+(SURVEY.md §2.9). This measures one aggregation round — N worker
+pseudo-gradient files -> weighted mean -> Nesterov -> update+momentum
+files — for a GPT-2-small-sized tree, comparing the native full-step path
+against the Python fallback.
+
+Run: python benchmarks/outer_step_bench.py [--params-m 124] [--workers 4]
+Prints one JSON line.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import tempfile
+import time
+from pathlib import Path
+
+import numpy as np
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+
+def make_deltas(tmp: Path, n_workers: int, params_m: float) -> list[Path]:
+    from safetensors.numpy import save_file
+
+    # A transformer-shaped tree: a few big matrices + many small ones.
+    total = int(params_m * 1e6)
+    shapes = {}
+    emb = int((total * 0.4) ** 0.5)
+    shapes["wte"] = (emb, emb)
+    rest = total - emb * emb
+    n_blocks = 12
+    per_block = rest // n_blocks
+    side = int((per_block / 4) ** 0.5)
+    for i in range(n_blocks):
+        shapes[f"h_{i}/attn"] = (side, 4 * side)
+
+    rng = np.random.default_rng(0)
+    paths = []
+    for k in range(n_workers):
+        tree = {
+            name: rng.standard_normal(shape).astype(np.float32)
+            for name, shape in shapes.items()
+        }
+        p = tmp / f"delta-{k}.safetensors"
+        save_file(tree, str(p))
+        paths.append(p)
+    return paths
+
+
+def bench_native(paths, weights, tmp: Path, reps: int) -> float | None:
+    from hypha_tpu import native
+
+    if not native.native_available():
+        return None
+    best = float("inf")
+    for r in range(reps):
+        t0 = time.perf_counter()
+        native.ps_outer_step(
+            paths, weights, None, tmp / f"mn-{r}.st", tmp / f"un-{r}.st", 0.7, 0.9
+        )
+        best = min(best, time.perf_counter() - t0)
+    return best
+
+
+def bench_python(paths, weights, tmp: Path, reps: int) -> float:
+    from safetensors.numpy import load_file, save_file
+
+    from hypha_tpu import native
+
+    best = float("inf")
+    for r in range(reps):
+        t0 = time.perf_counter()
+        trees = [load_file(str(p)) for p in paths]
+        momentum: dict = {}
+        update = {}
+        for key in trees[0]:
+            srcs = [t[key] for t in trees]
+            m = np.zeros(srcs[0].size, np.float32)
+            new_m, upd = native.fused_mean_nesterov(srcs, weights, m, 0.7, 0.9)
+            momentum[key] = new_m.reshape(srcs[0].shape)
+            update[key] = upd.reshape(srcs[0].shape)
+        save_file(update, str(tmp / f"up-{r}.st"))
+        save_file(momentum, str(tmp / f"mp-{r}.st"))
+        best = min(best, time.perf_counter() - t0)
+    return best
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--params-m", type=float, default=124.0)
+    parser.add_argument("--workers", type=int, default=4)
+    parser.add_argument("--reps", type=int, default=3)
+    args = parser.parse_args()
+
+    tmp = Path(tempfile.mkdtemp(prefix="hypha-psbench-"))
+    paths = make_deltas(tmp, args.workers, args.params_m)
+    total_bytes = sum(p.stat().st_size for p in paths)
+    weights = np.full(args.workers, 1.0 / args.workers, np.float32)
+
+    t_native = bench_native(paths, weights, tmp, args.reps)
+    t_python = bench_python(paths, weights, tmp, args.reps)
+
+    gb = total_bytes / (1 << 30)
+    result = {
+        "metric": "ps_outer_step",
+        "value": round(gb / t_native, 2) if t_native else round(gb / t_python, 2),
+        "unit": "GB/s_aggregated",
+        "native_s": round(t_native, 3) if t_native else None,
+        "python_s": round(t_python, 3),
+        "speedup": round(t_python / t_native, 2) if t_native else 1.0,
+        "workers": args.workers,
+        "params_m": args.params_m,
+        "vs_baseline": round(t_python / t_native, 2) if t_native else 1.0,
+    }
+    print(json.dumps(result))
+    import shutil
+
+    shutil.rmtree(tmp, ignore_errors=True)
+
+
+if __name__ == "__main__":
+    main()

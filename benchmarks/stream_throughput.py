@@ -1,0 +1,86 @@
+"""Fabric bulk-stream throughput: push a large tensor file peer-to-peer.
+
+The reference's only published quantitative network numbers are libp2p
+stream throughput (rfc/2025-03-25: 50-60 MB/s stock, ~1 GB/s with new
+yamux + parallel streams on loopback). This measures the same thing for
+our fabric: a pseudo-gradient-sized file pushed over real TCP loopback
+(one connection per stream, the design choice the reference's RFC landed
+on), with the receiver streaming to disk.
+
+Run: python benchmarks/stream_throughput.py [--mb 256] [--streams 4]
+Prints one JSON line.
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import os
+import sys
+import tempfile
+import time
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+
+async def run_bench(total_mb: int, streams: int) -> dict:
+    from hypha_tpu.network import TcpTransport
+    from hypha_tpu.network.node import Node
+
+    tmp = Path(tempfile.mkdtemp(prefix="hypha-bench-"))
+    per_stream = total_mb // streams
+    src = tmp / "payload.bin"
+    src.write_bytes(os.urandom(per_stream << 20))
+
+    a = Node(TcpTransport(), peer_id="sender")
+    b = Node(TcpTransport(), peer_id="receiver")
+    await a.start(["127.0.0.1:0"])
+    await b.start(["127.0.0.1:0"])
+    a.add_peer_addr("receiver", b.listen_addrs[0])
+
+    async def recv(i: int) -> int:
+        push = await b.next_push()
+        return await push.save_to(tmp / f"out-{i}.bin")
+
+    t0 = time.perf_counter()
+    results = await asyncio.gather(
+        *(recv(i) for i in range(streams)),
+        *(
+            a.push("receiver", {"resource": "bench", "name": f"p{i}"}, src)
+            for i in range(streams)
+        ),
+    )
+    elapsed = time.perf_counter() - t0
+    received = sum(results[:streams])
+    await a.stop()
+    await b.stop()
+    for p in tmp.iterdir():
+        p.unlink()
+    tmp.rmdir()
+
+    mb = received / (1 << 20)
+    return {
+        "metric": "stream_throughput",
+        "value": round(mb / elapsed, 1),
+        "unit": "MB/s",
+        "streams": streams,
+        "total_mb": round(mb, 1),
+        "seconds": round(elapsed, 3),
+        # reference context: stock libp2p 50-60 MB/s, tuned ~1 GB/s loopback
+        "vs_baseline": round((mb / elapsed) / 1024.0, 3),
+    }
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--mb", type=int, default=256)
+    parser.add_argument("--streams", type=int, default=4)
+    args = parser.parse_args()
+    result = asyncio.run(run_bench(args.mb, args.streams))
+    print(json.dumps(result))
+
+
+if __name__ == "__main__":
+    main()

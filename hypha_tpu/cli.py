@@ -244,7 +244,9 @@ async def _run_scheduler(conf: SchedulerConfig) -> None:
         )
         orch = Orchestrator(node, metrics_connector=connector)
         with tracer.span("run_job", {"dataset": conf.job.dataset}):
-            result = await orch.run(conf.job.to_job())
+            result = await orch.run(
+                conf.job.to_job(), max_attempts=conf.job.max_attempts
+            )
         print(f"job {result.job_id} completed: {result.rounds} rounds", flush=True)
     finally:
         await node.stop()

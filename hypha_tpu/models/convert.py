@@ -128,22 +128,47 @@ def convert_state_dict(
     return unflatten_like(flat, params_template)
 
 
+def _torch_to_np(t) -> np.ndarray:
+    """Torch tensor -> numpy, upcasting bf16 (numpy has no bfloat16; the
+    converter casts everything to f32 anyway)."""
+    import torch
+
+    if t.dtype == torch.bfloat16:
+        t = t.float()
+    return t.numpy()
+
+
 def load_checkpoint_files(paths: list[str | Path]) -> dict[str, np.ndarray]:
     """Load tensors from HF checkpoint files (.safetensors preferred,
-    torch .bin supported) into one numpy state dict."""
+    torch .bin supported) into one numpy state dict.
+
+    Real Llama-format repos store bf16, which safetensors' numpy loader
+    rejects — those fall back to the torch loader and upcast.
+    """
     state: dict[str, np.ndarray] = {}
     for path in paths:
         path = Path(path)
         if path.suffix == ".safetensors":
-            from safetensors.numpy import load_file
+            try:
+                from safetensors.numpy import load_file
 
-            state.update(load_file(str(path)))
+                state.update(load_file(str(path)))
+            except (TypeError, ValueError, RuntimeError):
+                from safetensors.torch import load_file as load_torch
+
+                state.update(
+                    {k: _torch_to_np(v) for k, v in load_torch(str(path)).items()}
+                )
         elif path.suffix in (".bin", ".pt", ".pth"):
             import torch
 
             loaded = torch.load(path, map_location="cpu", weights_only=True)
             state.update(
-                {k: v.numpy() for k, v in loaded.items() if hasattr(v, "numpy")}
+                {
+                    k: _torch_to_np(v)
+                    for k, v in loaded.items()
+                    if hasattr(v, "numpy")
+                }
             )
         else:
             log.debug("skipping non-checkpoint artifact %s", path)

@@ -233,10 +233,16 @@ class JobSection:
     checkpoint_every: int = field(
         default=1, metadata={"doc": "checkpoint every N completed rounds"}
     )
+    max_attempts: int = field(
+        default=1,
+        metadata={"doc": "re-run a failed job up to N times (elastic recovery)"},
+    )
 
     def validate(self) -> None:
         if not self.dataset:
             raise ConfigError("job.dataset is required")
+        if self.max_attempts < 1:
+            raise ConfigError("job.max_attempts must be >= 1")
         try:
             ModelType(self.model_type)
         except ValueError:

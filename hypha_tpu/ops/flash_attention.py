@@ -42,8 +42,13 @@ def _kernel(q_ref, k_ref, v_ref, o_ref, *, block_q, block_k, scale, causal, seq_
     acc0 = jnp.zeros((block_q, d), jnp.float32)
 
     if causal:
-        # K blocks at or below this q block's last row.
-        num_k_blocks = (qi * block_q + block_q + block_k - 1) // block_k
+        # K blocks at or below this q block's last row — clamped to the
+        # blocks that exist (Sq > Sk cross-length calls otherwise read
+        # out of bounds).
+        num_k_blocks = jnp.minimum(
+            (qi * block_q + block_q + block_k - 1) // block_k,
+            seq_k // block_k,
+        )
     else:
         num_k_blocks = seq_k // block_k
 

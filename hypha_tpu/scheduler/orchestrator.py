@@ -153,6 +153,49 @@ class Orchestrator:
         auction_timeout: float = 2.0,
         allocation_attempts: int = 3,
         status_timeout: float = 600.0,
+        max_attempts: int = 1,
+        retry_backoff: float = 11.0,
+    ) -> JobResult:
+        """Run the job; with ``max_attempts > 1``, a failed attempt (worker
+        death, stall) is re-run from scratch against whatever workers the
+        auction finds — and when the job has a ``checkpoint_dir`` the
+        replacement attempt warm-starts from the last completed round.
+
+        This is the elastic-recovery seam the reference leaves as future
+        work (rfc/2025-08-04 "Next Steps: Automatic Rescheduling";
+        worker.rs:62-70 NOTEs). ``retry_backoff`` defaults past the 10 s
+        lease TTL so the failed attempt's leases lapse and the surviving
+        workers' capacity frees before re-auctioning.
+        """
+        if max_attempts < 1:
+            raise ValueError("max_attempts must be >= 1")
+        last: JobFailed | AllocationError | None = None
+        for attempt in range(max_attempts):
+            if attempt:
+                log.warning(
+                    "job attempt %d/%d failed (%s); retrying in %.0fs",
+                    attempt, max_attempts, last, retry_backoff,
+                )
+                await asyncio.sleep(retry_backoff)
+            try:
+                return await self._run_once(
+                    job,
+                    auction_timeout=auction_timeout,
+                    allocation_attempts=allocation_attempts,
+                    status_timeout=status_timeout,
+                )
+            except (JobFailed, AllocationError) as e:
+                last = e
+        assert last is not None
+        raise last
+
+    async def _run_once(
+        self,
+        job: DiLoCoJob,
+        *,
+        auction_timeout: float = 2.0,
+        allocation_attempts: int = 3,
+        status_timeout: float = 600.0,
     ) -> JobResult:
         worker_offers = await self._allocate_train(
             job, auction_timeout=auction_timeout, attempts=allocation_attempts

@@ -232,3 +232,93 @@ def test_diloco_ps_colocated_with_train_worker(tmp_path):
 
     result = run(main())
     assert result.rounds == 1
+
+
+@pytest.mark.slow
+def test_elastic_retry_after_worker_death(tmp_path):
+    """Automatic rescheduling (the reference's explicit future work,
+    rfc/2025-08-04): a worker dies mid-job -> attempt fails via lease
+    renewal -> the orchestrator re-auctions and the retry completes,
+    warm-starting from the checkpoint."""
+
+    async def main():
+        import json
+
+        from hypha_tpu.executor.checkpoint import latest_manifest
+
+        hub = MemoryTransport()
+        gw = Gateway(hub.shared(), peer_id="gw")
+        await gw.start()
+        boot = [gw.node.listen_addrs[0]]
+        data = DataNode(
+            hub.shared(), {"toy": make_dataset(tmp_path)}, peer_id="data",
+            bootstrap=boot,
+        )
+        await data.start()
+
+        def mk_worker(name, tpu=4.0):
+            return WorkerNode(
+                hub.shared(),
+                resources=Resources(tpu=tpu, cpu=8, memory=1000),
+                peer_id=name,
+                offer=OfferConfig(strategy="whole"),
+                bootstrap=boot,
+                work_root=tmp_path / name,
+            )
+
+        w0, w1 = mk_worker("w0"), mk_worker("w1", tpu=2.0)
+        psw = WorkerNode(
+            hub.shared(), resources=Resources(cpu=2, memory=200), peer_id="psw",
+            bootstrap=boot, work_root=tmp_path / "psw",
+        )
+        for w in (w0, w1, psw):
+            await w.start()
+
+        sched = Node(hub.shared(), peer_id="sched", bootstrap=boot)
+        await sched.start()
+        await sched.wait_for_bootstrap()
+
+        tracked = []
+        orch = Orchestrator(
+            sched,
+            metrics_connector=CallbackConnector(
+                lambda w, r, n, v: tracked.append((w, r, n, v))
+            ),
+        )
+        job = diloco_job(rounds=3)
+        job.checkpoint_dir = str(tmp_path / "ckpt")
+
+        async def killer():
+            # Wait for round 0 to complete on some worker, then kill w1.
+            while not any(n == "loss" for (_w, _r, n, _v) in tracked):
+                await asyncio.sleep(0.05)
+            await w1.stop()
+
+        kill_task = asyncio.create_task(killer())
+        replacement = mk_worker("w2", tpu=2.0)
+        try:
+            run_task = asyncio.create_task(
+                orch.run(
+                    job,
+                    auction_timeout=1.5,
+                    status_timeout=30.0,
+                    max_attempts=2,
+                    retry_backoff=11.0,
+                )
+            )
+            await kill_task
+            # The replacement joins while attempt 1 is dying / backing off.
+            # Explicit address: the hub's auto-naming can collide with a
+            # slot freed by the stopped worker.
+            await replacement.start(["mem:replacement-w2"])
+            result = await run_task
+        finally:
+            for w in (w0, psw, replacement):
+                await w.stop()
+            await data.stop()
+            await sched.stop()
+            await gw.stop()
+        return result
+
+    result = run(main(), timeout=240)
+    assert result.rounds == 3
