@@ -1,51 +1,103 @@
-"""Benchmark: tokens/sec/chip of the jitted DiLoCo inner train step on the
-flagship model (GPT-2-small, bf16), the metric BASELINE.md asks this repo to
-establish. Prints ONE JSON line.
+"""Benchmark: tokens/sec/chip + MFU of the jitted DiLoCo inner train step on
+the flagship model (GPT-2-small, bf16), the metric BASELINE.md asks this repo
+to establish. Prints ONE JSON line on stdout; diagnostics go to stderr.
 
 The reference publishes no model-level numbers (BASELINE.json published={}),
 so ``vs_baseline`` is measured against the reference-stack estimate recorded
 in BENCH_BASELINE.json when present, else reported as 1.0 alongside the
 absolute number.
+
+Backend init is hardened (VERDICT r1 #1): the environment's remote-TPU PJRT
+plugin ("axon") can fail or HANG transiently at startup, and a hung PJRT
+init blocks in C and cannot be interrupted in-process. So the accelerator
+benchmark runs in a throwaway CHILD process (`bench.py --run <platform>`)
+under a timeout, retried with backoff; the parent only ever initializes the
+CPU backend (which cannot hang) for the fallback — the script always emits a
+parseable line.
 """
 
 from __future__ import annotations
 
 import json
 import os
+import subprocess
 import sys
 import time
 
+# Overall wall-clock budget for accelerator attempts before the CPU fallback.
+_DEADLINE_S = float(os.environ.get("HYPHA_BENCH_DEADLINE", "900"))
+# Per-attempt child timeout: must cover tunnel init + first compile + bench.
+_ATTEMPT_S = float(os.environ.get("HYPHA_BENCH_ATTEMPT_TIMEOUT", "480"))
 
-def main() -> None:
+
+def _log(msg: str) -> None:
+    print(f"[bench] {msg}", file=sys.stderr, flush=True)
+
+
+# bf16 peak FLOP/s per chip by device-kind substring (public TPU specs).
+_PEAK_FLOPS = {
+    "v6": 918e12,
+    "v5p": 459e12,
+    "v5e": 197e12,
+    "v5 lite": 197e12,
+    "v4": 275e12,
+    "v3": 123e12,
+    "v2": 45e12,
+}
+
+
+def _peak_flops(device) -> float | None:
+    kind = getattr(device, "device_kind", "").lower()
+    for key, val in _PEAK_FLOPS.items():
+        if key in kind:
+            return val
+    return None
+
+
+def _bench_line() -> dict:
+    """Run the benchmark on the CURRENT (already selected) backend."""
     import jax
     import jax.numpy as jnp
-
-    platform = jax.devices()[0].platform
-    on_accel = platform not in ("cpu",)
 
     from hypha_tpu.executor.train import TrainState, build_optimizer, make_train_step
     from hypha_tpu.messages import Adam
     from hypha_tpu.models import GPT2, GPT2Config
 
+    devices = jax.devices()
+    platform = devices[0].platform
+    on_accel = platform not in ("cpu",)
+
     if on_accel:
         cfg = GPT2Config.small()  # 124M params, bf16 activations
         B, S = 8, 1024
         steps, warmup = 20, 3
+        assert jnp.dtype(cfg.dtype) == jnp.bfloat16, "flagship bench must run bf16"
     else:  # CPU smoke fallback so the script always emits a line
         cfg = GPT2Config(vocab_size=512, n_positions=256, n_embd=128, n_layer=2, n_head=4)
         B, S = 2, 128
         steps, warmup = 3, 1
 
-    model = GPT2(cfg)
+    # On TPU the block runs the pallas flash kernel (forward + custom-VJP
+    # backward); off-TPU interpret mode is slower than XLA dense, so skip it.
+    attn = None
+    if on_accel:
+        from hypha_tpu.ops.flash_attention import flash_attention
+
+        attn = flash_attention
+    model = GPT2(cfg, attn_impl=attn)
     ids = jax.random.randint(jax.random.key(1), (B, S), 0, cfg.vocab_size)
     params = model.init(jax.random.key(0), ids)
     state = TrainState.create(params, build_optimizer(Adam(lr=1e-4)))
     step = make_train_step(model.apply)
     batch = {"input_ids": ids}
 
+    n_params = sum(x.size for x in jax.tree.leaves(params))
+
+    t_c0 = time.perf_counter()
     for _ in range(warmup):
         state, metrics = step(state, batch)
     jax.block_until_ready(metrics["loss"])
+    _log(f"warmup+compile {time.perf_counter() - t_c0:.1f}s; params {n_params / 1e6:.1f}M")
 
     t0 = time.perf_counter()
     for _ in range(steps):
@@ -57,6 +109,13 @@ def main() -> None:
     n_chips = 1  # single-chip inner loop benchmark
     value = tokens_per_sec / n_chips
 
+    # Training FLOPs/token (PaLM appendix accounting): 6N for the matmuls
+    # (fwd 2N + bwd 4N) + 12·L·E·S for attention score/value products.
+    flops_per_token = 6 * n_params + 12 * cfg.n_layer * cfg.n_embd * S
+    achieved_flops = flops_per_token * tokens_per_sec
+    peak = _peak_flops(devices[0])
+    mfu = achieved_flops / (peak * n_chips) if peak else None
+
     baseline = None
     try:
         with open(os.path.join(os.path.dirname(__file__), "BENCH_BASELINE.json")) as f:
@@ -65,25 +124,107 @@ def main() -> None:
         pass
     vs = value / baseline if baseline else 1.0
 
-    print(
-        json.dumps(
-            {
-                "metric": "gpt2s_train_tokens_per_sec_per_chip",
-                "value": round(value, 1),
-                "unit": "tokens/s/chip",
-                "vs_baseline": round(vs, 3),
-                "platform": platform,
-                "batch": B,
-                "seq": S,
-                "steps": steps,
-                "loss": float(metrics["loss"]),
-            }
-        )
-    )
+    return {
+        "metric": "gpt2s_train_tokens_per_sec_per_chip",
+        "value": round(value, 1),
+        "unit": "tokens/s/chip",
+        "vs_baseline": round(vs, 3),
+        "platform": platform,
+        "device_kind": getattr(devices[0], "device_kind", ""),
+        "batch": B,
+        "seq": S,
+        "steps": steps,
+        "params": n_params,
+        "mfu": round(mfu, 4) if mfu is not None else None,
+        "tflops_per_chip": round(achieved_flops / 1e12, 2),
+        "loss": float(metrics["loss"]),
+    }
+
+
+def _child_main(platform: str) -> int:
+    """``bench.py --run <platform>``: pin the platform, bench, emit."""
+    import jax
+
+    jax.config.update("jax_platforms", platform)
+    print(json.dumps(_bench_line()))
+    return 0
+
+
+def _accelerator_candidates() -> list[str]:
+    requested = os.environ.get("JAX_PLATFORMS") or os.environ.get("JAX_PLATFORM_NAME")
+    if requested:
+        first = requested.split(",")[0]
+        return [] if first == "cpu" else [first]
+    # Ask a child (cheap, no device init) which factories exist.
+    try:
+        out = subprocess.run(
+            [
+                sys.executable,
+                "-c",
+                "from jax._src import xla_bridge as xb;"
+                "print(','.join(xb._backend_factories))",
+            ],
+            capture_output=True,
+            text=True,
+            timeout=60,
+        ).stdout.strip()
+        factories = out.split(",") if out else []
+    except Exception:
+        factories = []
+    return [c for c in ("axon", "tpu") if c in factories]
+
+
+def main() -> None:
+    candidates = _accelerator_candidates()
+    deadline = time.monotonic() + _DEADLINE_S
+    last_err: str | None = None
+    attempt = 0
+    while candidates:
+        remaining = deadline - time.monotonic()
+        if remaining <= 0:
+            break
+        plat = candidates[attempt % len(candidates)]
+        budget = min(_ATTEMPT_S, max(30.0, remaining))
+        _log(f"attempt {attempt + 1}: platform '{plat}' in child (timeout {budget:.0f}s)")
+        try:
+            r = subprocess.run(
+                [sys.executable, os.path.abspath(__file__), "--run", plat],
+                capture_output=True,
+                text=True,
+                timeout=budget,
+                env={**os.environ, "JAX_PLATFORMS": plat},
+            )
+        except subprocess.TimeoutExpired:
+            last_err = f"{plat}: benchmark child timed out after {budget:.0f}s"
+            r = None
+        if r is not None:
+            sys.stderr.write(r.stderr or "")
+            if r.returncode == 0 and r.stdout.strip():
+                print(r.stdout.strip().splitlines()[-1])
+                return
+            tail = (r.stderr or r.stdout).strip().splitlines()
+            last_err = f"{plat}: {tail[-1] if tail else f'child rc={r.returncode}'}"
+        attempt += 1
+        pause = min(2.0**attempt, 15.0)
+        _log(f"attempt {attempt} failed ({last_err!r}); retry in {pause:.0f}s")
+        time.sleep(pause)
+
+    # CPU fallback in-process: the CPU backend cannot hang on init.
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    if last_err:
+        _log(f"accelerator attempts exhausted; falling back to CPU ({last_err})")
+    line = _bench_line()
+    if last_err:
+        line["accelerator_init_error"] = last_err
+    print(json.dumps(line))
 
 
 if __name__ == "__main__":
     try:
+        if len(sys.argv) >= 3 and sys.argv[1] == "--run":
+            sys.exit(_child_main(sys.argv[2]))
         main()
     except Exception as e:  # always emit a parseable line
         print(json.dumps({"metric": "error", "value": 0, "unit": "", "vs_baseline": 0, "error": str(e)}))

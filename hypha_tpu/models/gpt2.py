@@ -9,6 +9,7 @@ configurable dtype (bf16 on TPU); layer norms and softmax in f32.
 from __future__ import annotations
 
 from dataclasses import dataclass
+from typing import Callable
 
 import flax.linen as nn
 import jax.numpy as jnp
@@ -40,6 +41,7 @@ class GPT2Config:
 
 class _Block(nn.Module):
     config: GPT2Config
+    attn_impl: Callable | None = None
 
     @nn.compact
     def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
@@ -53,7 +55,7 @@ class _Block(nn.Module):
         q = q.reshape(B, S, cfg.n_head, hd)
         k = k.reshape(B, S, cfg.n_head, hd)
         v = v.reshape(B, S, cfg.n_head, hd)
-        attn = dot_product_attention(q, k, v, causal=True)
+        attn = (self.attn_impl or dot_product_attention)(q, k, v, causal=True)
         attn = attn.reshape(B, S, E)
         x = x + nn.Dense(E, dtype=dtype, name="c_proj")(attn)
 
@@ -66,6 +68,7 @@ class _Block(nn.Module):
 
 class GPT2(nn.Module):
     config: GPT2Config = GPT2Config()
+    attn_impl: Callable | None = None  # e.g. the pallas flash kernel
 
     @nn.compact
     def __call__(self, input_ids: jnp.ndarray) -> jnp.ndarray:
@@ -81,7 +84,7 @@ class GPT2(nn.Module):
         )
         x = (wte[input_ids] + wpe[None, :S]).astype(dtype)
         for i in range(cfg.n_layer):
-            x = _Block(cfg, name=f"h_{i}")(x)
+            x = _Block(cfg, self.attn_impl, name=f"h_{i}")(x)
         x = nn.LayerNorm(epsilon=cfg.layer_norm_epsilon, dtype=jnp.float32, name="ln_f")(x)
         # tied LM head: logits against the embedding matrix, f32 for the loss
         return jnp.einsum("bse,ve->bsv", x.astype(jnp.float32), wte)

@@ -71,6 +71,6 @@ def build_model(spec: dict[str, Any], attn_impl=None):
         import dataclasses
 
         cfg = dataclasses.replace(cfg, **overrides)
-    if family in ("lenet", "gpt2"):  # families without a pluggable attention
+    if family == "lenet":  # no attention to plug
         return module_cls(cfg), cfg
     return module_cls(cfg, attn_impl), cfg

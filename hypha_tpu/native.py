@@ -215,17 +215,26 @@ class SafeTensorsView:
         if not self._handle:
             raise ValueError(f"st_open({path}): {err.value.decode()}")
 
+    def _live_handle(self):
+        # After close() the C layer would dereference NULL -> SIGSEGV;
+        # surface a Python error instead.
+        if not self._handle:
+            raise ValueError("SafeTensorsView is closed")
+        return self._handle
+
     def keys(self) -> list[str]:
-        n = self._lib.st_count(self._handle)
-        return [self._lib.st_name(self._handle, i).decode() for i in range(n)]
+        handle = self._live_handle()
+        n = self._lib.st_count(handle)
+        return [self._lib.st_name(handle, i).decode() for i in range(n)]
 
     def tensor(self, name: str) -> np.ndarray:
+        handle = self._live_handle()
         nbytes = ctypes.c_int64()
         dtype_buf = ctypes.create_string_buffer(16)
         shape = (ctypes.c_int64 * 16)()
         ndim = ctypes.c_int()
         ptr = self._lib.st_tensor(
-            self._handle, name.encode(), ctypes.byref(nbytes),
+            handle, name.encode(), ctypes.byref(nbytes),
             dtype_buf, len(dtype_buf), shape, 16, ctypes.byref(ndim),
         )
         if not ptr:
@@ -234,6 +243,10 @@ class SafeTensorsView:
         if dtype is None:
             raise ValueError(f"unsupported dtype {dtype_buf.value!r} for {name}")
         buf = (ctypes.c_char * nbytes.value).from_address(ptr)
+        # The array's base chain ends at `buf`; anchor the view there so a
+        # GC'd SafeTensorsView can't munmap pages a live array still reads
+        # (explicit close() remains the caller's contract).
+        buf._owner = self
         arr = np.frombuffer(buf, dtype=dtype)
         # The mapping is PROT_READ: an in-place write through a writable
         # view would SIGSEGV, not raise. Make numpy enforce it.
@@ -245,6 +258,12 @@ class SafeTensorsView:
         if self._handle:
             self._lib.st_close(self._handle)
             self._handle = None
+
+    def __del__(self) -> None:  # leak guard; safe: arrays anchor self via buf
+        try:
+            self.close()
+        except Exception:
+            pass
 
     def __enter__(self) -> "SafeTensorsView":
         return self

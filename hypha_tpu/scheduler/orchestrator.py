@@ -425,10 +425,13 @@ class Orchestrator:
                 )
                 if not done:
                     continue  # re-check the watchdog, keep waiting
-                first = next(iter(done))
-                if waiters[first] == "complete":
+                # Completion wins ties: when a worker's lease-renewal failure
+                # lands in the same asyncio.wait round as job completion
+                # (plausible during teardown), the job must not be reported
+                # failed and re-executed.
+                if any(waiters[t] == "complete" for t in done):
                     return
-                raise JobFailed(str(first.result()))
+                raise JobFailed(str(next(iter(done)).result()))
         finally:
             for t in waiters:
                 t.cancel()

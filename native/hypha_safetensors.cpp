@@ -238,7 +238,9 @@ struct StFile {
     if (map == MAP_FAILED) { map = nullptr; *error = "mmap failed"; return false; }
     uint64_t hlen;
     std::memcpy(&hlen, map, 8);  // little-endian hosts only (x86/arm64)
-    if (8 + static_cast<int64_t>(hlen) > size) { *error = "header overruns file"; return false; }
+    // Unsigned compare: `8 + (int64_t)hlen > size` wraps negative (UB) for
+    // hlen near INT64_MAX and would pass the check on a hostile header.
+    if (hlen > static_cast<uint64_t>(size) - 8) { *error = "header overruns file"; return false; }
     const char *json = static_cast<const char *>(map) + 8;
     data = json + hlen;
     data_size = size - 8 - static_cast<int64_t>(hlen);

@@ -1,0 +1,32 @@
+"""The driver's entry points must never rot: exercise the EXACT functions the
+driver runs (`__graft_entry__.entry` / `dryrun_multichip`) on the virtual
+8-device CPU mesh (VERDICT r1 weak #5)."""
+
+import pathlib
+import sys
+
+import jax
+import pytest
+
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parent.parent))
+
+import __graft_entry__ as graft  # noqa: E402
+
+
+@pytest.mark.slow
+def test_dryrun_multichip_8():
+    graft.dryrun_multichip(8)
+
+
+def test_force_cpu_devices_idempotent():
+    devs = graft._force_cpu_devices(8)
+    assert len(devs) >= 8 and devs[0].platform == "cpu"
+    # second call must not clear/re-init a good backend
+    assert graft._force_cpu_devices(8)[0] is devs[0]
+
+
+@pytest.mark.slow
+def test_entry_compiles_single_chip():
+    fn, (params, ids) = graft.entry()
+    lowered = jax.jit(fn).lower(params, ids)
+    assert lowered.compile() is not None
