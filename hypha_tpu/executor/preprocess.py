@@ -1,0 +1,129 @@
+"""Preprocessor pipeline: the reference's 5 HF Auto processor kinds, applied
+to configured slice keys before batching.
+
+Parity with executors/accelerate/.../utils.py ``get_preprocessor`` (builds
+AutoProcessor / AutoFeatureExtractor / AutoImageProcessor / AutoTokenizer /
+AutoVideoProcessor from fetched artifacts) and dataset.py:10-41 (pops the
+``processor_inputs`` keys from each slice, runs the processor, merges the
+outputs back before per-sample iteration).
+
+Job-spec shape (TrainExecutorConfig.preprocessor):
+
+    {"kind": Preprocessor, "source": Fetch, "inputs": ["text"],
+     "options": {...forwarded to the processor call...}}
+
+TPU-native note: slices are SafeTensors, so every value is a fixed-shape
+numeric array. Text for the tokenizer kind rides as fixed-width uint8
+utf-8 rows (trailing NULs stripped) — decoded here, tokenized with
+padding="max_length" so batch shapes stay static for XLA.
+"""
+
+from __future__ import annotations
+
+import logging
+from pathlib import Path
+from typing import Any, Callable
+
+import numpy as np
+
+from ..messages import Preprocessor
+
+__all__ = ["build_preprocessor", "make_apply"]
+
+log = logging.getLogger("hypha.executor.preprocess")
+
+_AUTO_CLASSES = {
+    Preprocessor.TOKENIZER: "AutoTokenizer",
+    Preprocessor.IMAGE_PROCESSOR: "AutoImageProcessor",
+    Preprocessor.FEATURE_EXTRACTOR: "AutoFeatureExtractor",
+    Preprocessor.PROCESSOR: "AutoProcessor",
+    Preprocessor.VIDEO_PROCESSOR: "AutoVideoProcessor",
+}
+
+
+def _decode_text_rows(arr: np.ndarray) -> list[str]:
+    """[N, W] uint8 utf-8 rows (NUL-padded) → list of N strings."""
+    if arr.dtype != np.uint8:
+        raise ValueError(f"tokenizer input must be uint8 rows, got {arr.dtype}")
+    rows = np.atleast_2d(arr)
+    return [bytes(r).rstrip(b"\x00").decode("utf-8", errors="replace") for r in rows]
+
+
+def load_processor(kind: Preprocessor | str, path: str | Path):
+    """Instantiate the HF Auto processor for ``kind`` from a local dir/file."""
+    import transformers
+
+    kind = kind if isinstance(kind, Preprocessor) else Preprocessor(kind)
+    cls = getattr(transformers, _AUTO_CLASSES[kind])
+    return cls.from_pretrained(str(path), local_files_only=True)
+
+
+def make_apply(
+    processor: Any,
+    kind: Preprocessor,
+    inputs: list[str],
+    options: dict[str, Any] | None = None,
+) -> Callable[[dict[str, np.ndarray]], dict[str, np.ndarray]]:
+    """Wrap an HF processor as the dataset's slice-level hook: pop ``inputs``
+    keys, run the processor, merge its arrays back (dataset.py:25-30)."""
+    options = dict(options or {})
+    if kind is Preprocessor.TOKENIZER:
+        options.setdefault("padding", "max_length")
+        options.setdefault("truncation", True)
+        options.setdefault(
+            "max_length", getattr(processor, "model_max_length", 128) or 128
+        )
+        if options["max_length"] > 4096:  # HF's "unset" sentinel is huge
+            options["max_length"] = 128
+
+    def apply(tensors: dict[str, np.ndarray]) -> dict[str, np.ndarray]:
+        taken = {k: tensors.pop(k) for k in inputs if k in tensors}
+        if not taken:
+            return tensors
+        if kind is Preprocessor.TOKENIZER:
+            texts: list[str] = []
+            for v in taken.values():
+                texts.extend(_decode_text_rows(v))
+            out = processor(texts, return_tensors="np", **options)
+        else:
+            out = processor(*taken.values(), return_tensors="np", **options)
+        processed = {k: np.asarray(v) for k, v in dict(out).items()}
+        return {**processed, **tensors}
+
+    return apply
+
+
+def build_preprocessor(
+    spec: dict[str, Any],
+    session: Any,
+    work_dir: Path,
+) -> Callable[[dict[str, np.ndarray]], dict[str, np.ndarray]] | None:
+    """Fetch the processor artifacts via the bridge and build the slice hook.
+
+    Returns None when the spec is empty — jobs without a preprocessor stream
+    slices untouched (utils.py:38: ``if preprocessor_config``).
+    """
+    if not spec:
+        return None
+    from .. import messages
+
+    kind = spec.get("kind", Preprocessor.TOKENIZER)
+    kind = kind if isinstance(kind, Preprocessor) else Preprocessor(kind)
+    inputs = list(spec.get("inputs") or [])
+    if not inputs:
+        raise ValueError("preprocessor spec needs 'inputs': slice keys to process")
+
+    path = spec.get("path")
+    if not path:
+        source = spec.get("source")
+        if source is None:
+            raise ValueError("preprocessor spec needs 'source' (Fetch) or 'path'")
+        fetch = messages.from_json_dict(source) if isinstance(source, dict) else source
+        rels = session.fetch(fetch)
+        if not rels:
+            raise ValueError("preprocessor fetch returned no artifacts")
+        first = work_dir / rels[0]
+        path = first.parent if len(rels) > 1 or first.is_dir() else first.parent
+    processor = load_processor(kind, path)
+    log.info("preprocessor: %s from %s on keys %s", kind.value, path, inputs)
+    return make_apply(processor, kind, inputs, spec.get("options"))

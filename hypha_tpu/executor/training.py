@@ -113,6 +113,20 @@ def _init_model(cfg: TrainExecutorConfig, session, work_dir: Path, first_batch):
         from ..ops.flash_attention import flash_attention
 
         attn_impl = flash_attention
+
+    source = model_spec.get("source")
+    if model_spec.get("family") == "hf" and source is not None and not model_spec.get("path"):
+        # The hf family loads weights via from_pretrained, so the checkpoint
+        # dir must exist BEFORE the model is built (the native families
+        # init-then-overwrite below instead).
+        fetch = messages.from_json_dict(source) if isinstance(source, dict) else source
+        rels = session.fetch(fetch)
+        cfg_file = next((r for r in rels if r.endswith("config.json")), None)
+        model_spec["path"] = str(
+            (work_dir / cfg_file).parent if cfg_file else work_dir
+        )
+        source = None  # weights are loaded by the builder; skip the overwrite
+
     model, _mcfg = build_model(model_spec, attn_impl)
     model_type = resolve_model_type(model_spec.get("model_type", ModelType.CAUSAL_LM))
     causal_lm = model_type not in _NON_CAUSAL
@@ -122,7 +136,6 @@ def _init_model(cfg: TrainExecutorConfig, session, work_dir: Path, first_batch):
     seed = int(model_spec.get("seed", 0))
     params = model.init(jax.random.key(seed), inputs)
 
-    source = model_spec.get("source")
     if source is not None:
         fetch = messages.from_json_dict(source) if isinstance(source, dict) else source
         rels = session.fetch(fetch)
@@ -175,7 +188,12 @@ def run_training(
 
     model_spec = dict(cfg.model)
     input_names = model_spec.get("input_names")
-    stream = stream_batches(fetch_slice, cfg.batch_size, input_names)
+    preprocessor = None
+    if cfg.preprocessor:
+        from .preprocess import build_preprocessor
+
+        preprocessor = build_preprocessor(cfg.preprocessor, session, work_dir)
+    stream = stream_batches(fetch_slice, cfg.batch_size, input_names, preprocessor)
 
     first_batch = next(stream)
     model, params, causal_lm, has_aux = _init_model(cfg, session, work_dir, first_batch)

@@ -1,0 +1,160 @@
+"""HF-transformers fallback family: non-native ModelTypes as Flax modules.
+
+The reference resolves all 38 ``ModelType`` variants through HF
+``AutoModelFor*`` torch classes (executors/accelerate/.../model.py:48-123).
+The TPU-native equivalent resolves them through the **Flax** auto classes —
+native JAX modules that jit/shard like any other model here — wrapped in the
+framework's model protocol (``init(rng, inputs) -> params`` /
+``apply(params, inputs) -> logits``) so the jitted train step, Δθ
+extraction, and checkpointing are family-agnostic.
+
+Torch-only checkpoints convert on load (``from_pt=True``); ModelTypes HF
+ships no Flax head for raise a clear error naming the type — the reference's
+torch breadth on those heads has no JAX counterpart to wrap.
+"""
+
+from __future__ import annotations
+
+import logging
+from pathlib import Path
+from typing import Any
+
+import jax
+import numpy as np
+
+from ..messages import ModelType
+
+__all__ = ["HFFlaxModel", "build_hf_model", "FLAX_AUTO_CLASSES"]
+
+log = logging.getLogger("hypha.models.hf")
+
+# ModelType → transformers Flax auto-class name. Only types with a Flax
+# implementation appear; the rest raise in build_hf_model.
+FLAX_AUTO_CLASSES: dict[ModelType, str] = {
+    ModelType.CAUSAL_LM: "FlaxAutoModelForCausalLM",
+    ModelType.MASKED_LM: "FlaxAutoModelForMaskedLM",
+    ModelType.SEQ2SEQ_LM: "FlaxAutoModelForSeq2SeqLM",
+    ModelType.SEQUENCE_CLASSIFICATION: "FlaxAutoModelForSequenceClassification",
+    ModelType.TOKEN_CLASSIFICATION: "FlaxAutoModelForTokenClassification",
+    ModelType.QUESTION_ANSWERING: "FlaxAutoModelForQuestionAnswering",
+    ModelType.MULTIPLE_CHOICE: "FlaxAutoModelForMultipleChoice",
+    ModelType.NEXT_SENTENCE_PREDICTION: "FlaxAutoModelForNextSentencePrediction",
+    ModelType.IMAGE_CLASSIFICATION: "FlaxAutoModelForImageClassification",
+    ModelType.VISION2SEQ: "FlaxAutoModelForVision2Seq",
+    ModelType.IMAGE_TEXT_TO_TEXT: "FlaxAutoModelForVision2Seq",
+    ModelType.SPEECH_SEQ2SEQ: "FlaxAutoModelForSpeechSeq2Seq",
+    ModelType.PRETRAINING: "FlaxAutoModelForPreTraining",
+    ModelType.FEATURE_EXTRACTION: "FlaxAutoModel",
+}
+
+_PIXEL_TYPES = {
+    ModelType.IMAGE_CLASSIFICATION,
+    ModelType.VISION2SEQ,
+    ModelType.IMAGE_TEXT_TO_TEXT,
+}
+_DECODER_TYPES = {ModelType.SEQ2SEQ_LM, ModelType.SPEECH_SEQ2SEQ}
+
+
+class HFFlaxModel:
+    """Adapter: HF Flax model → the framework's (init, apply) protocol."""
+
+    def __init__(self, flax_model: Any, model_type: ModelType) -> None:
+        self._model = flax_model
+        self.model_type = model_type
+        if model_type in _PIXEL_TYPES:
+            self.input_kw = "pixel_values"
+        elif model_type is ModelType.SPEECH_SEQ2SEQ:
+            self.input_kw = "input_features"
+        else:
+            self.input_kw = "input_ids"
+
+    @property
+    def config(self) -> Any:
+        return self._model.config
+
+    def init(self, rng: Any, inputs: Any) -> Any:
+        """Return the (already materialized) param tree; rng/inputs are part
+        of the protocol signature but from_pretrained/from_config own the
+        actual initialization."""
+        del rng, inputs
+        return self._model.params
+
+    def apply(self, params: Any, inputs: Any) -> Any:
+        kwargs: dict[str, Any] = {self.input_kw: inputs}
+        if self.model_type in _DECODER_TYPES:
+            # v1 contract: the single streamed input feeds both sides (the
+            # batch layout carries no separate decoder stream yet).
+            kwargs["decoder_input_ids"] = inputs
+        out = self._model(params=params, train=False, **kwargs)
+        for attr in ("logits", "prediction_logits", "last_hidden_state"):
+            if hasattr(out, attr):
+                return getattr(out, attr)
+        return out[0]
+
+
+def _has_flax_weights(path: Path) -> bool:
+    return any(path.glob("*.msgpack")) or any(path.glob("flax_model*.bin"))
+
+
+def build_hf_model(
+    spec: dict[str, Any], model_type: ModelType
+) -> tuple[HFFlaxModel, Any]:
+    """Build from a job's model spec: ``path`` (a fetched HF checkpoint dir
+    with config.json [+ weights]) loads pretrained; ``hf_config`` (a dict of
+    HF config fields incl. ``model_type``) random-inits from config."""
+    try:
+        import transformers
+    except Exception as e:  # pragma: no cover — transformers is baked in
+        raise RuntimeError("transformers unavailable for the hf family") from e
+
+    cls_name = FLAX_AUTO_CLASSES.get(model_type)
+    if cls_name is None:
+        supported = ", ".join(sorted(t.value for t in FLAX_AUTO_CLASSES))
+        raise NotImplementedError(
+            f"ModelType {model_type.value!r} has no HF Flax head; "
+            f"hf-family types: {supported}"
+        )
+    auto_cls = getattr(transformers, cls_name)
+
+    dtype = spec.get("dtype", "float32")
+    jdtype = {"float32": jax.numpy.float32, "bfloat16": jax.numpy.bfloat16}[dtype]
+    path = spec.get("path")
+    if path:
+        path = Path(path)
+        from_pt = not _has_flax_weights(path)
+        model = auto_cls.from_pretrained(
+            str(path), dtype=jdtype, from_pt=from_pt, local_files_only=True
+        )
+        log.info(
+            "hf: loaded %s from %s (%s weights)",
+            cls_name, path, "torch-converted" if from_pt else "flax",
+        )
+    else:
+        hf_config = spec.get("hf_config")
+        if not hf_config:
+            raise ValueError(
+                "hf family needs model.path (fetched checkpoint dir) or "
+                "model.hf_config ({'model_type': ..., ...} HF config fields)"
+            )
+        config = transformers.AutoConfig.for_model(**dict(hf_config))
+        model = auto_cls.from_config(config, seed=int(spec.get("seed", 0)), dtype=jdtype)
+        log.info("hf: random-initialized %s (%s)", cls_name, config.model_type)
+    # numpy params → jax arrays once, so the first jitted step doesn't pay
+    # a per-leaf host transfer inside tracing.
+    model.params = jax.tree.map(jax.numpy.asarray, model.params)
+    return HFFlaxModel(model, model_type), model.config
+
+
+def hf_state_dict(model: HFFlaxModel) -> dict[str, np.ndarray]:
+    """Flatten params with '/'-joined names for SafeTensors export."""
+    flat = {}
+
+    def walk(prefix: str, tree: Any) -> None:
+        if isinstance(tree, dict):
+            for k, v in tree.items():
+                walk(f"{prefix}/{k}" if prefix else k, v)
+        else:
+            flat[prefix] = np.asarray(tree)
+
+    walk("", model._model.params)
+    return flat

@@ -54,10 +54,10 @@ def build_model(spec: dict[str, Any], attn_impl=None):
             ModelType.IMAGE_CLASSIFICATION: "lenet",
         }.get(mt, "hf")
     if family == "hf":
-        raise NotImplementedError(
-            "HF-converted model types are resolved by the executor's weight "
-            "converter; native families: " + ", ".join(FAMILIES)
-        )
+        from .hf import build_hf_model
+
+        mt = resolve_model_type(spec.get("model_type", ModelType.CAUSAL_LM))
+        return build_hf_model(spec, mt)
     if family not in FAMILIES:
         raise ValueError(f"unknown model family {family!r}")
     module_cls, config_cls = FAMILIES[family]
