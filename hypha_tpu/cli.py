@@ -80,6 +80,15 @@ def _make_node(conf, *, registry_server: bool = False, peer_id: str | None = Non
     otherwise (dev mode)."""
     from .network.node import Node
 
+    node_kwargs = dict(
+        bootstrap=list(conf.network.gateways),
+        registry_server=registry_server,
+        exclude_cidrs=list(conf.network.exclude_cidrs),
+        # Non-gateway nodes hold circuit reservations at their gateways so
+        # NAT'd peers stay reachable (reference listens on relay circuits by
+        # default, crates/network/src/listen.rs:25-131).
+        relay_listen=not registry_server and getattr(conf.network, "relay", True),
+    )
     if conf.tls.enabled():
         from .network.secure import secure_node
 
@@ -88,18 +97,12 @@ def _make_node(conf, *, registry_server: bool = False, peer_id: str | None = Non
             conf.tls.key,
             conf.tls.trust,
             conf.tls.crls or None,
-            bootstrap=list(conf.network.gateways),
-            registry_server=registry_server,
+            **node_kwargs,
         )
     else:
         from .network.fabric import TcpTransport
 
-        node = Node(
-            TcpTransport(),
-            peer_id=peer_id or conf.name,
-            bootstrap=list(conf.network.gateways),
-            registry_server=registry_server,
-        )
+        node = Node(TcpTransport(), peer_id=peer_id or conf.name, **node_kwargs)
     node.external_addrs = list(conf.network.external)
     return node
 

@@ -109,10 +109,15 @@ def _init_model(cfg: TrainExecutorConfig, session, work_dir: Path, first_batch):
     # default (sequence-parallel jobs swap in the ring kernel instead, via
     # _build_mesh); off-TPU the XLA dense path is faster than interpret mode.
     attn_impl = None
-    if jax.default_backend() == "tpu" and not cfg.sharding:
+    from ..hw import is_accelerator
+
+    if is_accelerator() and not cfg.sharding:
         from ..ops.flash_attention import flash_attention
 
         attn_impl = flash_attention
+        log.info("attention path: pallas flash kernel (backend=%s)", jax.default_backend())
+    else:
+        log.info("attention path: XLA dense (backend=%s)", jax.default_backend())
 
     source = model_spec.get("source")
     if model_spec.get("family") == "hf" and source is not None and not model_spec.get("path"):

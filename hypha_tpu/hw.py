@@ -1,0 +1,38 @@
+"""Hardware/backend detection.
+
+The one question the framework keeps asking is "is the active JAX backend a
+real accelerator?" — to pick the pallas flash kernel vs the XLA dense path,
+and to select pallas interpret mode for CPU tests. The answer must NOT be a
+string compare against ``"tpu"``: remote-TPU PJRT plugins register under
+their own platform names (this environment's tunnel registers as ``"axon"``)
+while still being TPU hardware that lowers pallas-TPU kernels. Anything that
+is not the CPU backend is treated as hardware.
+
+Reference seam: the reference picks its compute device via torch/Accelerate
+device strings (``executors/accelerate/src/hypha/accelerate_executor/
+training.py``); this is the TPU-native equivalent of that selection.
+"""
+
+from __future__ import annotations
+
+
+# Backends that are definitely NOT TPUs: the CPU backend and GPU platform
+# names. Anything else (tpu itself, or a remote-TPU plugin under its own
+# name) is treated as TPU hardware.
+_NON_TPU_BACKENDS = frozenset({"cpu", "gpu", "cuda", "rocm", "metal"})
+
+
+def is_accelerator() -> bool:
+    """True when the active JAX backend is TPU-class hardware that lowers
+    the pallas-TPU kernels (pltpu VMEM scratch etc.). GPU backends count as
+    non-TPU: they'd fail to lower the kernels, so they take the XLA dense
+    path like CPU does."""
+    import jax
+
+    return jax.default_backend().lower() not in _NON_TPU_BACKENDS
+
+
+def interpret_default() -> bool:
+    """Pallas interpret-mode default: interpret everywhere except on a
+    TPU-class backend."""
+    return not is_accelerator()

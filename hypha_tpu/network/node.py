@@ -52,6 +52,12 @@ log = logging.getLogger("hypha.network")
 
 PROTOCOL_GOSSIP = "/hypha-gossip/0.0.1"
 PROTOCOL_REGISTRY = "/hypha-registry/0.0.1"
+# Circuit relay through the gateway — the fabric's answer to the reference's
+# libp2p relay server + circuit listen addresses (crates/gateway/src/
+# network.rs:41-48 relay::Behaviour; crates/network/src/listen.rs:25-131
+# relay-circuit listeners). Streams between two NAT'd peers are spliced
+# byte-for-byte at the gateway.
+PROTOCOL_RELAY = "/hypha-relay/0.0.1"
 # Tensor stream protocol ids follow the reference names
 # (crates/network/src/stream_push.rs:16, stream_pull.rs:21).
 PROTOCOL_PUSH = "/hypha-tensor-stream/push"
@@ -65,12 +71,40 @@ MAX_STREAM_HEADER = 1024 * 1024
 ACCEPT_LIMIT = 8
 # Providers age out unless re-announced (clients refresh every 30 s).
 PROVIDER_TTL = 90.0
+# How long the relay waits for the reserved peer to dial back and accept a
+# circuit before failing the dialer's connect.
+RELAY_ACCEPT_TIMEOUT = 15.0
 
 _SEEN_CAP = 4096  # gossip dedup cache entries
 
 
 class RequestError(RuntimeError):
     """Remote handler failed or RPC transport failed."""
+
+
+class ExcludedAddressError(ConnectionError):
+    """Dial target falls inside a configured ``exclude_cidrs`` range."""
+
+
+def _parse_cidrs(cidrs: list[str]):
+    import ipaddress
+
+    return [ipaddress.ip_network(c, strict=False) for c in cidrs]
+
+
+def _addr_host(addr: str) -> str:
+    return addr.rpartition(":")[0].strip("[]")
+
+
+def _addr_ip(addr: str):
+    """The literal IP of a ``host:port`` fabric address, or None for
+    non-IP addresses (memory transport, hostnames)."""
+    import ipaddress
+
+    try:
+        return ipaddress.ip_address(_addr_host(addr))
+    except ValueError:
+        return None
 
 
 @dataclass(slots=True)
@@ -330,6 +364,32 @@ class _CountingStream(Stream):
         await self._inner.abort()
 
 
+class _RelayStream(Stream):
+    """A stream riding a gateway circuit. The TLS certificate on the socket
+    is the *gateway's*, so certificate-derived identity checks don't apply;
+    instead the stream carries the peer id the (cert-verified, trusted
+    infrastructure) gateway attested for the far end. End-to-end payload
+    privacy through the relay matches the deployment's trust in gateways —
+    the reference's relay server likewise terminates transport security per
+    hop (crates/gateway/src/network.rs:41-48)."""
+
+    def __init__(self, inner: Stream, attested_peer: str) -> None:
+        self._inner = inner
+        self.attested_peer = attested_peer
+
+    async def read(self, n: int = 65536) -> bytes:
+        return await self._inner.read(n)
+
+    async def write(self, data: bytes) -> None:
+        await self._inner.write(data)
+
+    async def close(self) -> None:
+        await self._inner.close()
+
+    async def abort(self) -> None:
+        await self._inner.abort()
+
+
 class Node:
     """One fabric identity: listen addresses, peerstore, typed services."""
 
@@ -340,6 +400,9 @@ class Node:
         bootstrap: list[str] | None = None,
         registry_server: bool = False,
         expected_peer_id: Callable[[Stream], str | None] | None = None,
+        relay_server: bool | None = None,
+        relay_listen: bool = False,
+        exclude_cidrs: list[str] | None = None,
     ) -> None:
         self.transport = transport
         self.peer_id = peer_id or f"peer-{uuid.uuid4().hex[:16]}"
@@ -371,10 +434,21 @@ class Node:
         self._pull_handler: Callable[[str, Any, Stream], Awaitable[None]] | None = None
         self._tasks: set[asyncio.Task] = set()
         self._closed = False
+        # relay (gateway circuit) state: gateways serve circuits by default
+        # (reference: the gateway IS the relay server, gateway/network.rs:44)
+        self._relay_server = registry_server if relay_server is None else relay_server
+        self._relay_listen = relay_listen
+        self._relay_controls: dict[str, Stream] = {}  # reserved peer -> ctrl
+        self._relay_pending: dict[str, dict] = {}  # circuit id -> record
+        # Addresses never dialed, enforced on EVERY dial — the reference
+        # checks its CIDR exclusion list on each outbound connection
+        # (crates/network/src/dial.rs:28-41,164).
+        self._exclude_nets = _parse_cidrs(exclude_cidrs or [])
         # inbound/outbound byte counters (telemetry bandwidth role,
         # reference crates/telemetry/src/bandwidth.rs)
         self.bytes_in = 0
         self.bytes_out = 0
+        self.bytes_relayed = 0
 
     # ------------------------------------------------------------------ core
 
@@ -390,6 +464,12 @@ class Node:
             self.listen_addrs.append(bound)
         if self._bootstrap_addrs:
             self._spawn(self._bootstrap_loop())
+            if self._relay_listen:
+                # Keep a circuit reservation alive at every gateway — the
+                # reference's relay-circuit listen addresses
+                # (crates/network/src/listen.rs:25-131).
+                for gw in self._bootstrap_addrs:
+                    self._spawn(self._relay_reserve_loop(gw))
         else:
             self._bootstrapped.set()  # self-anchored (tests / gateway itself)
 
@@ -457,7 +537,18 @@ class Node:
             log.debug("bad handshake: %s", e)
             await stream.abort()
             return
-        if self._expected_peer_id is not None:
+        if isinstance(stream, _RelayStream):
+            # Identity through a circuit comes from the gateway's attestation
+            # (the gateway cert-verified the dialer); the socket cert is the
+            # gateway's and proves nothing about the far end.
+            if stream.attested_peer and peer != stream.attested_peer:
+                log.warning(
+                    "relayed peer id %s does not match gateway attestation %s",
+                    peer, stream.attested_peer,
+                )
+                await stream.abort()
+                return
+        elif self._expected_peer_id is not None:
             expected = self._expected_peer_id(stream)
             if expected is not None and expected != peer:
                 log.warning("peer id %s does not match certificate %s", peer, expected)
@@ -469,6 +560,8 @@ class Node:
         try:
             if proto == PROTOCOL_GOSSIP:
                 await self._handle_gossip(peer, stream)
+            elif proto == PROTOCOL_RELAY:
+                await self._handle_relay(peer, stream)
             elif proto == PROTOCOL_REGISTRY:
                 await self._handle_registry(peer, stream)
             elif proto == PROTOCOL_PUSH:
@@ -557,7 +650,40 @@ class Node:
 
     # ---------------------------------------------------------------- dialing
 
+    async def _check_dialable(self, addr: str) -> None:
+        """Every outbound dial funnels through here — the reference enforces
+        its CIDR exclusion on each dial attempt against the *resolved*
+        connection address (dial.rs:28-41,164), so hostnames are resolved
+        and every A/AAAA answer checked; spelling an excluded IP as a DNS
+        name does not evade the policy."""
+        if not self._exclude_nets:
+            return
+        ips = []
+        ip = _addr_ip(addr)
+        if ip is not None:
+            ips = [ip]
+        else:
+            host = _addr_host(addr)
+            if host:
+                import ipaddress
+                import socket
+
+                try:
+                    infos = await asyncio.get_running_loop().getaddrinfo(
+                        host, None, type=socket.SOCK_STREAM
+                    )
+                    ips = [ipaddress.ip_address(i[4][0]) for i in infos]
+                except (OSError, ValueError):
+                    # Not a resolvable host — a transport-specific address
+                    # (memory fabric etc.); no IP policy applies.
+                    return
+        for ip in ips:
+            for net in self._exclude_nets:
+                if ip.version == net.version and ip in net:
+                    raise ExcludedAddressError(f"{addr} is in excluded CIDR {net}")
+
     async def _open_raw(self, addr: str, proto: str) -> Stream:
+        await self._check_dialable(addr)
         stream = await self.transport.dial(addr)
         await stream.write_frame(
             {"from": self.peer_id, "proto": proto, "addr": self.primary_addr()}
@@ -569,8 +695,20 @@ class Node:
         if not addrs:
             found = await self._lookup_peer(peer_id)
             addrs = list(found)
+        # Direct routes first; circuit routes are the fallback. If the peer
+        # advertises no relay address, its gateways still might hold a
+        # reservation — try ours last (dial-fallback-to-relay).
+        addrs.sort(key=lambda a: a.startswith("relay:"))
+        if not any(a.startswith("relay:") for a in addrs):
+            addrs += [f"relay:{gw}" for gw in self._bootstrap_addrs]
         last_err: Exception | None = None
         for addr in addrs:
+            if addr.startswith("relay:"):
+                try:
+                    return await self._dial_via_relay(addr[len("relay:"):], peer_id, proto)
+                except (ConnectionError, OSError, FrameError, RequestError) as e:
+                    last_err = e
+                    continue
             try:
                 stream = await self._open_raw(addr, proto)
             except (ConnectionError, OSError) as e:
@@ -591,6 +729,173 @@ class Node:
                     continue
             return stream
         raise RequestError(f"no route to {peer_id}: {last_err}")
+
+    # ----------------------------------------------------------------- relay
+    #
+    # Wire (all frames ride PROTOCOL_RELAY streams after the normal hello):
+    #   listener -> gateway   {"t":"reserve"}            long-lived control
+    #   gateway  -> listener  {"t":"incoming","circuit","from"}   on control
+    #   dialer   -> gateway   {"t":"connect","target"}   becomes circuit leg A
+    #   listener -> gateway   {"t":"accept","circuit"}   becomes circuit leg B
+    # After both legs ack'd the gateway splices A<->B byte-for-byte; the
+    # dialer then speaks the ordinary stream protocol through the circuit.
+    # Reference: crates/gateway/src/network.rs:41-48 (relay server),
+    # crates/network/src/listen.rs:25-131 (circuit listen addresses).
+
+    async def _handle_relay(self, peer: str, stream: Stream) -> None:
+        frame = await stream.read_frame()
+        t = frame.get("t")
+        if not self._relay_server:
+            await stream.write_frame({"ok": False, "error": "not a relay server"})
+            return
+        if t == "reserve":
+            old = self._relay_controls.get(peer)
+            self._relay_controls[peer] = stream
+            if old is not None:
+                await old.abort()
+            await stream.write_frame({"ok": True})
+            log.debug("relay reservation for %s", peer)
+            try:
+                # Park until the listener drops; EOF tears the reservation.
+                while await stream.read(65536):
+                    pass
+            finally:
+                if self._relay_controls.get(peer) is stream:
+                    del self._relay_controls[peer]
+        elif t == "connect":
+            target = frame.get("target", "")
+            ctrl = self._relay_controls.get(target)
+            if ctrl is None:
+                await stream.write_frame(
+                    {"ok": False, "error": f"no relay reservation for {target}"}
+                )
+                return
+            circuit = uuid.uuid4().hex
+            fut: asyncio.Future = asyncio.get_running_loop().create_future()
+            self._relay_pending[circuit] = {"dialer": peer, "fut": fut}
+            try:
+                await ctrl.write_frame({"t": "incoming", "circuit": circuit, "from": peer})
+                leg_b, done = await asyncio.wait_for(fut, RELAY_ACCEPT_TIMEOUT)
+            except (asyncio.TimeoutError, FrameError, ConnectionError, OSError) as e:
+                self._relay_pending.pop(circuit, None)
+                await stream.write_frame(
+                    {"ok": False, "error": f"relay accept failed: {e!r}"}
+                )
+                return
+            try:
+                # The ok-frame write can itself fail (dialer timed out and
+                # dropped); done.set() must run regardless or the parked
+                # accept handler and the listener leg leak forever.
+                await stream.write_frame({"ok": True, "peer": target})
+                await self._splice(stream, leg_b)
+            finally:
+                done.set()
+        elif t == "accept":
+            rec = self._relay_pending.pop(frame.get("circuit", ""), None)
+            if rec is None or rec["fut"].done():
+                await stream.write_frame({"ok": False, "error": "unknown circuit"})
+                return
+            await stream.write_frame({"ok": True, "peer": rec["dialer"]})
+            done = asyncio.Event()
+            rec["fut"].set_result((stream, done))
+            # Hold the accept handler open for the life of the circuit — the
+            # transport closes the socket when this returns.
+            await done.wait()
+        else:
+            await stream.write_frame({"ok": False, "error": f"unknown relay op {t!r}"})
+
+    async def _splice(self, a: Stream, b: Stream) -> None:
+        """Pump bytes both ways until both directions EOF; half-close each
+        destination as its source drains so in-flight replies survive."""
+
+        async def pump(src: Stream, dst: Stream) -> None:
+            try:
+                self.bytes_relayed += await copy_stream(src, dst)
+            finally:
+                try:
+                    await dst.close()
+                except (ConnectionError, OSError):
+                    pass
+
+        await asyncio.gather(pump(a, b), pump(b, a), return_exceptions=True)
+
+    async def _relay_reserve_loop(self, gw_addr: str) -> None:
+        """Keep one circuit reservation alive at ``gw_addr``; advertise the
+        circuit address so other peers can route to us through it."""
+        backoff = 0.25
+        relay_addr = f"relay:{gw_addr}"
+        while not self._closed:
+            try:
+                stream = await self._open_raw(gw_addr, PROTOCOL_RELAY)
+                try:
+                    await stream.write_frame({"t": "reserve"})
+                    reply = await stream.read_frame()
+                    if not reply.get("ok", False):
+                        raise RequestError(reply.get("error", "reserve refused"))
+                    if relay_addr not in self.external_addrs:
+                        self.external_addrs.append(relay_addr)
+                    log.debug("relay reservation live at %s", gw_addr)
+                    backoff = 0.25
+                    while True:
+                        frame = await stream.read_frame()
+                        if frame.get("t") == "incoming":
+                            self._spawn(
+                                self._relay_accept(gw_addr, frame.get("circuit", ""))
+                            )
+                finally:
+                    await stream.abort()
+            except asyncio.CancelledError:
+                raise
+            except (ConnectionError, OSError, FrameError, RequestError) as e:
+                log.debug("relay reservation at %s dropped: %s", gw_addr, e)
+            await asyncio.sleep(backoff)
+            backoff = min(backoff * 2, 5.0)
+
+    async def _relay_accept(self, gw_addr: str, circuit: str) -> None:
+        """Dial back to the gateway to complete an announced circuit, then
+        serve it like any inbound stream."""
+        try:
+            stream = await self._open_raw(gw_addr, PROTOCOL_RELAY)
+        except (ConnectionError, OSError) as e:
+            log.debug("relay accept dial to %s failed: %s", gw_addr, e)
+            return
+        try:
+            await stream.write_frame({"t": "accept", "circuit": circuit})
+            reply = await stream.read_frame()
+            if not reply.get("ok", False):
+                raise RequestError(reply.get("error", "accept refused"))
+            dialer = reply.get("peer", "")
+        except (FrameError, ConnectionError, OSError, RequestError) as e:
+            log.debug("relay accept for circuit %s failed: %s", circuit, e)
+            await stream.abort()
+            return
+        await self._on_stream(_RelayStream(stream, attested_peer=dialer))
+
+    async def _dial_via_relay(self, gw_addr: str, target: str, proto: str) -> Stream:
+        """Open a circuit to ``target`` through the gateway at ``gw_addr``.
+        Returns the raw circuit; the caller speaks ``proto`` through it
+        starting with the ordinary hello frame."""
+        stream = await self._open_raw(gw_addr, PROTOCOL_RELAY)
+        try:
+            await stream.write_frame({"t": "connect", "target": target})
+            reply = await asyncio.wait_for(
+                stream.read_frame(), RELAY_ACCEPT_TIMEOUT + 5.0
+            )
+        except (FrameError, ConnectionError, OSError, asyncio.TimeoutError) as e:
+            await stream.abort()
+            raise RequestError(f"relay connect via {gw_addr} failed: {e!r}") from e
+        if not reply.get("ok", False):
+            await stream.abort()
+            raise RequestError(reply.get("error", "relay connect refused"))
+        attested = reply.get("peer", "")
+        if attested and attested != target:
+            await stream.abort()
+            raise RequestError(f"relay attested {attested}, wanted {target}")
+        relayed = _RelayStream(stream, attested_peer=attested)
+        await relayed.write_frame(
+            {"from": self.peer_id, "proto": proto, "addr": self.primary_addr()}
+        )
+        return relayed
 
     # ---------------------------------------------------------------- gossip
 

@@ -26,6 +26,7 @@ def secure_node(
     crl_file: str | Path | None = None,
     bootstrap: list[str] | None = None,
     registry_server: bool = False,
+    **node_kwargs,
 ) -> Node:
     """A Node whose transport is mTLS and whose peer id is its cert-key hash.
 
@@ -63,4 +64,5 @@ def secure_node(
         bootstrap=bootstrap,
         registry_server=registry_server,
         expected_peer_id=expected_peer_id,
+        **node_kwargs,
     )

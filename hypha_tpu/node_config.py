@@ -49,6 +49,10 @@ class NetworkConfig:
         default_factory=list,
         metadata={"doc": "CIDR ranges never dialed (scheduler network.rs CIDR exclusion)"},
     )
+    relay: bool = field(
+        default=True,
+        metadata={"doc": "hold gateway circuit reservations so NAT'd peers can reach us"},
+    )
 
 
 @dataclass

@@ -378,7 +378,9 @@ def flash_attention(
         k = jnp.repeat(k, reps, axis=2)
         v = jnp.repeat(v, reps, axis=2)
     if interpret is None:
-        interpret = jax.default_backend() not in ("tpu",)
+        from ..hw import interpret_default
+
+        interpret = interpret_default()
     scale = softmax_scale if softmax_scale is not None else D**-0.5
 
     # [B, S, H, D] -> [B*H, S, D]
