@@ -238,12 +238,19 @@ def generate_crl(
 # ---------------------------------------------------------------------------
 
 
+def peer_id_from_spki_der(spki: bytes) -> str:
+    """PeerID from a DER SubjectPublicKeyInfo — the one identity derivation
+    shared by the cert layer and gossip message signing (a gossip frame's
+    embedded key must hash to its claimed origin)."""
+    return "12H" + hashlib.sha256(spki).hexdigest()[:40]
+
+
 def peer_id_from_cert_der(der: bytes) -> str:
     cert = x509.load_der_x509_certificate(der)
     spki = cert.public_key().public_bytes(
         serialization.Encoding.DER, serialization.PublicFormat.SubjectPublicKeyInfo
     )
-    return "12H" + hashlib.sha256(spki).hexdigest()[:40]
+    return peer_id_from_spki_der(spki)
 
 
 def peer_id_from_cert_pem(pem: bytes) -> str:

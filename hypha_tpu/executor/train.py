@@ -139,24 +139,67 @@ def make_train_step(
     causal_lm: bool = True,
     has_aux: bool = False,
     donate: bool = True,
+    dropout_seed: int | None = None,
+    labels_aligned: bool = False,
 ):
     """Build the jitted train step.
 
     ``apply_fn(params, batch_inputs)`` returns logits (or (logits, aux_loss)
-    when ``has_aux`` — the MoE router loss). For causal LM the labels are the
-    inputs shifted left; otherwise the batch carries explicit ``labels``.
+    when ``has_aux`` — the MoE router loss). Apply functions may opt into
+    richer calling conventions by declaring keyword params (inspected once
+    at build time, so the jitted call stays static):
+
+      * ``rng``   — a per-step dropout key (folded from ``dropout_seed`` and
+        the step counter), enabling train-mode stochasticity; the reference
+        trains its torch models in train() mode (training.py:106-116).
+      * ``batch`` — the full batch dict, for models that consume extra
+        streams (e.g. seq2seq ``decoder_input_ids``).
+
+    For causal LM the labels are the *target stream* shifted left — the
+    decoder stream when the batch carries one, else the inputs; otherwise
+    the batch carries explicit ``labels``.
     Returns ``step(state, batch) -> (state, metrics)``.
     """
+    import inspect
 
-    def loss_fn(params, batch):
+    try:
+        sig = set(inspect.signature(apply_fn).parameters)
+    except (TypeError, ValueError):
+        sig = set()
+    wants_rng = "rng" in sig and dropout_seed is not None
+    wants_batch = "batch" in sig
+
+    def loss_fn(params, batch, step_no):
         inputs = batch["input_ids"] if "input_ids" in batch else batch["inputs"]
-        out = apply_fn(params, inputs)
+        kwargs = {}
+        if wants_rng:
+            kwargs["rng"] = jax.random.fold_in(jax.random.key(dropout_seed), step_no)
+        if wants_batch:
+            kwargs["batch"] = batch
+        out = apply_fn(params, inputs, **kwargs)
         aux = jnp.float32(0)
         if has_aux:
             out, aux = out
         if causal_lm:
-            logits = out[:, :-1]
-            labels = inputs[:, 1:]
+            # Teacher forcing over the target stream. Three layouts:
+            #   * decoder_input_ids AND labels (HF convention: decoder is
+            #     labels shifted right) — out[t] already predicts labels[t],
+            #     no further shift;
+            #   * decoder stream only — next-token within the decoder;
+            #   * otherwise — next-token over labels (== inputs by default).
+            dec = batch.get("decoder_input_ids")
+            explicit = batch.get("labels")
+            if explicit is not None and (dec is not None or labels_aligned):
+                # Decoder inputs are labels shifted right (either supplied
+                # by the batch or shifted inside the model — the
+                # ``labels_aligned`` seq2seq contract): out[t] predicts
+                # labels[t] already.
+                logits, labels = out, explicit
+            elif dec is not None:
+                logits, labels = out[:, :-1], dec[:, 1:]
+            else:
+                target = explicit if explicit is not None else inputs
+                logits, labels = out[:, :-1], target[:, 1:]
         else:
             logits = out
             labels = batch["labels"]
@@ -165,7 +208,7 @@ def make_train_step(
 
     def step(state: TrainState, batch) -> tuple:
         (total, (loss, aux)), grads = jax.value_and_grad(loss_fn, has_aux=True)(
-            state.params, batch
+            state.params, batch, state.step
         )
         new_state = state.apply_gradients(grads)
         metrics = {

@@ -231,7 +231,21 @@ def run_training(
                 )
 
     loss_kind = cfg.loss or Loss.CROSS_ENTROPY
-    step = make_train_step(model.apply, loss_kind, causal_lm=causal_lm, has_aux=has_aux)
+    from ..models.hf import _DECODER_TYPES
+
+    step = make_train_step(
+        model.apply,
+        loss_kind,
+        causal_lm=causal_lm,
+        has_aux=has_aux,
+        # Models that declare an ``rng`` kwarg (the hf family) train with
+        # live dropout, keyed per-step from the job seed — the reference
+        # trains its torch models in train() mode (training.py:106-116).
+        dropout_seed=int(dict(cfg.model).get("seed", 0)),
+        # Seq2seq hf models shift labels into decoder inputs internally, so
+        # their logits are already aligned with the labels stream.
+        labels_aligned=getattr(model, "model_type", None) in _DECODER_TYPES,
+    )
 
     if mesh is not None:
         from jax.sharding import NamedSharding

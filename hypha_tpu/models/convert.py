@@ -96,10 +96,28 @@ def _llama_key(key: str) -> tuple[str, bool] | None:
     raise KeyError(f"unmapped llama tensor {key!r}")
 
 
+def _qwen2_key(key: str) -> tuple[str, bool] | None:
+    """Qwen2 is Llama-architecture plus q/k/v projection biases."""
+    m = re.fullmatch(
+        r"model\.layers\.(\d+)\.self_attn\.([qkv]_proj)\.bias", key
+    )
+    if m is not None:
+        return f"params/layers_{m.group(1)}/self_attn/{m.group(2)}/bias", False
+    return _llama_key(key)
+
+
+# Mistral checkpoints are weight-identical to Llama (the sliding window is a
+# config property, not a tensor); Qwen2 adds attention biases.
 HF_CONVERTERS = {
     "gpt2": _gpt2_key,
     "llama": _llama_key,
+    "mistral": _llama_key,
+    "qwen2": _qwen2_key,
 }
+
+# Llama-architecture families whose checkpoints may tie the LM head to the
+# embeddings (no lm_head.weight tensor on disk).
+_TIED_HEAD_FAMILIES = {"llama", "mistral", "qwen2"}
 
 
 def convert_state_dict(
@@ -125,7 +143,23 @@ def convert_state_dict(
         if transpose:
             arr = np.ascontiguousarray(arr.T)
         flat[name] = arr.astype(np.float32, copy=False)
+    if (
+        family in _TIED_HEAD_FAMILIES
+        and "params/lm_head" not in flat
+        and "params/embed_tokens" in flat
+        and _template_has(params_template, "lm_head")
+    ):
+        # Tied-embedding checkpoint into an untied template: materialize the
+        # head from the embeddings rather than failing or training silently
+        # from random head weights.
+        log.info("%s: tied checkpoint — materializing lm_head from embeddings", family)
+        flat["params/lm_head"] = flat["params/embed_tokens"]
     return unflatten_like(flat, params_template)
+
+
+def _template_has(template: Any, leaf: str) -> bool:
+    params = template.get("params", template) if isinstance(template, dict) else {}
+    return isinstance(params, dict) and leaf in params
 
 
 def _torch_to_np(t) -> np.ndarray:

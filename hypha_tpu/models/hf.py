@@ -79,13 +79,44 @@ class HFFlaxModel:
         del rng, inputs
         return self._model.params
 
-    def apply(self, params: Any, inputs: Any) -> Any:
+    def apply(self, params: Any, inputs: Any, *, rng: Any = None, batch: Any = None) -> Any:
+        """Forward pass. ``rng`` (supplied by the train step) switches the
+        model into train mode with live dropout — matching the reference's
+        torch train() mode (training.py:106-116); without it the pass is
+        deterministic (eval). ``batch`` provides extra streams: seq2seq
+        types take real ``decoder_input_ids`` from it (fallbacks: labels,
+        then the encoder stream)."""
         kwargs: dict[str, Any] = {self.input_kw: inputs}
         if self.model_type in _DECODER_TYPES:
-            # v1 contract: the single streamed input feeds both sides (the
-            # batch layout carries no separate decoder stream yet).
-            kwargs["decoder_input_ids"] = inputs
-        out = self._model(params=params, train=False, **kwargs)
+            dec = None
+            if batch is not None:
+                dec = batch.get("decoder_input_ids")
+                if dec is None and batch.get("labels") is not None:
+                    # HF shift_tokens_right: labels become decoder inputs by
+                    # prepending the start token; -100 ignore-sentinels must
+                    # NOT reach the embedding table (negative indices wrap).
+                    import jax.numpy as jnp
+
+                    labels = batch["labels"]
+                    cfg = self._model.config
+                    pad = getattr(cfg, "pad_token_id", None)
+                    start = getattr(cfg, "decoder_start_token_id", None)
+                    if start is None:
+                        start = pad if pad is not None else 0
+                    if pad is None:
+                        pad = 0
+                    shifted = jnp.concatenate(
+                        [jnp.full_like(labels[:, :1], start), labels[:, :-1]],
+                        axis=1,
+                    )
+                    dec = jnp.where(shifted == -100, pad, shifted)
+            kwargs["decoder_input_ids"] = dec if dec is not None else inputs
+        if rng is not None:
+            kwargs["dropout_rng"] = rng
+            kwargs["train"] = True
+        else:
+            kwargs["train"] = False
+        out = self._model(params=params, **kwargs)
         for attr in ("logits", "prediction_logits", "last_hidden_state"):
             if hasattr(out, attr):
                 return getattr(out, attr)

@@ -7,6 +7,12 @@ grouped-query attention. Param tree names are chosen to map 1:1 onto HF
 shard the sequence axis and swap the attention core for the ring kernel
 (hypha_tpu.ops.ring_attention) — the model takes an ``attn_impl`` hook so the
 executor can lower attention onto the mesh without redefining the model.
+
+The same module also hosts the Llama-ARCHITECTURE descendants the reference
+reaches through torch AutoModel (model.py:48-123) but HF ships no Flax port
+for: **Mistral** (sliding-window attention; otherwise weight-identical) and
+**Qwen2** (q/k/v projection biases, optionally tied embeddings) — selected
+via config fields, converted via models.convert.
 """
 
 from __future__ import annotations
@@ -36,10 +42,37 @@ class LlamaConfig:
     rope_theta: float = 10_000.0
     rms_eps: float = 1e-5
     dtype: str = "bfloat16"
+    # Architecture toggles for Llama descendants:
+    attn_bias: bool = False  # Qwen2: biases on q/k/v projections
+    sliding_window: int | None = None  # Mistral: local attention window
+    tie_word_embeddings: bool = False  # Qwen2-small: lm_head = embeddings
 
     @classmethod
     def llama2_7b(cls) -> "LlamaConfig":
         return cls()
+
+    @classmethod
+    def from_hf(cls, d: dict, **overrides) -> "LlamaConfig":
+        """Map an HF ``config.json`` dict (llama / mistral / qwen2) onto the
+        native config, so real checkpoint dirs load without hand-mapping."""
+        fields = dict(
+            vocab_size=d.get("vocab_size", 32_000),
+            hidden_size=d.get("hidden_size", 4096),
+            intermediate_size=d.get("intermediate_size", 11_008),
+            num_layers=d.get("num_hidden_layers", 32),
+            num_heads=d.get("num_attention_heads", 32),
+            num_kv_heads=d.get(
+                "num_key_value_heads", d.get("num_attention_heads", 32)
+            ),
+            max_seq_len=d.get("max_position_embeddings", 4096),
+            rope_theta=d.get("rope_theta", 10_000.0),
+            rms_eps=d.get("rms_norm_eps", 1e-5),
+            attn_bias=d.get("model_type") == "qwen2",
+            sliding_window=d.get("sliding_window"),
+            tie_word_embeddings=d.get("tie_word_embeddings", False),
+        )
+        fields.update(overrides)
+        return cls(**fields)
 
     @classmethod
     def tiny(cls) -> "LlamaConfig":
@@ -78,15 +111,25 @@ class _Attention(nn.Module):
         dtype = jnp.dtype(cfg.dtype)
         B, S, E = x.shape
         hd = cfg.head_dim
-        q = nn.Dense(cfg.num_heads * hd, use_bias=False, dtype=dtype, name="q_proj")(x)
-        k = nn.Dense(cfg.num_kv_heads * hd, use_bias=False, dtype=dtype, name="k_proj")(x)
-        v = nn.Dense(cfg.num_kv_heads * hd, use_bias=False, dtype=dtype, name="v_proj")(x)
+        bias = cfg.attn_bias
+        q = nn.Dense(cfg.num_heads * hd, use_bias=bias, dtype=dtype, name="q_proj")(x)
+        k = nn.Dense(cfg.num_kv_heads * hd, use_bias=bias, dtype=dtype, name="k_proj")(x)
+        v = nn.Dense(cfg.num_kv_heads * hd, use_bias=bias, dtype=dtype, name="v_proj")(x)
         q = q.reshape(B, S, cfg.num_heads, hd)
         k = k.reshape(B, S, cfg.num_kv_heads, hd)
         v = v.reshape(B, S, cfg.num_kv_heads, hd)
         q = apply_rope(q, cos, sin)
         k = apply_rope(k, cos, sin)
-        attn = (self.attn_impl or dot_product_attention)(q, k, v, causal=True)
+        window = cfg.sliding_window
+        if window is not None and S > window:
+            # Mistral local attention: position i sees (i-window, i]. Only
+            # the dense path takes a mask; window jobs use it (a windowed
+            # pallas kernel would go through attn_impl the same way).
+            pos = jnp.arange(S)
+            local = (pos[None, :] > pos[:, None] - window)[None, None]
+            attn = dot_product_attention(q, k, v, causal=True, mask=local)
+        else:
+            attn = (self.attn_impl or dot_product_attention)(q, k, v, causal=True)
         attn = attn.reshape(B, S, cfg.num_heads * hd)
         return nn.Dense(E, use_bias=False, dtype=dtype, name="o_proj")(attn)
 
@@ -141,10 +184,13 @@ class Llama(nn.Module):
         for i in range(cfg.num_layers):
             x = _Block(cfg, self.attn_impl, name=f"layers_{i}")(x, cos, sin)
         x = _RMSNorm(cfg.rms_eps, name="norm")(x)
-        lm_head = self.param(
-            "lm_head",
-            nn.initializers.normal(0.02),
-            (cfg.vocab_size, cfg.hidden_size),
-            jnp.float32,
-        )
+        if cfg.tie_word_embeddings:
+            lm_head = embed  # Qwen2-small convention: head shares embeddings
+        else:
+            lm_head = self.param(
+                "lm_head",
+                nn.initializers.normal(0.02),
+                (cfg.vocab_size, cfg.hidden_size),
+                jnp.float32,
+            )
         return jnp.einsum("bse,ve->bsv", x.astype(jnp.float32), lm_head)

@@ -2,9 +2,11 @@
 
 The reference maps 38 ``ModelType`` variants to HF ``AutoModelFor*`` classes
 (executors/accelerate/.../model.py:48-123). Here the flagship families
-(GPT-2, Llama, Mixtral, LeNet) are native JAX definitions; other model types
-resolve through the HF-transformers fallback (converted torch weights) when
-``transformers`` is importable, and raise a clear error otherwise.
+(GPT-2, Llama + its Mistral/Qwen2 descendants, Mixtral, LeNet) are native
+JAX definitions; of the remaining ModelTypes, the 14 with an HF **Flax**
+head resolve through the hf fallback family (torch checkpoints convert via
+``from_pt``), and types with neither a native family nor a Flax head raise
+a clear error naming the type — HF ships no JAX implementation to wrap.
 
 A model spec is the ``model`` dict of a TrainExecutorConfig:
   {"model_type": ModelType, "family": "gpt2"|"llama"|"mixtral"|"lenet"|"hf",
@@ -33,8 +35,19 @@ _PRESETS = {
 FAMILIES = {
     "gpt2": (GPT2, GPT2Config),
     "llama": (Llama, LlamaConfig),
+    # Llama-architecture descendants HF ships no Flax port for — the
+    # reference reaches them via torch AutoModel (model.py:48-123); here
+    # they are the native Llama module under family-specific config defaults
+    # with converted torch weights (models.convert).
+    "mistral": (Llama, LlamaConfig),
+    "qwen2": (Llama, LlamaConfig),
     "mixtral": (Mixtral, MixtralConfig),
     "lenet": (LeNet, LeNetConfig),
+}
+
+# Architecture toggles implied by the family name.
+_FAMILY_DEFAULTS: dict[str, dict[str, Any]] = {
+    "qwen2": {"attn_bias": True},
 }
 
 
@@ -62,11 +75,17 @@ def build_model(spec: dict[str, Any], attn_impl=None):
         raise ValueError(f"unknown model family {family!r}")
     module_cls, config_cls = FAMILIES[family]
     preset = spec.get("preset")
+    hf_config = spec.get("hf_config")
     if preset is not None:
-        cfg = _PRESETS[family][preset]()
+        cfg = _PRESETS.get(family, {}).get(preset)
+        cfg = cfg() if cfg is not None else config_cls()
+    elif hf_config is not None and hasattr(config_cls, "from_hf"):
+        # A fetched checkpoint's config.json fields drive the native config
+        # (llama / mistral / qwen2).
+        cfg = config_cls.from_hf(dict(hf_config))
     else:
         cfg = config_cls()
-    overrides = spec.get("config") or {}
+    overrides = {**_FAMILY_DEFAULTS.get(family, {}), **(spec.get("config") or {})}
     if overrides:
         import dataclasses
 

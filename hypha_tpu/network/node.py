@@ -92,6 +92,63 @@ def _parse_cidrs(cidrs: list[str]):
     return [ipaddress.ip_network(c, strict=False) for c in cidrs]
 
 
+# Signed gossip frames carry a timestamp covered by the signature; frames
+# outside this window (stale or future-dated) are dropped, bounding replay
+# of captured frames to the window even after the seen-cache evicts them.
+GOSSIP_MAX_SKEW_S = 120.0
+
+
+def _gossip_seen_key(
+    msg_id: str, sig: bytes | None, canonical: bytes = b""
+) -> str:
+    """Dedup key binding the message id to the signature AND the canonical
+    signed bytes, so a forged frame (altered body/origin/ts, or a reused
+    genuine signature over altered data) can never occupy the genuine
+    frame's dedup slot — while byte-identical flood copies still dedup
+    cheaply (one sha256, no Ed25519 verify) and repeated identical
+    forgeries dedup too."""
+    if sig is None:
+        return msg_id
+    import hashlib
+
+    return msg_id + ":" + hashlib.sha256(canonical + sig).hexdigest()[:16]
+
+
+def _gossip_sign_bytes(
+    topic: str, msg_id: str, origin: str, ts_ns: int, body: bytes
+) -> bytes:
+    """Canonical byte string covered by a gossip signature: every field a
+    relay could tamper with, under a domain-separation prefix."""
+    from .. import codec
+
+    return codec.dumps(["hypha-gossip-sig", topic, msg_id, origin, ts_ns, body])
+
+
+def _gossip_verify(
+    topic: str, msg_id: str, origin: str, ts_ns: int, body: bytes, key: bytes, sig: bytes
+) -> bool:
+    """Self-certifying verification: the embedded SPKI public key must hash
+    to the claimed origin peer id (same derivation as cert identities), and
+    the Ed25519 signature must cover the canonical frame bytes. No key
+    distribution needed — the id IS the key hash."""
+    from cryptography.exceptions import InvalidSignature
+    from cryptography.hazmat.primitives.asymmetric import ed25519
+    from cryptography.hazmat.primitives.serialization import load_der_public_key
+
+    from ..certs import peer_id_from_spki_der
+
+    try:
+        if peer_id_from_spki_der(key) != origin:
+            return False
+        pub = load_der_public_key(key)
+        if not isinstance(pub, ed25519.Ed25519PublicKey):
+            return False
+        pub.verify(sig, _gossip_sign_bytes(topic, msg_id, origin, ts_ns, body))
+        return True
+    except (InvalidSignature, ValueError, TypeError):
+        return False
+
+
 def _addr_host(addr: str) -> str:
     return addr.rpartition(":")[0].strip("[]")
 
@@ -403,6 +460,7 @@ class Node:
         relay_server: bool | None = None,
         relay_listen: bool = False,
         exclude_cidrs: list[str] | None = None,
+        gossip_key=None,
     ) -> None:
         self.transport = transport
         self.peer_id = peer_id or f"peer-{uuid.uuid4().hex[:16]}"
@@ -444,6 +502,13 @@ class Node:
         # checks its CIDR exclusion list on each outbound connection
         # (crates/network/src/dial.rs:28-41,164).
         self._exclude_nets = _parse_cidrs(exclude_cidrs or [])
+        # Ed25519PrivateKey (the node-cert key) for gossip message signing —
+        # the reference signs gossipsub messages with the swarm keypair
+        # (crates/scheduler/src/network.rs:132-136). When a key is present
+        # the mesh is permissioned and unsigned/invalid frames are DROPPED;
+        # keyless (dev-mode) nodes accept unsigned frames but still reject
+        # frames whose signature fails to verify.
+        self._gossip_key = gossip_key
         # inbound/outbound byte counters (telemetry bandwidth role,
         # reference crates/telemetry/src/bandwidth.rs)
         self.bytes_in = 0
@@ -914,17 +979,38 @@ class Node:
             lst.remove(sub)
 
     async def publish(self, topic: str, msg: Any) -> None:
-        """Flood ``msg`` to the mesh. NOTE on attribution: the ``origin``
-        delivered to subscribers is relay-supplied and advisory — gossip
-        carries only discovery/auction ads in a permissioned (mTLS) network,
-        and every security-relevant follow-up (offers, leases, dispatch)
-        happens over cert-verified RPC. Do not authorize based on gossip
-        origin; message signing is tracked as future hardening."""
+        """Flood ``msg`` to the mesh. When the node has a ``gossip_key``
+        (every mTLS node does), the frame carries an Ed25519 signature by
+        the origin's cert key and receivers verify key-hash == origin, so
+        the ``origin`` delivered to subscribers is authenticated end-to-end
+        across relays (reference: signed gossipsub,
+        crates/scheduler/src/network.rs:132-136), and a signed timestamp
+        bounds replay of captured frames to GOSSIP_MAX_SKEW_S. Within that
+        window a mesh member can still re-flood a captured frame, so treat
+        gossip as advertisement, not authorization — security-relevant
+        follow-ups (offers, leases, dispatch) run over cert-verified RPC.
+        Keyless dev-mode nodes flood unsigned and accept unsigned."""
         msg_id = uuid.uuid4().hex
-        self._mark_seen(msg_id)
         body = messages.encode(msg)
+        key = sig = None
+        ts_ns = time.time_ns()
+        if self._gossip_key is not None:
+            from cryptography.hazmat.primitives import serialization
+
+            key = self._gossip_key.public_key().public_bytes(
+                serialization.Encoding.DER,
+                serialization.PublicFormat.SubjectPublicKeyInfo,
+            )
+            canonical = _gossip_sign_bytes(topic, msg_id, self.peer_id, ts_ns, body)
+            sig = self._gossip_key.sign(canonical)
+            self._mark_seen(_gossip_seen_key(msg_id, sig, canonical))
+        else:
+            self._mark_seen(_gossip_seen_key(msg_id, None))
         self._deliver_local(topic, self.peer_id, body)
-        await self._gossip_fanout(topic, msg_id, self.peer_id, body, exclude=set())
+        await self._gossip_fanout(
+            topic, msg_id, self.peer_id, body, exclude=set(),
+            key=key, sig=sig, ts_ns=ts_ns,
+        )
 
     def _mark_seen(self, msg_id: str) -> bool:
         """Returns True if this id is new."""
@@ -948,7 +1034,15 @@ class Node:
             sub._deliver(origin, msg)
 
     async def _gossip_fanout(
-        self, topic: str, msg_id: str, origin: str, body: bytes, exclude: set[str]
+        self,
+        topic: str,
+        msg_id: str,
+        origin: str,
+        body: bytes,
+        exclude: set[str],
+        key: bytes | None = None,
+        sig: bytes | None = None,
+        ts_ns: int = 0,
     ) -> None:
         frame = {
             "t": "pub",
@@ -957,6 +1051,12 @@ class Node:
             "origin": origin,
             "data": body,
         }
+        if key is not None and sig is not None:
+            # Relays forward the ORIGIN's key+signature untouched, so
+            # verification is end-to-end regardless of the flood path.
+            frame["key"] = key
+            frame["sig"] = sig
+            frame["ts"] = ts_ns
         targets = [p for p in self._gossip_peers if p not in exclude]
         # Fire in parallel; unreachable peers are dropped from the mesh.
         results = await asyncio.gather(
@@ -982,14 +1082,49 @@ class Node:
         t = frame.get("t")
         if t == "pub":
             msg_id = frame.get("id", "")
-            if not self._mark_seen(msg_id):
-                return
             topic = frame.get("topic", "")
             origin = frame.get("origin", peer)
             body = frame.get("data", b"")
+            key, sig = frame.get("key"), frame.get("sig")
+            ts_ns = int(frame.get("ts", 0))
+            # Dedup keyed on (id, canonical-bytes, sig) BEFORE the Ed25519
+            # verify: identical flood copies of a genuine frame short-circuit
+            # without paying verification, while any forgery reusing the id
+            # hashes to a different key, misses the cache, fails verification
+            # — and cannot poison the dedup entry of the real message.
+            canonical = (
+                _gossip_sign_bytes(topic, msg_id, origin, ts_ns, body)
+                if sig is not None
+                else b""
+            )
+            if not self._mark_seen(_gossip_seen_key(msg_id, sig, canonical)):
+                return
+            if key is not None and sig is not None:
+                if abs(time.time_ns() - ts_ns) > GOSSIP_MAX_SKEW_S * 1e9:
+                    log.warning(
+                        "dropping gossip on %s: frame from %s outside the "
+                        "freshness window (replay or clock skew)", topic, origin,
+                    )
+                    return
+                if not _gossip_verify(topic, msg_id, origin, ts_ns, body, key, sig):
+                    log.warning(
+                        "dropping gossip on %s: bad signature for origin %s "
+                        "(relayed by %s)", topic, origin, peer,
+                    )
+                    return
+            elif self._gossip_key is not None:
+                # This node runs a signed mesh; unsigned frames are dropped
+                # (reference: gossipsub ValidationMode::Strict).
+                log.warning(
+                    "dropping unsigned gossip on %s from %s", topic, peer
+                )
+                return
             self._deliver_local(topic, origin, body)
             self._spawn(
-                self._gossip_fanout(topic, msg_id, origin, body, exclude={peer})
+                self._gossip_fanout(
+                    topic, msg_id, origin, body, exclude={peer},
+                    key=key, sig=sig, ts_ns=ts_ns,
+                )
             )
         # "sub"/"unsub" frames are accepted for forward-compat; flood
         # forwarding does not require remote subscription state.

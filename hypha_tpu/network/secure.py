@@ -64,5 +64,9 @@ def secure_node(
         bootstrap=bootstrap,
         registry_server=registry_server,
         expected_peer_id=expected_peer_id,
+        # The node-cert key signs gossip frames (reference signs gossipsub
+        # messages with the swarm keypair, scheduler/network.rs:132-136);
+        # receivers verify self-certifying key-hash == origin.
+        gossip_key=certs.load_private_key_from_pem(key_file),
         **node_kwargs,
     )

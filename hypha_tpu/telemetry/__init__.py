@@ -40,6 +40,8 @@ __all__ = [
     "Meter",
     "Counter",
     "Histogram",
+    "LogRecord",
+    "LogBridge",
     "init_telemetry",
     "instrument_node",
     "parse_attributes",
@@ -80,6 +82,75 @@ class Span:
         self.status_ok = False
         self.attributes["error.type"] = type(err).__name__
         self.attributes["error.message"] = str(err)
+
+
+@dataclass
+class LogRecord:
+    """One exported log record (OTLP LogRecord shape)."""
+
+    scope: str
+    time_ns: int
+    severity_number: int
+    severity_text: str
+    body: str
+    attributes: dict[str, Any] = field(default_factory=dict)
+    trace_id: str | None = None
+    span_id: str | None = None
+
+
+# Python logging levels -> OTLP severity numbers (spec table).
+_SEVERITY = {
+    _pylog.DEBUG: (5, "DEBUG"),
+    _pylog.INFO: (9, "INFO"),
+    _pylog.WARNING: (13, "WARN"),
+    _pylog.ERROR: (17, "ERROR"),
+    _pylog.CRITICAL: (21, "FATAL"),
+}
+
+
+def _severity_for(levelno: int) -> tuple[int, str]:
+    for lvl in sorted(_SEVERITY, reverse=True):
+        if levelno >= lvl:
+            return _SEVERITY[lvl]
+    return 1, "TRACE"
+
+
+class LogBridge(_pylog.Handler):
+    """Bridges Python ``logging`` records into the OTLP log export, the way
+    the reference's tracing layer forwards events to its OTLP log provider
+    (crates/telemetry/src/logging.rs). Records are correlated with the
+    context's current span (traceId/spanId) when one is active."""
+
+    def __init__(self, telemetry: "Telemetry", level: int = _pylog.INFO) -> None:
+        super().__init__(level)
+        self._telemetry = telemetry
+
+    def emit(self, record: _pylog.LogRecord) -> None:
+        try:
+            num, text = _severity_for(record.levelno)
+            span = _current_span.get()
+            attrs: dict[str, Any] = {
+                "code.function": record.funcName,
+                "code.filepath": record.pathname,
+                "code.lineno": record.lineno,
+            }
+            if record.exc_info and record.exc_info[0] is not None:
+                attrs["exception.type"] = record.exc_info[0].__name__
+                attrs["exception.message"] = str(record.exc_info[1])
+            self._telemetry._record_log(
+                LogRecord(
+                    scope=record.name,
+                    time_ns=int(record.created * 1e9),
+                    severity_number=num,
+                    severity_text=text,
+                    body=record.getMessage(),
+                    attributes=attrs,
+                    trace_id=span.trace_id if span is not None else None,
+                    span_id=span.span_id if span is not None else None,
+                )
+            )
+        except Exception:  # a logging handler must never raise
+            self.handleError(record)
 
 
 class Tracer:
@@ -220,6 +291,8 @@ class Telemetry:
         self._instruments: dict[tuple[str, str], Any] = {}
         self._gauges: dict[tuple[str, str], tuple[Callable[[], float], str]] = {}
         self._spans: list[tuple[str, Span]] = []
+        self._logs: list[LogRecord] = []
+        self._log_handlers: list[LogBridge] = []
         self._lock = threading.Lock()
         self._export_interval = export_interval
         self._stop = threading.Event()
@@ -254,11 +327,31 @@ class Telemetry:
             if len(self._spans) > 4096:
                 del self._spans[: len(self._spans) - 4096]
 
+    def _record_log(self, record: LogRecord) -> None:
+        with self._lock:
+            self._logs.append(record)
+            if len(self._logs) > 4096:
+                del self._logs[: len(self._logs) - 4096]
+
+    def attach_logging(
+        self, logger: str = "", level: int = _pylog.INFO
+    ) -> LogBridge:
+        """Install the OTLP log bridge on ``logger`` (default: root), so
+        ordinary ``logging`` calls flow to the collector alongside spans and
+        metrics — the reference's logging provider role
+        (crates/telemetry/src/logging.rs)."""
+        handler = LogBridge(self, level)
+        _pylog.getLogger(logger).addHandler(handler)
+        self._log_handlers.append(handler)
+        return handler
+
     # -- export -------------------------------------------------------------
-    def _drain(self) -> tuple[list, dict, dict]:
+    def _drain(self) -> tuple[list, dict, dict, list]:
         with self._lock:
             spans = self._spans
             self._spans = []
+            logs = self._logs
+            self._logs = []
             instruments = dict(self._instruments)
         gauges = {}
         for key, (cb, unit) in list(self._gauges.items()):
@@ -268,16 +361,18 @@ class Telemetry:
                 # A raising gauge callback (e.g. reading state mid-teardown)
                 # must not kill the export thread or mask shutdown errors.
                 log.warning("observable gauge %s raised: %s", key, e)
-        return spans, instruments, gauges
+        return spans, instruments, gauges, logs
 
     def flush(self) -> None:
         if self.exporter is None:
             return
-        spans, instruments, gauges = self._drain()
+        spans, instruments, gauges, logs = self._drain()
         try:
             if spans:
                 self.exporter.export_spans(spans)
             self.exporter.export_metrics(instruments, gauges)
+            if logs and hasattr(self.exporter, "export_logs"):
+                self.exporter.export_logs(logs)
         except Exception as e:  # export must never break the node
             log.warning("telemetry export failed: %s", e)
 
@@ -289,6 +384,17 @@ class Telemetry:
         self._stop.set()
         if self._thread is not None:
             self._thread.join(timeout=5)
+        if self._log_handlers:
+            loggers = [
+                lg
+                for lg in list(_pylog.Logger.manager.loggerDict.values())
+                if isinstance(lg, _pylog.Logger)
+            ] + [_pylog.getLogger()]
+            for handler in self._log_handlers:
+                for lg in loggers:
+                    if handler in lg.handlers:
+                        lg.removeHandler(handler)
+            self._log_handlers.clear()
         self.flush()
 
     # -- test/introspection --------------------------------------------------
@@ -318,13 +424,19 @@ def init_telemetry(
     env_attrs = os.environ.get("OTEL_RESOURCE_ATTRIBUTES")
     if env_attrs:
         attrs.update(parse_attributes(env_attrs))
-    return Telemetry(
+    telemetry = Telemetry(
         service_name=service_name,
         endpoint=endpoint,
         sample_ratio=sample_ratio,
         attributes=attrs,
         exporter=exporter,
     )
+    if telemetry.exporter is not None:
+        # Logs flow to the same collector as spans/metrics — the reference
+        # installs its log provider globally at binary startup
+        # (crates/telemetry/src/logging.rs).
+        telemetry.attach_logging()
+    return telemetry
 
 
 def instrument_node(meter: Meter, node) -> None:

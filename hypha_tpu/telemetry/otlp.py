@@ -1,9 +1,9 @@
 """OTLP/HTTP+JSON export (crates/telemetry/src/otlp.rs role).
 
-Speaks the standard OTLP HTTP endpoints (``/v1/traces``, ``/v1/metrics``)
-in their JSON encoding, so any OTEL collector can ingest it. Posts run on
-the telemetry thread; failures are logged and dropped — export must never
-stall or crash a node.
+Speaks the standard OTLP HTTP endpoints (``/v1/traces``, ``/v1/metrics``,
+``/v1/logs``) in their JSON encoding, so any OTEL collector can ingest it.
+Posts run on the telemetry thread; failures are logged and dropped — export
+must never stall or crash a node.
 """
 
 from __future__ import annotations
@@ -157,3 +157,36 @@ class OtlpJsonExporter:
             ]
         }
         self._post("/v1/metrics", payload)
+
+    # --------------------------------------------------------------- logs
+    def export_logs(self, records: list) -> None:
+        """Standard OTLP ``/v1/logs`` export — parity with the reference's
+        log pipeline (crates/telemetry/src/logging.rs: tracing events ->
+        OTLP LogRecords alongside spans/metrics)."""
+        by_scope: dict[str, list] = {}
+        for rec in records:
+            by_scope.setdefault(rec.scope, []).append(
+                {
+                    "timeUnixNano": str(rec.time_ns),
+                    "severityNumber": rec.severity_number,
+                    "severityText": rec.severity_text,
+                    "body": {"stringValue": rec.body},
+                    "attributes": _attr_list(rec.attributes),
+                    **({"traceId": rec.trace_id} if rec.trace_id else {}),
+                    **({"spanId": rec.span_id} if rec.span_id else {}),
+                }
+            )
+        if not by_scope:
+            return
+        payload = {
+            "resourceLogs": [
+                {
+                    "resource": {"attributes": _attr_list(self.resource)},
+                    "scopeLogs": [
+                        {"scope": {"name": scope}, "logRecords": recs}
+                        for scope, recs in by_scope.items()
+                    ],
+                }
+            ]
+        }
+        self._post("/v1/logs", payload)

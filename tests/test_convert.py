@@ -106,3 +106,105 @@ def test_load_checkpoint_files_formats(tmp_path):
     )
     assert set(state) == {"a", "b"}
     assert state["b"].shape == (3,)
+
+
+def test_mistral_conversion_matches_hf_logits():
+    """Mistral is Llama-architecture with a sliding window; its torch
+    checkpoints load into the native Llama module with logit parity
+    (VERDICT r2 missing #2 — torch-only modern decoders)."""
+    hf_cfg = transformers.MistralConfig(
+        vocab_size=96,
+        hidden_size=32,
+        intermediate_size=64,
+        num_hidden_layers=2,
+        num_attention_heads=4,
+        num_key_value_heads=2,
+        max_position_embeddings=64,
+        rms_norm_eps=1e-5,
+        rope_theta=10000.0,
+        sliding_window=None,
+        tie_word_embeddings=False,
+    )
+    torch.manual_seed(2)
+    hf = transformers.MistralForCausalLM(hf_cfg).eval()
+    ids = np.random.default_rng(2).integers(0, 96, (2, 12))
+    with torch.no_grad():
+        want = hf(torch.from_numpy(ids)).logits.numpy()
+
+    cfg = LlamaConfig.from_hf(hf_cfg.to_dict(), dtype="float32")
+    model = Llama(cfg)
+    template = model.init(jax.random.key(0), ids.astype(np.int32))
+    state = {k: v.numpy() for k, v in hf.state_dict().items()}
+    params = convert_state_dict("mistral", state, template)
+    got = np.asarray(model.apply(params, ids.astype(np.int32)))
+    np.testing.assert_allclose(got, want, rtol=2e-4, atol=2e-4)
+
+
+def test_qwen2_conversion_matches_hf_logits_with_biases_and_tied_head():
+    """Qwen2 adds q/k/v biases and (small sizes) tied embeddings; both map
+    into the native Llama module."""
+    hf_cfg = transformers.Qwen2Config(
+        vocab_size=96,
+        hidden_size=32,
+        intermediate_size=64,
+        num_hidden_layers=2,
+        num_attention_heads=4,
+        num_key_value_heads=2,
+        max_position_embeddings=64,
+        rms_norm_eps=1e-5,
+        rope_theta=10000.0,
+        tie_word_embeddings=True,
+        use_sliding_window=False,
+    )
+    torch.manual_seed(3)
+    hf = transformers.Qwen2ForCausalLM(hf_cfg).eval()
+    ids = np.random.default_rng(3).integers(0, 96, (2, 12))
+    with torch.no_grad():
+        want = hf(torch.from_numpy(ids)).logits.numpy()
+
+    cfg = LlamaConfig.from_hf(hf_cfg.to_dict(), dtype="float32")
+    assert cfg.attn_bias and cfg.tie_word_embeddings
+    model = Llama(cfg)
+    template = model.init(jax.random.key(0), ids.astype(np.int32))
+    state = {k: v.numpy() for k, v in hf.state_dict().items()}
+    params = convert_state_dict("qwen2", state, template)
+    got = np.asarray(model.apply(params, ids.astype(np.int32)))
+    np.testing.assert_allclose(got, want, rtol=2e-4, atol=2e-4)
+
+
+def test_mistral_sliding_window_masks_long_range():
+    """With sliding_window set and S > window, positions must not attend
+    past the window (the Mistral local-attention contract)."""
+    cfg = LlamaConfig(
+        vocab_size=32, hidden_size=16, intermediate_size=32,
+        num_layers=1, num_heads=2, num_kv_heads=2, max_seq_len=32,
+        dtype="float32", sliding_window=4,
+    )
+    model = Llama(cfg)
+    rng = np.random.default_rng(0)
+    ids = rng.integers(0, 32, (1, 16)).astype(np.int32)
+    params = model.init(jax.random.key(0), ids)
+    base = np.asarray(model.apply(params, ids))
+    # Perturb token 0: logits at positions >= window must be unaffected
+    # (outside every window), positions < window change.
+    ids2 = ids.copy(); ids2[0, 0] = (ids2[0, 0] + 1) % 32
+    pert = np.asarray(model.apply(params, ids2))
+    assert not np.allclose(base[0, 1:4], pert[0, 1:4])
+    np.testing.assert_allclose(base[0, 4:], pert[0, 4:], rtol=1e-5, atol=1e-5)
+
+
+def test_registry_builds_mistral_and_qwen2_families():
+    from hypha_tpu.models.registry import build_model
+
+    m, cfg = build_model({
+        "family": "mistral",
+        "hf_config": {"model_type": "mistral", "vocab_size": 64,
+                      "hidden_size": 16, "intermediate_size": 32,
+                      "num_hidden_layers": 1, "num_attention_heads": 2,
+                      "num_key_value_heads": 1, "sliding_window": 8},
+    })
+    assert isinstance(m, Llama) and cfg.sliding_window == 8
+    m2, cfg2 = build_model({"family": "qwen2", "config": {
+        "vocab_size": 64, "hidden_size": 16, "intermediate_size": 32,
+        "num_layers": 1, "num_heads": 2, "num_kv_heads": 1}})
+    assert isinstance(m2, Llama) and cfg2.attn_bias

@@ -1,0 +1,172 @@
+"""Gossip message signing tests.
+
+The reference signs every gossipsub message with the swarm keypair and
+rejects unsigned/invalid messages (crates/scheduler/src/network.rs:132-136,
+gossipsub ValidationMode::Strict). Here the frame embeds the origin's SPKI
+public key + Ed25519 signature; verification is self-certifying because
+PeerID = hash(SPKI) — the same derivation the cert layer uses.
+"""
+
+from __future__ import annotations
+
+import asyncio
+
+from cryptography.hazmat.primitives.asymmetric import ed25519
+
+from hypha_tpu.certs import peer_id_from_spki_der
+from hypha_tpu.network import MemoryTransport, Node
+from hypha_tpu.network.node import PROTOCOL_GOSSIP
+
+
+def run(coro):
+    return asyncio.run(asyncio.wait_for(coro, timeout=30))
+
+
+def _keyed_peer(hub, name):
+    key = ed25519.Ed25519PrivateKey.generate()
+    from cryptography.hazmat.primitives import serialization
+
+    spki = key.public_key().public_bytes(
+        serialization.Encoding.DER, serialization.PublicFormat.SubjectPublicKeyInfo
+    )
+    return Node(hub.shared(), peer_id=peer_id_from_spki_der(spki), gossip_key=key)
+
+
+async def _mesh(*nodes):
+    for n in nodes:
+        await n.start()
+    for a in nodes:
+        for b in nodes:
+            if a is not b:
+                a.add_peer_addr(b.peer_id, b.listen_addrs[0])
+                a.add_gossip_peer(b.peer_id)
+
+
+
+def test_signed_gossip_delivered_between_keyed_nodes():
+    async def main():
+        hub = MemoryTransport()
+        a, b = _keyed_peer(hub, "a"), _keyed_peer(hub, "b")
+        await _mesh(a, b)
+        sub = await b.subscribe("ads")
+        await a.publish("ads", {"kind": "ad", "n": 1})
+        origin, msg = await asyncio.wait_for(sub.__anext__(), 5)
+        assert origin == a.peer_id
+        assert msg == {"kind": "ad", "n": 1}
+        await a.stop(); await b.stop()
+
+    run(main())
+
+
+def test_unsigned_gossip_dropped_by_keyed_node():
+    async def main():
+        hub = MemoryTransport()
+        a = Node(hub.shared(), peer_id="plain-a")  # keyless attacker/dev node
+        b = _keyed_peer(hub, "b")
+        await _mesh(a, b)
+        sub = await b.subscribe("ads")
+        await a.publish("ads", {"kind": "ad"})
+        with __import__("pytest").raises(asyncio.TimeoutError):
+            await asyncio.wait_for(sub.__anext__(), 0.5)
+        await a.stop(); await b.stop()
+
+    run(main())
+
+
+def test_tampered_gossip_dropped():
+    """A relay that rewrites the payload (or forges the origin) is caught:
+    the signature covers topic/id/origin/data."""
+    from hypha_tpu import codec, messages
+
+    async def main():
+        hub = MemoryTransport()
+        a, b = _keyed_peer(hub, "a"), _keyed_peer(hub, "b")
+        await _mesh(a, b)
+        sub = await b.subscribe("ads")
+
+        # Capture a genuine signed frame by publishing, then replay it to b
+        # with the payload swapped (signature now stale).
+        import time
+
+        from hypha_tpu.network.node import _gossip_sign_bytes
+        from cryptography.hazmat.primitives import serialization
+
+        ts = time.time_ns()
+        body = messages.encode({"kind": "ad", "n": 1})
+        spki = a._gossip_key.public_key().public_bytes(
+            serialization.Encoding.DER,
+            serialization.PublicFormat.SubjectPublicKeyInfo,
+        )
+        sig = a._gossip_key.sign(_gossip_sign_bytes("ads", "mid1", a.peer_id, ts, body))
+
+        async def send(frame):
+            stream = await b.transport.dial(b.listen_addrs[0])
+            await stream.write_frame(
+                {"from": a.peer_id, "proto": PROTOCOL_GOSSIP, "addr": ""}
+            )
+            await stream.write_frame(frame)
+            await stream.close()
+
+        # 1. Tampered data under a real signature -> dropped.
+        await send({
+            "t": "pub", "topic": "ads", "id": "mid1", "origin": a.peer_id,
+            "data": messages.encode({"kind": "ad", "n": 666}),
+            "key": spki, "sig": sig, "ts": ts,
+        })
+        # 2. Forged origin (claiming a third id) under a's key -> dropped
+        #    (key hash != origin).
+        sig2 = a._gossip_key.sign(
+            _gossip_sign_bytes("ads", "mid2", "12Hforged", ts, body)
+        )
+        await send({
+            "t": "pub", "topic": "ads", "id": "mid2", "origin": "12Hforged",
+            "data": body, "key": spki, "sig": sig2, "ts": ts,
+        })
+        # 3. A stale-but-valid frame (outside the freshness window) ->
+        #    dropped: replay of captured frames is time-bounded.
+        old_ts = ts - int(600e9)
+        sig3 = a._gossip_key.sign(
+            _gossip_sign_bytes("ads", "mid3", a.peer_id, old_ts, body)
+        )
+        await send({
+            "t": "pub", "topic": "ads", "id": "mid3", "origin": a.peer_id,
+            "data": body, "key": spki, "sig": sig3, "ts": old_ts,
+        })
+        with __import__("pytest").raises(asyncio.TimeoutError):
+            await asyncio.wait_for(sub.__anext__(), 0.5)
+
+        # 4. The genuine frame still goes through -> proves b is healthy
+        #    (same msg id as the tampered frame: the forged copy must not
+        #    have poisoned the dedup slot).
+        await send({
+            "t": "pub", "topic": "ads", "id": "mid1", "origin": a.peer_id,
+            "data": body, "key": spki, "sig": sig, "ts": ts,
+        })
+        origin, msg = await asyncio.wait_for(sub.__anext__(), 5)
+        assert origin == a.peer_id and msg == {"kind": "ad", "n": 1}
+        await a.stop(); await b.stop()
+
+    run(main())
+
+
+def test_signature_survives_multi_hop_relay():
+    """Verification is end-to-end: hop b relays a's frame to c untouched,
+    and c verifies against a's key."""
+
+    async def main():
+        hub = MemoryTransport()
+        a, b, c = (_keyed_peer(hub, n) for n in "abc")
+        await a.start(); await b.start(); await c.start()
+        # Line topology: a <-> b <-> c (no direct a-c link).
+        for x, y in ((a, b), (b, c)):
+            x.add_peer_addr(y.peer_id, y.listen_addrs[0])
+            y.add_peer_addr(x.peer_id, x.listen_addrs[0])
+            x.add_gossip_peer(y.peer_id)
+            y.add_gossip_peer(x.peer_id)
+        sub = await c.subscribe("ads")
+        await a.publish("ads", {"kind": "ad", "hop": 2})
+        origin, msg = await asyncio.wait_for(sub.__anext__(), 5)
+        assert origin == a.peer_id and msg["hop"] == 2
+        await a.stop(); await b.stop(); await c.stop()
+
+    run(main())

@@ -191,3 +191,63 @@ def test_histogram_default_bounds_overflow_bucket():
     h = Histogram("x")
     h.record(999999)
     assert h.snapshot()["bucket_counts"][-1] == 1
+
+
+def test_otlp_logs_pipeline():
+    """Python logging records flow to /v1/logs alongside spans/metrics with
+    severity mapping and active-span correlation (reference:
+    crates/telemetry/src/logging.rs)."""
+    import logging
+
+    posts: list = []
+
+    class CapturingExporter(OtlpJsonExporter):
+        def _post(self, path, payload):
+            posts.append((path, payload))
+
+    exp = CapturingExporter("127.0.0.1:9999", {"service.name": "t"})
+    t = Telemetry(service_name="t", exporter=exp, export_interval=3600)
+    t.attach_logging(logger="hypha.test.logs", level=logging.INFO)
+    lg = logging.getLogger("hypha.test.logs")
+    lg.setLevel(logging.DEBUG)
+
+    tracer = t.tracer("sc")
+    with tracer.span("op") as span:
+        lg.warning("inside span %d", 7)
+        trace_id, span_id = span.trace_id, span.span_id
+    lg.error("after span")
+    lg.debug("below handler level: dropped")
+    t.flush()
+    t.shutdown()
+
+    by_path = {p: pl for p, pl in posts}
+    scope_logs = by_path["/v1/logs"]["resourceLogs"][0]["scopeLogs"]
+    assert scope_logs[0]["scope"]["name"] == "hypha.test.logs"
+    recs = scope_logs[0]["logRecords"]
+    assert [r["body"]["stringValue"] for r in recs] == ["inside span 7", "after span"]
+    inside, after = recs
+    assert inside["severityText"] == "WARN" and inside["severityNumber"] == 13
+    assert inside["traceId"] == trace_id and inside["spanId"] == span_id
+    assert after["severityText"] == "ERROR" and "traceId" not in after
+    # resource attributes ride along, and the payload is JSON-clean
+    json.dumps(by_path["/v1/logs"])
+
+
+def test_log_bridge_exception_attributes_and_detach():
+    import logging
+
+    from hypha_tpu.telemetry import LogBridge
+
+    t, exporter = make()
+    handler = t.attach_logging(logger="hypha.test.exc")
+    lg = logging.getLogger("hypha.test.exc")
+    try:
+        raise ValueError("boom")
+    except ValueError:
+        lg.exception("it failed")
+    with t._lock:
+        recs = list(t._logs)
+    assert recs and recs[0].attributes["exception.type"] == "ValueError"
+    assert recs[0].attributes["exception.message"] == "boom"
+    t.shutdown()
+    assert handler not in lg.handlers  # shutdown detaches the bridge
