@@ -295,10 +295,12 @@ def main() -> None:
             line = None
             for raw in reversed(r.stdout.strip().splitlines()):
                 try:
-                    line = json.loads(raw)
-                    break
+                    parsed = json.loads(raw)
                 except ValueError:
                     continue
+                if isinstance(parsed, dict):  # skip bare numbers/null/lists
+                    line = parsed
+                    break
             if isinstance(line, dict):
                 line["attempts"] = attempts + [rec]
                 print(json.dumps(line))

@@ -17,11 +17,24 @@ via config fields, converted via models.convert.
 
 from __future__ import annotations
 
+import warnings
 from dataclasses import dataclass
 from typing import Callable
 
 import flax.linen as nn
 import jax.numpy as jnp
+
+
+def _accepts_kw(fn: Callable, name: str) -> bool:
+    import inspect
+
+    try:
+        params = inspect.signature(fn).parameters
+    except (TypeError, ValueError):
+        return False
+    return name in params or any(
+        p.kind is inspect.Parameter.VAR_KEYWORD for p in params.values()
+    )
 
 from ..ops.attention import dot_product_attention
 from ..ops.rmsnorm import rms_norm
@@ -68,7 +81,12 @@ class LlamaConfig:
             rope_theta=d.get("rope_theta", 10_000.0),
             rms_eps=d.get("rms_norm_eps", 1e-5),
             attn_bias=d.get("model_type") == "qwen2",
-            sliding_window=d.get("sliding_window"),
+            # Qwen2 configs ship a non-null sliding_window with
+            # use_sliding_window=false — honor the switch (absent means
+            # enabled, the Mistral convention).
+            sliding_window=(
+                d.get("sliding_window") if d.get("use_sliding_window", True) else None
+            ),
             tie_word_embeddings=d.get("tie_word_embeddings", False),
         )
         fields.update(overrides)
@@ -122,12 +140,22 @@ class _Attention(nn.Module):
         k = apply_rope(k, cos, sin)
         window = cfg.sliding_window
         if window is not None and S > window:
-            # Mistral local attention: position i sees (i-window, i]. Only
-            # the dense path takes a mask; window jobs use it (a windowed
-            # pallas kernel would go through attn_impl the same way).
-            pos = jnp.arange(S)
-            local = (pos[None, :] > pos[:, None] - window)[None, None]
-            attn = dot_product_attention(q, k, v, causal=True, mask=local)
+            # Mistral local attention: position i sees (i-window, i]. The
+            # window threads through attn_impl when the kernel supports it;
+            # otherwise the fused-iota dense path runs (the flash/ring
+            # kernels don't take a window yet — warn, don't silently alter
+            # the objective OR silently drop the installed kernel).
+            impl = self.attn_impl or dot_product_attention
+            if _accepts_kw(impl, "window"):
+                attn = impl(q, k, v, causal=True, window=window)
+            else:
+                if self.attn_impl is not None:
+                    warnings.warn(
+                        "sliding_window set but the installed attn_impl "
+                        "takes no 'window' kwarg; using the dense windowed "
+                        "path instead", stacklevel=2,
+                    )
+                attn = dot_product_attention(q, k, v, causal=True, window=window)
         else:
             attn = (self.attn_impl or dot_product_attention)(q, k, v, causal=True)
         attn = attn.reshape(B, S, cfg.num_heads * hd)

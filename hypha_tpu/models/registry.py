@@ -77,8 +77,13 @@ def build_model(spec: dict[str, Any], attn_impl=None):
     preset = spec.get("preset")
     hf_config = spec.get("hf_config")
     if preset is not None:
-        cfg = _PRESETS.get(family, {}).get(preset)
-        cfg = cfg() if cfg is not None else config_cls()
+        presets = _PRESETS.get(family, {})
+        if preset not in presets:
+            raise KeyError(
+                f"unknown preset {preset!r} for family {family!r} "
+                f"(have {sorted(presets) or 'none'})"
+            )
+        cfg = presets[preset]()
     elif hf_config is not None and hasattr(config_cls, "from_hf"):
         # A fetched checkpoint's config.json fields drive the native config
         # (llama / mistral / qwen2).

@@ -24,12 +24,16 @@ def dot_product_attention(
     mask: jnp.ndarray | None = None,  # [B, 1, Sq, Sk] or broadcastable, bool
     softmax_scale: float | None = None,
     q_offset: int = 0,
+    window: int | None = None,
 ) -> jnp.ndarray:
     """Scaled dot-product attention with grouped-query support.
 
     ``q_offset`` shifts the causal diagonal — used for decoding (queries start
     at position ``q_offset`` of the kv sequence) and by the ring-attention
-    blocks.
+    blocks. ``window`` applies Mistral-style local attention (query i sees
+    keys in (i-window, i]); the band comparison is built from iotas inline so
+    XLA fuses it into the masked softmax instead of loading a materialized
+    [Sq, Sk] mask from HBM.
     """
     B, Sq, H, D = q.shape
     _, Sk, Hkv, _ = k.shape
@@ -44,11 +48,13 @@ def dot_product_attention(
     logits = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
     logits = logits.astype(jnp.float32)  # softmax in f32 for stability
 
-    if causal:
+    if causal or window is not None:
         qi = jnp.arange(Sq)[:, None] + q_offset
         ki = jnp.arange(Sk)[None, :]
-        causal_mask = qi >= ki
-        logits = jnp.where(causal_mask[None, None], logits, -jnp.inf)
+        keep = qi >= ki if causal else jnp.bool_(True)
+        if window is not None:
+            keep = keep & (ki > qi - window)
+        logits = jnp.where(keep[None, None], logits, -jnp.inf)
     if mask is not None:
         logits = jnp.where(mask, logits, -jnp.inf)
 
