@@ -13,7 +13,20 @@ platform and drop the remote factories from the registry.
 
 import os
 
-os.environ["JAX_PLATFORMS"] = "cpu"
+# Escape hatch for the ON-HARDWARE kernel tests (tests/test_tpu_hw.py):
+# HYPHA_ALLOW_TPU=1 leaves the real backend registered so an explicit
+# `HYPHA_ALLOW_TPU=1 pytest tests/test_tpu_hw.py` run validates the pallas
+# kernels on the chip. The hatch only opens when the hardware tests are the
+# TARGETED paths — a leftover exported var must not send the whole suite
+# onto the remote backend (init can block for minutes).
+import sys
+
+_ALLOW_TPU = os.environ.get("HYPHA_ALLOW_TPU") == "1" and any(
+    "test_tpu_hw" in a for a in sys.argv
+)
+
+if not _ALLOW_TPU:
+    os.environ["JAX_PLATFORMS"] = "cpu"
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
@@ -24,14 +37,15 @@ os.environ.setdefault("JAX_ENABLE_X64", "0")
 # the load-bearing step that keeps tests off the remote backend.
 import jax as _jax
 
-_jax.config.update("jax_platforms", "cpu")
+if not _ALLOW_TPU:
+    _jax.config.update("jax_platforms", "cpu")
 
-try:  # best-effort: drop the remote factory too (private API, may churn)
-    from jax._src import xla_bridge as _xb
+    try:  # best-effort: drop the remote factory too (private API, may churn)
+        from jax._src import xla_bridge as _xb
 
-    _xb._backend_factories.pop("axon", None)
-except Exception:
-    pass
+        _xb._backend_factories.pop("axon", None)
+    except Exception:
+        pass
 
 
 def pytest_configure(config):

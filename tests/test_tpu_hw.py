@@ -1,0 +1,127 @@
+"""ON-HARDWARE pallas kernel validation (VERDICT r2 next-step #2).
+
+These tests run the compiled (interpret=False) flash kernels on a real
+TPU-class backend and are SKIPPED everywhere else — the normal suite forces
+the virtual CPU mesh (conftest). Run explicitly on hardware with:
+
+    HYPHA_ALLOW_TPU=1 python -m pytest tests/test_tpu_hw.py -v
+
+What they pin that interpret mode cannot: VMEM fit of the (block_q, 1)
+scratch layouts, dimension_semantics acceptance, mosaic lowering of the GQA
+index maps, and that flash beats the dense XLA path at S=2048.
+"""
+
+from __future__ import annotations
+
+import time
+
+import numpy as np
+import pytest
+
+
+def _tpu_backend() -> bool:
+    import jax
+
+    try:
+        return jax.default_backend().lower() not in ("cpu", "gpu", "cuda", "rocm")
+    except Exception:
+        return False
+
+
+pytestmark = pytest.mark.skipif(
+    not _tpu_backend(), reason="requires a real TPU-class backend"
+)
+
+
+def test_flash_fwd_bwd_compiles_and_matches_dense_on_chip():
+    import jax
+    import jax.numpy as jnp
+
+    from hypha_tpu.ops.attention import dot_product_attention
+    from hypha_tpu.ops.flash_attention import flash_attention
+
+    B, S, H, Hkv, D = 2, 1024, 8, 4, 64
+    kq, kk, kv = jax.random.split(jax.random.key(0), 3)
+    q = jax.random.normal(kq, (B, S, H, D), jnp.bfloat16)
+    k = jax.random.normal(kk, (B, S, Hkv, D), jnp.bfloat16)
+    v = jax.random.normal(kv, (B, S, Hkv, D), jnp.bfloat16)
+
+    def loss_flash(q, k, v):
+        return flash_attention(q, k, v, causal=True, interpret=False).astype(
+            jnp.float32
+        ).sum()
+
+    def loss_dense(q, k, v):
+        return dot_product_attention(q, k, v, causal=True).astype(jnp.float32).sum()
+
+    out_f = jax.jit(lambda *a: flash_attention(*a, causal=True, interpret=False))(
+        q, k, v
+    )
+    out_d = jax.jit(lambda *a: dot_product_attention(*a, causal=True))(q, k, v)
+    np.testing.assert_allclose(
+        np.asarray(out_f, np.float32), np.asarray(out_d, np.float32),
+        rtol=5e-2, atol=5e-2,  # bf16 accumulation differences
+    )
+
+    gf = jax.jit(jax.grad(loss_flash, argnums=(0, 1, 2)))(q, k, v)
+    gd = jax.jit(jax.grad(loss_dense, argnums=(0, 1, 2)))(q, k, v)
+    for a, b, name in zip(gf, gd, "qkv"):
+        fa = np.asarray(a, np.float32)
+        fb = np.asarray(b, np.float32)
+        err = np.abs(fa - fb).max() / max(np.abs(fb).max(), 1e-6)
+        assert err < 8e-2, (name, err)
+
+
+def test_flash_beats_dense_at_long_context_on_chip():
+    import jax
+    import jax.numpy as jnp
+
+    from hypha_tpu.ops.attention import dot_product_attention
+    from hypha_tpu.ops.flash_attention import flash_attention
+
+    B, S, H, D = 4, 2048, 12, 64
+    kq, kk, kv = jax.random.split(jax.random.key(1), 3)
+    q = jax.random.normal(kq, (B, S, H, D), jnp.bfloat16)
+    k = jax.random.normal(kk, (B, S, H, D), jnp.bfloat16)
+    v = jax.random.normal(kv, (B, S, H, D), jnp.bfloat16)
+
+    flash = jax.jit(lambda *a: flash_attention(*a, causal=True, interpret=False))
+    dense = jax.jit(lambda *a: dot_product_attention(*a, causal=True))
+
+    def bench(fn, reps=20):
+        fn(q, k, v).block_until_ready()  # compile + warm
+        t0 = time.perf_counter()
+        for _ in range(reps):
+            out = fn(q, k, v)
+        out.block_until_ready()
+        return (time.perf_counter() - t0) / reps
+
+    t_flash = bench(flash)
+    t_dense = bench(dense)
+    print(f"S={S}: flash {t_flash * 1e3:.2f} ms vs dense {t_dense * 1e3:.2f} ms")
+    assert t_flash < t_dense, (
+        f"flash ({t_flash * 1e3:.2f} ms) must beat dense ({t_dense * 1e3:.2f} ms) at S={S}"
+    )
+
+
+def test_gpt2_flash_train_step_on_chip():
+    """One fused train step of GPT-2 with the flash kernel on hardware —
+    the exact path bench.py measures."""
+    import functools
+
+    import jax
+
+    from hypha_tpu.executor.train import TrainState, build_optimizer, make_train_step
+    from hypha_tpu.messages import Adam
+    from hypha_tpu.models import GPT2, GPT2Config
+    from hypha_tpu.ops.flash_attention import flash_attention
+
+    cfg = GPT2Config(vocab_size=1024, n_positions=512, n_embd=256, n_layer=2, n_head=4)
+    model = GPT2(cfg, attn_impl=functools.partial(flash_attention, interpret=False))
+    ids = jax.random.randint(jax.random.key(0), (2, 512), 0, cfg.vocab_size)
+    params = model.init(jax.random.key(1), ids)
+    state = TrainState.create(params, build_optimizer(Adam(lr=1e-4)))
+    step = make_train_step(model.apply)
+    state, metrics = step(state, {"input_ids": ids})
+    assert np.isfinite(float(metrics["loss"]))
+    assert float(metrics["grad_norm"]) > 0
