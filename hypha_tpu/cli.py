@@ -103,6 +103,10 @@ def _make_node(conf, *, registry_server: bool = False, peer_id: str | None = Non
         from .network.fabric import TcpTransport
 
         node = Node(TcpTransport(), peer_id=peer_id or conf.name, **node_kwargs)
+    if getattr(conf.network, "mux", False):
+        from .network.mux import MuxTransport
+
+        node.transport = MuxTransport(node.transport)
     node.external_addrs = list(conf.network.external)
     return node
 

@@ -53,6 +53,14 @@ class NetworkConfig:
         default=True,
         metadata={"doc": "hold gateway circuit reservations so NAT'd peers can reach us"},
     )
+    mux: bool = field(
+        default=False,
+        metadata={
+            "doc": "multiplex streams over one connection per peer "
+            "(yamux-role second transport; lower RPC latency, bulk pushes "
+            "prefer the default parallel connections)"
+        },
+    )
 
 
 @dataclass

@@ -1,0 +1,236 @@
+"""Multiplexed-transport tests (the second transport — the reference runs
+TCP+TLS+yamux AND QUIC, crates/scheduler/src/network.rs:109-131; here a
+yamux-role muxer over the TCP fabric).
+
+Pin: many concurrent logical streams on ONE base connection, full Node
+vocabulary (RPC, gossip, push/pull), connection reuse across sequential
+RPCs, bounded inbound buffering, clean teardown when the base drops.
+"""
+
+from __future__ import annotations
+
+import asyncio
+
+import pytest
+
+from hypha_tpu.messages import DataSlice, HealthRequest, HealthResponse
+from hypha_tpu.network import MemoryTransport, Node, TcpTransport
+from hypha_tpu.network.mux import MuxTransport
+
+
+def run(coro):
+    return asyncio.run(asyncio.wait_for(coro, timeout=30))
+
+
+def test_many_streams_one_connection_tcp():
+    """100 concurrent RPCs over a muxed TCP transport — one TCP connection
+    carries them all (dial-side connection reuse)."""
+
+    async def main():
+        a = Node(MuxTransport(TcpTransport()), peer_id="a")
+        b_mux = MuxTransport(TcpTransport())
+        b = Node(b_mux, peer_id="b")
+        await a.start(["127.0.0.1:0"])
+        await b.start(["127.0.0.1:0"])
+        a.add_peer_addr("b", b.listen_addrs[0])
+
+        calls = 0
+
+        async def handler(peer, msg):
+            nonlocal calls
+            calls += 1
+            await asyncio.sleep(0.01)  # force real concurrency
+            return HealthResponse(healthy=True)
+
+        b.on("/health", HealthRequest).concurrency(100).respond_with(handler)
+        replies = await asyncio.gather(
+            *(a.request("b", "/health", HealthRequest()) for _ in range(100))
+        )
+        assert calls == 100 and all(r.healthy for r in replies)
+        # All rode ONE accepted base connection.
+        assert len(b_mux._accepted) == 1
+        await a.stop(); await b.stop()
+
+    run(main())
+
+
+def test_push_and_pull_over_mux():
+    async def main():
+        hub = MemoryTransport()
+        a = Node(MuxTransport(hub.shared()), peer_id="a")
+        b = Node(MuxTransport(hub.shared()), peer_id="b")
+        await a.start(); await b.start()
+        a.add_peer_addr("b", b.listen_addrs[0])
+        b.add_peer_addr("a", a.listen_addrs[0])
+
+        payload = bytes(range(256)) * 8192  # 2 MiB
+
+        async def recv():
+            p = await b.next_push(timeout=10)
+            return await p.read_all()
+
+        t = asyncio.create_task(recv())
+        sent = await a.push("b", DataSlice(dataset="g", index=0), payload)
+        assert sent == len(payload) and await t == payload
+
+        async def pull_handler(peer, resource):
+            return payload
+
+        b.on_pull(pull_handler)
+        stream = await a.pull("b", DataSlice(dataset="g", index=0))
+        got = []
+        while True:
+            chunk = await stream.read(1 << 20)
+            if not chunk:
+                break
+            got.append(chunk)
+        assert b"".join(got) == payload
+        await a.stop(); await b.stop()
+
+    run(main())
+
+
+def test_interleaved_streams_do_not_corrupt():
+    """Two large pushes interleave frame-by-frame on one connection; each
+    consumer gets exactly its own bytes."""
+
+    async def main():
+        hub = MemoryTransport()
+        a = Node(MuxTransport(hub.shared()), peer_id="a")
+        b = Node(MuxTransport(hub.shared()), peer_id="b")
+        await a.start(); await b.start()
+        a.add_peer_addr("b", b.listen_addrs[0])
+
+        pay1 = b"\x01" * (3 << 20)
+        pay2 = b"\x02" * (3 << 20)
+
+        got = {}
+
+        async def recv(n):
+            for _ in range(n):
+                p = await b.next_push(timeout=15)
+                got[p.resource.dataset] = await p.read_all()
+
+        t = asyncio.create_task(recv(2))
+        await asyncio.gather(
+            a.push("b", DataSlice(dataset="one", index=0), pay1),
+            a.push("b", DataSlice(dataset="two", index=0), pay2),
+        )
+        await t
+        assert got["one"] == pay1 and got["two"] == pay2
+        await a.stop(); await b.stop()
+
+    run(main())
+
+
+def test_base_connection_drop_fails_open_streams():
+    """When the remote tears down the base connection, in-flight and later
+    requests fail with RequestError instead of hanging."""
+
+    async def main():
+        from hypha_tpu.network import RequestError
+
+        hub = MemoryTransport()
+        mux_a = MuxTransport(hub.shared())
+        a = Node(mux_a, peer_id="a")
+        b = Node(MuxTransport(hub.shared()), peer_id="b")
+        await a.start(); await b.start()
+        a.add_peer_addr("b", b.listen_addrs[0])
+        b.on("/health", HealthRequest).respond_with(
+            lambda p, m: _healthy()
+        )
+        r = await a.request("b", "/health", HealthRequest(), timeout=5)
+        assert r.healthy  # connection proven live first
+        await b.stop()  # tears down the accepted mux connection
+        with pytest.raises(RequestError):
+            await a.request("b", "/health", HealthRequest(), timeout=5)
+        await a.stop()
+
+    async def _healthy():
+        return HealthResponse(healthy=True)
+
+    run(main())
+
+
+def test_abandoned_stream_returns_window_credit():
+    """A consumer that abandons a large message mid-read must not stall the
+    connection: unread bytes are credited back on close/reset, so later
+    streams still flow (regression: pump parked on _has_credit forever)."""
+
+    async def main():
+        hub = MemoryTransport()
+        a = Node(MuxTransport(hub.shared()), peer_id="a")
+        b = Node(MuxTransport(hub.shared()), peer_id="b")
+        await a.start(); await b.start()
+        a.add_peer_addr("b", b.listen_addrs[0])
+
+        big = b"\x05" * (6 << 20)  # > the 4 MiB connection window
+
+        async def recv_and_abandon():
+            push = await b.next_push(timeout=10)
+            await push.stream.read(10)  # taste it, then walk away
+            await push.stream.abort()
+            push.finish()
+
+        t = asyncio.create_task(recv_and_abandon())
+        try:
+            await asyncio.wait_for(
+                a.push("b", DataSlice(dataset="big", index=0), big), 10
+            )
+        except Exception:
+            pass  # the abort may surface at the sender; the point is below
+        await t
+
+        # The SAME connection must still serve new streams.
+        b.on("/health", HealthRequest).respond_with(
+            lambda p, m: _healthy()
+        )
+        r = await asyncio.wait_for(
+            a.request("b", "/health", HealthRequest()), 5
+        )
+        assert r.healthy
+        await a.stop(); await b.stop()
+
+    async def _healthy():
+        return HealthResponse(healthy=True)
+
+    run(main())
+
+
+def test_mux_over_mtls_preserves_peer_identity():
+    """PeerID = cert-key-hash checks pass through the muxer (logical
+    streams expose the base connection's certificate)."""
+    import pathlib
+    import tempfile
+
+    from hypha_tpu import certs
+    from hypha_tpu.network.secure import secure_node
+
+    async def main():
+        tmp = pathlib.Path(tempfile.mkdtemp())
+        root_cert, root_key = certs.generate_root_ca()
+        org_cert, org_key = certs.generate_org_ca("org", root_cert, root_key)
+        na = certs.write_node_dir(tmp / "a", "a", org_cert, org_key, root_cert)
+        nb = certs.write_node_dir(tmp / "b", "b", org_cert, org_key, root_cert)
+
+        def mk(d):
+            node = secure_node(d["cert"], d["key"], d["trust"])
+            node.transport = MuxTransport(node.transport)
+            return node
+
+        a, b = mk(na), mk(nb)
+        await a.start(["127.0.0.1:0"])
+        await b.start(["127.0.0.1:0"])
+        peer = await a.dial(b.listen_addrs[0])
+        assert peer == b.peer_id  # identity verified through the muxer
+
+        async def handler(p, msg):
+            assert p == a.peer_id
+            return HealthResponse(healthy=True)
+
+        b.on("/health", HealthRequest).respond_with(handler)
+        r = await a.request(b.peer_id, "/health", HealthRequest())
+        assert r.healthy
+        await a.stop(); await b.stop()
+
+    run(main())
