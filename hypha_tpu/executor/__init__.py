@@ -4,6 +4,7 @@ outer (aggregate) optimizer — the TPU-native replacement for the reference's
 tensor math (SURVEY.md §2.6, §2.9)."""
 
 from .diloco import extract_delta, merge_update, nesterov_init, nesterov_outer_step
+from .generate import generate
 from .train import (
     TrainState,
     build_optimizer,
@@ -13,6 +14,7 @@ from .train import (
 )
 
 __all__ = [
+    "generate",
     "extract_delta",
     "merge_update",
     "nesterov_init",

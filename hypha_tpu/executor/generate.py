@@ -1,0 +1,131 @@
+"""Autoregressive generation: KV-cached prefill + lax.scan decode loop.
+
+The reference ships NO inference path (BASELINE.json config 4 — "Llama-2-7B
+inference serving" — is a north-star scenario, not an existing feature);
+this is the TPU-native serving primitive: the prompt prefills the cache in
+one batched forward (MXU-sized matmuls), then a single compiled
+``lax.scan`` emits one token per step against the static-shape cache — no
+per-token retracing, no dynamic shapes, greedy or temperature/top-k
+sampling inside the scan.
+
+Works with any model module exposing ``decode``/``decode_len`` attrs and a
+"cache" variable collection (models.gpt2, models.llama and its
+Mistral/Qwen2 configs).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["generate"]
+
+
+def _sample(logits: jnp.ndarray, rng, temperature: float, top_k: int | None):
+    """logits [B, V] -> token ids [B]."""
+    if temperature <= 0.0:
+        return jnp.argmax(logits, axis=-1)
+    logits = logits / temperature
+    if top_k is not None:
+        kth = jax.lax.top_k(logits, top_k)[0][:, -1:]
+        logits = jnp.where(logits < kth, -jnp.inf, logits)
+    return jax.random.categorical(rng, logits, axis=-1)
+
+
+def generate(
+    model: Any,
+    params: Any,
+    prompt_ids: jnp.ndarray,  # [B, S_prompt] int32
+    max_new_tokens: int,
+    *,
+    temperature: float = 0.0,
+    top_k: int | None = None,
+    rng: jax.Array | None = None,
+    eos_token_id: int | None = None,
+) -> jnp.ndarray:
+    """Generate ``max_new_tokens`` continuations. Returns [B, max_new_tokens].
+
+    ``model`` is a training-mode module instance (e.g. ``GPT2(cfg)``); its
+    decode twin is derived here, so the SAME converted/trained params serve
+    inference. After ``eos_token_id`` a sequence keeps emitting eos (the
+    scan stays static-shape; callers trim).
+    """
+    if max_new_tokens < 1:
+        raise ValueError(f"max_new_tokens must be >= 1, got {max_new_tokens}")
+    prompt_ids = jnp.asarray(prompt_ids, jnp.int32)
+    B, S = prompt_ids.shape
+    total = S + max_new_tokens
+    cfg = model.config
+    limit = getattr(cfg, "n_positions", None) or getattr(cfg, "max_seq_len", None)
+    if limit is not None and total > limit:
+        raise ValueError(f"prompt+new = {total} exceeds the model's {limit} positions")
+    rng = rng if rng is not None else jax.random.key(0)
+    # The framework's model protocol hands apply() the full variables dict
+    # (init's return value); accept a bare param tree too.
+    if isinstance(params, dict) and "params" in params:
+        base_vars = dict(params)
+    else:
+        base_vars = {"params": params}
+
+    prefill, decode_steps, cache_skel = _compiled(
+        model, B, S, max_new_tokens, temperature, top_k, eos_token_id
+    )
+    cache = jax.tree.map(lambda s: jnp.zeros(s.shape, s.dtype), cache_skel)
+
+    rng, r0 = jax.random.split(rng)
+    cache, first = prefill(base_vars, cache, prompt_ids, r0)
+    if max_new_tokens == 1:
+        return first[:, None]
+    rest = decode_steps(base_vars, cache, first, rng)
+    return jnp.concatenate([first[:, None], rest.T], axis=1)
+
+
+@functools.lru_cache(maxsize=64)
+def _compiled(model, B, S, max_new_tokens, temperature, top_k, eos_token_id):
+    """Jitted (prefill, decode_steps, cache_skeleton) for a serving shape.
+
+    Keyed on the (hashable, frozen) flax module + static shape/sampling
+    params, so a serving loop calling generate() per request reuses the
+    compiled executables instead of retracing the whole scan each call.
+    """
+    total = S + max_new_tokens
+    dec = dataclasses.replace(model, decode=True, decode_len=total)
+
+    # Cache skeleton without materializing throwaway params: eval_shape
+    # traces init abstractly; callers build zeros per leaf.
+    shapes = jax.eval_shape(
+        lambda: dec.init(jax.random.key(0), jnp.zeros((B, 1), jnp.int32))
+    )
+    cache_skel = shapes["cache"]
+
+    @jax.jit
+    def prefill(params, cache, prompt, rng):
+        logits, vars_ = dec.apply(
+            {**params, "cache": cache}, prompt, mutable=["cache"]
+        )
+        tok = _sample(logits[:, -1], rng, temperature, top_k)
+        return vars_["cache"], tok
+
+    @jax.jit
+    def decode_steps(params, cache, first, rng):
+        def step(carry, _):
+            cache, tok, rng = carry
+            logits, vars_ = dec.apply(
+                {**params, "cache": cache}, tok[:, None], mutable=["cache"]
+            )
+            rng, sub = jax.random.split(rng)
+            nxt = _sample(logits[:, -1], sub, temperature, top_k)
+            if eos_token_id is not None:
+                nxt = jnp.where(tok == eos_token_id, eos_token_id, nxt)
+            return (vars_["cache"], nxt, rng), nxt
+
+        (_, _, _), toks = jax.lax.scan(
+            step, (cache, first, rng), None, length=max_new_tokens - 1
+        )
+        return toks  # [max_new-1, B]
+
+    return prefill, decode_steps, cache_skel

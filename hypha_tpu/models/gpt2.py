@@ -42,6 +42,8 @@ class GPT2Config:
 class _Block(nn.Module):
     config: GPT2Config
     attn_impl: Callable | None = None
+    decode: bool = False  # KV-cached serving forward (see models/llama.py)
+    decode_len: int = 0
 
     @nn.compact
     def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
@@ -55,7 +57,28 @@ class _Block(nn.Module):
         q = q.reshape(B, S, cfg.n_head, hd)
         k = k.reshape(B, S, cfg.n_head, hd)
         v = v.reshape(B, S, cfg.n_head, hd)
-        attn = (self.attn_impl or dot_product_attention)(q, k, v, causal=True)
+        if self.decode:
+            import jax
+
+            ck = self.variable(
+                "cache", "k", jnp.zeros, (B, self.decode_len, cfg.n_head, hd), dtype
+            )
+            cv = self.variable(
+                "cache", "v", jnp.zeros, (B, self.decode_len, cfg.n_head, hd), dtype
+            )
+            idx = self.variable("cache", "idx", lambda: jnp.zeros((), jnp.int32))
+            ck.value = jax.lax.dynamic_update_slice(
+                ck.value, k.astype(dtype), (0, idx.value, 0, 0)
+            )
+            cv.value = jax.lax.dynamic_update_slice(
+                cv.value, v.astype(dtype), (0, idx.value, 0, 0)
+            )
+            attn = dot_product_attention(
+                q, ck.value, cv.value, causal=True, q_offset=idx.value
+            )
+            idx.value = idx.value + S
+        else:
+            attn = (self.attn_impl or dot_product_attention)(q, k, v, causal=True)
         attn = attn.reshape(B, S, E)
         x = x + nn.Dense(E, dtype=dtype, name="c_proj")(attn)
 
@@ -69,10 +92,14 @@ class _Block(nn.Module):
 class GPT2(nn.Module):
     config: GPT2Config = GPT2Config()
     attn_impl: Callable | None = None  # e.g. the pallas flash kernel
+    decode: bool = False  # serving mode: KV-cached autoregressive forward
+    decode_len: int = 0
 
     @nn.compact
     def __call__(self, input_ids: jnp.ndarray) -> jnp.ndarray:
         """input_ids [B, S] -> logits [B, S, vocab] (f32)."""
+        import jax
+
         cfg = self.config
         dtype = jnp.dtype(cfg.dtype)
         B, S = input_ids.shape
@@ -82,9 +109,17 @@ class GPT2(nn.Module):
         wpe = self.param(
             "wpe", nn.initializers.normal(0.01), (cfg.n_positions, cfg.n_embd), jnp.float32
         )
-        x = (wte[input_ids] + wpe[None, :S]).astype(dtype)
+        if self.decode:
+            pos = self.variable("cache", "pos", lambda: jnp.zeros((), jnp.int32))
+            pe = jax.lax.dynamic_slice(wpe, (pos.value, 0), (S, cfg.n_embd))
+            pos.value = pos.value + S
+            x = (wte[input_ids] + pe[None]).astype(dtype)
+        else:
+            x = (wte[input_ids] + wpe[None, :S]).astype(dtype)
         for i in range(cfg.n_layer):
-            x = _Block(cfg, self.attn_impl, name=f"h_{i}")(x)
+            x = _Block(
+                cfg, self.attn_impl, self.decode, self.decode_len, name=f"h_{i}"
+            )(x)
         x = nn.LayerNorm(epsilon=cfg.layer_norm_epsilon, dtype=jnp.float32, name="ln_f")(x)
         # tied LM head: logits against the embedding matrix, f32 for the loss
         return jnp.einsum("bse,ve->bsv", x.astype(jnp.float32), wte)

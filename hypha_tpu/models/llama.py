@@ -122,9 +122,13 @@ class _RMSNorm(nn.Module):
 class _Attention(nn.Module):
     config: LlamaConfig
     attn_impl: Callable | None = None
+    decode: bool = False  # autoregressive serving: KV cache in the "cache"
+    decode_len: int = 0  # static cache capacity (prompt + new tokens)
 
     @nn.compact
     def __call__(self, x, cos, sin):
+        import jax
+
         cfg = self.config
         dtype = jnp.dtype(cfg.dtype)
         B, S, E = x.shape
@@ -136,6 +140,39 @@ class _Attention(nn.Module):
         q = q.reshape(B, S, cfg.num_heads, hd)
         k = k.reshape(B, S, cfg.num_kv_heads, hd)
         v = v.reshape(B, S, cfg.num_kv_heads, hd)
+        if self.decode:
+            # KV-cache decoding (net-new vs the reference, which has no
+            # inference path): static-shape cache + dynamic_update_slice +
+            # q_offset causal masking — everything a lax.scan'd decode loop
+            # needs to stay one compiled program.
+            ck = self.variable(
+                "cache", "k", jnp.zeros,
+                (B, self.decode_len, cfg.num_kv_heads, hd), dtype,
+            )
+            cv = self.variable(
+                "cache", "v", jnp.zeros,
+                (B, self.decode_len, cfg.num_kv_heads, hd), dtype,
+            )
+            idx = self.variable("cache", "idx", lambda: jnp.zeros((), jnp.int32))
+            positions = jnp.broadcast_to(idx.value + jnp.arange(S), (B, S))
+            q = apply_rope(q, cos, sin, positions=positions)
+            k = apply_rope(k, cos, sin, positions=positions)
+            ck.value = jax.lax.dynamic_update_slice(
+                ck.value, k.astype(dtype), (0, idx.value, 0, 0)
+            )
+            cv.value = jax.lax.dynamic_update_slice(
+                cv.value, v.astype(dtype), (0, idx.value, 0, 0)
+            )
+            # The window applies in decode too (positions are absolute, so
+            # the band mask composes with q_offset) — cached generation must
+            # match the training forward exactly for Mistral-style configs.
+            attn = dot_product_attention(
+                q, ck.value, cv.value, causal=True, q_offset=idx.value,
+                window=cfg.sliding_window,
+            )
+            idx.value = idx.value + S
+            attn = attn.reshape(B, S, cfg.num_heads * hd)
+            return nn.Dense(E, use_bias=False, dtype=dtype, name="o_proj")(attn)
         q = apply_rope(q, cos, sin)
         k = apply_rope(k, cos, sin)
         window = cfg.sliding_window
@@ -179,13 +216,15 @@ class _MLP(nn.Module):
 class _Block(nn.Module):
     config: LlamaConfig
     attn_impl: Callable | None = None
+    decode: bool = False
+    decode_len: int = 0
 
     @nn.compact
     def __call__(self, x, cos, sin):
         cfg = self.config
-        x = x + _Attention(cfg, self.attn_impl, name="self_attn")(
-            _RMSNorm(cfg.rms_eps, name="input_layernorm")(x), cos, sin
-        )
+        x = x + _Attention(
+            cfg, self.attn_impl, self.decode, self.decode_len, name="self_attn"
+        )(_RMSNorm(cfg.rms_eps, name="input_layernorm")(x), cos, sin)
         x = x + _MLP(cfg, name="mlp")(
             _RMSNorm(cfg.rms_eps, name="post_attention_layernorm")(x)
         )
@@ -195,6 +234,8 @@ class _Block(nn.Module):
 class Llama(nn.Module):
     config: LlamaConfig = LlamaConfig()
     attn_impl: Callable | None = None  # e.g. a ring-attention closure
+    decode: bool = False  # serving mode: KV-cached autoregressive forward
+    decode_len: int = 0
 
     @nn.compact
     def __call__(self, input_ids: jnp.ndarray) -> jnp.ndarray:
@@ -208,9 +249,13 @@ class Llama(nn.Module):
             jnp.float32,
         )
         x = embed[input_ids].astype(dtype)
-        cos, sin = rope_frequencies(cfg.head_dim, cfg.max_seq_len, cfg.rope_theta)
+        table_len = max(cfg.max_seq_len, self.decode_len)
+        cos, sin = rope_frequencies(cfg.head_dim, table_len, cfg.rope_theta)
         for i in range(cfg.num_layers):
-            x = _Block(cfg, self.attn_impl, name=f"layers_{i}")(x, cos, sin)
+            x = _Block(
+                cfg, self.attn_impl, self.decode, self.decode_len,
+                name=f"layers_{i}",
+            )(x, cos, sin)
         x = _RMSNorm(cfg.rms_eps, name="norm")(x)
         if cfg.tie_word_embeddings:
             lm_head = embed  # Qwen2-small convention: head shares embeddings

@@ -162,7 +162,15 @@ class _MuxConn:
         if self.closed:
             raise ConnectionError("mux connection closed")
         async with self._wlock:
-            await self.base.write(_HDR.pack(sid, flag, len(payload)) + bytes(payload))
+            if len(payload) >= 65536:
+                # Large chunks: write header and payload separately rather
+                # than copying megabytes into a concatenated buffer.
+                await self.base.write(_HDR.pack(sid, flag, len(payload)))
+                await self.base.write(payload)
+            else:
+                await self.base.write(
+                    _HDR.pack(sid, flag, len(payload)) + bytes(payload)
+                )
 
     def open_stream(self) -> _MuxStream:
         sid = self._next_id
@@ -191,15 +199,20 @@ class _MuxConn:
                     break
                 payload = await self.base.read_exactly(length) if length else b""
                 if flag == _OPEN:
+                    if self._on_stream is None:
+                        # Dial-side connection with no inbound handler: a
+                        # registered-but-unconsumed stream would eat window
+                        # credit forever. Refuse the stream instead.
+                        await self.send(sid, _RESET, b"")
+                        continue
                     stream = _MuxStream(self, sid)
                     self._streams[sid] = stream
                     if payload:
                         self._inflight += len(payload)
                         stream._deliver(payload)
-                    if self._on_stream is not None:
-                        task = asyncio.create_task(self._serve(stream))
-                        self._tasks.add(task)
-                        task.add_done_callback(self._tasks.discard)
+                    task = asyncio.create_task(self._serve(stream))
+                    self._tasks.add(task)
+                    task.add_done_callback(self._tasks.discard)
                 elif flag == _DATA:
                     stream = self._streams.get(sid)
                     if stream is not None:
