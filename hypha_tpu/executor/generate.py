@@ -89,8 +89,12 @@ def _compiled(model, B, S, max_new_tokens, temperature, top_k, eos_token_id):
     """Jitted (prefill, decode_steps, cache_skeleton) for a serving shape.
 
     Keyed on the (hashable, frozen) flax module + static shape/sampling
-    params, so a serving loop calling generate() per request reuses the
-    compiled executables instead of retracing the whole scan each call.
+    params, so repeat calls with the SAME (B, S, max_new) shapes reuse the
+    compiled executables. Distinct prompt lengths still compile separately
+    — a production serving loop should pad prompts to a small set of length
+    buckets before calling generate() (prompt-bucket masking inside the
+    cache is future work), and the persistent jax compilation cache
+    amortizes the rest.
     """
     total = S + max_new_tokens
     dec = dataclasses.replace(model, decode=True, decode_len=total)

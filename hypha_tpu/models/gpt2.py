@@ -58,25 +58,14 @@ class _Block(nn.Module):
         k = k.reshape(B, S, cfg.n_head, hd)
         v = v.reshape(B, S, cfg.n_head, hd)
         if self.decode:
-            import jax
+            from ..ops.kvcache import update_kv_cache
 
-            ck = self.variable(
-                "cache", "k", jnp.zeros, (B, self.decode_len, cfg.n_head, hd), dtype
-            )
-            cv = self.variable(
-                "cache", "v", jnp.zeros, (B, self.decode_len, cfg.n_head, hd), dtype
-            )
-            idx = self.variable("cache", "idx", lambda: jnp.zeros((), jnp.int32))
-            ck.value = jax.lax.dynamic_update_slice(
-                ck.value, k.astype(dtype), (0, idx.value, 0, 0)
-            )
-            cv.value = jax.lax.dynamic_update_slice(
-                cv.value, v.astype(dtype), (0, idx.value, 0, 0)
+            full_k, full_v, offset = update_kv_cache(
+                self, k.astype(dtype), v.astype(dtype), self.decode_len
             )
             attn = dot_product_attention(
-                q, ck.value, cv.value, causal=True, q_offset=idx.value
+                q, full_k, full_v, causal=True, q_offset=offset
             )
-            idx.value = idx.value + S
         else:
             attn = (self.attn_impl or dot_product_attention)(q, k, v, causal=True)
         attn = attn.reshape(B, S, E)

@@ -142,35 +142,36 @@ class _Attention(nn.Module):
         v = v.reshape(B, S, cfg.num_kv_heads, hd)
         if self.decode:
             # KV-cache decoding (net-new vs the reference, which has no
-            # inference path): static-shape cache + dynamic_update_slice +
-            # q_offset causal masking — everything a lax.scan'd decode loop
-            # needs to stay one compiled program.
-            ck = self.variable(
-                "cache", "k", jnp.zeros,
-                (B, self.decode_len, cfg.num_kv_heads, hd), dtype,
+            # inference path): static-shape cache + q_offset causal masking
+            # — everything a lax.scan'd decode loop needs to stay one
+            # compiled program. RoPE must see absolute positions, so it
+            # runs against the pre-update index (read via a peek variable
+            # inside update_kv_cache's offset return).
+            from ..ops.kvcache import update_kv_cache
+
+            # RoPE needs absolute positions, i.e. the cache index BEFORE
+            # this step's write — the prepare hook runs against it.
+            roped = {}
+
+            def _rope_at(offset):
+                positions = jnp.broadcast_to(offset + jnp.arange(S), (B, S))
+                roped["q"] = apply_rope(q, cos, sin, positions=positions)
+                return (
+                    apply_rope(k, cos, sin, positions=positions).astype(dtype),
+                    v.astype(dtype),
+                )
+
+            full_k, full_v, offset = update_kv_cache(
+                self, k, v, self.decode_len, prepare=_rope_at
             )
-            cv = self.variable(
-                "cache", "v", jnp.zeros,
-                (B, self.decode_len, cfg.num_kv_heads, hd), dtype,
-            )
-            idx = self.variable("cache", "idx", lambda: jnp.zeros((), jnp.int32))
-            positions = jnp.broadcast_to(idx.value + jnp.arange(S), (B, S))
-            q = apply_rope(q, cos, sin, positions=positions)
-            k = apply_rope(k, cos, sin, positions=positions)
-            ck.value = jax.lax.dynamic_update_slice(
-                ck.value, k.astype(dtype), (0, idx.value, 0, 0)
-            )
-            cv.value = jax.lax.dynamic_update_slice(
-                cv.value, v.astype(dtype), (0, idx.value, 0, 0)
-            )
+            q = roped["q"]
             # The window applies in decode too (positions are absolute, so
             # the band mask composes with q_offset) — cached generation must
             # match the training forward exactly for Mistral-style configs.
             attn = dot_product_attention(
-                q, ck.value, cv.value, causal=True, q_offset=idx.value,
+                q, full_k, full_v, causal=True, q_offset=offset,
                 window=cfg.sliding_window,
             )
-            idx.value = idx.value + S
             attn = attn.reshape(B, S, cfg.num_heads * hd)
             return nn.Dense(E, use_bias=False, dtype=dtype, name="o_proj")(attn)
         q = apply_rope(q, cos, sin)

@@ -162,15 +162,11 @@ class _MuxConn:
         if self.closed:
             raise ConnectionError("mux connection closed")
         async with self._wlock:
-            if len(payload) >= 65536:
-                # Large chunks: write header and payload separately rather
-                # than copying megabytes into a concatenated buffer.
-                await self.base.write(_HDR.pack(sid, flag, len(payload)))
-                await self.base.write(payload)
-            else:
-                await self.base.write(
-                    _HDR.pack(sid, flag, len(payload)) + bytes(payload)
-                )
+            # ONE write per frame, always: a caller's wait_for() cancelling
+            # between a split header/payload pair would tear the frame and
+            # desync every stream on the connection. The concatenation copy
+            # (~30 us/MiB) is the price of cancellation atomicity.
+            await self.base.write(_HDR.pack(sid, flag, len(payload)) + bytes(payload))
 
     def open_stream(self) -> _MuxStream:
         sid = self._next_id
@@ -202,8 +198,12 @@ class _MuxConn:
                     if self._on_stream is None:
                         # Dial-side connection with no inbound handler: a
                         # registered-but-unconsumed stream would eat window
-                        # credit forever. Refuse the stream instead.
-                        await self.send(sid, _RESET, b"")
+                        # credit forever. Refuse it — from a spawned task,
+                        # never awaiting a write inside the read pump (a
+                        # non-draining peer could wedge the connection).
+                        task = asyncio.create_task(self._reset_quietly(sid))
+                        self._tasks.add(task)
+                        task.add_done_callback(self._tasks.discard)
                         continue
                     stream = _MuxStream(self, sid)
                     self._streams[sid] = stream
@@ -229,6 +229,12 @@ class _MuxConn:
             pass
         finally:
             await self._teardown()
+
+    async def _reset_quietly(self, sid: int) -> None:
+        try:
+            await self.send(sid, _RESET, b"")
+        except (ConnectionError, OSError):
+            pass
 
     async def _serve(self, stream: _MuxStream) -> None:
         try:

@@ -1,0 +1,42 @@
+"""Shared KV-cache update for decode-mode attention blocks.
+
+One implementation of the cache bookkeeping (variable declaration,
+dynamic_update_slice writes, index advance) used by every model family's
+decode branch (models.gpt2, models.llama) — a cache-layout change lands
+once, not per family.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["update_kv_cache"]
+
+
+def update_kv_cache(
+    module, k: jnp.ndarray, v: jnp.ndarray, decode_len: int, prepare=None
+):
+    """Append this step's K/V into ``module``'s cache collection.
+
+    ``k``/``v``: [B, S, H_kv, D] for the current positions. Returns
+    ``(full_k, full_v, offset)`` — the cache contents [B, decode_len, H_kv,
+    D] and the integer position of this step's first token (the attention
+    ``q_offset``). ``prepare(offset) -> (k, v)`` lets position-dependent
+    transforms (RoPE) run against the pre-update index before the write —
+    flax forbids declaring the same variable twice, so peeking the index
+    outside this helper is not possible. Must be called from inside a flax
+    module in decode mode; declares ``cache`` variables k/v/idx on it.
+    """
+    B, S, Hkv, D = k.shape
+    idx = module.variable("cache", "idx", lambda: jnp.zeros((), jnp.int32))
+    offset = idx.value
+    if prepare is not None:
+        k, v = prepare(offset)
+    dtype = k.dtype
+    ck = module.variable("cache", "k", jnp.zeros, (B, decode_len, Hkv, D), dtype)
+    cv = module.variable("cache", "v", jnp.zeros, (B, decode_len, Hkv, D), dtype)
+    ck.value = jax.lax.dynamic_update_slice(ck.value, k, (0, offset, 0, 0))
+    cv.value = jax.lax.dynamic_update_slice(cv.value, v, (0, offset, 0, 0))
+    idx.value = offset + S
+    return ck.value, cv.value, offset

@@ -208,3 +208,26 @@ def test_registry_builds_mistral_and_qwen2_families():
         "vocab_size": 64, "hidden_size": 16, "intermediate_size": 32,
         "num_layers": 1, "num_heads": 2, "num_kv_heads": 1}})
     assert isinstance(m2, Llama) and cfg2.attn_bias
+
+
+def test_training_loop_loss_parity_vs_torch():
+    """Short end-to-end parity: identical weights + data + AdamW, our jitted
+    step vs the reference-style torch loop — loss trajectories must agree
+    (BASELINE metric: 'eval-loss parity vs CUDA/accelerate path')."""
+    import sys
+    from pathlib import Path
+
+    sys.path.insert(0, str(Path(__file__).resolve().parent.parent / "benchmarks"))
+    from eval_parity import jax_losses, torch_losses
+
+    torch.manual_seed(0)
+    hf_cfg = transformers.GPT2Config(
+        vocab_size=96, n_positions=32, n_embd=32, n_layer=1, n_head=2,
+        resid_pdrop=0.0, embd_pdrop=0.0, attn_pdrop=0.0,
+    )
+    hf = transformers.GPT2LMHeadModel(hf_cfg)
+    ids = np.random.default_rng(1).integers(0, 96, (2, 32)).astype(np.int64)
+    state = {k: v.numpy().copy() for k, v in hf.state_dict().items()}
+    lt = torch_losses(hf, ids, 8)
+    lj = jax_losses(hf, state, ids.astype(np.int32), 8)
+    assert max(abs(a - b) for a, b in zip(lt, lj)) < 1e-3
