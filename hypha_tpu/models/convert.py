@@ -107,17 +107,20 @@ def _qwen2_key(key: str) -> tuple[str, bool] | None:
 
 
 # Mistral checkpoints are weight-identical to Llama (the sliding window is a
-# config property, not a tensor); Qwen2 adds attention biases.
+# config property, not a tensor); Qwen2 adds attention biases; Gemma uses
+# the same tensor names (its offset-RMSNorm/GeGLU/embed-scale differences
+# are config, not layout).
 HF_CONVERTERS = {
     "gpt2": _gpt2_key,
     "llama": _llama_key,
     "mistral": _llama_key,
     "qwen2": _qwen2_key,
+    "gemma": _llama_key,
 }
 
 # Llama-architecture families whose checkpoints may tie the LM head to the
 # embeddings (no lm_head.weight tensor on disk).
-_TIED_HEAD_FAMILIES = {"llama", "mistral", "qwen2"}
+_TIED_HEAD_FAMILIES = {"llama", "mistral", "qwen2", "gemma"}
 
 
 def convert_state_dict(

@@ -58,7 +58,11 @@ class LlamaConfig:
     # Architecture toggles for Llama descendants:
     attn_bias: bool = False  # Qwen2: biases on q/k/v projections
     sliding_window: int | None = None  # Mistral: local attention window
-    tie_word_embeddings: bool = False  # Qwen2-small: lm_head = embeddings
+    tie_word_embeddings: bool = False  # Qwen2-small/Gemma: head = embeddings
+    head_dim_override: int | None = None  # Gemma: head_dim != hidden/heads
+    mlp_act: str = "silu"  # "silu" (Llama) | "gelu_tanh" (Gemma GeGLU)
+    rms_offset: bool = False  # Gemma RMSNorm: x * (1 + weight)
+    embed_scale: bool = False  # Gemma: embeddings scaled by sqrt(hidden)
 
     @classmethod
     def llama2_7b(cls) -> "LlamaConfig":
@@ -88,7 +92,20 @@ class LlamaConfig:
                 d.get("sliding_window") if d.get("use_sliding_window", True) else None
             ),
             tie_word_embeddings=d.get("tie_word_embeddings", False),
+            # Any Llama-family config may pin an explicit head_dim that
+            # differs from hidden/heads (Gemma always; Mistral-NeMo-style
+            # checkpoints too).
+            head_dim_override=d.get("head_dim"),
         )
+        if d.get("model_type") == "gemma":
+            fields.update(
+                mlp_act="gelu_tanh",
+                rms_offset=True,
+                embed_scale=True,
+                # HF Gemma always ties (the field is often absent from
+                # config.json but GemmaForCausalLM ties unconditionally).
+                tie_word_embeddings=d.get("tie_word_embeddings", True),
+            )
         fields.update(overrides)
         return cls(**fields)
 
@@ -107,16 +124,22 @@ class LlamaConfig:
 
     @property
     def head_dim(self) -> int:
+        if self.head_dim_override is not None:
+            return self.head_dim_override
         return self.hidden_size // self.num_heads
 
 
 class _RMSNorm(nn.Module):
     eps: float
+    # Gemma convention: weights parameterize the DELTA from identity
+    # (effective scale = 1 + weight, zero-init on disk).
+    offset: bool = False
 
     @nn.compact
     def __call__(self, x):
-        w = self.param("weight", nn.initializers.ones, (x.shape[-1],), jnp.float32)
-        return rms_norm(x, w, self.eps)
+        init = nn.initializers.zeros if self.offset else nn.initializers.ones
+        w = self.param("weight", init, (x.shape[-1],), jnp.float32)
+        return rms_norm(x, w + 1.0 if self.offset else w, self.eps)
 
 
 class _Attention(nn.Module):
@@ -209,8 +232,16 @@ class _MLP(nn.Module):
         dtype = jnp.dtype(cfg.dtype)
         gate = nn.Dense(cfg.intermediate_size, use_bias=False, dtype=dtype, name="gate_proj")(x)
         up = nn.Dense(cfg.intermediate_size, use_bias=False, dtype=dtype, name="up_proj")(x)
+        if cfg.mlp_act in ("gelu_tanh", "gelu"):  # Gemma GeGLU — HF ships
+            # both spellings ("gelu_pytorch_tanh" maps here via from_hf;
+            # older configs say "gelu" but GemmaMLP runs the tanh approx).
+            act = nn.gelu(gate, approximate=True)
+        elif cfg.mlp_act == "silu":
+            act = nn.silu(gate)
+        else:
+            raise ValueError(f"unknown mlp_act {cfg.mlp_act!r} (silu | gelu_tanh)")
         return nn.Dense(x.shape[-1], use_bias=False, dtype=dtype, name="down_proj")(
-            nn.silu(gate) * up
+            act * up
         )
 
 
@@ -225,9 +256,9 @@ class _Block(nn.Module):
         cfg = self.config
         x = x + _Attention(
             cfg, self.attn_impl, self.decode, self.decode_len, name="self_attn"
-        )(_RMSNorm(cfg.rms_eps, name="input_layernorm")(x), cos, sin)
+        )(_RMSNorm(cfg.rms_eps, cfg.rms_offset, name="input_layernorm")(x), cos, sin)
         x = x + _MLP(cfg, name="mlp")(
-            _RMSNorm(cfg.rms_eps, name="post_attention_layernorm")(x)
+            _RMSNorm(cfg.rms_eps, cfg.rms_offset, name="post_attention_layernorm")(x)
         )
         return x
 
@@ -250,6 +281,8 @@ class Llama(nn.Module):
             jnp.float32,
         )
         x = embed[input_ids].astype(dtype)
+        if cfg.embed_scale:  # Gemma: inputs scaled by sqrt(hidden), in dtype
+            x = x * jnp.asarray(cfg.hidden_size**0.5, dtype)
         table_len = max(cfg.max_seq_len, self.decode_len)
         cos, sin = rope_frequencies(cfg.head_dim, table_len, cfg.rope_theta)
         for i in range(cfg.num_layers):
@@ -257,7 +290,7 @@ class Llama(nn.Module):
                 cfg, self.attn_impl, self.decode, self.decode_len,
                 name=f"layers_{i}",
             )(x, cos, sin)
-        x = _RMSNorm(cfg.rms_eps, name="norm")(x)
+        x = _RMSNorm(cfg.rms_eps, cfg.rms_offset, name="norm")(x)
         if cfg.tie_word_embeddings:
             lm_head = embed  # Qwen2-small convention: head shares embeddings
         else:

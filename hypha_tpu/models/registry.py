@@ -41,6 +41,7 @@ FAMILIES = {
     # with converted torch weights (models.convert).
     "mistral": (Llama, LlamaConfig),
     "qwen2": (Llama, LlamaConfig),
+    "gemma": (Llama, LlamaConfig),
     "mixtral": (Mixtral, MixtralConfig),
     "lenet": (LeNet, LeNetConfig),
 }
@@ -48,6 +49,12 @@ FAMILIES = {
 # Architecture toggles implied by the family name.
 _FAMILY_DEFAULTS: dict[str, dict[str, Any]] = {
     "qwen2": {"attn_bias": True},
+    "gemma": {
+        "mlp_act": "gelu_tanh",
+        "rms_offset": True,
+        "embed_scale": True,
+        "tie_word_embeddings": True,
+    },
 }
 
 

@@ -231,3 +231,39 @@ def test_training_loop_loss_parity_vs_torch():
     lt = torch_losses(hf, ids, 8)
     lj = jax_losses(hf, state, ids.astype(np.int32), 8)
     assert max(abs(a - b) for a, b in zip(lt, lj)) < 1e-3
+
+
+def test_gemma_conversion_matches_hf_logits():
+    """Gemma: offset-RMSNorm (1+w), GeGLU, sqrt(E) embedding scale, explicit
+    head_dim, tied head — all map into the native Llama module with logit
+    parity against the torch reference."""
+    hf_cfg = transformers.GemmaConfig(
+        vocab_size=96,
+        hidden_size=32,
+        intermediate_size=64,
+        num_hidden_layers=2,
+        num_attention_heads=4,
+        num_key_value_heads=2,
+        head_dim=16,  # != hidden/heads: exercises the override
+        max_position_embeddings=64,
+        rms_norm_eps=1e-6,
+        rope_theta=10000.0,
+        hidden_activation="gelu_pytorch_tanh",
+    )
+    torch.manual_seed(5)
+    hf = transformers.GemmaForCausalLM(hf_cfg).eval()
+    ids = np.random.default_rng(5).integers(0, 96, (2, 12))
+    with torch.no_grad():
+        want = hf(torch.from_numpy(ids)).logits.numpy()
+
+    from hypha_tpu.models.llama import LlamaConfig
+
+    cfg = LlamaConfig.from_hf(hf_cfg.to_dict(), dtype="float32")
+    assert cfg.rms_offset and cfg.embed_scale and cfg.mlp_act == "gelu_tanh"
+    assert cfg.head_dim == 16 and cfg.tie_word_embeddings
+    model = Llama(cfg)
+    template = model.init(jax.random.key(0), ids.astype(np.int32))
+    state = {k: v.numpy() for k, v in hf.state_dict().items()}
+    params = convert_state_dict("gemma", state, template)
+    got = np.asarray(model.apply(params, ids.astype(np.int32)))
+    np.testing.assert_allclose(got, want, rtol=2e-3, atol=2e-3)

@@ -31,7 +31,7 @@ def _greedy_reference(model, params, prompt, n):
     return jnp.stack(out, axis=1)
 
 
-@pytest.mark.parametrize("family", ["gpt2", "llama", "qwen2"])
+@pytest.mark.parametrize("family", ["gpt2", "llama", "qwen2", "gemma"])
 def test_cached_decode_matches_full_forward(family):
     if family == "gpt2":
         cfg = GPT2Config(vocab_size=96, n_positions=64, n_embd=32, n_layer=2,
@@ -41,6 +41,13 @@ def test_cached_decode_matches_full_forward(family):
         cfg = LlamaConfig(vocab_size=96, hidden_size=32, intermediate_size=64,
                           num_layers=2, num_heads=4, num_kv_heads=2,
                           max_seq_len=64, dtype="float32")
+        model = Llama(cfg)
+    elif family == "gemma":  # offset-norm, GeGLU, embed scale, tied head
+        cfg = LlamaConfig(vocab_size=96, hidden_size=32, intermediate_size=64,
+                          num_layers=2, num_heads=4, num_kv_heads=2,
+                          max_seq_len=64, dtype="float32", rms_offset=True,
+                          embed_scale=True, mlp_act="gelu_tanh",
+                          tie_word_embeddings=True, head_dim_override=16)
         model = Llama(cfg)
     else:  # qwen2-flavoured llama: biases + tied head
         cfg = LlamaConfig(vocab_size=96, hidden_size=32, intermediate_size=64,
