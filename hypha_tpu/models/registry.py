@@ -97,7 +97,12 @@ def build_model(spec: dict[str, Any], attn_impl=None):
         cfg = config_cls.from_hf(dict(hf_config))
     else:
         cfg = config_cls()
-    overrides = {**_FAMILY_DEFAULTS.get(family, {}), **(spec.get("config") or {})}
+    # Family defaults fill gaps only when NO checkpoint config drove the
+    # build — from_hf already derives architecture toggles from the
+    # config.json (and may legitimately disagree with the defaults, e.g. an
+    # untied-head gemma variant).
+    base = {} if hf_config is not None else _FAMILY_DEFAULTS.get(family, {})
+    overrides = {**base, **(spec.get("config") or {})}
     if overrides:
         import dataclasses
 

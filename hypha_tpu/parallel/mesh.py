@@ -2,6 +2,7 @@
 
 Axes (in fixed order, outer to inner — outer axes map to slower links):
   dp    data parallel (pure replication of params)
+  pp    pipeline parallel (layer stages; activations flow via ppermute)
   fsdp  fully-sharded data parallel (params sharded, gathered per layer)
   ep    expert parallel (MoE expert axis)
   tp    tensor parallel (attention heads / mlp hidden)
@@ -16,7 +17,7 @@ from jax.sharding import Mesh
 
 __all__ = ["MESH_AXES", "create_mesh", "local_mesh"]
 
-MESH_AXES = ("dp", "fsdp", "ep", "tp", "sp")
+MESH_AXES = ("dp", "pp", "fsdp", "ep", "tp", "sp")
 
 
 def create_mesh(axis_sizes: dict[str, int] | None = None, devices=None) -> Mesh:
