@@ -32,9 +32,11 @@ __all__ = [
     "PROTOCOL_API",
     "PROTOCOL_HEALTH",
     "PROTOCOL_PROGRESS",
+    "PROTOCOL_GENERATE",
     "TOPIC_WORKER",
     "TRAIN_EXECUTOR_NAME",
     "AGGREGATE_EXECUTOR_NAME",
+    "INFER_EXECUTOR_NAME",
     "encode",
     "decode",
     "register",
@@ -87,12 +89,14 @@ __all__ = [
 PROTOCOL_API = "/hypha-api/0.0.1"
 PROTOCOL_HEALTH = "/hypha-health/0.0.1"
 PROTOCOL_PROGRESS = "/hypha-progress/0.0.1"
+PROTOCOL_GENERATE = "/hypha-generate/0.0.1"
 TOPIC_WORKER = "hypha/worker"
 
 # Executor implementation names: what the scheduler asks for at auction and
 # what workers advertise (crates/scheduler/src/bin/hypha-scheduler.rs:47-48).
 TRAIN_EXECUTOR_NAME = "diloco-transformer"
 AGGREGATE_EXECUTOR_NAME = "parameter-server"
+INFER_EXECUTOR_NAME = "generate"
 
 # --------------------------------------------------------------------------
 # Self-describing serialization: registry of tagged dataclasses.
@@ -462,21 +466,65 @@ class AggregateExecutorConfig:
 
 @register
 @dataclass(slots=True)
-class Executor:
-    """Tagged union Train|Aggregate (crates/messages/src/lib.rs JobSpec)."""
+class InferExecutorConfig:
+    """Serving job: load a model, answer GenerateRequest RPCs.
 
-    kind: str  # "train" | "aggregate"
+    Net-new wire vocabulary — the reference's Executor union is
+    Train|Aggregate only (crates/messages/src/lib.rs:627-631) and it ships
+    no inference path; BASELINE.json config 4 ("Llama-2-7B inference
+    serving via the gateway on a TPU worker pool") names the scenario this
+    realizes. Additive: existing peers never see kind="infer" unless a
+    scheduler dispatches one.
+    """
+
+    model: dict  # same shape as TrainExecutorConfig.model
+    serve_name: str  # providers announce "serve:<serve_name>" for discovery
+    max_new_tokens: int = 256  # per-request cap
+    max_batch: int = 8  # prompts per request cap
+    temperature: float = 0.0  # default sampling (request may override)
+    top_k: int | None = None
+
+
+@register
+@dataclass(slots=True)
+class GenerateRequest:
+    """One serving RPC: token-id prompts in, continuations out."""
+
+    serve_name: str
+    prompts: list  # list[list[int]]
+    max_new_tokens: int = 64
+    temperature: float | None = None  # None = server default
+    top_k: int | None = None
+    seed: int = 0
+
+
+@register
+@dataclass(slots=True)
+class GenerateResponse:
+    tokens: list  # list[list[int]], one continuation per prompt
+
+
+@register
+@dataclass(slots=True)
+class Executor:
+    """Tagged union Train|Aggregate (crates/messages/src/lib.rs JobSpec),
+    plus the net-new Infer serving kind."""
+
+    kind: str  # "train" | "aggregate" | "infer"
     name: str  # executor implementation name, e.g. "diloco-transformer"
     train: TrainExecutorConfig | None = None
     aggregate: AggregateExecutorConfig | None = None
+    infer: InferExecutorConfig | None = None
 
     def __post_init__(self) -> None:
-        if self.kind not in ("train", "aggregate"):
+        if self.kind not in ("train", "aggregate", "infer"):
             raise ValueError(f"unknown executor kind {self.kind!r}")
         if self.kind == "train" and self.train is None:
             raise ValueError("train executor needs train config")
         if self.kind == "aggregate" and self.aggregate is None:
             raise ValueError("aggregate executor needs aggregate config")
+        if self.kind == "infer" and self.infer is None:
+            raise ValueError("infer executor needs infer config")
 
 
 @register

@@ -92,9 +92,14 @@ def build_model(spec: dict[str, Any], attn_impl=None):
             )
         cfg = presets[preset]()
     elif hf_config is not None and hasattr(config_cls, "from_hf"):
-        # A fetched checkpoint's config.json fields drive the native config
-        # (llama / mistral / qwen2).
-        cfg = config_cls.from_hf(dict(hf_config))
+        # A fetched checkpoint's config.json fields drive the native config.
+        # The family name stands in for a missing model_type so from_hf can
+        # derive architecture toggles (gemma/qwen2) even from a bare field
+        # dict — otherwise a caller-supplied hf_config without model_type
+        # would silently build plain-Llama architecture.
+        hf = dict(hf_config)
+        hf.setdefault("model_type", family)
+        cfg = config_cls.from_hf(hf)
     else:
         cfg = config_cls()
     # Family defaults fill gaps only when NO checkpoint config drove the

@@ -1215,6 +1215,20 @@ class Node:
         if not reply.get("ok", False):
             raise RequestError(reply.get("error", "provide failed"))
 
+    async def unprovide(self, key: str) -> None:
+        """Withdraw a provider announcement: stop the refresh loop from
+        re-announcing AND delete the registry entry now (clients must not
+        keep discovering a dead server until the TTL sweep)."""
+        self._provided.discard(key)
+        try:
+            await self._registry_call(
+                {"t": "unprovide", "key": key, "peer": self.peer_id}
+            )
+        except RequestError as e:
+            # Best effort: with the refresh stopped, PROVIDER_TTL ages the
+            # entry out anyway.
+            log.debug("unprovide %s failed: %s", key, e)
+
     async def find_providers(self, key: str) -> list[str]:
         reply = await self._registry_call({"t": "find", "key": key})
         providers = reply.get("providers", [])
@@ -1262,6 +1276,10 @@ class Node:
             self._providers.setdefault(key, {})[peer] = time.time()
             if frame.get("addrs"):
                 self._addr_book[peer] = list(frame["addrs"])
+            return {"ok": True}
+        if t == "unprovide":
+            key, peer = frame.get("key", ""), from_peer or frame.get("peer", "")
+            self._providers.get(key, {}).pop(peer, None)
             return {"ok": True}
         if t == "find":
             # Drop providers that stopped refreshing (crashed data nodes must

@@ -142,20 +142,19 @@ def make_gpt2_pp_train_step(cfg, mesh, n_micro: int, dp_axis: str = "dp"):
         check_vma=False,
     )
 
+    import flax.linen as nn
+
+    # The SAME flax module GPT2 uses for its final norm — parity with the
+    # plain model is structural, not re-derived math.
+    ln_f = nn.LayerNorm(epsilon=cfg.layer_norm_epsilon, dtype=jnp.float32)
+
     def apply_fn(params, ids):
         outer, stacked = params
         dtype = jnp.dtype(cfg.dtype)
         S = ids.shape[1]
         x = (outer["wte"][ids] + outer["wpe"][None, :S]).astype(dtype)
         h = pipe(stacked, x)
-        # ln_f in float32, matching GPT2's nn.LayerNorm(dtype=float32) —
-        # bf16 runs must not drift from the plain model.
-        h = h.astype(jnp.float32)
-        ln = outer["ln_f"]
-        mean = h.mean(-1, keepdims=True)
-        var = ((h - mean) ** 2).mean(-1, keepdims=True)
-        hn = (h - mean) * jax.lax.rsqrt(var + cfg.layer_norm_epsilon)
-        hn = hn * ln["scale"] + ln["bias"]
-        return jnp.einsum("bse,ve->bsv", hn, outer["wte"])
+        hn = ln_f.apply({"params": outer["ln_f"]}, h)
+        return jnp.einsum("bse,ve->bsv", hn.astype(jnp.float32), outer["wte"])
 
     return make_train_step(apply_fn)

@@ -1,0 +1,227 @@
+"""In-process inference executor: load a model, serve GenerateRequest RPCs.
+
+Net-new vs the reference (its Executor union is Train|Aggregate only and it
+ships no inference path — crates/messages/src/lib.rs:627-631); this is the
+worker half of BASELINE.json config 4's "inference serving via the gateway
+on a TPU worker pool": the scheduler dispatches an ``Executor(kind="infer")``
+job, the worker loads the model, announces ``serve:<name>`` in the registry,
+and answers ``/hypha-generate/0.0.1`` RPCs with KV-cached continuations
+(executor.generate: prefill + one compiled lax.scan per shape) until the
+job is cancelled or its lease expires.
+
+Clients: :func:`generate_remote` — find providers of ``serve:<name>``
+through the gateway registry, RPC the first reachable one.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+from dataclasses import dataclass, field
+from pathlib import Path
+
+from ..messages import (
+    PROTOCOL_GENERATE,
+    GenerateRequest,
+    GenerateResponse,
+    JobSpec,
+)
+from ..network.node import Node, RequestError
+from .job_manager import Execution, JobExecutor
+
+__all__ = ["InProcessInferExecutor", "generate_remote", "serve_key"]
+
+log = logging.getLogger("hypha.worker.infer")
+
+
+def serve_key(name: str) -> str:
+    return f"serve:{name}"
+
+
+@dataclass(slots=True)
+class InProcessInferExecutor(JobExecutor):
+    node: Node
+    work_root: Path = field(default_factory=lambda: Path("/tmp"))
+
+    async def execute(
+        self, job_id: str, spec: JobSpec, scheduler_peer: str
+    ) -> Execution:
+        cfg = spec.executor.infer
+        if cfg is None:
+            raise ValueError(f"job {job_id} is not an infer job")
+
+        # Return the Execution IMMEDIATELY — a 7B-class load/convert takes
+        # minutes, and the dispatch RPC (and lease-expiry cancellation) must
+        # not block on it. The model loads in a background task; the serve
+        # handler registers once it's ready.
+        execution = Execution(job_id)
+        loaded: dict = {}
+        cancelled = asyncio.Event()
+
+        async def handle(peer: str, req: GenerateRequest) -> GenerateResponse:
+            model, params = loaded["model"], loaded["params"]
+            if len(req.prompts) > cfg.max_batch:
+                raise ValueError(
+                    f"{len(req.prompts)} prompts exceed max_batch {cfg.max_batch}"
+                )
+            if not req.prompts or any(not p for p in req.prompts):
+                raise ValueError("prompts must be non-empty token id lists")
+            n_new = min(int(req.max_new_tokens), cfg.max_new_tokens)
+            temperature = (
+                cfg.temperature if req.temperature is None else req.temperature
+            )
+            top_k = cfg.top_k if req.top_k is None else req.top_k
+            tokens = await asyncio.to_thread(
+                self._generate_grouped,
+                model, params, req.prompts, n_new, temperature, top_k, req.seed,
+            )
+            return GenerateResponse(tokens=tokens)
+
+        registration: dict = {}
+
+        async def bring_up() -> None:
+            try:
+                model, params = await asyncio.to_thread(
+                    self._load_model, dict(cfg.model)
+                )
+            except asyncio.CancelledError:
+                raise
+            except Exception as e:
+                log.exception("infer job %s model load failed", job_id)
+                execution.finish("failed", str(e))
+                return
+            if cancelled.is_set():
+                return
+            loaded["model"], loaded["params"] = model, params
+            registration["reg"] = (
+                self.node.on(PROTOCOL_GENERATE, GenerateRequest)
+                .match(lambda m: m.serve_name == cfg.serve_name)
+                .concurrency(4)
+                .respond_with(handle)
+            )
+            try:
+                await self.node.provide(serve_key(cfg.serve_name))
+            except RequestError as e:
+                log.warning("serve announce for %s failed: %s", cfg.serve_name, e)
+            log.info("job %s serving %s", job_id, cfg.serve_name)
+
+        loader = asyncio.create_task(bring_up())
+
+        # A serving job runs until cancelled (or its lease expires).
+        async def cancel() -> None:
+            cancelled.set()
+            if registration.get("reg") is not None:
+                registration["reg"].close()
+            # Withdraw discovery: stop re-announcing AND delete the registry
+            # entry, so clients don't keep finding a dead server.
+            await self.node.unprovide(serve_key(cfg.serve_name))
+            if not loader.done():
+                loader.cancel()
+            execution.finish("cancelled")
+
+        execution.cancel = cancel  # type: ignore[method-assign]
+        return execution
+
+    # -- blocking helpers (run in worker threads) ---------------------------
+
+    def _load_model(self, model_spec: dict):
+        import jax
+
+        from ..models import build_model
+
+        model, _cfg = build_model(model_spec)
+        seed = int(model_spec.get("seed", 0))
+        import numpy as np
+
+        probe = np.zeros((1, 8), np.int32)
+        path = model_spec.get("weights")
+        if path:  # optional local checkpoint (flat safetensors or HF dict)
+            from ..executor.serialization import unflatten_like
+            from ..models.convert import convert_state_dict, load_checkpoint_files
+
+            # Abstract template only — materializing a random 7B tree just
+            # to overwrite it would double peak memory at job start.
+            template = jax.eval_shape(
+                lambda: model.init(jax.random.key(seed), probe)
+            )
+            state = load_checkpoint_files([Path(path)])
+            try:
+                params = unflatten_like(state, template)
+            except KeyError:
+                params = convert_state_dict(
+                    model_spec.get("family", "gpt2"), state, template
+                )
+        else:
+            params = model.init(jax.random.key(seed), probe)
+        return model, params
+
+    def _generate_grouped(
+        self, model, params, prompts, n_new, temperature, top_k, seed
+    ):
+        """Batch prompts of equal length together (generate requires a
+        rectangular [B, S]); order is preserved in the response."""
+        import jax
+        import numpy as np
+
+        from ..executor.generate import generate
+
+        by_len: dict[int, list[int]] = {}
+        for i, p in enumerate(prompts):
+            by_len.setdefault(len(p), []).append(i)
+        out: list[list[int]] = [None] * len(prompts)  # type: ignore[list-item]
+        for length, idxs in by_len.items():
+            batch = np.asarray([prompts[i] for i in idxs], np.int32)
+            toks = np.asarray(
+                generate(
+                    model, params, batch, n_new,
+                    temperature=temperature, top_k=top_k,
+                    rng=jax.random.key(seed),
+                )
+            )
+            for row, i in enumerate(idxs):
+                out[i] = toks[row].tolist()
+        return out
+
+
+async def generate_remote(
+    node: Node,
+    serve_name: str,
+    prompts: list,
+    max_new_tokens: int = 64,
+    *,
+    temperature: float | None = None,
+    top_k: int | None = None,
+    seed: int = 0,
+    timeout: float = 120.0,
+) -> list:
+    """Client side: discover a server of ``serve_name`` via the registry and
+    RPC it. Returns one token-id list per prompt. Discovery polls briefly —
+    a freshly dispatched serve job announces only once its model is loaded."""
+    deadline = asyncio.get_running_loop().time() + min(timeout, 30.0)
+    while True:
+        providers = await node.find_providers(serve_key(serve_name))
+        if providers:
+            break
+        if asyncio.get_running_loop().time() >= deadline:
+            raise RequestError(f"no provider serving {serve_name!r}")
+        await asyncio.sleep(0.2)
+    last: Exception | None = None
+    for peer in providers:
+        try:
+            resp = await node.request(
+                peer,
+                PROTOCOL_GENERATE,
+                GenerateRequest(
+                    serve_name=serve_name,
+                    prompts=[list(map(int, p)) for p in prompts],
+                    max_new_tokens=max_new_tokens,
+                    temperature=temperature,
+                    top_k=top_k,
+                    seed=seed,
+                ),
+                timeout=timeout,
+            )
+            return resp.tokens
+        except RequestError as e:
+            last = e
+    raise RequestError(f"all providers of {serve_name!r} failed: {last}")

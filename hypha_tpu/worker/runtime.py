@@ -25,7 +25,7 @@ import logging
 from pathlib import Path
 
 from ..health import serve_health
-from ..messages import AGGREGATE_EXECUTOR_NAME, TRAIN_EXECUTOR_NAME
+from ..messages import AGGREGATE_EXECUTOR_NAME, INFER_EXECUTOR_NAME, TRAIN_EXECUTOR_NAME
 from ..network.fabric import Transport
 from ..network.node import Node
 from ..resources import Resources
@@ -88,6 +88,13 @@ class WorkerNode:
                 )
             executors[("aggregate", AGGREGATE_EXECUTOR_NAME)] = (
                 ParameterServerExecutor(self.node, work_root)
+            )
+            # Serving (net-new; BASELINE config 4): every worker can host
+            # infer jobs — the model loads lazily on dispatch.
+            from .infer_executor import InProcessInferExecutor
+
+            executors[("infer", INFER_EXECUTOR_NAME)] = InProcessInferExecutor(
+                self.node, work_root
             )
         self.job_manager = JobManager(self.node, executors)
         self.arbiter = Arbiter(
