@@ -174,3 +174,88 @@ def test_serving_loads_checkpoint_weights(tmp_path):
         await client.stop(); await worker.stop(); await gw.stop()
 
     run(main())
+
+
+def test_infer_job_through_full_auction_path():
+    """The FULL control plane dispatches a serving job: RequestWorker gossip
+    -> worker offer -> lease -> DispatchJob(kind=infer) -> model serves;
+    lease-LINKED cancellation (the call the arbiter's expiry prune makes)
+    stops serving and withdraws discovery. (Timed expiry itself is covered
+    by test_auction.py's prune tests.)"""
+    from hypha_tpu.messages import (
+        INFER_EXECUTOR_NAME,
+        ExecutorDescriptor,
+        PriceRange,
+        WorkerSpec,
+    )
+    from hypha_tpu.resources import Resources
+    from hypha_tpu.scheduler.allocator import GreedyWorkerAllocator
+    from hypha_tpu.scheduler.task import StatusRouter, Task
+    from hypha_tpu.scheduler.worker_handle import WorkerHandle
+    from hypha_tpu.worker import (
+        Arbiter,
+        JobManager,
+        LeaseManager,
+        OfferConfig,
+        StaticResourceManager,
+    )
+
+    async def main():
+        hub = MemoryTransport()
+        gw = Node(hub.shared(), peer_id="gw", registry_server=True)
+        await gw.start()
+        sched = Node(hub.shared(), peer_id="sched", bootstrap=[gw.listen_addrs[0]])
+        worker = Node(hub.shared(), peer_id="w1", bootstrap=[gw.listen_addrs[0]])
+        await sched.start(); await worker.start()
+        await sched.wait_for_bootstrap(5); await worker.wait_for_bootstrap(5)
+
+        lm = LeaseManager(StaticResourceManager(Resources(tpu=4, cpu=8, memory=1000)))
+        jm = JobManager(
+            worker,
+            {("infer", INFER_EXECUTOR_NAME): InProcessInferExecutor(worker)},
+        )
+        arb = Arbiter(worker, lm, jm, offer=OfferConfig(price=1.0, floor=0.0))
+        await arb.start()
+
+        allocator = GreedyWorkerAllocator(sched)
+        spec = WorkerSpec(
+            resources=Resources(tpu=1.0, memory=100),
+            executor=[
+                ExecutorDescriptor(executor_class="infer", name=INFER_EXECUTOR_NAME)
+            ],
+        )
+        offers = await allocator.request(
+            spec, PriceRange(bid=2.0, max=5.0), timeout=2.0, num_workers=1
+        )
+        assert len(offers) == 1
+        handle = await WorkerHandle.create(sched, offers[0])
+
+        job = JobSpec(
+            job_id="serve-auction",
+            executor=Executor(
+                kind="infer", name=INFER_EXECUTOR_NAME,
+                infer=InferExecutorConfig(model=_MODEL, serve_name="auctioned"),
+            ),
+        )
+        router = StatusRouter(sched)
+        task = await Task.dispatch(sched, router, job, [handle])
+        peer, status = await task.next_status(timeout=5)
+        assert status.state == "running"
+
+        client = Node(hub.shared(), peer_id="c", bootstrap=[gw.listen_addrs[0]])
+        await client.start(); await client.wait_for_bootstrap(5)
+        toks = await generate_remote(client, "auctioned", [[1, 2, 3]], 4)
+        assert len(toks[0]) == 4
+
+        # lease-linked cancellation must stop serving
+        await jm.cancel_for_lease(handle.lease_id)
+        with pytest.raises(RequestError, match="no provider"):
+            await generate_remote(client, "auctioned", [[1]], 2, timeout=1.0)
+
+        task.close(); router.close()
+        await handle.release()
+        await arb.stop()
+        for n in (client, sched, worker, gw):
+            await n.stop()
+
+    run(main())
