@@ -246,6 +246,34 @@ async def _run_scheduler(conf: SchedulerConfig) -> None:
     print(f"scheduler {node.peer_id} on {node.listen_addrs}", flush=True)
     try:
         await node.wait_for_bootstrap()
+        if conf.job.kind == "serve":
+            # Inference deployment (BASELINE config 4): buy a worker via the
+            # auction, dispatch the serving job, hold it elastically until
+            # SIGINT/SIGTERM.
+            from .scheduler.serving import ServingSupervisor
+
+            sup = ServingSupervisor(
+                node,
+                conf.job.to_model_spec(),
+                conf.job.serve_name,
+                resources=conf.job.worker_resources(),
+                price=conf.job.worker_price(),
+                max_new_tokens=conf.job.serve_max_new_tokens,
+                max_batch=conf.job.serve_max_batch,
+            )
+            print(f"serving {conf.job.serve_name!r}; ctrl-c to stop", flush=True)
+            runner = asyncio.create_task(sup.run())
+            with tracer.span("serve_job", {"serve_name": conf.job.serve_name}):
+                # Watch the supervisor too: if it dies, surface the error
+                # now instead of sitting signal-parked while serving nothing.
+                signal_task = asyncio.create_task(_serve_until_signal())
+                await asyncio.wait(
+                    {signal_task, runner}, return_when=asyncio.FIRST_COMPLETED
+                )
+                signal_task.cancel()
+            await sup.stop()
+            await runner
+            return
         connector = (
             AimConnector(conf.status_bridge) if conf.status_bridge else NoOpConnector()
         )

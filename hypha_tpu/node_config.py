@@ -197,6 +197,19 @@ class JobSection:
 
     # Default job mirrors the reference's (scheduler_config.rs:79-102:
     # 2 workers, 100 rounds, 1200 samples/round, LeNet/MNIST).
+    kind: str = field(
+        default="train",
+        metadata={"doc": "train (DiLoCo) | serve (inference deployment)"},
+    )
+    serve_name: str = field(
+        default="", metadata={"doc": "serve jobs: name announced as serve:<name>"}
+    )
+    serve_max_new_tokens: int = field(
+        default=256, metadata={"doc": "serve jobs: per-request generation cap"}
+    )
+    serve_max_batch: int = field(
+        default=8, metadata={"doc": "serve jobs: prompts per request cap"}
+    )
     dataset: str = field(
         default="mnist", metadata={"doc": "dataset name announced by a data node"}
     )
@@ -251,6 +264,18 @@ class JobSection:
     )
 
     def validate(self) -> None:
+        if self.kind not in ("train", "serve"):
+            raise ConfigError("job.kind must be 'train' or 'serve'")
+        try:
+            ModelType(self.model_type)
+        except ValueError:
+            raise ConfigError(
+                f"job.model_type: unknown {self.model_type!r}"
+            ) from None
+        if self.kind == "serve":
+            if not self.serve_name:
+                raise ConfigError("job.serve_name is required for serve jobs")
+            return  # dataset/rounds are train-only concerns
         if not self.dataset:
             raise ConfigError("job.dataset is required")
         if self.max_attempts < 1:
@@ -264,7 +289,8 @@ class JobSection:
         except ValueError:
             raise ConfigError(f"job.lr_schedule: unknown {self.lr_schedule!r}")
 
-    def to_job(self) -> DiLoCoJob:
+    def to_model_spec(self) -> dict:
+        """The model dict shared by train and serve jobs."""
         model: dict[str, Any] = {
             "model_type": ModelType(self.model_type),
             "family": self.model_family,
@@ -274,6 +300,18 @@ class JobSection:
             model["preset"] = self.model_preset
         if self.model_config:
             model["config"] = dict(self.model_config)
+        return model
+
+    def worker_resources(self) -> Resources:
+        return Resources(
+            tpu=self.worker_tpu, cpu=self.worker_cpu, memory=self.worker_memory
+        )
+
+    def worker_price(self) -> PriceRange:
+        return PriceRange(bid=self.worker_bid, max=self.worker_max_price)
+
+    def to_job(self) -> DiLoCoJob:
+        model = self.to_model_spec()
         schedule = None
         if self.lr_schedule != "constant":
             schedule = LRScheduler(
@@ -293,14 +331,10 @@ class JobSection:
             outer_optimizer=Nesterov(lr=self.outer_lr, momentum=self.outer_momentum),
             resources=JobResources(
                 num_workers=self.num_workers,
-                worker=Resources(
-                    tpu=self.worker_tpu, cpu=self.worker_cpu, memory=self.worker_memory
-                ),
+                worker=self.worker_resources(),
                 parameter_server=Resources(cpu=self.ps_cpu, memory=self.ps_memory),
-                worker_price=PriceRange(bid=self.worker_bid, max=self.worker_max_price),
-                parameter_server_price=PriceRange(
-                    bid=self.worker_bid, max=self.worker_max_price
-                ),
+                worker_price=self.worker_price(),
+                parameter_server_price=self.worker_price(),
             ),
             lr_scheduler=schedule,
             sharding=dict(self.sharding) or None,

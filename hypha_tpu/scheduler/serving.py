@@ -1,0 +1,178 @@
+"""Scheduler-side serving supervisor: buy a worker, dispatch an infer job,
+keep it alive.
+
+The serving analog of the orchestrator's training supervision (BASELINE
+config 4 — "inference serving via the gateway on a TPU worker pool", a
+scenario the reference names but ships no code for): auction a worker with
+the infer executor, dispatch ``Executor(kind="infer")``, hold the lease via
+the renewal loop, and on worker failure re-auction and re-dispatch — the
+same elastic-recovery shape the training orchestrator uses for replicas
+(scheduler/orchestrator.py).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import uuid
+
+from ..messages import (
+    INFER_EXECUTOR_NAME,
+    PROTOCOL_API,
+    CancelJob,
+    Executor,
+    ExecutorDescriptor,
+    InferExecutorConfig,
+    JobSpec,
+    PriceRange,
+    WorkerSpec,
+)
+from ..network.node import Node
+from ..resources import Resources
+from .allocator import GreedyWorkerAllocator
+from .task import StatusRouter, Task
+from .worker_handle import WorkerHandle
+
+__all__ = ["ServingSupervisor"]
+
+log = logging.getLogger("hypha.scheduler.serving")
+
+
+class ServingSupervisor:
+    """Keeps one serving deployment alive across worker failures."""
+
+    def __init__(
+        self,
+        node: Node,
+        model: dict,
+        serve_name: str,
+        *,
+        resources: Resources | None = None,
+        price: PriceRange | None = None,
+        max_new_tokens: int = 256,
+        max_batch: int = 8,
+        auction_timeout: float = 2.0,
+        retry_pause: float = 1.0,
+    ) -> None:
+        self.node = node
+        self.serve_name = serve_name
+        self._config = InferExecutorConfig(
+            model=model,
+            serve_name=serve_name,
+            max_new_tokens=max_new_tokens,
+            max_batch=max_batch,
+        )
+        self._resources = resources or Resources(tpu=1.0, memory=100.0)
+        self._price = price or PriceRange(bid=1.0, max=10.0)
+        self._auction_timeout = auction_timeout
+        self._retry_pause = retry_pause
+        self._allocator = GreedyWorkerAllocator(node)
+        self._router = StatusRouter(node)
+        self._stop = asyncio.Event()
+        self.redeployments = 0  # failures recovered (observability/tests)
+
+    async def run(self) -> None:
+        """Supervise until :meth:`stop`; returns after teardown."""
+        handle: WorkerHandle | None = None
+        task: Task | None = None
+        job_id: str | None = None
+        try:
+            while not self._stop.is_set():
+                if handle is None:
+                    try:
+                        handle, task, job_id = await self._deploy()
+                    except asyncio.CancelledError:
+                        raise
+                    except Exception as e:
+                        # A worker dying mid-acceptance (or any transient
+                        # dispatch error) must not kill the supervisor whose
+                        # whole job is elastic recovery.
+                        log.warning(
+                            "deploy of %s failed (%s); retrying",
+                            self.serve_name, e,
+                        )
+                        handle = task = job_id = None
+                    if handle is None:
+                        await self._pause()
+                        continue
+                stop_wait = asyncio.create_task(self._stop.wait())
+                done, _ = await asyncio.wait(
+                    {stop_wait, handle.failed},
+                    return_when=asyncio.FIRST_COMPLETED,
+                )
+                stop_wait.cancel()
+                if handle.failed in done:
+                    failure = handle.failed.result()
+                    log.warning(
+                        "serving worker %s failed (%s); redeploying",
+                        handle.peer_id, failure,
+                    )
+                    self.redeployments += 1
+                    await self._teardown(handle, task, job_id)
+                    handle = task = job_id = None
+        finally:
+            await self._teardown(handle, task, job_id)
+            self._router.close()
+
+    async def stop(self) -> None:
+        self._stop.set()
+
+    # ------------------------------------------------------------------ impl
+
+    async def _deploy(self) -> tuple[WorkerHandle | None, Task | None, str | None]:
+        spec = WorkerSpec(
+            resources=self._resources,
+            executor=[
+                ExecutorDescriptor(
+                    executor_class="infer", name=INFER_EXECUTOR_NAME
+                )
+            ],
+        )
+        offers = await self._allocator.request(
+            spec, self._price, timeout=self._auction_timeout, num_workers=1
+        )
+        if not offers:
+            log.info("no offers for serving %s; retrying", self.serve_name)
+            return None, None, None
+        handle = await WorkerHandle.create(self.node, offers[0])
+        job = JobSpec(
+            job_id=f"serve-{self.serve_name}-{uuid.uuid4().hex[:8]}",
+            executor=Executor(
+                kind="infer", name=INFER_EXECUTOR_NAME, infer=self._config
+            ),
+        )
+        task = await Task.dispatch(self.node, self._router, job, [handle])
+        log.info(
+            "serving %s deployed on %s (job %s)",
+            self.serve_name, handle.peer_id, job.job_id,
+        )
+        return handle, task, job.job_id
+
+    async def _pause(self) -> None:
+        try:
+            await asyncio.wait_for(self._stop.wait(), self._retry_pause)
+        except asyncio.TimeoutError:
+            pass
+
+    async def _teardown(
+        self,
+        handle: WorkerHandle | None,
+        task: Task | None,
+        job_id: str | None,
+    ) -> None:
+        if task is not None:
+            task.close()
+        if handle is not None and job_id is not None:
+            try:  # stop serving now; lease expiry backstops a dead worker
+                await self.node.request(
+                    handle.peer_id, PROTOCOL_API,
+                    CancelJob(lease_id=handle.lease_id, job_id=job_id),
+                    timeout=10,
+                )
+            except Exception as e:
+                log.debug("cancel of %s on %s failed: %s", job_id, handle.peer_id, e)
+        if handle is not None:
+            try:
+                await handle.release()
+            except Exception:
+                pass

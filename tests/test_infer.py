@@ -259,3 +259,87 @@ def test_infer_job_through_full_auction_path():
             await n.stop()
 
     run(main())
+
+
+def test_serving_supervisor_redeploys_on_worker_failure():
+    """ServingSupervisor keeps the deployment alive: when the serving
+    worker dies, it re-auctions onto another worker and clients keep
+    generating (elastic serving — the training orchestrator's recovery
+    shape applied to BASELINE config 4)."""
+    from hypha_tpu.messages import INFER_EXECUTOR_NAME
+    from hypha_tpu.resources import Resources
+    from hypha_tpu.scheduler.serving import ServingSupervisor
+    from hypha_tpu.worker import (
+        Arbiter,
+        JobManager,
+        LeaseManager,
+        OfferConfig,
+        StaticResourceManager,
+    )
+
+    async def _worker(hub, name, gw_addr):
+        node = Node(hub.shared(), peer_id=name, bootstrap=[gw_addr])
+        await node.start()
+        await node.wait_for_bootstrap(5)
+        lm = LeaseManager(StaticResourceManager(Resources(tpu=4, cpu=8, memory=1000)))
+        jm = JobManager(
+            node, {("infer", INFER_EXECUTOR_NAME): InProcessInferExecutor(node)}
+        )
+        arb = Arbiter(node, lm, jm, offer=OfferConfig(price=1.0, floor=0.0))
+        await arb.start()
+        return node, arb
+
+    async def main():
+        hub = MemoryTransport()
+        gw = Node(hub.shared(), peer_id="gw", registry_server=True)
+        await gw.start()
+        gw_addr = gw.listen_addrs[0]
+        w1, arb1 = await _worker(hub, "w1", gw_addr)
+        w2, arb2 = await _worker(hub, "w2", gw_addr)
+        sched = Node(hub.shared(), peer_id="sched", bootstrap=[gw_addr])
+        await sched.start(); await sched.wait_for_bootstrap(5)
+        client = Node(hub.shared(), peer_id="c", bootstrap=[gw_addr])
+        await client.start(); await client.wait_for_bootstrap(5)
+
+        sup = ServingSupervisor(
+            sched, _MODEL, "ha-serve",
+            resources=Resources(tpu=1.0, memory=100),
+            auction_timeout=1.0, retry_pause=0.2,
+        )
+        runner = asyncio.create_task(sup.run())
+
+        toks = await generate_remote(client, "ha-serve", [[1, 2, 3]], 4, timeout=30)
+        assert len(toks[0]) == 4
+
+        # Kill whichever worker is serving; the supervisor must redeploy to
+        # the other and clients recover.
+        serving = await client.find_providers("serve:ha-serve")
+        assert len(serving) == 1
+        dead = serving[0]
+        if dead == "w1":
+            await arb1.stop(); await w1.stop()
+        else:
+            await arb2.stop(); await w2.stop()
+
+        for _ in range(200):
+            now = await client.find_providers("serve:ha-serve")
+            if now and now[0] != dead:
+                break
+            await asyncio.sleep(0.2)
+        else:
+            raise AssertionError(f"never redeployed off {dead}")
+        toks2 = await generate_remote(client, "ha-serve", [[1, 2, 3]], 4, timeout=30)
+        assert toks2 == toks  # greedy + same seed model: identical output
+        assert sup.redeployments >= 1
+
+        await sup.stop()
+        await asyncio.wait_for(runner, 30)
+        for stopper in (arb1 if dead != "w1" else arb2,):
+            await stopper.stop()
+        for n in (client, sched, gw, w1 if dead != "w1" else w2):
+            try:
+                await n.stop()
+            except Exception:
+                pass
+
+    run(main())
