@@ -275,6 +275,10 @@ class JobSection:
         if self.kind == "serve":
             if not self.serve_name:
                 raise ConfigError("job.serve_name is required for serve jobs")
+            if self.serve_max_new_tokens < 1:
+                raise ConfigError("job.serve_max_new_tokens must be >= 1")
+            if self.serve_max_batch < 1:
+                raise ConfigError("job.serve_max_batch must be >= 1")
             return  # dataset/rounds are train-only concerns
         if not self.dataset:
             raise ConfigError("job.dataset is required")

@@ -28,6 +28,7 @@ __all__ = [
     "split_block_params",
     "merge_block_params",
     "make_gpt2_pp_train_step",
+    "make_llama_pp_train_step",
 ]
 
 
@@ -109,6 +110,62 @@ def merge_block_params(outer: Any, stacked: Any, prefix: str = "h_"):
     return {"params": tree}
 
 
+def _make_pipe(block_apply, mesh, n_micro: int, dp_axis: str):
+    from jax import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    return shard_map(
+        lambda stacked, x: pipeline_blocks(block_apply, stacked, x, n_micro),
+        mesh=mesh,
+        in_specs=(P("pp"), P(dp_axis)),
+        out_specs=P(dp_axis),
+        check_vma=False,
+    )
+
+
+def _check_divisible(n_layers: int, mesh) -> None:
+    pp_size = mesh.shape["pp"]
+    if n_layers % pp_size:
+        raise ValueError(f"{n_layers} layers not divisible by pp={pp_size}")
+
+
+def make_llama_pp_train_step(cfg, mesh, n_micro: int, dp_axis: str = "dp"):
+    """Pipeline-parallel train step for the Llama family (incl. the
+    Mistral/Qwen2/Gemma configs): same contract as the GPT-2 builder —
+    params are (outer, stacked from :func:`split_block_params` with
+    prefix="layers_"), batch shards over ``dp``, blocks over ``pp``."""
+    from ..executor.train import make_train_step
+    from ..models.llama import _Block, _RMSNorm
+    from ..ops.rope import rope_frequencies
+
+    _check_divisible(cfg.num_layers, mesh)
+    block = _Block(cfg)
+    cos, sin = rope_frequencies(cfg.head_dim, cfg.max_seq_len, cfg.rope_theta)
+
+    def block_apply(layer_p, h):
+        return block.apply({"params": layer_p}, h, cos, sin)
+
+    pipe = _make_pipe(block_apply, mesh, n_micro, dp_axis)
+    norm = _RMSNorm(cfg.rms_eps, cfg.rms_offset)
+
+    def apply_fn(params, ids):
+        outer, stacked = params
+        dtype = jnp.dtype(cfg.dtype)
+        x = outer["embed_tokens"][ids].astype(dtype)
+        if cfg.embed_scale:
+            x = x * jnp.asarray(cfg.hidden_size**0.5, dtype)
+        h = pipe(stacked, x)
+        hn = norm.apply({"params": outer["norm"]}, h)
+        head = (
+            outer["embed_tokens"]
+            if cfg.tie_word_embeddings
+            else outer["lm_head"]
+        )
+        return jnp.einsum("bse,ve->bsv", hn.astype(jnp.float32), head)
+
+    return make_train_step(apply_fn)
+
+
 def make_gpt2_pp_train_step(cfg, mesh, n_micro: int, dp_axis: str = "dp"):
     """Jitted pipeline-parallel train step for the GPT-2 family.
 
@@ -119,9 +176,6 @@ def make_gpt2_pp_train_step(cfg, mesh, n_micro: int, dp_axis: str = "dp"):
     grads, metrics and optimizer plumbing are the SAME code every other
     layout uses (the optimizer rides on TrainState.tx).
     """
-    from jax.sharding import PartitionSpec as P
-    from jax import shard_map
-
     from ..executor.train import make_train_step
     from ..models.gpt2 import _Block
 
@@ -130,17 +184,8 @@ def make_gpt2_pp_train_step(cfg, mesh, n_micro: int, dp_axis: str = "dp"):
     def block_apply(layer_p, h):
         return block.apply({"params": layer_p}, h)
 
-    pp_size = mesh.shape["pp"]
-    if cfg.n_layer % pp_size:
-        raise ValueError(f"{cfg.n_layer} layers not divisible by pp={pp_size}")
-
-    pipe = shard_map(
-        lambda stacked, x: pipeline_blocks(block_apply, stacked, x, n_micro),
-        mesh=mesh,
-        in_specs=(P("pp"), P(dp_axis)),
-        out_specs=P(dp_axis),
-        check_vma=False,
-    )
+    _check_divisible(cfg.n_layer, mesh)
+    pipe = _make_pipe(block_apply, mesh, n_micro, dp_axis)
 
     import flax.linen as nn
 

@@ -96,17 +96,34 @@ class ServingSupervisor:
                         await self._pause()
                         continue
                 stop_wait = asyncio.create_task(self._stop.wait())
+                # Watch BOTH failure channels: lease-renewal liveness
+                # (handle.failed) and the job's status stream — a job that
+                # fails while its worker stays healthy (e.g. model load
+                # error) reports JobStatus("failed") and must redeploy too.
+                status_wait = asyncio.create_task(task.next_status())
                 done, _ = await asyncio.wait(
-                    {stop_wait, handle.failed},
+                    {stop_wait, status_wait, handle.failed},
                     return_when=asyncio.FIRST_COMPLETED,
                 )
                 stop_wait.cancel()
+                redeploy = False
                 if handle.failed in done:
-                    failure = handle.failed.result()
                     log.warning(
                         "serving worker %s failed (%s); redeploying",
-                        handle.peer_id, failure,
+                        handle.peer_id, handle.failed.result(),
                     )
+                    redeploy = True
+                elif status_wait in done and not status_wait.cancelled():
+                    peer, status = status_wait.result()
+                    if status.state == "running":
+                        continue  # informational; keep watching
+                    log.warning(
+                        "serving job %s reported %s on %s; redeploying",
+                        job_id, status.state, peer,
+                    )
+                    redeploy = True
+                status_wait.cancel()
+                if redeploy:
                     self.redeployments += 1
                     await self._teardown(handle, task, job_id)
                     handle = task = job_id = None
@@ -141,7 +158,14 @@ class ServingSupervisor:
                 kind="infer", name=INFER_EXECUTOR_NAME, infer=self._config
             ),
         )
-        task = await Task.dispatch(self.node, self._router, job, [handle])
+        try:
+            task = await Task.dispatch(self.node, self._router, job, [handle])
+        except BaseException:
+            # The lease is live (renewal loop running) — a dispatch failure
+            # must release it or the worker's capacity leaks to a zombie
+            # lease on every retry.
+            await handle.release()
+            raise
         log.info(
             "serving %s deployed on %s (job %s)",
             self.serve_name, handle.peer_id, job.job_id,

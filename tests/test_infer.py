@@ -343,3 +343,87 @@ def test_serving_supervisor_redeploys_on_worker_failure():
                 pass
 
     run(main())
+
+
+def test_serving_supervisor_redeploys_on_job_failure():
+    """A job that FAILS while its worker stays healthy (e.g. model load
+    error) must also redeploy — the supervisor watches the JobStatus stream,
+    not just lease liveness."""
+    from hypha_tpu.messages import INFER_EXECUTOR_NAME
+    from hypha_tpu.resources import Resources
+    from hypha_tpu.scheduler.serving import ServingSupervisor
+    from hypha_tpu.worker import (
+        Arbiter,
+        JobManager,
+        LeaseManager,
+        OfferConfig,
+        StaticResourceManager,
+    )
+    from hypha_tpu.worker.job_manager import Execution, JobExecutor
+
+    class BrokenExecutor(JobExecutor):
+        """Model load always fails (the infer executor's failure shape)."""
+
+        async def execute(self, job_id, spec, scheduler_peer):
+            ex = Execution(job_id)
+            ex.finish("failed", "model load exploded")
+            return ex
+
+    async def _worker(hub, name, gw_addr, executor, price):
+        node = Node(hub.shared(), peer_id=name, bootstrap=[gw_addr])
+        await node.start(); await node.wait_for_bootstrap(5)
+        lm = LeaseManager(StaticResourceManager(Resources(tpu=4, cpu=8, memory=1000)))
+        jm = JobManager(node, {("infer", INFER_EXECUTOR_NAME): executor})
+        arb = Arbiter(node, lm, jm, offer=OfferConfig(price=price, floor=0.0))
+        await arb.start()
+        return node, arb
+
+    async def main():
+        hub = MemoryTransport()
+        gw = Node(hub.shared(), peer_id="gw", registry_server=True)
+        await gw.start()
+        gw_addr = gw.listen_addrs[0]
+        # Only the BROKEN worker exists at first: the supervisor must
+        # observe the JobStatus("failed") and redeploy (not park).
+        wb, arb_b = await _worker(hub, "wbad", gw_addr, BrokenExecutor(), 0.5)
+
+        sched = Node(hub.shared(), peer_id="sched", bootstrap=[gw_addr])
+        await sched.start(); await sched.wait_for_bootstrap(5)
+        client = Node(hub.shared(), peer_id="c", bootstrap=[gw_addr])
+        await client.start(); await client.wait_for_bootstrap(5)
+
+        sup = ServingSupervisor(
+            sched, _MODEL, "resilient",
+            resources=Resources(tpu=1.0, memory=100),
+            auction_timeout=1.0, retry_pause=0.2,
+        )
+        runner = asyncio.create_task(sup.run())
+        for _ in range(150):  # wait for at least one failed deploy cycle
+            if sup.redeployments >= 1:
+                break
+            await asyncio.sleep(0.2)
+        else:
+            raise AssertionError("supervisor never saw the job failure")
+
+        # Now a healthy worker joins; the supervisor must land on it.
+        wg_node = Node(hub.shared(), peer_id="wgood", bootstrap=[gw_addr])
+        await wg_node.start(); await wg_node.wait_for_bootstrap(5)
+        lm = LeaseManager(StaticResourceManager(Resources(tpu=4, cpu=8, memory=1000)))
+        jm = JobManager(
+            wg_node,
+            {("infer", INFER_EXECUTOR_NAME): InProcessInferExecutor(wg_node)},
+        )
+        arb_g = Arbiter(wg_node, lm, jm, offer=OfferConfig(price=2.0, floor=0.0))
+        await arb_g.start()
+        # Stop the broken worker's arbiter so the good one wins the race.
+        await arb_b.stop()
+        toks = await generate_remote(client, "resilient", [[1, 2]], 3, timeout=90)
+        assert len(toks[0]) == 3
+        assert sup.redeployments >= 1
+        await sup.stop()
+        await asyncio.wait_for(runner, 30)
+        await arb_b.stop(); await arb_g.stop()
+        for n in (client, sched, wb, wg_node, gw):
+            await n.stop()
+
+    run(main())

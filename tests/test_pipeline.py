@@ -121,3 +121,35 @@ def test_pipeline_rejects_indivisible_shapes():
     mesh = create_mesh({"dp": 2, "pp": 4})
     with pytest.raises(ValueError, match="divisible"):
         make_gpt2_pp_train_step(cfg, mesh, n_micro=2)
+
+
+def test_llama_pp_train_step_matches_plain_model():
+    """The Llama-family pipeline (GQA + RoPE + tied-head Gemma config)
+    computes the plain model's loss."""
+    from hypha_tpu.models import Llama
+    from hypha_tpu.models.llama import LlamaConfig
+    from hypha_tpu.parallel.pipeline import make_llama_pp_train_step
+
+    cfg = LlamaConfig(
+        vocab_size=64, hidden_size=32, intermediate_size=64, num_layers=4,
+        num_heads=4, num_kv_heads=2, max_seq_len=32, dtype="float32",
+        rms_offset=True, embed_scale=True, mlp_act="gelu_tanh",
+        tie_word_embeddings=True,
+    )
+    model = Llama(cfg)
+    ids = np.random.default_rng(2).integers(0, 64, (8, 16)).astype(np.int32)
+    jids = jnp.asarray(ids)
+    params = model.init(jax.random.key(0), ids)
+    loss_ref = float(_ref_loss(model, params, jids))
+
+    mesh = create_mesh({"dp": 2, "pp": 4})
+    outer, stacked = split_block_params(params["params"], cfg.num_layers, prefix="layers_")
+    step = make_llama_pp_train_step(cfg, mesh, n_micro=2)
+    state = TrainState.create(
+        jax.tree.map(jnp.copy, (outer, stacked)), optax.adamw(1e-3)
+    )
+    state, metrics = step(state, {"input_ids": jids})
+    assert abs(float(metrics["loss"]) - loss_ref) < 1e-5
+    for _ in range(8):
+        state, metrics = step(state, {"input_ids": jids})
+    assert float(metrics["loss"]) < loss_ref
