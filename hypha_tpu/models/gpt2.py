@@ -28,6 +28,10 @@ class GPT2Config:
     n_head: int = 12
     layer_norm_epsilon: float = 1e-5  # HF gpt2 parity
     dtype: str = "bfloat16"
+    # Gradient checkpointing: recompute each block in the backward instead
+    # of keeping activations — HBM for FLOPs, the standard big-model trade
+    # (jax.checkpoint / nn.remat per transformer block).
+    remat: bool = False
 
     @classmethod
     def small(cls) -> "GPT2Config":
@@ -105,8 +109,9 @@ class GPT2(nn.Module):
             x = (wte[input_ids] + pe[None]).astype(dtype)
         else:
             x = (wte[input_ids] + wpe[None, :S]).astype(dtype)
+        block_cls = nn.remat(_Block) if cfg.remat and not self.decode else _Block
         for i in range(cfg.n_layer):
-            x = _Block(
+            x = block_cls(
                 cfg, self.attn_impl, self.decode, self.decode_len, name=f"h_{i}"
             )(x)
         x = nn.LayerNorm(epsilon=cfg.layer_norm_epsilon, dtype=jnp.float32, name="ln_f")(x)

@@ -57,6 +57,7 @@ class LlamaConfig:
     dtype: str = "bfloat16"
     # Architecture toggles for Llama descendants:
     attn_bias: bool = False  # Qwen2: biases on q/k/v projections
+    remat: bool = False  # gradient checkpointing per block (see gpt2.py)
     sliding_window: int | None = None  # Mistral: local attention window
     tie_word_embeddings: bool = False  # Qwen2-small/Gemma: head = embeddings
     head_dim_override: int | None = None  # Gemma: head_dim != hidden/heads
@@ -285,8 +286,9 @@ class Llama(nn.Module):
             x = x * jnp.asarray(cfg.hidden_size**0.5, dtype)
         table_len = max(cfg.max_seq_len, self.decode_len)
         cos, sin = rope_frequencies(cfg.head_dim, table_len, cfg.rope_theta)
+        block_cls = nn.remat(_Block) if cfg.remat and not self.decode else _Block
         for i in range(cfg.num_layers):
-            x = _Block(
+            x = block_cls(
                 cfg, self.attn_impl, self.decode, self.decode_len,
                 name=f"layers_{i}",
             )(x, cos, sin)

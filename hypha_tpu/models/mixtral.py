@@ -42,6 +42,7 @@ class MixtralConfig:
     rms_eps: float = 1e-5
     router_aux_coef: float = 0.02
     dtype: str = "bfloat16"
+    remat: bool = False  # gradient checkpointing per block (see gpt2.py)
 
     @classmethod
     def mixtral_8x7b(cls) -> "MixtralConfig":
@@ -175,8 +176,9 @@ class Mixtral(nn.Module):
         x = embed[input_ids].astype(dtype)
         cos, sin = rope_frequencies(cfg.head_dim, cfg.max_seq_len, cfg.rope_theta)
         aux_total = 0.0
+        block_cls = nn.remat(_MoEBlock) if cfg.remat else _MoEBlock
         for i in range(cfg.num_layers):
-            x, aux = _MoEBlock(cfg, self.attn_impl, name=f"layers_{i}")(x, cos, sin)
+            x, aux = block_cls(cfg, self.attn_impl, name=f"layers_{i}")(x, cos, sin)
             aux_total = aux_total + aux
         x = _RMSNorm(cfg.rms_eps, name="norm")(x)
         lm_head = self.param(

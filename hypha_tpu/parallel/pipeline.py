@@ -145,6 +145,11 @@ def make_llama_pp_train_step(cfg, mesh, n_micro: int, dp_axis: str = "dp"):
     def block_apply(layer_p, h):
         return block.apply({"params": layer_p}, h, cos, sin)
 
+    if getattr(cfg, "remat", False):
+        # Honor gradient checkpointing in the pipeline too — the large-model
+        # regime is exactly where both pp and remat matter.
+        block_apply = jax.checkpoint(block_apply)
+
     pipe = _make_pipe(block_apply, mesh, n_micro, dp_axis)
     norm = _RMSNorm(cfg.rms_eps, cfg.rms_offset)
 
@@ -183,6 +188,9 @@ def make_gpt2_pp_train_step(cfg, mesh, n_micro: int, dp_axis: str = "dp"):
 
     def block_apply(layer_p, h):
         return block.apply({"params": layer_p}, h)
+
+    if getattr(cfg, "remat", False):
+        block_apply = jax.checkpoint(block_apply)
 
     _check_divisible(cfg.n_layer, mesh)
     pipe = _make_pipe(block_apply, mesh, n_micro, dp_axis)

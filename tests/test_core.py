@@ -327,3 +327,47 @@ def test_reserved_keys_in_user_dicts_roundtrip():
     out = messages.decode(messages.encode(p))
     assert out.metrics == {"_t": "Ack", "_e": "x", "_d": 1, "loss": 0.5}
     assert isinstance(out.metrics, dict)  # no registry object materialized
+
+
+def test_remat_is_numerically_transparent():
+    """Gradient checkpointing changes memory, never math: same params, same
+    loss, same grads with remat on and off (GPT2 + Llama + Mixtral)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from hypha_tpu.models import GPT2, GPT2Config, Llama, Mixtral
+    from hypha_tpu.models.llama import LlamaConfig
+    from hypha_tpu.models.mixtral import MixtralConfig
+
+    ids = np.random.default_rng(0).integers(0, 64, (2, 16)).astype(np.int32)
+
+    def loss_of(model, params):
+        def f(p):
+            out = model.apply(p, ids)
+            if isinstance(out, tuple):
+                out = out[0]
+            return out.astype(jnp.float32).sum()
+        return jax.value_and_grad(f)(params)
+
+    import dataclasses
+
+    cases = [
+        (GPT2, GPT2Config(vocab_size=64, n_positions=32, n_embd=32,
+                          n_layer=2, n_head=2, dtype="float32")),
+        (Llama, LlamaConfig(vocab_size=64, hidden_size=32, intermediate_size=64,
+                            num_layers=2, num_heads=4, num_kv_heads=2,
+                            max_seq_len=32, dtype="float32")),
+        (Mixtral, dataclasses.replace(MixtralConfig.tiny(), dtype="float32")),
+    ]
+    for cls, cfg in cases:
+        plain = cls(cfg)
+        params = plain.init(jax.random.key(0), ids)
+        l0, g0 = loss_of(plain, params)
+        rm = cls(dataclasses.replace(cfg, remat=True))
+        l1, g1 = loss_of(rm, params)  # SAME param tree: remat adds no params
+        assert abs(float(l0) - float(l1)) < 1e-4
+        for a, b in zip(jax.tree.leaves(g0), jax.tree.leaves(g1)):
+            np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-5
+            )

@@ -153,3 +153,28 @@ def test_llama_pp_train_step_matches_plain_model():
     for _ in range(8):
         state, metrics = step(state, {"input_ids": jids})
     assert float(metrics["loss"]) < loss_ref
+
+
+def test_pp_honors_remat():
+    """cfg.remat changes nothing numerically under the pipeline either."""
+    import dataclasses
+
+    cfg = _tiny_cfg()
+    model = GPT2(cfg)
+    ids = np.random.default_rng(3).integers(0, 64, (8, 16)).astype(np.int32)
+    jids = jnp.asarray(ids)
+    params = model.init(jax.random.key(0), ids)
+    mesh = create_mesh({"dp": 2, "pp": 4})
+    outer, stacked = split_block_params(params["params"], cfg.n_layer)
+
+    losses = []
+    for flag in (False, True):
+        step = make_gpt2_pp_train_step(
+            dataclasses.replace(cfg, remat=flag), mesh, n_micro=2
+        )
+        state = TrainState.create(
+            jax.tree.map(jnp.copy, (outer, stacked)), optax.adamw(1e-3)
+        )
+        _, metrics = step(state, {"input_ids": jids})
+        losses.append(float(metrics["loss"]))
+    assert abs(losses[0] - losses[1]) < 1e-6
