@@ -145,7 +145,7 @@ def make_llama_pp_train_step(cfg, mesh, n_micro: int, dp_axis: str = "dp"):
     def block_apply(layer_p, h):
         return block.apply({"params": layer_p}, h, cos, sin)
 
-    if getattr(cfg, "remat", False):
+    if cfg.remat:
         # Honor gradient checkpointing in the pipeline too — the large-model
         # regime is exactly where both pp and remat matter.
         block_apply = jax.checkpoint(block_apply)
@@ -189,7 +189,7 @@ def make_gpt2_pp_train_step(cfg, mesh, n_micro: int, dp_axis: str = "dp"):
     def block_apply(layer_p, h):
         return block.apply({"params": layer_p}, h)
 
-    if getattr(cfg, "remat", False):
+    if cfg.remat:
         block_apply = jax.checkpoint(block_apply)
 
     _check_divisible(cfg.n_layer, mesh)

@@ -156,25 +156,40 @@ def test_llama_pp_train_step_matches_plain_model():
 
 
 def test_pp_honors_remat():
-    """cfg.remat changes nothing numerically under the pipeline either."""
+    """cfg.remat changes nothing numerically under the pipeline either —
+    both builders (GPT-2 and the Llama family's RoPE-closure block)."""
     import dataclasses
 
-    cfg = _tiny_cfg()
-    model = GPT2(cfg)
+    from hypha_tpu.models import Llama
+    from hypha_tpu.models.llama import LlamaConfig
+    from hypha_tpu.parallel.pipeline import make_llama_pp_train_step
+
+    mesh = create_mesh({"dp": 2, "pp": 4})
     ids = np.random.default_rng(3).integers(0, 64, (8, 16)).astype(np.int32)
     jids = jnp.asarray(ids)
-    params = model.init(jax.random.key(0), ids)
-    mesh = create_mesh({"dp": 2, "pp": 4})
-    outer, stacked = split_block_params(params["params"], cfg.n_layer)
 
-    losses = []
-    for flag in (False, True):
-        step = make_gpt2_pp_train_step(
-            dataclasses.replace(cfg, remat=flag), mesh, n_micro=2
+    cases = [
+        (GPT2, _tiny_cfg(), make_gpt2_pp_train_step, "h_", "n_layer"),
+        (
+            Llama,
+            LlamaConfig(vocab_size=64, hidden_size=32, intermediate_size=64,
+                        num_layers=4, num_heads=4, num_kv_heads=2,
+                        max_seq_len=32, dtype="float32"),
+            make_llama_pp_train_step, "layers_", "num_layers",
+        ),
+    ]
+    for cls, cfg, builder, prefix, nfield in cases:
+        model = cls(cfg)
+        params = model.init(jax.random.key(0), ids)
+        outer, stacked = split_block_params(
+            params["params"], getattr(cfg, nfield), prefix=prefix
         )
-        state = TrainState.create(
-            jax.tree.map(jnp.copy, (outer, stacked)), optax.adamw(1e-3)
-        )
-        _, metrics = step(state, {"input_ids": jids})
-        losses.append(float(metrics["loss"]))
-    assert abs(losses[0] - losses[1]) < 1e-6
+        losses = []
+        for flag in (False, True):
+            step = builder(dataclasses.replace(cfg, remat=flag), mesh, n_micro=2)
+            state = TrainState.create(
+                jax.tree.map(jnp.copy, (outer, stacked)), optax.adamw(1e-3)
+            )
+            _, metrics = step(state, {"input_ids": jids})
+            losses.append(float(metrics["loss"]))
+        assert abs(losses[0] - losses[1]) < 1e-6, cls.__name__
