@@ -213,6 +213,20 @@ async def _run_worker(conf: WorkerConfig) -> None:
     from .worker.arbiter import OfferConfig
     from .worker.runtime import WorkerNode
 
+    # Join the pod slice BEFORE any backend touch, so jax.devices() is
+    # global and one replica's mesh spans this worker's hosts.
+    from .parallel.multihost import MultihostConfig, initialize
+
+    if conf.multihost.coordinator_address:
+        initialize(
+            MultihostConfig(
+                coordinator_address=conf.multihost.coordinator_address,
+                num_processes=conf.multihost.num_processes,
+                process_id=conf.multihost.process_id,
+            )
+        )
+    else:
+        initialize()  # JAX_COORDINATOR_ADDRESS / _NUM_PROCESSES / _PROCESS_ID env
     node = _make_node(conf)
     worker = WorkerNode(
         None,

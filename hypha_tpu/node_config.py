@@ -26,6 +26,7 @@ __all__ = [
     "SchedulerConfig",
     "ResourcesConfig",
     "OfferConfigSection",
+    "MultihostSection",
     "ExecutorSection",
     "JobSection",
 ]
@@ -172,12 +173,41 @@ class DataNodeConfig:
 
 
 @dataclass
+class MultihostSection:
+    """Pod-slice membership: this worker process joins a multi-host JAX
+    runtime before touching the backend, so one replica spans hosts
+    (jax.distributed; parallel/multihost.py)."""
+
+    coordinator_address: str = field(
+        default="", metadata={"doc": "host:port of process 0; empty = single-host"}
+    )
+    num_processes: int = field(default=1, metadata={"doc": "processes in the slice"})
+    process_id: int = field(default=0, metadata={"doc": "this process's rank"})
+
+    def validate(self) -> None:
+        if self.coordinator_address and self.num_processes < 2:
+            raise ConfigError(
+                "multihost.coordinator_address set but num_processes < 2"
+            )
+        if self.num_processes > 1 and not self.coordinator_address:
+            # Half-configured pods must fail at startup — four workers each
+            # running an independent "global" mesh would train silently
+            # wrong, not loudly.
+            raise ConfigError(
+                "multihost.num_processes > 1 needs multihost.coordinator_address"
+            )
+        if not 0 <= self.process_id < max(self.num_processes, 1):
+            raise ConfigError("multihost.process_id out of range")
+
+
+@dataclass
 class WorkerConfig:
     name: str = field(default="worker", metadata={"doc": "node name (cert CN)"})
     work_root: str = field(default="/tmp", metadata={"doc": "per-job work dirs live here"})
     resources: ResourcesConfig = field(default_factory=ResourcesConfig)
     offer: OfferConfigSection = field(default_factory=OfferConfigSection)
     executor: ExecutorSection = field(default_factory=ExecutorSection)
+    multihost: MultihostSection = field(default_factory=MultihostSection)
     network: NetworkConfig = field(default_factory=NetworkConfig)
     tls: TLSConfig = field(default_factory=TLSConfig)
     telemetry: TelemetryConfig = field(default_factory=TelemetryConfig)
@@ -185,6 +215,7 @@ class WorkerConfig:
     def validate(self) -> None:
         self.offer.validate()
         self.executor.validate()
+        self.multihost.validate()
         self.tls.validate_files()
         self.telemetry.validate()
         if self.resources.to_resources().is_zero():

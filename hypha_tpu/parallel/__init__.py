@@ -10,10 +10,13 @@ lowers to a psum when replicas are co-located on one slice.
 """
 
 from .mesh import MESH_AXES, create_mesh, local_mesh
+from .multihost import MultihostConfig, initialize as initialize_multihost
 from .sharding import batch_spec, param_sharding, shard_params
 from .collectives import cross_replica_mean, tree_psum
 
 __all__ = [
+    "MultihostConfig",
+    "initialize_multihost",
     "MESH_AXES",
     "create_mesh",
     "local_mesh",

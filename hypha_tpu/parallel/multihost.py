@@ -1,0 +1,85 @@
+"""Multi-host mesh bring-up: ``jax.distributed`` for pod-scale replicas.
+
+The reference scales across machines with libp2p + NCCL-style process
+groups; the TPU-native equivalent is one jit program spanning hosts: every
+process in a pod slice calls :func:`initialize` (a GRPC coordination
+service barrier), after which ``jax.devices()`` is GLOBAL and the ordinary
+mesh/sharding machinery (parallel.mesh/sharding, the pipeline, ring
+attention) spans hosts unchanged — XLA lays collectives onto ICI within a
+slice and DCN across slices. One DiLoCo replica can therefore be a whole
+pod slice (the BASELINE north star: "the scheduler's performance-aware
+placement treats a pod as a single DiLoCo replica").
+
+Configured per worker via the ``[multihost]`` config section (or the
+standard JAX coordination env vars); call before ANY backend touch.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+
+__all__ = ["MultihostConfig", "initialize", "is_initialized"]
+
+log = logging.getLogger("hypha.parallel.multihost")
+
+_initialized = False
+
+
+class MultihostConfig:
+    """Pod-slice membership (mirrors jax.distributed.initialize args)."""
+
+    def __init__(
+        self,
+        coordinator_address: str = "",
+        num_processes: int = 1,
+        process_id: int = 0,
+        local_device_ids: list[int] | None = None,
+    ) -> None:
+        self.coordinator_address = coordinator_address
+        self.num_processes = num_processes
+        self.process_id = process_id
+        self.local_device_ids = local_device_ids
+
+    def enabled(self) -> bool:
+        return bool(self.coordinator_address) and self.num_processes > 1
+
+
+def is_initialized() -> bool:
+    return _initialized
+
+
+def initialize(config: MultihostConfig | None = None) -> bool:
+    """Join the pod's coordination service. Must run before any JAX backend
+    initialization in this process. Returns True when a multi-process
+    runtime came up (False = single-host mode, no-op).
+
+    Env fallbacks (standard JAX names) let launchers configure without
+    touching the TOML: JAX_COORDINATOR_ADDRESS / JAX_NUM_PROCESSES /
+    JAX_PROCESS_ID.
+    """
+    global _initialized
+    if _initialized:
+        return True
+    cfg = config or MultihostConfig(
+        coordinator_address=os.environ.get("JAX_COORDINATOR_ADDRESS", ""),
+        num_processes=int(os.environ.get("JAX_NUM_PROCESSES", "1")),
+        process_id=int(os.environ.get("JAX_PROCESS_ID", "0")),
+    )
+    if not cfg.enabled():
+        return False
+    import jax
+
+    jax.distributed.initialize(
+        coordinator_address=cfg.coordinator_address,
+        num_processes=cfg.num_processes,
+        process_id=cfg.process_id,
+        local_device_ids=cfg.local_device_ids,
+    )
+    _initialized = True
+    log.info(
+        "multihost runtime up: process %d/%d via %s — %d global devices",
+        cfg.process_id, cfg.num_processes, cfg.coordinator_address,
+        len(jax.devices()),
+    )
+    return True
