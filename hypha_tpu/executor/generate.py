@@ -10,7 +10,8 @@ sampling inside the scan.
 
 Works with any model module exposing ``decode``/``decode_len`` attrs and a
 "cache" variable collection (models.gpt2, models.llama and its
-Mistral/Qwen2 configs).
+Mistral/Qwen2/Gemma configs, models.mixtral — MoE decode routes DROP-FREE,
+so serving is exact regardless of router load; the aux loss is dropped).
 """
 
 from __future__ import annotations
@@ -111,6 +112,8 @@ def _compiled(model, B, S, max_new_tokens, temperature, top_k, eos_token_id):
         logits, vars_ = dec.apply(
             {**params, "cache": cache}, prompt, mutable=["cache"]
         )
+        if isinstance(logits, tuple):  # MoE models return (logits, aux)
+            logits = logits[0]
         tok = _sample(logits[:, -1], rng, temperature, top_k)
         return vars_["cache"], tok
 
@@ -121,6 +124,8 @@ def _compiled(model, B, S, max_new_tokens, temperature, top_k, eos_token_id):
             logits, vars_ = dec.apply(
                 {**params, "cache": cache}, tok[:, None], mutable=["cache"]
             )
+            if isinstance(logits, tuple):
+                logits = logits[0]
             rng, sub = jax.random.split(rng)
             nxt = _sample(logits[:, -1], sub, temperature, top_k)
             if eos_token_id is not None:

@@ -90,6 +90,11 @@ class MoELayer(nn.Module):
     """
 
     config: MixtralConfig
+    # Drop-free routing: every token reaches its top-k experts, no capacity
+    # truncation — the SERVING semantics (decode mode uses it so cached
+    # generation is exact for any router load), at E/K x the expert FLOPs.
+    # Training keeps the capacity path (static shapes, bounded expert work).
+    dropless: bool = False
 
     @nn.compact
     def __call__(self, x: jnp.ndarray) -> tuple:
@@ -106,6 +111,35 @@ class MoELayer(nn.Module):
         # top-k selection; renormalize the kept weights (Mixtral semantics)
         top_w, top_idx = jax.lax.top_k(probs, K)  # [B, S, K]
         top_w = top_w / jnp.maximum(top_w.sum(-1, keepdims=True), 1e-9)
+
+        if self.dropless:
+            w_gate = self.param(
+                "w_gate", nn.initializers.normal(0.02),
+                (E, D, cfg.intermediate_size), jnp.float32,
+            )
+            w_up = self.param(
+                "w_up", nn.initializers.normal(0.02),
+                (E, D, cfg.intermediate_size), jnp.float32,
+            )
+            w_down = self.param(
+                "w_down", nn.initializers.normal(0.02),
+                (E, cfg.intermediate_size, D), jnp.float32,
+            )
+            # Every expert sees every token; combine weights zero out the
+            # non-selected ones. Exact regardless of router load.
+            h = nn.silu(jnp.einsum("bsd,edf->ebsf", x, w_gate.astype(dtype)))
+            h = h * jnp.einsum("bsd,edf->ebsf", x, w_up.astype(dtype))
+            out_all = jnp.einsum("ebsf,efd->ebsd", h, w_down.astype(dtype))
+            combine_e = jnp.einsum(
+                "bsk,bske->bse", top_w, jax.nn.one_hot(top_idx, E, dtype=jnp.float32)
+            ).astype(dtype)
+            out = jnp.einsum("bse,ebsd->bsd", combine_e, out_all)
+            frac_routed = jnp.mean(
+                jax.nn.one_hot(top_idx, E, dtype=jnp.float32).sum(2), axis=(0, 1)
+            )
+            mean_prob = jnp.mean(probs, axis=(0, 1))
+            aux = cfg.router_aux_coef * E * jnp.sum(frac_routed * mean_prob)
+            return out, aux
 
         # position-in-expert via cumulative count over the sequence; tokens
         # beyond capacity are dropped (static shapes — TPU-friendly)
@@ -142,15 +176,18 @@ class MoELayer(nn.Module):
 class _MoEBlock(nn.Module):
     config: MixtralConfig
     attn_impl: Callable | None = None
+    decode: bool = False  # KV-cached serving (the shared llama attention)
+    decode_len: int = 0
+    dropless: bool = False  # drop-free MoE routing (see MoELayer)
 
     @nn.compact
     def __call__(self, x, cos, sin):
         cfg = self.config
         lcfg = cfg.as_llama()
-        x = x + _Attention(lcfg, self.attn_impl, name="self_attn")(
-            _RMSNorm(cfg.rms_eps, name="input_layernorm")(x), cos, sin
-        )
-        moe_out, aux = MoELayer(cfg, name="moe")(
+        x = x + _Attention(
+            lcfg, self.attn_impl, self.decode, self.decode_len, name="self_attn"
+        )(_RMSNorm(cfg.rms_eps, name="input_layernorm")(x), cos, sin)
+        moe_out, aux = MoELayer(cfg, dropless=self.decode or self.dropless, name="moe")(
             _RMSNorm(cfg.rms_eps, name="post_attention_layernorm")(x)
         )
         return x + moe_out, aux
@@ -159,6 +196,9 @@ class _MoEBlock(nn.Module):
 class Mixtral(nn.Module):
     config: MixtralConfig = MixtralConfig()
     attn_impl: Callable | None = None
+    decode: bool = False  # serving mode: KV-cached autoregressive forward
+    decode_len: int = 0
+    dropless: bool = False  # drop-free routing in the plain forward too
 
     @nn.compact
     def __call__(self, input_ids: jnp.ndarray) -> tuple:
@@ -174,11 +214,17 @@ class Mixtral(nn.Module):
             jnp.float32,
         )
         x = embed[input_ids].astype(dtype)
-        cos, sin = rope_frequencies(cfg.head_dim, cfg.max_seq_len, cfg.rope_theta)
+        table_len = max(cfg.max_seq_len, self.decode_len)
+        cos, sin = rope_frequencies(cfg.head_dim, table_len, cfg.rope_theta)
         aux_total = 0.0
-        block_cls = nn.remat(_MoEBlock) if cfg.remat else _MoEBlock
+        block_cls = (
+            nn.remat(_MoEBlock) if cfg.remat and not self.decode else _MoEBlock
+        )
         for i in range(cfg.num_layers):
-            x, aux = block_cls(cfg, self.attn_impl, name=f"layers_{i}")(x, cos, sin)
+            x, aux = block_cls(
+                cfg, self.attn_impl, self.decode, self.decode_len,
+                self.dropless, name=f"layers_{i}",
+            )(x, cos, sin)
             aux_total = aux_total + aux
         x = _RMSNorm(cfg.rms_eps, name="norm")(x)
         lm_head = self.param(

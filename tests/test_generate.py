@@ -144,3 +144,40 @@ def test_zero_new_tokens_raises_clearly():
     params = model.init(jax.random.key(0), prompt)
     with pytest.raises(ValueError, match="max_new_tokens"):
         generate(model, params, prompt, 0)
+
+
+def test_mixtral_cached_decode_matches_dropless_forward():
+    """MoE serving semantics: decode routes DROP-FREE (capacity truncation
+    is a training-time bound, not an inference semantic — with it, parity
+    would depend on router load and sequence length). Cached decode must
+    equal the drop-free full forward exactly, for any router load."""
+    import dataclasses
+
+    from hypha_tpu.models import Mixtral
+    from hypha_tpu.models.mixtral import MixtralConfig
+
+    cfg = dataclasses.replace(MixtralConfig.tiny(), dtype="float32")
+    model = Mixtral(cfg)
+    prompt = np.random.default_rng(6).integers(0, cfg.vocab_size, (2, 7)).astype(np.int32)
+    params = model.init(jax.random.key(0), prompt)
+
+    dropless = Mixtral(cfg, dropless=True)
+
+    def ref(params, prompt, n):
+        ids = jnp.asarray(prompt, jnp.int32)
+        out = []
+        for _ in range(n):
+            logits, _aux = dropless.apply(params, ids)
+            nxt = jnp.argmax(logits[:, -1], axis=-1)
+            out.append(nxt)
+            ids = jnp.concatenate([ids, nxt[:, None]], axis=1)
+        return jnp.stack(out, axis=1)
+
+    got = generate(model, params, prompt, 8)
+    want = ref(params, prompt, 8)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+    # Dropless and capacity paths share the SAME param tree (w_gate/w_up/
+    # w_down/gate) — serving needs no weight conversion.
+    logits_cap, _ = model.apply(params, jnp.asarray(prompt, jnp.int32))
+    assert logits_cap.shape == (2, 7, cfg.vocab_size)
