@@ -69,18 +69,20 @@ def _peak_flops(device) -> float | None:
 
 
 def _time_steps(step, state, batch, steps: int, warmup: int):
-    import jax
-
+    # Sync by FETCHING the loss value, not block_until_ready: on the
+    # tunneled TPU backend block_until_ready can return before execution
+    # finishes (observed r3: 0.02 ms "completions"), silently inflating
+    # tokens/s. A device→host value fetch is a hard sync everywhere.
     t_c0 = time.perf_counter()
     for _ in range(warmup):
         state, metrics = step(state, batch)
-    jax.block_until_ready(metrics["loss"])
+    float(metrics["loss"])
     compile_s = time.perf_counter() - t_c0
     t0 = time.perf_counter()
     for _ in range(steps):
         state, metrics = step(state, batch)
-    jax.block_until_ready(metrics["loss"])
-    return state, metrics, compile_s, time.perf_counter() - t0
+    loss = float(metrics["loss"])
+    return state, metrics, compile_s, time.perf_counter() - t0, loss
 
 
 def _run_config(cfg, B: int, S: int, steps: int, warmup: int, attn, label: str):
@@ -100,13 +102,15 @@ def _run_config(cfg, B: int, S: int, steps: int, warmup: int, attn, label: str):
     state = TrainState.create(params, build_optimizer(Adam(lr=1e-4)))
     step = make_train_step(model.apply)
     n_params = sum(x.size for x in jax.tree.leaves(params))
-    state, metrics, compile_s, dt = _time_steps(step, state, {"input_ids": ids}, steps, warmup)
+    state, metrics, compile_s, dt, loss = _time_steps(
+        step, state, {"input_ids": ids}, steps, warmup
+    )
     tok_s = B * S * steps / dt
     _log(
         f"{label}: params {n_params / 1e6:.1f}M warmup+compile {compile_s:.1f}s "
-        f"{steps} steps in {dt:.2f}s -> {tok_s:,.0f} tok/s loss {float(metrics['loss']):.3f}"
+        f"{steps} steps in {dt:.2f}s -> {tok_s:,.0f} tok/s loss {loss:.3f}"
     )
-    return n_params, tok_s, compile_s, float(metrics["loss"])
+    return n_params, tok_s, compile_s, loss
 
 
 def _bench_line() -> dict:
@@ -149,7 +153,9 @@ def _bench_line() -> dict:
 
     if on_accel:
         cfg = GPT2Config.small()  # 124M params, bf16 activations
-        B, S = 8, 1024
+        # B=16 from the r3 on-chip sweep (B=8 underfills the v5e MXU; the
+        # remote compiler rejects B=32 at this seq len).
+        B, S = 16, 1024
         steps, warmup = 20, 3
         assert jnp.dtype(cfg.dtype) == jnp.bfloat16, "flagship bench must run bf16"
     else:  # CPU smoke fallback so the script always emits a line
@@ -331,5 +337,12 @@ if __name__ == "__main__":
             sys.exit(_child_main(sys.argv[2]))
         main()
     except Exception as e:  # always emit a parseable line
+        # The full traceback goes to STDERR — in child mode that is the
+        # persisted .bench_logs/attemptN.log the parent embeds in the JSON
+        # (r2's silent-child-death lesson: a stdout-only error is discarded
+        # with the failed attempt's stdout).
+        import traceback
+
+        traceback.print_exc()
         print(json.dumps({"metric": "error", "value": 0, "unit": "", "vs_baseline": None, "error": str(e)}))
         sys.exit(1)

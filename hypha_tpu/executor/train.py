@@ -117,10 +117,16 @@ def compute_loss(kind: Loss, logits: jnp.ndarray, labels: jnp.ndarray) -> jnp.nd
     """Loss selector (crates/messages/src/lib.rs:662-670). Labels == -100 are
     ignored for classification losses (HF convention the reference relies on)."""
     if kind in (Loss.CROSS_ENTROPY, Loss.NLL):
-        logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+        # CE as logsumexp − picked-logit: two streaming reductions over the
+        # logits instead of materializing the full f32 log-softmax tensor —
+        # at LM vocab width that tensor is gigabytes of HBM traffic per step.
         valid = labels != -100
         safe = jnp.where(valid, labels, 0)
-        nll = -jnp.take_along_axis(logp, safe[..., None], axis=-1)[..., 0]
+        lse = jax.scipy.special.logsumexp(
+            logits.astype(jnp.float32), axis=-1
+        )
+        picked = jnp.take_along_axis(logits, safe[..., None], axis=-1)[..., 0]
+        nll = lse - picked.astype(jnp.float32)
         return jnp.sum(nll * valid) / jnp.maximum(valid.sum(), 1)
     if kind is Loss.MSE:
         return jnp.mean((logits.astype(jnp.float32) - labels) ** 2)
@@ -141,6 +147,7 @@ def make_train_step(
     donate: bool = True,
     dropout_seed: int | None = None,
     labels_aligned: bool = False,
+    loss_override: Callable | None = None,
 ):
     """Build the jitted train step.
 
@@ -157,7 +164,9 @@ def make_train_step(
 
     For causal LM the labels are the *target stream* shifted left — the
     decoder stream when the batch carries one, else the inputs; otherwise
-    the batch carries explicit ``labels``.
+    the batch carries explicit ``labels``. ``loss_override(out, batch)``
+    (a model's ``custom_loss`` — CTC, detection, contrastive, span …)
+    replaces the ``compute_loss`` selector entirely.
     Returns ``step(state, batch) -> (state, metrics)``.
     """
     import inspect
@@ -180,6 +189,9 @@ def make_train_step(
         aux = jnp.float32(0)
         if has_aux:
             out, aux = out
+        if loss_override is not None:
+            loss = loss_override(out, batch)
+            return loss + aux, (loss, aux)
         if causal_lm:
             # Teacher forcing over the target stream. Three layouts:
             #   * decoder_input_ids AND labels (HF convention: decoder is

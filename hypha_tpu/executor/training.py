@@ -56,11 +56,14 @@ __all__ = ["run_training", "main", "TrainResult"]
 
 log = logging.getLogger("hypha.executor.training")
 
-_NON_CAUSAL = {
-    ModelType.IMAGE_CLASSIFICATION,
-    ModelType.SEQUENCE_CLASSIFICATION,
-    ModelType.TOKEN_CLASSIFICATION,
-}
+def _non_causal_types():
+    from ..models.heads import HEAD_TYPES
+
+    return {
+        ModelType.IMAGE_CLASSIFICATION,
+        ModelType.SEQUENCE_CLASSIFICATION,
+        ModelType.TOKEN_CLASSIFICATION,
+    } | HEAD_TYPES
 
 
 class TrainResult:
@@ -134,7 +137,7 @@ def _init_model(cfg: TrainExecutorConfig, session, work_dir: Path, first_batch):
 
     model, _mcfg = build_model(model_spec, attn_impl)
     model_type = resolve_model_type(model_spec.get("model_type", ModelType.CAUSAL_LM))
-    causal_lm = model_type not in _NON_CAUSAL
+    causal_lm = model_type not in _non_causal_types()
     has_aux = isinstance(model, Mixtral)
 
     inputs = first_batch["input_ids"] if "input_ids" in first_batch else first_batch["inputs"]
@@ -245,6 +248,9 @@ def run_training(
         # Seq2seq hf models shift labels into decoder inputs internally, so
         # their logits are already aligned with the labels stream.
         labels_aligned=getattr(model, "model_type", None) in _DECODER_TYPES,
+        # Heads-family tasks with structured objectives (CTC, detection,
+        # contrastive, span…) carry their own loss.
+        loss_override=getattr(model, "custom_loss", None),
     )
 
     if mesh is not None:

@@ -130,13 +130,10 @@ class MoELayer(nn.Module):
             h = nn.silu(jnp.einsum("bsd,edf->ebsf", x, w_gate.astype(dtype)))
             h = h * jnp.einsum("bsd,edf->ebsf", x, w_up.astype(dtype))
             out_all = jnp.einsum("ebsf,efd->ebsd", h, w_down.astype(dtype))
-            combine_e = jnp.einsum(
-                "bsk,bske->bse", top_w, jax.nn.one_hot(top_idx, E, dtype=jnp.float32)
-            ).astype(dtype)
+            onehot = jax.nn.one_hot(top_idx, E, dtype=jnp.float32)  # [B,S,K,E]
+            combine_e = jnp.einsum("bsk,bske->bse", top_w, onehot).astype(dtype)
             out = jnp.einsum("bse,ebsd->bsd", combine_e, out_all)
-            frac_routed = jnp.mean(
-                jax.nn.one_hot(top_idx, E, dtype=jnp.float32).sum(2), axis=(0, 1)
-            )
+            frac_routed = jnp.mean(onehot.sum(2), axis=(0, 1))
             mean_prob = jnp.mean(probs, axis=(0, 1))
             aux = cfg.router_aux_coef * E * jnp.sum(frac_routed * mean_prob)
             return out, aux

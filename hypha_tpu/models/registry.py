@@ -1,12 +1,13 @@
 """Model registry: resolve a job's model spec to a flax module.
 
 The reference maps 38 ``ModelType`` variants to HF ``AutoModelFor*`` classes
-(executors/accelerate/.../model.py:48-123). Here the flagship families
-(GPT-2, Llama + its Mistral/Qwen2 descendants, Mixtral, LeNet) are native
-JAX definitions; of the remaining ModelTypes, the 14 with an HF **Flax**
+(executors/accelerate/.../model.py:48-123). Here every variant resolves:
+the flagship families (GPT-2, Llama + its Mistral/Qwen2/Gemma descendants,
+Mixtral, LeNet) are native JAX definitions; the 14 types with an HF **Flax**
 head resolve through the hf fallback family (torch checkpoints convert via
-``from_pt``), and types with neither a native family nor a Flax head raise
-a clear error naming the type — HF ships no JAX implementation to wrap.
+``from_pt``); the remaining torch-only-head types resolve through the
+``heads`` family — JAX task heads over Flax backbones (models/heads.py),
+mirroring HF's own random-init-the-missing-head fine-tuning behavior.
 
 A model spec is the ``model`` dict of a TrainExecutorConfig:
   {"model_type": ModelType, "family": "gpt2"|"llama"|"mixtral"|"lenet"|"hf",
@@ -64,20 +65,34 @@ def resolve_model_type(model_type: ModelType | str) -> ModelType:
     return ModelType(model_type)
 
 
+def _head_types():
+    from .heads import HEAD_TYPES
+
+    return HEAD_TYPES
+
+
 def build_model(spec: dict[str, Any], attn_impl=None):
     """Build (module, config) from a job's model spec."""
     family = spec.get("family")
     if family is None:
         mt = resolve_model_type(spec.get("model_type", ModelType.CAUSAL_LM))
-        family = {
-            ModelType.CAUSAL_LM: "gpt2",
-            ModelType.IMAGE_CLASSIFICATION: "lenet",
-        }.get(mt, "hf")
+        if mt in _head_types():
+            family = "heads"
+        else:
+            family = {
+                ModelType.CAUSAL_LM: "gpt2",
+                ModelType.IMAGE_CLASSIFICATION: "lenet",
+            }.get(mt, "hf")
     if family == "hf":
         from .hf import build_hf_model
 
         mt = resolve_model_type(spec.get("model_type", ModelType.CAUSAL_LM))
         return build_hf_model(spec, mt)
+    if family == "heads":
+        from .heads import build_head_model
+
+        mt = resolve_model_type(spec.get("model_type", ModelType.CAUSAL_LM))
+        return build_head_model(spec, mt)
     if family not in FAMILIES:
         raise ValueError(f"unknown model family {family!r}")
     module_cls, config_cls = FAMILIES[family]

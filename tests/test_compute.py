@@ -371,10 +371,21 @@ def test_flash_attention_matches_xla_reference():
     got = flash_attention(q, kg, vg, causal=True)
     assert jnp.allclose(got, want, rtol=2e-3, atol=2e-3)
 
-    # non-tiling shape falls back to the XLA path (still correct)
+    # Short sequence (<= 128): legal whole-sequence block, runs in-kernel.
     q3 = q[:, :100]
     want = dot_product_attention(q3, k[:, :100], v[:, :100], causal=True)
     got = flash_attention(q3, k[:, :100], v[:, :100], causal=True)
+    assert jnp.allclose(got, want, rtol=2e-3, atol=2e-3)
+
+    # Non-tileable shapes fall back to the XLA path (still correct):
+    # S=192 has no Mosaic-legal tile (>128, not a multiple of 128), and an
+    # explicitly-passed illegal block must also fall back, not crash.
+    q4 = q[:, :192]
+    want = dot_product_attention(q4, k[:, :192], v[:, :192], causal=True)
+    got = flash_attention(q4, k[:, :192], v[:, :192], causal=True)
+    assert jnp.allclose(got, want, rtol=2e-3, atol=2e-3)
+    got = flash_attention(q, k, v, causal=True, block_q=128, block_k=200)
+    want = dot_product_attention(q, k, v, causal=True)
     assert jnp.allclose(got, want, rtol=2e-3, atol=2e-3)
 
 

@@ -1,0 +1,165 @@
+"""Task-head family: every torch-only-head ModelType builds, runs one
+jitted train step, and produces a finite loss with changed params.
+
+Mirrors the reference's breadth test surface: its model.py maps each
+ModelType to a torch AutoModel class (executors/accelerate/.../model.py:
+48-123); here each maps to a JAX head over a Flax backbone
+(hypha_tpu/models/heads.py), so the assertion is end-to-end trainability,
+not just construction.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from hypha_tpu.executor.train import TrainState, build_optimizer, make_train_step
+from hypha_tpu.messages import Adam, Loss, ModelType
+from hypha_tpu.models.heads import HEAD_TYPES, build_head_model
+from hypha_tpu.models.registry import build_model
+
+B = 2
+_IMG = (B, 3, 32, 32)  # HF Flax vision models take NCHW pixel_values
+_AUDIO = (B, 512)
+_TEXT_T = 16
+
+
+def _img(key=0):
+    return jax.random.normal(jax.random.key(key), _IMG, jnp.float32)
+
+
+def _audio(key=0):
+    return jax.random.normal(jax.random.key(key), _AUDIO, jnp.float32)
+
+
+def _ids(t=_TEXT_T, key=0, vocab=1000):
+    return jax.random.randint(jax.random.key(key), (B, t), 0, vocab)
+
+
+def _img_labels(shape_from, fill="mse"):
+    def make(out):
+        if fill == "mse":
+            return jnp.zeros_like(jnp.asarray(out, jnp.float32))
+        raise AssertionError
+
+    return make
+
+
+# (model_type, spec extras, inputs, batch maker, loss kind)
+# batch maker gets the apply() output so regression targets match shapes.
+CASES = [
+    (ModelType.AUDIO_CLASSIFICATION, {}, _audio(),
+     lambda o: {"labels": jnp.array([0, 1])}, Loss.CROSS_ENTROPY),
+    (ModelType.AUDIO_FRAME_CLASSIFICATION, {}, _audio(),
+     lambda o: {"labels": jnp.zeros(o.shape[:2], jnp.int32)}, Loss.CROSS_ENTROPY),
+    (ModelType.AUDIO_XVECTOR, {}, _audio(),
+     lambda o: {"labels": jnp.array([1, 0])}, Loss.CROSS_ENTROPY),
+    (ModelType.CTC, {"num_labels": 8}, _audio(),
+     lambda o: {"labels": jnp.array([[1, 2, 3, -1], [2, 2, -1, -1]])}, None),
+    (ModelType.VIDEO_CLASSIFICATION, {},
+     jax.random.normal(jax.random.key(3), (B, 3, 3, 32, 32)),
+     lambda o: {"labels": jnp.array([0, 1])}, Loss.CROSS_ENTROPY),
+    (ModelType.SEMANTIC_SEGMENTATION, {"num_labels": 5}, _img(),
+     lambda o: {"labels": jnp.zeros((B, 32, 32), jnp.int32)}, Loss.CROSS_ENTROPY),
+    (ModelType.IMAGE_SEGMENTATION, {"num_labels": 5}, _img(),
+     lambda o: {"labels": jnp.zeros((B, 32, 32), jnp.int32)}, Loss.CROSS_ENTROPY),
+    (ModelType.INSTANCE_SEGMENTATION, {"num_labels": 4}, _img(),
+     lambda o: {"labels": jnp.zeros((B, 32, 32), jnp.int32)}, Loss.CROSS_ENTROPY),
+    (ModelType.UNIVERSAL_SEGMENTATION, {"num_labels": 4}, _img(),
+     lambda o: {"labels": jnp.zeros((B, 32, 32), jnp.int32)}, Loss.CROSS_ENTROPY),
+    (ModelType.DEPTH_ESTIMATION, {}, _img(),
+     lambda o: {"labels": jnp.zeros_like(o)}, Loss.MSE),
+    (ModelType.KEYPOINT_DETECTION, {"num_keypoints": 5}, _img(),
+     lambda o: {"labels": jnp.zeros_like(o)}, Loss.MSE),
+    (ModelType.IMAGE_TO_IMAGE, {}, _img(),
+     lambda o: {"labels": jnp.zeros_like(o)}, Loss.MAE),
+    (ModelType.MASK_GENERATION, {}, _img(),
+     lambda o: {"labels": (jnp.zeros_like(o) > 0).astype(jnp.float32)},
+     Loss.BCE_WITH_LOGITS),
+    (ModelType.MASKED_IMAGE_MODELING, {}, _img(),
+     lambda o: {"labels": jnp.zeros_like(o),
+                "mask": jnp.ones((B, 32, 32), jnp.float32)}, None),
+    (ModelType.OBJECT_DETECTION, {"num_labels": 3}, _img(),
+     lambda o: {
+         "boxes": jnp.array([[[0.1, 0.1, 0.6, 0.6], [0.5, 0.5, 0.9, 0.9]]] * B),
+         "labels": jnp.array([[0, 2]] * B),
+     }, None),
+    (ModelType.ZERO_SHOT_IMAGE_CLASSIFICATION, {}, _img(),
+     lambda o: {"pixel_values": _img(), "input_ids": _ids(8, vocab=500)}, None),
+    (ModelType.ZERO_SHOT_OBJECT_DETECTION, {}, _img(),
+     lambda o: {"pixel_values": _img(), "input_ids": _ids(8, vocab=500),
+                "boxes": jnp.array([[0.2, 0.2, 0.8, 0.8]] * B)}, None),
+    (ModelType.VISUAL_QUESTION_ANSWERING, {"num_labels": 7}, _img(),
+     lambda o: {"pixel_values": _img(), "input_ids": _ids(8, vocab=500),
+                "labels": jnp.array([3, 1])}, Loss.CROSS_ENTROPY),
+    (ModelType.DOCUMENT_QUESTION_ANSWERING, {}, _ids(),
+     lambda o: {"bbox": jnp.zeros((B, _TEXT_T, 4), jnp.int32),
+                "start_positions": jnp.array([1, 2]),
+                "end_positions": jnp.array([3, 4])}, None),
+    (ModelType.TABLE_QUESTION_ANSWERING, {}, _ids(),
+     lambda o: {"row_ids": jnp.zeros((B, _TEXT_T), jnp.int32),
+                "column_ids": jnp.zeros((B, _TEXT_T), jnp.int32),
+                "labels": jnp.zeros((B, _TEXT_T), jnp.int32),
+                "aggregation_labels": jnp.array([0, 1])}, None),
+    (ModelType.TIME_SERIES_PREDICTION, {"horizon": 8},
+     jax.random.normal(jax.random.key(5), (B, 32, 4)),
+     lambda o: {"labels": jnp.zeros_like(o)}, Loss.MSE),
+    (ModelType.TEXT_TO_SPECTROGRAM, {"vocab_size": 64}, _ids(vocab=64),
+     lambda o: {"labels": jnp.zeros_like(o)}, Loss.MSE),
+    (ModelType.TEXT_TO_WAVEFORM, {"vocab_size": 64}, _ids(vocab=64),
+     lambda o: {"labels": jnp.zeros_like(o)}, Loss.MAE),
+    (ModelType.IMAGE_FEATURE_EXTRACTION, {}, _img(),
+     lambda o: {"labels": jnp.zeros_like(o)}, Loss.MSE),
+]
+
+
+def test_head_types_all_covered():
+    """Registry + hf + native families reach all 38 ModelTypes."""
+    from hypha_tpu.models.hf import FLAX_AUTO_CLASSES
+
+    covered = set(FLAX_AUTO_CLASSES) | HEAD_TYPES
+    assert covered == set(ModelType), set(ModelType) - covered
+
+
+def test_cases_cover_head_types():
+    assert {c[0] for c in CASES} == HEAD_TYPES
+
+
+@pytest.mark.parametrize("case", CASES, ids=lambda c: c[0].value)
+def test_head_model_trains(case):
+    mt, extras, inputs, make_batch, loss_kind = case
+    spec = {"model_type": mt, **extras}
+    model, _cfg = build_head_model(spec, mt)
+    params = model.init(jax.random.key(0), inputs)
+    out = model.apply(params, inputs, batch=make_batch(None) if mt in (
+        ModelType.ZERO_SHOT_IMAGE_CLASSIFICATION,
+        ModelType.ZERO_SHOT_OBJECT_DETECTION,
+        ModelType.VISUAL_QUESTION_ANSWERING,
+    ) else None)
+    probe = out if not isinstance(out, dict) else None
+    batch = {"inputs": inputs, **make_batch(probe)}
+
+    step = make_train_step(
+        model.apply,
+        loss_kind or Loss.CROSS_ENTROPY,
+        causal_lm=False,
+        donate=False,
+        loss_override=getattr(model, "custom_loss", None),
+    )
+    state = TrainState.create(params, build_optimizer(Adam(lr=1e-3)))
+    state2, metrics = step(state, batch)
+    loss = float(metrics["loss"])
+    assert np.isfinite(loss), (mt, loss)
+    # Gradients reached the head (and the backbone when present).
+    before = jax.tree.leaves(state.params)
+    after = jax.tree.leaves(state2.params)
+    assert any(
+        not np.allclose(np.asarray(a), np.asarray(b)) for a, b in zip(before, after)
+    ), mt
+
+
+def test_registry_routes_head_types():
+    model, _ = build_model({"model_type": ModelType.TIME_SERIES_PREDICTION})
+    assert model.model_type is ModelType.TIME_SERIES_PREDICTION
