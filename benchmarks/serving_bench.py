@@ -1,0 +1,81 @@
+"""Serving (inference) throughput on one chip: KV-cached decode tokens/s.
+
+The reference ships no inference path at all (BASELINE.json's "inference
+serving" entry is a north star, not a feature), so there is no reference
+number to beat — this records what the TPU-native serving primitive
+(executor/generate.py: one prefill forward + one compiled ``lax.scan``
+decode loop) delivers on real hardware, per batch size.
+
+Run on hardware (keep the axon sitecustomize on PYTHONPATH):
+
+    PYTHONPATH=/root/repo:$PYTHONPATH JAX_PLATFORMS=axon \
+        python benchmarks/serving_bench.py
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+
+def _bench(B: int, prompt_len: int, new_tokens: int) -> dict:
+    import jax
+    import jax.numpy as jnp
+
+    from hypha_tpu.executor.generate import generate
+    from hypha_tpu.models import GPT2, GPT2Config
+
+    cfg = GPT2Config.small()
+    model = GPT2(cfg)
+    ids = jax.random.randint(
+        jax.random.key(1), (B, prompt_len), 0, cfg.vocab_size
+    )
+    params = model.init(jax.random.key(0), ids)
+
+    assert prompt_len == new_tokens, "chaining needs prompt_len == new_tokens"
+    t0 = time.perf_counter()
+    out = generate(model, params, ids, new_tokens)
+    int(jax.device_get(out[0, 0]))  # value fetch = hard sync
+    compile_s = time.perf_counter() - t0
+
+    # Chain each rep on the previous output (generated tokens become the
+    # next prompt): on the tunneled backend only a data dependency plus a
+    # final value fetch proves every rep actually executed.
+    reps = 5
+    x = ids
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        x = generate(model, params, x, new_tokens)
+    _ = int(jax.device_get(x[0, -1]))
+    dt = (time.perf_counter() - t0) / reps
+    return {
+        "batch": B,
+        "prompt_len": prompt_len,
+        "new_tokens": new_tokens,
+        "decode_tokens_per_sec": round(B * new_tokens / dt, 1),
+        "requests_per_sec": round(B / dt, 2),
+        "latency_ms": round(dt * 1e3, 1),
+        "compile_s": round(compile_s, 1),
+    }
+
+
+def main() -> None:
+    import jax
+
+    dev = jax.devices()[0]
+    results: dict = {
+        "platform": dev.platform,
+        "device_kind": getattr(dev, "device_kind", ""),
+        "model": "gpt2-small 124M bf16",
+    }
+    for B in (1, 8, 32):
+        try:
+            results[f"decode_B{B}"] = _bench(B, prompt_len=128, new_tokens=128)
+        except Exception as e:
+            results[f"decode_B{B}"] = {"error": f"{type(e).__name__}: {e}"[:160]}
+    print(json.dumps(results))
+
+
+if __name__ == "__main__":
+    sys.exit(main())

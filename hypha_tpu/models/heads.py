@@ -116,41 +116,56 @@ class _Backbone:
         return out.last_hidden_state  # [B, T, H]
 
 
+def _load_flax_model(cls, spec: dict, make_config, what: str):
+    """Shared loader for every heads-family HF Flax model: pretrained from
+    ``spec['path']`` (torch checkpoints sniffed and converted), else
+    random-init from ``make_config()`` with the job seed; params become jax
+    arrays once so the first jitted step pays no per-leaf host transfer."""
+    from .hf import _has_flax_weights  # same checkpoint-format sniffing
+
+    dtype = {"float32": jnp.float32, "bfloat16": jnp.bfloat16}[
+        spec.get("dtype", "float32")
+    ]
+    path = spec.get("path")
+    if path:
+        from pathlib import Path
+
+        model = cls.from_pretrained(
+            str(path), dtype=dtype,
+            from_pt=not _has_flax_weights(Path(path)), local_files_only=True,
+        )
+        log.info("heads: loaded %s from %s", what, path)
+    else:
+        seed = int(spec.get("seed", 0))
+        config = make_config()
+        if hasattr(cls, "from_config"):  # Auto classes build via from_config
+            model = cls.from_config(config, dtype=dtype, seed=seed)
+        else:
+            model = cls(config, dtype=dtype, seed=seed)
+        log.info("heads: random-initialized tiny %s", what)
+    model.params = jax.tree.map(jnp.asarray, model.params)
+    return model
+
+
 def _build_backbone(spec: dict, modality: str) -> _Backbone:
     """Pretrained from ``spec['path']`` or tiny-config otherwise (tests /
     from-scratch jobs); ``spec['backbone']`` overrides config fields."""
     import transformers
 
-    from .hf import _has_flax_weights  # same checkpoint-format sniffing
-
     cls = transformers.FlaxCLIPModel if modality == "clip" else transformers.FlaxAutoModel
-    dtype = {"float32": jnp.float32, "bfloat16": jnp.bfloat16}[spec.get("dtype", "float32")]
-    path = spec.get("path")
-    if path:
-        from pathlib import Path
 
-        from_pt = not _has_flax_weights(Path(path))
-        model = cls.from_pretrained(str(path), dtype=dtype, from_pt=from_pt,
-                                    local_files_only=True)
-        log.info("heads: loaded %s backbone from %s", modality, path)
-    else:
+    def make_config():
         mt, defaults = _BACKBONE_DEFAULTS[modality]
         fields = {**defaults, **(spec.get("backbone") or {})}
         if modality == "clip":
-            config = transformers.CLIPConfig(
+            return transformers.CLIPConfig(
                 text_config=fields["text_config"],
                 vision_config=fields["vision_config"],
                 projection_dim=fields["projection_dim"],
             )
-            model = transformers.FlaxCLIPModel(config, dtype=dtype,
-                                               seed=int(spec.get("seed", 0)))
-        else:
-            config = transformers.AutoConfig.for_model(mt, **fields)
-            model = transformers.FlaxAutoModel.from_config(
-                config, dtype=dtype, seed=int(spec.get("seed", 0))
-            )
-        log.info("heads: random-initialized tiny %s backbone (%s)", modality, mt)
-    model.params = jax.tree.map(jnp.asarray, model.params)
+        return transformers.AutoConfig.for_model(mt, **fields)
+
+    model = _load_flax_model(cls, spec, make_config, f"{modality} backbone")
     return _Backbone(model, modality)
 
 
@@ -599,7 +614,6 @@ class _CLIPZeroShot:
             "vqa": ModelType.VISUAL_QUESTION_ANSWERING,
         }[mode]
         self.config = backbone.config
-        dim = backbone.config.projection_dim
         if mode == "vqa":
             self.head = FusionHead(num_labels or 2)
         elif mode == "zs-det":
@@ -763,8 +777,6 @@ def build_head_model(spec: dict[str, Any], model_type: ModelType):
         bb = _build_backbone(spec, "audio")
         return HeadedModel(mt, XVectorHead(n), bb), bb.config
     if mt is ModelType.CTC:
-        import transformers
-
         m = _build_wav2vec2_ctc(spec, n)
         return _DirectFlax(m, mt, "input_values", custom_loss=_ctc_loss), m.config
 
@@ -881,26 +893,14 @@ class _Identity(nn.Module):
 def _build_wav2vec2_ctc(spec: dict, vocab: int):
     import transformers
 
-    dtype = {"float32": jnp.float32, "bfloat16": jnp.bfloat16}[
-        spec.get("dtype", "float32")
-    ]
-    path = spec.get("path")
-    if path:
-        from pathlib import Path
+    def make_config():
+        _, defaults = _BACKBONE_DEFAULTS["audio"]
+        fields = {**defaults, **(spec.get("backbone") or {}), "vocab_size": vocab}
+        return transformers.Wav2Vec2Config(**fields)
 
-        from .hf import _has_flax_weights
-
-        return transformers.FlaxWav2Vec2ForCTC.from_pretrained(
-            str(path), dtype=dtype,
-            from_pt=not _has_flax_weights(Path(path)), local_files_only=True,
-        )
-    _, defaults = _BACKBONE_DEFAULTS["audio"]
-    fields = {**defaults, **(spec.get("backbone") or {}), "vocab_size": vocab}
-    config = transformers.Wav2Vec2Config(**fields)
-    m = transformers.FlaxWav2Vec2ForCTC(config, dtype=dtype,
-                                        seed=int(spec.get("seed", 0)))
-    m.params = jax.tree.map(jnp.asarray, m.params)
-    return m
+    return _load_flax_model(
+        transformers.FlaxWav2Vec2ForCTC, spec, make_config, "wav2vec2-ctc"
+    )
 
 
 # Every type this family covers (registry routes these here by default).

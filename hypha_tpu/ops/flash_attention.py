@@ -87,9 +87,12 @@ def _to_lanes(x, n):
 
 
 def _legal_block(block: int, dim: int) -> bool:
-    """Mosaic block rule: tile dims must be multiples of (8, 128) or equal
-    the array dim — and the grid needs the block to divide the sequence."""
-    return dim % block == 0 and (block % _LANES == 0 or block == dim)
+    """A block this kernel can run: divides the sequence, and its lane
+    layout is expressible — whole blocks ≤ 128 lanes (equal-to-dim is
+    Mosaic-legal and _to_lanes can slice), or 128-multiples (tileable).
+    A >128 non-multiple block would satisfy Mosaic's equal-to-dim rule but
+    not the lane-replicated stats layout, so it routes to dense instead."""
+    return dim % block == 0 and (block <= _LANES or block % _LANES == 0)
 
 
 def _pick_block(dim: int, cap: int) -> int | None:

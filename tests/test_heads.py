@@ -38,15 +38,6 @@ def _ids(t=_TEXT_T, key=0, vocab=1000):
     return jax.random.randint(jax.random.key(key), (B, t), 0, vocab)
 
 
-def _img_labels(shape_from, fill="mse"):
-    def make(out):
-        if fill == "mse":
-            return jnp.zeros_like(jnp.asarray(out, jnp.float32))
-        raise AssertionError
-
-    return make
-
-
 # (model_type, spec extras, inputs, batch maker, loss kind)
 # batch maker gets the apply() output so regression targets match shapes.
 CASES = [

@@ -6,9 +6,13 @@ the virtual CPU mesh (conftest). Run explicitly on hardware with:
 
     HYPHA_ALLOW_TPU=1 python -m pytest tests/test_tpu_hw.py -v
 
-What they pin that interpret mode cannot: VMEM fit of the (block_q, 1)
-scratch layouts, dimension_semantics acceptance, mosaic lowering of the GQA
-index maps, and that flash beats the dense XLA path at S=2048.
+What they pin that interpret mode cannot: Mosaic acceptance of the
+lane-replicated (block_q, 128) stats layouts, dimension_semantics, lowering
+of the GQA index maps, and that flash beats the dense XLA path at S=2048.
+
+Timing note: on the tunneled backend ``block_until_ready`` can return
+before execution finishes, so the perf test chains each call on the
+previous output and syncs with a device→host value fetch.
 """
 
 from __future__ import annotations
@@ -89,11 +93,13 @@ def test_flash_beats_dense_at_long_context_on_chip():
     dense = jax.jit(lambda *a: dot_product_attention(*a, causal=True))
 
     def bench(fn, reps=20):
-        fn(q, k, v).block_until_ready()  # compile + warm
+        out = fn(q, k, v)  # compile + warm
+        float(out.astype(jnp.float32).reshape(-1)[0])
+        x = q
         t0 = time.perf_counter()
         for _ in range(reps):
-            out = fn(q, k, v)
-        out.block_until_ready()
+            x = fn(x, k, v)  # chained: each call consumes the previous
+        float(x.astype(jnp.float32).reshape(-1)[0])  # hard sync
         return (time.perf_counter() - t0) / reps
 
     t_flash = bench(flash)
