@@ -9,6 +9,12 @@ integers, byte strings, text strings, arrays, maps, floats, bool, and null.
 Encoding is canonical-ish: definite lengths only, shortest integer heads,
 f64 for all floats. Decoding additionally accepts f16/f32 and indefinite
 strings/arrays/maps for interop.
+
+Like the reference, the codec is native on the hot path: a C++ CPython
+extension (native/hypha_cbor.cpp, the ciborium role) is compiled on first
+use and preferred; this module is the portable fallback and the semantic
+spec — parity between the two is pinned by the test corpus running against
+both. ``HYPHA_NATIVE_CBOR=0`` disables the native path.
 """
 
 from __future__ import annotations
@@ -17,7 +23,7 @@ import struct
 from io import BytesIO
 from typing import Any
 
-__all__ = ["dumps", "loads", "CBORDecodeError", "MAX_DEPTH"]
+__all__ = ["dumps", "loads", "CBORDecodeError", "MAX_DEPTH", "native_codec_active"]
 
 _BREAK = object()
 
@@ -43,7 +49,11 @@ def _head(fp: BytesIO, major: int, value: int) -> None:
         fp.write(bytes([(major << 5) | 27]) + struct.pack(">Q", value))
 
 
-def _encode(fp: BytesIO, obj: Any) -> None:
+def _encode(fp: BytesIO, obj: Any, depth: int = 0) -> None:
+    if depth > MAX_DEPTH:
+        # Same bound and exception class as the native encoder, so which
+        # codec is active never changes whether an object serializes.
+        raise ValueError("object nesting too deep to encode")
     if obj is None:
         fp.write(b"\xf6")
     elif obj is True:
@@ -70,12 +80,12 @@ def _encode(fp: BytesIO, obj: Any) -> None:
     elif isinstance(obj, (list, tuple)):
         _head(fp, 4, len(obj))
         for item in obj:
-            _encode(fp, item)
+            _encode(fp, item, depth + 1)
     elif isinstance(obj, dict):
         _head(fp, 5, len(obj))
         for k, v in obj.items():
-            _encode(fp, k)
-            _encode(fp, v)
+            _encode(fp, k, depth + 1)
+            _encode(fp, v, depth + 1)
     else:
         raise TypeError(f"cannot CBOR-encode {type(obj).__name__}")
 
@@ -87,7 +97,13 @@ def dumps(obj: Any) -> bytes:
 
 
 def _read(fp: BytesIO, n: int) -> bytes:
-    b = fp.read(n)
+    try:
+        b = fp.read(n)
+    except OverflowError:
+        # A hostile header can declare a length beyond Py_ssize_t; that is
+        # by definition longer than the buffer — a truncation, not a crash
+        # (found by the native/Python parity fuzzer).
+        raise CBORDecodeError("truncated input") from None
     if len(b) != n:
         raise CBORDecodeError("truncated input")
     return b
@@ -165,7 +181,13 @@ def _decode(fp: BytesIO, depth: int = 0) -> Any:
                 k = _decode(fp, depth + 1)
                 if k is _BREAK:
                     break
-                d[k] = _decode(fp, depth + 1)
+                v = _decode(fp, depth + 1)
+                if v is _BREAK:
+                    # A break in value position must reject the frame, not
+                    # leak the sentinel into the decoded map (parity with
+                    # the native codec; review r3).
+                    raise CBORDecodeError("break in map value position")
+                d[k] = v
             return d
         d = {}
         for _ in range(_read_uint(fp, info)):
@@ -214,3 +236,73 @@ def loads(data: bytes) -> Any:
     if fp.read(1):
         raise CBORDecodeError("trailing bytes")
     return obj
+
+
+# ------------------------------------------------------------- native path
+
+_py_dumps = dumps
+_py_loads = loads
+_native = None
+
+
+def _build_native():
+    """Compile + import native/hypha_cbor.cpp (g++, cached .so). Returns the
+    module or None — environments without a toolchain use the Python path."""
+    import importlib.machinery
+    import importlib.util
+    import logging
+    import os
+    import subprocess
+    import sysconfig
+    from pathlib import Path
+
+    if os.environ.get("HYPHA_NATIVE_CBOR", "1") == "0":
+        return None
+    repo = Path(__file__).resolve().parent.parent
+    src = repo / "native" / "hypha_cbor.cpp"
+    so = repo / "native" / "build" / "hypha_cbor.so"
+    try:
+        if not so.exists() or so.stat().st_mtime < src.stat().st_mtime:
+            so.parent.mkdir(parents=True, exist_ok=True)
+            include = sysconfig.get_paths()["include"]
+            # Per-process temp name: concurrent first imports (multi-worker
+            # boxes) must not interleave writes into one file and publish
+            # garbage; os.replace of a private file is atomic.
+            tmp = so.with_suffix(f".so.tmp.{os.getpid()}")
+            subprocess.run(
+                ["g++", "-O2", "-std=c++17", "-shared", "-fPIC",
+                 f"-I{include}", str(src), "-o", str(tmp)],
+                check=True, capture_output=True, timeout=120,
+            )
+            os.replace(tmp, so)
+        loader = importlib.machinery.ExtensionFileLoader("hypha_cbor", str(so))
+        spec = importlib.util.spec_from_loader("hypha_cbor", loader)
+        mod = importlib.util.module_from_spec(spec)
+        loader.exec_module(mod)
+        return mod
+    except Exception as e:  # pragma: no cover — toolchain-dependent
+        logging.getLogger("hypha.codec").info("native CBOR unavailable: %s", e)
+        return None
+
+
+def native_codec_active() -> bool:
+    return _native is not None
+
+
+def _native_dumps(obj: Any) -> bytes:
+    return _native.dumps(obj)
+
+
+def _native_loads(data: bytes) -> Any:
+    try:
+        return _native.loads(data)
+    except ValueError as e:
+        # The extension raises plain ValueError; the wire contract is
+        # CBORDecodeError (a ValueError subclass callers catch by type).
+        raise CBORDecodeError(str(e)) from None
+
+
+_native = _build_native()
+if _native is not None:
+    dumps = _native_dumps
+    loads = _native_loads

@@ -153,6 +153,26 @@ class InProcessInferExecutor(JobExecutor):
                 )
         else:
             params = model.init(jax.random.key(seed), probe)
+        # Serve in bf16 by default: decode at small batch is bound by the
+        # per-step weight read, and bf16 halves that traffic (on the
+        # tunneled bench chip the gain is hidden under dispatch-latency
+        # noise at B=1 — see SERVING_r03 note — but the bandwidth argument
+        # holds on any TPU). Training keeps f32 masters; this cast is
+        # serving-only. serve_dtype=float32 opts out.
+        serve_dtype = model_spec.get("serve_dtype", "bfloat16")
+        if serve_dtype not in ("bfloat16", "float32"):
+            raise ValueError(
+                f"serve_dtype must be 'bfloat16' or 'float32', got {serve_dtype!r}"
+            )
+        if serve_dtype == "bfloat16":
+            import jax.numpy as jnp
+
+            params = jax.tree.map(
+                lambda x: x.astype(jnp.bfloat16)
+                if hasattr(x, "dtype") and x.dtype == jnp.float32
+                else x,
+                params,
+            )
         return model, params
 
     def _generate_grouped(

@@ -1,0 +1,501 @@
+// Native CBOR (RFC 8949) codec — CPython extension.
+//
+// The reference's wire codec is native (ciborium in Rust; every
+// request-response protocol serializes through it, crates/messages/src/
+// lib.rs:15-44). This is the TPU framework's native equivalent for the
+// same role: exact semantic parity with hypha_tpu/codec.py (the portable
+// fallback) — shortest-head definite-length encoding; decoding accepts
+// f16/f32, indefinite strings/arrays/maps and tags; MAX_DEPTH nesting
+// bound so hostile frames fail with a decode error instead of exhausting
+// the C stack. Parity is pinned by tests/test_core.py running its codec
+// corpus against BOTH implementations.
+//
+// Errors: decode problems raise ValueError (codec.py re-wraps into
+// CBORDecodeError); unencodable types raise TypeError, matching the
+// Python encoder.
+
+#define PY_SSIZE_T_CLEAN
+#include <Python.h>
+
+#include <cmath>
+#include <cstdint>
+#include <cstring>
+#include <string>
+#include <vector>
+
+namespace {
+
+constexpr int kMaxDepth = 128;
+
+// ---------------------------------------------------------------- encoder
+
+struct Encoder {
+  std::vector<unsigned char> out;
+
+  void head(int major, uint64_t value) {
+    if (value < 24) {
+      out.push_back((unsigned char)((major << 5) | value));
+    } else if (value < 0x100) {
+      out.push_back((unsigned char)((major << 5) | 24));
+      out.push_back((unsigned char)value);
+    } else if (value < 0x10000) {
+      out.push_back((unsigned char)((major << 5) | 25));
+      out.push_back((unsigned char)(value >> 8));
+      out.push_back((unsigned char)value);
+    } else if (value < 0x100000000ULL) {
+      out.push_back((unsigned char)((major << 5) | 26));
+      for (int s = 24; s >= 0; s -= 8) out.push_back((unsigned char)(value >> s));
+    } else {
+      out.push_back((unsigned char)((major << 5) | 27));
+      for (int s = 56; s >= 0; s -= 8) out.push_back((unsigned char)(value >> s));
+    }
+  }
+
+  void raw(const char* data, Py_ssize_t n) {
+    out.insert(out.end(), (const unsigned char*)data,
+               (const unsigned char*)data + n);
+  }
+
+  // Returns 0 on success, -1 with a Python exception set.
+  int encode(PyObject* obj, int depth) {
+    if (depth > kMaxDepth) {
+      PyErr_SetString(PyExc_ValueError, "object nesting too deep to encode");
+      return -1;
+    }
+    if (obj == Py_None) {
+      out.push_back(0xf6);
+      return 0;
+    }
+    if (obj == Py_True) {
+      out.push_back(0xf5);
+      return 0;
+    }
+    if (obj == Py_False) {
+      out.push_back(0xf4);
+      return 0;
+    }
+    // bool is a subclass of int, but Py_True/Py_False are singletons —
+    // handled above, so PyLong here is a plain integer.
+    if (PyLong_Check(obj)) {
+      int overflow = 0;
+      long long v = PyLong_AsLongLongAndOverflow(obj, &overflow);
+      if (!overflow) {
+        if (v >= 0) {
+          head(0, (uint64_t)v);
+        } else {
+          head(1, (uint64_t)(-1 - v));  // -1-v fits: v >= LLONG_MIN
+        }
+        return 0;
+      }
+      // Out of long long: still legal if it fits u64 (positive) or the
+      // negative encoding's u64 payload.
+      if (overflow > 0) {
+        uint64_t u = PyLong_AsUnsignedLongLong(obj);
+        if (u == (uint64_t)-1 && PyErr_Occurred()) {
+          PyErr_Clear();
+          PyErr_Format(PyExc_TypeError, "integer out of CBOR 64-bit range");
+          return -1;
+        }
+        head(0, u);
+        return 0;
+      }
+      // overflow < 0: compute -1-obj and encode as major 1 if it fits u64.
+      PyObject* minus_one = PyLong_FromLong(-1);
+      if (!minus_one) return -1;
+      PyObject* payload = PyNumber_Subtract(minus_one, obj);  // -1 - obj
+      Py_DECREF(minus_one);
+      if (!payload) return -1;
+      uint64_t u = PyLong_AsUnsignedLongLong(payload);
+      Py_DECREF(payload);
+      if (u == (uint64_t)-1 && PyErr_Occurred()) {
+        PyErr_Clear();
+        PyErr_Format(PyExc_TypeError, "integer out of CBOR 64-bit range");
+        return -1;
+      }
+      head(1, u);
+      return 0;
+    }
+    if (PyFloat_Check(obj)) {
+      double d = PyFloat_AS_DOUBLE(obj);
+      uint64_t bits;
+      static_assert(sizeof(bits) == sizeof(d), "double is 64-bit");
+      std::memcpy(&bits, &d, 8);
+      out.push_back(0xfb);
+      for (int s = 56; s >= 0; s -= 8) out.push_back((unsigned char)(bits >> s));
+      return 0;
+    }
+    if (PyBytes_Check(obj)) {
+      head(2, (uint64_t)PyBytes_GET_SIZE(obj));
+      raw(PyBytes_AS_STRING(obj), PyBytes_GET_SIZE(obj));
+      return 0;
+    }
+    if (PyByteArray_Check(obj)) {
+      head(2, (uint64_t)PyByteArray_GET_SIZE(obj));
+      raw(PyByteArray_AS_STRING(obj), PyByteArray_GET_SIZE(obj));
+      return 0;
+    }
+    if (PyMemoryView_Check(obj)) {
+      Py_buffer view;
+      if (PyObject_GetBuffer(obj, &view, PyBUF_CONTIG_RO) < 0) return -1;
+      head(2, (uint64_t)view.len);
+      raw((const char*)view.buf, view.len);
+      PyBuffer_Release(&view);
+      return 0;
+    }
+    if (PyUnicode_Check(obj)) {
+      Py_ssize_t n = 0;
+      const char* s = PyUnicode_AsUTF8AndSize(obj, &n);
+      if (!s) return -1;
+      head(3, (uint64_t)n);
+      raw(s, n);
+      return 0;
+    }
+    if (PyList_Check(obj) || PyTuple_Check(obj)) {
+      Py_ssize_t n = PySequence_Fast_GET_SIZE(obj);
+      head(4, (uint64_t)n);
+      for (Py_ssize_t i = 0; i < n; i++) {
+        if (encode(PySequence_Fast_GET_ITEM(obj, i), depth + 1) < 0) return -1;
+      }
+      return 0;
+    }
+    if (PyDict_Check(obj)) {
+      head(5, (uint64_t)PyDict_GET_SIZE(obj));
+      PyObject *key, *value;
+      Py_ssize_t pos = 0;
+      while (PyDict_Next(obj, &pos, &key, &value)) {
+        if (encode(key, depth + 1) < 0) return -1;
+        if (encode(value, depth + 1) < 0) return -1;
+      }
+      return 0;
+    }
+    PyErr_Format(PyExc_TypeError, "cannot CBOR-encode %s",
+                 Py_TYPE(obj)->tp_name);
+    return -1;
+  }
+};
+
+// ---------------------------------------------------------------- decoder
+
+struct Decoder {
+  const unsigned char* p;
+  Py_ssize_t len;
+  Py_ssize_t pos = 0;
+
+  bool fail(const char* msg) {
+    PyErr_SetString(PyExc_ValueError, msg);
+    return false;
+  }
+
+  bool read(Py_ssize_t n, const unsigned char** out) {
+    if (pos + n > len) return fail("truncated input");
+    *out = p + pos;
+    pos += n;
+    return true;
+  }
+
+  bool read_uint(int info, uint64_t* out) {
+    const unsigned char* b;
+    if (info < 24) {
+      *out = (uint64_t)info;
+      return true;
+    }
+    if (info == 24) {
+      if (!read(1, &b)) return false;
+      *out = b[0];
+      return true;
+    }
+    if (info == 25) {
+      if (!read(2, &b)) return false;
+      *out = ((uint64_t)b[0] << 8) | b[1];
+      return true;
+    }
+    if (info == 26) {
+      if (!read(4, &b)) return false;
+      *out = ((uint64_t)b[0] << 24) | ((uint64_t)b[1] << 16) |
+             ((uint64_t)b[2] << 8) | b[3];
+      return true;
+    }
+    if (info == 27) {
+      if (!read(8, &b)) return false;
+      uint64_t v = 0;
+      for (int i = 0; i < 8; i++) v = (v << 8) | b[i];
+      *out = v;
+      return true;
+    }
+    return fail("invalid additional info");
+  }
+
+  static double decode_f16(const unsigned char* b) {
+    uint16_t h = (uint16_t)((b[0] << 8) | b[1]);
+    double sign = (h & 0x8000) ? -1.0 : 1.0;
+    int exp = (h >> 10) & 0x1F;
+    int frac = h & 0x3FF;
+    if (exp == 0) return sign * frac * std::pow(2.0, -24);
+    if (exp == 31) {
+      if (frac == 0) return sign * HUGE_VAL;
+      return std::nan("");
+    }
+    return sign * (1.0 + frac * std::pow(2.0, -10)) * std::pow(2.0, exp - 15);
+  }
+
+  // Decodes one item. Returns new ref; nullptr = error. *is_break set when
+  // the 0xff break byte was read (caller decides if legal).
+  PyObject* decode(int depth, bool* is_break) {
+    *is_break = false;
+    if (depth > kMaxDepth) {
+      fail("nesting deeper than MAX_DEPTH");
+      return nullptr;
+    }
+    const unsigned char* b;
+    if (!read(1, &b)) return nullptr;
+    int major = b[0] >> 5, info = b[0] & 0x1F;
+    uint64_t n;
+    switch (major) {
+      case 0: {
+        if (!read_uint(info, &n)) return nullptr;
+        return PyLong_FromUnsignedLongLong(n);
+      }
+      case 1: {
+        if (!read_uint(info, &n)) return nullptr;
+        // -1 - n, exact even for n >= 2^63.
+        PyObject* pn = PyLong_FromUnsignedLongLong(n);
+        if (!pn) return nullptr;
+        PyObject* minus_one = PyLong_FromLong(-1);
+        if (!minus_one) {
+          Py_DECREF(pn);
+          return nullptr;
+        }
+        PyObject* r = PyNumber_Subtract(minus_one, pn);
+        Py_DECREF(minus_one);
+        Py_DECREF(pn);
+        return r;
+      }
+      case 2:
+      case 3: {
+        if (info == 31) {  // indefinite: concatenate same-major chunks
+          std::string buf;
+          for (;;) {
+            bool brk = false;
+            PyObject* item = decode(depth + 1, &brk);
+            if (brk) break;
+            if (!item) return nullptr;
+            // Chunks must match the outer type (bytes for 2, str for 3);
+            // the Python codec surfaces mismatches as a join TypeError →
+            // CBORDecodeError, so mirror that as ValueError here.
+            if (major == 2 ? !PyBytes_Check(item) : !PyUnicode_Check(item)) {
+              Py_DECREF(item);
+              fail("malformed CBOR: mixed indefinite chunk types");
+              return nullptr;
+            }
+            if (major == 2) {
+              buf.append(PyBytes_AS_STRING(item),
+                         (size_t)PyBytes_GET_SIZE(item));
+            } else {
+              Py_ssize_t sn = 0;
+              const char* s = PyUnicode_AsUTF8AndSize(item, &sn);
+              if (!s) {
+                Py_DECREF(item);
+                return nullptr;
+              }
+              buf.append(s, (size_t)sn);
+            }
+            Py_DECREF(item);
+          }
+          if (major == 2)
+            return PyBytes_FromStringAndSize(buf.data(), (Py_ssize_t)buf.size());
+          PyObject* u = PyUnicode_DecodeUTF8(buf.data(), (Py_ssize_t)buf.size(),
+                                             nullptr);
+          if (!u) {
+            PyErr_Clear();
+            fail("malformed CBOR: invalid utf-8");
+          }
+          return u;
+        }
+        if (!read_uint(info, &n)) return nullptr;
+        if (n > (uint64_t)(len - pos)) {
+          fail("truncated input");
+          return nullptr;
+        }
+        const unsigned char* data;
+        if (!read((Py_ssize_t)n, &data)) return nullptr;
+        if (major == 2)
+          return PyBytes_FromStringAndSize((const char*)data, (Py_ssize_t)n);
+        PyObject* u =
+            PyUnicode_DecodeUTF8((const char*)data, (Py_ssize_t)n, nullptr);
+        if (!u) {
+          PyErr_Clear();
+          fail("malformed CBOR: invalid utf-8");
+        }
+        return u;
+      }
+      case 4: {
+        PyObject* list = PyList_New(0);
+        if (!list) return nullptr;
+        if (info == 31) {
+          for (;;) {
+            bool brk = false;
+            PyObject* item = decode(depth + 1, &brk);
+            if (brk) break;
+            if (!item || PyList_Append(list, item) < 0) {
+              Py_XDECREF(item);
+              Py_DECREF(list);
+              return nullptr;
+            }
+            Py_DECREF(item);
+          }
+          return list;
+        }
+        if (!read_uint(info, &n)) {
+          Py_DECREF(list);
+          return nullptr;
+        }
+        for (uint64_t i = 0; i < n; i++) {
+          bool brk = false;
+          PyObject* item = decode(depth + 1, &brk);
+          if (brk) {
+            Py_DECREF(list);
+            fail("break inside definite-length array");
+            return nullptr;
+          }
+          if (!item || PyList_Append(list, item) < 0) {
+            Py_XDECREF(item);
+            Py_DECREF(list);
+            return nullptr;
+          }
+          Py_DECREF(item);
+        }
+        return list;
+      }
+      case 5: {
+        PyObject* dict = PyDict_New();
+        if (!dict) return nullptr;
+        bool indef = (info == 31);
+        uint64_t count = 0;
+        if (!indef && !read_uint(info, &count)) {
+          Py_DECREF(dict);
+          return nullptr;
+        }
+        for (uint64_t i = 0; indef || i < count; i++) {
+          bool brk = false;
+          PyObject* key = decode(depth + 1, &brk);
+          if (brk) {
+            if (indef) return dict;
+            Py_DECREF(dict);
+            fail("break inside definite-length map");
+            return nullptr;
+          }
+          if (!key) {
+            Py_DECREF(dict);
+            return nullptr;
+          }
+          PyObject* value = decode(depth + 1, &brk);
+          if (brk || !value) {
+            Py_DECREF(key);
+            Py_DECREF(dict);
+            if (brk) fail("break inside definite-length map");
+            return nullptr;
+          }
+          int rc = PyDict_SetItem(dict, key, value);
+          Py_DECREF(key);
+          Py_DECREF(value);
+          if (rc < 0) {
+            // Unhashable key from hostile input → decode error, matching
+            // the Python codec's wrap of TypeError.
+            PyErr_Clear();
+            Py_DECREF(dict);
+            fail("malformed CBOR: unhashable map key");
+            return nullptr;
+          }
+        }
+        return dict;
+      }
+      case 6: {  // tag: read and discard the tag number, decode the item
+        if (!read_uint(info, &n)) return nullptr;
+        return decode(depth + 1, is_break);
+      }
+      default: {  // major 7: simple values / floats
+        if (info == 20) Py_RETURN_FALSE;
+        if (info == 21) Py_RETURN_TRUE;
+        if (info == 22 || info == 23) Py_RETURN_NONE;
+        if (info == 25) {
+          const unsigned char* fb;
+          if (!read(2, &fb)) return nullptr;
+          return PyFloat_FromDouble(decode_f16(fb));
+        }
+        if (info == 26) {
+          const unsigned char* fb;
+          if (!read(4, &fb)) return nullptr;
+          uint32_t bits = ((uint32_t)fb[0] << 24) | ((uint32_t)fb[1] << 16) |
+                          ((uint32_t)fb[2] << 8) | fb[3];
+          float f;
+          std::memcpy(&f, &bits, 4);
+          return PyFloat_FromDouble((double)f);
+        }
+        if (info == 27) {
+          const unsigned char* fb;
+          if (!read(8, &fb)) return nullptr;
+          uint64_t bits = 0;
+          for (int i = 0; i < 8; i++) bits = (bits << 8) | fb[i];
+          double d;
+          std::memcpy(&d, &bits, 8);
+          return PyFloat_FromDouble(d);
+        }
+        if (info == 31) {
+          *is_break = true;
+          Py_RETURN_NONE;  // placeholder; caller checks is_break
+        }
+        if (info < 24 || info == 24) {  // unassigned simple value: skip
+          if (!read_uint(info, &n)) return nullptr;
+          Py_RETURN_NONE;
+        }
+        fail("unsupported simple/float info");
+        return nullptr;
+      }
+    }
+  }
+};
+
+// ------------------------------------------------------------- module api
+
+PyObject* cbor_dumps(PyObject*, PyObject* obj) {
+  Encoder enc;
+  if (enc.encode(obj, 0) < 0) return nullptr;
+  return PyBytes_FromStringAndSize((const char*)enc.out.data(),
+                                   (Py_ssize_t)enc.out.size());
+}
+
+PyObject* cbor_loads(PyObject*, PyObject* arg) {
+  Py_buffer view;
+  if (PyObject_GetBuffer(arg, &view, PyBUF_CONTIG_RO) < 0) return nullptr;
+  Decoder dec{(const unsigned char*)view.buf, view.len};
+  bool brk = false;
+  PyObject* obj = dec.decode(0, &brk);
+  if (obj && brk) {
+    Py_DECREF(obj);
+    obj = nullptr;
+    PyErr_SetString(PyExc_ValueError, "unexpected break");
+  }
+  if (obj && dec.pos != dec.len) {
+    Py_DECREF(obj);
+    obj = nullptr;
+    PyErr_SetString(PyExc_ValueError, "trailing bytes");
+  }
+  PyBuffer_Release(&view);
+  return obj;
+}
+
+PyMethodDef kMethods[] = {
+    {"dumps", cbor_dumps, METH_O, "Encode a Python object to CBOR bytes."},
+    {"loads", cbor_loads, METH_O, "Decode CBOR bytes to a Python object."},
+    {nullptr, nullptr, 0, nullptr},
+};
+
+PyModuleDef kModule = {
+    PyModuleDef_HEAD_INIT, "hypha_cbor",
+    "Native CBOR codec (parity twin of hypha_tpu.codec).", -1, kMethods,
+    nullptr, nullptr, nullptr, nullptr,
+};
+
+}  // namespace
+
+PyMODINIT_FUNC PyInit_hypha_cbor(void) { return PyModule_Create(&kModule); }

@@ -171,6 +171,152 @@ def test_cbor_errors():
         codec.dumps(object())
 
 
+# -- native/Python codec parity ----------------------------------------------
+# The C++ extension (native/hypha_cbor.cpp) and the Python module are parity
+# twins: same bytes out, same objects and same error CLASS back, including on
+# hostile input. These tests run whenever the native codec built.
+
+_needs_native = pytest.mark.skipif(
+    not codec.native_codec_active(), reason="native codec not built"
+)
+
+
+def _parity_corpus():
+    return [
+        0, 23, 24, 255, 65536, 2**32, 2**63, 2**64 - 1,
+        -1, -24, -(2**31), -(2**63), -(2**64),
+        1.5, -0.0, float("inf"), True, False, None,
+        "", "hello", "ünïcodé", b"", b"\x00\xff", bytearray(b"ba"),
+        [], [1, [2, [3]]], (4, 5),
+        {}, {"a": 1, "b": [True, None]}, {7: "int-key", b"b": "bytes-key"},
+        {"nested": {"x": b"bytes", "y": -7.25, "z": [1.0, {"q": None}]}},
+    ]
+
+
+@_needs_native
+def test_native_codec_byte_parity_with_python():
+    for obj in _parity_corpus():
+        nb = codec._native_dumps(obj)
+        pb = codec._py_dumps(obj)
+        assert nb == pb, obj
+        got_n = codec._native_loads(nb)
+        got_p = codec._py_loads(pb)
+        assert got_n == got_p, obj
+
+
+@_needs_native
+def test_native_codec_fuzz_parity():
+    """Random structures + random byte strings: both decoders must agree on
+    the value or BOTH reject with CBORDecodeError."""
+    import random
+
+    rng = random.Random(7)
+
+    def rand_obj(depth=0):
+        kinds = "ifsblId" if depth < 3 else "ifsb"
+        k = rng.choice(kinds)
+        if k == "i":
+            return rng.randint(-(2**64), 2**64 - 1)
+        if k == "f":
+            return rng.uniform(-1e9, 1e9)
+        if k == "s":
+            return "".join(chr(rng.randint(32, 0x2FF)) for _ in range(rng.randint(0, 8)))
+        if k == "b":
+            return bytes(rng.randrange(256) for _ in range(rng.randint(0, 8)))
+        if k == "l":
+            return [rand_obj(depth + 1) for _ in range(rng.randint(0, 4))]
+        if k == "I":
+            return rng.choice([None, True, False])
+        return {
+            str(i): rand_obj(depth + 1) for i in range(rng.randint(0, 4))
+        }
+
+    for _ in range(200):
+        obj = rand_obj()
+        assert codec._native_dumps(obj) == codec._py_dumps(obj)
+        assert codec._native_loads(codec._native_dumps(obj)) == codec._py_loads(
+            codec._py_dumps(obj)
+        )
+
+    for _ in range(500):
+        blob = bytes(rng.randrange(256) for _ in range(rng.randint(1, 24)))
+        try:
+            pv = codec._py_loads(blob)
+            p_err = None
+        except codec.CBORDecodeError:
+            p_err = True
+        try:
+            nv = codec._native_loads(blob)
+            n_err = None
+        except codec.CBORDecodeError:
+            n_err = True
+        assert p_err == n_err, blob.hex()
+        if p_err is None:
+            # NaN != NaN; compare reprs for float payloads
+            assert repr(pv) == repr(nv), blob.hex()
+
+
+@_needs_native
+def test_native_codec_hostile_input_parity():
+    cases = [
+        b"\x18",              # truncated uint payload
+        b"\x9f" * 200,        # nesting bomb
+        b"\xff",              # lone break
+        b"\x81\xff",          # break inside definite array
+        b"\xa1\xff",          # break inside definite map
+        b"\xbf\x01\xff\xff",  # break in indefinite-map VALUE position
+        b"\x7f\x42ab\xff",    # mixed chunk types in indefinite text
+        b"\x62\xff\xfe",      # invalid utf-8 in text
+        b"\xa1\x81\x00\x00",  # unhashable (list) map key
+        b"\x1c",              # invalid additional info
+        b"\x5b" + b"\xff" * 8,  # declared length beyond the buffer
+    ]
+    for blob in cases:
+        with pytest.raises(codec.CBORDecodeError):
+            codec._py_loads(blob)
+        with pytest.raises(codec.CBORDecodeError):
+            codec._native_loads(blob)
+
+
+@_needs_native
+def test_codec_encode_depth_limit_parity():
+    """Both encoders bound nesting with the same exception class, so which
+    codec is active never changes whether an object serializes."""
+    deep = obj = []
+    for _ in range(200):
+        inner: list = []
+        obj.append(inner)
+        obj = inner
+    with pytest.raises(ValueError):
+        codec._py_dumps(deep)
+    with pytest.raises(ValueError):
+        codec._native_dumps(deep)
+    ok = nested = []
+    for _ in range(100):  # under MAX_DEPTH: both accept
+        inner2: list = []
+        nested.append(inner2)
+        nested = inner2
+    assert codec._py_dumps(ok) == codec._native_dumps(ok)
+
+
+@_needs_native
+def test_native_codec_interop_decode_forms():
+    import struct
+
+    # f16 / f32 / tags / indefinite forms decode identically
+    vectors = [
+        b"\xf9\x3c\x00",                     # f16 1.0
+        b"\xfa" + struct.pack(">f", 2.5),    # f32
+        b"\xc0\x63abc",                      # tag(0) "abc"
+        b"\x5f\x42ab\x41c\xff",              # indefinite bytes
+        b"\x7f\x62ab\x61c\xff",              # indefinite text
+        b"\x9f\x01\x02\xff",                 # indefinite array
+        b"\xbf\x61a\x01\xff",                # indefinite map
+    ]
+    for blob in vectors:
+        assert codec._py_loads(blob) == codec._native_loads(blob), blob.hex()
+
+
 # -- wire messages ------------------------------------------------------------
 
 
