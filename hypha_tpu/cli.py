@@ -88,6 +88,7 @@ def _make_node(conf, *, registry_server: bool = False, peer_id: str | None = Non
         # NAT'd peers stay reachable (reference listens on relay circuits by
         # default, crates/network/src/listen.rs:25-131).
         relay_listen=not registry_server and getattr(conf.network, "relay", True),
+        advertise_listen=getattr(conf.network, "advertise_listen", True),
     )
     if conf.tls.enabled():
         from .network.secure import secure_node

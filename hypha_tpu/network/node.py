@@ -58,6 +58,16 @@ PROTOCOL_REGISTRY = "/hypha-registry/0.0.1"
 # relay-circuit listeners). Streams between two NAT'd peers are spliced
 # byte-for-byte at the gateway.
 PROTOCOL_RELAY = "/hypha-relay/0.0.1"
+# Direct-connection upgrade over an established circuit — the fabric's
+# DCUtR role (reference: dcutr in every node's behaviour,
+# crates/scheduler/src/network.rs:46-95): peers exchange their direct
+# addresses through the relay and both sides attempt direct dials; once one
+# lands in the address book, _stream_to's direct-first ordering migrates
+# traffic off the circuit.
+PROTOCOL_DCUTR = "/hypha-dcutr/0.0.1"
+# Per-peer cooldown between upgrade attempts (a NAT that never opens would
+# otherwise burn a dial volley on every relayed RPC).
+DCUTR_RETRY_S = 30.0
 # Tensor stream protocol ids follow the reference names
 # (crates/network/src/stream_push.rs:16, stream_pull.rs:21).
 PROTOCOL_PUSH = "/hypha-tensor-stream/push"
@@ -459,6 +469,7 @@ class Node:
         expected_peer_id: Callable[[Stream], str | None] | None = None,
         relay_server: bool | None = None,
         relay_listen: bool = False,
+        advertise_listen: bool = True,
         exclude_cidrs: list[str] | None = None,
         gossip_key=None,
     ) -> None:
@@ -496,8 +507,10 @@ class Node:
         # (reference: the gateway IS the relay server, gateway/network.rs:44)
         self._relay_server = registry_server if relay_server is None else relay_server
         self._relay_listen = relay_listen
+        self._advertise_listen = advertise_listen
         self._relay_controls: dict[str, Stream] = {}  # reserved peer -> ctrl
         self._relay_pending: dict[str, dict] = {}  # circuit id -> record
+        self._dcutr_last: dict[str, float] = {}  # peer -> last upgrade try
         # Addresses never dialed, enforced on EVERY dial — the reference
         # checks its CIDR exclusion list on each outbound connection
         # (crates/network/src/dial.rs:28-41,164).
@@ -627,6 +640,8 @@ class Node:
                 await self._handle_gossip(peer, stream)
             elif proto == PROTOCOL_RELAY:
                 await self._handle_relay(peer, stream)
+            elif proto == PROTOCOL_DCUTR:
+                await self._handle_dcutr(peer, stream)
             elif proto == PROTOCOL_REGISTRY:
                 await self._handle_registry(peer, stream)
             elif proto == PROTOCOL_PUSH:
@@ -770,10 +785,16 @@ class Node:
         for addr in addrs:
             if addr.startswith("relay:"):
                 try:
-                    return await self._dial_via_relay(addr[len("relay:"):], peer_id, proto)
+                    stream = await self._dial_via_relay(
+                        addr[len("relay:"):], peer_id, proto
+                    )
                 except (ConnectionError, OSError, FrameError, RequestError) as e:
                     last_err = e
                     continue
+                # Circuit in use → try to upgrade to a direct connection in
+                # the background (DCUtR role); future dials prefer direct.
+                self._maybe_upgrade_direct(addr[len("relay:"):], peer_id)
+                return stream
             try:
                 stream = await self._open_raw(addr, proto)
             except (ConnectionError, OSError) as e:
@@ -961,6 +982,95 @@ class Node:
             {"from": self.peer_id, "proto": proto, "addr": self.primary_addr()}
         )
         return relayed
+
+    # ----------------------------------------------------------------- dcutr
+    #
+    # Wire (one PROTOCOL_DCUTR stream through a circuit, dialer-initiated):
+    #   dialer   -> listener  {"t":"holepunch","addrs":[...direct addrs]}
+    #   listener -> dialer    {"ok":true,"addrs":[...direct addrs]}
+    # Then BOTH sides attempt direct dials of the other's list (the
+    # simultaneous attempts are what open NAT pinholes for TCP; on an open
+    # network the first reverse dial simply lands). A working address enters
+    # the address book via dial()'s identify, after which _stream_to's
+    # direct-before-relay ordering routes around the gateway.
+
+    def _direct_addrs(self) -> list[str]:
+        return [
+            a
+            for a in [*self.listen_addrs, *self.external_addrs]
+            if not a.startswith("relay:")
+        ]
+
+    def _maybe_upgrade_direct(self, gw_addr: str, peer_id: str) -> None:
+        """Throttled background direct-upgrade attempt for ``peer_id``.
+        (No book-based skip: the book may hold direct addrs that do NOT
+        work — that is exactly why this dial fell back to the relay.)"""
+        now = time.monotonic()
+        if now - self._dcutr_last.get(peer_id, -DCUTR_RETRY_S) < DCUTR_RETRY_S:
+            return
+        self._dcutr_last[peer_id] = now
+        self._spawn(self._direct_upgrade(gw_addr, peer_id))
+
+    # Peer-supplied candidate lists are capped: each failed candidate costs
+    # up to a 5 s dial wait, so an uncapped hostile list would pin a
+    # background task for hours.
+    DCUTR_MAX_CANDIDATES = 8
+
+    async def _try_direct(self, peer_id: str, addrs: list[str]) -> None:
+        """Dial candidates until one identifies as ``peer_id``; dial()
+        records the working address in the address book."""
+        for addr in addrs[: self.DCUTR_MAX_CANDIDATES]:
+            try:
+                got = await asyncio.wait_for(self.dial(addr), 5.0)
+            except asyncio.CancelledError:
+                raise
+            except Exception as e:
+                log.debug("dcutr: direct dial %s failed: %s", addr, e)
+                continue
+            if got == peer_id:
+                log.debug("dcutr: direct route to %s via %s", peer_id, addr)
+                return
+        log.debug("dcutr: no direct route to %s (tried %d)", peer_id, len(addrs))
+
+    async def _direct_upgrade(self, gw_addr: str, target: str) -> None:
+        """Dialer side: exchange direct addresses over a fresh circuit, then
+        race a direct dial while the listener dials us back."""
+        try:
+            stream = await self._dial_via_relay(gw_addr, target, PROTOCOL_DCUTR)
+        except (ConnectionError, OSError, FrameError, RequestError) as e:
+            log.debug("dcutr: circuit to %s failed: %s", target, e)
+            return
+        try:
+            await stream.write_frame(
+                {"t": "holepunch", "addrs": self._direct_addrs()}
+            )
+            reply = await asyncio.wait_for(stream.read_frame(), 10.0)
+        except (FrameError, ConnectionError, OSError, asyncio.TimeoutError) as e:
+            log.debug("dcutr: exchange with %s failed: %s", target, e)
+            return
+        finally:
+            await stream.close()
+        if reply.get("ok"):
+            addrs = [a for a in reply.get("addrs", []) if isinstance(a, str)]
+            await self._try_direct(target, addrs)
+
+    async def _handle_dcutr(self, peer: str, stream: Stream) -> None:
+        frame = await stream.read_frame()
+        if frame.get("t") != "holepunch":
+            await stream.write_frame({"ok": False, "error": "unknown dcutr op"})
+            return
+        await stream.write_frame({"ok": True, "addrs": self._direct_addrs()})
+        # The dial-back volley is throttled like the initiating side — a
+        # peer opening dcutr streams in a loop must not multiply background
+        # dial tasks (the address list is additionally capped in
+        # _try_direct).
+        now = time.monotonic()
+        if now - self._dcutr_last.get(peer, -DCUTR_RETRY_S) < DCUTR_RETRY_S:
+            return
+        self._dcutr_last[peer] = now
+        addrs = [a for a in frame.get("addrs", []) if isinstance(a, str)]
+        # Dial back outside the circuit's lifetime.
+        self._spawn(self._try_direct(peer, addrs))
 
     # ---------------------------------------------------------------- gossip
 
@@ -1176,6 +1286,12 @@ class Node:
             await stream.close()
 
     def _my_addrs(self) -> list[str]:
+        """Addresses to advertise. A NAT'd node (``advertise_listen=False``)
+        publishes only its external/circuit addresses — its listen addrs are
+        private-network noise to other peers; the DCUtR exchange is the
+        channel that hands those candidates to a peer at upgrade time."""
+        if not self._advertise_listen:
+            return list(dict.fromkeys(self.external_addrs))
         return list(dict.fromkeys(self.external_addrs + self.listen_addrs))
 
     async def wait_for_bootstrap(self, timeout: float = 60.0) -> None:

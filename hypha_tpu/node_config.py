@@ -54,6 +54,14 @@ class NetworkConfig:
         default=True,
         metadata={"doc": "hold gateway circuit reservations so NAT'd peers can reach us"},
     )
+    advertise_listen: bool = field(
+        default=True,
+        metadata={
+            "doc": "publish listen addresses to discovery; NAT'd nodes set "
+            "false (private addrs travel via the direct-upgrade exchange "
+            "instead — the dcutr role)"
+        },
+    )
     mux: bool = field(
         default=False,
         metadata={

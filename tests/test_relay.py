@@ -53,13 +53,16 @@ async def _natted_pair():
 
     blocked_a: set[str] = set()
     blocked_b: set[str] = set()
+    # advertise_listen=False: like real NAT'd nodes, the registry record
+    # carries only circuit addresses — the private listen addrs travel via
+    # the DCUtR exchange, not discovery.
     a = Node(
         Firewall(hub.shared(), blocked_a), peer_id="a",
-        bootstrap=[gw_addr], relay_listen=True,
+        bootstrap=[gw_addr], relay_listen=True, advertise_listen=False,
     )
     b = Node(
         Firewall(hub.shared(), blocked_b), peer_id="b",
-        bootstrap=[gw_addr], relay_listen=True,
+        bootstrap=[gw_addr], relay_listen=True, advertise_listen=False,
     )
     await a.start()
     await b.start()
@@ -233,5 +236,105 @@ def test_exclude_cidrs_allows_relay_of_permitted_gateway():
 
     async def _ok():
         return HealthResponse(healthy=True)
+
+    run(main())
+
+
+def test_dcutr_direct_upgrade_when_pinhole_opens():
+    """DCUtR role: a circuit in use triggers a background direct upgrade.
+    Phase 1 (NAT closed): upgrade attempts fail, traffic stays on the relay.
+    Phase 2 (pinhole opens b->a): the listener's reverse dial lands, b's
+    address book gains a direct route and b's traffic leaves the gateway.
+    Phase 3 (fully open): the dialer's own direct attempt lands and a's
+    traffic leaves the gateway too."""
+
+    async def main():
+        gw, a, b = await _natted_pair()
+
+        async def handler(peer, msg):
+            return HealthResponse(healthy=True)
+
+        b.on("/health", HealthRequest).respond_with(handler)
+        a.on("/health", HealthRequest).respond_with(handler)
+
+        # Phase 1: both directions firewalled — RPC rides the circuit and
+        # the upgrade volley cannot land a direct route.
+        await a.request("b", "/health", HealthRequest())
+        await asyncio.sleep(0.3)  # let the background upgrade run out
+        assert all(x.startswith("relay:") for x in a._peers.get("b", [])), a._peers
+        assert gw.bytes_relayed > 0
+
+        async def settle():
+            # bytes_relayed grows at pump EOF; wait for in-flight circuit
+            # teardowns (incl. the exchange circuit itself) to finish before
+            # capturing a baseline, or leftover bytes make the flat-counter
+            # assertion flaky.
+            prev = -1
+            while gw.bytes_relayed != prev:
+                prev = gw.bytes_relayed
+                await asyncio.sleep(0.1)
+
+        # Phase 2: pinhole opens b->a (reverse-dial scenario). Re-arm the
+        # throttle on BOTH roles (initiator volley and responder dial-back
+        # share the per-peer cooldown) and use the circuit again.
+        b.transport.blocked.clear()
+        a._dcutr_last.clear(); b._dcutr_last.clear()
+        await a.request("b", "/health", HealthRequest())
+        for _ in range(100):
+            if any(not x.startswith("relay:") for x in b._peers.get("a", [])):
+                break
+            await asyncio.sleep(0.05)
+        else:
+            raise AssertionError(f"b never learned a direct route: {b._peers}")
+        await settle()
+        relayed_before = gw.bytes_relayed
+        reply = await b.request("a", "/health", HealthRequest())
+        assert reply.healthy
+        assert gw.bytes_relayed == relayed_before, "b->a must ride the direct route"
+
+        # Phase 3: fully open — a's own direct attempt lands.
+        a.transport.blocked.clear()
+        a._dcutr_last.clear(); b._dcutr_last.clear()
+        await a.request("b", "/health", HealthRequest())
+        for _ in range(100):
+            if any(not x.startswith("relay:") for x in a._peers.get("b", [])):
+                break
+            await asyncio.sleep(0.05)
+        else:
+            raise AssertionError(f"a never learned a direct route: {a._peers}")
+        await settle()
+        relayed_before = gw.bytes_relayed
+        reply = await a.request("b", "/health", HealthRequest())
+        assert reply.healthy
+        assert gw.bytes_relayed == relayed_before, "a->b must ride the direct route"
+        await a.stop(); await b.stop(); await gw.stop()
+
+    run(main())
+
+
+def test_dcutr_upgrade_attempts_are_throttled():
+    """A NAT that never opens must not burn a dial volley per relayed RPC."""
+
+    async def main():
+        gw, a, b = await _natted_pair()
+
+        async def handler(peer, msg):
+            return HealthResponse(healthy=True)
+
+        b.on("/health", HealthRequest).respond_with(handler)
+        dials = 0
+        orig = a._direct_upgrade
+
+        async def counting(gw_addr, target):
+            nonlocal dials
+            dials += 1
+            await orig(gw_addr, target)
+
+        a._direct_upgrade = counting
+        for _ in range(5):
+            await a.request("b", "/health", HealthRequest())
+        await asyncio.sleep(0.2)
+        assert dials <= 1, f"upgrade fired {dials} times within the cooldown"
+        await a.stop(); await b.stop(); await gw.stop()
 
     run(main())
