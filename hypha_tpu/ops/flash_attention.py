@@ -484,7 +484,7 @@ def flash_attention(
     """
     B, Sq, H, D = q.shape
     _, Sk, Hkv, _ = k.shape
-    explicit_fwd = block_q is not None or block_k is not None
+    explicit_q, explicit_k = block_q is not None, block_k is not None
     if block_q is None:
         block_q = _pick_block(Sq, 512)
     if block_k is None:
@@ -503,14 +503,19 @@ def flash_attention(
     # q-major, dk/dv k-major): on the r3 bench chip, (512, 512) bwd tiles
     # over reused fwd (512, 256) measured 5.40 → 5.01 ms on the isolated
     # op and 97.8k → 109.2k tok/s end-to-end on the GPT-2 train step.
-    # A caller who tuned the FORWARD tiles explicitly (e.g. to bound VMEM)
-    # keeps them for the backward too unless overridden; an illegal bwd
-    # block falls back the same way, never to the dense path.
-    if block_q_bwd is None or not _legal_block(block_q_bwd, Sq):
-        bq = None if explicit_fwd else _pick_block(Sq, 512)
+    # Per dimension: a caller who tuned a FORWARD tile explicitly (e.g. to
+    # bound VMEM) keeps it for the backward unless overridden; an
+    # explicitly passed but illegal bwd tile is an error (a silent
+    # substitute would make tuning sweeps record phantom configs).
+    if block_q_bwd is not None and not _legal_block(block_q_bwd, Sq):
+        raise ValueError(f"block_q_bwd={block_q_bwd} illegal for Sq={Sq}")
+    if block_k_bwd is not None and not _legal_block(block_k_bwd, Sk):
+        raise ValueError(f"block_k_bwd={block_k_bwd} illegal for Sk={Sk}")
+    if block_q_bwd is None:
+        bq = None if explicit_q else _pick_block(Sq, 512)
         block_q_bwd = block_q if bq is None else bq
-    if block_k_bwd is None or not _legal_block(block_k_bwd, Sk):
-        bk = None if explicit_fwd else _pick_block(Sk, 512)
+    if block_k_bwd is None:
+        bk = None if explicit_k else _pick_block(Sk, 512)
         block_k_bwd = block_k if bk is None else bk
     if H % Hkv:
         raise ValueError(f"query heads {H} not a multiple of kv heads {Hkv}")

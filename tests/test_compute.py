@@ -388,6 +388,13 @@ def test_flash_attention_matches_xla_reference():
     want = dot_product_attention(q, k, v, causal=True)
     assert jnp.allclose(got, want, rtol=2e-3, atol=2e-3)
 
+    # An explicitly passed but illegal BACKWARD tile is an error (a silent
+    # substitute would let tuning sweeps record configs that never ran).
+    import pytest as _pytest
+
+    with _pytest.raises(ValueError, match="block_k_bwd"):
+        flash_attention(q, k, v, causal=True, block_k_bwd=200)
+
 
 def test_flash_attention_grad_matches_xla_reference():
     """jax.grad through the pallas flash kernel (custom VJP, interpret mode
