@@ -26,7 +26,13 @@ import numpy as np
 
 from ..executor.serialization import unflatten_like
 
-__all__ = ["convert_state_dict", "load_checkpoint_files", "HF_CONVERTERS"]
+__all__ = [
+    "convert_state_dict",
+    "convert_checkpoint",
+    "load_checkpoint_files",
+    "ShardedCheckpoint",
+    "HF_CONVERTERS",
+]
 
 log = logging.getLogger("hypha.models.convert")
 
@@ -173,6 +179,165 @@ def _torch_to_np(t) -> np.ndarray:
     if t.dtype == torch.bfloat16:
         t = t.float()
     return t.numpy()
+
+
+class ShardedCheckpoint:
+    """Lazy tensor reader over an HF checkpoint — single ``.safetensors``
+    file, a directory with one, or a sharded repo with
+    ``model.safetensors.index.json`` (the layout every released >2 GB HF
+    checkpoint uses; reference loads these through AutoModel which resolves
+    the same index, executors/accelerate/.../model.py:48-123).
+
+    Tensors are read one at a time (native mmap when available, lazy
+    ``safe_open`` slices otherwise), so peak host memory is one tensor —
+    a 7B checkpoint converts on a host with a few GB of RAM.
+    """
+
+    def __init__(self, path: str | Path) -> None:
+        path = Path(path)
+        self._weight_map: dict[str, Path]  # tensor name -> shard file
+        if path.is_dir():
+            index = sorted(path.glob("*.safetensors.index.json"))
+            if index:
+                import json
+
+                meta = json.loads(index[0].read_text())
+                self._weight_map = {
+                    k: path / v for k, v in meta["weight_map"].items()
+                }
+            else:
+                shards = sorted(path.glob("*.safetensors"))
+                if not shards:
+                    raise FileNotFoundError(
+                        f"no .safetensors or index.json under {path}"
+                    )
+                self._weight_map = {}
+                for shard in shards:
+                    for name in self._shard_keys(shard):
+                        self._weight_map[name] = shard
+        elif path.name.endswith(".index.json"):
+            import json
+
+            meta = json.loads(path.read_text())
+            self._weight_map = {
+                k: path.parent / v for k, v in meta["weight_map"].items()
+            }
+        else:
+            self._weight_map = {name: path for name in self._shard_keys(path)}
+        self._open: dict[Path, Any] = {}  # shard -> reader, opened lazily
+
+    @staticmethod
+    def _shard_keys(shard: Path) -> list[str]:
+        from ..native import SafeTensorsView
+
+        try:
+            with SafeTensorsView(shard) as view:
+                return view.keys()
+        except (OSError, ValueError):
+            import safetensors
+
+            with safetensors.safe_open(str(shard), framework="numpy") as f:
+                return list(f.keys())
+
+    def keys(self) -> list[str]:
+        return list(self._weight_map)
+
+    def _reader(self, shard: Path):
+        reader = self._open.get(shard)
+        if reader is None:
+            from ..native import SafeTensorsView
+
+            try:
+                reader = SafeTensorsView(shard)
+            except (OSError, ValueError):
+                import safetensors
+
+                # torch framework: the one loader that reads every dtype a
+                # real repo ships (bf16 included) lazily.
+                reader = safetensors.safe_open(str(shard), framework="torch")
+            self._open[shard] = reader
+        return reader
+
+    def tensor(self, name: str) -> np.ndarray:
+        shard = self._weight_map.get(name)
+        if shard is None:
+            raise KeyError(name)
+        reader = self._reader(shard)
+        if hasattr(reader, "tensor"):
+            return reader.tensor(name)  # native mmap view
+        return _torch_to_np(reader.get_tensor(name))
+
+    def close(self) -> None:
+        for reader in self._open.values():
+            if hasattr(reader, "close"):
+                reader.close()
+        self._open.clear()
+
+    def __enter__(self) -> "ShardedCheckpoint":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+
+def convert_checkpoint(
+    family: str,
+    path: str | Path,
+    params_template: Any,
+    *,
+    dtype: Any = None,
+    put: Any = None,
+) -> Any:
+    """Streaming HF→native conversion for checkpoints of any size.
+
+    Unlike :func:`convert_state_dict` (which wants the whole state dict in
+    host memory), this walks the checkpoint tensor-by-tensor: read → map
+    name → transpose → cast to ``dtype`` → hand to ``put`` (e.g.
+    ``jax.device_put``) → drop the host copy. A Llama-2-7B in bf16 streams
+    onto a 16 GB chip without ever holding more than one tensor on host.
+
+    ``put``: optional ``(flat_name, np.ndarray) -> leaf`` placed into the
+    result tree (default: keep the numpy array).
+    """
+    mapper = HF_CONVERTERS.get(family)
+    if mapper is None:
+        raise ValueError(
+            f"no HF converter for family {family!r} (have {sorted(HF_CONVERTERS)})"
+        )
+    flat: dict[str, Any] = {}
+    with ShardedCheckpoint(path) as ckpt:
+        def _load_one(hf_key: str, name: str, transpose: bool) -> None:
+            arr = np.asarray(ckpt.tensor(hf_key))
+            if transpose:
+                arr = arr.T
+            target = np.dtype(dtype) if dtype is not None else np.float32
+            # One OWNED contiguous host copy in the target dtype — never a
+            # view: the shard mmap is unmapped when the checkpoint closes,
+            # and ascontiguousarray would alias it for already-contiguous
+            # same-dtype tensors.
+            arr = np.array(arr, dtype=target, order="C")
+            flat[name] = put(name, arr) if put is not None else arr
+
+        hf_keys: dict[str, tuple[str, bool]] = {}
+        for hf_key in ckpt.keys():
+            mapped = mapper(hf_key)
+            if mapped is None:
+                continue
+            name, transpose = mapped
+            hf_keys[name] = (hf_key, transpose)
+            _load_one(hf_key, name, transpose)
+        if (
+            family in _TIED_HEAD_FAMILIES
+            and "params/lm_head" not in flat
+            and "params/embed_tokens" in hf_keys
+            and _template_has(params_template, "lm_head")
+        ):
+            log.info(
+                "%s: tied checkpoint — materializing lm_head from embeddings",
+                family,
+            )
+            _load_one(hf_keys["params/embed_tokens"][0], "params/lm_head", False)
+    return unflatten_like(flat, params_template)
 
 
 def load_checkpoint_files(paths: list[str | Path]) -> dict[str, np.ndarray]:

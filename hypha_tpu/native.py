@@ -239,9 +239,17 @@ class SafeTensorsView:
         )
         if not ptr:
             raise KeyError(name)
-        dtype = _DTYPES.get(dtype_buf.value.decode())
+        dtype_name = dtype_buf.value.decode()
+        if dtype_name == "BF16":
+            # ml_dtypes ships with jax; imported lazily so the PS path (pure
+            # f32) keeps working in stripped environments.
+            import ml_dtypes
+
+            dtype = ml_dtypes.bfloat16
+        else:
+            dtype = _DTYPES.get(dtype_name)
         if dtype is None:
-            raise ValueError(f"unsupported dtype {dtype_buf.value!r} for {name}")
+            raise ValueError(f"unsupported dtype {dtype_name!r} for {name}")
         buf = (ctypes.c_char * nbytes.value).from_address(ptr)
         # The array's base chain ends at `buf`; anchor the view there so a
         # GC'd SafeTensorsView can't munmap pages a live array still reads

@@ -995,11 +995,17 @@ class Node:
     # direct-before-relay ordering routes around the gateway.
 
     def _direct_addrs(self) -> list[str]:
-        return [
-            a
-            for a in [*self.listen_addrs, *self.external_addrs]
-            if not a.startswith("relay:")
-        ]
+        # Wildcard binds (0.0.0.0 / [::]) are listenable but not dialable;
+        # advertising them would waste slots in the capped dial volley.
+        out = []
+        for a in [*self.listen_addrs, *self.external_addrs]:
+            if a.startswith("relay:"):
+                continue
+            host = a.rsplit(":", 1)[0].strip("[]")
+            if host in ("0.0.0.0", "::", ""):
+                continue
+            out.append(a)
+        return out
 
     def _maybe_upgrade_direct(self, gw_addr: str, peer_id: str) -> None:
         """Throttled background direct-upgrade attempt for ``peer_id``.
@@ -1008,8 +1014,19 @@ class Node:
         now = time.monotonic()
         if now - self._dcutr_last.get(peer_id, -DCUTR_RETRY_S) < DCUTR_RETRY_S:
             return
+        self._prune_dcutr(now)
         self._dcutr_last[peer_id] = now
         self._spawn(self._direct_upgrade(gw_addr, peer_id))
+
+    def _prune_dcutr(self, now: float) -> None:
+        """Entries older than the retry window carry no throttle information;
+        dropping them bounds the table against peers churning fresh ids."""
+        if len(self._dcutr_last) < 1024:
+            return
+        cutoff = now - DCUTR_RETRY_S
+        self._dcutr_last = {
+            p: t for p, t in self._dcutr_last.items() if t >= cutoff
+        }
 
     # Peer-supplied candidate lists are capped: each failed candidate costs
     # up to a 5 s dial wait, so an uncapped hostile list would pin a
@@ -1067,6 +1084,7 @@ class Node:
         now = time.monotonic()
         if now - self._dcutr_last.get(peer, -DCUTR_RETRY_S) < DCUTR_RETRY_S:
             return
+        self._prune_dcutr(now)
         self._dcutr_last[peer] = now
         addrs = [a for a in frame.get("addrs", []) if isinstance(a, str)]
         # Dial back outside the circuit's lifetime.

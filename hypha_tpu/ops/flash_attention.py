@@ -97,11 +97,17 @@ def _legal_block(block: int, dim: int) -> bool:
 
 def _pick_block(dim: int, cap: int) -> int | None:
     """Largest legal tile ≤ cap, else None (→ dense fallback). Caps come
-    from the r3 on-chip sweep (see flash_attention docstring)."""
+    from the r3 on-chip sweep (see flash_attention docstring). Prefers
+    128-multiple tiles; when none divides the sequence (e.g. S=192, 320),
+    falls back to the largest ≤128 divisor, which _legal_block admits and
+    keeps such lengths on the flash path instead of dense."""
     if dim <= _LANES:
         return dim  # whole-sequence block: equal-to-dim is always legal
     for d in range(cap, 0, -_LANES):
         if dim % d == 0:
+            return d
+    for d in range(min(cap, _LANES), 0, -1):
+        if dim % d == 0 and d % 8 == 0:  # sublane-aligned small tile
             return d
     return None
 

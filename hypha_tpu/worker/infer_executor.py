@@ -134,25 +134,6 @@ class InProcessInferExecutor(JobExecutor):
         import numpy as np
 
         probe = np.zeros((1, 8), np.int32)
-        path = model_spec.get("weights")
-        if path:  # optional local checkpoint (flat safetensors or HF dict)
-            from ..executor.serialization import unflatten_like
-            from ..models.convert import convert_state_dict, load_checkpoint_files
-
-            # Abstract template only — materializing a random 7B tree just
-            # to overwrite it would double peak memory at job start.
-            template = jax.eval_shape(
-                lambda: model.init(jax.random.key(seed), probe)
-            )
-            state = load_checkpoint_files([Path(path)])
-            try:
-                params = unflatten_like(state, template)
-            except KeyError:
-                params = convert_state_dict(
-                    model_spec.get("family", "gpt2"), state, template
-                )
-        else:
-            params = model.init(jax.random.key(seed), probe)
         # Serve in bf16 by default: decode at small batch is bound by the
         # per-step weight read, and bf16 halves that traffic (on the
         # tunneled bench chip the gain is hidden under dispatch-latency
@@ -164,6 +145,52 @@ class InProcessInferExecutor(JobExecutor):
             raise ValueError(
                 f"serve_dtype must be 'bfloat16' or 'float32', got {serve_dtype!r}"
             )
+        if serve_dtype == "bfloat16":
+            # Visible migration signal: the implicit cast changes logits
+            # for every serving job, so operators must be able to
+            # attribute numeric drift to it (serve_dtype=float32 opts out).
+            log.info(
+                "serving params cast f32->bf16 (default; set "
+                "serve_dtype=float32 to keep f32 logits)"
+            )
+        path = model_spec.get("weights")
+        if path:  # optional local checkpoint (flat safetensors or HF repo)
+            from ..executor.serialization import unflatten_like
+            from ..models.convert import (
+                convert_checkpoint,
+                convert_state_dict,
+                load_checkpoint_files,
+            )
+
+            # Abstract template only — materializing a random 7B tree just
+            # to overwrite it would double peak memory at job start.
+            template = jax.eval_shape(
+                lambda: model.init(jax.random.key(seed), probe)
+            )
+            p = Path(path)
+            if p.is_dir() or p.name.endswith(".index.json"):
+                # HF repo layout (sharded or single-file): stream leaves to
+                # device in the serving dtype — one tensor of host memory,
+                # no f32 full tree (a 7B repo would need 27 GB otherwise).
+                import jax.numpy as jnp
+
+                target = jnp.bfloat16 if serve_dtype == "bfloat16" else jnp.float32
+                return model, convert_checkpoint(
+                    model_spec.get("family", "gpt2"),
+                    p,
+                    template,
+                    dtype=target,
+                    put=lambda _n, a: jax.device_put(a),
+                )
+            state = load_checkpoint_files([p])
+            try:
+                params = unflatten_like(state, template)
+            except KeyError:
+                params = convert_state_dict(
+                    model_spec.get("family", "gpt2"), state, template
+                )
+        else:
+            params = model.init(jax.random.key(seed), probe)
         if serve_dtype == "bfloat16":
             import jax.numpy as jnp
 

@@ -277,7 +277,10 @@ struct Decoder {
           for (;;) {
             bool brk = false;
             PyObject* item = decode(depth + 1, &brk);
-            if (brk) break;
+            if (brk) {
+              Py_DECREF(item);  // None placeholder is an owned ref pre-3.12
+              break;
+            }
             if (!item) return nullptr;
             // Chunks must match the outer type (bytes for 2, str for 3);
             // the Python codec surfaces mismatches as a join TypeError →
@@ -335,7 +338,10 @@ struct Decoder {
           for (;;) {
             bool brk = false;
             PyObject* item = decode(depth + 1, &brk);
-            if (brk) break;
+            if (brk) {
+              Py_DECREF(item);
+              break;
+            }
             if (!item || PyList_Append(list, item) < 0) {
               Py_XDECREF(item);
               Py_DECREF(list);
@@ -353,6 +359,7 @@ struct Decoder {
           bool brk = false;
           PyObject* item = decode(depth + 1, &brk);
           if (brk) {
+            Py_DECREF(item);  // owned None placeholder
             Py_DECREF(list);
             fail("break inside definite-length array");
             return nullptr;
@@ -379,6 +386,7 @@ struct Decoder {
           bool brk = false;
           PyObject* key = decode(depth + 1, &brk);
           if (brk) {
+            Py_DECREF(key);
             if (indef) return dict;
             Py_DECREF(dict);
             fail("break inside definite-length map");
@@ -390,9 +398,10 @@ struct Decoder {
           }
           PyObject* value = decode(depth + 1, &brk);
           if (brk || !value) {
+            Py_XDECREF(value);  // on break: owned None placeholder
             Py_DECREF(key);
             Py_DECREF(dict);
-            if (brk) fail("break inside definite-length map");
+            if (brk) fail("break inside value position of map");
             return nullptr;
           }
           int rc = PyDict_SetItem(dict, key, value);

@@ -377,15 +377,26 @@ def test_flash_attention_matches_xla_reference():
     got = flash_attention(q3, k[:, :100], v[:, :100], causal=True)
     assert jnp.allclose(got, want, rtol=2e-3, atol=2e-3)
 
-    # Non-tileable shapes fall back to the XLA path (still correct):
-    # S=192 has no Mosaic-legal tile (>128, not a multiple of 128), and an
-    # explicitly-passed illegal block must also fall back, not crash.
+    # S=192 has no 128-multiple divisor; _pick_block now drops to the
+    # largest sublane-aligned ≤128 divisor (96) and stays on the flash
+    # path. An explicitly-passed illegal block must fall back, not crash.
     q4 = q[:, :192]
     want = dot_product_attention(q4, k[:, :192], v[:, :192], causal=True)
     got = flash_attention(q4, k[:, :192], v[:, :192], causal=True)
     assert jnp.allclose(got, want, rtol=2e-3, atol=2e-3)
     got = flash_attention(q, k, v, causal=True, block_q=128, block_k=200)
     want = dot_product_attention(q, k, v, causal=True)
+    assert jnp.allclose(got, want, rtol=2e-3, atol=2e-3)
+
+    # S=300 has NO legal tile at any size (no >128 divisor is a 128-multiple
+    # and no ≤128 divisor is sublane-aligned): _pick_block returns None and
+    # the automatic dense fallback must engage.
+    q5, k5, v5 = q[:, :12], k[:, :12], v[:, :12]
+    q5 = jnp.tile(q5, (1, 25, 1, 1))  # S=300
+    k5 = jnp.tile(k5, (1, 25, 1, 1))
+    v5 = jnp.tile(v5, (1, 25, 1, 1))
+    want = dot_product_attention(q5, k5, v5, causal=True)
+    got = flash_attention(q5, k5, v5, causal=True)
     assert jnp.allclose(got, want, rtol=2e-3, atol=2e-3)
 
     # An explicitly passed but illegal BACKWARD tile is an error (a silent
