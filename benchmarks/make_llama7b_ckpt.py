@@ -1,0 +1,121 @@
+"""Write a full-size Llama-2-7B HF checkpoint + torch parity oracle.
+
+The hub is unreachable from this environment (zero egress), so the
+checkpoint is *written by the torch reference stack itself*:
+``transformers.LlamaForCausalLM`` with the exact Llama-2-7B architecture
+(vocab 32000, hidden 4096, 32 layers / heads, intermediate 11008),
+``save_pretrained(max_shard_size=...)`` producing the same sharded
+``model.safetensors.index.json`` repo layout every released >2 GB HF
+checkpoint uses — the format the reference's executor consumes via
+AutoModelForCausalLM (executors/accelerate/.../model.py:48-123).
+
+Alongside the repo it writes ``oracle.npz``: last-position logits (f32)
+and greedy continuations for fixed prompts, computed by torch with KV
+cache. The conversion/serving benches compare the jax side against these
+recorded values, so the chip run needs no torch in the loop.
+
+Run:  python benchmarks/make_llama7b_ckpt.py [out_dir]   (CPU, ~30 min)
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+from pathlib import Path
+
+import numpy as np
+
+N_PROMPTS = 3
+PROMPT_LEN = 12
+GREEDY_TOKENS = 8
+
+
+def main(out: str = "/tmp/llama2_7b") -> None:
+    import torch
+    import transformers
+
+    out_dir = Path(out)
+    t0 = time.time()
+    cfg = transformers.LlamaConfig(
+        vocab_size=32000,
+        hidden_size=4096,
+        intermediate_size=11008,
+        num_hidden_layers=32,
+        num_attention_heads=32,
+        num_key_value_heads=32,
+        max_position_embeddings=4096,
+        rms_norm_eps=1e-5,
+        rope_theta=10000.0,
+        attention_bias=False,
+        tie_word_embeddings=False,
+    )
+    torch.manual_seed(0)
+    print("initializing 7B torch model (f32)...", flush=True)
+    model = transformers.LlamaForCausalLM(cfg).eval()
+    n_params = sum(p.numel() for p in model.parameters())
+    print(f"init done: {n_params/1e9:.2f}B params, {time.time()-t0:.0f}s", flush=True)
+
+    # ---- oracle: f32 logits + greedy continuations, recorded for the chip
+    rng = np.random.default_rng(7)
+    prompts = rng.integers(0, cfg.vocab_size, (N_PROMPTS, PROMPT_LEN))
+    logits = np.zeros((N_PROMPTS, cfg.vocab_size), np.float32)
+    greedy = np.zeros((N_PROMPTS, GREEDY_TOKENS), np.int64)
+    with torch.no_grad():
+        for i, p in enumerate(prompts):
+            t1 = time.time()
+            ids = torch.from_numpy(p[None, :])
+            logits[i] = model(ids).logits[0, -1].numpy()
+            gen = model.generate(
+                ids,
+                max_new_tokens=GREEDY_TOKENS,
+                do_sample=False,
+                use_cache=True,
+                pad_token_id=0,
+            )
+            greedy[i] = gen[0, PROMPT_LEN:].numpy()
+            print(f"oracle prompt {i}: {time.time()-t1:.0f}s", flush=True)
+
+    # ---- bf16 sharded repo, the dtype Llama-2 actually ships in
+    print("casting to bf16 + save_pretrained (sharded)...", flush=True)
+    model.to(torch.bfloat16)
+    # bf16-storage oracle: serving casts params to bf16, so record the
+    # torch bf16-weights logits too (computed in f32 matmul via autocast
+    # off — torch CPU bf16 linear upcasts internally).
+    logits_bf16 = np.zeros((N_PROMPTS, cfg.vocab_size), np.float32)
+    greedy_bf16 = np.zeros((N_PROMPTS, GREEDY_TOKENS), np.int64)
+    with torch.no_grad():
+        for i, p in enumerate(prompts):
+            ids = torch.from_numpy(p[None, :])
+            logits_bf16[i] = model(ids).logits[0, -1].float().numpy()
+            gen = model.generate(
+                ids,
+                max_new_tokens=GREEDY_TOKENS,
+                do_sample=False,
+                use_cache=True,
+                pad_token_id=0,
+            )
+            greedy_bf16[i] = gen[0, PROMPT_LEN:].numpy()
+    model.save_pretrained(out_dir, max_shard_size="5GB", safe_serialization=True)
+    np.savez(
+        out_dir / "oracle.npz",
+        prompts=prompts,
+        logits_f32=logits,
+        greedy_f32=greedy,
+        logits_bf16=logits_bf16,
+        greedy_bf16=greedy_bf16,
+    )
+    shards = sorted(f.name for f in out_dir.glob("model-*.safetensors"))
+    meta = {
+        "params": n_params,
+        "shards": shards,
+        "index": (out_dir / "model.safetensors.index.json").exists(),
+        "wrote_s": round(time.time() - t0, 0),
+        "writer": f"transformers {transformers.__version__} / torch {torch.__version__}",
+    }
+    (out_dir / "WRITER.json").write_text(json.dumps(meta, indent=1))
+    print(json.dumps(meta), flush=True)
+
+
+if __name__ == "__main__":
+    main(*sys.argv[1:])

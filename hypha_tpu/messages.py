@@ -480,9 +480,15 @@ class InferExecutorConfig:
     model: dict  # same shape as TrainExecutorConfig.model
     serve_name: str  # providers announce "serve:<serve_name>" for discovery
     max_new_tokens: int = 256  # per-request cap
-    max_batch: int = 8  # prompts per request cap
+    max_batch: int = 8  # prompts per request cap AND per coalesced decode
     temperature: float = 0.0  # default sampling (request may override)
     top_k: int | None = None
+    # Cross-request batching window: concurrent greedy requests arriving
+    # within this many ms share one prefill+decode (0 = coalesce only
+    # already-queued requests; negative = independent decodes, the
+    # pre-batching behavior). Additive field: absent on the wire = default,
+    # so old peers interop.
+    batch_window_ms: float = 4.0
 
 
 @register

@@ -427,3 +427,86 @@ def test_serving_supervisor_redeploys_on_job_failure():
             await n.stop()
 
     run(main())
+
+
+def test_concurrent_requests_coalesce_into_one_decode():
+    """N concurrent clients with compatible sampling state must share ONE
+    prefill+decode (VERDICT r3 weak #3): the batching window coalesces
+    them, and per-request responses still match the independent result."""
+    async def main():
+        hub = MemoryTransport()
+        gw = Node(hub.shared(), peer_id="gw", registry_server=True)
+        await gw.start()
+        worker = Node(hub.shared(), peer_id="w", bootstrap=[gw.listen_addrs[0]])
+        client = Node(hub.shared(), peer_id="c", bootstrap=[gw.listen_addrs[0]])
+        await worker.start(); await client.start()
+        await worker.wait_for_bootstrap(5); await client.wait_for_bootstrap(5)
+
+        ex = InProcessInferExecutor(worker)
+        # Window wide enough that 6 concurrent submits always land in it,
+        # even on a loaded single-core CI box.
+        execution = await ex.execute(
+            "job-batch-1", _spec("co", max_batch=8, batch_window_ms=200.0), "s"
+        )
+        # Warm up (waits for model load; its own decode).
+        warm = await generate_remote(client, "co", [[7, 7]], 3)
+
+        prompts = [[i + 1, i + 2] for i in range(6)]
+        results = await asyncio.gather(
+            *(generate_remote(client, "co", [p], 3) for p in prompts)
+        )
+        batcher = ex.batchers["job-batch-1"]
+        assert batcher.requests == 7  # warmup + 6
+        # 6 concurrent requests -> exactly one additional decode
+        assert batcher.decodes == 2, f"expected coalescing, got {batcher.decodes}"
+        assert batcher.batched_prompts == 6
+        # responses split back correctly: each must equal the independent run
+        solo = await generate_remote(client, "co", [prompts[2]], 3)
+        assert results[2][0] == solo[0]
+        assert all(len(r) == 1 and len(r[0]) == 3 for r in results)
+
+        # incompatible sampling state (different n_new) never merges
+        a, b = await asyncio.gather(
+            generate_remote(client, "co", [[1, 2]], 3),
+            generate_remote(client, "co", [[3, 4]], 4),
+        )
+        assert len(a[0]) == 3 and len(b[0]) == 4
+
+        # cancel fails queued work instead of hanging clients
+        await execution.cancel()
+        with pytest.raises(RequestError):
+            await client.request(
+                "w", PROTOCOL_GENERATE,
+                GenerateRequest(serve_name="co", prompts=[[1]]),
+                timeout=5,
+            )
+        await client.stop(); await worker.stop(); await gw.stop()
+
+    run(main())
+
+
+def test_batcher_splits_oversized_and_respects_cap():
+    """A bucket never exceeds max_batch prompts per decode; overflow starts
+    a fresh bucket rather than failing or over-batching."""
+    from hypha_tpu.worker.batcher import RequestBatcher
+
+    async def main():
+        calls: list[int] = []
+
+        def runner(prompts, n_new, temperature, top_k, seed):
+            calls.append(len(prompts))
+            return [[0] * n_new for _ in prompts]
+
+        b = RequestBatcher(runner, max_batch=4, window_s=0.05)
+        outs = await asyncio.gather(
+            *(b.submit([[i]], 2, 0.0, None, 0) for i in range(10))
+        )
+        assert all(len(o) == 1 and o[0] == [0, 0] for o in outs)
+        assert sum(calls) == 10
+        assert max(calls) <= 4
+        assert b.decodes == len(calls) <= 4  # 10 prompts / cap 4 -> >=3 decodes
+        b.close()
+        with pytest.raises(RuntimeError):
+            await b.submit([[1]], 2, 0.0, None, 0)
+
+    run(main())
