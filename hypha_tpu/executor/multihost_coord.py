@@ -1,0 +1,261 @@
+"""Multi-process (pod) coordination for the DiLoCo training executor.
+
+One replica's mesh can span several ``jax.distributed`` processes
+(parallel/multihost.py). In the multi-controller model every process must
+dispatch the SAME jit computations in the same order, but only one process
+should own the control plane — the bridge session, data fetching, delta
+shipping, scheduler heartbeats. This module makes that split:
+
+  * **leader** (process 0): runs the ordinary ``run_training`` loop inside
+    the worker runtime; before every collective-bearing action it
+    broadcasts an opcode + payload so followers mirror the dispatch.
+  * **followers** (process 1..n-1): run :func:`run_training_follower` — a
+    compute daemon that needs NO job foreknowledge: the init broadcast
+    carries the job spec, initial params/optimizer state, and the first
+    batch; afterwards each STEP/MERGE opcode drives one mirrored dispatch.
+
+Transport is ``jax.experimental.multihost_utils.broadcast_one_to_all``
+over the jax.distributed runtime itself (no second network stack): a
+fixed-shape [op, nbytes] header, then an npz-encoded byte payload. The
+reference has no equivalent — its replicas are single torch processes
+(NCCL process groups stay inside one executor); pod-as-one-replica is the
+TPU-native scale story (SURVEY §2.8, BASELINE north star).
+"""
+
+from __future__ import annotations
+
+import io
+import json
+import logging
+from typing import Any
+
+import numpy as np
+
+__all__ = [
+    "HostCoordinator",
+    "LeaderCoordination",
+    "run_training_follower",
+    "OP_INIT",
+    "OP_STEP",
+    "OP_MERGE",
+    "OP_DONE",
+]
+
+log = logging.getLogger("hypha.executor.multihost")
+
+OP_INIT, OP_STEP, OP_MERGE, OP_DONE = 0, 1, 2, 3
+
+
+def _encode(payload: dict[str, np.ndarray]) -> bytes:
+    buf = io.BytesIO()
+    np.savez(buf, **payload)
+    return buf.getvalue()
+
+
+def _decode(data: bytes) -> dict[str, np.ndarray]:
+    with np.load(io.BytesIO(data), allow_pickle=False) as z:
+        return {k: z[k] for k in z.files}
+
+
+class HostCoordinator:
+    """Broadcast channel from process 0 to all processes.
+
+    Two ``broadcast_one_to_all`` rounds per message: a fixed-shape header
+    (opcode, payload length) so followers can allocate a matching buffer,
+    then the payload bytes. Every process must call send/recv in lockstep —
+    which is exactly the property the executor protocol maintains.
+    """
+
+    def __init__(self) -> None:
+        import jax
+
+        self.rank = jax.process_index()
+        self.n_processes = jax.process_count()
+
+    def send(self, op: int, payload: dict[str, np.ndarray] | None) -> None:
+        assert self.rank == 0, "only the leader sends"
+        self._exchange(op, payload)
+
+    def recv(self) -> tuple[int, dict[str, np.ndarray] | None]:
+        assert self.rank != 0, "the leader does not recv"
+        return self._exchange(0, None)
+
+    def _exchange(
+        self, op: int, payload: dict[str, np.ndarray] | None
+    ) -> tuple[int, dict[str, np.ndarray] | None]:
+        from jax.experimental import multihost_utils as mhu
+
+        data = _encode(payload) if (self.rank == 0 and payload) else b""
+        header = np.array([op, len(data)], np.int64)
+        header = np.asarray(mhu.broadcast_one_to_all(header))
+        op, nbytes = int(header[0]), int(header[1])
+        if nbytes == 0:
+            return op, None
+        buf = (
+            np.frombuffer(data, np.uint8)
+            if self.rank == 0
+            else np.zeros(nbytes, np.uint8)
+        )
+        buf = np.asarray(mhu.broadcast_one_to_all(buf))
+        return op, (None if self.rank == 0 else _decode(buf.tobytes()))
+
+
+def _flatten_prefixed(prefix: str, tree: Any) -> dict[str, np.ndarray]:
+    from .serialization import flatten_tree
+
+    import jax
+
+    return {
+        f"{prefix}{k}": np.asarray(v)
+        for k, v in flatten_tree(jax.device_get(tree)).items()
+    }
+
+
+def _unflatten_prefixed(prefix: str, payload: dict, like: Any) -> Any:
+    from .serialization import unflatten_like
+
+    flat = {
+        k[len(prefix):]: v for k, v in payload.items() if k.startswith(prefix)
+    }
+    return unflatten_like(flat, like)
+
+
+class LeaderCoordination:
+    """The leader-side hooks run_training calls at each protocol point."""
+
+    def __init__(self) -> None:
+        self.mh = HostCoordinator()
+
+    def init(self, spec_json: str, state, first_batch: dict) -> None:
+        payload = {
+            "__spec__": np.frombuffer(spec_json.encode(), np.uint8),
+            "__step__": np.asarray(int(state.step), np.int64),
+        }
+        payload.update(_flatten_prefixed("p/", state.params))
+        payload.update(_flatten_prefixed("o/", state.opt_state))
+        payload.update({f"b/{k}": np.asarray(v) for k, v in first_batch.items()})
+        self.mh.send(OP_INIT, payload)
+
+    def step(self, batch: dict) -> None:
+        self.mh.send(OP_STEP, {f"b/{k}": np.asarray(v) for k, v in batch.items()})
+
+    def merge(self, flat_update: dict[str, np.ndarray]) -> None:
+        self.mh.send(OP_MERGE, {f"u/{k}": np.asarray(v) for k, v in flat_update.items()})
+
+    def done(self) -> None:
+        self.mh.send(OP_DONE, None)
+
+
+def run_training_follower() -> int:
+    """Compute daemon for processes 1..n-1 of a multi-process replica.
+
+    Blocks on the init broadcast, mirrors every STEP/MERGE dispatch, and
+    returns the number of merges (outer rounds) completed when the leader
+    signals DONE.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from .. import messages
+    from ..messages import JobSpec, Loss
+    from .diloco import merge_update
+    from .train import TrainState, build_optimizer, make_train_step
+
+    mh = HostCoordinator()
+    op, payload = mh.recv()
+    if op == OP_DONE:
+        return 0
+    assert op == OP_INIT, f"expected INIT, got opcode {op}"
+    assert payload is not None
+    spec = messages.from_json_dict(
+        json.loads(bytes(payload["__spec__"]).decode())
+    )
+    assert isinstance(spec, JobSpec)
+    cfg = spec.executor.train
+    assert cfg is not None
+
+    from ..models import Mixtral, build_model
+    from ..models.hf import _DECODER_TYPES
+    from ..models.registry import resolve_model_type
+    from .training import _build_mesh, _non_causal_types
+
+    first_batch = {
+        k[2:]: payload[k] for k in payload if k.startswith("b/")
+    }
+    model_spec = dict(cfg.model)
+    model, _ = build_model(model_spec)
+    model_type = resolve_model_type(
+        model_spec.get("model_type", messages.ModelType.CAUSAL_LM)
+    )
+    causal_lm = model_type not in _non_causal_types()
+    has_aux = isinstance(model, Mixtral)
+    inputs = (
+        first_batch["input_ids"] if "input_ids" in first_batch
+        else first_batch["inputs"]
+    )
+    params = model.init(jax.random.key(int(model_spec.get("seed", 0))), inputs)
+    state = TrainState.create(
+        params, build_optimizer(cfg.optimizer, cfg.scheduler)
+    )
+    state = state.replace(
+        params=_unflatten_prefixed("p/", payload, state.params),
+        opt_state=_unflatten_prefixed("o/", payload, state.opt_state),
+        step=jnp.asarray(int(payload["__step__"]), jnp.int32),
+    )
+
+    mesh = _build_mesh(cfg.sharding)
+    assert mesh is not None, "a multi-process replica requires a sharding config"
+    from jax.sharding import NamedSharding
+
+    from ..parallel import param_sharding
+    from ..parallel.sharding import batch_spec
+
+    state = jax.device_put(state, param_sharding(state, mesh))
+    b_sharding = NamedSharding(mesh, batch_spec())
+
+    def place(batch):
+        # make_array_from_callback works identically on every process of a
+        # multi-controller mesh (device_put alone may refuse shardings that
+        # span non-addressable devices).
+        return {
+            k: jax.make_array_from_callback(
+                v.shape, b_sharding, lambda idx, v=v: v[idx]
+            )
+            for k, v in batch.items()
+        }
+
+    step = make_train_step(
+        model.apply,
+        cfg.loss or Loss.CROSS_ENTROPY,
+        causal_lm=causal_lm,
+        has_aux=has_aux,
+        dropout_seed=int(model_spec.get("seed", 0)),
+        labels_aligned=getattr(model, "model_type", None) in _DECODER_TYPES,
+        loss_override=getattr(model, "custom_loss", None),
+    )
+
+    def snapshot(tree):
+        return jax.tree.map(jnp.copy, tree)
+
+    anchor = snapshot(state.params)
+    rounds = 0
+    while True:
+        op, payload = mh.recv()
+        if op == OP_DONE:
+            log.info("follower %d done after %d rounds", mh.rank, rounds)
+            return rounds
+        if op == OP_STEP:
+            assert payload is not None
+            batch = {k[2:]: payload[k] for k in payload if k.startswith("b/")}
+            state, _metrics = step(state, place(batch))
+        elif op == OP_MERGE:
+            assert payload is not None
+            # The leader computed Δθ locally to ship it; that op has no
+            # cross-process collective, so followers need not (and do not)
+            # mirror it — only the merge itself runs here.
+            update = _unflatten_prefixed("u/", payload, state.params)
+            state = state.replace(params=merge_update(state.params, update))
+            anchor = snapshot(state.params)
+            rounds += 1
+        else:
+            raise RuntimeError(f"unknown opcode {op}")

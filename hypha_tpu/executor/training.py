@@ -233,48 +233,104 @@ def run_training(
                     ckpt_dir, r_step, r_round,
                 )
 
-    loss_kind = cfg.loss or Loss.CROSS_ENTROPY
-    from ..models.hf import _DECODER_TYPES
+    # Multi-process replica (pod-as-one-replica): process 0 — this loop —
+    # owns the control plane and broadcasts each collective-bearing action
+    # so follower processes (executor.multihost_coord.run_training_follower)
+    # mirror the dispatches over the same global mesh. The init broadcast
+    # runs BEFORE mesh placement: it device_gets the state, which must
+    # still be host/single-device arrays (global arrays spanning another
+    # process cannot be fetched locally).
+    mh = None
+    if jax.process_count() > 1:
+        if mesh is None:
+            # Fail fast HERE: the follower asserts a mesh exists, and a
+            # leader training unsharded while followers expect lockstep
+            # dispatches would deadlock on the first step broadcast.
+            raise ValueError(
+                f"job {spec.job_id}: {jax.process_count()} processes need a "
+                f"sharding config spanning all {len(jax.devices())} global "
+                f"devices; got {cfg.sharding!r}"
+            )
+        from .multihost_coord import LeaderCoordination
 
-    step = make_train_step(
-        model.apply,
-        loss_kind,
-        causal_lm=causal_lm,
-        has_aux=has_aux,
-        # Models that declare an ``rng`` kwarg (the hf family) train with
-        # live dropout, keyed per-step from the job seed — the reference
-        # trains its torch models in train() mode (training.py:106-116).
-        dropout_seed=int(dict(cfg.model).get("seed", 0)),
-        # Seq2seq hf models shift labels into decoder inputs internally, so
-        # their logits are already aligned with the labels stream.
-        labels_aligned=getattr(model, "model_type", None) in _DECODER_TYPES,
-        # Heads-family tasks with structured objectives (CTC, detection,
-        # contrastive, span…) carry their own loss.
-        loss_override=getattr(model, "custom_loss", None),
-    )
+        mh = LeaderCoordination()
+        mh.init(json.dumps(messages.to_json_dict(spec)), state, first_batch)
+        log.info(
+            "multihost leader: %d processes, %d global devices",
+            jax.process_count(), len(jax.devices()),
+        )
 
-    if mesh is not None:
-        from jax.sharding import NamedSharding
+    try:
+        # From the init broadcast on, ANY leader exit without OP_DONE
+        # leaves followers blocked in recv — this guard plus the loop's
+        # finally below cover every path.
+        loss_kind = cfg.loss or Loss.CROSS_ENTROPY
+        from ..models.hf import _DECODER_TYPES
 
-        from ..parallel import param_sharding
-        from ..parallel.sharding import batch_spec
+        step = make_train_step(
+            model.apply,
+            loss_kind,
+            causal_lm=causal_lm,
+            has_aux=has_aux,
+            # Models that declare an ``rng`` kwarg (the hf family) train
+            # with live dropout, keyed per-step from the job seed — the
+            # reference trains its torch models in train() mode
+            # (training.py:106-116).
+            dropout_seed=int(dict(cfg.model).get("seed", 0)),
+            # Seq2seq hf models shift labels into decoder inputs
+            # internally, so their logits are already aligned with the
+            # labels stream.
+            labels_aligned=getattr(model, "model_type", None) in _DECODER_TYPES,
+            # Heads-family tasks with structured objectives (CTC,
+            # detection, contrastive, span…) carry their own loss.
+            loss_override=getattr(model, "custom_loss", None),
+        )
 
-        state = jax.device_put(state, param_sharding(state, mesh))
-        batch_sharding = NamedSharding(mesh, batch_spec())
+        if mesh is not None:
+            from jax.sharding import NamedSharding
 
-        def place(batch):
-            return {k: jax.device_put(v, batch_sharding) for k, v in batch.items()}
-    else:
+            from ..parallel import param_sharding
+            from ..parallel.sharding import batch_spec
 
-        def place(batch):
-            return batch
+            state = jax.device_put(state, param_sharding(state, mesh))
+            batch_sharding = NamedSharding(mesh, batch_spec())
 
-    def snapshot(tree):
-        # A deep copy, NOT an alias: the jitted step donates its input state,
-        # so aliased buffers would be deleted on the next step.
-        return jax.tree.map(jnp.copy, tree)
+            if mh is not None:
 
-    anchor = snapshot(state.params)  # θ₀: the round anchor (training.py:58-60)
+                def place(batch):
+                    # Multi-controller: build global arrays shard-by-shard
+                    # (device_put may refuse shardings spanning devices
+                    # this process cannot address). Every process holds the
+                    # same host batch — the leader just broadcast it.
+                    return {
+                        k: jax.make_array_from_callback(
+                            np.shape(v), batch_sharding,
+                            lambda idx, v=v: np.asarray(v)[idx],
+                        )
+                        for k, v in batch.items()
+                    }
+            else:
+
+                def place(batch):
+                    return {
+                        k: jax.device_put(v, batch_sharding)
+                        for k, v in batch.items()
+                    }
+        else:
+
+            def place(batch):
+                return batch
+
+        def snapshot(tree):
+            # A deep copy, NOT an alias: the jitted step donates its input
+            # state, so aliased buffers would be deleted on the next step.
+            return jax.tree.map(jnp.copy, tree)
+
+        anchor = snapshot(state.params)  # θ₀: the round anchor
+    except BaseException:
+        if mh is not None:
+            mh.done()  # followers must never hang on a dead leader
+        raise
     result = TrainResult()
     countdown: int | None = None
     round_num = 0
@@ -314,6 +370,8 @@ def run_training(
             event = next(events)
         update_file = work_dir / event["path"]
         flat = load_flat(update_file)
+        if mh is not None:
+            mh.merge(flat)  # followers mirror the merge dispatch
         update = unflatten_like(flat, state.params)
         state = state.replace(params=merge_update(state.params, update))
         anchor = snapshot(state.params)
@@ -341,38 +399,44 @@ def run_training(
         return resp.kind == ProgressResponseKind.CONTINUE
 
     t0 = time.monotonic()
-    for batch in batches():
-        if should_stop is not None and should_stop():
-            log.info("cooperative stop requested; ending training loop")
-            break
-        state, metrics = step(state, place(batch))
-        loss = float(metrics["loss"])
-        round_losses.append(loss)
-        result.losses.append(loss)
-        result.batches += 1
-        round_samples += cfg.batch_size
+    try:
+        for batch in batches():
+            if should_stop is not None and should_stop():
+                log.info("cooperative stop requested; ending training loop")
+                break
+            if mh is not None:
+                mh.step(batch)  # followers dispatch the same step
+            state, metrics = step(state, place(batch))
+            loss = float(metrics["loss"])
+            round_losses.append(loss)
+            result.losses.append(loss)
+            result.batches += 1
+            round_samples += cfg.batch_size
 
-        resp = session.send_status(
-            Progress(
-                kind=ProgressKind.STATUS,
-                job_id=spec.job_id,
-                batch_size=cfg.batch_size,
+            resp = session.send_status(
+                Progress(
+                    kind=ProgressKind.STATUS,
+                    job_id=spec.job_id,
+                    batch_size=cfg.batch_size,
+                )
             )
-        )
-        if resp.kind == ProgressResponseKind.DONE:
-            break
-        if resp.kind == ProgressResponseKind.SCHEDULE_UPDATE:
-            countdown = resp.counter
-        if countdown is not None:
-            if countdown <= 0:
-                countdown = None
-                if not do_update():
-                    break
-            else:
-                countdown -= 1
-        if max_batches is not None and result.batches >= max_batches:
-            log.warning("max_batches=%d reached; stopping", max_batches)
-            break
+            if resp.kind == ProgressResponseKind.DONE:
+                break
+            if resp.kind == ProgressResponseKind.SCHEDULE_UPDATE:
+                countdown = resp.counter
+            if countdown is not None:
+                if countdown <= 0:
+                    countdown = None
+                    if not do_update():
+                        break
+                else:
+                    countdown -= 1
+            if max_batches is not None and result.batches >= max_batches:
+                log.warning("max_batches=%d reached; stopping", max_batches)
+                break
+    finally:
+        if mh is not None:
+            mh.done()  # followers must never hang on a dead leader
     log.info(
         "training done: %d rounds, %d batches, %.1fs, last loss %.4f",
         result.rounds, result.batches, time.monotonic() - t0, result.last_loss,

