@@ -28,6 +28,7 @@ __all__ = [
     "make_lr_schedule",
     "build_optimizer",
     "compute_loss",
+    "make_loss_fn",
     "make_train_step",
 ]
 
@@ -169,6 +170,46 @@ def make_train_step(
     replaces the ``compute_loss`` selector entirely.
     Returns ``step(state, batch) -> (state, metrics)``.
     """
+    loss_fn = make_loss_fn(
+        apply_fn,
+        loss_kind,
+        causal_lm=causal_lm,
+        has_aux=has_aux,
+        dropout_seed=dropout_seed,
+        labels_aligned=labels_aligned,
+        loss_override=loss_override,
+    )
+
+    def step(state: TrainState, batch) -> tuple:
+        (total, (loss, aux)), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+            state.params, batch, state.step
+        )
+        new_state = state.apply_gradients(grads)
+        metrics = {
+            "loss": loss,
+            "total_loss": total,
+            "aux_loss": aux,
+            "grad_norm": optax.global_norm(grads),
+        }
+        return new_state, metrics
+
+    return jax.jit(step, donate_argnums=(0,) if donate else ())
+
+
+def make_loss_fn(
+    apply_fn: Callable,
+    loss_kind: Loss = Loss.CROSS_ENTROPY,
+    *,
+    causal_lm: bool = True,
+    has_aux: bool = False,
+    dropout_seed: int | None = None,
+    labels_aligned: bool = False,
+    loss_override: Callable | None = None,
+) -> Callable:
+    """``loss_fn(params, batch, step_no) -> (total, (loss, aux))`` with the
+    full label-layout semantics documented on :func:`make_train_step`.
+    Shared by the full-parameter step and the LoRA step (executor.lora), so
+    the two paths can never diverge on label shifting or loss selection."""
     import inspect
 
     try:
@@ -218,17 +259,4 @@ def make_train_step(
         loss = compute_loss(loss_kind, logits, labels)
         return loss + aux, (loss, aux)
 
-    def step(state: TrainState, batch) -> tuple:
-        (total, (loss, aux)), grads = jax.value_and_grad(loss_fn, has_aux=True)(
-            state.params, batch, state.step
-        )
-        new_state = state.apply_gradients(grads)
-        metrics = {
-            "loss": loss,
-            "total_loss": total,
-            "aux_loss": aux,
-            "grad_norm": optax.global_norm(grads),
-        }
-        return new_state, metrics
-
-    return jax.jit(step, donate_argnums=(0,) if donate else ())
+    return loss_fn

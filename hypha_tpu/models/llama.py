@@ -64,6 +64,25 @@ class LlamaConfig:
     mlp_act: str = "silu"  # "silu" (Llama) | "gelu_tanh" (Gemma GeGLU)
     rms_offset: bool = False  # Gemma RMSNorm: x * (1 + weight)
     embed_scale: bool = False  # Gemma: embeddings scaled by sqrt(hidden)
+    # LoRA adapters (executor/lora.py): rank 0 = off. Applied as the
+    # runtime two-matmul form y = xW + (xA)B·(α/r) — never materializing
+    # W+ΔW, so a 7B fine-tune's grads/optimizer touch only the adapters.
+    lora_rank: int = 0
+    lora_alpha: float = 16.0
+    lora_targets: tuple = ("q_proj", "v_proj")
+
+    _LORA_SUPPORTED = frozenset({"q_proj", "k_proj", "v_proj", "o_proj"})
+
+    def __post_init__(self):
+        if self.lora_rank > 0:
+            bad = set(self.lora_targets) - self._LORA_SUPPORTED
+            if bad or not self.lora_targets:
+                # A typo'd target would silently create ZERO adapters and
+                # train nothing — fail at construction instead.
+                raise ValueError(
+                    f"lora_targets {sorted(bad) or '(empty)'} unsupported; "
+                    f"choose from {sorted(self._LORA_SUPPORTED)}"
+                )
 
     @classmethod
     def llama2_7b(cls) -> "LlamaConfig":
@@ -149,6 +168,30 @@ class _Attention(nn.Module):
     decode: bool = False  # autoregressive serving: KV cache in the "cache"
     decode_len: int = 0  # static cache capacity (prompt + new tokens)
 
+    def _proj(self, x, features, use_bias, dtype, name):
+        """Dense projection, plus the low-rank LoRA path when enabled.
+
+        B starts at zero so a freshly-initialized adapter is an exact
+        no-op; the (xA)B form keeps autodiff low-rank — dL/dA, dL/dB
+        never touch a [in, out]-shaped buffer.
+        """
+        cfg = self.config
+        y = nn.Dense(features, use_bias=use_bias, dtype=dtype, name=name)(x)
+        if cfg.lora_rank > 0 and name in cfg.lora_targets:
+            r = cfg.lora_rank
+            a = self.param(
+                f"{name}_lora_a", nn.initializers.normal(0.02),
+                (x.shape[-1], r), jnp.float32,
+            )
+            b = self.param(
+                f"{name}_lora_b", nn.initializers.zeros, (r, features),
+                jnp.float32,
+            )
+            y = y + ((x @ a.astype(dtype)) @ b.astype(dtype)) * (
+                cfg.lora_alpha / r
+            )
+        return y
+
     @nn.compact
     def __call__(self, x, cos, sin):
         import jax
@@ -158,9 +201,9 @@ class _Attention(nn.Module):
         B, S, E = x.shape
         hd = cfg.head_dim
         bias = cfg.attn_bias
-        q = nn.Dense(cfg.num_heads * hd, use_bias=bias, dtype=dtype, name="q_proj")(x)
-        k = nn.Dense(cfg.num_kv_heads * hd, use_bias=bias, dtype=dtype, name="k_proj")(x)
-        v = nn.Dense(cfg.num_kv_heads * hd, use_bias=bias, dtype=dtype, name="v_proj")(x)
+        q = self._proj(x, cfg.num_heads * hd, bias, dtype, "q_proj")
+        k = self._proj(x, cfg.num_kv_heads * hd, bias, dtype, "k_proj")
+        v = self._proj(x, cfg.num_kv_heads * hd, bias, dtype, "v_proj")
         q = q.reshape(B, S, cfg.num_heads, hd)
         k = k.reshape(B, S, cfg.num_kv_heads, hd)
         v = v.reshape(B, S, cfg.num_kv_heads, hd)
@@ -197,7 +240,7 @@ class _Attention(nn.Module):
                 window=cfg.sliding_window,
             )
             attn = attn.reshape(B, S, cfg.num_heads * hd)
-            return nn.Dense(E, use_bias=False, dtype=dtype, name="o_proj")(attn)
+            return self._proj(attn, E, False, dtype, "o_proj")
         q = apply_rope(q, cos, sin)
         k = apply_rope(k, cos, sin)
         window = cfg.sliding_window
@@ -221,7 +264,7 @@ class _Attention(nn.Module):
         else:
             attn = (self.attn_impl or dot_product_attention)(q, k, v, causal=True)
         attn = attn.reshape(B, S, cfg.num_heads * hd)
-        return nn.Dense(E, use_bias=False, dtype=dtype, name="o_proj")(attn)
+        return self._proj(attn, E, False, dtype, "o_proj")
 
 
 class _MLP(nn.Module):
