@@ -1,0 +1,161 @@
+"""Assemble the distributed-layer benchmark artifact (DISTBENCH_r{N}.json).
+
+Runs the fabric stream-throughput bench (several reps — the shared
+single-core host is noisy), the native PS outer step, the torch-parity
+eval, and the wire-codec microbench, and writes one self-describing JSON
+with reference context. Run: python benchmarks/distbench.py [--round N]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import statistics
+import subprocess
+import sys
+import time
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+BENCH = REPO / "benchmarks"
+
+
+def _run_json(script: str, *args: str, timeout: int = 600) -> dict:
+    out = subprocess.run(
+        [sys.executable, str(BENCH / script), *args],
+        capture_output=True, text=True, timeout=timeout,
+    )
+    line = out.stdout.strip().splitlines()[-1]
+    return json.loads(line)
+
+
+def _codec_bench() -> dict:
+    sys.path.insert(0, str(REPO))
+    from hypha_tpu import codec, messages
+
+    cfg = messages.TrainExecutorConfig(
+        model={"model_type": messages.ModelType.CAUSAL_LM,
+               "family": "gpt2", "config": {"n_embd": 768}},
+        data=messages.Fetch(messages.Reference.from_scheduler("sched", "ds")),
+        updates=messages.Send(messages.Reference.from_peers(["ps"], "updates")),
+        results=messages.Receive(messages.Reference.from_peers(["ps"], "results")),
+        optimizer=messages.Adam(lr=1e-4),
+        batch_size=16,
+        sharding={"dp": 2, "tp": 4},
+    )
+    msg = messages.DispatchJob(
+        lease_id="l1",
+        spec=messages.JobSpec(
+            job_id="bench-job",
+            executor=messages.Executor(kind="train", name="training", train=cfg),
+        ),
+    )
+    payload = messages.encode(msg)
+    # The codec comparison runs on the WIRE OBJECT (the nested dict the
+    # messages layer produces) — measuring messages.encode would mix the
+    # dataclass→dict conversion into the codec number.
+    obj = codec.loads(payload)
+
+    def rate(fn, reps=20000):
+        t0 = time.perf_counter()
+        for _ in range(reps):
+            fn()
+        return round(reps / (time.perf_counter() - t0))
+
+    native = {
+        "encode_msgs_per_sec": rate(lambda: codec.dumps(obj)),
+        "decode_msgs_per_sec": rate(lambda: codec.loads(payload)),
+    }
+    enc_py, dec_py = codec._py_dumps, codec._py_loads
+    python = {
+        "encode_msgs_per_sec": rate(lambda: enc_py(obj), 2000),
+        "decode_msgs_per_sec": rate(lambda: dec_py(payload), 2000),
+    }
+    return {
+        "metric": "cbor_codec_throughput",
+        "message": f"representative DispatchJob ({len(payload)} B)",
+        "native": native,
+        "python": python,
+        "speedup_encode": round(
+            native["encode_msgs_per_sec"] / python["encode_msgs_per_sec"], 1
+        ),
+        "speedup_decode": round(
+            native["decode_msgs_per_sec"] / python["decode_msgs_per_sec"], 1
+        ),
+        "note": "native C++ CPython extension vs the portable Python "
+                "fallback; parity pinned by differential fuzzing "
+                "(tests/test_core.py)",
+    }
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--round", type=int, default=4)
+    ap.add_argument("--stream-reps", type=int, default=5)
+    args = ap.parse_args()
+
+    reps = []
+    for _ in range(args.stream_reps):
+        reps.append(_run_json("stream_throughput.py", "--mb", "1024", "--streams", "8"))
+    values = sorted(r["value"] for r in reps)
+    median = statistics.median(values)
+    stream = dict(reps[0])
+    stream.update(
+        value=round(median, 1),
+        vs_baseline=round(median / 1024.0, 3),
+        reps=values,
+        best=values[-1],
+        protocol="median of %d reps, 1 GiB over 8 parallel push streams"
+        % args.stream_reps,
+    )
+
+    outer = _run_json("outer_step_bench.py")
+    parity = _run_json("eval_parity.py")
+    codec_r = _codec_bench()
+
+    artifact = {
+        "round": args.round,
+        "host_note": (
+            "single-CPU-core container; loopback TCP; sender uses kernel "
+            "sendfile, receiver 4 MiB buffered reads + thread-offloaded writes "
+            "(r4: the asyncio 64 KiB reader limit was the previous first-order "
+            "bottleneck; an inline-write variant measured ~920 MB/s median but "
+            "blocks the worker event loop, so the thread hop stays). Remaining "
+            "gap to the reference's ~1 GB/s loopback claim is the receiver's "
+            "kernel->user->page-cache double copy plus the executor hop, which "
+            "one core must fund for all 8 streams and both event loops; on any "
+            "multi-core host the sender and receiver no longer share the copy "
+            "budget."
+        ),
+        "reference_context": {
+            "stream_throughput": (
+                "reference RFC claims 50-60 MB/s stock libp2p, ~1 GB/s "
+                "optimized on loopback (rfc/2025-03-25-libp2p_network_stack"
+                ".md:9,17); vs_baseline is against the 1 GB/s optimized claim"
+            ),
+            "ps_outer_step": (
+                "no reference number exists; vs_baseline is native-vs-python "
+                "speedup on the same box"
+            ),
+            "eval_loss_parity": (
+                "same initial weights (converted), same data/optimizer: our "
+                "jitted JAX train step's loss trajectory vs the reference-"
+                "style torch AdamW loop (training.py:106-116); value = max "
+                "abs loss diff over the run"
+            ),
+        },
+        "results": {
+            "stream_throughput": stream,
+            "ps_outer_step": outer,
+            "eval_loss_parity": parity,
+            "wire_codec": codec_r,
+        },
+    }
+    out = REPO / f"DISTBENCH_r{args.round:02d}.json"
+    out.write_text(json.dumps(artifact, indent=1))
+    print(json.dumps(artifact["results"]["stream_throughput"]))
+    print(f"wrote {out}")
+
+
+if __name__ == "__main__":
+    main()

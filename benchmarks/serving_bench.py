@@ -84,7 +84,10 @@ def _concurrent_clients(n_clients: int, batched: bool, model_spec=None) -> dict:
         generate_remote,
     )
 
-    PROMPT_LEN, NEW = 16, 32
+    # 128 new tokens: long enough that the comparison measures DECODE
+    # throughput — at 32 tokens both sides were dominated by the tunnel's
+    # per-dispatch latency and the ratio understated the batching win.
+    PROMPT_LEN, NEW = 16, 128
     if model_spec is None:
         model_spec = {"family": "gpt2", "config": {
             "vocab_size": 50257, "n_positions": 1024, "n_embd": 768,
@@ -118,8 +121,15 @@ def _concurrent_clients(n_clients: int, batched: bool, model_spec=None) -> dict:
         execution = await ex.execute("bench-serve", spec, "s")
         prompts = [[(7 * i + j) % vocab for j in range(PROMPT_LEN)]
                    for i in range(n_clients)]
-        # Warm both decode shapes out of the measurement (first jit is
-        # tens of seconds on the tunneled chip).
+        # Model load + first jit is tens of seconds on the tunneled chip —
+        # longer than generate_remote's 30 s discovery cap — so wait for
+        # the serve announcement explicitly before the warmup.
+        deadline = time.perf_counter() + 600
+        while time.perf_counter() < deadline:
+            if await client.find_providers("serve:bench"):
+                break
+            await asyncio.sleep(1.0)
+        # Warm both decode shapes out of the measurement.
         await generate_remote(client, "bench", [prompts[0]], NEW, timeout=600)
         if batched:
             await asyncio.gather(*(

@@ -43,6 +43,12 @@ __all__ = [
 # (crates/network/src/stream_pull.rs:28); RPC bodies get 32 MiB headroom for
 # large specs.
 MAX_FRAME = 32 * 1024 * 1024
+# StreamReader buffer limit. asyncio's 64 KiB default caps every read() at
+# 64 KiB, which on the bulk-push path costs one event-loop pass + one
+# worker-thread hop per 64 KiB — a first-order throughput limit on a
+# single-core host (measured in DISTBENCH: the 4 MiB limit nearly doubled
+# loopback stream throughput).
+STREAM_BUFFER_LIMIT = 4 * 1024 * 1024
 
 _LEN = struct.Struct("<Q")
 
@@ -289,7 +295,8 @@ class TcpTransport(Transport):
                     pass
 
         server = await asyncio.start_server(
-            handle, host, int(port), ssl=self._server_ssl
+            handle, host, int(port), ssl=self._server_ssl,
+            limit=STREAM_BUFFER_LIMIT,
         )
         self._servers.append(server)
         bound = server.sockets[0].getsockname()
@@ -304,7 +311,8 @@ class TcpTransport(Transport):
             # mTLS fork does (rfc/2025-05-30_mtls.md).
             server_hostname = ""
         reader, writer = await asyncio.open_connection(
-            host, int(port), ssl=self._client_ssl, server_hostname=server_hostname
+            host, int(port), ssl=self._client_ssl,
+            server_hostname=server_hostname, limit=STREAM_BUFFER_LIMIT,
         )
         return _TcpStream(reader, writer)
 

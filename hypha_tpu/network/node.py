@@ -389,10 +389,14 @@ class PushStream:
         self.finish()
         return b"".join(parts)
 
-    async def save_to(self, path, chunk: int = 1 << 20) -> int:
+    async def save_to(self, path, chunk: int = 1 << 22) -> int:
         """Stream to disk without buffering the whole payload (the reference
         file-mediates all tensor transfers, bridge.rs:392-504). File writes
-        run in a thread so the event loop is never stalled."""
+        run in a thread so the event loop is never stalled — a worker's
+        loop also carries heartbeats and lease renewals, and a writeback-
+        throttled disk must not expire leases. (4 MiB chunks match the
+        transport's reader limit; the r4 sweep showed chunk size, not the
+        thread hop, is the first-order receiver cost.)"""
         loop = asyncio.get_running_loop()
         total = 0
         with open(path, "wb") as f:

@@ -479,14 +479,16 @@ def flash_attention(
     memory traffic). ``interpret=None`` auto-selects interpret mode off-TPU
     so tests exercise the kernels on CPU.
 
-    Default blocks come from on-chip sweeps (TPU v5e, r3): forward
-    (512, 256) — (128, 128) halved throughput, per-cell overhead dominates
-    at small tiles — and backward (512, 512), tiled independently via
-    ``block_q_bwd``/``block_k_bwd``. The tuned defaults beat the XLA dense
-    path at S=1024 and scale to the long-context shapes dense cannot even
-    compile. Explicitly passed forward tiles also govern the backward
-    (a VMEM-bounding caller keeps their bound) unless the bwd params
-    override them.
+    Default blocks come from on-chip sweeps (TPU v5e, r3+r4): forward
+    (512, 512) — (128, 128) halved throughput, per-cell overhead dominates
+    at small tiles — and backward (1024, 512), tiled independently via
+    ``block_q_bwd``/``block_k_bwd`` (the r4 sweep under the headline
+    timing protocol: fwd 512×512 + bwd 1024×512 measured 112.5k vs the r3
+    defaults' 108.1k tok/s on the GPT-2 step, MFUPROBE_r04.json). The
+    tuned defaults beat the XLA dense path at S=1024 and scale to the
+    long-context shapes dense cannot even compile. Explicitly passed
+    forward tiles also govern the backward (a VMEM-bounding caller keeps
+    their bound) unless the bwd params override them.
     """
     B, Sq, H, D = q.shape
     _, Sk, Hkv, _ = k.shape
@@ -494,7 +496,7 @@ def flash_attention(
     if block_q is None:
         block_q = _pick_block(Sq, 512)
     if block_k is None:
-        block_k = _pick_block(Sk, 256)
+        block_k = _pick_block(Sk, 512)
     if (
         block_q is None
         or block_k is None
@@ -518,7 +520,7 @@ def flash_attention(
     if block_k_bwd is not None and not _legal_block(block_k_bwd, Sk):
         raise ValueError(f"block_k_bwd={block_k_bwd} illegal for Sk={Sk}")
     if block_q_bwd is None:
-        bq = None if explicit_q else _pick_block(Sq, 512)
+        bq = None if explicit_q else _pick_block(Sq, 1024)
         block_q_bwd = block_q if bq is None else bq
     if block_k_bwd is None:
         bk = None if explicit_k else _pick_block(Sk, 512)
