@@ -25,8 +25,14 @@ def _run_json(script: str, *args: str, timeout: int = 600) -> dict:
         [sys.executable, str(BENCH / script), *args],
         capture_output=True, text=True, timeout=timeout,
     )
-    line = out.stdout.strip().splitlines()[-1]
-    return json.loads(line)
+    if out.returncode != 0:
+        raise RuntimeError(
+            f"{script} exited {out.returncode}: {out.stderr.strip()[-500:]}"
+        )
+    lines = out.stdout.strip().splitlines()
+    if not lines:
+        raise RuntimeError(f"{script} produced no output; stderr: {out.stderr[-500:]}")
+    return json.loads(lines[-1])
 
 
 def _codec_bench() -> dict:
@@ -99,15 +105,20 @@ def main() -> None:
         reps.append(_run_json("stream_throughput.py", "--mb", "1024", "--streams", "8"))
     values = sorted(r["value"] for r in reps)
     median = statistics.median(values)
-    stream = dict(reps[0])
-    stream.update(
-        value=round(median, 1),
-        vs_baseline=round(median / 1024.0, 3),
-        reps=values,
-        best=values[-1],
-        protocol="median of %d reps, 1 GiB over 8 parallel push streams"
+    # A consistent record: per-rep fields (seconds, ...) would contradict
+    # the median value, so only shared config fields survive.
+    stream = {
+        "metric": "stream_throughput",
+        "unit": "MB/s",
+        "streams": reps[0]["streams"],
+        "total_mb": reps[0]["total_mb"],
+        "value": round(median, 1),
+        "vs_baseline": round(median / 1024.0, 3),
+        "reps": values,
+        "best": values[-1],
+        "protocol": "median of %d reps, 1 GiB over 8 parallel push streams"
         % args.stream_reps,
-    )
+    }
 
     outer = _run_json("outer_step_bench.py")
     parity = _run_json("eval_parity.py")

@@ -213,7 +213,16 @@ def main() -> None:
             results[f"batch{b2}"] = {"error": f"{type(e).__name__}: {e}"[:140]}
         print(f"batch{b2}", results[f"batch{b2}"], flush=True)
 
-    (REPO / "MFUPROBE_r04.json").write_text(json.dumps(results, indent=1))
+    # MERGE into the artifact: it also carries sections this script does
+    # not produce (headline_protocol_tiles, chunked_ce — recorded by their
+    # own runs); a rerun must refresh the ablation rows without deleting
+    # the evidence behind the kernel defaults.
+    out_path = REPO / "MFUPROBE_r04.json"
+    merged = {}
+    if out_path.exists():
+        merged = json.loads(out_path.read_text())
+    merged.update(results)
+    out_path.write_text(json.dumps(merged, indent=1))
     print(json.dumps(results), flush=True)
 
 

@@ -29,6 +29,7 @@ __all__ = [
     "build_optimizer",
     "compute_loss",
     "make_loss_fn",
+    "chunked_causal_ce",
     "make_train_step",
 ]
 
@@ -137,6 +138,56 @@ def compute_loss(kind: Loss, logits: jnp.ndarray, labels: jnp.ndarray) -> jnp.nd
         x = logits.astype(jnp.float32)
         return jnp.mean(jnp.maximum(x, 0) - x * labels + jnp.log1p(jnp.exp(-jnp.abs(x))))
     raise ValueError(f"unknown loss {kind}")
+
+
+def chunked_causal_ce(
+    hidden: jnp.ndarray,
+    head_w: jnp.ndarray,
+    labels: jnp.ndarray,
+    *,
+    chunk: int = 128,
+) -> jnp.ndarray:
+    """Streaming CE over sequence chunks — full-width logits NEVER exist.
+
+    ``hidden`` [B, S, D] are final hidden states (already shifted by the
+    caller: ``hidden[:, :-1]`` against ``labels = inputs[:, 1:]``),
+    ``head_w`` [V, D] the (tied) LM head. Each ``lax.map`` iteration
+    projects one sequence chunk to vocab width, reduces it to
+    logsumexp − picked, and drops it; ``jax.checkpoint`` makes the
+    backward recompute the chunk's logits instead of storing them. Peak
+    loss memory falls from O(B·S·V) to O(B·chunk·V) — the [B,S,50257]
+    f32 logits tensor is what OOMs the GPT-2 bench at B≥24
+    (MFUPROBE_r04.json). Labels == -100 are ignored, matching
+    :func:`compute_loss` CE semantics exactly.
+    """
+    B, S, D = hidden.shape
+    pad = (-S) % chunk
+    if pad:
+        # Ragged tail (the shifted caller pattern makes S odd — e.g.
+        # 1023): pad with ignored positions rather than collapsing to one
+        # dense chunk, which would resurrect the full logits tensor.
+        hidden = jnp.pad(hidden, ((0, 0), (0, pad), (0, 0)))
+        labels = jnp.pad(labels, ((0, 0), (0, pad)), constant_values=-100)
+        S += pad
+    n = S // chunk
+    hs = hidden.reshape(B, n, chunk, D).swapaxes(0, 1)  # [n, B, c, D]
+    ls = labels.reshape(B, n, chunk).swapaxes(0, 1)
+
+    @jax.checkpoint
+    def one(h, l):
+        logits = jnp.einsum(
+            "bcd,vd->bcv", h.astype(head_w.dtype), head_w,
+            preferred_element_type=jnp.float32,
+        )
+        valid = l != -100
+        safe = jnp.where(valid, l, 0)
+        lse = jax.scipy.special.logsumexp(logits.astype(jnp.float32), axis=-1)
+        picked = jnp.take_along_axis(logits, safe[..., None], axis=-1)[..., 0]
+        nll = (lse - picked.astype(jnp.float32)) * valid
+        return nll.sum(), valid.sum()
+
+    sums, counts = jax.lax.map(lambda args: one(*args), (hs, ls))
+    return sums.sum() / jnp.maximum(counts.sum(), 1)
 
 
 def make_train_step(

@@ -108,6 +108,17 @@ def _init_model(cfg: TrainExecutorConfig, session, work_dir: Path, first_batch):
     from ..models.registry import resolve_model_type
 
     model_spec = dict(cfg.model)
+    if cfg.lora:
+        # Adapter-only fine-tuning: inject the LoRA fields into the model
+        # config (the Llama family's _proj picks them up). The LlamaConfig
+        # constructor validates rank/targets; unsupported families have no
+        # lora_rank field and fail loudly in their config constructor.
+        model_spec["config"] = dict(
+            model_spec.get("config", {}),
+            lora_rank=int(cfg.lora.get("rank", 8)),
+            lora_alpha=float(cfg.lora.get("alpha", 16.0)),
+            lora_targets=tuple(cfg.lora.get("targets", ("q_proj", "v_proj"))),
+        )
     # On TPU the pluggable-attention families run the pallas flash kernel by
     # default (sequence-parallel jobs swap in the ring kernel instead, via
     # _build_mesh); off-TPU the XLA dense path is faster than interpret mode.
@@ -154,13 +165,21 @@ def _init_model(cfg: TrainExecutorConfig, session, work_dir: Path, first_batch):
             from ..models.convert import convert_state_dict, load_checkpoint_files
 
             state = load_checkpoint_files([work_dir / r for r in weight_files])
+            target = params
+            if cfg.lora:
+                # Checkpoints carry the BASE weights only; adapters keep
+                # their seed init (B=0 -> exact base behavior at step 0).
+                from .lora import merge_lora, split_lora
+
+                adapters_t, target = split_lora(params)
             try:
                 # Native flat names (our own checkpoints/exports)…
-                params = unflatten_like(state, params)
+                loaded = unflatten_like(state, target)
             except KeyError:
                 # …or an HF-format state dict for this family.
                 family = model_spec.get("family", "gpt2")
-                params = convert_state_dict(family, state, params)
+                loaded = convert_state_dict(family, state, target)
+            params = merge_lora(adapters_t, loaded) if cfg.lora else loaded
             log.info("loaded %d initial tensors from %s", len(state), weight_files)
     return model, params, causal_lm, has_aux
 
@@ -207,6 +226,20 @@ def run_training(
     model, params, causal_lm, has_aux = _init_model(cfg, session, work_dir, first_batch)
     mesh = _build_mesh(cfg.sharding)
 
+    # LoRA jobs train (ship, checkpoint, merge) the ADAPTER tree only; the
+    # frozen base rides along as a constant input to every step.
+    frozen = None
+    if cfg.lora:
+        from .lora import split_lora
+
+        adapters, frozen = split_lora(params)
+        if not jax.tree_util.tree_leaves(adapters):
+            raise ValueError(
+                f"job {spec.job_id}: lora={cfg.lora!r} produced no adapters "
+                f"(family {dict(cfg.model).get('family')!r})"
+            )
+        params = adapters
+
     tx = build_optimizer(cfg.optimizer, cfg.scheduler)
     state = TrainState.create(params, tx)
 
@@ -242,6 +275,11 @@ def run_training(
     # process cannot be fetched locally).
     mh = None
     if jax.process_count() > 1:
+        if frozen is not None:
+            raise ValueError(
+                "lora + multi-process replicas are not supported yet (the "
+                "follower protocol does not carry the frozen base)"
+            )
         if mesh is None:
             # Fail fast HERE: the follower asserts a mesh exists, and a
             # leader training unsharded while followers expect lockstep
@@ -267,9 +305,7 @@ def run_training(
         loss_kind = cfg.loss or Loss.CROSS_ENTROPY
         from ..models.hf import _DECODER_TYPES
 
-        step = make_train_step(
-            model.apply,
-            loss_kind,
+        step_kwargs = dict(
             causal_lm=causal_lm,
             has_aux=has_aux,
             # Models that declare an ``rng`` kwarg (the hf family) train
@@ -285,6 +321,15 @@ def run_training(
             # detection, contrastive, span…) carry their own loss.
             loss_override=getattr(model, "custom_loss", None),
         )
+        if frozen is not None:
+            from .lora import make_lora_train_step
+
+            lora_step = make_lora_train_step(model.apply, loss_kind, **step_kwargs)
+
+            def step(state, batch):
+                return lora_step(state, frozen, batch)
+        else:
+            step = make_train_step(model.apply, loss_kind, **step_kwargs)
 
         if mesh is not None:
             from jax.sharding import NamedSharding
@@ -293,6 +338,8 @@ def run_training(
             from ..parallel.sharding import batch_spec
 
             state = jax.device_put(state, param_sharding(state, mesh))
+            if frozen is not None:
+                frozen = jax.device_put(frozen, param_sharding(frozen, mesh))
             batch_sharding = NamedSharding(mesh, batch_spec())
 
             if mh is not None:

@@ -448,6 +448,11 @@ class TrainExecutorConfig:
     # Net-new vs reference (SURVEY.md §5 "Checkpoint/resume: none"):
     # {"dir": str, "every_rounds": int} — resume across executor restarts.
     checkpoint: dict | None = None
+    # Adapter-only fine-tuning (executor/lora.py): {"rank": int,
+    # "alpha": float?, "targets": [str]?}. The base stays frozen on
+    # device; Δθ shipped to the PS is the ADAPTER delta only, so DiLoCo
+    # round traffic shrinks by ~the base/adapter ratio (1600x at 7B r8).
+    lora: dict | None = None
 
 
 @register

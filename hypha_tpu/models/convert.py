@@ -22,6 +22,7 @@ import re
 from pathlib import Path
 from typing import Any
 
+import jax
 import numpy as np
 
 from ..executor.serialization import unflatten_like
@@ -112,6 +113,42 @@ def _qwen2_key(key: str) -> tuple[str, bool] | None:
     return _llama_key(key)
 
 
+class StackSlot:
+    """Mapper result for one slice of a stacked tensor: HF Mixtral stores
+    experts as separate ``experts.K.w{1,2,3}`` Linears, the TPU-native
+    MoE stores them stacked ``[E, ...]`` so dispatch/combine are single
+    batched matmuls on the MXU (models/mixtral.py). The converter buffers
+    slices and emits the stack once every index has arrived."""
+
+    __slots__ = ("name", "index", "transpose")
+
+    def __init__(self, name: str, index: int, transpose: bool) -> None:
+        self.name = name
+        self.index = index
+        self.transpose = transpose
+
+
+def _mixtral_key(key: str):
+    """HF Mixtral name -> (our name, transpose) | StackSlot | None."""
+    m = re.fullmatch(
+        r"model\.layers\.(\d+)\.block_sparse_moe\.experts\.(\d+)\.(w[123])\.weight",
+        key,
+    )
+    if m is not None:
+        i, e, w = m.group(1), int(m.group(2)), m.group(3)
+        # Mixtral semantics: w1 = gate-proj, w3 = up-proj ([F, D] torch ->
+        # transposed [D, F]); w2 = down-proj ([D, F] -> [F, D]).
+        name = {"w1": "w_gate", "w3": "w_up", "w2": "w_down"}[w]
+        return StackSlot(f"params/layers_{i}/moe/{name}", e, True)
+    m = re.fullmatch(
+        r"model\.layers\.(\d+)\.block_sparse_moe\.gate\.weight", key
+    )
+    if m is not None:
+        # router Linear [E, D] -> flax kernel [D, E]
+        return f"params/layers_{m.group(1)}/moe/gate/kernel", True
+    return _llama_key(key)  # attention / norms / embed / head are Llama-shaped
+
+
 # Mistral checkpoints are weight-identical to Llama (the sliding window is a
 # config property, not a tensor); Qwen2 adds attention biases; Gemma uses
 # the same tensor names (its offset-RMSNorm/GeGLU/embed-scale differences
@@ -122,11 +159,49 @@ HF_CONVERTERS = {
     "mistral": _llama_key,
     "qwen2": _qwen2_key,
     "gemma": _llama_key,
+    "mixtral": _mixtral_key,
 }
 
 # Llama-architecture families whose checkpoints may tie the LM head to the
 # embeddings (no lm_head.weight tensor on disk).
 _TIED_HEAD_FAMILIES = {"llama", "mistral", "qwen2", "gemma"}
+
+
+class _Stacker:
+    """Accumulates StackSlot slices into ``[E, ...]`` tensors.
+
+    With ``expected`` counts (from a params template) a stack is emitted
+    as soon as its last slice arrives — the streaming path then holds at
+    most one layer's experts. Without counts, stacks finalize at the end.
+    """
+
+    def __init__(self, expected: dict[str, int] | None = None) -> None:
+        self._slices: dict[str, dict[int, np.ndarray]] = {}
+        self._expected = expected or {}
+
+    def add(self, slot: StackSlot, arr: np.ndarray):
+        got = self._slices.setdefault(slot.name, {})
+        if slot.index in got:
+            raise KeyError(f"duplicate expert slice {slot.index} for {slot.name}")
+        got[slot.index] = arr
+        want = self._expected.get(slot.name)
+        if want is not None and len(got) == want:
+            del self._slices[slot.name]
+            return slot.name, self._stack(slot.name, got)
+        return None
+
+    @staticmethod
+    def _stack(name: str, got: dict[int, np.ndarray]) -> np.ndarray:
+        if sorted(got) != list(range(len(got))):
+            raise KeyError(
+                f"{name}: expert indices {sorted(got)} are not contiguous"
+            )
+        return np.stack([got[i] for i in range(len(got))])
+
+    def finalize(self):
+        for name, got in self._slices.items():
+            yield name, self._stack(name, got)
+        self._slices.clear()
 
 
 def convert_state_dict(
@@ -143,15 +218,23 @@ def convert_state_dict(
             f"no HF converter for family {family!r} (have {sorted(HF_CONVERTERS)})"
         )
     flat: dict[str, np.ndarray] = {}
+    stacker = _Stacker()
     for key, value in state_dict.items():
         mapped = mapper(key)
         if mapped is None:
             continue
-        name, transpose = mapped
         arr = np.asarray(value)
+        if isinstance(mapped, StackSlot):
+            if mapped.transpose:
+                arr = np.ascontiguousarray(arr.T)
+            stacker.add(mapped, arr.astype(np.float32, copy=False))
+            continue
+        name, transpose = mapped
         if transpose:
             arr = np.ascontiguousarray(arr.T)
         flat[name] = arr.astype(np.float32, copy=False)
+    for name, arr in stacker.finalize():
+        flat[name] = arr
     if (
         family in _TIED_HEAD_FAMILIES
         and "params/lm_head" not in flat
@@ -305,17 +388,31 @@ def convert_checkpoint(
             f"no HF converter for family {family!r} (have {sorted(HF_CONVERTERS)})"
         )
     flat: dict[str, Any] = {}
+    # Expected expert counts per stacked tensor, from the template's
+    # leading dims — lets the stacker emit (and free) each stack as soon
+    # as its layer's last expert streams in.
+    expected: dict[str, int] = {}
+    for keypath, leaf in jax.tree_util.tree_flatten_with_path(params_template)[0]:
+        name = "/".join(str(getattr(k, "key", k)) for k in keypath)
+        if name.rsplit("/", 1)[-1] in ("w_gate", "w_up", "w_down"):
+            expected[name] = int(leaf.shape[0])
+    stacker = _Stacker(expected)
+
     with ShardedCheckpoint(path) as ckpt:
-        def _load_one(hf_key: str, name: str, transpose: bool) -> None:
+        target = np.dtype(dtype) if dtype is not None else np.float32
+
+        def _read(hf_key: str, transpose: bool) -> np.ndarray:
             arr = np.asarray(ckpt.tensor(hf_key))
             if transpose:
                 arr = arr.T
-            target = np.dtype(dtype) if dtype is not None else np.float32
             # One OWNED contiguous host copy in the target dtype — never a
             # view: the shard mmap is unmapped when the checkpoint closes,
             # and ascontiguousarray would alias it for already-contiguous
             # same-dtype tensors.
-            arr = np.array(arr, dtype=target, order="C")
+            return np.array(arr, dtype=target, order="C")
+
+        def _load_one(hf_key: str, name: str, transpose: bool) -> None:
+            arr = _read(hf_key, transpose)
             flat[name] = put(name, arr) if put is not None else arr
 
         hf_keys: dict[str, tuple[str, bool]] = {}
@@ -323,9 +420,17 @@ def convert_checkpoint(
             mapped = mapper(hf_key)
             if mapped is None:
                 continue
+            if isinstance(mapped, StackSlot):
+                done = stacker.add(mapped, _read(hf_key, mapped.transpose))
+                if done is not None:
+                    sname, stacked = done
+                    flat[sname] = put(sname, stacked) if put is not None else stacked
+                continue
             name, transpose = mapped
             hf_keys[name] = (hf_key, transpose)
             _load_one(hf_key, name, transpose)
+        for sname, stacked in stacker.finalize():
+            flat[sname] = put(sname, stacked) if put is not None else stacked
         if (
             family in _TIED_HEAD_FAMILIES
             and "params/lm_head" not in flat

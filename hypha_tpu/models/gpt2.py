@@ -87,10 +87,16 @@ class GPT2(nn.Module):
     attn_impl: Callable | None = None  # e.g. the pallas flash kernel
     decode: bool = False  # serving mode: KV-cached autoregressive forward
     decode_len: int = 0
+    # with_head=False returns the final hidden states [B, S, E] instead of
+    # logits — the fused/chunked-CE training path computes the vocab
+    # projection inside the loss so full-width [B, S, V] logits never
+    # materialize (executor.train.chunked_causal_ce).
+    with_head: bool = True
 
     @nn.compact
     def __call__(self, input_ids: jnp.ndarray) -> jnp.ndarray:
-        """input_ids [B, S] -> logits [B, S, vocab] (f32)."""
+        """input_ids [B, S] -> logits [B, S, vocab] (f32), or final hidden
+        states when ``with_head=False``."""
         import jax
 
         cfg = self.config
@@ -115,5 +121,7 @@ class GPT2(nn.Module):
                 cfg, self.attn_impl, self.decode, self.decode_len, name=f"h_{i}"
             )(x)
         x = nn.LayerNorm(epsilon=cfg.layer_norm_epsilon, dtype=jnp.float32, name="ln_f")(x)
+        if not self.with_head:
+            return x
         # tied LM head: logits against the embedding matrix, f32 for the loss
         return jnp.einsum("bse,ve->bsv", x.astype(jnp.float32), wte)

@@ -62,6 +62,10 @@ class DiLoCoJob:
     loss: Loss | None = None
     # TPU-native: intra-replica mesh axes for the inner loop ({} = one chip).
     sharding: dict | None = None
+    # Adapter-only fine-tuning: {"rank": int, "alpha": float?,
+    # "targets": [..]?} — workers train/ship LoRA adapters only (the Δθ the
+    # PS averages shrinks by the base/adapter ratio; see executor/lora.py).
+    lora: dict | None = None
     # Net-new checkpoint/resume: workers save under
     # <checkpoint_dir>/<peer_id>, the PS under <checkpoint_dir>/ps (paths are
     # per-host). Unset checkpoint_dir — or checkpoint_every <= 0 — disables.

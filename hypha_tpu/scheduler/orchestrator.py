@@ -333,6 +333,7 @@ class Orchestrator:
                             scheduler=job.lr_scheduler,
                             loss=job.loss,
                             sharding=job.sharding,
+                            lora=job.lora,
                             checkpoint=(
                                 {
                                     "dir": f"{job.checkpoint_dir}/{handle.peer_id}",

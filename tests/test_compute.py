@@ -508,3 +508,60 @@ def test_moe_expert_parallel_matches_single_device():
         grads,
         ref_grads,
     )
+
+
+def test_chunked_causal_ce_matches_dense_loss_and_grads():
+    """The fused hidden->CE path (no full-width logits) must reproduce the
+    standard CE loss AND its gradients — it exists purely to cut the
+    O(B*S*V) loss memory that caps the bench batch size."""
+    from hypha_tpu.executor.train import chunked_causal_ce, make_loss_fn
+    from hypha_tpu.models import GPT2
+
+    cfg = GPT2Config(
+        vocab_size=64, n_positions=32, n_embd=16, n_layer=1, n_head=2,
+        dtype="float32",
+    )
+    ids = jax.random.randint(jax.random.key(0), (2, 32), 0, 64)
+    model = GPT2(cfg)
+    params = model.init(jax.random.key(1), ids)
+    dense_loss = make_loss_fn(model.apply)
+
+    nohead = GPT2(cfg, with_head=False)
+
+    def chunked_loss(p, batch, step):
+        h = nohead.apply(p, batch["input_ids"])
+        return chunked_causal_ce(
+            h[:, :-1], p["params"]["wte"], batch["input_ids"][:, 1:], chunk=8
+        )
+
+    batch = {"input_ids": ids}
+    want, _ = dense_loss(params, batch, 0)
+    got = chunked_loss(params, batch, 0)
+    np.testing.assert_allclose(float(got), float(want), rtol=1e-6)
+
+    g_want = jax.grad(lambda p: dense_loss(p, batch, 0)[0])(params)
+    g_got = jax.grad(lambda p: chunked_loss(p, batch, 0))(params)
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=2e-5, atol=2e-6
+        ),
+        g_got, g_want,
+    )
+
+    # -100 labels are ignored identically. S-1 = 31 with chunk=8 pads to
+    # 32 -> FOUR real lax.map chunks (the multi-chunk path, not a dense
+    # degenerate).
+    lab = np.array(ids[:, 1:])
+    lab[:, :10] = -100
+    h = nohead.apply(params, ids)
+    from hypha_tpu.executor.train import compute_loss
+    from hypha_tpu.messages import Loss
+
+    logits = model.apply(params, ids)
+    want2 = compute_loss(Loss.CROSS_ENTROPY, logits[:, :-1], jnp.asarray(lab))
+    got2 = chunked_causal_ce(h[:, :-1], params["params"]["wte"], jnp.asarray(lab), chunk=8)
+    np.testing.assert_allclose(float(got2), float(want2), rtol=1e-6)
+
+    # ragged chunking (31 = 4*7 + 3 -> padded) still matches
+    got3 = chunked_causal_ce(h[:, :-1], params["params"]["wte"], jnp.asarray(lab), chunk=7)
+    np.testing.assert_allclose(float(got3), float(want2), rtol=1e-6)

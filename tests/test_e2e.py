@@ -11,6 +11,7 @@ broadcast merge, round accounting, metrics — through the real protocols.
 from __future__ import annotations
 
 import asyncio
+import dataclasses
 
 import numpy as np
 import pytest
@@ -423,3 +424,59 @@ def test_full_diloco_job_heads_family(tmp_path):
     losses = [(w, r, v) for (w, r, n, v) in tracked if n == "loss"]
     assert {w for w, _, _ in losses} == {"w0", "w1"}
     assert all(np.isfinite(v) for _, _, v in losses)
+
+
+@pytest.mark.slow
+def test_full_diloco_lora_job(tmp_path, monkeypatch):
+    """A LoRA DiLoCo job end to end: the control plane, auction, PS outer
+    step and broadcast merge all run over the ADAPTER tree only — every
+    shipped delta contains exclusively _lora_ tensors (the round traffic
+    shrinks by the base/adapter ratio), and rounds still complete."""
+    import hypha_tpu.executor.training as tr
+    from hypha_tpu.executor.serialization import flatten_tree
+
+    shipped: list[list[str]] = []
+    orig_save = tr.save_tree
+
+    def spy(path, tree):
+        shipped.append(sorted(flatten_tree(tree)))
+        return orig_save(path, tree)
+
+    monkeypatch.setattr(tr, "save_tree", spy)
+
+    async def main():
+        hub, gw, data, workers, sched = await start_cluster(tmp_path)
+        orch = Orchestrator(sched)
+        job = diloco_job(rounds=2)
+        job = dataclasses.replace(
+            job,
+            model={
+                "model_type": ModelType.CAUSAL_LM,
+                "family": "llama",
+                "config": {
+                    "vocab_size": VOCAB, "hidden_size": 16,
+                    "intermediate_size": 32, "num_layers": 1,
+                    "num_heads": 2, "num_kv_heads": 1,
+                    "max_seq_len": SEQ, "dtype": "float32",
+                },
+                "seed": 5,
+            },
+            lora={"rank": 2, "alpha": 8.0, "targets": ["q_proj", "v_proj"]},
+        )
+        try:
+            result = await orch.run(job, auction_timeout=1.5)
+        finally:
+            for w in workers:
+                await w.stop()
+            await data.stop()
+            await sched.stop()
+            await gw.stop()
+        return result
+
+    result = run(main())
+    assert result.rounds == 2
+    assert shipped, "no deltas were shipped"
+    for names in shipped:
+        assert names and all("_lora_" in n for n in names), names[:4]
+        # rank-2 on q/v of one layer: exactly 4 adapter tensors
+        assert len(names) == 4
