@@ -510,3 +510,65 @@ def test_batcher_splits_oversized_and_respects_cap():
             await b.submit([[1]], 2, 0.0, None, 0)
 
     run(main())
+
+
+def test_serving_mixtral_from_hf_repo(tmp_path):
+    """A converted HF Mixtral repo serves end to end: directory weights
+    stream through the stacking converter, decode handles the MoE
+    (logits, aux) output, and dropless routing keeps cached generation
+    exact."""
+    transformers = pytest.importorskip("transformers")
+    import torch
+
+    hf_cfg = transformers.MixtralConfig(
+        vocab_size=64, hidden_size=32, intermediate_size=64,
+        num_hidden_layers=1, num_attention_heads=4, num_key_value_heads=2,
+        num_local_experts=4, num_experts_per_tok=2,
+        max_position_embeddings=64, tie_word_embeddings=False,
+    )
+    torch.manual_seed(21)
+    transformers.MixtralForCausalLM(hf_cfg).save_pretrained(
+        tmp_path, safe_serialization=True
+    )
+
+    async def main():
+        hub = MemoryTransport()
+        gw = Node(hub.shared(), peer_id="gw", registry_server=True)
+        await gw.start()
+        worker = Node(hub.shared(), peer_id="w", bootstrap=[gw.listen_addrs[0]])
+        client = Node(hub.shared(), peer_id="c", bootstrap=[gw.listen_addrs[0]])
+        await worker.start(); await client.start()
+        await worker.wait_for_bootstrap(5); await client.wait_for_bootstrap(5)
+
+        ex = InProcessInferExecutor(worker)
+        spec = JobSpec(
+            job_id="job-moe",
+            executor=Executor(
+                kind="infer", name="generate",
+                infer=InferExecutorConfig(
+                    model={
+                        "family": "mixtral",
+                        "config": {
+                            "vocab_size": 64, "hidden_size": 32,
+                            "intermediate_size": 64, "num_layers": 1,
+                            "num_heads": 4, "num_kv_heads": 2,
+                            "num_experts": 4, "experts_per_token": 2,
+                            "max_seq_len": 64, "rope_theta": 1e6,
+                        },
+                        "weights": str(tmp_path),
+                    },
+                    serve_name="moe",
+                ),
+            ),
+        )
+        execution = await ex.execute("job-moe", spec, "s")
+        toks = await generate_remote(client, "moe", [[3, 1, 4], [1, 5]], 6)
+        assert len(toks) == 2 and all(len(t) == 6 for t in toks)
+        assert all(0 <= t < 64 for row in toks for t in row)
+        # greedy determinism through the KV-cached MoE decode
+        toks2 = await generate_remote(client, "moe", [[3, 1, 4], [1, 5]], 6)
+        assert toks == toks2
+        await execution.cancel()
+        await client.stop(); await worker.stop(); await gw.stop()
+
+    run(main())
