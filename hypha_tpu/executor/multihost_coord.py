@@ -126,7 +126,7 @@ class LeaderCoordination:
     def __init__(self) -> None:
         self.mh = HostCoordinator()
 
-    def init(self, spec_json: str, state, first_batch: dict) -> None:
+    def init(self, spec_json: str, state, first_batch: dict, frozen=None) -> None:
         payload = {
             "__spec__": np.frombuffer(spec_json.encode(), np.uint8),
             "__step__": np.asarray(int(state.step), np.int64),
@@ -134,6 +134,11 @@ class LeaderCoordination:
         payload.update(_flatten_prefixed("p/", state.params))
         payload.update(_flatten_prefixed("o/", state.opt_state))
         payload.update({f"b/{k}": np.asarray(v) for k, v in first_batch.items()})
+        if frozen is not None:
+            # LoRA replica: state.params is the adapter tree only; the
+            # frozen base travels once in the init broadcast (followers
+            # then hold it as a constant step input).
+            payload.update(_flatten_prefixed("f/", frozen))
         self.mh.send(OP_INIT, payload)
 
     def step(self, batch: dict) -> None:
@@ -183,6 +188,15 @@ def run_training_follower() -> int:
         k[2:]: payload[k] for k in payload if k.startswith("b/")
     }
     model_spec = dict(cfg.model)
+    if cfg.lora:
+        # Mirror the leader's LoRA config injection (training._init_model)
+        # so the follower's param tree has the same adapter leaves.
+        model_spec["config"] = dict(
+            model_spec.get("config", {}),
+            lora_rank=int(cfg.lora.get("rank", 8)),
+            lora_alpha=float(cfg.lora.get("alpha", 16.0)),
+            lora_targets=tuple(cfg.lora.get("targets", ("q_proj", "v_proj"))),
+        )
     model, _ = build_model(model_spec)
     model_type = resolve_model_type(
         model_spec.get("model_type", messages.ModelType.CAUSAL_LM)
@@ -194,6 +208,13 @@ def run_training_follower() -> int:
         else first_batch["inputs"]
     )
     params = model.init(jax.random.key(int(model_spec.get("seed", 0))), inputs)
+    frozen = None
+    if cfg.lora:
+        from .lora import split_lora
+
+        adapters_t, frozen_t = split_lora(params)
+        frozen = _unflatten_prefixed("f/", payload, frozen_t)
+        params = adapters_t
     state = TrainState.create(
         params, build_optimizer(cfg.optimizer, cfg.scheduler)
     )
@@ -211,6 +232,8 @@ def run_training_follower() -> int:
     from ..parallel.sharding import batch_spec
 
     state = jax.device_put(state, param_sharding(state, mesh))
+    if frozen is not None:
+        frozen = jax.device_put(frozen, param_sharding(frozen, mesh))
     b_sharding = NamedSharding(mesh, batch_spec())
 
     def place(batch):
@@ -224,15 +247,26 @@ def run_training_follower() -> int:
             for k, v in batch.items()
         }
 
-    step = make_train_step(
-        model.apply,
-        cfg.loss or Loss.CROSS_ENTROPY,
+    step_kwargs = dict(
         causal_lm=causal_lm,
         has_aux=has_aux,
         dropout_seed=int(model_spec.get("seed", 0)),
         labels_aligned=getattr(model, "model_type", None) in _DECODER_TYPES,
         loss_override=getattr(model, "custom_loss", None),
     )
+    if frozen is not None:
+        from .lora import make_lora_train_step
+
+        lora_step = make_lora_train_step(
+            model.apply, cfg.loss or Loss.CROSS_ENTROPY, **step_kwargs
+        )
+
+        def step(state, batch):
+            return lora_step(state, frozen, batch)
+    else:
+        step = make_train_step(
+            model.apply, cfg.loss or Loss.CROSS_ENTROPY, **step_kwargs
+        )
 
     def snapshot(tree):
         return jax.tree.map(jnp.copy, tree)

@@ -275,11 +275,6 @@ def run_training(
     # process cannot be fetched locally).
     mh = None
     if jax.process_count() > 1:
-        if frozen is not None:
-            raise ValueError(
-                "lora + multi-process replicas are not supported yet (the "
-                "follower protocol does not carry the frozen base)"
-            )
         if mesh is None:
             # Fail fast HERE: the follower asserts a mesh exists, and a
             # leader training unsharded while followers expect lockstep
@@ -292,7 +287,10 @@ def run_training(
         from .multihost_coord import LeaderCoordination
 
         mh = LeaderCoordination()
-        mh.init(json.dumps(messages.to_json_dict(spec)), state, first_batch)
+        mh.init(
+            json.dumps(messages.to_json_dict(spec)), state, first_batch,
+            frozen=frozen,
+        )
         log.info(
             "multihost leader: %d processes, %d global devices",
             jax.process_count(), len(jax.devices()),

@@ -132,13 +132,22 @@ _LEADER = textwrap.dedent("""
         await ps.start()
         sched = Node(hub.shared(), peer_id="sched", bootstrap=boot)
         await sched.start(); await sched.wait_for_bootstrap()
+        lora = {lora!r}
+        model = (
+            {{"model_type": ModelType.CAUSAL_LM, "family": "llama",
+              "config": {{"vocab_size": 32, "hidden_size": 16,
+                          "intermediate_size": 32, "num_layers": 1,
+                          "num_heads": 2, "num_kv_heads": 1,
+                          "max_seq_len": 16, "dtype": "float32"}},
+              "seed": 7}}
+            if lora else
+            {{"model_type": ModelType.CAUSAL_LM, "family": "gpt2",
+              "config": {{"vocab_size": 32, "n_positions": 16,
+                          "n_embd": 16, "n_layer": 1, "n_head": 2}},
+              "seed": 7}}
+        )
         job = DiLoCoJob(
-            model={{
-                "model_type": ModelType.CAUSAL_LM, "family": "gpt2",
-                "config": {{"vocab_size": 32, "n_positions": 16,
-                            "n_embd": 16, "n_layer": 1, "n_head": 2}},
-                "seed": 7,
-            }},
+            model=model,
             dataset="toy",
             rounds=DiLoCoRounds(update_rounds=2,
                                 avg_samples_between_updates=8,
@@ -148,6 +157,7 @@ _LEADER = textwrap.dedent("""
             # The multihost replica: dp spans the two processes, fsdp the
             # two local devices of each.
             sharding={{"dp": 2, "fsdp": 2}},
+            lora=lora,
             resources=JobResources(
                 num_workers=1,
                 worker=Resources(tpu=1.0, cpu=1.0, memory=10),
@@ -187,7 +197,10 @@ _FOLLOWER = textwrap.dedent("""
 
 
 @pytest.mark.slow
-def test_multihost_diloco_round_through_worker_runtime(tmp_path):
+@pytest.mark.parametrize(
+    "lora", [None, {"rank": 2, "alpha": 8.0}], ids=["full", "lora"]
+)
+def test_multihost_diloco_round_through_worker_runtime(tmp_path, lora):
     """A replica spanning TWO jax.distributed processes completes a full
     DiLoCo job through the real worker runtime + training executor against
     an in-process scheduler + PS (VERDICT r3 weak #4): process 0 owns the
@@ -201,7 +214,8 @@ def test_multihost_diloco_round_through_worker_runtime(tmp_path):
     leader = tmp_path / "leader.py"
     follower = tmp_path / "follower.py"
     leader.write_text(_LEADER.format(repo=repo, addr=addr,
-                                     work=str(tmp_path / "work")))
+                                     work=str(tmp_path / "work"),
+                                     lora=lora))
     follower.write_text(_FOLLOWER.format(repo=repo, addr=addr))
     procs = [
         subprocess.Popen(
