@@ -57,13 +57,42 @@ def _decode(data: bytes) -> dict[str, np.ndarray]:
         return {k: z[k] for k in z.files}
 
 
+# Upper bound on one broadcast group. A 7B-class OP_INIT carries ~27 GB of
+# f32 base + optimizer state; encoding it as ONE npz blob plus the matching
+# uint8 broadcast array tripled peak host memory and could OOM hosts whose
+# sharded on-device state would have fit. Grouping bounds the transient to
+# ~2x this value (encoded bytes + broadcast buffer) regardless of tree size,
+# while keeping the barrier count ~payload_bytes/64MB instead of per-tensor.
+_CHUNK_BYTES = 64 << 20
+
+
+def _group_items(
+    items: list[tuple[str, np.ndarray]]
+) -> list[list[tuple[str, np.ndarray]]]:
+    groups: list[list[tuple[str, np.ndarray]]] = []
+    cur: list[tuple[str, np.ndarray]] = []
+    cur_bytes = 0
+    for k, v in items:
+        if cur and cur_bytes + v.nbytes > _CHUNK_BYTES:
+            groups.append(cur)
+            cur, cur_bytes = [], 0
+        cur.append((k, v))
+        cur_bytes += v.nbytes
+    if cur:
+        groups.append(cur)
+    return groups
+
+
 class HostCoordinator:
     """Broadcast channel from process 0 to all processes.
 
-    Two ``broadcast_one_to_all`` rounds per message: a fixed-shape header
-    (opcode, payload length) so followers can allocate a matching buffer,
-    then the payload bytes. Every process must call send/recv in lockstep —
-    which is exactly the property the executor protocol maintains.
+    Per message: a fixed-shape header (opcode, group count), then per group
+    a length header and the npz-encoded bytes. Groups cap the transient
+    host-memory cost of a broadcast at ~2x ``_CHUNK_BYTES`` (or one tensor,
+    if a single tensor exceeds it) — the follower's assembled dict is the
+    only full-size allocation, and it is the output. Every process must
+    call send/recv in lockstep — which is exactly the property the
+    executor protocol maintains.
     """
 
     def __init__(self) -> None:
@@ -85,19 +114,32 @@ class HostCoordinator:
     ) -> tuple[int, dict[str, np.ndarray] | None]:
         from jax.experimental import multihost_utils as mhu
 
-        data = _encode(payload) if (self.rank == 0 and payload) else b""
-        header = np.array([op, len(data)], np.int64)
-        header = np.asarray(mhu.broadcast_one_to_all(header))
-        op, nbytes = int(header[0]), int(header[1])
-        if nbytes == 0:
-            return op, None
-        buf = (
-            np.frombuffer(data, np.uint8)
-            if self.rank == 0
-            else np.zeros(nbytes, np.uint8)
+        groups = (
+            _group_items([(k, np.asarray(v)) for k, v in payload.items()])
+            if (self.rank == 0 and payload)
+            else []
         )
-        buf = np.asarray(mhu.broadcast_one_to_all(buf))
-        return op, (None if self.rank == 0 else _decode(buf.tobytes()))
+        header = np.array([op, len(groups)], np.int64)
+        header = np.asarray(mhu.broadcast_one_to_all(header))
+        op, n_groups = int(header[0]), int(header[1])
+        if n_groups == 0:
+            return op, None
+        if self.rank == 0:
+            for group in groups:
+                data = _encode(dict(group))
+                mhu.broadcast_one_to_all(np.array([len(data)], np.int64))
+                mhu.broadcast_one_to_all(np.frombuffer(data, np.uint8))
+            return op, None
+        out: dict[str, np.ndarray] = {}
+        for _ in range(n_groups):
+            hdr = np.asarray(
+                mhu.broadcast_one_to_all(np.zeros(1, np.int64))
+            )
+            buf = np.asarray(
+                mhu.broadcast_one_to_all(np.zeros(int(hdr[0]), np.uint8))
+            )
+            out.update(_decode(buf.tobytes()))
+        return op, out
 
 
 def _flatten_prefixed(prefix: str, tree: Any) -> dict[str, np.ndarray]:
@@ -268,10 +310,9 @@ def run_training_follower() -> int:
             model.apply, cfg.loss or Loss.CROSS_ENTROPY, **step_kwargs
         )
 
-    def snapshot(tree):
-        return jax.tree.map(jnp.copy, tree)
-
-    anchor = snapshot(state.params)
+    # No follower-side anchor: the leader alone computes Δθ (that op has no
+    # cross-process collective), so a follower anchor would be dead state
+    # inviting divergence if someone ever read it.
     rounds = 0
     while True:
         op, payload = mh.recv()
@@ -289,7 +330,6 @@ def run_training_follower() -> int:
             # mirror it — only the merge itself runs here.
             update = _unflatten_prefixed("u/", payload, state.params)
             state = state.replace(params=merge_update(state.params, update))
-            anchor = snapshot(state.params)
             rounds += 1
         else:
             raise RuntimeError(f"unknown opcode {op}")

@@ -312,10 +312,17 @@ class Llama(nn.Module):
     attn_impl: Callable | None = None  # e.g. a ring-attention closure
     decode: bool = False  # serving mode: KV-cached autoregressive forward
     decode_len: int = 0
+    # with_head=False returns final hidden states [B, S, E] — the
+    # chunked-CE training path (executor.train.chunked_causal_ce) projects
+    # to vocab inside the loss so [B, S, 32000] f32 logits never
+    # materialize (0.5 GB/chip at B_local=1 S=4096; see gpt2.py). Init
+    # with with_head=True so the param tree still carries lm_head.
+    with_head: bool = True
 
     @nn.compact
     def __call__(self, input_ids: jnp.ndarray) -> jnp.ndarray:
-        """input_ids [B, S] -> logits [B, S, vocab] (f32)."""
+        """input_ids [B, S] -> logits [B, S, vocab] (f32), or final hidden
+        states when ``with_head=False``."""
         cfg = self.config
         dtype = jnp.dtype(cfg.dtype)
         embed = self.param(
@@ -336,6 +343,8 @@ class Llama(nn.Module):
                 name=f"layers_{i}",
             )(x, cos, sin)
         x = _RMSNorm(cfg.rms_eps, cfg.rms_offset, name="norm")(x)
+        if not self.with_head:
+            return x
         if cfg.tie_word_embeddings:
             lm_head = embed  # Qwen2-small convention: head shares embeddings
         else:

@@ -252,6 +252,41 @@ class _TcpStream(Stream):
         file→socket on plain TCP; asyncio falls back internally under TLS)."""
         return self._writer.transport
 
+    def raw_socket_handoff(self):
+        """Hand the raw socket to a thread-side drain, or None.
+
+        The receiver mirror of ``sendfile_transport``: bulk pushes drain
+        fastest with blocking ``recv_into`` straight into an mmap of the
+        destination file (one kernel→page-cache copy, no event-loop
+        scheduling per chunk — DISTBENCH r4's remaining gap). Only valid
+        on plain TCP (TLS bytes need the event-loop's decrypt) and only
+        when the caller will consume the stream to EOF: reading is paused
+        here and never resumed. Returns ``(socket, buffered)`` where
+        ``buffered`` is whatever the event loop had already read ahead.
+        """
+        if self._writer.get_extra_info("ssl_object") is not None:
+            return None
+        sock = self._writer.get_extra_info("socket")
+        if sock is None:
+            return None
+        try:
+            self._writer.transport.pause_reading()
+        except (NotImplementedError, RuntimeError):
+            return None
+        try:
+            buffered = bytes(self._reader._buffer)
+            self._reader._buffer.clear()
+        except (AttributeError, TypeError):
+            # Private-API drift (StreamReader._buffer): undo the pause so
+            # the fallback read loop isn't left waiting on a transport
+            # that will never feed it.
+            try:
+                self._writer.transport.resume_reading()
+            except (NotImplementedError, RuntimeError):
+                pass
+            return None
+        return sock, buffered
+
     async def drain(self) -> None:
         await self._writer.drain()
 

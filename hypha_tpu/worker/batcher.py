@@ -86,11 +86,18 @@ class RequestBatcher:
         # position and on whoever shared its window — same request + same
         # seed would stop reproducing. A unique key gives sampled requests
         # their own decode (still serialized on the chip lock).
-        if temperature == 0.0:
-            key = (int(n_new), 0.0, top_k, 0)
-        else:
-            key = (int(n_new), float(temperature), top_k, int(seed), object())
+        sampled = temperature != 0.0
         fut = asyncio.get_running_loop().create_future()
+        if sampled:
+            # Nothing can ever join a sampled bucket (see above), so skip
+            # registration and the window timer entirely — a window wait
+            # would be pure added latency.
+            bucket = _Bucket((int(n_new), float(temperature), top_k, int(seed)))
+            bucket.items.append((prompts, fut))
+            bucket.count = len(prompts)
+            self._flush(bucket)
+            return await fut
+        key = (int(n_new), 0.0, top_k, 0)
         bucket = self._buckets.get(key)
         if (
             bucket is not None

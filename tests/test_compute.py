@@ -136,6 +136,62 @@ def test_ring_attention_gqa_and_grad():
     np.testing.assert_allclose(np.asarray(g_ring), np.asarray(g_ref), rtol=1e-3, atol=1e-3)
 
 
+@pytest.mark.parametrize("causal", [True, False])
+def test_chunked_attention_matches_reference(causal):
+    from hypha_tpu.ops.chunked_attention import chunked_attention
+
+    B, S, H, D = 2, 32, 4, 16
+    q = jax.random.normal(jax.random.key(0), (B, S, H, D))
+    k = jax.random.normal(jax.random.key(1), (B, S, H, D))
+    v = jax.random.normal(jax.random.key(2), (B, S, H, D))
+    out = chunked_attention(q, k, v, causal=causal, block=8)
+    ref = dot_product_attention(q, k, v, causal=causal)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=1e-4, atol=1e-4)
+
+
+def test_chunked_attention_gqa_and_grads():
+    from hypha_tpu.ops.chunked_attention import chunked_attention
+
+    B, S, D = 1, 16, 8
+    q = jax.random.normal(jax.random.key(0), (B, S, 4, D))
+    k = jax.random.normal(jax.random.key(1), (B, S, 2, D))
+    v = jax.random.normal(jax.random.key(2), (B, S, 2, D))
+
+    def f_chunked(q, k, v):
+        return (chunked_attention(q, k, v, causal=True, block=4) ** 2).sum()
+
+    def f_ref(q, k, v):
+        return (dot_product_attention(q, k, v, causal=True) ** 2).sum()
+
+    np.testing.assert_allclose(
+        f_chunked(q, k, v), f_ref(q, k, v), rtol=1e-4, atol=1e-4
+    )
+    # The hand-derived VJP covers all three inputs (dq from the carry,
+    # dk/dv from per-block stacking, GQA group-summing via the repeat
+    # transpose) — check every one against autodiff through the dense path.
+    g_c = jax.grad(f_chunked, argnums=(0, 1, 2))(q, k, v)
+    g_r = jax.grad(f_ref, argnums=(0, 1, 2))(q, k, v)
+    for gc, gr in zip(g_c, g_r):
+        np.testing.assert_allclose(
+            np.asarray(gc), np.asarray(gr), rtol=1e-3, atol=1e-3
+        )
+
+
+def test_llama_with_chunked_attention_matches_dense():
+    import dataclasses
+
+    cfg = dataclasses.replace(LlamaConfig.tiny(), dtype="float32")
+    from hypha_tpu.ops.chunked_attention import chunked_attention
+
+    ids = jax.random.randint(jax.random.key(1), (2, 16), 0, cfg.vocab_size)
+    dense = Llama(cfg)
+    params = dense.init(jax.random.key(0), ids)
+    ref = dense.apply(params, ids)
+    chunked = Llama(cfg, attn_impl=chunked_attention)
+    out = chunked.apply(params, ids)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=2e-3, atol=2e-3)
+
+
 def test_llama_with_ring_attention_matches_dense():
     import dataclasses
 
