@@ -169,9 +169,15 @@ def worker(args) -> None:
     )
     B_loc = B // bshard
     E = cfg.hidden_size
-    remat_stored = (
-        cfg.num_layers * B_loc * S * E * 2 if args.remat == "on" else None
-    )
+    I = cfg.intermediate_size
+    if args.remat == "on":
+        # nn.remat stores only each block's input [B_loc, S, E] bf16.
+        remat_stored = cfg.num_layers * B_loc * S * E * 2
+    else:
+        # Without remat the backward needs every block's intermediates:
+        # ~(x, q, k, v, attn_out, 2 norm outs ≈ 6E) + (gate, up, act·up
+        # ≈ 3I) per position, bf16 (chunked attention keeps scores out).
+        remat_stored = cfg.num_layers * B_loc * S * (6 * E + 3 * I) * 2
     per_layer_params = 4 * E * E + 3 * E * cfg.intermediate_size + 2 * E
     grad_window = 4 * per_layer_params * 4 // max(
         1, dshape["fsdp"] * dshape["tp"]
@@ -179,6 +185,12 @@ def worker(args) -> None:
     embed_grads = 2 * cfg.vocab_size * E * 4 // max(
         1, dshape["fsdp"] * dshape["tp"]
     )
+    # Loss-path transient: f32 logits + their gradient, both alive across
+    # the head-projection backward. Chunked CE bounds the width at one
+    # 512-token chunk; the full path materializes the whole [B_loc, S, V]
+    # pair (conservatively unsharded over vocab).
+    loss_width = 512 if args.loss == "chunked" else S
+    loss_buffer = 2 * B_loc * loss_width * cfg.vocab_size * 4
     peak = int(ma.peak_memory_in_bytes)
     row = {
         "mesh": mesh_sizes,
@@ -206,6 +218,7 @@ def worker(args) -> None:
             "remat_stored_bytes": remat_stored,
             "grad_window_bytes": grad_window,
             "embed_head_grad_bytes": embed_grads,
+            "loss_buffer_bytes": loss_buffer,
         },
         "lower_s": round(t1 - t0, 1),
         "compile_s": round(t2 - t1, 1),
@@ -216,6 +229,7 @@ def worker(args) -> None:
         + (remat_stored or 0)
         + grad_window
         + embed_grads
+        + loss_buffer
     )
     row["est_peak_bytes"] = est
     row["est_peak_gib"] = round(est / 1024**3, 3)

@@ -24,7 +24,9 @@ import numpy as np
 sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
 
 
-def make_deltas(tmp: Path, n_workers: int, params_m: float) -> list[Path]:
+def make_deltas(
+    tmp: Path, n_workers: int, params_m: float, dtype: str = "float32"
+) -> list[Path]:
     from safetensors.numpy import save_file
 
     # A transformer-shaped tree: a few big matrices + many small ones.
@@ -39,16 +41,24 @@ def make_deltas(tmp: Path, n_workers: int, params_m: float) -> list[Path]:
     for i in range(n_blocks):
         shapes[f"h_{i}/attn"] = (side, 4 * side)
 
+    np_dtype: object = np.float32
+    if dtype == "bfloat16":
+        import ml_dtypes
+
+        np_dtype = ml_dtypes.bfloat16
     rng = np.random.default_rng(0)
     paths = []
     for k in range(n_workers):
+        # One worker's tree in memory at a time (13.5 GB bf16 at 7B) —
+        # never all n_workers at once.
         tree = {
-            name: rng.standard_normal(shape).astype(np.float32)
+            name: rng.standard_normal(shape, dtype=np.float32).astype(np_dtype)
             for name, shape in shapes.items()
         }
         p = tmp / f"delta-{k}.safetensors"
         save_file(tree, str(p))
         paths.append(p)
+        del tree
     return paths
 
 
@@ -64,6 +74,9 @@ def bench_native(paths, weights, tmp: Path, reps: int) -> float | None:
             paths, weights, None, tmp / f"mn-{r}.st", tmp / f"un-{r}.st", 0.7, 0.9
         )
         best = min(best, time.perf_counter() - t0)
+        # 2x27 GB of outputs per rep at 7B: drop them before the next rep.
+        (tmp / f"mn-{r}.st").unlink(missing_ok=True)
+        (tmp / f"un-{r}.st").unlink(missing_ok=True)
     return best
 
 
@@ -87,6 +100,8 @@ def bench_python(paths, weights, tmp: Path, reps: int) -> float:
         save_file(update, str(tmp / f"up-{r}.st"))
         save_file(momentum, str(tmp / f"mp-{r}.st"))
         best = min(best, time.perf_counter() - t0)
+        (tmp / f"up-{r}.st").unlink(missing_ok=True)
+        (tmp / f"mp-{r}.st").unlink(missing_ok=True)
     return best
 
 
@@ -95,32 +110,64 @@ def main() -> None:
     parser.add_argument("--params-m", type=float, default=124.0)
     parser.add_argument("--workers", type=int, default=4)
     parser.add_argument("--reps", type=int, default=3)
+    parser.add_argument("--dtype", choices=["float32", "bfloat16"], default=None,
+                        help="delta wire dtype (default: f32, bf16 at 7B scale)")
+    parser.add_argument("--skip-python", action="store_true",
+                        help="native only (the python path loads every tree "
+                             "into RAM — 4x27 GB at 7B f32)")
     args = parser.parse_args()
+    big = args.params_m > 1000
+    dtype = args.dtype or ("bfloat16" if big else "float32")
+    if big:
+        # 7B-scale runs: the streaming/mmap claim is the point. The python
+        # comparison would hold all trees in RAM, and f32 deltas would not
+        # fit this host's disk — the bf16 wire format is the 7B design.
+        args.skip_python = True
 
     tmp = Path(tempfile.mkdtemp(prefix="hypha-psbench-"))
-    paths = make_deltas(tmp, args.workers, args.params_m)
+    # Outputs (f32 momentum+update = 2x27 GB at 7B) go to /dev/shm so the
+    # deltas + outputs fit disk+RAM together.
+    out_base = Path("/dev/shm") if big and Path("/dev/shm").is_dir() else None
+    out_tmp = Path(tempfile.mkdtemp(prefix="hypha-psbench-", dir=out_base))
+    paths = make_deltas(tmp, args.workers, args.params_m, dtype)
     total_bytes = sum(p.stat().st_size for p in paths)
     weights = np.full(args.workers, 1.0 / args.workers, np.float32)
 
-    t_native = bench_native(paths, weights, tmp, args.reps)
-    t_python = bench_python(paths, weights, tmp, args.reps)
+    import resource
+
+    t_native = bench_native(paths, weights, out_tmp, args.reps)
+    t_python = None if args.skip_python else bench_python(
+        paths, weights, out_tmp, args.reps
+    )
+    peak_rss_gib = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss / (1 << 20)
 
     gb = total_bytes / (1 << 30)
+    fallback = t_python if t_python is not None else t_native
     result = {
         "metric": "ps_outer_step",
-        "value": round(gb / t_native, 2) if t_native else round(gb / t_python, 2),
+        "value": round(gb / (t_native or fallback), 2),
         "unit": "GB/s_aggregated",
         "native_s": round(t_native, 3) if t_native else None,
-        "python_s": round(t_python, 3),
-        "speedup": round(t_python / t_native, 2) if t_native else 1.0,
+        "python_s": round(t_python, 3) if t_python is not None else None,
+        "speedup": (
+            round(t_python / t_native, 2)
+            if t_native and t_python is not None else None
+        ),
         "workers": args.workers,
         "params_m": args.params_m,
-        "vs_baseline": round(t_python / t_native, 2) if t_native else 1.0,
+        "delta_dtype": dtype,
+        "deltas_gib": round(gb, 2),
+        "peak_rss_gib": round(peak_rss_gib, 2),
+        "vs_baseline": (
+            round(t_python / t_native, 2)
+            if t_native and t_python is not None else 1.0
+        ),
     }
     print(json.dumps(result))
     import shutil
 
     shutil.rmtree(tmp, ignore_errors=True)
+    shutil.rmtree(out_tmp, ignore_errors=True)
 
 
 if __name__ == "__main__":

@@ -67,11 +67,117 @@ def _bench(B: int, prompt_len: int, new_tokens: int) -> dict:
     }
 
 
-def _concurrent_clients(n_clients: int, batched: bool, model_spec=None) -> dict:
+def _llama124m_spec() -> dict:
+    """A GPT-2-small-sized Llama (pool-capable family) for scheduling
+    comparisons: same depth/width as the headline model, llama lineage so
+    the continuous pool engages."""
+    return {"family": "llama", "config": {
+        "vocab_size": 32000, "hidden_size": 768, "intermediate_size": 2048,
+        "num_layers": 12, "num_heads": 12, "num_kv_heads": 12,
+        "max_seq_len": 1024,
+    }}
+
+
+def _late_arrival(scheduling: str, reps: int = 3) -> dict:
+    """VERDICT r4 weak #4 / r5 task 3: a request arriving MID-DECODE.
+
+    One long request (256 new tokens) starts decoding; 0.3 s later four
+    short requests (16 tokens) arrive. Under the window batcher they wait
+    for the entire in-flight decode; under the continuous pool they admit
+    into free KV rows at the next chunk boundary. Reports the shorts' p50
+    latency and the long request's completion time.
+    """
+    import asyncio
+    import statistics
+
+    from hypha_tpu.messages import Executor, InferExecutorConfig, JobSpec
+    from hypha_tpu.network.fabric import MemoryTransport
+    from hypha_tpu.network.node import Node
+    from hypha_tpu.worker.infer_executor import (
+        InProcessInferExecutor,
+        generate_remote,
+    )
+
+    LONG_NEW, SHORT_NEW = 256, 16
+    spec_model = _llama124m_spec()
+    vocab = spec_model["config"]["vocab_size"]
+
+    async def run() -> dict:
+        hub = MemoryTransport()
+        gw = Node(hub.shared(), peer_id="gw", registry_server=True)
+        await gw.start()
+        worker = Node(hub.shared(), peer_id="w", bootstrap=[gw.listen_addrs[0]])
+        client = Node(hub.shared(), peer_id="c", bootstrap=[gw.listen_addrs[0]])
+        await worker.start(); await client.start()
+        await worker.wait_for_bootstrap(5); await client.wait_for_bootstrap(5)
+        ex = InProcessInferExecutor(worker)
+        spec = JobSpec(
+            job_id="bench-late",
+            executor=Executor(
+                kind="infer", name="generate",
+                infer=InferExecutorConfig(
+                    model=spec_model, serve_name="late",
+                    max_batch=8, max_new_tokens=LONG_NEW,
+                    scheduling=scheduling,
+                    pool_slots=8, pool_max_len=512, pool_chunk=8,
+                    batch_window_ms=4.0,
+                ),
+            ),
+        )
+        execution = await ex.execute("bench-late", spec, "s")
+        deadline = time.perf_counter() + 600
+        while time.perf_counter() < deadline:
+            if await client.find_providers("serve:late"):
+                break
+            await asyncio.sleep(1.0)
+        long_prompt = [7 * j % vocab for j in range(16)]
+        shorts = [[(11 * i + j) % vocab for j in range(16)] for i in range(4)]
+        # Warm every decode shape out of the measurement.
+        await generate_remote(client, "late", [long_prompt], LONG_NEW, timeout=600)
+        await generate_remote(client, "late", [shorts[0]], SHORT_NEW, timeout=600)
+
+        short_lat: list[float] = []
+        long_wall: list[float] = []
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            long_task = asyncio.create_task(
+                generate_remote(client, "late", [long_prompt], LONG_NEW, timeout=600)
+            )
+            await asyncio.sleep(0.3)  # the long decode is now in flight
+
+            async def timed(p):
+                t = time.perf_counter()
+                out = await generate_remote(client, "late", [p], SHORT_NEW, timeout=600)
+                assert len(out[0]) == SHORT_NEW
+                return time.perf_counter() - t
+
+            lats = await asyncio.gather(*(timed(p) for p in shorts))
+            short_lat.extend(lats)
+            await long_task
+            long_wall.append(time.perf_counter() - t0)
+        await execution.cancel()
+        await client.stop(); await worker.stop(); await gw.stop()
+        return {
+            "scheduling": scheduling,
+            "short_p50_ms": round(statistics.median(short_lat) * 1e3, 1),
+            "short_max_ms": round(max(short_lat) * 1e3, 1),
+            "long_wall_s": round(statistics.median(long_wall), 2),
+            "reps": reps,
+            "protocol": f"1x{LONG_NEW}-tok decode in flight, 4x{SHORT_NEW}-tok "
+                        "arrive 0.3s later",
+        }
+
+    return asyncio.run(run())
+
+
+def _concurrent_clients(
+    n_clients: int, batched: bool, model_spec=None, scheduling: str = "window"
+) -> dict:
     """End-to-end through the infer executor over the in-memory fabric:
     ``n_clients`` concurrent requests, with the cross-request batching
     window on (one coalesced decode) or off (max_batch=1 — the pre-r4
-    independent-decode behavior). The wall clock spans first request to
+    independent-decode behavior), or the continuous pool
+    (``scheduling="continuous"``). The wall clock spans first request to
     last response, so queuing and response splitting are all in the number.
     """
     import asyncio
@@ -111,6 +217,8 @@ def _concurrent_clients(n_clients: int, batched: bool, model_spec=None) -> dict:
                 infer=InferExecutorConfig(
                     model=model_spec, serve_name="bench",
                     max_batch=n_clients if batched else 1,
+                    scheduling=scheduling,
+                    pool_slots=n_clients, pool_max_len=512,
                     # negative window = the true pre-r4 path: independent
                     # to_thread decodes under handler concurrency 4, no
                     # chip lock.
@@ -137,7 +245,7 @@ def _concurrent_clients(n_clients: int, batched: bool, model_spec=None) -> dict:
                 for p in prompts
             ))
         b = ex.batchers.get("bench-serve")
-        before = (b.decodes, b.requests) if b else (0, 0)
+        before = (getattr(b, "decodes", 0), b.requests) if b else (0, 0)
         t0 = time.perf_counter()
         outs = await asyncio.gather(*(
             generate_remote(client, "bench", [p], NEW, timeout=600)
@@ -147,9 +255,12 @@ def _concurrent_clients(n_clients: int, batched: bool, model_spec=None) -> dict:
         assert all(len(o) == 1 and len(o[0]) == NEW for o in outs)
         # Deltas over the measured window only (warmups excluded).
         stats = (
-            {"decodes": b.decodes - before[0], "requests": b.requests - before[1]}
+            {"decodes": getattr(b, "decodes", 0) - before[0],
+             "requests": b.requests - before[1]}
             if b else {"decodes": len(prompts), "requests": len(prompts)}
         )
+        if hasattr(b, "chunks"):
+            stats["pool_chunks"] = b.chunks
         await execution.cancel()
         await client.stop(); await worker.stop(); await gw.stop()
         return {
@@ -185,6 +296,23 @@ def main() -> None:
             results[key] = _concurrent_clients(16, batched)
         except Exception as e:
             results[key] = {"error": f"{type(e).__name__}: {e}"[:160]}
+    # VERDICT r5 task 3: continuous batching. Same 16-client burst through
+    # the pool (aggregate must hold the window path's win), plus the
+    # late-arrival protocol the window path structurally loses.
+    try:
+        results["clients16_continuous"] = _concurrent_clients(
+            16, True, model_spec=_llama124m_spec(), scheduling="continuous"
+        )
+        results["clients16_window_llama"] = _concurrent_clients(
+            16, True, model_spec=_llama124m_spec(), scheduling="window"
+        )
+    except Exception as e:
+        results["clients16_continuous"] = {"error": f"{type(e).__name__}: {e}"[:160]}
+    for mode in ("window", "continuous"):
+        try:
+            results[f"late_arrival_{mode}"] = _late_arrival(mode)
+        except Exception as e:
+            results[f"late_arrival_{mode}"] = {"error": f"{type(e).__name__}: {e}"[:160]}
     print(json.dumps(results))
 
 

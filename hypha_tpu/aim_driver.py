@@ -118,12 +118,18 @@ async def serve(
     return server, sink
 
 
-def main(argv: list[str] | None = None) -> int:
-    parser = argparse.ArgumentParser(description="hypha metrics status sink")
+def build_parser() -> argparse.ArgumentParser:
+    parser = argparse.ArgumentParser(
+        prog="hypha-aim-driver", description="hypha metrics status sink"
+    )
     parser.add_argument("--host", default="127.0.0.1")
     parser.add_argument("--port", type=int, default=8875)
     parser.add_argument("--out", help="also append metrics to this JSONL file")
-    args = parser.parse_args(argv)
+    return parser
+
+
+def main(argv: list[str] | None = None) -> int:
+    args = build_parser().parse_args(argv)
     logging.basicConfig(level=logging.INFO, format="%(name)s %(message)s")
 
     async def run() -> None:

@@ -91,7 +91,7 @@ def _cmd_revoke(args) -> int:
     return 0
 
 
-def main(argv: list[str] | None = None) -> int:
+def build_parser() -> argparse.ArgumentParser:
     parser = argparse.ArgumentParser(prog="hypha-certutil", description=__doc__)
     sub = parser.add_subparsers(dest="cmd", required=True)
 
@@ -120,8 +120,11 @@ def main(argv: list[str] | None = None) -> int:
     p.add_argument("--cert", action="append", required=True)
     p.add_argument("--days", type=int, default=365, help="CRL validity (re-issuance deadline)")
     p.set_defaults(fn=_cmd_revoke)
+    return parser
 
-    args = parser.parse_args(argv)
+
+def main(argv: list[str] | None = None) -> int:
+    args = build_parser().parse_args(argv)
     return args.fn(args)
 
 

@@ -1,0 +1,143 @@
+"""Generate per-command CLI reference markdown from the argparse trees.
+
+The reference auto-generates its CLI docs at build time (clap-markdown in
+each crate's build.rs → docs/reference/*.md); this is the same role for
+the argparse-based binaries. Output is deterministic, so a test can assert
+the committed docs match a fresh render (no drift).
+
+Regenerate:
+
+    python -m hypha_tpu.docgen docs/reference
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+from pathlib import Path
+
+__all__ = ["render_tool", "write_reference", "TOOLS"]
+
+
+def _tools() -> dict:
+    from . import aim_driver, certutil, cli
+    from .executor import training
+
+    return {
+        "hypha-tpu": (
+            cli.build_parser,
+            "Node runtimes: gateway / scheduler / worker / data, each with "
+            "init / probe / run.",
+        ),
+        "hypha-certutil": (
+            certutil.build_parser,
+            "Dev PKI: root CA, org CAs, node certs, CRLs.",
+        ),
+        "hypha-training-executor": (
+            training.build_parser,
+            "The DiLoCo inner-loop executor the worker launches per job "
+            "(normally spawned by the worker, not by hand).",
+        ),
+        "hypha-aim-driver": (
+            aim_driver.build_parser,
+            "Metrics status sink (JSONL / aim backend).",
+        ),
+    }
+
+
+TOOLS = _tools
+
+
+def _action_rows(parser: argparse.ArgumentParser) -> tuple[list, list]:
+    """(positionals, options) rows, skipping help/subparser actions."""
+    pos, opt = [], []
+    for a in parser._actions:  # argparse offers no public walk API
+        if isinstance(a, (argparse._HelpAction, argparse._SubParsersAction)):
+            continue
+        help_ = (a.help or "").replace("|", "\\|")
+        if not a.option_strings:
+            pos.append((a.metavar or a.dest, help_))
+            continue
+        flags = ", ".join(f"`{s}`" for s in a.option_strings)
+        default = ""
+        if a.default not in (None, False, argparse.SUPPRESS):
+            default = f"`{a.default}`"
+        req = "yes" if a.required else ""
+        opt.append((flags, req, default, help_))
+    return pos, opt
+
+
+def _subparsers(parser: argparse.ArgumentParser) -> dict:
+    for a in parser._actions:
+        if isinstance(a, argparse._SubParsersAction):
+            return dict(a.choices)
+    return {}
+
+
+def _render(parser: argparse.ArgumentParser, title: str, depth: int) -> list[str]:
+    out = [f"{'#' * min(depth, 6)} `{title}`", ""]
+    if parser.description:
+        out += [parser.description.strip(), ""]
+    usage = parser.format_usage().replace("usage: ", "").strip()
+    out += ["**Usage:** `" + " ".join(usage.split()) + "`", ""]
+    pos, opt = _action_rows(parser)
+    if pos:
+        out += ["| argument | description |", "|---|---|"]
+        out += [f"| `{n}` | {h} |" for n, h in pos]
+        out += [""]
+    if opt:
+        out += ["| option | required | default | description |", "|---|---|---|---|"]
+        out += [f"| {f} | {r} | {d} | {h} |" for f, r, d, h in opt]
+        out += [""]
+    for name, sub in _subparsers(parser).items():
+        out += _render(sub, f"{title} {name}", depth + 1)
+    return out
+
+
+def render_tool(name: str) -> str:
+    build, blurb = _tools()[name]
+    parser = build()
+    lines = _render(parser, name, 1)
+    # Insert the one-line tool blurb under the title.
+    lines.insert(2, blurb)
+    lines.insert(3, "")
+    lines.append("")
+    return "\n".join(lines)
+
+
+def render_index() -> str:
+    lines = [
+        "# CLI reference",
+        "",
+        "Generated from the argparse trees by `python -m hypha_tpu.docgen "
+        "docs/reference` — do not edit by hand (a test asserts these files "
+        "match a fresh render).",
+        "",
+    ]
+    for name, (_b, blurb) in _tools().items():
+        lines.append(f"- [`{name}`]({name}.md) — {blurb}")
+    lines.append("")
+    return "\n".join(lines)
+
+
+def write_reference(out_dir: Path) -> dict[str, str]:
+    """Render everything; returns {relative filename: content}."""
+    files = {"README.md": render_index()}
+    for name in _tools():
+        files[f"{name}.md"] = render_tool(name)
+    out_dir.mkdir(parents=True, exist_ok=True)
+    for rel, content in files.items():
+        (out_dir / rel).write_text(content)
+    return files
+
+
+def main(argv: list[str] | None = None) -> int:
+    args = argv if argv is not None else sys.argv[1:]
+    out = Path(args[0]) if args else Path("docs/reference")
+    files = write_reference(out)
+    print(f"wrote {len(files)} files to {out}")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

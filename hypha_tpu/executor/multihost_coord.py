@@ -38,12 +38,13 @@ __all__ = [
     "OP_INIT",
     "OP_STEP",
     "OP_MERGE",
+    "OP_GATHER",
     "OP_DONE",
 ]
 
 log = logging.getLogger("hypha.executor.multihost")
 
-OP_INIT, OP_STEP, OP_MERGE, OP_DONE = 0, 1, 2, 3
+OP_INIT, OP_STEP, OP_MERGE, OP_DONE, OP_GATHER = 0, 1, 2, 3, 4
 
 
 def _encode(payload: dict[str, np.ndarray]) -> bytes:
@@ -189,8 +190,29 @@ class LeaderCoordination:
     def merge(self, flat_update: dict[str, np.ndarray]) -> None:
         self.mh.send(OP_MERGE, {f"u/{k}": np.asarray(v) for k, v in flat_update.items()})
 
+    def gather(self, params) -> Any:
+        """Collective Δθ support: fetch the FULL param tree to this host.
+
+        With a mesh spanning processes, param shards live on devices the
+        leader cannot address, so ``jax.device_get`` cannot produce the
+        delta file (caught by the 4-process test — the 2-process mesh
+        layout happened to keep fsdp shards process-local). The gather is
+        itself a collective, so followers mirror it via OP_GATHER.
+        """
+        self.mh.send(OP_GATHER, None)
+        return _allgather_host(params)
+
     def done(self) -> None:
         self.mh.send(OP_DONE, None)
+
+
+def _allgather_host(params):
+    from jax.experimental import multihost_utils as mhu
+
+    import jax
+
+    gathered = mhu.process_allgather(params, tiled=True)
+    return jax.tree.map(np.asarray, gathered)
 
 
 def run_training_follower() -> int:
@@ -323,6 +345,10 @@ def run_training_follower() -> int:
             assert payload is not None
             batch = {k[2:]: payload[k] for k in payload if k.startswith("b/")}
             state, _metrics = step(state, place(batch))
+        elif op == OP_GATHER:
+            # The leader is assembling Δθ on its host; the allgather is a
+            # collective every process must join. Result discarded here.
+            _allgather_host(state.params)
         elif op == OP_MERGE:
             assert payload is not None
             # The leader computed Δθ locally to ship it; that op has no

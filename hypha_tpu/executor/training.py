@@ -56,6 +56,61 @@ __all__ = ["run_training", "main", "TrainResult"]
 
 log = logging.getLogger("hypha.executor.training")
 
+# Multihost liveness bound: a lost follower process leaves the leader's
+# cross-process collectives (and therefore the loss fetch) blocked forever
+# — jax.distributed's own heartbeat detection is minutes away and may hard-
+# kill the process instead of failing the job. Any collective-bearing phase
+# exceeding this raises, so the bridge reports a clean job failure the
+# scheduler can re-auction. Overridable for tests / long compiles.
+_MH_STEP_TIMEOUT_ENV = "HYPHA_MULTIHOST_STEP_TIMEOUT"
+_MH_STEP_TIMEOUT_DEFAULT = 600.0
+# The FIRST dispatch of each jitted multihost program compiles on every
+# process — minutes at 7B scale — so the liveness bound only tightens once
+# a program has run end-to-end at least once.
+_MH_COMPILE_GRACE_ENV = "HYPHA_MULTIHOST_COMPILE_GRACE"
+_MH_COMPILE_GRACE_DEFAULT = 1800.0
+
+
+def _with_deadline(fn: Callable[[], Any], timeout: float, what: str):
+    """Run ``fn`` in a daemon thread with a wall-clock bound.
+
+    On timeout the worker thread is abandoned (a thread blocked inside a
+    collective cannot be cancelled) and the caller raises — the executor
+    process is about to exit over the bridge's failure path anyway, and a
+    daemon thread cannot keep it alive.
+    """
+    import threading
+
+    box: dict[str, Any] = {}
+
+    def work() -> None:
+        try:
+            box["value"] = fn()
+        except BaseException as e:  # surfaced below on the caller thread
+            box["error"] = e
+
+    t = threading.Thread(target=work, daemon=True, name="mh-step")
+    t.start()
+    t.join(timeout)
+    if t.is_alive():
+        raise RuntimeError(
+            f"multihost {what} did not complete within {timeout:.0f}s — "
+            "follower process lost? (job fails instead of hanging; "
+            f"tune ${_MH_STEP_TIMEOUT_ENV})"
+        )
+    if "error" in box:
+        raise box["error"]
+    return box["value"]
+
+
+def _mh_done_bounded(mh) -> None:
+    """Best-effort OP_DONE: with a follower already dead, the done
+    broadcast itself blocks — never let the cleanup path hang the job."""
+    try:
+        _with_deadline(mh.done, 30.0, "done broadcast")
+    except Exception as e:
+        log.warning("multihost done broadcast failed: %s", e)
+
 def _non_causal_types():
     from ..models.heads import HEAD_TYPES
 
@@ -274,6 +329,7 @@ def run_training(
     # still be host/single-device arrays (global arrays spanning another
     # process cannot be fetched locally).
     mh = None
+    host_anchor = None
     if jax.process_count() > 1:
         if mesh is None:
             # Fail fast HERE: the follower asserts a mesh exists, and a
@@ -291,6 +347,12 @@ def run_training(
             json.dumps(messages.to_json_dict(spec)), state, first_batch,
             frozen=frozen,
         )
+        # θ₀ on the HOST, captured while state is still host/single-device
+        # arrays: cross-process meshes shard params onto devices this
+        # process cannot address, so a device anchor would be unreadable at
+        # delta time (refreshed each round from the OP_GATHER allgather +
+        # the merged update).
+        host_anchor = jax.tree.map(np.asarray, jax.device_get(state.params))
         log.info(
             "multihost leader: %d processes, %d global devices",
             jax.process_count(), len(jax.devices()),
@@ -371,10 +433,13 @@ def run_training(
             # state, so aliased buffers would be deleted on the next step.
             return jax.tree.map(jnp.copy, tree)
 
-        anchor = snapshot(state.params)  # θ₀: the round anchor
+        # Multihost keeps its anchor on the host (captured at mh.init above,
+        # while state was still addressable); single-process keeps the
+        # jitted device anchor.
+        anchor = None if mh is not None else snapshot(state.params)
     except BaseException:
         if mh is not None:
-            mh.done()  # followers must never hang on a dead leader
+            _mh_done_bounded(mh)  # followers must never hang on a dead leader
         raise
     result = TrainResult()
     countdown: int | None = None
@@ -388,11 +453,37 @@ def run_training(
 
     def do_update() -> bool:
         """Ship Δθ, wait for the PS broadcast, merge. True = next round."""
-        nonlocal state, anchor, round_num, round_samples
+        nonlocal state, anchor, host_anchor, round_num, round_samples
         session.send_status(Progress(kind=ProgressKind.UPDATE, job_id=spec.job_id))
-        delta = extract_delta(state.params, anchor)
+        host_params = None
+        if mh is not None:
+            # Collective Δθ: the allgather every process joins (OP_GATHER),
+            # then host-side subtraction against the host anchor — param
+            # shards on other processes' devices cannot be device_get here.
+            host_params = _with_deadline(
+                lambda: mh.gather(state.params), mh_bound("gather"),
+                "param gather",
+            )
+            compiled_once["gather"] = True
+            host_delta = jax.tree.map(
+                lambda p, a: p - a, host_params, host_anchor
+            )
+        else:
+            delta = extract_delta(state.params, anchor)
+            host_delta = jax.device_get(delta)
+        if cfg.delta_dtype == "bfloat16":
+            # bf16 wire format: halves the upload; the PS accumulates in
+            # f32 (worker/ps_executor.py + native kernel both widen).
+            import ml_dtypes
+
+            host_delta = jax.tree.map(
+                lambda a: np.asarray(a).astype(ml_dtypes.bfloat16)
+                if np.asarray(a).dtype == np.float32
+                else np.asarray(a),
+                host_delta,
+            )
         delta_path = work_dir / f"delta-{round_num}.safetensors"
-        save_tree(delta_path, jax.device_get(delta))
+        save_tree(delta_path, host_delta)
         session.send_resource(
             cfg.updates,
             delta_path.name,
@@ -416,10 +507,23 @@ def run_training(
         update_file = work_dir / event["path"]
         flat = load_flat(update_file)
         if mh is not None:
-            mh.merge(flat)  # followers mirror the merge dispatch
+            # followers mirror the merge dispatch; bounded like the step
+            # broadcasts — a lost follower must fail the job, not hang it
+            _with_deadline(
+                lambda: mh.merge(flat), mh_bound("merge"), "merge broadcast"
+            )
+            compiled_once["merge"] = True
         update = unflatten_like(flat, state.params)
         state = state.replace(params=merge_update(state.params, update))
-        anchor = snapshot(state.params)
+        if mh is not None:
+            # New anchor = merged params, assembled on the host from the
+            # round's gathered params + the same update the device merge
+            # applied — no second collective needed.
+            host_anchor = jax.tree.map(
+                lambda p, u: p + np.asarray(u, p.dtype), host_params, update
+            )
+        else:
+            anchor = snapshot(state.params)
         delta_path.unlink(missing_ok=True)
         # The broadcast update is merged — drop it, or a long job accumulates
         # one full-parameter-sized file per round under work_dir/incoming.
@@ -432,16 +536,49 @@ def run_training(
         round_samples = 0
         round_losses.clear()
         if ckpt_dir is not None and round_num % ckpt_every == 0:
-            # Manifest round counts CUMULATIVE completed rounds across
-            # resumes, not just this execution's.
-            save_train_checkpoint(
-                ckpt_dir,
-                state.params,
-                state.opt_state,
-                int(state.step),
-                round_offset + round_num,
-            )
+            if mh is not None:
+                # Sharded opt_state spans non-addressable devices; a full
+                # host gather of params+opt per round is not worth wiring
+                # until a job needs it (sharded orbax-style checkpointing
+                # is the real fix). Resume still works via the PS momentum
+                # checkpoint + re-dispatch from θ of the last round.
+                log.warning(
+                    "checkpointing skipped: multihost replicas do not yet "
+                    "support train-state checkpoints"
+                )
+            else:
+                # Manifest round counts CUMULATIVE completed rounds across
+                # resumes, not just this execution's.
+                save_train_checkpoint(
+                    ckpt_dir,
+                    state.params,
+                    state.opt_state,
+                    int(state.step),
+                    round_offset + round_num,
+                )
         return resp.kind == ProgressResponseKind.CONTINUE
+
+    import os as _os
+
+    mh_timeout = float(
+        _os.environ.get(_MH_STEP_TIMEOUT_ENV, _MH_STEP_TIMEOUT_DEFAULT)
+    )
+    mh_grace = max(
+        mh_timeout,
+        float(_os.environ.get(_MH_COMPILE_GRACE_ENV, _MH_COMPILE_GRACE_DEFAULT)),
+    )
+    compiled_once = {"step": False, "merge": False, "gather": False}
+
+    def mh_bound(what: str) -> float:
+        return mh_timeout if compiled_once[what] else mh_grace
+
+    def run_one(batch):
+        """Broadcast + dispatch + host fetch: every phase that can block on
+        a dead follower, so the deadline covers all of them."""
+        if mh is not None:
+            mh.step(batch)  # followers dispatch the same step
+        new_state, metrics = step(state, place(batch))
+        return new_state, metrics, float(metrics["loss"])
 
     t0 = time.monotonic()
     try:
@@ -450,9 +587,12 @@ def run_training(
                 log.info("cooperative stop requested; ending training loop")
                 break
             if mh is not None:
-                mh.step(batch)  # followers dispatch the same step
-            state, metrics = step(state, place(batch))
-            loss = float(metrics["loss"])
+                state, metrics, loss = _with_deadline(
+                    lambda b=batch: run_one(b), mh_bound("step"), "train step"
+                )
+                compiled_once["step"] = True
+            else:
+                state, metrics, loss = run_one(batch)
             round_losses.append(loss)
             result.losses.append(loss)
             result.batches += 1
@@ -481,7 +621,7 @@ def run_training(
                 break
     finally:
         if mh is not None:
-            mh.done()  # followers must never hang on a dead leader
+            _mh_done_bounded(mh)  # followers must never hang on a dead leader
     log.info(
         "training done: %d rounds, %d batches, %.1fs, last loss %.4f",
         result.rounds, result.batches, time.monotonic() - t0, result.last_loss,
@@ -489,13 +629,20 @@ def run_training(
     return result
 
 
-def main(argv: list[str] | None = None) -> int:
-    parser = argparse.ArgumentParser(description="hypha-tpu DiLoCo training executor")
+def build_parser() -> argparse.ArgumentParser:
+    parser = argparse.ArgumentParser(
+        prog="hypha-training-executor",
+        description="hypha-tpu DiLoCo training executor",
+    )
     parser.add_argument("--socket", required=True, help="bridge unix socket path")
     parser.add_argument("--work-dir", required=True)
     parser.add_argument("--job", required=True, help="job spec JSON (inline or @file)")
     parser.add_argument("--max-batches", type=int, default=None)
-    args = parser.parse_args(argv)
+    return parser
+
+
+def main(argv: list[str] | None = None) -> int:
+    args = build_parser().parse_args(argv)
     logging.basicConfig(level=logging.INFO, format="%(name)s %(message)s")
 
     raw = args.job

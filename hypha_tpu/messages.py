@@ -453,6 +453,12 @@ class TrainExecutorConfig:
     # device; Δθ shipped to the PS is the ADAPTER delta only, so DiLoCo
     # round traffic shrinks by ~the base/adapter ratio (1600x at 7B r8).
     lora: dict | None = None
+    # Wire dtype for the shipped Δθ ("float32" | "bfloat16"): bf16 halves
+    # a 7B round's upload (27 GB -> 13.5 GB per worker). The PS widens to
+    # f32 for the weighted mean and keeps momentum/update f32, so only the
+    # shipped differences round — not the compounding outer state. Additive
+    # field: absent on the wire = f32, old peers interop.
+    delta_dtype: str = "float32"
 
 
 @register
@@ -494,6 +500,21 @@ class InferExecutorConfig:
     # pre-batching behavior). Additive field: absent on the wire = default,
     # so old peers interop.
     batch_window_ms: float = 4.0
+    # Request scheduling: "auto" runs the continuous-batching pool
+    # (iteration-level admission over a fixed KV-slot pool,
+    # executor.pool) for model families with a per-row decode path and
+    # falls back to the window batcher otherwise; "window"/"continuous"
+    # force one. Additive field, same interop note as above.
+    scheduling: str = "auto"
+    # Pool geometry (continuous scheduling only): KV rows held on-device
+    # and each row's static window (prompt bucket + new tokens must fit).
+    # 0 = derive: slots from max_batch, window from the model's limit
+    # capped at 1024.
+    pool_slots: int = 0
+    pool_max_len: int = 0
+    # Decode steps per dispatched program: admission/release latency is one
+    # chunk; dispatch overhead amortizes over it.
+    pool_chunk: int = 8
 
 
 @register

@@ -167,6 +167,10 @@ class _Attention(nn.Module):
     attn_impl: Callable | None = None
     decode: bool = False  # autoregressive serving: KV cache in the "cache"
     decode_len: int = 0  # static cache capacity (prompt + new tokens)
+    # Continuous-batching pool mode: every row carries its own cache index
+    # and left-pad start (executor.pool.DecodePool admits/releases rows at
+    # token boundaries, so rows sit at different positions).
+    per_row_decode: bool = False
 
     def _proj(self, x, features, use_bias, dtype, name):
         """Dense projection, plus the low-rank LoRA path when enabled.
@@ -219,6 +223,32 @@ class _Attention(nn.Module):
             # RoPE needs absolute positions, i.e. the cache index BEFORE
             # this step's write — the prepare hook runs against it.
             roped = {}
+
+            if self.per_row_decode:
+                # Pool rows are left-padded into their window: RoPE runs on
+                # LOGICAL positions (cache index minus the row's pad
+                # boundary), and attention masks keys below the boundary.
+                def _rope_rows(offset, start):
+                    logical = jnp.maximum(
+                        offset[:, None] - start[:, None] + jnp.arange(S)[None, :],
+                        0,
+                    )
+                    roped["q"] = apply_rope(q, cos, sin, positions=logical)
+                    return (
+                        apply_rope(k, cos, sin, positions=logical).astype(dtype),
+                        v.astype(dtype),
+                    )
+
+                full_k, full_v, offset, start = update_kv_cache(
+                    self, k, v, self.decode_len, prepare=_rope_rows,
+                    per_row=True,
+                )
+                attn = dot_product_attention(
+                    roped["q"], full_k, full_v, causal=True, q_offset=offset,
+                    window=cfg.sliding_window, k_start=start,
+                )
+                attn = attn.reshape(B, S, cfg.num_heads * hd)
+                return self._proj(attn, E, False, dtype, "o_proj")
 
             def _rope_at(offset):
                 positions = jnp.broadcast_to(offset + jnp.arange(S), (B, S))
@@ -294,12 +324,14 @@ class _Block(nn.Module):
     attn_impl: Callable | None = None
     decode: bool = False
     decode_len: int = 0
+    per_row_decode: bool = False
 
     @nn.compact
     def __call__(self, x, cos, sin):
         cfg = self.config
         x = x + _Attention(
-            cfg, self.attn_impl, self.decode, self.decode_len, name="self_attn"
+            cfg, self.attn_impl, self.decode, self.decode_len,
+            self.per_row_decode, name="self_attn"
         )(_RMSNorm(cfg.rms_eps, cfg.rms_offset, name="input_layernorm")(x), cos, sin)
         x = x + _MLP(cfg, name="mlp")(
             _RMSNorm(cfg.rms_eps, cfg.rms_offset, name="post_attention_layernorm")(x)
@@ -312,6 +344,7 @@ class Llama(nn.Module):
     attn_impl: Callable | None = None  # e.g. a ring-attention closure
     decode: bool = False  # serving mode: KV-cached autoregressive forward
     decode_len: int = 0
+    per_row_decode: bool = False  # continuous-batching pool (executor.pool)
     # with_head=False returns final hidden states [B, S, E] — the
     # chunked-CE training path (executor.train.chunked_causal_ce) projects
     # to vocab inside the loss so [B, S, 32000] f32 logits never
@@ -340,7 +373,7 @@ class Llama(nn.Module):
         for i in range(cfg.num_layers):
             x = block_cls(
                 cfg, self.attn_impl, self.decode, self.decode_len,
-                name=f"layers_{i}",
+                self.per_row_decode, name=f"layers_{i}",
             )(x, cos, sin)
         x = _RMSNorm(cfg.rms_eps, cfg.rms_offset, name="norm")(x)
         if not self.with_head:

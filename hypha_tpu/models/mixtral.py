@@ -43,6 +43,12 @@ class MixtralConfig:
     router_aux_coef: float = 0.02
     dtype: str = "bfloat16"
     remat: bool = False  # gradient checkpointing per block (see gpt2.py)
+    # Drop-free TRAINING (serving decode is always dropless): every token
+    # reaches its top-k experts at E/K x the expert FLOPs — reachable from
+    # job specs via {"config": {"dropless": true}}, so the capacity-vs-
+    # dropless fidelity tradeoff (MOE_r05.json) is an operator choice, not
+    # a code edit.
+    dropless: bool = False
 
     @classmethod
     def mixtral_8x7b(cls) -> "MixtralConfig":
@@ -143,6 +149,14 @@ class MoELayer(nn.Module):
         onehot = jax.nn.one_hot(top_idx, E, dtype=jnp.float32)  # [B, S, K, E]
         pos = jnp.cumsum(onehot.reshape(B, S * K, E), axis=1).reshape(B, S, K, E) - onehot
         keep = (pos < C) * onehot  # [B, S, K, E]
+        # Observability for the capacity-routing fidelity question
+        # (MOE_r05): fraction of (token, expert-slot) assignments dropped
+        # this step. Recorded only when callers apply with
+        # mutable=["intermediates"] — zero cost in the jitted train step.
+        self.sow(
+            "intermediates", "drop_frac",
+            1.0 - keep.sum() / jnp.maximum(onehot.sum(), 1.0),
+        )
         pos_cap = jax.nn.one_hot(pos.astype(jnp.int32), C, dtype=jnp.float32)  # [B, S, K, E, C]
         dispatch = jnp.einsum("bske,bskec->bsec", keep, pos_cap)  # [B, S, E, C]
         combine = jnp.einsum("bsk,bske,bskec->bsec", top_w, keep, pos_cap)
@@ -176,15 +190,21 @@ class _MoEBlock(nn.Module):
     decode: bool = False  # KV-cached serving (the shared llama attention)
     decode_len: int = 0
     dropless: bool = False  # drop-free MoE routing (see MoELayer)
+    per_row_decode: bool = False  # continuous-batching pool (executor.pool)
 
     @nn.compact
     def __call__(self, x, cos, sin):
         cfg = self.config
         lcfg = cfg.as_llama()
         x = x + _Attention(
-            lcfg, self.attn_impl, self.decode, self.decode_len, name="self_attn"
+            lcfg, self.attn_impl, self.decode, self.decode_len,
+            self.per_row_decode, name="self_attn"
         )(_RMSNorm(cfg.rms_eps, name="input_layernorm")(x), cos, sin)
-        moe_out, aux = MoELayer(cfg, dropless=self.decode or self.dropless, name="moe")(
+        moe_out, aux = MoELayer(
+            cfg,
+            dropless=self.decode or self.dropless or cfg.dropless,
+            name="moe",
+        )(
             _RMSNorm(cfg.rms_eps, name="post_attention_layernorm")(x)
         )
         return x + moe_out, aux
@@ -196,10 +216,15 @@ class Mixtral(nn.Module):
     decode: bool = False  # serving mode: KV-cached autoregressive forward
     decode_len: int = 0
     dropless: bool = False  # drop-free routing in the plain forward too
+    per_row_decode: bool = False  # continuous-batching pool (executor.pool)
+    # with_head=False returns (hidden [B, S, E], aux) for the chunked-CE
+    # training path (see llama.py / gpt2.py).
+    with_head: bool = True
 
     @nn.compact
     def __call__(self, input_ids: jnp.ndarray) -> tuple:
-        """input_ids [B, S] -> (logits [B, S, vocab] f32, aux_loss scalar)."""
+        """input_ids [B, S] -> (logits [B, S, vocab] f32, aux_loss scalar),
+        or (hidden, aux) when ``with_head=False``."""
         cfg = self.config
         dtype = jnp.dtype(cfg.dtype)
         from ..ops.rope import rope_frequencies
@@ -220,10 +245,12 @@ class Mixtral(nn.Module):
         for i in range(cfg.num_layers):
             x, aux = block_cls(
                 cfg, self.attn_impl, self.decode, self.decode_len,
-                self.dropless, name=f"layers_{i}",
+                self.dropless, self.per_row_decode, name=f"layers_{i}",
             )(x, cos, sin)
             aux_total = aux_total + aux
         x = _RMSNorm(cfg.rms_eps, name="norm")(x)
+        if not self.with_head:
+            return x, aux_total
         lm_head = self.param(
             "lm_head",
             nn.initializers.normal(0.02),

@@ -23,16 +23,20 @@ def dot_product_attention(
     causal: bool = True,
     mask: jnp.ndarray | None = None,  # [B, 1, Sq, Sk] or broadcastable, bool
     softmax_scale: float | None = None,
-    q_offset: int = 0,
+    q_offset=0,  # int scalar, or int32 [B] per-row offsets
     window: int | None = None,
+    k_start=None,  # int32 [B]: keys before start_b are masked (pad slots)
 ) -> jnp.ndarray:
     """Scaled dot-product attention with grouped-query support.
 
     ``q_offset`` shifts the causal diagonal — used for decoding (queries start
     at position ``q_offset`` of the kv sequence) and by the ring-attention
-    blocks. ``window`` applies Mistral-style local attention (query i sees
-    keys in (i-window, i]); the band comparison is built from iotas inline so
-    XLA fuses it into the masked softmax instead of loading a materialized
+    blocks; a [B] vector gives every row its own diagonal (continuous-
+    batching pool, where rows sit at different positions). ``k_start``
+    masks keys below a per-row floor — the left-pad slots of pooled rows.
+    ``window`` applies Mistral-style local attention (query i sees keys in
+    (i-window, i]); all comparisons are built from iotas inline so XLA
+    fuses them into the masked softmax instead of loading a materialized
     [Sq, Sk] mask from HBM.
     """
     B, Sq, H, D = q.shape
@@ -48,13 +52,18 @@ def dot_product_attention(
     logits = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
     logits = logits.astype(jnp.float32)  # softmax in f32 for stability
 
-    if causal or window is not None:
-        qi = jnp.arange(Sq)[:, None] + q_offset
-        ki = jnp.arange(Sk)[None, :]
+    if causal or window is not None or k_start is not None:
+        offset = jnp.asarray(q_offset, jnp.int32)
+        # qi/ki broadcast to [B, Sq, Sk] when offset or k_start is per-row;
+        # stay [1, Sq, Sk] in the scalar case (XLA folds the size-1 batch).
+        qi = offset.reshape(-1, 1, 1) + jnp.arange(Sq)[None, :, None]
+        ki = jnp.arange(Sk)[None, None, :]
         keep = qi >= ki if causal else jnp.bool_(True)
         if window is not None:
             keep = keep & (ki > qi - window)
-        logits = jnp.where(keep[None, None], logits, -jnp.inf)
+        if k_start is not None:
+            keep = keep & (ki >= k_start.reshape(-1, 1, 1))
+        logits = jnp.where(keep[:, None], logits, -jnp.inf)
     if mask is not None:
         logits = jnp.where(mask, logits, -jnp.inf)
 

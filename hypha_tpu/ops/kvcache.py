@@ -4,6 +4,18 @@ One implementation of the cache bookkeeping (variable declaration,
 dynamic_update_slice writes, index advance) used by every model family's
 decode branch (models.gpt2, models.llama) — a cache-layout change lands
 once, not per family.
+
+Two modes:
+
+* **scalar** (default): one cache index shared by every row — the one-shot
+  ``executor.generate`` path, where all rows prefill and decode in
+  lockstep.
+* **per-row** (``per_row=True``): each row carries its own write index and
+  window start — the continuous-batching serving pool
+  (``executor.pool.DecodePool``), where rows are admitted and released at
+  token boundaries and therefore sit at different positions. The ``start``
+  vector marks where each row's left-padded prompt begins so attention can
+  mask the pad slots (and RoPE can compute logical positions) per row.
 """
 
 from __future__ import annotations
@@ -15,27 +27,60 @@ __all__ = ["update_kv_cache"]
 
 
 def update_kv_cache(
-    module, k: jnp.ndarray, v: jnp.ndarray, decode_len: int, prepare=None
+    module,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    decode_len: int,
+    prepare=None,
+    *,
+    per_row: bool = False,
 ):
     """Append this step's K/V into ``module``'s cache collection.
 
     ``k``/``v``: [B, S, H_kv, D] for the current positions. Returns
     ``(full_k, full_v, offset)`` — the cache contents [B, decode_len, H_kv,
-    D] and the integer position of this step's first token (the attention
-    ``q_offset``). ``prepare(offset) -> (k, v)`` lets position-dependent
-    transforms (RoPE) run against the pre-update index before the write —
-    flax forbids declaring the same variable twice, so peeking the index
-    outside this helper is not possible. Must be called from inside a flax
-    module in decode mode; declares ``cache`` variables k/v/idx on it.
+    D] and the position of this step's first token (the attention
+    ``q_offset``) — or ``(full_k, full_v, offset, start)`` in per-row
+    mode, where ``offset``/``start`` are int32 [B] vectors.
+    ``prepare(offset)`` (scalar) / ``prepare(offset, start)`` (per-row)
+    ``-> (k, v)`` lets position-dependent transforms (RoPE) run against
+    the pre-update index before the write — flax forbids declaring the
+    same variable twice, so peeking the index outside this helper is not
+    possible. Must be called from inside a flax module in decode mode;
+    declares ``cache`` variables k/v/idx (and ``start`` in per-row mode)
+    on it.
+
+    Per-row mode: ``idx``/``start`` are [B] vectors the serving pool
+    overwrites directly in the cache tree when admitting rows (``start``
+    marks each row's left-pad boundary). Writes use a scatter at
+    (row, idx_row + j); out-of-range indices (a released row decoding
+    past ``decode_len``) are DROPPED by XLA scatter semantics, so stale
+    rows can never corrupt live ones.
     """
     B, S, Hkv, D = k.shape
-    idx = module.variable("cache", "idx", lambda: jnp.zeros((), jnp.int32))
+    if per_row:
+        idx = module.variable(
+            "cache", "idx", lambda: jnp.zeros((B,), jnp.int32)
+        )
+        start = module.variable(
+            "cache", "start", lambda: jnp.zeros((B,), jnp.int32)
+        )
+    else:
+        idx = module.variable("cache", "idx", lambda: jnp.zeros((), jnp.int32))
+        start = None
     offset = idx.value
     if prepare is not None:
-        k, v = prepare(offset)
+        k, v = prepare(offset, start.value) if per_row else prepare(offset)
     dtype = k.dtype
     ck = module.variable("cache", "k", jnp.zeros, (B, decode_len, Hkv, D), dtype)
     cv = module.variable("cache", "v", jnp.zeros, (B, decode_len, Hkv, D), dtype)
+    if per_row:
+        rows = jnp.arange(B)[:, None]  # [B, 1]
+        cols = offset[:, None] + jnp.arange(S)[None, :]  # [B, S]
+        ck.value = ck.value.at[rows, cols].set(k, mode="drop")
+        cv.value = cv.value.at[rows, cols].set(v, mode="drop")
+        idx.value = offset + S
+        return ck.value, cv.value, offset, start.value
     ck.value = jax.lax.dynamic_update_slice(ck.value, k, (0, offset, 0, 0))
     cv.value = jax.lax.dynamic_update_slice(cv.value, v, (0, offset, 0, 0))
     idx.value = offset + S

@@ -66,6 +66,9 @@ class DiLoCoJob:
     # "targets": [..]?} — workers train/ship LoRA adapters only (the Δθ the
     # PS averages shrinks by the base/adapter ratio; see executor/lora.py).
     lora: dict | None = None
+    # Wire dtype for shipped Δθ ("float32" | "bfloat16"): bf16 halves a 7B
+    # round's upload; the PS accumulates/keeps state in f32 either way.
+    delta_dtype: str = "float32"
     # Net-new checkpoint/resume: workers save under
     # <checkpoint_dir>/<peer_id>, the PS under <checkpoint_dir>/ps (paths are
     # per-host). Unset checkpoint_dir — or checkpoint_every <= 0 — disables.
@@ -73,6 +76,10 @@ class DiLoCoJob:
     checkpoint_every: int = 1
 
     def __post_init__(self) -> None:
+        if self.delta_dtype not in ("float32", "bfloat16"):
+            raise ValueError(
+                f"delta_dtype must be float32|bfloat16, got {self.delta_dtype!r}"
+            )
         if self.rounds.update_rounds <= 0:
             raise ValueError("update_rounds must be positive")
         if self.rounds.avg_samples_between_updates <= 0:

@@ -334,6 +334,7 @@ class Orchestrator:
                             loss=job.loss,
                             sharding=job.sharding,
                             lora=job.lora,
+                            delta_dtype=job.delta_dtype,
                             checkpoint=(
                                 {
                                     "dir": f"{job.checkpoint_dir}/{handle.peer_id}",

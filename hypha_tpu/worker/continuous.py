@@ -1,0 +1,94 @@
+"""Serving adapter for the continuous-batching decode pool.
+
+Bridges the async worker runtime onto :class:`executor.pool.DecodePool`:
+greedy requests go straight into the pool (admitted into free KV rows at
+the next chunk boundary — iteration-level scheduling); sampled requests
+keep the one-shot fallback path, since per-row draws from a shared rng key
+would make their outputs depend on batch composition (the same
+reproducibility policy as worker.batcher, whose window this replaces for
+pool-capable model families).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+from typing import Any, Callable
+
+from ..executor.pool import DecodePool
+
+__all__ = ["PoolServer"]
+
+log = logging.getLogger("hypha.worker.continuous")
+
+
+class PoolServer:
+    """Drop-in for RequestBatcher.submit()/close() over a DecodePool.
+
+    ``run_fallback`` is the blocking one-shot generation function used for
+    sampled requests ``(prompts, n_new, temperature, top_k, seed) ->
+    list[list[int]]``. Sampled decodes run in worker threads and contend
+    with the pool only in the device queue — the pool never blocks on
+    them.
+    """
+
+    def __init__(
+        self,
+        model: Any,
+        params: Any,
+        run_fallback: Callable[..., list],
+        *,
+        slots: int,
+        max_len: int,
+        steps_per_call: int = 8,
+        eos_token_id: int | None = None,
+    ) -> None:
+        self.pool = DecodePool(
+            model,
+            params,
+            slots=slots,
+            max_len=max_len,
+            steps_per_call=steps_per_call,
+            eos_token_id=eos_token_id,
+        )
+        self._run_fallback = run_fallback
+        self._closed = False
+        # stats, read by tests and the serving bench (names mirror
+        # RequestBatcher where the meaning carries over)
+        self.requests = 0
+        self.fallbacks = 0  # sampled + oversized-greedy one-shot decodes
+
+    @property
+    def chunks(self) -> int:
+        return self.pool.chunks
+
+    async def submit(
+        self,
+        prompts: list,
+        n_new: int,
+        temperature: float,
+        top_k: int | None,
+        seed: int,
+    ) -> list:
+        if self._closed:
+            raise RuntimeError("server is closed")
+        self.requests += 1
+        if temperature == 0.0 and self.pool.fits(prompts, n_new):
+            return await asyncio.wrap_future(
+                self.pool.submit([list(p) for p in prompts], n_new)
+            )
+        # Sampled requests (shared-key reproducibility) AND greedy requests
+        # that exceed the pool window/slots both take the one-shot path —
+        # the window batcher served any prompt up to the model limit, and
+        # pooling must not regress that.
+        self.fallbacks += 1
+        return await asyncio.to_thread(
+            self._run_fallback, prompts, n_new, temperature, top_k, seed
+        )
+
+    def close(self) -> None:
+        # wait=False: called from the job's async cancel path — the serve
+        # thread fails in-flight futures itself; joining here would park
+        # the worker event loop behind a mid-chunk decode.
+        self._closed = True
+        self.pool.close(wait=False)

@@ -251,6 +251,11 @@ class ParameterServerExecutor(JobExecutor):
                 raise ValueError(
                     f"delta {key!r}: size {srcs[0].size} != momentum {m.size}"
                 )
+            if dtype != np.float32:
+                # bf16 wire-format deltas (ml_dtypes.bfloat16 via
+                # safetensors): widen per-tensor for the f32 kernel — the
+                # accumulator/momentum stay f32 like the native path.
+                srcs = [np.asarray(s, np.float32) for s in srcs]
             new_m, upd = native.fused_mean_nesterov(srcs, weights, m, lr, mu)
             momentum[key] = new_m.reshape(shape)
             update[key] = upd.reshape(shape)

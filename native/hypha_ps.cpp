@@ -62,4 +62,34 @@ void fused_mean_nesterov_f32(const float *const *srcs, const float *weights,
   }
 }
 
+// BF16 variant for the wire-format deltas: a 7B round ships ~13.5 GB per
+// worker in bf16 vs 27 GB f32, and the PS is the fan-in point for N of
+// them. Deltas arrive bf16; the accumulator, momentum and update stay f32
+// (bf16's 8 mantissa bits are fine for the SHIPPED deltas — they are
+// differences the outer optimizer averages — but compounding state must
+// not round). bf16 is the f32 high half, so conversion is a shift.
+static inline float bf16_val(uint16_t b) {
+  union {
+    uint32_t u;
+    float f;
+  } cvt;
+  cvt.u = static_cast<uint32_t>(b) << 16;
+  return cvt.f;
+}
+
+void fused_mean_nesterov_bf16(const uint16_t *const *srcs,
+                              const float *weights, int64_t n_srcs,
+                              float *momentum, float *update_out, int64_t n,
+                              float lr, float mu) {
+  for (int64_t i = 0; i < n; ++i) {
+    float g = 0.0f;
+    for (int64_t k = 0; k < n_srcs; ++k) {
+      g += weights[k] * bf16_val(srcs[k][i]);
+    }
+    float m = mu * momentum[i] + g;
+    momentum[i] = m;
+    update_out[i] = lr * (mu * m + g);
+  }
+}
+
 }  // extern "C"

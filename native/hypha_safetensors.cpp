@@ -26,11 +26,15 @@
 #include <sys/stat.h>
 #include <unistd.h>
 
-// Shared flat kernel from hypha_ps.cpp (same shared library).
+// Shared flat kernels from hypha_ps.cpp (same shared library).
 extern "C" void fused_mean_nesterov_f32(const float *const *srcs,
                                         const float *weights, int64_t n_srcs,
                                         float *momentum, float *update_out,
                                         int64_t n, float lr, float mu);
+extern "C" void fused_mean_nesterov_bf16(const uint16_t *const *srcs,
+                                         const float *weights, int64_t n_srcs,
+                                         float *momentum, float *update_out,
+                                         int64_t n, float lr, float mu);
 
 namespace {
 
@@ -427,23 +431,29 @@ int64_t ps_outer_step(const char *const *delta_paths, int64_t n_files,
 
   std::vector<std::vector<float>> new_momentum;
   std::vector<std::vector<float>> updates;
+  std::vector<TensorInfo> out_infos;
   new_momentum.reserve(first.tensors.size());
   updates.reserve(first.tensors.size());
+  out_infos.reserve(first.tensors.size());
   int64_t total = 0;
 
   for (const TensorInfo &t : first.tensors) {
-    if (t.dtype != "F32") {
-      set_err(err, errlen, "non-F32 delta tensor: " + t.name);
+    // Deltas may arrive F32 or BF16 (the bf16 wire format halves a 7B
+    // round's upload); momentum/update state stays F32 throughout.
+    const bool bf16 = t.dtype == "BF16";
+    if (!bf16 && t.dtype != "F32") {
+      set_err(err, errlen, "unsupported delta dtype for tensor: " + t.name);
       return -1;
     }
     int64_t nbytes = t.end - t.begin;
-    int64_t n = nbytes / 4;
+    int64_t n = nbytes / (bf16 ? 2 : 4);
     std::vector<const float *> srcs;
     srcs.reserve(static_cast<size_t>(n_files));
     for (int64_t k = 0; k < n_files; ++k) {
       const StFile &f = files[static_cast<size_t>(k)];
       const TensorInfo *tk = f.find(t.name);
-      if (tk == nullptr || tk->end - tk->begin != nbytes || tk->dtype != "F32") {
+      if (tk == nullptr || tk->end - tk->begin != nbytes ||
+          tk->dtype != t.dtype) {
         set_err(err, errlen, "delta mismatch for tensor: " + t.name);
         return -1;
       }
@@ -456,7 +466,9 @@ int64_t ps_outer_step(const char *const *delta_paths, int64_t n_files,
         // Present but mismatched momentum = wrong model/corruption: fail
         // loudly (matches the Python fallback's size validation). A tensor
         // absent from the momentum file starts at zero, like a fresh key.
-        if (tm->end - tm->begin != nbytes || tm->dtype != "F32") {
+        // Momentum is F32 regardless of the delta wire dtype, so its
+        // expected byte count is n*4, not the delta's nbytes.
+        if (tm->end - tm->begin != n * 4 || tm->dtype != "F32") {
           set_err(err, errlen, "momentum mismatch for tensor: " + t.name);
           return -1;
         }
@@ -468,12 +480,25 @@ int64_t ps_outer_step(const char *const *delta_paths, int64_t n_files,
     if (m_in != nullptr) {
       std::memcpy(m_new.data(), m_in, static_cast<size_t>(n) * 4);
     }
-    // One source of truth for the outer-optimizer math: the shared kernel
+    // One source of truth for the outer-optimizer math: the shared kernels
     // from hypha_ps.cpp (linked into the same library), in-out on m_new.
-    fused_mean_nesterov_f32(srcs.data(), weights, n_files, m_new.data(),
-                            upd.data(), n, lr, mu);
+    if (bf16) {
+      fused_mean_nesterov_bf16(
+          reinterpret_cast<const uint16_t *const *>(srcs.data()), weights,
+          n_files, m_new.data(), upd.data(), n, lr, mu);
+    } else {
+      fused_mean_nesterov_f32(srcs.data(), weights, n_files, m_new.data(),
+                              upd.data(), n, lr, mu);
+    }
     new_momentum.push_back(std::move(m_new));
     updates.push_back(std::move(upd));
+    // Outputs are F32: carry an info row with F32 byte extents so the
+    // writer's offsets stay right when the deltas arrived BF16.
+    TensorInfo out = t;
+    out.dtype = "F32";
+    out.begin = 0;
+    out.end = n * 4;
+    out_infos.push_back(std::move(out));
     total += n;
   }
 
@@ -482,8 +507,8 @@ int64_t ps_outer_step(const char *const *delta_paths, int64_t n_files,
     upd_ptrs.push_back(updates[i].data());
     mom_ptrs.push_back(new_momentum[i].data());
   }
-  if (!write_safetensors_f32(update_out, first.tensors, upd_ptrs, &error) ||
-      !write_safetensors_f32(momentum_out, first.tensors, mom_ptrs, &error)) {
+  if (!write_safetensors_f32(update_out, out_infos, upd_ptrs, &error) ||
+      !write_safetensors_f32(momentum_out, out_infos, mom_ptrs, &error)) {
     set_err(err, errlen, error);
     return -1;
   }

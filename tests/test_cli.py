@@ -190,3 +190,20 @@ def test_quickstart_processes(tmp_path):
     finally:
         for p in reversed(procs):
             p.stop()
+
+
+def test_cli_reference_docs_are_fresh():
+    """docs/reference/ is GENERATED (hypha_tpu.docgen — the clap-markdown
+    role from the reference's build.rs); a hand-edit or a CLI change
+    without regeneration fails here. Fix: python -m hypha_tpu.docgen
+    docs/reference"""
+    import pathlib
+
+    from hypha_tpu import docgen
+
+    out_dir = pathlib.Path(__file__).resolve().parents[1] / "docs" / "reference"
+    fresh = {"README.md": docgen.render_index()}
+    for name in docgen.TOOLS():
+        fresh[f"{name}.md"] = docgen.render_tool(name)
+    on_disk = {p.name: p.read_text() for p in out_dir.glob("*.md")}
+    assert on_disk == fresh
