@@ -439,7 +439,16 @@ def main() -> None:
         worker_b(args)
         return
 
-    result: dict = {"task": "Mixtral-8x7B at real shapes (MOE_r05)"}
+    out = args.out or str(REPO / "MOE_r05.json")
+    # Merge-don't-clobber: parts run as separate invocations. A truncated
+    # artifact (a part killed mid-write) must not brick later parts.
+    result: dict = {}
+    if Path(out).exists():
+        try:
+            result = json.loads(Path(out).read_text())
+        except (json.JSONDecodeError, OSError):
+            result = {}
+    result["task"] = "Mixtral-8x7B at real shapes (MOE_r05)"
     if args.part in ("all", "a"):
         result["memory_table"] = {
             "method": "mem7b.py method on the full mixtral_8x7b config: "
@@ -461,8 +470,9 @@ def main() -> None:
         result["routing_fidelity"] = run_part_c()
         print(json.dumps(result["routing_fidelity"])[:400], flush=True)
 
-    out = args.out or str(REPO / "MOE_r05.json")
-    Path(out).write_text(json.dumps(result, indent=1))
+    tmp_out = Path(out + ".tmp")
+    tmp_out.write_text(json.dumps(result, indent=1))
+    os.replace(tmp_out, out)
     print(f"[moe8x7b] wrote {out}", flush=True)
 
 

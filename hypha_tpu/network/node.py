@@ -449,24 +449,26 @@ class PushStream:
         """Stream to disk without buffering the whole payload (the reference
         file-mediates all tensor transfers, bridge.rs:392-504).
 
-        Fast path (plain-TCP push connections): the raw socket is handed to
-        a dedicated thread that ``recv_into``s an mmap of the destination
-        file — one kernel→page-cache copy, no event-loop scheduling per
-        chunk, and the worker's loop (heartbeats, lease renewals) is never
-        touched. This was DISTBENCH r4's named remaining gap: the old path
-        paid kernel→user→page-cache plus a loop wakeup and executor hop per
-        4 MiB chunk, all funded by the same core that runs both event loops
-        on a single-core host.
+        Default path: 4 MiB buffered reads with thread-offloaded writes —
+        chunk size, not the thread hop, is the first-order cost (r4 sweep).
 
-        Fallback (TLS / mux / relay streams, where bytes must pass through
-        the event loop): 4 MiB buffered reads with thread-offloaded writes
-        — chunk size, not the thread hop, is the first-order cost there
-        (r4 sweep)."""
+        Opt-in fast path (``HYPHA_RAW_DRAIN=1``, plain-TCP push connections
+        only): the raw socket is handed to a dedicated thread that
+        ``recv_into``s an mmap of the destination file — one
+        kernel→page-cache copy, zero event-loop involvement. This closes
+        DISTBENCH r4's named double-copy gap and measures ~26% faster on a
+        CLEAN page cache (972 vs 771 MB/s singles), but under sustained
+        writeback pressure on a slow virtio disk the mmap page-fault path
+        throttles harder than write() and LOSES (DISTBENCH_r05 A/B:
+        ~220-530 vs ~760-780 sustained) — so it stays off by default and
+        is the right switch only for hosts with fast local disks. TLS /
+        mux / relay streams always use the buffered path (their bytes
+        must pass through the event loop)."""
         import os as _os
 
-        handoff = getattr(self.stream, "raw_socket_handoff", None)
-        if _os.environ.get("HYPHA_DISABLE_RAW_DRAIN") == "1":
-            handoff = None  # A/B escape hatch for DISTBENCH comparisons
+        handoff = None
+        if _os.environ.get("HYPHA_RAW_DRAIN") == "1":
+            handoff = getattr(self.stream, "raw_socket_handoff", None)
         handoff = handoff() if handoff is not None else None
         if handoff is not None:
             sock, buffered = handoff
