@@ -487,14 +487,19 @@ class PushStream:
             return total
         loop = asyncio.get_running_loop()
         total = 0
-        with open(path, "wb") as f:
-            while True:
-                data = await self.stream.read(chunk)
-                if not data:
-                    break
-                await loop.run_in_executor(None, f.write, data)
-                total += len(data)
-        self.finish()
+        try:
+            with open(path, "wb") as f:
+                while True:
+                    data = await self.stream.read(chunk)
+                    if not data:
+                        break
+                    await loop.run_in_executor(None, f.write, data)
+                    total += len(data)
+        finally:
+            # Same wedge as the raw path: a sender dying mid-push must
+            # still release the accept-semaphore slot, or ACCEPT_LIMIT
+            # failed senders stop all inbound pushes.
+            self.finish()
         return total
 
     def finish(self) -> None:

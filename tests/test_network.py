@@ -408,6 +408,46 @@ def test_push_raw_drain_opt_in(tmp_path, monkeypatch):
     run(main())
 
 
+def test_push_dead_sender_releases_slot_default_path(tmp_path):
+    """A sender dying mid-push on the DEFAULT (buffered) receive path must
+    release the accept-semaphore slot — ACCEPT_LIMIT failed senders would
+    otherwise wedge all inbound pushes (the raw path had this guard; the
+    default path gained it in r5)."""
+
+    async def main():
+        from hypha_tpu.network import TcpTransport
+        from hypha_tpu.network.node import ACCEPT_LIMIT
+
+        a = Node(TcpTransport(), peer_id="a")
+        b = Node(TcpTransport(), peer_id="b")
+        await a.start(["127.0.0.1:0"])
+        await b.start(["127.0.0.1:0"])
+        a.add_peer_addr("b", b.listen_addrs[0])
+
+        async def dribble():
+            yield b"x" * 4096
+            await asyncio.sleep(3600)  # stall until the sender dies
+
+        push_task = asyncio.create_task(
+            a.push("b", DataSlice(dataset="d", index=0), dribble())
+        )
+        push = await b.next_push(timeout=5)
+        drain = asyncio.create_task(push.save_to(tmp_path / "dead.bin"))
+        await asyncio.sleep(0.2)
+        push_task.cancel()
+        await a.stop()  # kills the socket mid-transfer
+        try:
+            await asyncio.wait_for(drain, 10)
+        except (ConnectionError, OSError):
+            pass  # error surfaced is fine; the slot release is the point
+        assert b._push_sem._value == ACCEPT_LIMIT, (
+            "accept slot leaked after a dead sender on the buffered path"
+        )
+        await b.stop()
+
+    run(main())
+
+
 def test_pull_stream_roundtrip():
     async def main():
         a, b = await make_nodes(2)
