@@ -78,7 +78,7 @@ def _llama124m_spec() -> dict:
     }}
 
 
-def _late_arrival(scheduling: str, reps: int = 3) -> dict:
+def _late_arrival(scheduling: str, reps: int = 3, pool_chunk: int = 8) -> dict:
     """VERDICT r4 weak #4 / r5 task 3: a request arriving MID-DECODE.
 
     One long request (256 new tokens) starts decoding; 0.3 s later four
@@ -119,7 +119,7 @@ def _late_arrival(scheduling: str, reps: int = 3) -> dict:
                     model=spec_model, serve_name="late",
                     max_batch=8, max_new_tokens=LONG_NEW,
                     scheduling=scheduling,
-                    pool_slots=8, pool_max_len=512, pool_chunk=8,
+                    pool_slots=8, pool_max_len=512, pool_chunk=pool_chunk,
                     batch_window_ms=4.0,
                 ),
             ),
@@ -132,9 +132,16 @@ def _late_arrival(scheduling: str, reps: int = 3) -> dict:
             await asyncio.sleep(1.0)
         long_prompt = [7 * j % vocab for j in range(16)]
         shorts = [[(11 * i + j) % vocab for j in range(16)] for i in range(4)]
-        # Warm every decode shape out of the measurement.
+        # Warm EVERY shape the measurement can hit: the long decode, a
+        # single short, and the coalesced B=4 short (the window batcher
+        # gathers the 4 concurrent shorts into one decode — unwarmed, its
+        # ~14 s compile would masquerade as scheduling latency).
         await generate_remote(client, "late", [long_prompt], LONG_NEW, timeout=600)
         await generate_remote(client, "late", [shorts[0]], SHORT_NEW, timeout=600)
+        await asyncio.gather(*(
+            generate_remote(client, "late", [p], SHORT_NEW, timeout=600)
+            for p in shorts
+        ))
 
         short_lat: list[float] = []
         long_wall: list[float] = []
@@ -159,6 +166,7 @@ def _late_arrival(scheduling: str, reps: int = 3) -> dict:
         await client.stop(); await worker.stop(); await gw.stop()
         return {
             "scheduling": scheduling,
+            "pool_chunk": pool_chunk if scheduling == "continuous" else None,
             "short_p50_ms": round(statistics.median(short_lat) * 1e3, 1),
             "short_max_ms": round(max(short_lat) * 1e3, 1),
             "long_wall_s": round(statistics.median(long_wall), 2),
@@ -171,7 +179,8 @@ def _late_arrival(scheduling: str, reps: int = 3) -> dict:
 
 
 def _concurrent_clients(
-    n_clients: int, batched: bool, model_spec=None, scheduling: str = "window"
+    n_clients: int, batched: bool, model_spec=None, scheduling: str = "window",
+    pool_chunk: int = 8,
 ) -> dict:
     """End-to-end through the infer executor over the in-memory fabric:
     ``n_clients`` concurrent requests, with the cross-request batching
@@ -219,6 +228,7 @@ def _concurrent_clients(
                     max_batch=n_clients if batched else 1,
                     scheduling=scheduling,
                     pool_slots=n_clients, pool_max_len=512,
+                    pool_chunk=pool_chunk,
                     # negative window = the true pre-r4 path: independent
                     # to_thread decodes under handler concurrency 4, no
                     # chip lock.
@@ -299,20 +309,31 @@ def main() -> None:
     # VERDICT r5 task 3: continuous batching. Same 16-client burst through
     # the pool (aggregate must hold the window path's win), plus the
     # late-arrival protocol the window path structurally loses.
-    try:
-        results["clients16_continuous"] = _concurrent_clients(
-            16, True, model_spec=_llama124m_spec(), scheduling="continuous"
-        )
-        results["clients16_window_llama"] = _concurrent_clients(
-            16, True, model_spec=_llama124m_spec(), scheduling="window"
-        )
-    except Exception as e:
-        results["clients16_continuous"] = {"error": f"{type(e).__name__}: {e}"[:160]}
-    for mode in ("window", "continuous"):
+    # pool_chunk is the dispatch-amortization knob: each chunk pays one
+    # host round-trip (~70 ms through the tunneled backend), so small
+    # chunks favor admission latency and large chunks favor aggregate
+    # throughput. Record both ends.
+    for key, sched, chunk in (
+        ("clients16_continuous_chunk8", "continuous", 8),
+        ("clients16_continuous_chunk64", "continuous", 64),
+        ("clients16_window_llama", "window", 8),
+    ):
         try:
-            results[f"late_arrival_{mode}"] = _late_arrival(mode)
+            results[key] = _concurrent_clients(
+                16, True, model_spec=_llama124m_spec(), scheduling=sched,
+                pool_chunk=chunk,
+            )
         except Exception as e:
-            results[f"late_arrival_{mode}"] = {"error": f"{type(e).__name__}: {e}"[:160]}
+            results[key] = {"error": f"{type(e).__name__}: {e}"[:160]}
+    for key, mode, chunk in (
+        ("late_arrival_window", "window", 8),
+        ("late_arrival_continuous", "continuous", 8),
+        ("late_arrival_continuous_chunk32", "continuous", 32),
+    ):
+        try:
+            results[key] = _late_arrival(mode, pool_chunk=chunk)
+        except Exception as e:
+            results[key] = {"error": f"{type(e).__name__}: {e}"[:160]}
     print(json.dumps(results))
 
 
