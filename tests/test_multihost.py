@@ -320,7 +320,7 @@ _EX_FOLLOWER = textwrap.dedent("""
 """)
 
 
-def _run_executor_procs(tmp_path, nproc, kill, kill_at, timeout=600):
+def _run_executor_procs(tmp_path, nproc, kill, kill_at, timeout=900):
     repo = str(pathlib.Path(__file__).resolve().parents[1])
     sock = socket.socket()
     sock.bind(("127.0.0.1", 0))
@@ -379,7 +379,7 @@ def test_follower_death_fails_leader_within_bound(tmp_path):
     bridge's normal failure path to the scheduler (job_manager reports
     'failed'; elastic re-auction is covered by tests/test_e2e.py)."""
     rc, outs = _run_executor_procs(
-        tmp_path, nproc=4, kill=True, kill_at=4, timeout=300
+        tmp_path, nproc=4, kill=True, kill_at=4, timeout=600
     )
     assert rc == 0, outs
     assert any("leader surfaced failure in" in o for o in outs), outs

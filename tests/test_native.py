@@ -107,7 +107,7 @@ def test_native_outer_step_rejects_mismatch(tmp_path):
             None, tmp_path / "m", tmp_path / "u", 0.7, 0.9,
         )
     c = _write_st(tmp_path / "c.safetensors", {"x": np.zeros((4,), np.int64)})
-    with pytest.raises(ValueError, match="F32"):
+    with pytest.raises(ValueError, match="unsupported delta dtype"):
         native.ps_outer_step(
             [c], np.asarray([1.0], np.float32),
             None, tmp_path / "m", tmp_path / "u", 0.7, 0.9,
