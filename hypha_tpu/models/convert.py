@@ -113,6 +113,17 @@ def _qwen2_key(key: str) -> tuple[str, bool] | None:
     return _llama_key(key)
 
 
+def _qwen3_key(key: str) -> tuple[str, bool] | None:
+    """Qwen3 drops qwen2's projection biases and adds per-head QK-norm
+    weights (model.layers.N.self_attn.{q,k}_norm.weight, 1-D)."""
+    m = re.fullmatch(
+        r"model\.layers\.(\d+)\.self_attn\.([qk]_norm)\.weight", key
+    )
+    if m is not None:
+        return f"params/layers_{m.group(1)}/self_attn/{m.group(2)}", False
+    return _llama_key(key)
+
+
 class StackSlot:
     """Mapper result for one slice of a stacked tensor: HF Mixtral stores
     experts as separate ``experts.K.w{1,2,3}`` Linears, the TPU-native
@@ -158,13 +169,14 @@ HF_CONVERTERS = {
     "llama": _llama_key,
     "mistral": _llama_key,
     "qwen2": _qwen2_key,
+    "qwen3": _qwen3_key,
     "gemma": _llama_key,
     "mixtral": _mixtral_key,
 }
 
 # Llama-architecture families whose checkpoints may tie the LM head to the
 # embeddings (no lm_head.weight tensor on disk).
-_TIED_HEAD_FAMILIES = {"llama", "mistral", "qwen2", "gemma"}
+_TIED_HEAD_FAMILIES = {"llama", "mistral", "qwen2", "qwen3", "gemma"}
 
 
 class _Stacker:

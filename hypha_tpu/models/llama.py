@@ -64,6 +64,9 @@ class LlamaConfig:
     mlp_act: str = "silu"  # "silu" (Llama) | "gelu_tanh" (Gemma GeGLU)
     rms_offset: bool = False  # Gemma RMSNorm: x * (1 + weight)
     embed_scale: bool = False  # Gemma: embeddings scaled by sqrt(hidden)
+    # Qwen3: RMSNorm over each head's q/k vectors before RoPE (replaces
+    # qwen2's projection biases as the attention-stability mechanism).
+    qk_norm: bool = False
     # LoRA adapters (executor/lora.py): rank 0 = off. Applied as the
     # runtime two-matmul form y = xW + (xA)B·(α/r) — never materializing
     # W+ΔW, so a 7B fine-tune's grads/optimizer touch only the adapters.
@@ -105,6 +108,7 @@ class LlamaConfig:
             rope_theta=d.get("rope_theta", 10_000.0),
             rms_eps=d.get("rms_norm_eps", 1e-5),
             attn_bias=d.get("model_type") == "qwen2",
+            qk_norm=d.get("model_type") == "qwen3",
             # Qwen2 configs ship a non-null sliding_window with
             # use_sliding_window=false — honor the switch (absent means
             # enabled, the Mistral convention).
@@ -211,6 +215,14 @@ class _Attention(nn.Module):
         q = q.reshape(B, S, cfg.num_heads, hd)
         k = k.reshape(B, S, cfg.num_kv_heads, hd)
         v = v.reshape(B, S, cfg.num_kv_heads, hd)
+        if cfg.qk_norm:
+            # Qwen3 QK-norm: per-head RMSNorm on the last (head_dim) axis,
+            # BEFORE RoPE — shared by the training forward and both decode
+            # paths, so cached generation matches training exactly.
+            qn = self.param("q_norm", nn.initializers.ones, (hd,), jnp.float32)
+            kn = self.param("k_norm", nn.initializers.ones, (hd,), jnp.float32)
+            q = rms_norm(q, qn, cfg.rms_eps).astype(dtype)
+            k = rms_norm(k, kn, cfg.rms_eps).astype(dtype)
         if self.decode:
             # KV-cache decoding (net-new vs the reference, which has no
             # inference path): static-shape cache + q_offset causal masking

@@ -42,6 +42,7 @@ FAMILIES = {
     # with converted torch weights (models.convert).
     "mistral": (Llama, LlamaConfig),
     "qwen2": (Llama, LlamaConfig),
+    "qwen3": (Llama, LlamaConfig),
     "gemma": (Llama, LlamaConfig),
     "mixtral": (Mixtral, MixtralConfig),
     "lenet": (LeNet, LeNetConfig),
@@ -50,6 +51,7 @@ FAMILIES = {
 # Architecture toggles implied by the family name.
 _FAMILY_DEFAULTS: dict[str, dict[str, Any]] = {
     "qwen2": {"attn_bias": True},
+    "qwen3": {"qk_norm": True},
     "gemma": {
         "mlp_act": "gelu_tanh",
         "rms_offset": True,

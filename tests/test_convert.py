@@ -430,3 +430,70 @@ def test_mixtral_conversion_matches_hf_logits(tmp_path):
     params2 = convert_checkpoint("mixtral", tmp_path, template)
     got2, _ = model.apply(params2, ids.astype(np.int32))
     np.testing.assert_allclose(np.asarray(got2), want, rtol=3e-4, atol=3e-4)
+
+
+def test_qwen3_conversion_matches_hf_logits_qk_norm():
+    """Qwen3 replaces qwen2's projection biases with per-head QK-norm
+    (q_norm/k_norm RMS weights before RoPE) and pins an explicit head_dim;
+    both map into the native Llama module via the qwen3 family."""
+    hf_cfg = transformers.Qwen3Config(
+        vocab_size=96,
+        hidden_size=32,
+        intermediate_size=64,
+        num_hidden_layers=2,
+        num_attention_heads=4,
+        num_key_value_heads=2,
+        head_dim=8,
+        max_position_embeddings=64,
+        rms_norm_eps=1e-5,
+        rope_theta=10000.0,
+        tie_word_embeddings=False,
+        use_sliding_window=False,
+        attention_bias=False,
+    )
+    torch.manual_seed(5)
+    hf = transformers.Qwen3ForCausalLM(hf_cfg).eval()
+    ids = np.random.default_rng(5).integers(0, 96, (2, 12))
+    with torch.no_grad():
+        want = hf(torch.from_numpy(ids)).logits.numpy()
+
+    cfg = LlamaConfig.from_hf(hf_cfg.to_dict(), dtype="float32")
+    assert cfg.qk_norm and not cfg.attn_bias and cfg.head_dim == 8
+    model = Llama(cfg)
+    template = model.init(jax.random.key(0), ids.astype(np.int32))
+    state = {k: v.numpy() for k, v in hf.state_dict().items()}
+    params = convert_state_dict("qwen3", state, template)
+    got = np.asarray(model.apply(params, ids.astype(np.int32)))
+    np.testing.assert_allclose(got, want, rtol=2e-4, atol=2e-4)
+
+
+def test_qwen3_tied_checkpoint_materializes_head():
+    """Real small Qwen3 repos tie embeddings and their on-disk safetensors
+    drop the duplicate lm_head tensor; conversion into an untied template
+    must materialize the head from embed_tokens (the qwen2/gemma path)."""
+    hf_cfg = transformers.Qwen3Config(
+        vocab_size=96, hidden_size=32, intermediate_size=64,
+        num_hidden_layers=1, num_attention_heads=4, num_key_value_heads=2,
+        head_dim=8, max_position_embeddings=64, rms_norm_eps=1e-5,
+        tie_word_embeddings=True, use_sliding_window=False,
+        attention_bias=False,
+    )
+    torch.manual_seed(9)
+    hf = transformers.Qwen3ForCausalLM(hf_cfg).eval()
+    state = {k: v.numpy() for k, v in hf.state_dict().items()}
+    state.pop("lm_head.weight", None)  # what safetensors actually ships
+
+    import dataclasses
+
+    cfg = dataclasses.replace(
+        LlamaConfig.from_hf(hf_cfg.to_dict(), dtype="float32"),
+        tie_word_embeddings=False,  # untied template: head must materialize
+    )
+    ids = np.random.default_rng(9).integers(0, 96, (1, 8)).astype(np.int32)
+    model = Llama(cfg)
+    template = model.init(jax.random.key(0), ids)
+    params = convert_state_dict("qwen3", state, template)
+    got = np.asarray(model.apply(params, ids))
+    with torch.no_grad():
+        want = hf(torch.from_numpy(ids.astype(np.int64))).logits.numpy()
+    np.testing.assert_allclose(got, want, rtol=2e-4, atol=2e-4)
