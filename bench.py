@@ -344,10 +344,32 @@ def main() -> None:
     print(json.dumps(line))
 
 
+def _chaos_main(spec: str) -> int:
+    """``bench.py --chaos kill-worker:<round>``: run the orchestrated
+    fault-injection scenario (benchmarks/ft_chaos.py — 4 workers, elastic
+    membership, scripted kill/delay/partition) on the CPU backend and
+    persist the result as FTBENCH_<scenario>.json next to this script."""
+    os.environ["JAX_PLATFORMS"] = "cpu"  # control-plane bench: no accelerator
+    sys.path.insert(0, os.path.join(_REPO, "benchmarks"))
+    from ft_chaos import run_chaos_scenario
+
+    line = run_chaos_scenario(spec)
+    safe = "".join(c if (c.isalnum() or c in "-_") else "-" for c in spec)
+    out_path = os.path.join(_REPO, f"FTBENCH_{safe}.json")
+    with open(out_path, "w") as f:
+        json.dump(line, f, indent=2)
+        f.write("\n")
+    _log(f"wrote {out_path}")
+    print(json.dumps(line))
+    return 0
+
+
 if __name__ == "__main__":
     try:
         if len(sys.argv) >= 3 and sys.argv[1] == "--run":
             sys.exit(_child_main(sys.argv[2]))
+        if len(sys.argv) >= 2 and sys.argv[1] == "--chaos":
+            sys.exit(_chaos_main(sys.argv[2] if len(sys.argv) > 2 else "kill-worker:1"))
         main()
     except Exception as e:  # always emit a parseable line
         # The full traceback goes to STDERR — in child mode that is the

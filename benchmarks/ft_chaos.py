@@ -1,0 +1,217 @@
+"""Fault-tolerance chaos benchmark: an orchestrated DiLoCo run under fire.
+
+Stands up the full in-process topology (gateway + data node + N train
+workers + parameter server + scheduler on the memory fabric — the same
+harness as tests/test_e2e.py) with elastic membership enabled, injects a
+scripted fault via :mod:`hypha_tpu.ft.chaos`, and reports:
+
+  * ``rounds_completed``      — outer rounds finished (must equal the plan)
+  * ``full_restarts``         — job re-runs (0 = elastic recovery worked)
+  * ``degraded_rounds``       — rounds aggregated below the bought replica
+                                count (quorum + deadline path)
+  * ``stale_deltas_dropped``  — late deltas rejected by round tag
+  * ``rejoins`` / ``rejoin_latency_ms`` — replacement workers caught up via
+                                the cumulative-update protocol
+
+Invoked by ``bench.py --chaos kill-worker:<round>`` which persists the
+result as ``FTBENCH_<scenario>.json``.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import os
+import sys
+import tempfile
+import time
+from pathlib import Path
+
+import numpy as np
+
+
+def _log(msg: str) -> None:
+    print(f"[ftbench] {msg}", file=sys.stderr, flush=True)
+
+
+def run_chaos_scenario(
+    spec: str = "kill-worker:1",
+    num_workers: int = 4,
+    rounds: int = 4,
+    quorum_fraction: float = 0.75,
+    round_deadline_s: float = 6.0,
+) -> dict:
+    """Run one chaos scenario; returns the FTBENCH result dict."""
+    from safetensors.numpy import save_file
+
+    from hypha_tpu.data_node import DataNode
+    from hypha_tpu.ft import ChaosController, FTConfig, parse_chaos_spec
+    from hypha_tpu.gateway import Gateway
+    from hypha_tpu.messages import Adam, ModelType, Nesterov, PriceRange
+    from hypha_tpu.network import MemoryTransport, Node
+    from hypha_tpu.resources import Resources
+    from hypha_tpu.scheduler.job_config import DiLoCoJob, DiLoCoRounds, JobResources
+    from hypha_tpu.scheduler.metrics_bridge import CallbackConnector
+    from hypha_tpu.scheduler.orchestrator import Orchestrator
+    from hypha_tpu.telemetry.ft_metrics import FT_METRICS
+    from hypha_tpu.worker.arbiter import OfferConfig
+    from hypha_tpu.worker.runtime import WorkerNode
+
+    FT_METRICS.reset()
+    victim = "w1"  # deterministic target: second allocated worker
+    action = parse_chaos_spec(spec, victim)
+    tmp = Path(tempfile.mkdtemp(prefix="hypha-ftbench-"))
+
+    vocab, seq = 32, 16
+
+    def make_dataset() -> Path:
+        d = tmp / "toy"
+        d.mkdir()
+        rng = np.random.default_rng(0)
+        for i in range(4):
+            ids = rng.integers(0, vocab, (8, seq)).astype(np.int32)
+            save_file({"input_ids": ids}, str(d / f"slice_{i:04d}.safetensors"))
+        return d
+
+    async def main() -> dict:
+        hub = MemoryTransport()
+        gw = Gateway(hub.shared(), peer_id="gw")
+        await gw.start()
+        boot = [gw.node.listen_addrs[0]]
+        data = DataNode(hub.shared(), {"toy": make_dataset()}, peer_id="data",
+                        bootstrap=boot)
+        await data.start()
+
+        def mk_worker(name: str) -> WorkerNode:
+            return WorkerNode(
+                hub.shared(),
+                resources=Resources(tpu=2.0, cpu=8, memory=1000),
+                peer_id=name,
+                offer=OfferConfig(price=1.0, strategy="whole"),
+                bootstrap=boot,
+                work_root=tmp / name,
+            )
+
+        workers = {f"w{i}": mk_worker(f"w{i}") for i in range(num_workers)}
+        for w in workers.values():
+            await w.start()
+        psw = WorkerNode(
+            hub.shared(), resources=Resources(cpu=2, memory=200),
+            peer_id="psw", bootstrap=boot, work_root=tmp / "psw",
+        )
+        await psw.start()
+        sched = Node(hub.shared(), peer_id="sched", bootstrap=boot)
+        await sched.start()
+        await sched.wait_for_bootstrap()
+
+        chaos = ChaosController([action], workers)
+        rounds_seen: set[int] = set()
+
+        def on_metric(w, r, n, v):
+            chaos.on_round_metrics(r)
+            rounds_seen.add(r)
+
+        orch = Orchestrator(sched, metrics_connector=CallbackConnector(on_metric))
+        job = DiLoCoJob(
+            model={
+                "model_type": ModelType.CAUSAL_LM,
+                "family": "gpt2",
+                "config": {
+                    "vocab_size": vocab, "n_positions": seq,
+                    "n_embd": 16, "n_layer": 1, "n_head": 2,
+                },
+                "seed": 7,
+            },
+            dataset="toy",
+            rounds=DiLoCoRounds(
+                update_rounds=rounds, avg_samples_between_updates=24,
+                max_batch_size=4,
+            ),
+            inner_optimizer=Adam(lr=1e-3),
+            outer_optimizer=Nesterov(lr=0.7, momentum=0.9),
+            resources=JobResources(
+                num_workers=num_workers,
+                worker=Resources(tpu=1.0, cpu=1.0, memory=10),
+                parameter_server=Resources(cpu=1.0, memory=10),
+                worker_price=PriceRange(bid=1.0, max=10.0),
+                parameter_server_price=PriceRange(bid=1.0, max=10.0),
+            ),
+            ft=FTConfig(
+                quorum_fraction=quorum_fraction,
+                round_deadline_s=round_deadline_s,
+                rejoin_attempts=8,
+                rejoin_backoff_s=1.0,
+            ),
+        )
+
+        replacement = mk_worker(f"{victim}b") if action.kind == "kill" else None
+
+        async def restarter() -> None:
+            while not chaos.fired:
+                await asyncio.sleep(0.05)
+            if replacement is not None:
+                _log(f"restarting victim as {victim}b")
+                await replacement.start([f"mem:restart-{victim}b"])
+
+        restart_task = asyncio.create_task(restarter())
+        t0 = time.monotonic()
+        try:
+            result = await orch.run(
+                job, auction_timeout=1.5, status_timeout=60.0, max_attempts=1
+            )
+        finally:
+            restart_task.cancel()
+            stops = list(workers.values()) + [psw]
+            if replacement is not None:
+                stops.append(replacement)
+            for w in stops:
+                try:
+                    await w.stop()
+                except (Exception, asyncio.CancelledError):
+                    pass
+            await data.stop()
+            await sched.stop()
+            await gw.stop()
+        wall_s = time.monotonic() - t0
+        snap = FT_METRICS.snapshot()
+        latency_ms = (
+            snap["rejoin_latency_ms_sum"] / snap["rejoin_latency_ms_count"]
+            if snap["rejoin_latency_ms_count"]
+            else None
+        )
+        return {
+            "metric": "ft_chaos_rounds_completed",
+            "value": result.rounds,
+            "unit": "rounds",
+            "scenario": spec,
+            "chaos_target": victim,
+            "num_workers": num_workers,
+            "planned_rounds": rounds,
+            "rounds_completed": result.rounds,
+            "full_restarts": result.attempt,
+            "quorum_fraction": quorum_fraction,
+            "round_deadline_s": round_deadline_s,
+            "degraded_rounds": snap["degraded_rounds"],
+            "stale_deltas_dropped": snap["stale_deltas_dropped"],
+            "suspected_peers": snap["suspected_peers"],
+            "rejoins": snap["rejoins"],
+            "rejoin_latency_ms": round(latency_ms, 1) if latency_ms else None,
+            "membership": result.ft,
+            "wall_s": round(wall_s, 1),
+            "vs_baseline": None,  # the seed aborts the whole job here
+        }
+
+    return asyncio.run(asyncio.wait_for(main(), timeout=600))
+
+
+def main() -> int:
+    spec = sys.argv[1] if len(sys.argv) > 1 else "kill-worker:1"
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    line = run_chaos_scenario(spec)
+    import json
+
+    print(json.dumps(line))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -21,7 +21,11 @@ from __future__ import annotations
 
 import dataclasses
 import os
-import tomllib
+
+try:
+    import tomllib
+except ImportError:  # Python < 3.11: the backport ships the same API
+    import tomli as tomllib  # type: ignore[no-redef]
 import typing
 from dataclasses import MISSING, dataclass, field, fields, is_dataclass
 from pathlib import Path

@@ -24,6 +24,7 @@ import jax.numpy as jnp
 __all__ = [
     "extract_delta",
     "merge_update",
+    "apply_updates",
     "average_deltas",
     "nesterov_init",
     "nesterov_outer_step",
@@ -40,6 +41,28 @@ def extract_delta(params, anchor):
 def merge_update(params, update):
     """θ_new = θ + update, preserving each leaf's dtype."""
     return jax.tree.map(lambda p, u: (p + u.astype(p.dtype)).astype(p.dtype), params, update)
+
+
+def apply_updates(params, updates: list):
+    """Fold several outer updates into θ in one pass: θ ← θ + Σ updates.
+
+    The rejoin catch-up path (hypha_tpu.ft.rejoin): a worker that missed
+    rounds k..r−1 applies their updates — or the parameter server's single
+    cumulative Σ — in f32 before the per-leaf cast, so a long catch-up does
+    not compound per-round rounding in low-precision params.
+    """
+    if not updates:
+        return params
+
+    @jax.jit
+    def _apply(p, us):
+        def leaf(x, *ys):
+            total = sum(jnp.asarray(y, jnp.float32) for y in ys)
+            return (x.astype(jnp.float32) + total).astype(x.dtype)
+
+        return jax.tree.map(leaf, p, *us)
+
+    return _apply(params, updates)
 
 
 def average_deltas(deltas: list, weights=None):

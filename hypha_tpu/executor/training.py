@@ -48,7 +48,7 @@ from ..messages import (
     ProgressResponseKind,
     TrainExecutorConfig,
 )
-from .diloco import extract_delta, merge_update
+from .diloco import apply_updates, extract_delta, merge_update
 from .serialization import load_flat, save_tree, unflatten_like
 from .train import TrainState, build_optimizer, make_train_step
 
@@ -447,6 +447,39 @@ def run_training(
     round_samples = 0
     round_losses: list[float] = []
 
+    if getattr(cfg, "rejoin", False):
+        # Elastic rejoin (hypha_tpu.ft.rejoin): this replica was dispatched
+        # mid-job. θ₀ above is the seed init every original worker started
+        # from; the parameter server owes us one catch-up push carrying
+        # Σ updates so far plus the authoritative next round number. Regular
+        # round broadcasts racing in first are safe to drop — their content
+        # is folded into any later cumulative sum.
+        if mh is not None:
+            _mh_done_bounded(mh)
+            raise ValueError("rejoin is not supported for multihost replicas")
+        from ..ft.rejoin import await_catchup
+
+        log.info("rejoin: waiting for the parameter server's catch-up")
+
+        def _drop(event: dict) -> None:
+            (work_dir / event["path"]).unlink(missing_ok=True)
+
+        with session.receive(cfg.results) as events:
+            catchup = await_catchup(events, on_skip=_drop)
+        meta = catchup.get("meta") or {}
+        catchup_file = work_dir / catchup["path"]
+        flat = load_flat(catchup_file)
+        if flat:
+            update = unflatten_like(flat, state.params)
+            state = state.replace(params=apply_updates(state.params, [update]))
+        anchor = snapshot(state.params)
+        catchup_file.unlink(missing_ok=True)
+        round_num = int(meta.get("round", 0))
+        log.info(
+            "rejoin: caught up to round %d (membership epoch %s, %d tensors)",
+            round_num, meta.get("epoch", "?"), len(flat),
+        )
+
     def batches() -> Iterator[Any]:
         yield first_batch
         yield from stream
@@ -491,7 +524,10 @@ def run_training(
             # right consumer on the PS node (job-unique, set by the
             # scheduler's orchestrator).
             resource=cfg.updates.ref.resource or "updates",
-            meta={"num_samples": float(round_samples)},
+            # round tags the delta so an elastic parameter server can
+            # reject a stale one (arriving after its round aggregated at
+            # quorum) instead of folding it into the wrong mean.
+            meta={"num_samples": float(round_samples), "round": round_num},
         )
         mean_loss = float(np.mean(round_losses)) if round_losses else math.nan
         session.send_status(
@@ -503,7 +539,14 @@ def run_training(
             )
         )
         with session.receive(cfg.results) as events:
-            event = next(events)
+            # Not bare next(): a severed bridge ends the SSE stream, and a
+            # StopIteration escaping through asyncio.to_thread turns into
+            # an unraisable TypeError instead of a clean job failure.
+            event = next(events, None)
+        if event is None:
+            raise RuntimeError(
+                "results stream ended before the round's update broadcast"
+            )
         update_file = work_dir / event["path"]
         flat = load_flat(update_file)
         if mh is not None:

@@ -1,0 +1,43 @@
+"""Fault tolerance for DiLoCo rounds: elastic round membership.
+
+The seed blocks each outer round on exactly ``num_workers`` deltas and
+recovers from any worker failure by restarting the whole job. This package
+replaces that with graceful degradation — DiLoCo's outer average is well
+defined over whichever replicas actually reported:
+
+  detector.py   — φ-accrual failure detector over heartbeats/lease renewals
+  membership.py — epoch-numbered RoundMembership + the FT wire vocabulary
+  rejoin.py     — catch-up protocol (θ_r = θ₀ + Σ updates) for replacements
+  chaos.py      — deterministic fault injection for tests and bench.py
+
+See docs/fault_tolerance.md for the full protocol description.
+"""
+
+from .chaos import ChaosAction, ChaosController, parse_chaos_spec
+from .detector import PHI_THRESHOLD_DEFAULT, PhiAccrualDetector
+from .membership import (
+    PROTOCOL_FT,
+    FTConfig,
+    MembershipUpdate,
+    MembershipView,
+    RoundMembership,
+    quorum_size,
+)
+from .rejoin import CATCHUP_KEY, CatchupBuffer, await_catchup
+
+__all__ = [
+    "PHI_THRESHOLD_DEFAULT",
+    "PhiAccrualDetector",
+    "PROTOCOL_FT",
+    "FTConfig",
+    "MembershipUpdate",
+    "MembershipView",
+    "RoundMembership",
+    "quorum_size",
+    "CATCHUP_KEY",
+    "CatchupBuffer",
+    "await_catchup",
+    "ChaosAction",
+    "ChaosController",
+    "parse_chaos_spec",
+]

@@ -1,0 +1,180 @@
+"""Deterministic fault injection for tests and benchmarks.
+
+Real failures are timing accidents; tests need them on a schedule. A
+:class:`ChaosController` watches the same per-round metrics stream the
+orchestrator's MetricsBridge sees and fires scripted actions at exact round
+boundaries, against the in-process :class:`~hypha_tpu.worker.runtime.
+WorkerNode` objects a test or ``bench.py --chaos`` holds:
+
+  * ``kill``       — stop the worker node outright (lease renewals start
+    failing, its delta never arrives: the canonical DiLoCo dropout);
+  * ``delay``      — add ``delay_s`` to every outbound push (a straggler:
+    its delta arrives but may miss the round deadline and be dropped as
+    stale);
+  * ``partition``  — fail every outbound push *and* request from the worker
+    (uplink loss: the worker computes but cannot report; the φ detector is
+    the only thing that can see this one).
+
+Trigger semantics: action ``at_round=r`` fires the first time a METRICS
+event for round ``r-1`` is observed — i.e. while round ``r`` is running —
+so "kill worker X mid-round r" is reproducible to the batch. ``at_round=0``
+fires on attach (before the job's first batch).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import time
+from dataclasses import dataclass, field
+from typing import Any, Callable
+
+__all__ = ["ChaosAction", "ChaosController", "parse_chaos_spec"]
+
+log = logging.getLogger("hypha.ft.chaos")
+
+_KINDS = ("kill", "delay", "partition")
+
+
+@dataclass(slots=True)
+class ChaosAction:
+    kind: str  # "kill" | "delay" | "partition"
+    target: str  # worker peer id
+    at_round: int = 1
+    delay_s: float = 0.0  # kind == "delay"
+    fired_at: float | None = None  # monotonic time the action ran
+
+    def __post_init__(self) -> None:
+        if self.kind not in _KINDS:
+            raise ValueError(f"unknown chaos kind {self.kind!r}")
+        if self.at_round < 0:
+            raise ValueError("at_round must be >= 0")
+
+
+def parse_chaos_spec(spec: str, target: str) -> ChaosAction:
+    """Parse a CLI chaos spec like ``kill-worker:1`` or ``delay-worker:2:0.5``
+    into an action against ``target``."""
+    parts = spec.split(":")
+    head = parts[0]
+    if head in ("kill-worker", "kill"):
+        kind = "kill"
+    elif head in ("delay-worker", "delay"):
+        kind = "delay"
+    elif head in ("partition-worker", "partition"):
+        kind = "partition"
+    else:
+        raise ValueError(f"unknown chaos spec {spec!r}")
+    at_round = int(parts[1]) if len(parts) > 1 else 1
+    delay_s = float(parts[2]) if len(parts) > 2 else 1.0
+    return ChaosAction(kind=kind, target=target, at_round=at_round, delay_s=delay_s)
+
+
+class ChaosController:
+    """Runs scripted :class:`ChaosAction`s against in-process worker nodes.
+
+    ``workers`` maps peer id → WorkerNode (anything with ``.stop()`` and
+    ``.node``). Wire :meth:`metrics_hook` into the orchestrator's metrics
+    connector so round completions drive the schedule.
+    """
+
+    def __init__(
+        self,
+        actions: list[ChaosAction],
+        workers: dict[str, Any],
+        clock: Callable[[], float] = time.monotonic,
+    ) -> None:
+        self.actions = list(actions)
+        self.workers = dict(workers)
+        self._clock = clock
+        self._tasks: set[asyncio.Task] = set()
+        self.fired: list[ChaosAction] = []
+        for action in self.actions:
+            if action.at_round == 0:
+                self._fire(action)
+
+    # ---------------------------------------------------------------- hooks
+    def metrics_hook(
+        self, inner: Callable[[str, int, dict], None] | None = None
+    ) -> Callable[[str, int, dict], None]:
+        """A metrics callback for CallbackConnector; chains to ``inner``."""
+
+        def on_metrics(peer: str, round_num: int, metrics: dict) -> None:
+            self.on_round_metrics(round_num)
+            if inner is not None:
+                inner(peer, round_num, metrics)
+
+        return on_metrics
+
+    def on_round_metrics(self, round_num: int) -> None:
+        """A worker reported metrics for ``round_num`` (end of that round)."""
+        for action in self.actions:
+            if action.fired_at is None and action.at_round <= round_num + 1:
+                self._fire(action)
+
+    # ---------------------------------------------------------------- firing
+    def _fire(self, action: ChaosAction) -> None:
+        action.fired_at = self._clock()
+        self.fired.append(action)
+        worker = self.workers.get(action.target)
+        if worker is None:
+            log.warning("chaos: no worker %r to %s", action.target, action.kind)
+            return
+        log.info("chaos: %s %s (round trigger %d)", action.kind, action.target, action.at_round)
+        if action.kind == "kill":
+            task = asyncio.create_task(self._kill(worker))
+            self._tasks.add(task)
+            task.add_done_callback(self._tasks.discard)
+        elif action.kind == "delay":
+            self._wrap_push_delay(worker.node, action.delay_s)
+        elif action.kind == "partition":
+            self._partition(worker.node)
+
+    @staticmethod
+    async def _kill(worker: Any) -> None:
+        """Crash semantics: sever the NODE first (instant network death —
+        in-flight deltas and heartbeats stop mid-round), then reap the
+        worker's local state in the background. A graceful worker.stop()
+        alone lets the training thread finish shipping the current round's
+        delta, which is a shutdown, not a failure."""
+        node_stop = getattr(getattr(worker, "node", None), "stop", None)
+        try:
+            if callable(node_stop):
+                await node_stop()
+            await worker.stop()
+        except (Exception, asyncio.CancelledError) as e:
+            log.warning("chaos kill: stop raised %s", e)
+
+    @staticmethod
+    def _wrap_push_delay(node: Any, delay_s: float) -> None:
+        orig_push = node.push
+
+        async def delayed_push(peer_id: str, resource: Any, source) -> int:
+            await asyncio.sleep(delay_s)
+            return await orig_push(peer_id, resource, source)
+
+        node.push = delayed_push
+
+    @staticmethod
+    def _partition(node: Any) -> None:
+        from ..network.node import RequestError
+
+        async def dead_push(peer_id: str, resource: Any, source) -> int:
+            raise RequestError(f"chaos partition: push to {peer_id} dropped")
+
+        async def dead_request(peer_id: str, protocol: str, msg: Any, **kw) -> Any:
+            raise RequestError(f"chaos partition: request to {peer_id} dropped")
+
+        node.push = dead_push
+        node.request = dead_request
+
+    # --------------------------------------------------------------- queries
+    def fired_at(self, target: str) -> float | None:
+        for action in self.fired:
+            if action.target == target:
+                return action.fired_at
+        return None
+
+    async def drain(self) -> None:
+        """Wait for in-flight kill tasks (test teardown hygiene)."""
+        if self._tasks:
+            await asyncio.gather(*list(self._tasks), return_exceptions=True)

@@ -1,0 +1,126 @@
+"""Worker rejoin: catch a replacement up to the current global weights.
+
+Protocol. The parameter server never holds full weights — it sees only
+pseudo-gradients and emits update tensors — but every worker initializes
+θ₀ deterministically from the job's model seed. So "current global weights"
+factor as
+
+    θ_r = θ₀ + Σ_{k<r} update_k
+
+and the PS *can* cheaply maintain the running sum Σ update_k (one
+param-sized f32 tree, accumulated each round). A rejoining worker:
+
+  1. is dispatched a train job with ``rejoin=True`` (same job-unique
+     updates/results tags as the original workers);
+  2. initializes θ₀ from the seed like everyone else;
+  3. blocks on its results stream until a push whose header carries
+     ``catchup: True`` arrives — the PS's cumulative update Σ_{k<r},
+     stamped with the authoritative next round number ``r`` and the
+     membership epoch;
+  4. merges it (θ ← θ₀ + Σ), re-anchors, sets ``round_num = r`` and enters
+     the normal inner loop — contributing to round ``r`` like any other
+     member, no whole-job restart anywhere.
+
+The PS serves catch-ups only at consistent points (between collecting and
+the next round's first broadcast), so a rejoiner can never observe a
+regular round update before its catch-up; :func:`await_catchup` still skips
+stray non-catch-up events defensively, because their content is *included*
+in any later cumulative sum.
+"""
+
+from __future__ import annotations
+
+from pathlib import Path
+from typing import Any, Callable, Iterable, Iterator
+
+import numpy as np
+from safetensors.numpy import load_file, save_file
+
+__all__ = ["CatchupBuffer", "await_catchup", "CATCHUP_KEY"]
+
+# Header key marking a results-stream push as a rejoin catch-up.
+CATCHUP_KEY = "catchup"
+
+
+class CatchupBuffer:
+    """The parameter server's running Σ of broadcast updates (f32, host).
+
+    Kept in memory between rounds; written to a SafeTensors file on demand
+    when a rejoiner needs it. Empty until the first outer step — a worker
+    joining during round 0 receives an empty catch-up (nothing to merge,
+    θ₀ already is the global state).
+    """
+
+    def __init__(self) -> None:
+        self._cum: dict[str, np.ndarray] = {}
+        self.rounds = 0  # outer updates accumulated so far
+
+    def accumulate(self, update_path: Path | str) -> None:
+        """Fold one round's update file into the running sum."""
+        update = load_file(str(update_path))
+        for key, value in update.items():
+            arr = np.asarray(value, np.float32)
+            prev = self._cum.get(key)
+            if prev is None:
+                self._cum[key] = arr.copy()
+            elif prev.shape != arr.shape:
+                raise ValueError(
+                    f"catchup {key!r}: update shape {arr.shape} != {prev.shape}"
+                )
+            else:
+                prev += arr
+        self.rounds += 1
+
+    def write(self, path: Path | str) -> Path:
+        """Materialize the sum for a catch-up push (atomic via temp name)."""
+        path = Path(path)
+        tmp = path.with_suffix(".tmp")
+        save_file(self._cum, str(tmp))
+        tmp.replace(path)
+        return path
+
+    def is_empty(self) -> bool:
+        return not self._cum
+
+
+def await_catchup(
+    events: Iterator[dict[str, Any]],
+    on_skip: Callable[[dict[str, Any]], None] | None = None,
+) -> dict[str, Any]:
+    """Consume bridge receive events until the catch-up arrives.
+
+    Returns the catch-up event (its ``meta`` carries ``round`` and
+    ``epoch``). Non-catch-up events that race in first are handed to
+    ``on_skip`` (e.g. to unlink the file) and dropped — safe because any
+    round update a rejoiner could see here is already folded into the
+    cumulative sum it is waiting for.
+    """
+    for event in events:
+        meta = event.get("meta") or {}
+        if meta.get(CATCHUP_KEY):
+            return event
+        if on_skip is not None:
+            on_skip(event)
+    raise RuntimeError("results stream ended before the rejoin catch-up arrived")
+
+
+def merge_catchup_arrays(
+    params: dict[str, np.ndarray], cum: dict[str, np.ndarray]
+) -> dict[str, np.ndarray]:
+    """Host-side θ₀ + Σ merge for non-JAX callers (tests, tools); the
+    executor's hot path uses the jitted tree op (executor.diloco)."""
+    merged = dict(params)
+    for key, value in cum.items():
+        if key not in merged:
+            raise KeyError(f"catchup tensor {key!r} not in params")
+        base = merged[key]
+        merged[key] = (base.astype(np.float32) + value).astype(base.dtype)
+    return merged
+
+
+def sum_updates(paths: Iterable[Path | str]) -> dict[str, np.ndarray]:
+    """Σ over a list of update files (utility mirror of CatchupBuffer)."""
+    buf = CatchupBuffer()
+    for p in paths:
+        buf.accumulate(p)
+    return dict(buf._cum)

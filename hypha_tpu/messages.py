@@ -459,6 +459,12 @@ class TrainExecutorConfig:
     # shipped differences round — not the compounding outer state. Additive
     # field: absent on the wire = f32, old peers interop.
     delta_dtype: str = "float32"
+    # Elastic membership (hypha_tpu.ft): a replacement worker dispatched
+    # mid-job. It initializes from the model seed, then blocks on its
+    # results stream for the parameter server's catch-up push (cumulative
+    # update + authoritative round counter) before entering the inner loop.
+    # Additive field: absent on the wire = fresh start, old peers interop.
+    rejoin: bool = False
 
 
 @register
@@ -473,6 +479,17 @@ class AggregateExecutorConfig:
     # Net-new: persist Nesterov momentum across PS restarts (the reference
     # keeps it in a tmp file that dies with the job, parameter_server.rs:392).
     checkpoint_dir: str | None = None
+    # Elastic round membership (hypha_tpu.ft). quorum_fraction > 0 switches
+    # the PS into quorum+deadline aggregation: a round closes once every
+    # live active worker reported, or — after round_deadline_s — once
+    # ceil(quorum_fraction·|active|) deltas arrived (sample-weighted mean
+    # over whatever actually arrived; stale deltas tagged with an old round
+    # are dropped). The membership view updates via /hypha-ft/0.0.1 from
+    # the scheduler, and joined peers get the rejoin catch-up push.
+    # Additive fields: absent on the wire = the seed's exact all-or-block
+    # semantics, old peers interop.
+    quorum_fraction: float = 0.0
+    round_deadline_s: float = 0.0
 
 
 @register

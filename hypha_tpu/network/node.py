@@ -1458,15 +1458,18 @@ class Node:
         # accepting-but-silent one must not stall the op (the healthy
         # gateways are the whole point of replication). Timeout surfaces as
         # ConnectionError so the caller's failover handles it uniformly.
+        async def op() -> dict:
+            stream = await self._open_raw(addr, PROTOCOL_REGISTRY)
+            try:
+                await stream.write_frame(frame)
+                return await stream.read_frame()
+            finally:
+                await stream.close()
+
         try:
-            async with asyncio.timeout(REGISTRY_OP_TIMEOUT):
-                stream = await self._open_raw(addr, PROTOCOL_REGISTRY)
-                try:
-                    await stream.write_frame(frame)
-                    return await stream.read_frame()
-                finally:
-                    await stream.close()
-        except TimeoutError as e:
+            # wait_for, not asyncio.timeout: the latter is Python 3.11+.
+            return await asyncio.wait_for(op(), REGISTRY_OP_TIMEOUT)
+        except (TimeoutError, asyncio.TimeoutError) as e:
             raise ConnectionError(f"registry op timed out at {addr}") from e
 
     async def _registry_call(self, frame: dict) -> dict:

@@ -13,6 +13,7 @@ from dataclasses import dataclass, field
 from typing import Any
 
 from .config import ConfigError, TLSConfig
+from .ft.membership import FTConfig
 from .messages import Adam, LRScheduler, LRSchedulerKind, ModelType, Nesterov, PriceRange
 from .resources import Resources
 from .scheduler.job_config import DiLoCoJob, DiLoCoRounds, JobResources
@@ -301,6 +302,21 @@ class JobSection:
         default=1,
         metadata={"doc": "re-run a failed job up to N times (elastic recovery)"},
     )
+    quorum_fraction: float = field(
+        default=0.0,
+        metadata={
+            "doc": "elastic rounds: aggregate at ceil(f*active) deltas after "
+            "the round deadline; 0 = wait for every worker (seed behavior)"
+        },
+    )
+    round_deadline_s: float = field(
+        default=30.0,
+        metadata={"doc": "elastic rounds: PS wait before quorum aggregation"},
+    )
+    phi_threshold: float = field(
+        default=8.0,
+        metadata={"doc": "phi-accrual suspicion threshold (Cassandra-style)"},
+    )
 
     def validate(self) -> None:
         if self.kind not in ("train", "serve"):
@@ -323,6 +339,12 @@ class JobSection:
             raise ConfigError("job.dataset is required")
         if self.max_attempts < 1:
             raise ConfigError("job.max_attempts must be >= 1")
+        if not 0.0 <= self.quorum_fraction <= 1.0:
+            raise ConfigError("job.quorum_fraction must be in [0, 1]")
+        if self.round_deadline_s < 0:
+            raise ConfigError("job.round_deadline_s must be >= 0")
+        if self.phi_threshold <= 0:
+            raise ConfigError("job.phi_threshold must be positive")
         try:
             ModelType(self.model_type)
         except ValueError:
@@ -383,6 +405,15 @@ class JobSection:
             sharding=dict(self.sharding) or None,
             checkpoint_dir=self.checkpoint_dir or None,
             checkpoint_every=self.checkpoint_every,
+            ft=(
+                FTConfig(
+                    quorum_fraction=self.quorum_fraction,
+                    round_deadline_s=self.round_deadline_s,
+                    phi_threshold=self.phi_threshold,
+                )
+                if self.quorum_fraction > 0
+                else None
+            ),
         )
 
 

@@ -29,7 +29,7 @@ from ..messages import (
     ProgressResponse,
     ProgressResponseKind,
 )
-from .simulation import WorkerSim, project
+from .simulation import project
 from .trackers import ProgressTracker, WorkerState
 
 __all__ = ["BatchScheduler", "TIME_CAP_MS", "UPDATES_CAP"]
@@ -113,14 +113,7 @@ class BatchScheduler:
             for p, s in zip(self.tracker.peers, self.tracker.states)
             if s in (WorkerState.TRAINING, WorkerState.UPDATE_SCHEDULED)
         ]
-        workers = [
-            WorkerSim(
-                batch_size=self.tracker.batch_sizes[self.tracker.index_of(p)],
-                mean_batch_ms=self.tracker.stats[self.tracker.index_of(p)].mean(),
-                elapsed_ms=self.tracker.elapsed_ms(p),
-            )
-            for p in sim_peers
-        ]
+        workers = self.tracker.sims(sim_peers)
         projection = project(
             self.tracker.counter, workers, self.time_cap_ms, self.updates_cap
         )

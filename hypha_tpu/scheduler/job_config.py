@@ -13,6 +13,7 @@ from __future__ import annotations
 
 from dataclasses import dataclass, field
 
+from ..ft.membership import FTConfig
 from ..messages import Adam, Loss, LRScheduler, Nesterov, PriceRange, register
 from ..resources import Resources
 
@@ -74,6 +75,11 @@ class DiLoCoJob:
     # per-host). Unset checkpoint_dir — or checkpoint_every <= 0 — disables.
     checkpoint_dir: str | None = None
     checkpoint_every: int = 1
+    # Elastic round membership (hypha_tpu.ft): quorum + deadline
+    # aggregation, φ-accrual suspicion and worker rejoin without a job
+    # restart. None keeps the seed's all-or-abort semantics; max_attempts
+    # full restarts remain the last resort either way.
+    ft: FTConfig | None = None
 
     def __post_init__(self) -> None:
         if self.delta_dtype not in ("float32", "bfloat16"):

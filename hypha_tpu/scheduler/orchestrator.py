@@ -14,9 +14,27 @@ crates/scheduler/src/bin/hypha-scheduler.rs:54-432):
      BatchScheduler (the DiLoCo control plane) and the MetricsBridge;
   6. dispatch the aggregate job to the PS and a train job per worker;
   7. supervise: job completes when the batch scheduler reports every
-     worker DONE; any worker failure or failed job status aborts the run
-     (automatic re-allocation is future work in the reference too,
-     rfc/2025-08-04 "Next Steps").
+     worker DONE.
+
+Failure handling comes in two tiers (net-new vs the reference, whose only
+answer is aborting the run — rfc/2025-08-04 "Next Steps"):
+
+  * **Elastic membership** (``job.ft`` set, hypha_tpu.ft): a train-worker
+    death — lease renewal failure, failed job status, or φ-accrual
+    suspicion — *degrades* the round instead of aborting it. The departed
+    peer leaves the epoch-numbered membership view, the parameter server is
+    told to aggregate at quorum, and a replacement is auctioned and caught
+    up (``rejoin=True`` dispatch + the PS's cumulative-update push) without
+    restarting anyone else. Only PS death or quorum loss fails the attempt.
+  * **Full restart** (``max_attempts > 1``): the last resort — the failed
+    attempt's leases lapse and the whole job re-runs, warm-starting from
+    checkpoints when configured.
+
+The no-progress watchdog is per-round: when ``status_timeout`` is not
+given, the deadline derives from the synchronization simulation's projected
+round time once every worker has timing statistics (satellite of the ft
+work: a 600 s whole-run constant both masked early stalls on fast jobs and
+killed slow-but-healthy large-model rounds).
 """
 
 from __future__ import annotations
@@ -24,8 +42,17 @@ from __future__ import annotations
 import asyncio
 import logging
 import uuid
+from typing import Any
 
 from .. import messages
+from ..ft.detector import PhiAccrualDetector
+from ..ft.membership import (
+    PROTOCOL_FT,
+    FTConfig,
+    MembershipUpdate,
+    MembershipView,
+    quorum_size,
+)
 from ..messages import (
     AGGREGATE_EXECUTOR_NAME,
     PROTOCOL_PROGRESS,
@@ -43,19 +70,29 @@ from ..messages import (
     TrainExecutorConfig,
     WorkerSpec,
 )
-from ..network.node import Node
+from ..network.node import Node, RequestError
+from ..telemetry.ft_metrics import FT_METRICS
 from .allocator import GreedyWorkerAllocator
 from .batch_scheduler import BatchScheduler
 from .data_scheduler import DataScheduler
 from .job_config import DiLoCoJob
 from .metrics_bridge import MetricsBridge, MetricsConnector
-from .task import StatusRouter, Task
-from .trackers import ProgressTracker
+from .simulation import project
+from .task import DispatchError, StatusRouter, Task
+from .trackers import ProgressTracker, WorkerState
 from .worker_handle import WorkerHandle
 
 __all__ = ["Orchestrator", "JobResult", "JobFailed", "AllocationError"]
 
 log = logging.getLogger("hypha.scheduler.orchestrator")
+
+# Watchdog fallback while no per-round projection exists (no statistics
+# yet, or a worker without a single timed batch).
+DEFAULT_STATUS_TIMEOUT = 600.0
+# Adaptive per-round deadline = clamp(factor · projected_round_time + the
+# PS round deadline, floor, DEFAULT_STATUS_TIMEOUT).
+ROUND_DEADLINE_FACTOR = 5.0
+ROUND_DEADLINE_FLOOR_S = 60.0
 
 
 class AllocationError(RuntimeError):
@@ -67,10 +104,46 @@ class JobFailed(RuntimeError):
 
 
 class JobResult:
-    def __init__(self, job_id: str, rounds: int, metrics: list) -> None:
+    def __init__(
+        self,
+        job_id: str,
+        rounds: int,
+        metrics: list,
+        attempt: int = 0,
+        ft: dict | None = None,
+    ) -> None:
         self.job_id = job_id
         self.rounds = rounds
         self.metrics = metrics  # [(peer, round, {name: value})]
+        self.attempt = attempt  # 0 = first attempt succeeded (no restart)
+        # Elastic-membership summary when the job ran with job.ft:
+        # {"epoch", "active", "departed", "suspected", "rejoins"}.
+        self.ft = ft
+
+
+class _RunContext:
+    """Everything one attempt's supervision + rejoin path needs."""
+
+    def __init__(self) -> None:
+        self.job: DiLoCoJob | None = None
+        self.ft: FTConfig | None = None
+        self.base_id = ""
+        self.updates_tag = ""
+        self.results_tag = ""
+        self.handles: dict[str, WorkerHandle] = {}
+        self.ps_handle: WorkerHandle | None = None
+        self.ps_job_id = ""
+        self.router: StatusRouter | None = None
+        self.tracker: ProgressTracker | None = None
+        self.data_scheduler: DataScheduler | None = None
+        self.complete: asyncio.Event | None = None
+        self.activity: list[float] = []
+        self.status_timeout: float | None = None
+        self.auction_timeout = 2.0
+        self.detector: PhiAccrualDetector | None = None
+        self.membership: MembershipView | None = None
+        self.rejoin_count = 0
+        self.notify_tasks: set[asyncio.Task] = set()
 
 
 class Orchestrator:
@@ -85,14 +158,20 @@ class Orchestrator:
 
     # ------------------------------------------------------------ allocation
 
+    @staticmethod
+    def _train_worker_spec(job: DiLoCoJob) -> WorkerSpec:
+        return WorkerSpec(
+            resources=job.resources.worker,
+            executor=[
+                ExecutorDescriptor(executor_class="train", name=TRAIN_EXECUTOR_NAME)
+            ],
+        )
+
     async def _allocate_train(
         self, job: DiLoCoJob, *, auction_timeout: float, attempts: int
     ) -> list:
         res = job.resources
-        train_spec = WorkerSpec(
-            resources=res.worker,
-            executor=[ExecutorDescriptor(executor_class="train", name=TRAIN_EXECUTOR_NAME)],
-        )
+        train_spec = self._train_worker_spec(job)
         for attempt in range(attempts):
             offers = await self.allocator.request(
                 train_spec, res.worker_price, auction_timeout, res.num_workers
@@ -152,20 +231,23 @@ class Orchestrator:
         *,
         auction_timeout: float = 2.0,
         allocation_attempts: int = 3,
-        status_timeout: float = 600.0,
+        status_timeout: float | None = None,
         max_attempts: int = 1,
         retry_backoff: float = 11.0,
     ) -> JobResult:
-        """Run the job; with ``max_attempts > 1``, a failed attempt (worker
-        death, stall) is re-run from scratch against whatever workers the
-        auction finds — and when the job has a ``checkpoint_dir`` the
-        replacement attempt warm-starts from the last completed round.
+        """Run the job; with ``max_attempts > 1``, a failed attempt (PS
+        death, quorum loss, stall) is re-run from scratch against whatever
+        workers the auction finds — and when the job has a
+        ``checkpoint_dir`` the replacement attempt warm-starts from the
+        last completed round.
 
-        This is the elastic-recovery seam the reference leaves as future
-        work (rfc/2025-08-04 "Next Steps: Automatic Rescheduling";
-        worker.rs:62-70 NOTEs). ``retry_backoff`` defaults past the 10 s
-        lease TTL so the failed attempt's leases lapse and the surviving
-        workers' capacity frees before re-auctioning.
+        With ``job.ft`` set, single train-worker failures never reach this
+        level: they degrade the round at quorum and trigger a rejoin
+        (hypha_tpu.ft), demoting the full restart to a last resort.
+        ``retry_backoff`` defaults past the 10 s lease TTL so the failed
+        attempt's leases lapse and the surviving workers' capacity frees
+        before re-auctioning. ``status_timeout=None`` uses the per-round
+        adaptive watchdog (simulation-projected round time).
         """
         if max_attempts < 1:
             raise ValueError("max_attempts must be >= 1")
@@ -178,16 +260,70 @@ class Orchestrator:
                 )
                 await asyncio.sleep(retry_backoff)
             try:
-                return await self._run_once(
+                result = await self._run_once(
                     job,
                     auction_timeout=auction_timeout,
                     allocation_attempts=allocation_attempts,
                     status_timeout=status_timeout,
                 )
+                result.attempt = attempt
+                return result
             except (JobFailed, AllocationError) as e:
                 last = e
         assert last is not None
         raise last
+
+    # ------------------------------------------------------------- job specs
+
+    def _train_spec(
+        self,
+        ctx: _RunContext,
+        suffix: str,
+        handle: WorkerHandle,
+        rejoin: bool = False,
+    ) -> JobSpec:
+        job = ctx.job
+        assert job is not None and ctx.ps_handle is not None
+        return JobSpec(
+            job_id=f"{ctx.base_id}-{suffix}",
+            executor=Executor(
+                kind="train",
+                name=TRAIN_EXECUTOR_NAME,
+                train=TrainExecutorConfig(
+                    model=job.model,
+                    data=Fetch(
+                        Reference.from_scheduler(self.node.peer_id, job.dataset)
+                    ),
+                    updates=Send(
+                        Reference.from_peers(
+                            [ctx.ps_handle.peer_id], ctx.updates_tag
+                        )
+                    ),
+                    results=Receive(
+                        Reference.from_peers(
+                            [ctx.ps_handle.peer_id], ctx.results_tag
+                        )
+                    ),
+                    optimizer=job.inner_optimizer,
+                    batch_size=handle.batch_size,
+                    preprocessor=job.preprocessor,
+                    scheduler=job.lr_scheduler,
+                    loss=job.loss,
+                    sharding=job.sharding,
+                    lora=job.lora,
+                    delta_dtype=job.delta_dtype,
+                    rejoin=rejoin,
+                    checkpoint=(
+                        {
+                            "dir": f"{job.checkpoint_dir}/{handle.peer_id}",
+                            "every_rounds": job.checkpoint_every,
+                        }
+                        if job.checkpoint_dir
+                        else None
+                    ),
+                ),
+            ),
+        )
 
     async def _run_once(
         self,
@@ -195,31 +331,35 @@ class Orchestrator:
         *,
         auction_timeout: float = 2.0,
         allocation_attempts: int = 3,
-        status_timeout: float = 600.0,
+        status_timeout: float | None = None,
     ) -> JobResult:
+        ft = job.ft if (job.ft is not None and job.ft.enabled) else None
         worker_offers = await self._allocate_train(
             job, auction_timeout=auction_timeout, attempts=allocation_attempts
         )
-        handles: list[WorkerHandle] = []
-        ps_handle: WorkerHandle | None = None
-        router: StatusRouter | None = None
-        data_scheduler: DataScheduler | None = None
+        ctx = _RunContext()
+        ctx.job = job
+        ctx.ft = ft
+        ctx.status_timeout = status_timeout
+        ctx.auction_timeout = auction_timeout
         progress_reg = None
+        tasks: list[Task] = []
         try:
             # Acceptance: first renewal converts each temp lease — must happen
             # within the 500 ms offer window, so BEFORE the PS auction runs
             # (worker.rs:75; rfc/2025-08-04 "Lease Renewal").
             for offer in worker_offers:
-                handles.append(await WorkerHandle.create(self.node, offer))
+                handle = await WorkerHandle.create(self.node, offer)
+                ctx.handles[handle.peer_id] = handle
             ps_offer = await self._allocate_ps(
                 job,
-                {h.peer_id for h in handles},
+                set(ctx.handles),
                 auction_timeout=auction_timeout,
                 attempts=allocation_attempts,
             )
-            ps_handle = await WorkerHandle.create(self.node, ps_offer)
+            ctx.ps_handle = await WorkerHandle.create(self.node, ps_offer)
 
-            for handle in handles:
+            for handle in ctx.handles.values():
                 handle.batch_size = self.batch_size_for(
                     handle.offer.resources,
                     job.resources.worker,
@@ -238,62 +378,74 @@ class Orchestrator:
                 raise JobFailed(f"no provider for dataset {job.dataset!r}")
             provider = providers[0]
 
-            data_scheduler = DataScheduler(
+            ctx.data_scheduler = DataScheduler(
                 self.node, provider, job.dataset, record.num_slices
             )
-            data_scheduler.start()
+            ctx.data_scheduler.start()
 
-            tracker = ProgressTracker(
-                parameter_server=ps_handle.peer_id,
+            ctx.tracker = ProgressTracker(
+                parameter_server=ctx.ps_handle.peer_id,
                 update_target=job.rounds.avg_samples_between_updates,
                 update_epochs=job.rounds.update_rounds,
             )
-            for handle in handles:
-                tracker.add_worker(handle.peer_id, handle.batch_size)
+            for peer, handle in ctx.handles.items():
+                ctx.tracker.add_worker(peer, handle.batch_size)
 
-            complete = asyncio.Event()
+            if ft is not None:
+                ctx.detector = PhiAccrualDetector(threshold=ft.phi_threshold)
+                ctx.membership = MembershipView(list(ctx.handles))
+                for handle in ctx.handles.values():
+                    handle.on_renew = ctx.detector.heartbeat
+
+            ctx.complete = asyncio.Event()
             collected: list = []
-            activity = [asyncio.get_running_loop().time()]  # watchdog feed
+            ctx.activity = [asyncio.get_running_loop().time()]  # watchdog feed
 
             def on_metrics(peer: str, round_num: int, metrics: dict) -> None:
                 collected.append((peer, round_num, metrics))
                 self.metrics_bridge.on_metrics(peer, round_num, metrics)
 
             batch_scheduler = BatchScheduler(
-                tracker, on_metrics=on_metrics, on_complete=complete.set
+                ctx.tracker, on_metrics=on_metrics, on_complete=ctx.complete.set
             )
 
             async def on_progress(peer: str, progress: Progress):
-                activity[0] = asyncio.get_running_loop().time()
+                ctx.activity[0] = asyncio.get_running_loop().time()
+                if ctx.detector is not None:
+                    # Every progress message is a liveness signal — per-batch
+                    # Status heartbeats mostly, but the PS's Updated and the
+                    # round metrics count too.
+                    ctx.detector.heartbeat(peer)
                 return batch_scheduler.on_progress(peer, progress)
 
             progress_reg = self.node.on(PROTOCOL_PROGRESS, Progress).respond_with(
                 on_progress
             )
 
-            router = StatusRouter(self.node)
-            base_id = str(uuid.uuid4())
-            worker_peers = [h.peer_id for h in handles]
+            ctx.router = StatusRouter(self.node)
+            ctx.base_id = str(uuid.uuid4())
+            worker_peers = list(ctx.handles)
             # Job-unique stream tags: push routing keys on these, so several
             # jobs (or a PS colocated with a train job) can share worker
             # nodes without consuming each other's tensor streams.
-            updates_tag = f"updates:{base_id}"
-            results_tag = f"results:{base_id}"
+            ctx.updates_tag = f"updates:{ctx.base_id}"
+            ctx.results_tag = f"results:{ctx.base_id}"
+            ctx.ps_job_id = f"{ctx.base_id}-ps"
 
             ps_task = await Task.dispatch(
                 self.node,
-                router,
+                ctx.router,
                 JobSpec(
-                    job_id=f"{base_id}-ps",
+                    job_id=ctx.ps_job_id,
                     executor=Executor(
                         kind="aggregate",
                         name=AGGREGATE_EXECUTOR_NAME,
                         aggregate=AggregateExecutorConfig(
                             updates=Receive(
-                                Reference.from_peers(worker_peers, updates_tag)
+                                Reference.from_peers(worker_peers, ctx.updates_tag)
                             ),
                             results=Send(
-                                Reference.from_peers(worker_peers, results_tag)
+                                Reference.from_peers(worker_peers, ctx.results_tag)
                             ),
                             optimizer=job.outer_optimizer,
                             num_workers=len(worker_peers),
@@ -302,144 +454,372 @@ class Orchestrator:
                                 if job.checkpoint_dir
                                 else None
                             ),
+                            quorum_fraction=ft.quorum_fraction if ft else 0.0,
+                            round_deadline_s=ft.round_deadline_s if ft else 0.0,
                         ),
                     ),
                 ),
-                [ps_handle],
+                [ctx.ps_handle],
             )
-            train_tasks: list[Task] = []
-            for i, handle in enumerate(handles):
-                spec = JobSpec(
-                    job_id=f"{base_id}-w{i}",
-                    executor=Executor(
-                        kind="train",
-                        name=TRAIN_EXECUTOR_NAME,
-                        train=TrainExecutorConfig(
-                            model=job.model,
-                            data=Fetch(
-                                Reference.from_scheduler(
-                                    self.node.peer_id, job.dataset
-                                )
-                            ),
-                            updates=Send(
-                                Reference.from_peers([ps_handle.peer_id], updates_tag)
-                            ),
-                            results=Receive(
-                                Reference.from_peers([ps_handle.peer_id], results_tag)
-                            ),
-                            optimizer=job.inner_optimizer,
-                            batch_size=handle.batch_size,
-                            preprocessor=job.preprocessor,
-                            scheduler=job.lr_scheduler,
-                            loss=job.loss,
-                            sharding=job.sharding,
-                            lora=job.lora,
-                            delta_dtype=job.delta_dtype,
-                            checkpoint=(
-                                {
-                                    "dir": f"{job.checkpoint_dir}/{handle.peer_id}",
-                                    "every_rounds": job.checkpoint_every,
-                                }
-                                if job.checkpoint_dir
-                                else None
-                            ),
-                        ),
-                    ),
-                )
-                train_tasks.append(
-                    await Task.dispatch(self.node, router, spec, [handle])
+            tasks.append(ps_task)
+            for i, (peer, handle) in enumerate(ctx.handles.items()):
+                spec = self._train_spec(ctx, f"w{i}", handle)
+                tasks.append(
+                    await Task.dispatch(self.node, ctx.router, spec, [handle])
                 )
 
-            await self._supervise(
-                complete,
-                handles + [ps_handle],
-                train_tasks + [ps_task],
-                status_timeout,
-                activity,
-            )
-            return JobResult(base_id, tracker.round, collected)
+            await self._supervise(ctx, tasks)
+            ft_summary = None
+            if ctx.membership is not None:
+                snap = ctx.membership.snapshot()
+                ft_summary = {
+                    "epoch": snap.epoch,
+                    "active": snap.active,
+                    "suspected": snap.suspected,
+                    "departed": snap.departed,
+                    "rejoins": ctx.rejoin_count,
+                }
+            return JobResult(ctx.base_id, ctx.tracker.round, collected, ft=ft_summary)
         finally:
+            for task in ctx.notify_tasks:
+                task.cancel()
             if progress_reg is not None:
                 progress_reg.close()
-            if data_scheduler is not None:
-                data_scheduler.stop()
-            if router is not None:
-                router.close()
-            for handle in handles:
+            if ctx.data_scheduler is not None:
+                ctx.data_scheduler.stop()
+            if ctx.router is not None:
+                ctx.router.close()
+            for handle in ctx.handles.values():
                 await handle.release()
-            if ps_handle is not None:
-                await ps_handle.release()
+            if ctx.ps_handle is not None:
+                await ctx.ps_handle.release()
             await self.metrics_bridge.close()
 
-    async def _supervise(
-        self,
-        complete: asyncio.Event,
-        handles: list[WorkerHandle],
-        tasks: list[Task],
-        status_timeout: float,
-        activity: list[float] | None = None,
-    ) -> None:
-        """Wait for completion; abort on worker failure or failed status
-        (hypha-scheduler.rs:372-412 select loop). ``status_timeout`` is a
-        no-PROGRESS watchdog: it resets on every progress message, so a
+    # ------------------------------------------------------------ supervision
+
+    def _effective_timeout(self, ctx: _RunContext) -> float:
+        """Per-round no-progress deadline.
+
+        Explicit ``status_timeout`` wins. Otherwise, once every tracked
+        worker has batch-timing statistics, the synchronization simulation
+        projects a full round from scratch and the deadline is
+        ``clamp(5 × projected + PS round deadline, 60 s, 600 s)`` —
+        recomputed every tick, so it tracks membership and speed changes.
+        """
+        if ctx.status_timeout is not None:
+            return ctx.status_timeout
+        tracker = ctx.tracker
+        if tracker is None or not tracker.has_full_stats():
+            return DEFAULT_STATUS_TIMEOUT
+        projection = project(
+            tracker.update_target,
+            tracker.sims(fresh=True),
+            time_cap_ms=float("inf"),
+            updates_cap=1_000_000_000,
+        )
+        deadline = ROUND_DEADLINE_FACTOR * projection.time_ms / 1000.0
+        if ctx.ft is not None:
+            deadline += ctx.ft.round_deadline_s
+        return min(max(deadline, ROUND_DEADLINE_FLOOR_S), DEFAULT_STATUS_TIMEOUT)
+
+    async def _watch_status(self, task: Task) -> tuple[str, str, str]:
+        """Resolve when ``task`` reports failed/cancelled on some worker."""
+        while True:
+            peer, status = await task.next_status()
+            log.info("job %s on %s: %s %s",
+                     status.job_id, peer, status.state, status.message)
+            if status.state == "failed":
+                return peer, status.job_id, status.message or "failed"
+            if status.state == "cancelled":
+                return peer, status.job_id, "cancelled"
+
+    async def _supervise(self, ctx: _RunContext, tasks: list[Task]) -> None:
+        """Wait for completion; tolerate train-worker loss when elastic.
+
+        Failure signals: per-task failed/cancelled job statuses, per-handle
+        lease-renewal failures, and (elastic only) φ-accrual suspicion
+        polled every tick. Without ``job.ft`` any failure aborts the attempt
+        exactly like the seed (hypha-scheduler.rs:372-412 select loop).
+        The no-PROGRESS watchdog resets on every progress message, so a
         long but steadily-reporting job is never killed."""
+        assert ctx.complete is not None
+        waiters: dict[asyncio.Task, tuple[str, Any]] = {}
 
-        async def watch_statuses() -> str:
-            async def one(task: Task) -> str:
-                while True:
-                    peer, status = await task.next_status()
-                    log.info("job %s on %s: %s %s",
-                             status.job_id, peer, status.state, status.message)
-                    if status.state == "failed":
-                        return f"{status.job_id} failed on {peer}: {status.message}"
-                    if status.state == "cancelled":
-                        return f"{status.job_id} cancelled on {peer}"
+        def add(kind: str, payload: Any, coro) -> None:
+            waiters[asyncio.create_task(coro, name=kind)] = (kind, payload)
 
-            watchers = [asyncio.create_task(one(t)) for t in tasks]
-            try:
-                done, _ = await asyncio.wait(
-                    watchers, return_when=asyncio.FIRST_COMPLETED
-                )
-                return next(iter(done)).result()
-            finally:
-                for w in watchers:
-                    w.cancel()
-
-        waiters = {
-            asyncio.create_task(complete.wait(), name="complete"): "complete",
-            asyncio.create_task(watch_statuses(), name="status"): "status",
-        }
-        for handle in handles:
-            waiters[
-                asyncio.create_task(_await_failure(handle), name="worker")
-            ] = "worker"
+        add("complete", None, ctx.complete.wait())
+        for task in tasks:
+            add("status", task, self._watch_status(task))
+        for handle in list(ctx.handles.values()) + [ctx.ps_handle]:
+            add("worker", handle, _await_failure(handle))
         loop = asyncio.get_running_loop()
         try:
             while True:
-                last = activity[0] if activity else loop.time()
-                remaining = (last + status_timeout) - loop.time()
+                timeout_s = self._effective_timeout(ctx)
+                last = ctx.activity[0] if ctx.activity else loop.time()
+                remaining = (last + timeout_s) - loop.time()
                 if remaining <= 0:
-                    raise JobFailed(f"no progress in {status_timeout}s")
+                    raise JobFailed(f"no progress in {timeout_s:.0f}s")
                 done, _ = await asyncio.wait(
                     waiters,
-                    timeout=min(remaining, 5.0),
+                    timeout=min(remaining, 1.0),
                     return_when=asyncio.FIRST_COMPLETED,
                 )
+                if ctx.membership is not None:
+                    self._poll_suspicion(ctx)
                 if not done:
                     continue  # re-check the watchdog, keep waiting
                 # Completion wins ties: when a worker's lease-renewal failure
                 # lands in the same asyncio.wait round as job completion
                 # (plausible during teardown), the job must not be reported
                 # failed and re-executed.
-                if any(waiters[t] == "complete" for t in done):
+                if any(waiters[t][0] == "complete" for t in done):
                     return
-                raise JobFailed(str(next(iter(done)).result()))
+                for t in done:
+                    kind, payload = waiters.pop(t)
+                    if t.cancelled():
+                        # A released handle's failure future was cancelled
+                        # (its peer already departed via another signal).
+                        continue
+                    if kind == "status":
+                        peer, job_id, reason = t.result()
+                        if ctx.ft is None or job_id == ctx.ps_job_id:
+                            raise JobFailed(f"{job_id} failed on {peer}: {reason}")
+                        await self._depart(ctx, peer, f"{job_id}: {reason}", add)
+                    elif kind == "worker":
+                        failure = t.result()
+                        peer = getattr(failure, "peer_id", "")
+                        is_ps = (
+                            ctx.ps_handle is not None
+                            and payload is ctx.ps_handle
+                        )
+                        if ctx.ft is None or is_ps:
+                            raise JobFailed(str(failure))
+                        await self._depart(ctx, peer, str(failure), add)
+                    elif kind == "rejoin":
+                        joined = t.result()
+                        if joined is not None:
+                            handle, task = joined
+                            add("status", task, self._watch_status(task))
+                            add("worker", handle, _await_failure(handle))
+                        else:
+                            log.warning(
+                                "rejoin gave up; continuing degraded at "
+                                "%d active workers",
+                                len(ctx.membership.active)
+                                if ctx.membership
+                                else -1,
+                            )
         finally:
             for t in waiters:
                 t.cancel()
             await asyncio.gather(*waiters, return_exceptions=True)
 
+    # ------------------------------------------------------- elastic details
 
-async def _await_failure(handle: WorkerHandle) -> str:
-    failure = await asyncio.shield(handle.failed)
-    return str(failure)
+    def _poll_suspicion(self, ctx: _RunContext) -> None:
+        """φ threshold crossings → suspected; heartbeats again → reinstated.
+
+        Suspicion is advisory (the PS stops *waiting* for suspected peers
+        beyond quorum but still accepts their deltas); the hard departure
+        signal stays the lease renewal failure / failed job status.
+
+        Only peers that SHOULD be heartbeating are judged: a worker that
+        shipped its delta (UPDATING) or finished (DONE) is protocol-silent
+        while it waits on the parameter server — φ over that silence would
+        suspect the whole fleet at every round boundary."""
+        assert ctx.membership is not None and ctx.detector is not None
+        assert ctx.tracker is not None
+        changed = False
+        for peer in list(ctx.membership.active):
+            if peer in ctx.tracker.peers and ctx.tracker.state(peer) in (
+                WorkerState.UPDATING,
+                WorkerState.DONE,
+            ):
+                continue
+            if ctx.detector.suspected(peer):
+                if ctx.membership.suspect(peer):
+                    FT_METRICS.suspected_peers.add(1)
+                    log.warning(
+                        "worker %s suspected (phi=%.1f >= %.1f)",
+                        peer, ctx.detector.phi(peer), ctx.detector.threshold,
+                    )
+                    changed = True
+            elif ctx.membership.reinstate(peer):
+                log.info("worker %s re-healed (phi=%.1f)", peer, ctx.detector.phi(peer))
+                changed = True
+        if changed:
+            self._notify_membership_soon(ctx)
+
+    def _notify_membership_soon(self, ctx: _RunContext, joined: list[str] | None = None) -> None:
+        """Fire-and-forget membership push to the PS (never blocks the
+        supervision loop; a lost update is repaired by the next one)."""
+        task = asyncio.create_task(self._notify_membership(ctx, joined))
+        ctx.notify_tasks.add(task)
+        task.add_done_callback(ctx.notify_tasks.discard)
+
+    async def _notify_membership(
+        self, ctx: _RunContext, joined: list[str] | None = None
+    ) -> bool:
+        """Push the current membership snapshot to the PS; False on failure.
+
+        Plain suspicion/departure updates tolerate a loss (the next update
+        carries the full snapshot, and the PS epoch-gates stale ones), but
+        a ``joined`` notification is load-bearing: it is the only message
+        that queues the rejoiner's catch-up, so its caller must check."""
+        assert ctx.membership is not None and ctx.ps_handle is not None
+        update = MembershipUpdate(
+            job_id=ctx.ps_job_id,
+            membership=ctx.membership.snapshot(),
+            joined=list(joined or []),
+        )
+        try:
+            await self.node.request(
+                ctx.ps_handle.peer_id, PROTOCOL_FT, update, timeout=10
+            )
+        except RequestError as e:
+            log.warning("membership update to PS failed: %s", e)
+            return False
+        return True
+
+    async def _depart(self, ctx: _RunContext, peer: str, reason: str, add) -> None:
+        """A train worker is gone: degrade the round set, maybe rejoin."""
+        assert ctx.membership is not None and ctx.tracker is not None
+        assert ctx.ft is not None and ctx.job is not None
+        if peer not in ctx.membership.active:
+            return  # double signal (lease failure + failed status)
+        log.warning("worker %s departed (%s); degrading round set", peer, reason)
+        ctx.membership.depart(peer)
+        if ctx.detector is not None:
+            ctx.detector.remove(peer)
+        handle = ctx.handles.pop(peer, None)
+        if handle is not None:
+            await handle.release()
+        if peer in ctx.tracker.peers:
+            ctx.tracker.remove_worker(peer)
+        if ctx.data_scheduler is not None:
+            ctx.data_scheduler.remove_worker(peer)
+        # The job bought num_workers replicas; falling below the quorum of
+        # THAT number means the round average has lost statistical meaning
+        # for this job — last-resort restart (run()'s max_attempts).
+        floor = quorum_size(ctx.ft.quorum_fraction, ctx.job.resources.num_workers)
+        if len(ctx.membership.active) < floor:
+            raise JobFailed(
+                f"quorum lost: {len(ctx.membership.active)} active < {floor} "
+                f"(of {ctx.job.resources.num_workers} bought)"
+            )
+        self._notify_membership_soon(ctx)
+        if ctx.tracker.rounds_left > 1 and ctx.ft.rejoin_attempts > 0:
+            departed_at = asyncio.get_running_loop().time()
+            add("rejoin", peer, self._rejoin_worker(ctx, peer, departed_at))
+        else:
+            log.info(
+                "not rejoining for %s (%d rounds left)",
+                peer, ctx.tracker.rounds_left,
+            )
+
+    async def _rejoin_worker(
+        self, ctx: _RunContext, departed_peer: str, departed_at: float
+    ) -> tuple[WorkerHandle, Task] | None:
+        """Auction a replacement and re-enter it at the next epoch.
+
+        The replacement initializes from the model seed and catches up from
+        the PS's cumulative update (ft/rejoin.py) — no job restart. Returns
+        (handle, task) or None after ``rejoin_attempts`` failed tries.
+        """
+        assert ctx.ft is not None and ctx.job is not None
+        assert ctx.membership is not None and ctx.tracker is not None
+        spec_ws = self._train_worker_spec(ctx.job)
+        loop = asyncio.get_running_loop()
+        for attempt in range(ctx.ft.rejoin_attempts):
+            if attempt:
+                await asyncio.sleep(ctx.ft.rejoin_backoff_s)
+            try:
+                offers = await self.allocator.request(
+                    spec_ws,
+                    ctx.job.resources.worker_price,
+                    ctx.auction_timeout,
+                    len(ctx.membership.active) + 1,
+                )
+            except Exception as e:
+                log.warning("rejoin auction failed: %s", e)
+                continue
+            candidates = [
+                o for o in offers if o.peer_id not in ctx.membership.active
+            ]
+            if not candidates:
+                log.info(
+                    "rejoin %d/%d: no fresh offers (got %d)",
+                    attempt + 1, ctx.ft.rejoin_attempts, len(offers),
+                )
+                continue
+            offer = candidates[0]
+            peer = offer.peer_id
+            handle: WorkerHandle | None = None
+            added = False
+            try:
+                handle = await WorkerHandle.create(self.node, offer)
+                handle.batch_size = self.batch_size_for(
+                    offer.resources, ctx.job.resources.worker,
+                    ctx.job.rounds.max_batch_size,
+                )
+                if ctx.detector is not None:
+                    handle.on_renew = ctx.detector.heartbeat
+                # Tracker + membership BEFORE dispatch: the worker's first
+                # Status must find it tracked, and the PS must have queued
+                # its catch-up before the executor starts waiting for it.
+                ctx.tracker.add_worker(peer, handle.batch_size)
+                ctx.membership.join(peer)
+                added = True
+                if not await self._notify_membership(ctx, joined=[peer]):
+                    # Without this update the PS never sends the catch-up
+                    # and the dispatched worker would block forever while
+                    # holding a tracker slot that must reach DONE.
+                    raise RequestError("join notification to PS failed")
+                spec = self._train_spec(
+                    ctx, f"r{ctx.rejoin_count}", handle, rejoin=True
+                )
+                task = await Task.dispatch(self.node, ctx.router, spec, [handle])
+            except asyncio.CancelledError:
+                # Supervision ended mid-rejoin (completion / attempt
+                # failure): a leaked handle would renew the lease forever,
+                # pinning the worker's capacity.
+                await self._rollback_rejoin(ctx, peer, handle, added)
+                raise
+            except (RequestError, DispatchError) as e:
+                log.warning("rejoin: attempt with %s failed: %s", peer, e)
+                await self._rollback_rejoin(ctx, peer, handle, added)
+                continue
+            ctx.handles[peer] = handle
+            ctx.rejoin_count += 1
+            latency_ms = (loop.time() - departed_at) * 1000.0
+            FT_METRICS.rejoins.add(1)
+            FT_METRICS.rejoin_latency_ms.record(latency_ms)
+            log.info(
+                "worker %s rejoined for %s at epoch %d (%.0f ms after departure)",
+                peer, departed_peer, ctx.membership.epoch, latency_ms,
+            )
+            return handle, task
+        return None
+
+    async def _rollback_rejoin(
+        self,
+        ctx: _RunContext,
+        peer: str,
+        handle: WorkerHandle | None,
+        added: bool,
+    ) -> None:
+        """Undo a half-done rejoin attempt (failed or cancelled)."""
+        if added:
+            assert ctx.tracker is not None and ctx.membership is not None
+            if peer in ctx.tracker.peers:
+                ctx.tracker.remove_worker(peer)
+            ctx.membership.depart(peer)
+            self._notify_membership_soon(ctx)
+        if handle is not None:
+            await handle.release()
+
+
+async def _await_failure(handle: WorkerHandle):
+    return await asyncio.shield(handle.failed)

@@ -10,6 +10,7 @@ import enum
 import time
 from typing import Callable
 
+from .simulation import WorkerSim
 from .statistics import RunningMean, RuntimeStatistic
 
 __all__ = ["WorkerState", "ProgressTracker", "SliceTracker"]
@@ -107,6 +108,27 @@ class ProgressTracker:
         self.round += 1
         self.counter = self.update_target
         self.round_start = self._clock()
+
+    def sims(self, peers: list[str] | None = None, fresh: bool = False) -> list[WorkerSim]:
+        """Simulation inputs for ``peers`` (default: all tracked workers).
+
+        ``fresh=True`` zeroes the elapsed time — projecting a whole round
+        from its start (the orchestrator's per-round deadline) instead of
+        the in-flight remainder (the batch scheduler's sync point)."""
+        if peers is None:
+            peers = list(self.peers)
+        return [
+            WorkerSim(
+                batch_size=self.batch_sizes[self.index_of(p)],
+                mean_batch_ms=self.stats[self.index_of(p)].mean(),
+                elapsed_ms=0.0 if fresh else self.elapsed_ms(p),
+            )
+            for p in peers
+        ]
+
+    def has_full_stats(self) -> bool:
+        """Every tracked worker has reported at least one timed batch."""
+        return bool(self.stats) and all(s.mean() is not None for s in self.stats)
 
     @property
     def rounds_left(self) -> int:

@@ -39,6 +39,10 @@ class WorkerHandle:
         self.failed: asyncio.Future[WorkerFailure] = (
             asyncio.get_event_loop().create_future()
         )
+        # Liveness hook: called with the peer id after every successful
+        # renewal — the orchestrator's φ-accrual detector feeds on it
+        # alongside the per-batch Status heartbeats (hypha_tpu.ft.detector).
+        self.on_renew: "callable | None" = None
         self._renewal: asyncio.Task | None = None
         self._released = False
 
@@ -63,13 +67,26 @@ class WorkerHandle:
         return resp.timeout
 
     async def _renewal_loop(self, timeout: float) -> None:
-        """Re-renew at 2/3 of the granted validity (worker.rs:103-117)."""
+        """Re-renew at 2/3 of the granted validity (worker.rs:103-117).
+
+        One immediate retry before declaring failure: renewing at 2/3 of
+        the TTL leaves a third of it unspent, so a single RPC timeout on a
+        loaded host must not depose a healthy worker — a dead node fails
+        both attempts fast and detection latency stays unchanged."""
         while not self._released:
             await asyncio.sleep(timeout * 2 / 3)
             if self._released:
                 return
             try:
-                timeout = await self._renew()
+                try:
+                    timeout = await self._renew()
+                except RequestError as e:
+                    log.warning(
+                        "renewal of %s failed (%s); one retry", self.peer_id, e
+                    )
+                    timeout = await self._renew()
+                if self.on_renew is not None:
+                    self.on_renew(self.peer_id)
             except RequestError as e:
                 # Resolved with (not raised as) the failure so an un-awaited
                 # handle doesn't log "exception never retrieved".

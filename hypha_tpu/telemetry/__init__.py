@@ -450,3 +450,10 @@ def instrument_node(meter: Meter, node) -> None:
     meter.observable_gauge(
         "hypha.bandwidth.outbound.bytes", lambda: float(node.bytes_out), unit="By"
     )
+
+
+# Fault-tolerance instruments (import at the bottom: ft_metrics uses the
+# Counter/Histogram classes defined above).
+from .ft_metrics import FT_METRICS, FTMetrics  # noqa: E402
+
+__all__ += ["FT_METRICS", "FTMetrics"]

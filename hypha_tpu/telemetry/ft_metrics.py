@@ -1,0 +1,58 @@
+"""Fault-tolerance instruments: one shared bundle for the ft subsystem.
+
+The φ detector, elastic parameter server and rejoin path all record into a
+process-global :data:`FT_METRICS` bundle so in-process tests and ``bench.py
+--chaos`` can read one snapshot regardless of which component did the work.
+``register_on`` exposes the same values as observable gauges on a real
+:class:`~hypha_tpu.telemetry.Meter` for OTLP export.
+"""
+
+from __future__ import annotations
+
+from . import Counter, Histogram, Meter
+
+__all__ = ["FTMetrics", "FT_METRICS", "register_on"]
+
+
+class FTMetrics:
+    def __init__(self) -> None:
+        self.suspected_peers = Counter("hypha.ft.suspected_peers")
+        self.degraded_rounds = Counter("hypha.ft.degraded_rounds")
+        self.stale_deltas_dropped = Counter("hypha.ft.stale_deltas_dropped")
+        self.rejoins = Counter("hypha.ft.rejoins")
+        self.rejoin_latency_ms = Histogram(
+            "hypha.ft.rejoin_latency", unit="ms",
+            bounds=(50, 100, 250, 500, 1000, 2500, 5000, 10000, 30000),
+        )
+
+    def snapshot(self) -> dict:
+        hist = self.rejoin_latency_ms.snapshot()
+        return {
+            "suspected_peers": self.suspected_peers.value(),
+            "degraded_rounds": self.degraded_rounds.value(),
+            "stale_deltas_dropped": self.stale_deltas_dropped.value(),
+            "rejoins": self.rejoins.value(),
+            "rejoin_latency_ms_sum": hist["sum"],
+            "rejoin_latency_ms_count": hist["count"],
+        }
+
+    def reset(self) -> None:
+        """Fresh instruments (tests and bench isolate runs this way)."""
+        self.__init__()
+
+
+FT_METRICS = FTMetrics()
+
+
+def register_on(meter: Meter, metrics: FTMetrics = FT_METRICS) -> None:
+    """Export the bundle through a Meter as observable gauges."""
+    meter.observable_gauge(
+        "hypha.ft.suspected_peers", metrics.suspected_peers.value
+    )
+    meter.observable_gauge(
+        "hypha.ft.degraded_rounds", metrics.degraded_rounds.value
+    )
+    meter.observable_gauge(
+        "hypha.ft.stale_deltas_dropped", metrics.stale_deltas_dropped.value
+    )
+    meter.observable_gauge("hypha.ft.rejoins", metrics.rejoins.value)

@@ -300,6 +300,9 @@ class Bridge:
                     "size": rf.size,
                     "from_peer": rf.from_peer,
                     "resource": rf.resource,
+                    # Full push header (round/epoch/catchup flags): the
+                    # training loop's rejoin path keys off this.
+                    "meta": rf.meta,
                 }
                 try:
                     writer.write(f"data: {json.dumps(event)}\n\n".encode())
@@ -308,7 +311,13 @@ class Bridge:
                     break
         finally:
             client_gone.cancel()
-            await gen.aclose()
+            try:
+                await gen.aclose()
+            except RuntimeError:
+                # A severed node can leave a cancelled-but-unfinished anext
+                # inside the generator; aclose() then refuses ("already
+                # running"). The consumer is closed either way.
+                pass
 
     async def _status(self, body: dict, writer: asyncio.StreamWriter) -> None:
         progress = messages.from_json_dict(body.get("progress"))

@@ -50,11 +50,21 @@ def _safe_name(name: str) -> str:
 
 
 class ReceivedFile:
-    def __init__(self, path: Path, size: int, from_peer: str, resource: str) -> None:
+    def __init__(
+        self,
+        path: Path,
+        size: int,
+        from_peer: str,
+        resource: str,
+        meta: dict | None = None,
+    ) -> None:
         self.path = path
         self.size = size
         self.from_peer = from_peer
         self.resource = resource
+        # Full push header (round, epoch, catchup, num_samples, ...): the
+        # executor-side control data that rides each tensor stream.
+        self.meta = meta or {}
 
 
 def fetch_uri(uri: str, dest_dir: Path) -> Path:
@@ -219,7 +229,8 @@ class Connector:
                     # so the sender's connection isn't pinned forever.
                     push.finish()
                     raise
-                yield ReceivedFile(dest, size, push.peer, resource)
+                meta = push.resource if isinstance(push.resource, dict) else {}
+                yield ReceivedFile(dest, size, push.peer, resource, meta)
         finally:
             consumer.close()
 

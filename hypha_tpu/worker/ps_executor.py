@@ -35,8 +35,11 @@ import numpy as np
 from safetensors.numpy import load_file, save_file
 
 from .. import native
+from ..ft.membership import PROTOCOL_FT, MembershipUpdate, RoundMembership, quorum_size
+from ..ft.rejoin import CATCHUP_KEY, CatchupBuffer
 from ..messages import (
     PROTOCOL_PROGRESS,
+    Ack,
     JobSpec,
     Progress,
     ProgressKind,
@@ -45,11 +48,52 @@ from ..messages import (
     TransferStrategy,
 )
 from ..network.node import Node, RequestError
+from ..telemetry.ft_metrics import FT_METRICS
 from .job_manager import Execution, JobExecutor
 
 __all__ = ["ParameterServerExecutor"]
 
 log = logging.getLogger("hypha.worker.ps")
+
+# Elastic collect poll tick: upper bound on how long a membership change or
+# pending rejoin waits before the collect loop notices it.
+_ELASTIC_TICK_S = 0.5
+
+
+class _ElasticState:
+    """Per-job elastic-membership state on the parameter server.
+
+    The scheduler owns membership truth; this is the PS's last adopted
+    snapshot plus the rejoin catch-up machinery (hypha_tpu.ft.rejoin).
+    """
+
+    def __init__(self, cfg, scheduler_peer: str) -> None:
+        self.quorum_fraction = cfg.quorum_fraction
+        self.round_deadline_s = cfg.round_deadline_s
+        self.scheduler_peer = scheduler_peer
+        self.membership = RoundMembership(
+            epoch=0, active=sorted(cfg.updates.ref.peers or [])
+        )
+        self.catchup = CatchupBuffer()
+        # peers awaiting a catch-up push -> remaining send attempts
+        self.pending_joins: dict[str, int] = {}
+        # early deltas: round -> peer -> (path, samples)
+        self.early: dict[int, dict[str, tuple[Path, float]]] = {}
+
+    def quorum(self) -> int:
+        return quorum_size(self.quorum_fraction, len(self.membership.active))
+
+    def adopt(self, update: MembershipUpdate) -> None:
+        # Epoch-gated: the orchestrator's notifications are concurrent
+        # fire-and-forget requests, so an older snapshot can land after a
+        # newer one — adopting it would regress the view (e.g. drop a
+        # freshly joined peer, whose deltas would then be rejected as
+        # non-member). joined is merged regardless: pending_joins is
+        # idempotent and a catch-up owed is owed.
+        if update.membership.epoch >= self.membership.epoch:
+            self.membership = update.membership
+        for peer in update.joined:
+            self.pending_joins.setdefault(peer, 3)
 
 
 class ParameterServerExecutor(JobExecutor):
@@ -86,6 +130,7 @@ class ParameterServerExecutor(JobExecutor):
         if num_workers <= 0:
             execution.finish("failed", "aggregate config names no workers")
             return
+        elastic = _ElasticState(cfg, scheduler_peer) if cfg.quorum_fraction > 0 else None
         lr, mu = cfg.optimizer.lr, cfg.optimizer.momentum
         # Momentum lives as a SafeTensors FILE (like the reference,
         # parameter_server.rs:392-397) so the native C++ outer step can mmap
@@ -111,11 +156,37 @@ class ParameterServerExecutor(JobExecutor):
             )
 
         consumer = self.node.consume_pushes(wants)
+        membership_reg = None
+        if elastic is not None:
+            # The scheduler's membership snapshots arrive over /hypha-ft;
+            # adopting one is the only mutation, so the collect loop simply
+            # re-reads `elastic.membership` on its next poll tick.
+            async def on_membership(peer: str, msg: MembershipUpdate) -> Ack:
+                if peer != scheduler_peer:
+                    return Ack(ok=False, message="membership updates come from the scheduler")
+                log.info(
+                    "ps %s: membership epoch %d (active=%d suspected=%d joined=%s)",
+                    job_id, msg.membership.epoch, len(msg.membership.active),
+                    len(msg.membership.suspected), msg.joined,
+                )
+                elastic.adopt(msg)
+                return Ack(ok=True)
+
+            membership_reg = (
+                self.node.on(PROTOCOL_FT, MembershipUpdate)
+                .match(lambda m: m.job_id == job_id)
+                .respond_with(on_membership)
+            )
         try:
             while True:
-                received = await self._collect_round(
-                    consumer, job_id, allowed, num_workers, work_dir, round_num
-                )
+                if elastic is not None:
+                    received = await self._collect_round_elastic(
+                        consumer, job_id, elastic, cfg, work_dir, round_num
+                    )
+                else:
+                    received = await self._collect_round(
+                        consumer, job_id, allowed, num_workers, work_dir, round_num
+                    )
                 update_path = self._outer_step(
                     received, momentum_file, lr, mu, work_dir, round_num
                 )
@@ -128,10 +199,18 @@ class ParameterServerExecutor(JobExecutor):
                 # starts a phantom extra round (the reference broadcasts
                 # first, parameter_server.rs:232-283, and carries this race).
                 response = await self._notify_updated(scheduler_peer, job_id, round_num)
-                await self._broadcast(cfg, update_path, round_num)
+                await self._broadcast(cfg, update_path, round_num, elastic)
                 for path, _ in received.values():
                     path.unlink(missing_ok=True)
                 round_num += 1
+                if elastic is not None:
+                    # The running Σ of updates is the rejoin catch-up payload
+                    # (θ_r = θ₀ + Σ); fold this round in, then serve anyone
+                    # who joined — before the next round's first broadcast,
+                    # so a rejoiner can never see an update it must skip.
+                    elastic.catchup.accumulate(update_path)
+                    update_path.unlink(missing_ok=True)
+                    await self._serve_joins(elastic, cfg, round_num, work_dir)
                 if response.kind == ProgressResponseKind.DONE:
                     execution.finish("completed")
                     return
@@ -141,6 +220,8 @@ class ParameterServerExecutor(JobExecutor):
             log.exception("parameter server job %s failed", job_id)
             execution.finish("failed", str(e))
         finally:
+            if membership_reg is not None:
+                membership_reg.close()
             consumer.close()
             shutil.rmtree(work_dir, ignore_errors=True)
 
@@ -169,25 +250,163 @@ class ParameterServerExecutor(JobExecutor):
                 log.warning("ps %s: duplicate delta from %s; replacing", job_id, peer)
                 received[peer][0].unlink(missing_ok=True)
                 del received[peer]
-            name = hashlib.sha256(peer.encode()).hexdigest()[:24]
-            dest = work_dir / f"delta-{round_num}-{name}.safetensors"
-            await push.save_to(dest)
-            samples = 1.0
-            if isinstance(push.resource, dict):
-                # Peer-supplied weight: a non-finite/zero/negative value must
-                # not poison the weighted mean (or crash the PS loop).
-                try:
-                    samples = float(push.resource.get("num_samples", 1.0))
-                except (TypeError, ValueError):
-                    samples = 1.0
-                if not np.isfinite(samples) or samples <= 0:
-                    samples = 1.0
-            received[peer] = (dest, samples)
+            received[peer] = await self._save_delta(push, work_dir, round_num)
             log.info(
                 "ps %s: round %d delta %d/%d (from %s)",
                 job_id, round_num, len(received), num_workers, peer,
             )
         return received
+
+    async def _collect_round_elastic(
+        self,
+        consumer,
+        job_id: str,
+        st: _ElasticState,
+        cfg,
+        work_dir: Path,
+        round_num: int,
+    ) -> dict[str, tuple[Path, float]]:
+        """Quorum + deadline gather: peer -> (path, samples).
+
+        Close conditions (both require ``len(received) >= quorum``):
+          * every live active worker (active − suspected) has reported, or
+          * ``round_deadline_s`` expired since the round's collect began.
+        Deltas tagged with an old round number are dropped as stale; ones
+        tagged with a future round are parked and pre-credited to it.
+        """
+        received: dict[str, tuple[Path, float]] = dict(st.early.pop(round_num, {}))
+        loop = asyncio.get_running_loop()
+        deadline = (
+            loop.time() + st.round_deadline_s if st.round_deadline_s > 0 else None
+        )
+        deadline_logged = False
+        while True:
+            # A rejoiner announced mid-round starts contributing to THIS
+            # round: serve its catch-up from inside the wait loop.
+            await self._serve_joins(st, cfg, round_num, work_dir)
+            expected = st.membership.expected() | set(received)
+            quorate = len(received) >= st.quorum()
+            if received and quorate and set(received) >= expected:
+                break
+            now = loop.time()
+            if deadline is not None and now >= deadline:
+                if quorate:
+                    break
+                if not deadline_logged:
+                    deadline_logged = True
+                    log.warning(
+                        "ps %s: round %d deadline passed with %d/%d deltas; "
+                        "waiting for quorum",
+                        job_id, round_num, len(received), st.quorum(),
+                    )
+            timeout = _ELASTIC_TICK_S
+            if deadline is not None and now < deadline:
+                timeout = min(timeout, max(deadline - now, 0.05))
+            try:
+                push = await consumer.next(timeout=timeout)
+            except asyncio.TimeoutError:
+                continue
+            peer = push.peer
+            if peer not in st.membership.active:
+                log.warning(
+                    "ps %s: push from non-member peer %s dropped", job_id, peer
+                )
+                await push.read_all()
+                continue
+            delta_round = round_num
+            if isinstance(push.resource, dict) and "round" in push.resource:
+                try:
+                    delta_round = int(push.resource["round"])
+                except (TypeError, ValueError):
+                    delta_round = round_num
+            if delta_round < round_num:
+                # Stale: the round it belongs to already aggregated (its
+                # sender was past the deadline / partitioned). Folding it
+                # into the current mean would double-apply old progress.
+                log.warning(
+                    "ps %s: stale delta for round %d from %s dropped (now %d)",
+                    job_id, delta_round, peer, round_num,
+                )
+                FT_METRICS.stale_deltas_dropped.add(1)
+                await push.read_all()
+                continue
+            entry = await self._save_delta(push, work_dir, delta_round)
+            if delta_round > round_num:
+                # Early: a fast worker already merged this round's broadcast
+                # and shipped the next pseudo-gradient; credit it forward.
+                bucket = st.early.setdefault(delta_round, {})
+                old = bucket.pop(peer, None)
+                if old is not None:
+                    old[0].unlink(missing_ok=True)
+                bucket[peer] = entry
+                continue
+            old = received.pop(peer, None)
+            if old is not None:
+                # Double-send guard (reference TODO :215-218): replace.
+                log.warning("ps %s: duplicate delta from %s; replacing", job_id, peer)
+                old[0].unlink(missing_ok=True)
+            received[peer] = entry
+            log.info(
+                "ps %s: round %d delta %d (quorum %d, active %d) from %s",
+                job_id, round_num, len(received), st.quorum(),
+                len(st.membership.active), peer,
+            )
+        # Degraded = fewer deltas than the job bought replicas (a departed
+        # worker that was never replaced keeps every round degraded, even
+        # though the shrunken active set reported "in full").
+        full = max(cfg.num_workers, len(st.membership.active))
+        if len(received) < full:
+            FT_METRICS.degraded_rounds.add(1)
+            log.warning(
+                "ps %s: round %d DEGRADED — aggregating %d of %d",
+                job_id, round_num, len(received), full,
+            )
+        return received
+
+    @staticmethod
+    async def _save_delta(
+        push, work_dir: Path, round_num: int
+    ) -> tuple[Path, float]:
+        """Save one pseudo-gradient push; returns (path, sample weight)."""
+        name = hashlib.sha256(push.peer.encode()).hexdigest()[:24]
+        dest = work_dir / f"delta-{round_num}-{name}.safetensors"
+        await push.save_to(dest)
+        samples = 1.0
+        if isinstance(push.resource, dict):
+            try:
+                samples = float(push.resource.get("num_samples", 1.0))
+            except (TypeError, ValueError):
+                samples = 1.0
+            if not np.isfinite(samples) or samples <= 0:
+                samples = 1.0
+        return dest, samples
+
+    async def _serve_joins(
+        self, st: _ElasticState, cfg, round_num: int, work_dir: Path
+    ) -> None:
+        """Push the cumulative-update catch-up to newly joined peers."""
+        for peer in [p for p, n in st.pending_joins.items() if n > 0]:
+            path = st.catchup.write(work_dir / "catchup.safetensors")
+            header = {
+                "resource": cfg.results.ref.resource or "results",
+                "name": f"catchup-{round_num}.safetensors",
+                "round": round_num,
+                "epoch": st.membership.epoch,
+                CATCHUP_KEY: True,
+            }
+            try:
+                await self.node.push(peer, header, path)
+            except RequestError as e:
+                st.pending_joins[peer] -= 1
+                if st.pending_joins[peer] <= 0:
+                    log.error("ps: catch-up to %s failed for good: %s", peer, e)
+                    del st.pending_joins[peer]
+                continue
+            del st.pending_joins[peer]
+            log.info(
+                "ps: served catch-up (%d rounds, next %d) to rejoiner %s",
+                st.catchup.rounds, round_num, peer,
+            )
 
     def _outer_step(
         self,
@@ -274,9 +493,16 @@ class ParameterServerExecutor(JobExecutor):
         shutil.copyfile(momentum_file, tmp)
         os.replace(tmp, ckpt_dir / "momentum.safetensors")
 
-    async def _broadcast(self, cfg, update_path: Path, round_num: int) -> None:
+    async def _broadcast(
+        self, cfg, update_path: Path, round_num: int, elastic: "_ElasticState | None" = None
+    ) -> None:
         """Push the update tensor to every worker (:232-269). Send failures
-        are tolerated — the worker can catch up next round (:265-268)."""
+        are tolerated — the worker can catch up next round (:265-268).
+
+        Elastic mode broadcasts to the current membership's active set
+        (rejoiners included, departed peers skipped) and stamps the
+        membership epoch into the header so every worker knows which view
+        of the round produced this update."""
         peers = cfg.results.ref.peers or []
         strategy = cfg.results.ref.strategy or TransferStrategy.ALL
         header = {
@@ -284,6 +510,9 @@ class ParameterServerExecutor(JobExecutor):
             "name": update_path.name,
             "round": round_num,
         }
+        if elastic is not None:
+            peers = list(elastic.membership.active)
+            header["epoch"] = elastic.membership.epoch
         for peer in peers:
             try:
                 await self.node.push(peer, header, update_path)

@@ -62,6 +62,12 @@ class InProcessTrainExecutor(JobExecutor):
             # Cooperative: the training thread polls the flag between
             # batches. Cancelling the awaiting task alone would leave the
             # thread computing while the work dir is deleted under it.
+            if runner.done():
+                # Double cancel (a chaos-killed node stopped again at
+                # teardown): awaiting a shield over an already-cancelled
+                # task would raise CancelledError out of stop().
+                execution.finish("cancelled")
+                return
             stop_flag.set()
             try:
                 await asyncio.wait_for(asyncio.shield(runner), timeout=5.0)

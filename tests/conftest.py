@@ -52,3 +52,8 @@ def pytest_configure(config):
     config.addinivalue_line(
         "markers", "slow: multi-node end-to-end tests (tens of seconds)"
     )
+    config.addinivalue_line(
+        "markers",
+        "fault: chaos/fault-injection tests (hypha_tpu.ft) — filter with "
+        "-m fault / -m 'not fault'",
+    )

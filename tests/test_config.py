@@ -3,7 +3,10 @@ documented TOML emit (reference: crates/config test coverage, SURVEY.md §4)."""
 
 from __future__ import annotations
 
-import tomllib
+try:
+    import tomllib
+except ImportError:  # Python < 3.11
+    import tomli as tomllib
 
 import pytest
 

@@ -326,6 +326,134 @@ def test_elastic_retry_after_worker_death(tmp_path):
 
 
 @pytest.mark.slow
+@pytest.mark.fault
+def test_elastic_quorum_round_and_rejoin(tmp_path):
+    """The elastic-membership acceptance scenario (hypha_tpu.ft): 4 train
+    workers, one killed mid-round by the chaos controller. The affected
+    round must aggregate at quorum (3 of 4) after the PS round deadline,
+    the membership epoch must advance, and a restarted worker must rejoin
+    via the catch-up protocol — all WITHOUT a full-job restart
+    (max_attempts=1: any restart would fail the run)."""
+    import dataclasses
+
+    from hypha_tpu.ft import ChaosAction, ChaosController, FTConfig
+    from hypha_tpu.telemetry.ft_metrics import FT_METRICS
+
+    async def main():
+        FT_METRICS.reset()
+        hub = MemoryTransport()
+        gw = Gateway(hub.shared(), peer_id="gw")
+        await gw.start()
+        boot = [gw.node.listen_addrs[0]]
+        data = DataNode(
+            hub.shared(), {"toy": make_dataset(tmp_path)}, peer_id="data",
+            bootstrap=boot,
+        )
+        await data.start()
+
+        def mk_worker(name):
+            return WorkerNode(
+                hub.shared(),
+                resources=Resources(tpu=2.0, cpu=8, memory=1000),
+                peer_id=name,
+                offer=OfferConfig(price=1.0, strategy="whole"),
+                bootstrap=boot,
+                work_root=tmp_path / name,
+            )
+
+        workers = {n: mk_worker(n) for n in ("w0", "w1", "w2", "w3")}
+        for w in workers.values():
+            await w.start()
+        psw = WorkerNode(
+            hub.shared(), resources=Resources(cpu=2, memory=200), peer_id="psw",
+            bootstrap=boot, work_root=tmp_path / "psw",
+        )
+        await psw.start()
+        sched = Node(hub.shared(), peer_id="sched", bootstrap=boot)
+        await sched.start()
+        await sched.wait_for_bootstrap()
+
+        # Kill w3 while round 1 runs (after round 0's metrics land).
+        chaos = ChaosController(
+            [ChaosAction(kind="kill", target="w3", at_round=1)], workers
+        )
+        tracked = []
+
+        def on_metric(w, r, n, v):
+            # CallbackConnector fans out one call per metric NAME; the round
+            # number is all the chaos schedule needs.
+            chaos.on_round_metrics(r)
+            tracked.append((w, r, n, v))
+
+        orch = Orchestrator(sched, metrics_connector=CallbackConnector(on_metric))
+        job = diloco_job(rounds=4)
+        job = dataclasses.replace(
+            job,
+            resources=dataclasses.replace(job.resources, num_workers=4),
+            rounds=DiLoCoRounds(
+                update_rounds=4, avg_samples_between_updates=24, max_batch_size=4
+            ),
+            ft=FTConfig(
+                quorum_fraction=0.75,
+                round_deadline_s=6.0,
+                rejoin_attempts=8,
+                rejoin_backoff_s=1.0,
+            ),
+        )
+
+        # The restarted worker comes up while the dead one's round is
+        # degrading; the orchestrator's rejoin auction must find it.
+        replacement = mk_worker("w3b")
+
+        async def restarter():
+            # Start the replacement the moment the kill FIRES — a fresh
+            # machine comes up independently of the dead one's teardown
+            # (w3's graceful stop can take a minute abandoning its thread).
+            while not chaos.fired:
+                await asyncio.sleep(0.05)
+            await replacement.start(["mem:replacement-w3b"])
+
+        restart_task = asyncio.create_task(restarter())
+        try:
+            result = await orch.run(
+                job, auction_timeout=1.5, status_timeout=90.0, max_attempts=1
+            )
+            await restart_task
+        finally:
+            restart_task.cancel()
+            for w in list(workers.values()) + [psw, replacement]:
+                try:
+                    await w.stop()
+                except (Exception, asyncio.CancelledError):
+                    pass  # w3 was chaos-killed; a second stop may trip
+            await data.stop()
+            await sched.stop()
+            await gw.stop()
+        return result, tracked
+
+    result, tracked = run(main(), timeout=240)
+    # All rounds completed on the FIRST attempt: no full-job restart.
+    assert result.rounds == 4
+    assert result.attempt == 0
+    # Membership: w3 departed, w3b rejoined, epoch advanced.
+    assert result.ft is not None
+    assert "w3" in result.ft["departed"]
+    assert "w3b" in result.ft["active"]
+    assert result.ft["epoch"] >= 2  # depart + join at minimum
+    assert result.ft["rejoins"] == 1
+    snap = FT_METRICS.snapshot()
+    # The kill degraded at least one round (3-of-4 quorum aggregation) and
+    # the rejoin latency was measured.
+    assert snap["degraded_rounds"] >= 1
+    assert snap["rejoins"] == 1
+    assert snap["rejoin_latency_ms_count"] == 1
+    # The rejoiner actually trained: its loss metrics flowed for later rounds.
+    rejoiner_rounds = {r for (w, r, n, v) in tracked if w == "w3b" and n == "loss"}
+    assert rejoiner_rounds, "rejoined worker never reported metrics"
+    assert max(rejoiner_rounds) >= 2
+
+
+@pytest.mark.slow
 def test_full_diloco_job_heads_family(tmp_path):
     """A heads-family task (time-series forecasting, MSE) runs the SAME
     DiLoCo path end to end: auction, dispatch, inner loop with explicit
