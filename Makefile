@@ -1,0 +1,27 @@
+# Developer entry points. `make lint` is what CI runs; see
+# docs/development.md for the lint rules and suppression syntax.
+
+PYTHON ?= python
+
+.PHONY: lint lint-fixtures test
+
+lint:
+	$(PYTHON) -m hypha_tpu.analysis hypha_tpu/
+	@if command -v ruff >/dev/null 2>&1; then \
+		ruff check hypha_tpu/ tests/ --exclude tests/fixtures; \
+	else \
+		echo "ruff not installed; skipping (hypha-lint ran above)"; \
+	fi
+
+# The seeded-violation fixtures must FAIL the linter — run as a sanity
+# check that the rules still fire (tests/test_lint.py asserts per-rule).
+lint-fixtures:
+	@if $(PYTHON) -m hypha_tpu.analysis --no-proto tests/fixtures/lint/async_bad.py >/dev/null; then \
+		echo "ERROR: fixtures passed the linter"; exit 1; \
+	else \
+		echo "fixtures correctly rejected"; \
+	fi
+
+test:
+	JAX_PLATFORMS=cpu $(PYTHON) -m pytest tests/ -q -m 'not slow' \
+		--continue-on-collection-errors -p no:cacheprovider

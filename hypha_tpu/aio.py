@@ -1,0 +1,109 @@
+"""Asyncio task lifecycle helpers: the blessed shapes hypha-lint checks for.
+
+Three recurring needs across the scheduler / worker / network layers, each
+previously hand-rolled slightly differently (and slightly wrong) at every
+site:
+
+  * :func:`spawn` — create a background task that can NEVER become an
+    exception black hole: the handle is retained (optionally in a caller
+    set) and a done-callback logs any failure and bumps
+    :data:`TASK_FAILURES`, so a dead heartbeat pump or membership push
+    surfaces in telemetry the moment it dies instead of at GC time;
+  * :func:`reap` — cancel-and-await teardown that absorbs the reaped
+    tasks' outcomes (including their ``CancelledError``) while still
+    propagating cancellation *of the caller* — the subtlety every
+    ``except (CancelledError, Exception): pass`` site got wrong;
+  * :func:`wait_quiet` — await something whose outcome you don't care
+    about, bounded by an optional timeout, again without eating the
+    caller's own cancellation.
+
+``asyncio.gather(..., return_exceptions=True)`` is the primitive that makes
+the cancellation semantics right: child outcomes become return values, but
+cancellation delivered to the *waiter* still raises through the await.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+from typing import Any, Awaitable, Coroutine, MutableSet
+
+from .telemetry import Counter
+
+__all__ = ["TASK_FAILURES", "spawn", "reap", "wait_quiet"]
+
+log = logging.getLogger("hypha.aio")
+
+# Background tasks that died with an unexpected exception (exported as an
+# observable gauge wherever a Meter is wired up; tests read .value()).
+TASK_FAILURES = Counter("hypha.aio.task_failures")
+
+
+def spawn(
+    coro: Coroutine[Any, Any, Any],
+    *,
+    name: str | None = None,
+    tasks: MutableSet[asyncio.Task] | None = None,
+    what: str = "",
+    logger: logging.Logger | None = None,
+) -> asyncio.Task:
+    """``create_task`` with mandatory exception surfacing.
+
+    ``tasks`` (usually the owner's ``self._tasks`` set) keeps a strong
+    reference until completion; the done-callback logs non-cancellation
+    failures and counts them in :data:`TASK_FAILURES`.
+    """
+    task = asyncio.create_task(coro, name=name or what or None)
+    if tasks is not None:
+        tasks.add(task)
+        task.add_done_callback(tasks.discard)
+    label = what or name or getattr(coro, "__qualname__", "task")
+    lg = logger or log
+
+    def _surface(t: asyncio.Task) -> None:
+        if t.cancelled():
+            return
+        exc = t.exception()
+        if exc is not None:
+            TASK_FAILURES.add(1)
+            lg.error("background task %r failed: %r", label, exc)
+
+    task.add_done_callback(_surface)
+    return task
+
+
+async def reap(*tasks: asyncio.Task | None) -> None:
+    """Cancel the given tasks and await them to actual completion.
+
+    Outcomes (results, exceptions, their cancellation) are absorbed —
+    anything noteworthy was already logged by :func:`spawn`'s callback.
+    Cancellation of the *caller* propagates normally, so shutdown paths
+    built on ``reap`` stay cancellable.
+    """
+    live = [t for t in tasks if t is not None]
+    for t in live:
+        t.cancel()
+    if live:
+        await asyncio.gather(*live, return_exceptions=True)
+
+
+async def wait_quiet(
+    *aws: Awaitable[Any] | None, timeout: float | None = None
+) -> None:
+    """Await things whose failure/result is someone else's problem.
+
+    On timeout the awaitables are cancelled (``asyncio.wait_for``
+    semantics) and the timeout is swallowed; caller cancellation always
+    propagates.
+    """
+    live = [a for a in aws if a is not None]
+    if not live:
+        return
+    gathered = asyncio.gather(*live, return_exceptions=True)
+    if timeout is None:
+        await gathered
+        return
+    try:
+        await asyncio.wait_for(gathered, timeout)
+    except asyncio.TimeoutError:
+        pass

@@ -1,0 +1,37 @@
+"""hypha-lint: AST + runtime invariant checker for this codebase.
+
+Three rule families, each mechanizing a class of bug the project has
+already paid for once (see docs/development.md for rule-by-rule rationale
+and the suppression syntax):
+
+  * async hygiene   — blocking calls in coroutines, fire-and-forget tasks,
+    swallowed cancellation, network round-trips under locks;
+  * JAX discipline  — host syncs and Python side effects inside jitted
+    functions, donated-buffer reuse;
+  * protocol schema — every wire message round-trips, carries its FT
+    round/epoch tags, and is claimed by a stream protocol.
+
+Run it as ``python -m hypha_tpu.analysis hypha_tpu/`` (CI and ``make
+lint`` do), or from tests via :func:`lint_paths` / :func:`lint_source`.
+Inline waivers — ``# hypha-lint: disable=<rule>`` on the flagged line —
+are counted against a repo-wide budget (default
+:data:`DEFAULT_SUPPRESSION_BUDGET`) so they stay exceptional.
+"""
+
+from .core import (
+    DEFAULT_SUPPRESSION_BUDGET,
+    RULES,
+    LintReport,
+    Violation,
+    lint_paths,
+    lint_source,
+)
+
+__all__ = [
+    "DEFAULT_SUPPRESSION_BUDGET",
+    "RULES",
+    "LintReport",
+    "Violation",
+    "lint_paths",
+    "lint_source",
+]

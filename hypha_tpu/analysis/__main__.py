@@ -1,0 +1,84 @@
+"""CLI: ``python -m hypha_tpu.analysis [paths...]``.
+
+Exit status 0 only when there are zero unsuppressed violations, zero parse
+errors, AND the inline-suppression count is within budget — CI treats a
+creeping waiver pile the same as a regression.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+from .core import DEFAULT_SUPPRESSION_BUDGET, RULES, lint_paths
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m hypha_tpu.analysis",
+        description="hypha-lint: asyncio / JAX / protocol invariant checker",
+    )
+    parser.add_argument(
+        "paths", nargs="*", default=["hypha_tpu"], help="files or directories"
+    )
+    parser.add_argument(
+        "--rule",
+        action="append",
+        dest="rules",
+        metavar="RULE",
+        help="run only this rule (repeatable)",
+    )
+    parser.add_argument(
+        "--list-rules", action="store_true", help="print rule ids and exit"
+    )
+    parser.add_argument(
+        "--no-proto",
+        action="store_true",
+        help="skip the runtime protocol-schema checks",
+    )
+    parser.add_argument(
+        "--budget",
+        type=int,
+        default=DEFAULT_SUPPRESSION_BUDGET,
+        help="max inline suppressions allowed repo-wide (default %(default)s)",
+    )
+    args = parser.parse_args(argv)
+
+    if args.list_rules:
+        width = max(len(r) for r in RULES)
+        for rule, desc in RULES.items():
+            print(f"{rule:<{width}}  {desc}")
+        return 0
+
+    rules = set(args.rules) if args.rules else None
+    if rules:
+        unknown = rules - set(RULES)
+        if unknown:
+            print(f"unknown rule(s): {', '.join(sorted(unknown))}", file=sys.stderr)
+            return 2
+
+    report = lint_paths(
+        args.paths, rules=rules, protocol_checks=not args.no_proto
+    )
+
+    for err in report.parse_errors:
+        print(f"PARSE ERROR: {err}")
+    for v in report.violations:
+        print(v.render())
+
+    n_active = len(report.active)
+    n_supp = len(report.suppression_sites)
+    print(
+        f"hypha-lint: {n_active} violation(s), "
+        f"{n_supp}/{args.budget} suppression(s) used"
+    )
+    if n_supp > args.budget:
+        print(
+            f"hypha-lint: suppression budget exceeded "
+            f"({n_supp} > {args.budget}) — fix violations instead of waiving them"
+        )
+    return 0 if report.ok(budget=args.budget) else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

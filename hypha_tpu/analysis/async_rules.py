@@ -1,0 +1,239 @@
+"""Async hygiene rules.
+
+Every rule here encodes a failure class PR 1's chaos tests hit the hard
+way (see docs/development.md for the incident-by-incident rationale):
+
+  * ``async-blocking-call``  — a blocking call on the event loop starves
+    heartbeats and lease renewals, which the φ-accrual detector then reads
+    as worker death;
+  * ``task-black-hole``      — a dropped ``create_task`` handle means the
+    task's exception is only reported at garbage collection, if ever;
+  * ``swallowed-cancel``     — a handler that eats ``CancelledError``
+    breaks cooperative shutdown: ``stop()`` hangs until the RPC timeout;
+  * ``lock-held-await``      — a network round-trip awaited under an
+    ``asyncio.Lock`` serializes the control plane on its slowest peer and
+    deadlocks if the peer's reply needs the same lock.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from .core import FileSource, Violation, dotted_name
+
+__all__ = ["check", "BLOCKING_CALLS", "ROUND_TRIP_ATTRS"]
+
+# Dotted call targets that block the event loop.  Sync file IO is caught via
+# the builtin ``open`` (reads and writes both seek/stat/transfer on the
+# calling thread); sockets via the connect/request entry points.
+BLOCKING_CALLS: frozenset[str] = frozenset(
+    {
+        "time.sleep",
+        "subprocess.run",
+        "subprocess.call",
+        "subprocess.check_call",
+        "subprocess.check_output",
+        "subprocess.Popen",
+        "os.system",
+        "os.popen",
+        "socket.create_connection",
+        "urllib.request.urlopen",
+        "requests.get",
+        "requests.post",
+        "requests.put",
+        "requests.request",
+        "shutil.rmtree",
+        "shutil.copytree",
+        "open",
+    }
+)
+
+# Attribute names whose *await* under a held lock we treat as a network
+# round-trip.  Deliberately excludes raw ``write``/``send``: muxers hold a
+# write lock precisely to serialize frame writes, and a single frame write
+# into a buffered transport is bounded work.  A full request/response (or a
+# gossip publish, which waits on every mesh peer) is not.
+ROUND_TRIP_ATTRS: frozenset[str] = frozenset(
+    {"request", "publish", "broadcast", "respond", "gossip", "provide"}
+)
+
+_CANCEL_NAMES = {"CancelledError", "BaseException"}
+
+
+_dotted = dotted_name
+
+
+def _catches_cancellation(handler: ast.ExceptHandler) -> str | None:
+    """Why this handler swallows cancellation, or None if it can't."""
+    t = handler.type
+    if t is None:
+        return "bare except"
+    exprs = t.elts if isinstance(t, ast.Tuple) else [t]
+    for e in exprs:
+        name = _dotted(e)
+        if name is None:
+            continue
+        short = name.rsplit(".", 1)[-1]
+        if short in _CANCEL_NAMES:
+            return f"except {name}"
+    return None
+
+
+def _has_raise(body: list[ast.stmt]) -> bool:
+    """Any ``raise`` in the handler body, not counting nested functions."""
+    todo: list[ast.AST] = list(body)
+    while todo:
+        node = todo.pop()
+        if isinstance(node, ast.Raise):
+            return True
+        if isinstance(
+            node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+        ):
+            continue
+        todo.extend(ast.iter_child_nodes(node))
+    return False
+
+
+def _assigns_exception(handler: ast.ExceptHandler) -> bool:
+    """Handler stores the caught exception object somewhere (the
+    thread-bridge pattern: the exception is re-raised on another thread).
+    Still reported — but with a message pointing at the suppression syntax,
+    since a deliberate bridge is the one legitimate shape."""
+    if handler.name is None:
+        return False
+    for node in ast.walk(handler):
+        if isinstance(node, ast.Assign):
+            for sub in ast.walk(node):
+                if isinstance(sub, ast.Name) and sub.id == handler.name:
+                    if isinstance(sub.ctx, ast.Load):
+                        return True
+    return False
+
+
+class _AsyncVisitor(ast.NodeVisitor):
+    def __init__(self, src: FileSource) -> None:
+        self.src = src
+        self.violations: list[Violation] = []
+        self._func_stack: list[bool] = []  # True = async frame
+        self._lock_depth = 0
+
+    # ------------------------------------------------------------- scoping
+
+    @property
+    def _in_async(self) -> bool:
+        return bool(self._func_stack) and self._func_stack[-1]
+
+    def _enter_func(self, node: ast.AST, is_async: bool) -> None:
+        # A nested function body runs later, not under any lock the
+        # enclosing frame currently holds.
+        held, self._lock_depth = self._lock_depth, 0
+        self._func_stack.append(is_async)
+        self.generic_visit(node)
+        self._func_stack.pop()
+        self._lock_depth = held
+
+    def visit_FunctionDef(self, node: ast.FunctionDef) -> None:
+        self._enter_func(node, False)
+
+    def visit_AsyncFunctionDef(self, node: ast.AsyncFunctionDef) -> None:
+        self._enter_func(node, True)
+
+    def visit_Lambda(self, node: ast.Lambda) -> None:
+        self._enter_func(node, False)
+
+    # ------------------------------------------------- async-blocking-call
+
+    def visit_Call(self, node: ast.Call) -> None:
+        if self._in_async:
+            name = _dotted(node.func)
+            if name in BLOCKING_CALLS:
+                self.violations.append(
+                    self.src.violation(
+                        "async-blocking-call",
+                        node,
+                        f"{name}() blocks the event loop inside an async "
+                        f"function; use an async equivalent or "
+                        f"asyncio.to_thread",
+                    )
+                )
+        self.generic_visit(node)
+
+    # ---------------------------------------------------- task-black-hole
+
+    def visit_Expr(self, node: ast.Expr) -> None:
+        call = node.value
+        if isinstance(call, ast.Call):
+            name = _dotted(call.func)
+            short = name.rsplit(".", 1)[-1] if name else None
+            if short in ("create_task", "ensure_future"):
+                self.violations.append(
+                    self.src.violation(
+                        "task-black-hole",
+                        node,
+                        f"{name}(...) result discarded: retain the task and "
+                        f"attach a done-callback (hypha_tpu.aio.spawn) or "
+                        f"its exceptions vanish",
+                    )
+                )
+        self.generic_visit(node)
+
+    # ---------------------------------------------------- swallowed-cancel
+
+    def visit_ExceptHandler(self, node: ast.ExceptHandler) -> None:
+        why = _catches_cancellation(node)
+        if why is not None and not _has_raise(node.body):
+            hint = (
+                "; exception is captured for another thread — if deliberate, "
+                "suppress with '# hypha-lint: disable=swallowed-cancel'"
+                if _assigns_exception(node)
+                else "; re-raise CancelledError (or use hypha_tpu.aio.reap / "
+                "wait_quiet for task teardown)"
+            )
+            self.violations.append(
+                self.src.violation(
+                    "swallowed-cancel",
+                    node,
+                    f"{why} swallows cancellation{hint}",
+                )
+            )
+        self.generic_visit(node)
+
+    # ------------------------------------------------------ lock-held-await
+
+    def visit_AsyncWith(self, node: ast.AsyncWith) -> None:
+        lockish = any(
+            "lock" in (_dotted(item.context_expr) or "").lower()
+            or (
+                isinstance(item.context_expr, ast.Call)
+                and "lock" in (_dotted(item.context_expr.func) or "").lower()
+            )
+            for item in node.items
+        )
+        if lockish:
+            self._lock_depth += 1
+        # Body awaits are inspected by visit_Await via _lock_depth.
+        self.generic_visit(node)
+        if lockish:
+            self._lock_depth -= 1
+
+    def visit_Await(self, node: ast.Await) -> None:
+        if self._lock_depth > 0 and isinstance(node.value, ast.Call):
+            name = _dotted(node.value.func)
+            short = name.rsplit(".", 1)[-1] if name else None
+            if short in ROUND_TRIP_ATTRS:
+                self.violations.append(
+                    self.src.violation(
+                        "lock-held-await",
+                        node,
+                        f"await {name}(...) while holding an asyncio.Lock: "
+                        f"a slow peer stalls every waiter (and a reply that "
+                        f"needs the lock deadlocks)",
+                    )
+                )
+        self.generic_visit(node)
+
+
+def check(src: FileSource) -> list[Violation]:
+    visitor = _AsyncVisitor(src)
+    visitor.visit(src.tree)
+    return visitor.violations

@@ -1,0 +1,281 @@
+"""hypha-lint core: violations, suppressions, file walking, reporting.
+
+The checker is a plain AST walk plus a handful of runtime protocol checks —
+no third-party lint framework, so it runs anywhere the package imports and
+is cheap enough for tier-1. Rule implementations live in
+:mod:`.async_rules`, :mod:`.jax_rules` and :mod:`.proto_rules`; this module
+owns everything rule-independent:
+
+  * :class:`Violation` — one finding, with its rule id and source location;
+  * inline suppressions — ``# hypha-lint: disable=<rule>[,<rule>...]`` on
+    the flagged line (or ``disable=all``).  Suppressed findings are kept,
+    flagged ``suppressed=True``, and counted against the repo budget so a
+    creeping pile of waivers fails CI just like a violation would;
+  * :func:`lint_paths` — walk files/dirs, run every registered rule family,
+    return a :class:`LintReport`.
+"""
+
+from __future__ import annotations
+
+import ast
+import io
+import re
+import tokenize
+from dataclasses import dataclass, field
+from pathlib import Path
+
+__all__ = [
+    "RULES",
+    "Violation",
+    "LintReport",
+    "FileSource",
+    "lint_paths",
+    "lint_source",
+    "dotted_name",
+    "DEFAULT_SUPPRESSION_BUDGET",
+]
+
+# Rule id -> one-line description (the CLI's --list-rules and the docs both
+# render from this table; docs/development.md carries the full rationale).
+RULES: dict[str, str] = {
+    # -- async hygiene ------------------------------------------------------
+    "async-blocking-call": (
+        "blocking call (time.sleep / subprocess / sync IO) inside async def"
+    ),
+    "task-black-hole": (
+        "create_task result dropped: exceptions can never surface"
+    ),
+    "swallowed-cancel": (
+        "except catches CancelledError (bare / BaseException / explicit) "
+        "without re-raising"
+    ),
+    "lock-held-await": (
+        "network round-trip awaited while holding an asyncio.Lock"
+    ),
+    # -- JAX discipline -----------------------------------------------------
+    "jit-host-sync": (
+        "host sync (.item() / np.asarray / float() / device_get) on a "
+        "traced value inside a jitted function"
+    ),
+    "jit-side-effect": (
+        "Python side effect (print / logging) inside a jitted function"
+    ),
+    "donated-buffer-reuse": (
+        "argument donated to a jitted call is used again afterwards"
+    ),
+    # -- protocol schema ----------------------------------------------------
+    "msg-roundtrip": (
+        "registered wire message does not encode/decode round-trip"
+    ),
+    "msg-missing-round-tag": (
+        "FT-critical message lacks a round/epoch tag"
+    ),
+    "msg-unmapped-protocol": (
+        "registered wire message not claimed by any stream protocol"
+    ),
+    # -- meta ---------------------------------------------------------------
+    "unused-suppression": (
+        "inline disable comment that waives nothing — delete it, or it "
+        "silently swallows the next violation on that line"
+    ),
+}
+
+DEFAULT_SUPPRESSION_BUDGET = 10
+
+
+def dotted_name(node: ast.AST) -> str | None:
+    """Best-effort dotted name for an expression (``a.b.c`` / ``name``)."""
+    parts: list[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+_SUPPRESS_RE = re.compile(r"#\s*hypha-lint:\s*disable=([A-Za-z0-9_,\- ]+)")
+
+
+@dataclass(slots=True)
+class Violation:
+    rule: str
+    path: str
+    line: int
+    message: str
+    suppressed: bool = False
+
+    def render(self) -> str:
+        mark = " (suppressed)" if self.suppressed else ""
+        return f"{self.path}:{self.line}: [{self.rule}] {self.message}{mark}"
+
+
+@dataclass(slots=True)
+class LintReport:
+    violations: list[Violation] = field(default_factory=list)
+    parse_errors: list[str] = field(default_factory=list)
+    # "path:line" of every inline disable comment seen — the unit the
+    # budget is charged in (one comment may waive several findings).
+    suppression_sites: list[str] = field(default_factory=list)
+
+    @property
+    def active(self) -> list[Violation]:
+        return [v for v in self.violations if not v.suppressed]
+
+    @property
+    def suppressed(self) -> list[Violation]:
+        return [v for v in self.violations if v.suppressed]
+
+    def extend(self, other: "LintReport") -> None:
+        self.violations.extend(other.violations)
+        self.parse_errors.extend(other.parse_errors)
+        self.suppression_sites.extend(other.suppression_sites)
+
+    def ok(self, budget: int = DEFAULT_SUPPRESSION_BUDGET) -> bool:
+        return (
+            not self.active
+            and not self.parse_errors
+            and len(self.suppression_sites) <= budget
+        )
+
+
+class FileSource:
+    """One parsed source file plus its suppression map."""
+
+    def __init__(self, path: str, text: str) -> None:
+        self.path = path
+        self.text = text
+        self.tree = ast.parse(text, filename=path)
+        # line number -> set of rule ids disabled on that line ("all"
+        # wildcards).  Tokenized so a marker applies only in a real COMMENT
+        # — a string literal mentioning the syntax must not waive anything.
+        self.suppressions: dict[int, set[str]] = {}
+        try:
+            tokens = tokenize.generate_tokens(io.StringIO(text).readline)
+            for tok in tokens:
+                if tok.type != tokenize.COMMENT:
+                    continue
+                m = _SUPPRESS_RE.search(tok.string)
+                if m:
+                    rules = {
+                        r.strip() for r in m.group(1).split(",") if r.strip()
+                    }
+                    self.suppressions[tok.start[0]] = rules
+        except tokenize.TokenError:
+            pass  # the ast.parse above accepted it; no comments recovered
+
+    def suppressed_at(self, line: int, rule: str) -> bool:
+        rules = self.suppressions.get(line)
+        if not rules:
+            return False
+        return rule in rules or "all" in rules
+
+    def violation(self, rule: str, node: ast.AST, message: str) -> Violation:
+        line = getattr(node, "lineno", 0)
+        return Violation(
+            rule=rule,
+            path=self.path,
+            line=line,
+            message=message,
+            suppressed=self.suppressed_at(line, rule),
+        )
+
+
+def _iter_py_files(paths: list[str | Path], errors: list[str]) -> list[Path]:
+    files: list[Path] = []
+    for p in paths:
+        p = Path(p)
+        if p.is_dir():
+            files.extend(sorted(p.rglob("*.py")))
+        elif p.suffix == ".py" and p.is_file():
+            files.append(p)
+        else:
+            # A missing/misspelled path must FAIL, not lint zero files and
+            # report a false green.
+            errors.append(f"{p}: not a Python file or directory")
+    return files
+
+
+def lint_source(
+    path: str, text: str, rules: set[str] | None = None
+) -> LintReport:
+    """Run the AST rule families over one in-memory source (test entry)."""
+    from . import async_rules, jax_rules
+
+    report = LintReport()
+    try:
+        src = FileSource(path, text)
+    except (SyntaxError, ValueError) as e:  # ValueError: e.g. null bytes
+        report.parse_errors.append(f"{path}: {e}")
+        return report
+    found = async_rules.check(src) + jax_rules.check(src)
+    for v in found:
+        if rules is None or v.rule in rules:
+            report.violations.append(v)
+    # Suppression bookkeeping: every disable comment is a budget site, and
+    # one that waived nothing is itself a violation (a stale marker would
+    # otherwise silently swallow the next finding on its line).  Waived
+    # lines come from the UNFILTERED findings, so a --rule subset can't
+    # misread a legitimately-used marker as stale.
+    waived_lines = {v.line for v in found if v.suppressed}
+    for lineno in sorted(src.suppressions):
+        report.suppression_sites.append(f"{path}:{lineno}")
+        named = src.suppressions[lineno]
+        if named and all(r.startswith("msg-") for r in named):
+            # Protocol-family waivers are consumed by the runtime checks,
+            # which this per-file pass can't see; only the budget counts.
+            continue
+        if lineno not in waived_lines and (
+            rules is None or "unused-suppression" in rules
+        ):
+            report.violations.append(
+                Violation(
+                    rule="unused-suppression",
+                    path=path,
+                    line=lineno,
+                    message=(
+                        "disable comment waives no violation on this line; "
+                        "delete it"
+                    ),
+                )
+            )
+    return report
+
+
+def lint_paths(
+    paths: list[str | Path],
+    *,
+    rules: set[str] | None = None,
+    protocol_checks: bool = True,
+) -> LintReport:
+    """Lint files/directories; optionally run the runtime protocol checks.
+
+    ``rules`` filters to a subset of rule ids (None = all).  The protocol
+    family needs the package importable (it inspects the live message
+    registry), so callers linting arbitrary snippets can switch it off.
+    """
+    report = LintReport()
+    for f in _iter_py_files(paths, report.parse_errors):
+        try:
+            # tokenize.open honors PEP 263 coding cookies; a file the
+            # decoder rejects must surface as a parse error, not a crash
+            # that silently drops every file after it.
+            with tokenize.open(f) as fh:
+                text = fh.read()
+        except (OSError, UnicodeDecodeError, SyntaxError) as e:
+            report.parse_errors.append(f"{f}: {e}")
+            continue
+        report.extend(lint_source(str(f), text, rules))
+    # The runtime protocol family imports the live message registry; skip
+    # it entirely when a --rule filter selects no msg-* rule, so AST-only
+    # runs work in minimal environments and don't pay the import.
+    if protocol_checks and (
+        rules is None or any(r.startswith("msg-") for r in rules)
+    ):
+        from . import proto_rules
+
+        for v in proto_rules.check():
+            if rules is None or v.rule in rules:
+                report.violations.append(v)
+    return report

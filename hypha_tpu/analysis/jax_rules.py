@@ -1,0 +1,263 @@
+"""JAX discipline rules.
+
+DiLoCo's inner loop lives or dies on dispatch overlap: one hidden host
+sync per step (an ``.item()`` on a traced loss, an ``np.asarray`` on a
+device buffer) serializes the TPU against Python and shows up directly as
+lost MFU.  Side effects inside a jit body are worse — they run once at
+trace time and then silently never again.  Donated-buffer reuse is a
+correctness bug: after ``jax.jit(f, donate_argnums=(0,))(x)`` the buffer
+behind ``x`` is deleted, and touching it raises (or, under some backends,
+reads freed memory).
+
+Rules:
+
+  * ``jit-host-sync``       — ``.item()`` / ``np.asarray`` / ``float()`` /
+    ``jax.device_get`` / ``.block_until_ready()`` inside a jitted function;
+  * ``jit-side-effect``     — ``print`` / ``logging`` calls inside a jitted
+    function (``jax.debug.print`` is the traced alternative);
+  * ``donated-buffer-reuse``— a local name passed in a donated position of
+    a jitted call and loaded again before reassignment.
+
+Jitted functions are recognized through decorators (``@jax.jit``, ``@jit``,
+``@partial(jax.jit, ...)``) and through wrapper assignments
+(``step = jax.jit(fn, donate_argnums=(0,))``) within the same module.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from .core import FileSource, Violation, dotted_name
+
+__all__ = ["check"]
+
+_HOST_SYNC_CALLS = frozenset(
+    {
+        "np.asarray",
+        "np.array",
+        "numpy.asarray",
+        "numpy.array",
+        "jax.device_get",
+        "jax.block_until_ready",
+    }
+)
+_HOST_SYNC_METHODS = frozenset({"item", "block_until_ready", "tolist"})
+_HOST_CASTS = frozenset({"float", "int", "bool"})
+_LOGGERS = frozenset({"log", "logger", "logging"})
+_LOG_METHODS = frozenset(
+    {"debug", "info", "warning", "error", "exception", "critical"}
+)
+
+
+_dotted = dotted_name
+
+
+def _is_jit_expr(node: ast.expr) -> bool:
+    """Does this decorator / callee expression denote jax.jit?"""
+    name = _dotted(node)
+    if name in ("jit", "jax.jit"):
+        return True
+    if isinstance(node, ast.Call):
+        fname = _dotted(node.func)
+        if fname in ("jit", "jax.jit"):
+            return True
+        # functools.partial(jax.jit, ...) decorator form
+        if fname in ("partial", "functools.partial") and node.args:
+            return _is_jit_expr(node.args[0])
+    return False
+
+
+def _donated_positions(call: ast.Call) -> list[int]:
+    """donate_argnums=(...) positions from a jax.jit(...) call, if static."""
+    for kw in call.keywords:
+        if kw.arg == "donate_argnums":
+            v = kw.value
+            if isinstance(v, ast.Constant) and isinstance(v.value, int):
+                return [v.value]
+            if isinstance(v, (ast.Tuple, ast.List)):
+                out = []
+                for e in v.elts:
+                    if isinstance(e, ast.Constant) and isinstance(e.value, int):
+                        out.append(e.value)
+                return out
+    return []
+
+
+class _JitBodyVisitor(ast.NodeVisitor):
+    """Flags host syncs / side effects inside one jitted function body."""
+
+    def __init__(self, src: FileSource, fn_name: str) -> None:
+        self.src = src
+        self.fn_name = fn_name
+        self.violations: list[Violation] = []
+
+    def visit_Call(self, node: ast.Call) -> None:
+        name = _dotted(node.func)
+        short = name.rsplit(".", 1)[-1] if name else None
+        if name in _HOST_SYNC_CALLS:
+            self._flag_sync(node, f"{name}()")
+        elif (
+            isinstance(node.func, ast.Attribute)
+            and node.func.attr in _HOST_SYNC_METHODS
+            and not node.args
+        ):
+            self._flag_sync(node, f".{node.func.attr}()")
+        elif (
+            name in _HOST_CASTS
+            and len(node.args) == 1
+            and not isinstance(node.args[0], ast.Constant)
+        ):
+            self._flag_sync(node, f"{name}(...) on a non-literal")
+        elif name == "print":
+            self._flag_effect(node, "print()")
+        elif (
+            isinstance(node.func, ast.Attribute)
+            and node.func.attr in _LOG_METHODS
+            and isinstance(node.func.value, ast.Name)
+            and node.func.value.id in _LOGGERS
+        ):
+            self._flag_effect(node, f"{name}()")
+        self.generic_visit(node)
+
+    def _flag_sync(self, node: ast.AST, what: str) -> None:
+        self.violations.append(
+            self.src.violation(
+                "jit-host-sync",
+                node,
+                f"{what} inside jitted `{self.fn_name}` forces a host sync "
+                f"per call (or traces to a constant); keep values on device",
+            )
+        )
+
+    def _flag_effect(self, node: ast.AST, what: str) -> None:
+        self.violations.append(
+            self.src.violation(
+                "jit-side-effect",
+                node,
+                f"{what} inside jitted `{self.fn_name}` runs once at trace "
+                f"time, then never again; use jax.debug.print or hoist it",
+            )
+        )
+
+
+def _collect_jitted(src: FileSource):
+    """(jitted function defs, donating wrapper names -> donated positions).
+
+    Wrapper names cover ``name = jax.jit(fn, donate_argnums=...)`` — the
+    function def referenced by ``fn`` in the same scope is also marked
+    jitted.  Decorator donation (``@partial(jax.jit, donate_argnums=...)``)
+    maps the def's own name to its donated positions.
+    """
+    jitted: list[ast.AST] = []
+    donors: dict[str, list[int]] = {}
+    by_name: dict[str, ast.AST] = {}
+    for node in ast.walk(src.tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            by_name.setdefault(node.name, node)
+            for dec in node.decorator_list:
+                if _is_jit_expr(dec):
+                    jitted.append(node)
+                    if isinstance(dec, ast.Call):
+                        pos = _donated_positions(dec)
+                        if not pos and dec.args and isinstance(dec.args[0], ast.Call):
+                            pos = _donated_positions(dec.args[0])
+                        # partial(jax.jit, donate_argnums=...) keeps kwargs
+                        # on the partial call itself.
+                        if pos:
+                            donors[node.name] = pos
+    for node in ast.walk(src.tree):
+        if isinstance(node, ast.Assign) and isinstance(node.value, ast.Call):
+            call = node.value
+            if _dotted(call.func) in ("jit", "jax.jit"):
+                pos = _donated_positions(call)
+                for tgt in node.targets:
+                    if isinstance(tgt, ast.Name) and pos:
+                        donors[tgt.id] = pos
+                if call.args and isinstance(call.args[0], ast.Name):
+                    inner = by_name.get(call.args[0].id)
+                    if inner is not None and inner not in jitted:
+                        jitted.append(inner)
+        # return jax.jit(step, donate_argnums=...) — mark the inner def
+        elif isinstance(node, ast.Return) and isinstance(node.value, ast.Call):
+            call = node.value
+            if _dotted(call.func) in ("jit", "jax.jit"):
+                if call.args and isinstance(call.args[0], ast.Name):
+                    inner = by_name.get(call.args[0].id)
+                    if inner is not None and inner not in jitted:
+                        jitted.append(inner)
+    return jitted, donors
+
+
+def _names_loaded(node: ast.AST) -> set[str]:
+    return {
+        n.id
+        for n in ast.walk(node)
+        if isinstance(n, ast.Name) and isinstance(n.ctx, ast.Load)
+    }
+
+
+def _names_stored(node: ast.AST) -> set[str]:
+    return {
+        n.id
+        for n in ast.walk(node)
+        if isinstance(n, ast.Name) and isinstance(n.ctx, ast.Store)
+    }
+
+
+def _check_donation(
+    src: FileSource, fn: ast.AST, donors: dict[str, list[int]]
+) -> list[Violation]:
+    """Linear scan of one function body for use-after-donate.
+
+    Statement-ordered and intentionally simple: a donated name is 'live
+    dead' from the donating statement until a statement stores to it.
+    Loads inside the donating statement itself are fine (the call consumes
+    the buffer), later loads are flagged.
+    """
+    out: list[Violation] = []
+    body = getattr(fn, "body", [])
+    dead: dict[str, int] = {}  # name -> line it was donated on
+    for stmt in body:
+        loaded = _names_loaded(stmt)
+        for name in sorted(loaded & set(dead)):
+            out.append(
+                src.violation(
+                    "donated-buffer-reuse",
+                    stmt,
+                    f"`{name}` was donated to a jitted call on line "
+                    f"{dead[name]}; its buffer is deleted — rebind the "
+                    f"result or drop donation",
+                )
+            )
+            del dead[name]  # one report per donation
+        # Record new donations from this statement.
+        for call in ast.walk(stmt):
+            if not isinstance(call, ast.Call):
+                continue
+            callee = _dotted(call.func)
+            short = callee.rsplit(".", 1)[-1] if callee else None
+            if short in donors:
+                for pos in donors[short]:
+                    if pos < len(call.args) and isinstance(
+                        call.args[pos], ast.Name
+                    ):
+                        dead[call.args[pos].id] = call.lineno
+        # Stores resurrect the name (fresh binding).
+        for name in _names_stored(stmt):
+            dead.pop(name, None)
+    return out
+
+
+def check(src: FileSource) -> list[Violation]:
+    violations: list[Violation] = []
+    jitted, donors = _collect_jitted(src)
+    for fn in jitted:
+        v = _JitBodyVisitor(src, getattr(fn, "name", "<fn>"))
+        for stmt in getattr(fn, "body", []):
+            v.visit(stmt)
+        violations.extend(v.violations)
+    if donors:
+        for node in ast.walk(src.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                violations.extend(_check_donation(src, node, donors))
+    return violations

@@ -1,0 +1,341 @@
+"""Protocol schema consistency rules (runtime, not AST).
+
+These run against the *live* message registry — importing
+:mod:`hypha_tpu.messages` and :mod:`hypha_tpu.ft.membership` — because the
+invariants are about behavior (does ``decode(encode(x)) == x``?) that a
+syntactic check can't establish:
+
+  * ``msg-roundtrip``         — every registered dataclass must survive
+    encode→decode→equality with a synthesized sample instance.  PR 1's
+    stale-round-tag bug was exactly a message whose wire form silently
+    dropped a field;
+  * ``msg-missing-round-tag`` — messages the FT layer epoch-gates
+    (:data:`REQUIRES_ROUND_TAG`) must carry a ``round``/``epoch`` field
+    (directly or via an embedded ``RoundMembership``), or the parameter
+    server cannot reject stale deltas and catch-up pushes;
+  * ``msg-unmapped-protocol`` — every registered message must be claimed by
+    a protocol in ``messages.PROTOCOL_MESSAGES`` or as nested value
+    vocabulary, so a new message can't ship without an owning stream.
+
+All three support the standard inline suppression, placed anywhere in the
+class's decorator block or on its ``class`` line in its defining module.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+import inspect
+
+from .core import Violation
+
+__all__ = ["check", "sample_instance", "REQUIRES_ROUND_TAG"]
+
+# Messages the FT layer requires a round/epoch tag on (see
+# docs/fault_tolerance.md: stale-delta rejection and catch-up push both key
+# on these tags).
+REQUIRES_ROUND_TAG: frozenset[str] = frozenset(
+    {"ParameterPush", "Progress", "RoundMembership", "MembershipUpdate"}
+)
+_TAG_FIELDS = {"round", "epoch", "round_num"}
+
+
+def _modules():
+    from hypha_tpu import messages
+    from hypha_tpu.ft import membership  # extends the manifest at import
+    from hypha_tpu.scheduler import job_config  # noqa: F401  (ditto)
+
+    return messages, membership
+
+
+def _package_registry(messages) -> dict[str, type]:
+    """The registry restricted to classes defined inside hypha_tpu.
+
+    Tests (and interactive sessions) may register ad-hoc classes; the
+    package-invariant checks must not depend on what happened to be
+    imported first.
+    """
+    return {
+        name: cls
+        for name, cls in messages.wire_registry().items()
+        if getattr(cls, "__module__", "").startswith("hypha_tpu")
+    }
+
+
+def sample_instance(cls, registry=None, enums=None, _depth: int = 0):
+    """Synthesize a plausible instance of a registered wire dataclass.
+
+    Order of attack: an explicit override (classes with cross-field
+    validation), bare construction from defaults, then per-field synthesis
+    driven by the annotation string.  Raises on failure — the caller turns
+    that into a ``msg-roundtrip`` violation, because "the lint tooling
+    can't even build one" almost always means the class grew a constraint
+    its wire form doesn't express.
+    """
+    messages, _ = _modules()
+    registry = registry if registry is not None else messages.wire_registry()
+    enums = enums if enums is not None else dict(messages._ENUMS)
+    if _depth > 6:
+        raise ValueError(f"sample_instance recursion too deep at {cls}")
+
+    override = _OVERRIDES.get(cls.__name__)
+    if override is not None:
+        return override(messages)
+    try:
+        return cls()
+    except TypeError:
+        pass
+    kwargs = {}
+    for f in dataclasses.fields(cls):
+        if (
+            f.default is not dataclasses.MISSING
+            or f.default_factory is not dataclasses.MISSING  # type: ignore[misc]
+        ):
+            continue
+        kwargs[f.name] = _sample_field(
+            str(f.type), registry, enums, _depth
+        )
+    return cls(**kwargs)
+
+
+def _sample_field(ann: str, registry, enums, depth):
+    ann = ann.strip()
+    base = ann.split("|", 1)[0].strip()
+    if base.startswith("Optional[") or ann.endswith("| None"):
+        # Required-but-optional: None round-trips (encoder omits it).
+        if base.startswith("Optional["):
+            return None
+    simple = {
+        "str": "sample",
+        "int": 1,
+        "float": 1.0,
+        "bool": True,
+        "bytes": b"x",
+        "list": [],
+        "dict": {},
+        "tuple": (),
+        "Any": "any",
+    }
+    if base in simple:
+        return simple[base]
+    if base.split("[", 1)[0] in ("list", "List"):
+        return []
+    if base.split("[", 1)[0] in ("dict", "Dict"):
+        return {}
+    if base == "Resources":
+        from hypha_tpu.resources import Resources
+
+        return Resources(tpu=1.0, memory=2.0)
+    if base in enums:
+        return next(iter(enums[base]))
+    if base in registry:
+        return sample_instance(registry[base], registry, enums, depth + 1)
+    if ann.endswith("None"):
+        return None
+    raise ValueError(f"cannot synthesize a sample for annotation {ann!r}")
+
+
+def _train_config(m):
+    return m.TrainExecutorConfig(
+        model={"model_type": m.ModelType.CAUSAL_LM},
+        data=m.Fetch(m.Reference.from_uri("file:///data")),
+        updates=m.Send(m.Reference.from_peers(["peer-a"], "updates")),
+        results=m.Receive(m.Reference.from_peers(["peer-b"], "results")),
+        optimizer=m.Adam(),
+        batch_size=8,
+    )
+
+
+def _executor(m):
+    return m.Executor(
+        kind="train", name=m.TRAIN_EXECUTOR_NAME, train=_train_config(m)
+    )
+
+
+_OVERRIDES = {
+    "Fetch": lambda m: m.Fetch(m.Reference.from_uri("file:///sample")),
+    "Send": lambda m: m.Send(m.Reference.from_peers(["peer-a"], "updates")),
+    "Receive": lambda m: m.Receive(
+        m.Reference.from_peers(["peer-a"], "results")
+    ),
+    "TrainExecutorConfig": _train_config,
+    "AggregateExecutorConfig": lambda m: m.AggregateExecutorConfig(
+        updates=m.Receive(m.Reference.from_peers(["peer-a"], "updates")),
+        results=m.Send(m.Reference.from_peers(["peer-a"], "results")),
+        optimizer=m.Nesterov(),
+    ),
+    "InferExecutorConfig": lambda m: m.InferExecutorConfig(
+        model={"model_type": m.ModelType.CAUSAL_LM}, serve_name="svc"
+    ),
+    "Executor": _executor,
+    "JobSpec": lambda m: m.JobSpec(job_id="job-1", executor=_executor(m)),
+    "DispatchJob": lambda m: m.DispatchJob(
+        lease_id="lease-1",
+        spec=m.JobSpec(job_id="job-1", executor=_executor(m)),
+    ),
+}
+
+
+def _class_site(cls) -> tuple[str, int]:
+    try:
+        path = inspect.getsourcefile(cls) or "<unknown>"
+        _, line = inspect.getsourcelines(cls)
+    except (OSError, TypeError):
+        path, line = "<unknown>", 0
+    return path, line
+
+
+def _suppressed_on_def(cls, rule: str) -> bool:
+    """Marker anywhere in the class's decorator block or on its ``class``
+    line (getsourcelines starts at the first decorator, e.g. ``@register``)."""
+    from .core import _SUPPRESS_RE
+
+    try:
+        src, _ = inspect.getsourcelines(cls)
+    except (OSError, TypeError):
+        return False
+    for line in src:
+        m = _SUPPRESS_RE.search(line)
+        if m:
+            named = {r.strip() for r in m.group(1).split(",") if r.strip()}
+            if rule in named or "all" in named:
+                return True
+        if line.lstrip().startswith("class "):
+            break  # header ends here; body comments don't waive class rules
+    return False
+
+
+def _violation(cls, rule: str, message: str) -> Violation:
+    path, line = _class_site(cls)
+    return Violation(
+        rule=rule,
+        path=path,
+        line=line,
+        message=message,
+        suppressed=_suppressed_on_def(cls, rule),
+    )
+
+
+def check_roundtrip(registry=None) -> list[Violation]:
+    messages, _ = _modules()
+    registry = registry if registry is not None else _package_registry(messages)
+    out: list[Violation] = []
+    for name, cls in sorted(registry.items()):
+        try:
+            sample = sample_instance(cls, registry)
+            decoded = messages.decode(messages.encode(sample))
+        except Exception as e:  # any failure = the invariant is broken
+            out.append(
+                _violation(
+                    cls,
+                    "msg-roundtrip",
+                    f"{name}: encode/decode raised {type(e).__name__}: {e}",
+                )
+            )
+            continue
+        if decoded != sample:
+            out.append(
+                _violation(
+                    cls,
+                    "msg-roundtrip",
+                    f"{name}: decode(encode(x)) != x "
+                    f"(got {decoded!r}, want {sample!r})",
+                )
+            )
+    return out
+
+
+def check_round_tags(registry=None, required=REQUIRES_ROUND_TAG) -> list[Violation]:
+    messages, _ = _modules()
+    registry = registry if registry is not None else _package_registry(messages)
+    out: list[Violation] = []
+    for name in sorted(required):
+        cls = registry.get(name)
+        if cls is None:
+            # A renamed/deleted FT-critical class must fail loudly — the
+            # tag invariant would otherwise silently stop being enforced.
+            out.append(
+                Violation(
+                    rule="msg-missing-round-tag",
+                    path=messages.__file__,
+                    line=1,
+                    message=(
+                        f"{name}: named in REQUIRES_ROUND_TAG but not in the "
+                        f"registry (renamed? update analysis/proto_rules.py)"
+                    ),
+                )
+            )
+            continue
+        fields = dataclasses.fields(cls)
+        tagged = any(f.name in _TAG_FIELDS for f in fields) or any(
+            "RoundMembership" in str(f.type) for f in fields
+        )
+        if not tagged:
+            out.append(
+                _violation(
+                    cls,
+                    "msg-missing-round-tag",
+                    f"{name}: FT layer epoch-gates this message but it has "
+                    f"no round/epoch field",
+                )
+            )
+    return out
+
+
+def check_protocol_map(registry=None, manifest=None, values=None) -> list[Violation]:
+    messages, _ = _modules()
+    registry = registry if registry is not None else _package_registry(messages)
+    manifest = (
+        manifest if manifest is not None else dict(messages.PROTOCOL_MESSAGES)
+    )
+    values = values if values is not None else set(messages.VALUE_VOCABULARY)
+    out: list[Violation] = []
+    claimed: set[str] = set(values)
+    for n in sorted(values):
+        if n not in registry:
+            out.append(
+                Violation(
+                    rule="msg-unmapped-protocol",
+                    path=messages.__file__,
+                    line=1,
+                    message=(
+                        f"VALUE_VOCABULARY claims unregistered message {n!r} "
+                        f"(stale declare_values entry)"
+                    ),
+                )
+            )
+    for proto, names in manifest.items():
+        for n in names:
+            claimed.add(n)
+            if n not in registry:
+                # A stale manifest entry is reported against the manifest's
+                # home module rather than a class (there is no class).
+                out.append(
+                    Violation(
+                        rule="msg-unmapped-protocol",
+                        path=messages.__file__,
+                        line=1,
+                        message=(
+                            f"{proto} claims unregistered message {n!r}"
+                        ),
+                    )
+                )
+    for name, cls in sorted(registry.items()):
+        if name in claimed:
+            continue
+        if isinstance(cls, type) and issubclass(cls, enum.Enum):
+            continue
+        out.append(
+            _violation(
+                cls,
+                "msg-unmapped-protocol",
+                f"{name}: registered wire message claimed by no protocol in "
+                f"messages.PROTOCOL_MESSAGES (declare_protocol / "
+                f"declare_values)",
+            )
+        )
+    return out
+
+
+def check() -> list[Violation]:
+    return check_roundtrip() + check_round_tags() + check_protocol_map()

@@ -52,12 +52,20 @@ def _atomic_write(path: Path, write_fn) -> None:
     path.parent.mkdir(parents=True, exist_ok=True)
     fd, tmp = tempfile.mkstemp(dir=path.parent, prefix=path.name + ".tmp")
     os.close(fd)
+    committed = False
     try:
         write_fn(Path(tmp))
         os.replace(tmp, path)
-    except BaseException:
-        Path(tmp).unlink(missing_ok=True)
+        committed = True
+    except Exception as e:
+        log.warning("atomic write of %s failed: %s", path, e)
         raise
+    finally:
+        # finally (not a broad except) so the tmp file is reclaimed on ANY
+        # exit — KeyboardInterrupt and cancellation included — while every
+        # exception still propagates unswallowed.
+        if not committed:
+            Path(tmp).unlink(missing_ok=True)
 
 
 def save_train_checkpoint(
@@ -84,6 +92,7 @@ def save_train_checkpoint(
     staging = Path(
         tempfile.mkdtemp(dir=directory, prefix=".staging-")
     )
+    committed = False
     try:
         save_tree(staging / _PARAMS, jax.device_get(params))
         save_tree(staging / _OPT, jax.device_get(opt_state))
@@ -98,9 +107,17 @@ def save_train_checkpoint(
         if target.exists():  # re-save of the same round: replace wholesale
             _rmtree(target)
         os.replace(staging, target)
-    except BaseException:
-        _rmtree(staging)
+        committed = True
+    except Exception as e:
+        log.warning(
+            "checkpoint save to %s (round %d) failed: %s", directory, round_num, e
+        )
         raise
+    finally:
+        # Reclaim the staging dir on any non-commit exit (interrupts too)
+        # without a broad except that could swallow them.
+        if not committed:
+            _rmtree(staging)
     _atomic_write(directory / _LATEST, lambda p: p.write_text(version))
     _prune_versions(directory, keep=_KEEP_VERSIONS)
     log.info("checkpoint saved to %s/%s (round %d)", directory, version, round_num)

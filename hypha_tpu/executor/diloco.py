@@ -43,26 +43,28 @@ def merge_update(params, update):
     return jax.tree.map(lambda p, u: (p + u.astype(p.dtype)).astype(p.dtype), params, update)
 
 
+@jax.jit
+def _apply_updates(p, us):
+    def leaf(x, *ys):
+        total = sum(jnp.asarray(y, jnp.float32) for y in ys)
+        return (x.astype(jnp.float32) + total).astype(x.dtype)
+
+    return jax.tree.map(leaf, p, *us)
+
+
 def apply_updates(params, updates: list):
     """Fold several outer updates into θ in one pass: θ ← θ + Σ updates.
 
     The rejoin catch-up path (hypha_tpu.ft.rejoin): a worker that missed
     rounds k..r−1 applies their updates — or the parameter server's single
     cumulative Σ — in f32 before the per-leaf cast, so a long catch-up does
-    not compound per-round rounding in low-precision params.
+    not compound per-round rounding in low-precision params.  The jitted
+    body lives at module level so repeated same-shape catch-ups hit the
+    compilation cache instead of re-tracing a parameter-sized tree op.
     """
     if not updates:
         return params
-
-    @jax.jit
-    def _apply(p, us):
-        def leaf(x, *ys):
-            total = sum(jnp.asarray(y, jnp.float32) for y in ys)
-            return (x.astype(jnp.float32) + total).astype(x.dtype)
-
-        return jax.tree.map(leaf, p, *us)
-
-    return _apply(params, updates)
+    return _apply_updates(params, list(updates))
 
 
 def average_deltas(deltas: list, weights=None):

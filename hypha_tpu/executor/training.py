@@ -86,8 +86,8 @@ def _with_deadline(fn: Callable[[], Any], timeout: float, what: str):
     def work() -> None:
         try:
             box["value"] = fn()
-        except BaseException as e:  # surfaced below on the caller thread
-            box["error"] = e
+        except BaseException as e:  # hypha-lint: disable=swallowed-cancel
+            box["error"] = e  # thread-bridge: re-raised on the caller thread
 
     t = threading.Thread(target=work, daemon=True, name="mh-step")
     t.start()

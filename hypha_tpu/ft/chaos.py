@@ -29,6 +29,8 @@ import time
 from dataclasses import dataclass, field
 from typing import Any, Callable
 
+from .. import aio
+
 __all__ = ["ChaosAction", "ChaosController", "parse_chaos_spec"]
 
 log = logging.getLogger("hypha.ft.chaos")
@@ -121,9 +123,9 @@ class ChaosController:
             return
         log.info("chaos: %s %s (round trigger %d)", action.kind, action.target, action.at_round)
         if action.kind == "kill":
-            task = asyncio.create_task(self._kill(worker))
-            self._tasks.add(task)
-            task.add_done_callback(self._tasks.discard)
+            aio.spawn(
+                self._kill(worker), tasks=self._tasks, what="chaos kill", logger=log
+            )
         elif action.kind == "delay":
             self._wrap_push_delay(worker.node, action.delay_s)
         elif action.kind == "partition":
@@ -141,7 +143,9 @@ class ChaosController:
             if callable(node_stop):
                 await node_stop()
             await worker.stop()
-        except (Exception, asyncio.CancelledError) as e:
+        except Exception as e:
+            # CancelledError propagates: a cancelled kill task must end
+            # cancelled, not swallow its own teardown signal.
             log.warning("chaos kill: stop raised %s", e)
 
     @staticmethod

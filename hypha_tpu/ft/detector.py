@@ -36,9 +36,10 @@ PHI_THRESHOLD_DEFAULT = 8.0
 # function that fires on the first microsecond of jitter.
 _MIN_STD_S = 0.05
 
-# Before two intervals exist there is no distribution to fit; assume this
-# mean so a peer that dies immediately after acceptance is still caught.
-_FIRST_ESTIMATE_S = 1.0
+# NOTE: a peer that dies with fewer than ``min_samples`` recorded intervals
+# is never suspected by φ (phi() returns 0.0 below the warm-up gate, by
+# design — see PhiAccrualDetector.min_samples).  Early death is caught by
+# the lease-renewal failure path instead, which needs no distribution.
 
 
 class _PeerHistory:
@@ -62,9 +63,8 @@ class _PeerHistory:
         self._sum_sq += interval * interval
 
     def mean_std(self) -> tuple[float, float]:
+        # Only reached past the min_samples warm-up gate, so n >= 1 always.
         n = len(self.intervals)
-        if n == 0:
-            return _FIRST_ESTIMATE_S, max(_FIRST_ESTIMATE_S / 2, _MIN_STD_S)
         mean = self._sum / n
         var = max(self._sum_sq / n - mean * mean, 0.0)
         return mean, max(math.sqrt(var), _MIN_STD_S)

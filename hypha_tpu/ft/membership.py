@@ -27,7 +27,7 @@ from __future__ import annotations
 import math
 from dataclasses import dataclass, field
 
-from ..messages import register
+from ..messages import declare_protocol, declare_values, register
 
 __all__ = [
     "PROTOCOL_FT",
@@ -109,6 +109,12 @@ class MembershipUpdate:
     job_id: str
     membership: RoundMembership = field(default_factory=RoundMembership)
     joined: list = field(default_factory=list)
+
+
+# Protocol manifest (hypha-lint msg-unmapped-protocol): MembershipUpdate
+# heads the FT stream; the snapshot and knobs ride inside other messages.
+declare_protocol(PROTOCOL_FT, "MembershipUpdate")
+declare_values("RoundMembership", "FTConfig")
 
 
 class MembershipView:

@@ -54,6 +54,7 @@ class CatchupBuffer:
     def __init__(self) -> None:
         self._cum: dict[str, np.ndarray] = {}
         self.rounds = 0  # outer updates accumulated so far
+        self._written: tuple[int, str] | None = None  # (rounds, path) cache
 
     def accumulate(self, update_path: Path | str) -> None:
         """Fold one round's update file into the running sum."""
@@ -72,11 +73,19 @@ class CatchupBuffer:
         self.rounds += 1
 
     def write(self, path: Path | str) -> Path:
-        """Materialize the sum for a catch-up push (atomic via temp name)."""
+        """Materialize the sum for a catch-up push (atomic via temp name).
+
+        Idempotent per accumulation state: the sum only changes in
+        :meth:`accumulate`, so re-serializing the parameter-sized file for
+        every pending rejoiner / retry tick would be pure waste.
+        """
         path = Path(path)
+        if self._written == (self.rounds, str(path)) and path.is_file():
+            return path
         tmp = path.with_suffix(".tmp")
         save_file(self._cum, str(tmp))
         tmp.replace(path)
+        self._written = (self.rounds, str(path))
         return path
 
     def is_empty(self) -> bool:

@@ -104,11 +104,41 @@ INFER_EXECUTOR_NAME = "generate"
 
 _REGISTRY: dict[str, type] = {}
 
+# Protocol manifest: stream protocol id (or gossip topic) -> the top-level
+# message types that may appear on it.  hypha-lint's ``msg-unmapped-protocol``
+# rule fails the build when a registered message is claimed by no protocol —
+# so adding a message forces deciding, in code review, which stream carries
+# it.  Subsystems owning their own protocol (hypha_tpu.ft) extend this at
+# import time via :func:`declare_protocol`.
+PROTOCOL_MESSAGES: dict[str, tuple[str, ...]] = {}
+
+# Nested value vocabulary: dataclasses that ride inside a protocol message
+# (job specs, optimizer configs, references) rather than heading a stream.
+VALUE_VOCABULARY: set[str] = set()
+
 
 def register(cls):
     """Class decorator: make a dataclass wire-serializable under its name."""
     _REGISTRY[cls.__name__] = cls
     return cls
+
+
+def declare_protocol(protocol_id: str, *message_names: str) -> None:
+    """Claim top-level message types for a stream protocol / gossip topic."""
+    existing = PROTOCOL_MESSAGES.get(protocol_id, ())
+    PROTOCOL_MESSAGES[protocol_id] = tuple(
+        dict.fromkeys(existing + message_names)
+    )
+
+
+def declare_values(*message_names: str) -> None:
+    """Claim message types as nested value vocabulary (no stream of their own)."""
+    VALUE_VOCABULARY.update(message_names)
+
+
+def wire_registry() -> dict[str, type]:
+    """Snapshot of every registered wire dataclass (hypha-lint / tests)."""
+    return dict(_REGISTRY)
 
 
 def _to_plain(obj: Any) -> Any:
@@ -803,3 +833,48 @@ class RequestWorker:
     timeout: float = 0.2  # offer window seconds
     bid: float = 0.0
     reply_to: str = ""  # scheduler peer id to send WorkerOffer to
+
+
+# --------------------------------------------------------------------------
+# Protocol manifest (validated by hypha-lint's protocol family): every
+# registered message above must be claimed by exactly one of these calls.
+# --------------------------------------------------------------------------
+
+declare_protocol(
+    PROTOCOL_API,
+    "WorkerOffer",
+    "RenewLease",
+    "RenewLeaseResponse",
+    "JobStatus",
+    "DispatchJob",
+    "DispatchJobResponse",
+    "CancelJob",
+    "DataRequest",
+    "DataResponse",
+    "ParameterPull",
+    "ParameterPush",
+    "Ack",
+)
+declare_protocol(PROTOCOL_HEALTH, "HealthRequest", "HealthResponse")
+declare_protocol(PROTOCOL_PROGRESS, "Progress", "ProgressResponse")
+declare_protocol(PROTOCOL_GENERATE, "GenerateRequest", "GenerateResponse")
+declare_protocol(f"gossip:{TOPIC_WORKER}", "RequestWorker")
+declare_values(
+    "LRScheduler",
+    "Adam",
+    "Nesterov",
+    "Reference",
+    "Fetch",
+    "Send",
+    "Receive",
+    "ExecutorDescriptor",
+    "WorkerSpec",
+    "TrainExecutorConfig",
+    "AggregateExecutorConfig",
+    "InferExecutorConfig",
+    "Executor",
+    "JobSpec",
+    "DataRecord",
+    "DataSlice",
+    "PriceRange",
+)

@@ -25,6 +25,7 @@ import asyncio
 import struct
 from typing import Any, AsyncIterator, Awaitable, Callable
 
+from .. import aio
 from .. import codec
 
 __all__ = [
@@ -202,17 +203,14 @@ class MemoryTransport(Transport):
         except KeyError:
             raise ConnectionRefusedError(addr) from None
         ours, theirs = _MemoryStream.pair()
-        task = asyncio.create_task(on_stream(theirs))
-        self._tasks.add(task)
-        task.add_done_callback(self._tasks.discard)
+        aio.spawn(on_stream(theirs), tasks=self._tasks, what="fabric accept")
         return ours
 
     async def close(self) -> None:
         for addr in self._listening:
             self.hub.pop(addr, None)
         self._listening.clear()
-        for task in list(self._tasks):
-            task.cancel()
+        await aio.reap(*list(self._tasks))
 
 
 # ---------------------------------------------------------------------------
@@ -357,10 +355,7 @@ class TcpTransport(Transport):
         for task in list(self._conn_tasks):
             task.cancel()
         for server in self._servers:
-            try:
-                await server.wait_closed()
-            except (ConnectionError, asyncio.CancelledError):
-                pass
+            await aio.wait_quiet(server.wait_closed())
         self._servers.clear()
 
 

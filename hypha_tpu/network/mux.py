@@ -32,6 +32,7 @@ import logging
 import struct
 from typing import Callable
 
+from .. import aio
 from .fabric import AcceptCallback, Stream, Transport
 
 __all__ = ["MuxTransport"]
@@ -151,7 +152,7 @@ class _MuxConn:
         self._has_credit.set()
         self.closed = False
         self._tasks: set[asyncio.Task] = set()
-        self._pump_task = asyncio.create_task(self._pump())
+        self._pump_task = aio.spawn(self._pump(), what="mux pump", logger=log)
 
     def _credit(self, n: int) -> None:
         self._inflight -= n
@@ -201,18 +202,24 @@ class _MuxConn:
                         # credit forever. Refuse it — from a spawned task,
                         # never awaiting a write inside the read pump (a
                         # non-draining peer could wedge the connection).
-                        task = asyncio.create_task(self._reset_quietly(sid))
-                        self._tasks.add(task)
-                        task.add_done_callback(self._tasks.discard)
+                        aio.spawn(
+                            self._reset_quietly(sid),
+                            tasks=self._tasks,
+                            what="mux stream reset",
+                            logger=log,
+                        )
                         continue
                     stream = _MuxStream(self, sid)
                     self._streams[sid] = stream
                     if payload:
                         self._inflight += len(payload)
                         stream._deliver(payload)
-                    task = asyncio.create_task(self._serve(stream))
-                    self._tasks.add(task)
-                    task.add_done_callback(self._tasks.discard)
+                    aio.spawn(
+                        self._serve(stream),
+                        tasks=self._tasks,
+                        what="mux stream serve",
+                        logger=log,
+                    )
                 elif flag == _DATA:
                     stream = self._streams.get(sid)
                     if stream is not None:
@@ -225,7 +232,9 @@ class _MuxConn:
                     if stream is not None:
                         stream._detach()
                         stream._deliver(None)
-        except (Exception, asyncio.CancelledError):
+        except asyncio.CancelledError:
+            raise  # finally still tears the connection down
+        except Exception:
             pass
         finally:
             await self._teardown()
@@ -256,11 +265,7 @@ class _MuxConn:
             pass
 
     async def close(self) -> None:
-        self._pump_task.cancel()
-        try:
-            await self._pump_task
-        except (asyncio.CancelledError, Exception):
-            pass
+        await aio.reap(self._pump_task)
 
 
 class MuxTransport(Transport):
@@ -280,9 +285,7 @@ class MuxTransport(Transport):
             # prune — a long-lived listener with client churn must not
             # accumulate dead connections.
             try:
-                await conn._pump_task
-            except (asyncio.CancelledError, Exception):
-                pass
+                await aio.wait_quiet(conn._pump_task)
             finally:
                 try:
                     self._accepted.remove(conn)

@@ -34,7 +34,7 @@ from collections import OrderedDict
 from dataclasses import dataclass, field
 from typing import Any, AsyncIterator, Awaitable, Callable
 
-from .. import messages
+from .. import aio, messages
 from .fabric import MAX_FRAME, FrameError, Stream, Transport, copy_stream
 
 __all__ = [
@@ -488,13 +488,17 @@ class PushStream:
         loop = asyncio.get_running_loop()
         total = 0
         try:
-            with open(path, "wb") as f:
+            # open() seeks/stats on the calling thread — off the loop too.
+            f = await asyncio.to_thread(open, path, "wb")
+            try:
                 while True:
                     data = await self.stream.read(chunk)
                     if not data:
                         break
                     await loop.run_in_executor(None, f.write, data)
                     total += len(data)
+            finally:
+                await asyncio.to_thread(f.close)
         finally:
             # Same wedge as the raw path: a sender dying mid-push must
             # still release the accept-semaphore slot, or ACCEPT_LIMIT
@@ -640,11 +644,8 @@ class Node:
 
     # ------------------------------------------------------------------ core
 
-    def _spawn(self, coro) -> asyncio.Task:
-        task = asyncio.create_task(coro)
-        self._tasks.add(task)
-        task.add_done_callback(self._tasks.discard)
-        return task
+    def _spawn(self, coro, what: str = "") -> asyncio.Task:
+        return aio.spawn(coro, tasks=self._tasks, what=what, logger=log)
 
     async def start(self, listen: list[str] | None = None) -> None:
         for addr in listen or ["", ]:
@@ -674,13 +675,7 @@ class Node:
                     sub._queue.put_nowait(None)
                 except asyncio.QueueFull:
                     pass
-        for task in list(self._tasks):
-            task.cancel()
-        for task in list(self._tasks):
-            try:
-                await task
-            except (asyncio.CancelledError, Exception):
-                pass
+        await aio.reap(*list(self._tasks))
         await self.transport.close()
 
     def add_peer_addr(self, peer_id: str, addr: str) -> None:
@@ -1674,19 +1669,24 @@ class Node:
             # asyncio streams the fallback itself under TLS.
             transport = getattr(stream, "sendfile_transport", lambda: None)()
             if transport is not None:
+                f = await asyncio.to_thread(open, source, "rb")
                 try:
-                    with open(source, "rb") as f:
-                        return await loop.sendfile(transport, f, fallback=True)
+                    return await loop.sendfile(transport, f, fallback=True)
                 except (AttributeError, NotImplementedError, RuntimeError):
                     pass  # transport without sendfile support: chunked copy
+                finally:
+                    await asyncio.to_thread(f.close)
             total = 0
-            with open(source, "rb") as f:
+            f = await asyncio.to_thread(open, source, "rb")
+            try:
                 while True:
                     chunk = await loop.run_in_executor(None, f.read, 1 << 20)
                     if not chunk:
                         break
                     await stream.write(chunk)
                     total += len(chunk)
+            finally:
+                await asyncio.to_thread(f.close)
             return total
         return await copy_stream(source, stream)
 

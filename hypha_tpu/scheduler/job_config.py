@@ -14,10 +14,22 @@ from __future__ import annotations
 from dataclasses import dataclass, field
 
 from ..ft.membership import FTConfig
-from ..messages import Adam, Loss, LRScheduler, Nesterov, PriceRange, register
+from ..messages import (
+    Adam,
+    Loss,
+    LRScheduler,
+    Nesterov,
+    PriceRange,
+    declare_values,
+    register,
+)
 from ..resources import Resources
 
 __all__ = ["DiLoCoRounds", "JobResources", "DiLoCoJob"]
+
+# Protocol manifest (hypha-lint msg-unmapped-protocol): job configs ride
+# inside DispatchJob specs / persisted config, never heading a stream.
+declare_values("DiLoCoRounds", "JobResources", "DiLoCoJob")
 
 
 @register

@@ -16,6 +16,8 @@ import logging
 import urllib.request
 from typing import Callable
 
+from .. import aio
+
 __all__ = [
     "MetricsConnector",
     "NoOpConnector",
@@ -69,15 +71,12 @@ class AimConnector(MetricsConnector):
             "metric_name": name,
             "value": value,
         }
+        coro = asyncio.to_thread(self._post, payload)
         try:
-            task = asyncio.get_running_loop().create_task(
-                asyncio.to_thread(self._post, payload)
-            )
+            aio.spawn(coro, tasks=self._pending, what="metrics post", logger=log)
         except RuntimeError:  # no loop (sync contexts / tests)
+            coro.close()
             self._post(payload)
-            return
-        self._pending.add(task)
-        task.add_done_callback(self._pending.discard)
 
     def _post(self, payload: dict) -> None:
         req = urllib.request.Request(

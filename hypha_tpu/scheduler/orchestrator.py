@@ -44,7 +44,7 @@ import logging
 import uuid
 from typing import Any
 
-from .. import messages
+from .. import aio, messages
 from ..ft.detector import PhiAccrualDetector
 from ..ft.membership import (
     PROTOCOL_FT,
@@ -654,10 +654,15 @@ class Orchestrator:
 
     def _notify_membership_soon(self, ctx: _RunContext, joined: list[str] | None = None) -> None:
         """Fire-and-forget membership push to the PS (never blocks the
-        supervision loop; a lost update is repaired by the next one)."""
-        task = asyncio.create_task(self._notify_membership(ctx, joined))
-        ctx.notify_tasks.add(task)
-        task.add_done_callback(ctx.notify_tasks.discard)
+        supervision loop; a lost update is repaired by the next one).
+        aio.spawn retains the task and logs/counts a failed push — the
+        PR-1 form dropped the exception with the task reference."""
+        aio.spawn(
+            self._notify_membership(ctx, joined),
+            tasks=ctx.notify_tasks,
+            what="membership notify",
+            logger=log,
+        )
 
     async def _notify_membership(
         self, ctx: _RunContext, joined: list[str] | None = None

@@ -16,6 +16,7 @@ import asyncio
 import logging
 import uuid
 
+from .. import aio
 from ..messages import (
     INFER_EXECUTOR_NAME,
     PROTOCOL_API,
@@ -95,12 +96,14 @@ class ServingSupervisor:
                     if handle is None:
                         await self._pause()
                         continue
-                stop_wait = asyncio.create_task(self._stop.wait())
+                stop_wait = aio.spawn(self._stop.wait(), what="serving stop waiter")
                 # Watch BOTH failure channels: lease-renewal liveness
                 # (handle.failed) and the job's status stream — a job that
                 # fails while its worker stays healthy (e.g. model load
                 # error) reports JobStatus("failed") and must redeploy too.
-                status_wait = asyncio.create_task(task.next_status())
+                status_wait = aio.spawn(
+                    task.next_status(), what="serving status waiter", logger=log
+                )
                 done, _ = await asyncio.wait(
                     {stop_wait, status_wait, handle.failed},
                     return_when=asyncio.FIRST_COMPLETED,
@@ -158,14 +161,21 @@ class ServingSupervisor:
                 kind="infer", name=INFER_EXECUTOR_NAME, infer=self._config
             ),
         )
+        dispatched = False
         try:
             task = await Task.dispatch(self.node, self._router, job, [handle])
-        except BaseException:
-            # The lease is live (renewal loop running) — a dispatch failure
-            # must release it or the worker's capacity leaks to a zombie
-            # lease on every retry.
-            await handle.release()
+            dispatched = True
+        except Exception as e:
+            log.warning(
+                "dispatch of %s to %s failed: %s", job.job_id, handle.peer_id, e
+            )
             raise
+        finally:
+            # The lease is live (renewal loop running) — any non-dispatch
+            # exit, cancellation included, must release it or the worker's
+            # capacity leaks to a zombie lease on every retry.
+            if not dispatched:
+                await handle.release()
         log.info(
             "serving %s deployed on %s (job %s)",
             self.serve_name, handle.peer_id, job.job_id,

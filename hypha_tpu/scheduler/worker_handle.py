@@ -12,6 +12,7 @@ from __future__ import annotations
 import asyncio
 import logging
 
+from .. import aio
 from ..messages import PROTOCOL_API, RenewLease, RenewLeaseResponse, WorkerOffer
 from ..network.node import Node, RequestError
 
@@ -98,11 +99,6 @@ class WorkerHandle:
         """Stop renewing; the worker-side lease expires on its own and the
         prune loop reclaims the resources."""
         self._released = True
-        if self._renewal is not None:
-            self._renewal.cancel()
-            try:
-                await self._renewal
-            except (asyncio.CancelledError, Exception):
-                pass
+        await aio.reap(self._renewal)
         if not self.failed.done():
             self.failed.cancel()

@@ -20,6 +20,7 @@ import logging
 import time
 from dataclasses import dataclass, field
 
+from .. import aio
 from ..messages import (
     PROTOCOL_API,
     TOPIC_WORKER,
@@ -99,13 +100,7 @@ class Arbiter:
             reg.close()
         if self._subscription is not None:
             await self._subscription.close()
-        for task in self._tasks:
-            task.cancel()
-        for task in self._tasks:
-            try:
-                await task
-            except (asyncio.CancelledError, Exception):
-                pass
+        await aio.reap(*self._tasks)
         await self.job_manager.shutdown()
 
     # ----------------------------------------------------------- auction

@@ -24,6 +24,8 @@ import logging
 from dataclasses import dataclass, field
 from typing import Any, Callable
 
+from .. import aio
+
 __all__ = ["RequestBatcher"]
 
 log = logging.getLogger("hypha.worker.batcher")
@@ -65,9 +67,7 @@ class RequestBatcher:
         self.batched_prompts = 0  # prompts that shared a decode with others
 
     def _spawn(self, coro) -> None:
-        task = asyncio.create_task(coro)
-        self._tasks.add(task)
-        task.add_done_callback(self._tasks.discard)
+        aio.spawn(coro, tasks=self._tasks, what="batch decode", logger=log)
 
     async def submit(
         self,

@@ -25,7 +25,7 @@ import logging
 import socket
 from pathlib import Path
 
-from .. import messages
+from .. import aio, messages
 from ..messages import PROTOCOL_PROGRESS, Fetch, Progress, Receive, Send
 from ..network.node import Node
 from .connectors import Connector
@@ -102,10 +102,7 @@ class Bridge:
         for task in list(self._conn_tasks):
             task.cancel()
         if self._server is not None:
-            try:
-                await asyncio.wait_for(self._server.wait_closed(), 10.0)
-            except (asyncio.TimeoutError, asyncio.CancelledError):
-                pass
+            await aio.wait_quiet(self._server.wait_closed(), timeout=10.0)
         # Drain in-flight background sends — the executor's final
         # pseudo-gradient is typically still uploading when it exits.
         # Re-snapshot each pass: a request already in-flight when the server
@@ -245,15 +242,12 @@ class Bridge:
             return
 
         # Background copy (bridge.rs:256-327): don't block the executor loop.
-        task = asyncio.create_task(self.connector.send(send, path, resource, meta))
-        self._send_tasks.add(task)
-
-        def _log_done(t: asyncio.Task) -> None:
-            self._send_tasks.discard(t)
-            if not t.cancelled() and t.exception():
-                log.warning("background send failed: %s", t.exception())
-
-        task.add_done_callback(_log_done)
+        aio.spawn(
+            self.connector.send(send, path, resource, meta),
+            tasks=self._send_tasks,
+            what="background send",
+            logger=log,
+        )
         await self._respond(writer, 202, {"ok": True})
 
     async def _receive(
@@ -285,11 +279,7 @@ class Bridge:
                     {nxt, client_gone}, return_when=asyncio.FIRST_COMPLETED
                 )
                 if nxt not in done:
-                    nxt.cancel()
-                    try:
-                        await nxt
-                    except (asyncio.CancelledError, StopAsyncIteration):
-                        pass
+                    await aio.reap(nxt)
                     break
                 try:
                     rf = nxt.result()

@@ -150,12 +150,15 @@ class Connector:
         dest = dest_dir / f"{_safe_name(ref.dataset or 'slice')}-{resp.index:06d}"
         loop = asyncio.get_running_loop()
         try:
-            with open(dest, "wb") as f:
+            f = await asyncio.to_thread(open, dest, "wb")
+            try:
                 while True:
                     chunk = await stream.read(1 << 20)
                     if not chunk:
                         break
                     await loop.run_in_executor(None, f.write, chunk)
+            finally:
+                await asyncio.to_thread(f.close)
         finally:
             await stream.close()
         return dest

@@ -14,6 +14,7 @@ import logging
 from dataclasses import dataclass, field
 from typing import Any
 
+from .. import aio
 from ..messages import PROTOCOL_API, JobSpec, JobStatus
 from ..network.node import Node, RequestError
 
@@ -87,8 +88,10 @@ class JobManager:
             raise ValueError(f"job {spec.job_id} already running")
         execution = await executor.execute(spec.job_id, spec, scheduler_peer)
         job = _ActiveJob(execution=execution, lease_id=lease_id)
-        job.monitor = asyncio.create_task(
-            self._monitor(spec.job_id, execution, scheduler_peer)
+        job.monitor = aio.spawn(
+            self._monitor(spec.job_id, execution, scheduler_peer),
+            what=f"job monitor {spec.job_id}",
+            logger=log,
         )
         self._active[spec.job_id] = job
         await self._report(
@@ -131,11 +134,7 @@ class JobManager:
         for job in list(self._active.values()):
             await job.execution.cancel()
         for job in list(self._active.values()):
-            if job.monitor is not None:
-                try:
-                    await asyncio.wait_for(job.monitor, 10)
-                except (asyncio.TimeoutError, asyncio.CancelledError):
-                    pass
+            await aio.wait_quiet(job.monitor, timeout=10)
 
     def __len__(self) -> int:
         return len(self._active)

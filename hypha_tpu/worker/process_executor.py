@@ -19,6 +19,7 @@ import uuid
 from dataclasses import dataclass, field
 from pathlib import Path
 
+from .. import aio
 from .. import messages
 from ..messages import JobSpec
 from ..network.node import Node
@@ -109,8 +110,12 @@ class _ProcessExecution(Execution):
         self._tasks: list[asyncio.Task] = []
 
     def start_supervision(self) -> None:
-        self._tasks.append(asyncio.create_task(self._pump_stdout()))
-        self._tasks.append(asyncio.create_task(self._supervise()))
+        self._tasks.append(
+            aio.spawn(self._pump_stdout(), what="executor stdout pump", logger=log)
+        )
+        self._tasks.append(
+            aio.spawn(self._supervise(), what="executor supervise", logger=log)
+        )
 
     async def _pump_stdout(self) -> None:
         """Pipe executor stdout through our log (process.rs:140-169)."""
@@ -125,7 +130,9 @@ class _ProcessExecution(Execution):
         rc = await self.proc.wait()
         await self.bridge.stop()
         if not self.keep_work_dir:
-            shutil.rmtree(self.work_dir, ignore_errors=True)  # process.rs:191-192
+            await asyncio.to_thread(  # process.rs:191-192
+                shutil.rmtree, self.work_dir, ignore_errors=True
+            )
         if self._cancelled:
             self.finish("cancelled")
         elif rc == 0:

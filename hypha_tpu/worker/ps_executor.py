@@ -34,6 +34,7 @@ from pathlib import Path
 import numpy as np
 from safetensors.numpy import load_file, save_file
 
+from .. import aio
 from .. import native
 from ..ft.membership import PROTOCOL_FT, MembershipUpdate, RoundMembership, quorum_size
 from ..ft.rejoin import CATCHUP_KEY, CatchupBuffer
@@ -114,11 +115,7 @@ class ParameterServerExecutor(JobExecutor):
         )
 
         async def cancel() -> None:
-            task.cancel()
-            try:
-                await task
-            except (asyncio.CancelledError, Exception):
-                pass
+            await aio.reap(task)
             execution.finish("cancelled")
 
         execution.cancel = cancel  # type: ignore[method-assign]
@@ -223,7 +220,7 @@ class ParameterServerExecutor(JobExecutor):
             if membership_reg is not None:
                 membership_reg.close()
             consumer.close()
-            shutil.rmtree(work_dir, ignore_errors=True)
+            await asyncio.to_thread(shutil.rmtree, work_dir, ignore_errors=True)
 
     async def _collect_round(
         self,
@@ -385,8 +382,14 @@ class ParameterServerExecutor(JobExecutor):
         self, st: _ElasticState, cfg, round_num: int, work_dir: Path
     ) -> None:
         """Push the cumulative-update catch-up to newly joined peers."""
-        for peer in [p for p, n in st.pending_joins.items() if n > 0]:
-            path = st.catchup.write(work_dir / "catchup.safetensors")
+        pending = [p for p, n in st.pending_joins.items() if n > 0]
+        if not pending:
+            return
+        # One serialization per call: the cumulative sum only changes at
+        # accumulate() (once per round), not per rejoiner or retry tick —
+        # re-writing the parameter-sized file per peer was pure waste.
+        path = st.catchup.write(work_dir / "catchup.safetensors")
+        for peer in pending:
             header = {
                 "resource": cfg.results.ref.resource or "results",
                 "name": f"catchup-{round_num}.safetensors",

@@ -24,6 +24,7 @@ import uuid
 from dataclasses import dataclass, field
 from pathlib import Path
 
+from .. import aio
 from ..messages import JobSpec
 from ..network.node import Node
 from .bridge import Bridge
@@ -83,11 +84,7 @@ class InProcessTrainExecutor(JobExecutor):
                         "job %s did not stop cooperatively; abandoning thread",
                         spec.job_id,
                     )
-                    runner.cancel()
-                    try:
-                        await runner
-                    except (asyncio.CancelledError, Exception):
-                        pass
+                    await aio.reap(runner)
             except Exception:
                 pass
             execution.finish("cancelled")
@@ -134,4 +131,6 @@ class InProcessTrainExecutor(JobExecutor):
         finally:
             await bridge.stop()
             if not self.keep_work_dir:
-                shutil.rmtree(work_dir, ignore_errors=True)
+                await asyncio.to_thread(
+                    shutil.rmtree, work_dir, ignore_errors=True
+                )

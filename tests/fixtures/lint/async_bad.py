@@ -1,0 +1,90 @@
+"""Seeded async-hygiene violations: hypha-lint's own regression fixture.
+
+Each block below is one deliberate violation of a rule in
+hypha_tpu.analysis.async_rules; tests/test_lint.py asserts every one is
+caught (and that the clean twins below them stay clean).  This file is
+never imported.
+"""
+
+import asyncio
+import subprocess
+import time
+
+
+async def blocking_sleep():          # async-blocking-call x2
+    time.sleep(1.0)
+    subprocess.run(["true"])
+
+
+async def blocking_open(path):       # async-blocking-call
+    with open(path) as f:
+        return f.read()
+
+
+def sync_sleep_is_fine():
+    time.sleep(0.1)  # sync context: not a violation
+
+
+async def to_thread_is_fine(path):
+    def _read():
+        with open(path) as f:  # nested sync def: runs off-loop
+            return f.read()
+
+    return await asyncio.to_thread(_read)
+
+
+async def black_hole(coro):          # task-black-hole
+    asyncio.create_task(coro)
+
+
+async def black_hole_ensure(coro):   # task-black-hole
+    asyncio.ensure_future(coro)
+
+
+async def retained_is_fine(coro, tasks):
+    task = asyncio.create_task(coro)
+    tasks.add(task)
+    task.add_done_callback(tasks.discard)
+    return task
+
+
+async def swallow_bare():            # swallowed-cancel
+    try:
+        await asyncio.sleep(1)
+    except:  # noqa: E722
+        pass
+
+
+async def swallow_base_exception():  # swallowed-cancel
+    try:
+        await asyncio.sleep(1)
+    except BaseException:
+        pass
+
+
+async def swallow_cancelled_tuple(task):  # swallowed-cancel
+    task.cancel()
+    try:
+        await task
+    except (asyncio.CancelledError, Exception):
+        pass
+
+
+async def reraise_is_fine():
+    try:
+        await asyncio.sleep(1)
+    except asyncio.CancelledError:
+        raise
+    except Exception:
+        pass
+
+
+async def lock_held_request(node, peer, proto, msg):
+    lock = asyncio.Lock()
+    async with lock:                 # lock-held-await
+        return await node.request(peer, proto, msg)
+
+
+async def lock_held_write_is_fine(stream, lock, frame):
+    async with lock:  # serialized frame write: bounded, allowed
+        await stream.write(frame)

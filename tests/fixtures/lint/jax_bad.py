@@ -1,0 +1,61 @@
+"""Seeded JAX-discipline violations for hypha-lint's regression tests.
+
+Never imported (jax is referenced, not required): the linter works on the
+AST alone.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from functools import partial
+
+
+@jax.jit
+def host_sync_item(x):               # jit-host-sync x2
+    loss = jnp.mean(x)
+    if float(loss) > 0:
+        return loss.item()
+    return 0.0
+
+
+@jax.jit
+def host_sync_asarray(x):            # jit-host-sync
+    return np.asarray(x).sum()
+
+
+@jax.jit
+def side_effect_print(x):            # jit-side-effect
+    print("tracing", x)
+    return x * 2
+
+
+@partial(jax.jit, donate_argnums=(0,))
+def donated_step(state, batch):
+    return state + batch
+
+
+def reuse_after_donation(state, batch):   # donated-buffer-reuse
+    new_state = donated_step(state, batch)
+    return new_state + state  # `state`'s buffer is already deleted
+
+
+def rebind_is_fine(state, batch):
+    state = donated_step(state, batch)
+    return state
+
+
+def _inner_step(params, grads):
+    return jax.tree.map(lambda p, g: p - g, params, grads)
+
+
+apply_step = jax.jit(_inner_step, donate_argnums=(0,))
+
+
+def wrapper_reuse(params, grads):          # donated-buffer-reuse
+    out = apply_step(params, grads)
+    return out, params  # donated via the wrapper assignment
+
+
+def not_jitted_is_fine(x):
+    print("host code may print")
+    return float(np.asarray(x).sum())

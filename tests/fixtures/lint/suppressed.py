@@ -1,0 +1,18 @@
+"""Seeded violations WITH inline waivers: exercises the suppression parser
+and the budget accounting in tests/test_lint.py.  Never imported."""
+
+import asyncio
+import time
+
+
+async def waived_sleep():
+    time.sleep(0.5)  # hypha-lint: disable=async-blocking-call
+
+
+async def waived_all(coro):
+    asyncio.create_task(coro)  # hypha-lint: disable=all
+
+
+async def wrong_rule_waived():
+    # A waiver for a different rule must NOT suppress this violation.
+    time.sleep(0.5)  # hypha-lint: disable=task-black-hole

@@ -1,0 +1,341 @@
+"""hypha-lint's own regression suite (tier-1).
+
+Three layers: (1) every rule family catches its seeded violations in
+tests/fixtures/lint/, (2) the suppression syntax and budget accounting
+work, (3) the real package is lint-clean — the acceptance invariant
+``python -m hypha_tpu.analysis hypha_tpu/`` exits 0, run in-process.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import subprocess
+import sys
+from collections import Counter
+from pathlib import Path
+
+import pytest
+
+from hypha_tpu.analysis import (
+    DEFAULT_SUPPRESSION_BUDGET,
+    RULES,
+    lint_paths,
+    lint_source,
+)
+from hypha_tpu.analysis.core import FileSource
+from hypha_tpu.analysis import proto_rules
+
+FIXTURES = Path(__file__).parent / "fixtures" / "lint"
+REPO = Path(__file__).parent.parent
+PACKAGE = REPO / "hypha_tpu"
+
+
+def _rules_by_count(path: Path) -> Counter:
+    report = lint_paths([path], protocol_checks=False)
+    assert not report.parse_errors, report.parse_errors
+    return Counter(v.rule for v in report.active)
+
+
+# ---------------------------------------------------------------- fixtures
+
+
+def test_async_fixture_catches_each_rule():
+    counts = _rules_by_count(FIXTURES / "async_bad.py")
+    assert counts["async-blocking-call"] == 3  # sleep, subprocess.run, open
+    assert counts["task-black-hole"] == 2  # create_task + ensure_future
+    assert counts["swallowed-cancel"] == 3  # bare, BaseException, tuple
+    assert counts["lock-held-await"] == 1
+
+
+@pytest.mark.parametrize("fixture", ["async_bad.py", "jax_bad.py"])
+def test_fixture_clean_twins_stay_clean(fixture):
+    """No violation may land inside a function whose name ends _is_fine."""
+    path = FIXTURES / fixture
+    lines = path.read_text().splitlines()
+    report = lint_paths([path], protocol_checks=False)
+    for v in report.active:
+        enclosing = ""
+        for line in reversed(lines[: v.line]):
+            stripped = line.strip()
+            if stripped.startswith(("def ", "async def ")):
+                enclosing = stripped.split("def ", 1)[1].split("(", 1)[0]
+                break
+        assert not enclosing.endswith("_is_fine"), (v.rule, v.line, enclosing)
+
+
+def test_jax_fixture_catches_each_rule():
+    counts = _rules_by_count(FIXTURES / "jax_bad.py")
+    assert counts["jit-host-sync"] == 3  # float(), .item(), np.asarray
+    assert counts["jit-side-effect"] == 1
+    assert counts["donated-buffer-reuse"] == 2  # decorator + wrapper forms
+
+
+def test_suppression_waives_only_the_named_rule():
+    report = lint_paths([FIXTURES / "suppressed.py"], protocol_checks=False)
+    assert len(report.suppressed) == 2  # named waiver + disable=all
+    # The waiver naming the wrong rule leaves its violation active AND is
+    # itself flagged as a stale marker.
+    assert sorted(v.rule for v in report.active) == [
+        "async-blocking-call",
+        "unused-suppression",
+    ]
+    assert len(report.suppression_sites) == 3
+
+
+def test_suppression_budget_counts_comment_sites():
+    report = lint_paths([FIXTURES / "suppressed.py"], protocol_checks=False)
+    report.violations = [v for v in report.violations if v.suppressed]
+    assert len(report.suppression_sites) == 3
+    assert report.ok(budget=3)
+    assert not report.ok(budget=2)  # budget exceeded == failure
+
+
+def test_unused_suppression_flagged_and_marker_in_string_ignored():
+    src = (
+        "import time\n"
+        "x = 1  # hypha-lint: disable=async-blocking-call\n"
+        's = "suppress with # hypha-lint: disable=swallowed-cancel"\n'
+    )
+    report = lint_source("x.py", src)
+    assert [v.rule for v in report.active] == ["unused-suppression"]
+    assert report.active[0].line == 2  # the string literal is NOT a marker
+    assert len(report.suppression_sites) == 1
+
+
+def test_missing_path_is_an_error_not_a_green():
+    report = lint_paths(["no/such/dir"], protocol_checks=False)
+    assert report.parse_errors and not report.ok()
+
+
+def test_undecodable_file_is_a_parse_error_not_a_crash(tmp_path):
+    bad = tmp_path / "latin.py"
+    bad.write_bytes(b"# -*- coding: latin-1 -*-\ns = '\xe9'\n")
+    nul = tmp_path / "nul.py"
+    nul.write_bytes(b"x = 1\x00\n")
+    utf = tmp_path / "ok.py"
+    utf.write_text("x = 1\n")
+    report = lint_paths([tmp_path], protocol_checks=False)
+    # latin-1 decodes fine via its PEP 263 cookie; the null byte errors;
+    # the walk continues past it either way.
+    assert any("nul.py" in e for e in report.parse_errors)
+    assert not any("ok.py" in e for e in report.parse_errors)
+
+
+def test_rule_filter_does_not_misfire_unused_suppression():
+    src = (
+        "import time\n"
+        "async def f():\n"
+        "    time.sleep(1)  # hypha-lint: disable=async-blocking-call\n"
+    )
+    report = lint_source("x.py", src, rules={"unused-suppression"})
+    assert not report.active  # the marker IS used, just filtered from view
+
+
+# ---------------------------------------------------- inline-source checks
+
+
+def test_blocking_call_in_nested_sync_def_not_flagged():
+    src = (
+        "import time, asyncio\n"
+        "async def outer():\n"
+        "    def inner():\n"
+        "        time.sleep(1)\n"
+        "    await asyncio.to_thread(inner)\n"
+    )
+    assert not lint_source("x.py", src).active
+
+
+def test_lock_from_enclosing_frame_not_held_in_nested_def():
+    src = (
+        "import asyncio\n"
+        "async def outer(lock, node):\n"
+        "    async with lock:\n"
+        "        async def later():\n"
+        "            await node.request('p', '/x', None)\n"
+        "        return later\n"
+    )
+    assert not lint_source("x.py", src).active
+
+
+def test_parse_error_reported_not_raised():
+    report = lint_source("bad.py", "def broken(:\n")
+    assert report.parse_errors and not report.ok()
+
+
+def test_every_rule_documented():
+    fixture_rules = set()
+    for f in (FIXTURES / "async_bad.py", FIXTURES / "jax_bad.py"):
+        fixture_rules |= set(_rules_by_count(f))
+    for rule in fixture_rules:
+        assert rule in RULES
+    dev_doc = (REPO / "docs" / "development.md").read_text()
+    for rule in RULES:
+        assert rule in dev_doc, f"rule {rule} missing from docs/development.md"
+
+
+# -------------------------------------------------------- protocol family
+
+
+def test_proto_roundtrip_catches_seeded_bad_class():
+    @dataclasses.dataclass
+    class Broken:
+        values: set = dataclasses.field(default_factory=set)  # CBOR can't
+
+    bad = proto_rules.check_roundtrip(registry={"Broken": Broken})
+    assert [v.rule for v in bad] == ["msg-roundtrip"]
+
+
+def test_proto_round_tag_catches_seeded_bad_class():
+    @dataclasses.dataclass
+    class Push:
+        job_id: str = ""
+
+    bad = proto_rules.check_round_tags(
+        registry={"Push": Push}, required=frozenset({"Push"})
+    )
+    assert [v.rule for v in bad] == ["msg-missing-round-tag"]
+
+
+def test_proto_round_tag_catches_renamed_required_class():
+    bad = proto_rules.check_round_tags(
+        registry={}, required=frozenset({"RenamedAway"})
+    )
+    assert [v.rule for v in bad] == ["msg-missing-round-tag"]
+    assert "REQUIRES_ROUND_TAG" in bad[0].message
+
+
+def test_proto_manifest_catches_stale_value_vocabulary():
+    bad = proto_rules.check_protocol_map(
+        registry={}, manifest={}, values={"GhostValue"}
+    )
+    assert [v.rule for v in bad] == ["msg-unmapped-protocol"]
+    assert "stale" in bad[0].message
+
+
+def test_proto_manifest_catches_unclaimed_and_stale():
+    @dataclasses.dataclass
+    class Orphan:
+        x: int = 0
+
+    bad = proto_rules.check_protocol_map(
+        registry={"Orphan": Orphan},
+        manifest={"/p/1": ("Ghost",)},
+        values=set(),
+    )
+    assert sorted(v.rule for v in bad) == [
+        "msg-unmapped-protocol",
+        "msg-unmapped-protocol",
+    ]
+
+
+def test_proto_suppression_matches_decorator_block_and_class_line():
+    @dataclasses.dataclass  # hypha-lint: disable=msg-roundtrip
+    class DecoratorWaived:
+        x: int = 0
+
+    @dataclasses.dataclass
+    class ClassLineWaived:  # hypha-lint: disable=msg-roundtrip
+        x: int = 0
+
+    @dataclasses.dataclass
+    class NotWaived:
+        x: int = 0
+
+    assert proto_rules._suppressed_on_def(DecoratorWaived, "msg-roundtrip")
+    assert proto_rules._suppressed_on_def(ClassLineWaived, "msg-roundtrip")
+    assert not proto_rules._suppressed_on_def(ClassLineWaived, "msg-missing-round-tag")
+    assert not proto_rules._suppressed_on_def(NotWaived, "msg-roundtrip")
+
+
+def test_sample_instance_covers_every_registered_message():
+    from hypha_tpu import messages
+    from hypha_tpu.ft import membership  # noqa: F401  (registers FT types)
+
+    for name, cls in sorted(messages.wire_registry().items()):
+        sample = proto_rules.sample_instance(cls)
+        assert isinstance(sample, cls), name
+
+
+# ------------------------------------------------------- the real package
+
+
+def test_package_is_lint_clean():
+    """The acceptance invariant, in-process: zero unsuppressed violations
+    and the suppression budget holds over hypha_tpu/."""
+    report = lint_paths([PACKAGE], protocol_checks=True)
+    assert not report.parse_errors, report.parse_errors
+    assert not report.active, "\n".join(v.render() for v in report.active)
+    assert len(report.suppression_sites) <= DEFAULT_SUPPRESSION_BUDGET
+
+
+def test_cli_exits_zero_on_package():
+    proc = subprocess.run(
+        [sys.executable, "-m", "hypha_tpu.analysis", str(PACKAGE)],
+        capture_output=True,
+        text=True,
+        cwd=REPO,
+        timeout=120,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+
+
+def test_cli_exits_nonzero_on_fixture():
+    proc = subprocess.run(
+        [
+            sys.executable,
+            "-m",
+            "hypha_tpu.analysis",
+            "--no-proto",
+            str(FIXTURES / "async_bad.py"),
+        ],
+        capture_output=True,
+        text=True,
+        cwd=REPO,
+        timeout=60,
+    )
+    assert proc.returncode == 1
+    assert "swallowed-cancel" in proc.stdout
+
+
+def test_cli_rule_filter_and_listing():
+    proc = subprocess.run(
+        [sys.executable, "-m", "hypha_tpu.analysis", "--list-rules"],
+        capture_output=True,
+        text=True,
+        cwd=REPO,
+        timeout=60,
+    )
+    assert proc.returncode == 0
+    for rule in RULES:
+        assert rule in proc.stdout
+    only = subprocess.run(
+        [
+            sys.executable,
+            "-m",
+            "hypha_tpu.analysis",
+            "--no-proto",
+            "--rule",
+            "task-black-hole",
+            str(FIXTURES / "async_bad.py"),
+        ],
+        capture_output=True,
+        text=True,
+        cwd=REPO,
+        timeout=60,
+    )
+    assert only.returncode == 1
+    assert "task-black-hole" in only.stdout
+    assert "swallowed-cancel" not in only.stdout
+
+
+def test_file_source_suppression_parsing():
+    src = FileSource(
+        "s.py",
+        "x = 1  # hypha-lint: disable=a, b\n"
+        "y = 2  # hypha-lint: disable=all\n"
+        "z = 3\n",
+    )
+    assert src.suppressed_at(1, "a") and src.suppressed_at(1, "b")
+    assert not src.suppressed_at(1, "c")
+    assert src.suppressed_at(2, "anything")
+    assert not src.suppressed_at(3, "a")
