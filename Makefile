@@ -3,7 +3,7 @@
 
 PYTHON ?= python
 
-.PHONY: lint lint-fixtures test
+.PHONY: lint lint-fixtures test compressbench
 
 lint:
 	$(PYTHON) -m hypha_tpu.analysis hypha_tpu/
@@ -25,3 +25,9 @@ lint-fixtures:
 test:
 	JAX_PLATFORMS=cpu $(PYTHON) -m pytest tests/ -q -m 'not slow' \
 		--continue-on-collection-errors -p no:cacheprovider
+
+# Compressed delta transport: bytes-on-wire / wall-clock / fidelity per
+# delta_codec (docs/performance.md "Quantized delta transport").
+compressbench:
+	JAX_PLATFORMS=cpu $(PYTHON) benchmarks/compressbench.py \
+		--out COMPRESSBENCH_r06.json

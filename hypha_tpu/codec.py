@@ -69,7 +69,14 @@ def _encode(fp: BytesIO, obj: Any, depth: int = 0) -> None:
             _head(fp, 1, -1 - obj)
     elif isinstance(obj, float):
         fp.write(b"\xfb" + struct.pack(">d", obj))
-    elif isinstance(obj, (bytes, bytearray, memoryview)):
+    elif isinstance(obj, bytes):
+        # No defensive copy: a large byte-string frame (e.g. a quantized
+        # delta header's payload) writes straight through.
+        _head(fp, 2, len(obj))
+        fp.write(obj)
+    elif isinstance(obj, (bytearray, memoryview)):
+        # Mutable/view types still copy once — len(memoryview) counts
+        # elements, not bytes, for non-'B' formats, so bytes() normalizes.
         b = bytes(obj)
         _head(fp, 2, len(b))
         fp.write(b)

@@ -1,0 +1,75 @@
+"""Compressed delta transport for the DiLoCo outer round.
+
+DiLoCo's premise is that outer synchronization is rare enough to tolerate
+slow links; this package makes each synchronization cheap too. Streaming
+DiLoCo (Douillard et al., 2025, PAPERS.md) shows outer pseudo-gradients
+survive 4-8x quantization *when the quantization error is fed back*: each
+end accumulates the error it introduced into the next round's payload, so
+the compressed trajectory provably tracks the uncompressed one (the
+residual never compounds — it is re-shipped, not dropped).
+
+Pieces:
+
+  * :mod:`quant`    — chunkwise int8 / packed-int4 quantization with
+    per-chunk max-abs f32 scales. Native C++ kernel
+    (native/hypha_quant.cpp) with a numpy fallback that is BIT-EXACT
+    against it (parity pinned by tests, like the CBOR codec pair).
+  * :mod:`frame`    — the self-describing HQD1 wire container: magic +
+    CBOR header (codec, chunk, tensor table) + packed payload. A receiver
+    needs no out-of-band schema; plain SafeTensors files pass through
+    :func:`read_delta` untouched, so codecs interoperate per job.
+  * :mod:`feedback` — the :class:`ErrorFeedback` residual accumulator used
+    on BOTH ends: the worker folds its quantization error into the next
+    round's delta, the parameter server folds broadcast quantization error
+    into the next outer update.
+
+Codec selection is per job via ``JobSpec.delta_codec``
+(none | bf16 | int8 | int4), superseding the older ``delta_dtype`` field
+(which maps onto the bf16 codec for back-compat).
+"""
+
+from __future__ import annotations
+
+from .feedback import ErrorFeedback
+from .frame import MAGIC, is_frame, read_delta, read_frame, write_delta, write_frame
+from .quant import DEFAULT_CHUNK, dequantize, quantize
+
+__all__ = [
+    "CODECS",
+    "QUANT_CODECS",
+    "DEFAULT_CHUNK",
+    "MAGIC",
+    "ErrorFeedback",
+    "effective_codec",
+    "quantize",
+    "dequantize",
+    "write_frame",
+    "read_frame",
+    "read_delta",
+    "write_delta",
+    "is_frame",
+]
+
+# Every per-job wire codec. "none" ships f32 SafeTensors (the seed format),
+# "bf16" casts to bfloat16 SafeTensors (the old delta_dtype behavior), and
+# the quantized pair ship HQD1 frames.
+CODECS = ("none", "bf16", "int8", "int4")
+
+# Codecs that quantize (and therefore want error feedback).
+QUANT_CODECS = ("int8", "int4")
+
+
+def effective_codec(delta_codec: str, delta_dtype: str = "float32") -> str:
+    """Resolve the job's wire codec, honoring the legacy ``delta_dtype``.
+
+    ``delta_codec`` wins when set to anything but "none"; otherwise
+    ``delta_dtype="bfloat16"`` keeps selecting the bf16 wire format so
+    pre-codec job specs behave exactly as before.
+    """
+    if delta_codec not in CODECS:
+        raise ValueError(
+            f"delta_codec must be one of {'|'.join(CODECS)}, got {delta_codec!r}"
+        )
+    if delta_codec == "none" and delta_dtype == "bfloat16":
+        return "bf16"
+    return delta_codec

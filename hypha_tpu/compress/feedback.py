@@ -1,0 +1,65 @@
+"""Error-feedback residuals for quantized delta transport.
+
+The recurrence (Streaming DiLoCo, Douillard et al., 2025; Seide et al.,
+2014 for the original 1-bit SGD form):
+
+    send_t     = Q(x_t + e_t)            # what goes on the wire
+    e_{t+1}    = (x_t + e_t) - send_t    # the error, kept locally
+
+Nothing is ever dropped — error the quantizer introduced in round ``t``
+rides in round ``t+1``'s payload, so the SUM of transmitted tensors tracks
+the sum of true tensors to within one round's quantization error, and the
+compressed run provably tracks the uncompressed one instead of drifting.
+
+Both transport ends hold one of these: the worker over its shipped
+pseudo-gradients, the parameter server over its broadcast outer updates.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["ErrorFeedback"]
+
+
+class ErrorFeedback:
+    """One f32 residual tree, keyed like the flat delta dicts."""
+
+    def __init__(self) -> None:
+        self._residual: dict[str, np.ndarray] = {}
+
+    def compensate(self, flat: dict[str, np.ndarray]) -> dict[str, np.ndarray]:
+        """``x_t + e_t`` as fresh f32 arrays (inputs are never mutated)."""
+        out: dict[str, np.ndarray] = {}
+        for name, value in flat.items():
+            v = np.asarray(value, np.float32)
+            r = self._residual.get(name)
+            if r is not None and r.shape != v.shape:
+                # A reshaped tensor between rounds (job restart mid-stream)
+                # invalidates the stored error; dropping it only costs one
+                # round's compensation.
+                r = None
+            out[name] = v + r if r is not None else v.copy()
+        return out
+
+    def absorb(
+        self,
+        compensated: dict[str, np.ndarray],
+        decoded: dict[str, np.ndarray],
+    ) -> None:
+        """Store ``e_{t+1} = compensated - Q(compensated)`` per tensor."""
+        residual: dict[str, np.ndarray] = {}
+        for name, comp in compensated.items():
+            d = np.asarray(decoded[name], np.float32)
+            if d.shape != comp.shape and d.size == comp.size:
+                # Scalars travel as (1,) in the frame (SafeTensors-style).
+                d = d.reshape(comp.shape)
+            residual[name] = comp - d
+        self._residual = residual
+
+    def reset(self) -> None:
+        self._residual.clear()
+
+    @property
+    def tensors(self) -> int:
+        return len(self._residual)

@@ -1,0 +1,203 @@
+"""HQD1: the self-describing compressed-delta wire container.
+
+Layout (little-endian):
+
+    bytes 0..3   magic ``HQD1``
+    bytes 4..7   u32 header length H
+    bytes 8..8+H CBOR header map:
+        {"codec": "int8"|"int4", "chunk": int,
+         "tensors": [{"name": str, "shape": [int, ...],
+                      "qoff": int, "qlen": int,
+                      "soff": int, "slen": int}, ...]}
+    payload      concatenated per-tensor quantized bytes + f32 scale
+                 arrays; every offset is relative to the payload start.
+
+The header rides the repo's own CBOR codec (hypha_tpu.codec — native
+extension when available), so the format needs no new dependency and a
+receiver needs no out-of-band schema: codec, chunking and the tensor
+table all travel in-band. SafeTensors files fail the magic check, which
+is how :func:`read_delta` lets quantized and plain deltas interoperate on
+the same stream.
+
+Writers emit via a temp name + ``os.replace`` so a crashed writer never
+publishes a torn frame.
+"""
+
+from __future__ import annotations
+
+import os
+import struct
+from pathlib import Path
+from typing import Any
+
+import numpy as np
+
+from .. import codec as cbor
+from .quant import DEFAULT_CHUNK, dequantize, quantize
+
+__all__ = [
+    "MAGIC",
+    "is_frame",
+    "write_frame",
+    "read_frame",
+    "read_delta",
+    "write_delta",
+]
+
+MAGIC = b"HQD1"
+
+# Header sanity bound for untrusted input: a tensor table bigger than this
+# is a malformed/hostile frame, not a real delta.
+_MAX_HEADER = 64 * 1024 * 1024
+
+
+def is_frame(path: Path | str) -> bool:
+    """True when ``path`` starts with the HQD1 magic."""
+    try:
+        with open(path, "rb") as fp:
+            return fp.read(4) == MAGIC
+    except OSError:
+        return False
+
+
+def write_frame(
+    path: Path | str,
+    flat: dict[str, np.ndarray],
+    codec: str,
+    chunk: int = DEFAULT_CHUNK,
+) -> dict[str, np.ndarray]:
+    """Quantize ``flat`` and write one HQD1 frame atomically.
+
+    Returns the DEQUANTIZED tree — exactly what a receiver will decode —
+    so the caller can compute its error-feedback residual without
+    re-reading the file.
+    """
+    path = Path(path)
+    table: list[dict[str, Any]] = []
+    chunks: list[bytes] = []
+    decoded: dict[str, np.ndarray] = {}
+    off = 0
+    for name, arr in flat.items():
+        a = np.ascontiguousarray(np.atleast_1d(np.asarray(arr, np.float32)))
+        payload, scales = quantize(a.ravel(), codec, chunk)
+        decoded[name] = dequantize(payload, scales, a.size, codec, chunk).reshape(
+            a.shape
+        )
+        qb, sb = payload.tobytes(), scales.tobytes()
+        table.append(
+            {
+                "name": name,
+                "shape": list(a.shape),
+                "qoff": off,
+                "qlen": len(qb),
+                "soff": off + len(qb),
+                "slen": len(sb),
+            }
+        )
+        chunks.append(qb)
+        chunks.append(sb)
+        off += len(qb) + len(sb)
+    header = cbor.dumps({"codec": codec, "chunk": chunk, "tensors": table})
+    tmp = path.with_name(path.name + f".tmp.{os.getpid()}")
+    with open(tmp, "wb") as fp:
+        fp.write(MAGIC)
+        fp.write(struct.pack("<I", len(header)))
+        fp.write(header)
+        for blob in chunks:
+            fp.write(blob)
+    os.replace(tmp, path)
+    return decoded
+
+
+def read_frame(path: Path | str) -> dict[str, np.ndarray]:
+    """Decode one HQD1 frame → {name: f32 ndarray}."""
+    with open(path, "rb") as fp:
+        data = fp.read()
+    if data[:4] != MAGIC:
+        raise ValueError(f"{path}: not an HQD1 frame")
+    if len(data) < 8:
+        raise ValueError(f"{path}: truncated frame header")
+    (hlen,) = struct.unpack("<I", data[4:8])
+    if hlen > _MAX_HEADER or 8 + hlen > len(data):
+        raise ValueError(f"{path}: header length {hlen} exceeds frame")
+    header = cbor.loads(data[8 : 8 + hlen])
+    if not isinstance(header, dict):
+        raise ValueError(f"{path}: malformed frame header")
+    codec = header.get("codec")
+    chunk = header.get("chunk")
+    table = header.get("tensors")
+    if not isinstance(chunk, int) or not isinstance(table, list):
+        raise ValueError(f"{path}: malformed frame header")
+    payload = memoryview(data)[8 + hlen :]
+    out: dict[str, np.ndarray] = {}
+    for entry in table:
+        name = entry["name"]
+        shape = tuple(int(d) for d in entry["shape"])
+        n = int(np.prod(shape, dtype=np.int64)) if shape else 1
+        qoff, qlen = int(entry["qoff"]), int(entry["qlen"])
+        soff, slen = int(entry["soff"]), int(entry["slen"])
+        if qoff < 0 or soff < 0 or qoff + qlen > len(payload) or soff + slen > len(payload):
+            raise ValueError(f"{path}: tensor {name!r} spans outside payload")
+        q = np.frombuffer(payload[qoff : qoff + qlen], np.uint8)
+        scales = np.frombuffer(payload[soff : soff + slen], np.float32)
+        out[name] = dequantize(q, scales, n, codec, chunk).reshape(shape)
+    return out
+
+
+def write_delta(
+    path: Path | str,
+    flat: dict[str, np.ndarray],
+    codec: str,
+    chunk: int = DEFAULT_CHUNK,
+    ef=None,
+) -> dict[str, np.ndarray]:
+    """The one send-side entry point: encode ``flat`` per ``codec``.
+
+    int8/int4 write an HQD1 frame — compensated through ``ef``
+    (:class:`~hypha_tpu.compress.feedback.ErrorFeedback`) when given, so
+    the quantization error rides the next send. bf16 casts f32 tensors
+    (others pass through) into SafeTensors; "none" writes f32 SafeTensors.
+    Returns the tree AS A RECEIVER WILL DECODE IT (for residuals, catch-up
+    accounting, or tests).
+    """
+    from safetensors.numpy import save_file
+
+    if codec in ("int8", "int4"):
+        if ef is not None:
+            flat = ef.compensate(flat)
+        decoded = write_frame(path, flat, codec, chunk)
+        if ef is not None:
+            ef.absorb(flat, decoded)
+        return decoded
+    norm = {
+        k: np.ascontiguousarray(np.atleast_1d(np.asarray(v)))
+        for k, v in flat.items()
+    }
+    if codec == "bf16":
+        # ml_dtypes ships with jax; lazy so stripped PS hosts without the
+        # bf16 codec configured never import it.
+        import ml_dtypes
+
+        norm = {
+            k: v.astype(ml_dtypes.bfloat16) if v.dtype == np.float32 else v
+            for k, v in norm.items()
+        }
+    elif codec != "none":
+        raise ValueError(f"unknown wire codec {codec!r}")
+    save_file(norm, str(path))
+    return norm
+
+
+def read_delta(path: Path | str) -> dict[str, np.ndarray]:
+    """Read a delta/update file in ANY per-job wire format.
+
+    HQD1 frames dequantize to f32; everything else is SafeTensors (f32 or
+    bf16 — callers widen per tensor as they always did). This is the one
+    receive-side entry point, so a job's codec choice never needs to reach
+    the decoder out-of-band.
+    """
+    if is_frame(path):
+        return read_frame(path)
+    from safetensors.numpy import load_file
+
+    return dict(load_file(str(path)))

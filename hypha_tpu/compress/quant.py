@@ -1,0 +1,152 @@
+"""Chunkwise max-abs quantization kernels (int8, packed int4).
+
+The tensor is flattened and cut into ``chunk``-element spans; each span
+gets one f32 scale ``maxabs / qmax`` and its values round to
+``rint(v * qmax / maxabs)`` clamped to ±qmax (half-to-even, numpy's
+``np.rint`` and C's default ``nearbyintf`` agree). int4 packs two
+two's-complement nibbles per byte (element ``2j`` in the low nibble),
+independent of chunking, so payload size is ``ceil(n/2)`` bytes.
+
+Native/numpy parity is BIT-EXACT by construction: both paths compute the
+same f32 operations in the same order (``inv = qmax / maxabs`` once per
+chunk, then ``rint(v * inv)`` per element — a bare product, so FMA
+contraction cannot reassociate it), and the parity corpus in
+tests/test_compress.py pins it the way the CBOR corpus pins the codec pair.
+
+A chunk whose max-abs is zero or non-finite (NaN propagates through the
+max like ``np.max``) encodes as all-zeros with a zero scale: deterministic
+on both paths, no non-finite value ever reaches an int cast, and a
+NaN/Inf delta degrades to "this span contributed nothing" instead of
+poisoning the aggregate.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from .. import native
+
+__all__ = ["DEFAULT_CHUNK", "QMAX", "quantize", "dequantize", "payload_nbytes"]
+
+# Span per f32 scale. 4096 keeps scale overhead at 0.1% of an int8 payload
+# while staying well inside L1 for the kernel's two passes.
+DEFAULT_CHUNK = 4096
+
+QMAX = {"int8": 127.0, "int4": 7.0}
+
+
+def _check(codec: str, chunk: int) -> None:
+    if codec not in QMAX:
+        raise ValueError(f"quantizing codec must be int8|int4, got {codec!r}")
+    if chunk <= 0:
+        raise ValueError(f"chunk must be positive, got {chunk}")
+    if codec == "int4" and chunk % 2:
+        raise ValueError(f"int4 chunk must be even, got {chunk}")
+
+
+def payload_nbytes(n: int, codec: str) -> int:
+    """Quantized payload size for ``n`` elements."""
+    return n if codec == "int8" else (n + 1) // 2
+
+
+def quantize(
+    src: np.ndarray, codec: str, chunk: int = DEFAULT_CHUNK
+) -> tuple[np.ndarray, np.ndarray]:
+    """Quantize a flat f32 array → (payload uint8, per-chunk f32 scales)."""
+    _check(codec, chunk)
+    a = np.ascontiguousarray(np.asarray(src, np.float32)).ravel()
+    n = a.size
+    nchunks = max((n + chunk - 1) // chunk, 1) if n else 0
+    payload = np.zeros(payload_nbytes(n, codec), np.uint8)
+    scales = np.zeros(nchunks, np.float32)
+    if n == 0:
+        return payload, scales
+    if native.quant_chunks(a, chunk, codec, payload, scales):
+        return payload, scales
+    _np_quantize(a, chunk, codec, payload, scales)
+    return payload, scales
+
+
+def dequantize(
+    payload: np.ndarray,
+    scales: np.ndarray,
+    n: int,
+    codec: str,
+    chunk: int = DEFAULT_CHUNK,
+) -> np.ndarray:
+    """Invert :func:`quantize` → flat f32 array of ``n`` elements."""
+    _check(codec, chunk)
+    q = np.ascontiguousarray(np.asarray(payload, np.uint8)).ravel()
+    s = np.ascontiguousarray(np.asarray(scales, np.float32)).ravel()
+    if q.size != payload_nbytes(n, codec):
+        raise ValueError(
+            f"{codec} payload is {q.size} bytes; {n} elements need "
+            f"{payload_nbytes(n, codec)}"
+        )
+    if n and s.size != (n + chunk - 1) // chunk:
+        raise ValueError(
+            f"{s.size} scales for {n} elements at chunk {chunk} "
+            f"(need {(n + chunk - 1) // chunk})"
+        )
+    dst = np.empty(n, np.float32)
+    if n == 0:
+        return dst
+    if native.dequant_chunks(q, s, n, chunk, codec, dst):
+        return dst
+    _np_dequantize(q, s, n, chunk, codec, dst)
+    return dst
+
+
+# ------------------------------------------------------------ numpy path
+#
+# The semantic spec the native kernel must match bit-for-bit. Every f32
+# operation below has a literal twin in native/hypha_quant.cpp.
+
+
+def _chunk_view(a: np.ndarray, chunk: int) -> tuple[np.ndarray, int]:
+    """Zero-pad to whole chunks and reshape (nchunks, chunk)."""
+    n = a.size
+    nchunks = (n + chunk - 1) // chunk
+    if n == nchunks * chunk:
+        return a.reshape(nchunks, chunk), nchunks
+    padded = np.zeros(nchunks * chunk, np.float32)
+    padded[:n] = a
+    return padded.reshape(nchunks, chunk), nchunks
+
+
+def _np_quantize(
+    a: np.ndarray, chunk: int, codec: str, payload: np.ndarray, scales: np.ndarray
+) -> None:
+    qmax = np.float32(QMAX[codec])
+    view, _ = _chunk_view(a, chunk)
+    with np.errstate(invalid="ignore"):  # Inf·0 in a degraded chunk is expected
+        maxabs = np.max(np.abs(view), axis=1).astype(np.float32)  # NaN propagates
+        ok = np.isfinite(maxabs) & (maxabs > 0)
+        inv = np.divide(qmax, maxabs, where=ok, out=np.zeros_like(maxabs))
+        scales[:] = np.divide(maxabs, qmax, where=ok, out=np.zeros_like(maxabs))
+        # Zero not-ok chunks explicitly: a NaN element must never reach the
+        # int cast (platform noise in numpy, UB in the C++ twin).
+        q = np.clip(np.rint(view * inv[:, None]), -qmax, qmax)
+        q = np.where(ok[:, None], q, np.float32(0)).astype(np.int8).ravel()[: a.size]
+    if codec == "int8":
+        payload[:] = q.view(np.uint8)
+    else:
+        nib = (q & 0xF).astype(np.uint8)
+        if nib.size % 2:
+            nib = np.append(nib, np.uint8(0))
+        payload[:] = nib[0::2] | (nib[1::2] << 4)
+
+
+def _np_dequantize(
+    q: np.ndarray, scales: np.ndarray, n: int, chunk: int, codec: str, dst: np.ndarray
+) -> None:
+    if codec == "int8":
+        vals = q.view(np.int8).astype(np.float32)
+    else:
+        nib = np.empty(q.size * 2, np.uint8)
+        nib[0::2] = q & 0xF
+        nib[1::2] = q >> 4
+        # Sign-extend the 4-bit two's complement nibble.
+        vals = ((nib.astype(np.int16) ^ 8) - 8).astype(np.float32)[:n]
+    per_elem = np.repeat(scales, chunk)[:n]
+    dst[:] = vals[:n] * per_elem

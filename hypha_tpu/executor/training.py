@@ -48,8 +48,9 @@ from ..messages import (
     ProgressResponseKind,
     TrainExecutorConfig,
 )
+from .. import compress
 from .diloco import apply_updates, extract_delta, merge_update
-from .serialization import load_flat, save_tree, unflatten_like
+from .serialization import flatten_tree, unflatten_like
 from .train import TrainState, build_optimizer, make_train_step
 
 __all__ = ["run_training", "main", "TrainResult"]
@@ -446,6 +447,16 @@ def run_training(
     round_num = 0
     round_samples = 0
     round_losses: list[float] = []
+    # Outer-round wire codec (hypha_tpu.compress): delta_codec wins, the
+    # legacy delta_dtype="bfloat16" maps onto the bf16 codec. Quantized
+    # codecs carry an error-feedback residual across rounds so the
+    # compressed trajectory tracks the uncompressed one.
+    wire_codec = compress.effective_codec(
+        getattr(cfg, "delta_codec", "none"), cfg.delta_dtype
+    )
+    delta_ef = (
+        compress.ErrorFeedback() if wire_codec in compress.QUANT_CODECS else None
+    )
 
     if getattr(cfg, "rejoin", False):
         # Elastic rejoin (hypha_tpu.ft.rejoin): this replica was dispatched
@@ -468,7 +479,7 @@ def run_training(
             catchup = await_catchup(events, on_skip=_drop)
         meta = catchup.get("meta") or {}
         catchup_file = work_dir / catchup["path"]
-        flat = load_flat(catchup_file)
+        flat = compress.read_delta(catchup_file)
         if flat:
             update = unflatten_like(flat, state.params)
             state = state.replace(params=apply_updates(state.params, [update]))
@@ -504,19 +515,15 @@ def run_training(
         else:
             delta = extract_delta(state.params, anchor)
             host_delta = jax.device_get(delta)
-        if cfg.delta_dtype == "bfloat16":
-            # bf16 wire format: halves the upload; the PS accumulates in
-            # f32 (worker/ps_executor.py + native kernel both widen).
-            import ml_dtypes
-
-            host_delta = jax.tree.map(
-                lambda a: np.asarray(a).astype(ml_dtypes.bfloat16)
-                if np.asarray(a).dtype == np.float32
-                else np.asarray(a),
-                host_delta,
-            )
         delta_path = work_dir / f"delta-{round_num}.safetensors"
-        save_tree(delta_path, host_delta)
+        # One send-side entry point for every codec (hypha_tpu.compress):
+        # int8/int4 ship Q(Δθ + e) as an HQD1 frame and keep
+        # e' = (Δθ + e) − Q(Δθ + e) for the next round (quantization error
+        # is re-shipped, never dropped); bf16 halves the upload; the PS
+        # widens/accumulates in f32 in every case.
+        compress.write_delta(
+            delta_path, flatten_tree(host_delta), wire_codec, ef=delta_ef
+        )
         session.send_resource(
             cfg.updates,
             delta_path.name,
@@ -548,7 +555,9 @@ def run_training(
                 "results stream ended before the round's update broadcast"
             )
         update_file = work_dir / event["path"]
-        flat = load_flat(update_file)
+        # read_delta sniffs the format: a quantized (HQD1) broadcast
+        # dequantizes to f32, a SafeTensors one loads as before.
+        flat = compress.read_delta(update_file)
         if mh is not None:
             # followers mirror the merge dispatch; bounded like the step
             # broadcasts — a lost follower must fail the job, not hang it

@@ -34,7 +34,9 @@ from pathlib import Path
 from typing import Any, Callable, Iterable, Iterator
 
 import numpy as np
-from safetensors.numpy import load_file, save_file
+from safetensors.numpy import save_file
+
+from ..compress import read_delta
 
 __all__ = ["CatchupBuffer", "await_catchup", "CATCHUP_KEY"]
 
@@ -57,8 +59,19 @@ class CatchupBuffer:
         self._written: tuple[int, str] | None = None  # (rounds, path) cache
 
     def accumulate(self, update_path: Path | str) -> None:
-        """Fold one round's update file into the running sum."""
-        update = load_file(str(update_path))
+        """Fold one round's update file into the running sum.
+
+        Decode-aware (hypha_tpu.compress.read_delta): a quantized (HQD1)
+        or bf16 broadcast accumulates at its DECODED values — what every
+        worker actually merged — so θ₀ + Σ reproduces their params
+        exactly regardless of wire codec.
+        """
+        self.accumulate_tree(read_delta(update_path))
+
+    def accumulate_tree(self, update: dict) -> None:
+        """Fold one round's already-decoded update tree into the sum (the
+        PS's broadcast encode returns exactly this tree — re-reading the
+        parameter-sized wire file would be pure waste)."""
         for key, value in update.items():
             arr = np.asarray(value, np.float32)
             prev = self._cum.get(key)

@@ -488,7 +488,16 @@ class TrainExecutorConfig:
     # f32 for the weighted mean and keeps momentum/update f32, so only the
     # shipped differences round — not the compounding outer state. Additive
     # field: absent on the wire = f32, old peers interop.
+    # Superseded by delta_codec below; kept for wire compat (an old
+    # scheduler's bfloat16 spec still selects the bf16 codec).
     delta_dtype: str = "float32"
+    # Per-job wire codec for shipped Δθ (hypha_tpu.compress):
+    # none | bf16 | int8 | int4. The quantized codecs ship chunkwise
+    # max-abs HQD1 frames with error-feedback residuals on both transport
+    # ends (~4x / ~8x smaller than f32). Receivers sniff the frame magic,
+    # so this field only configures the SENDING side. Additive field:
+    # absent on the wire = none (delta_dtype governs), old peers interop.
+    delta_codec: str = "none"
     # Elastic membership (hypha_tpu.ft): a replacement worker dispatched
     # mid-job. It initializes from the model seed, then blocks on its
     # results stream for the parameter server's catch-up push (cumulative
@@ -520,6 +529,13 @@ class AggregateExecutorConfig:
     # semantics, old peers interop.
     quorum_fraction: float = 0.0
     round_deadline_s: float = 0.0
+    # Wire codec for the BROADCAST update (hypha_tpu.compress):
+    # none | bf16 | int8 | int4, normally mirroring the train side's
+    # delta_codec. Quantized broadcasts carry their own error-feedback
+    # residual on the PS, and the rejoin catch-up sum accumulates the
+    # DECODED update — what workers actually merged — so θ_r stays exact.
+    # Additive field: absent on the wire = f32 broadcast, old peers interop.
+    delta_codec: str = "none"
 
 
 @register

@@ -11,7 +11,10 @@ Nesterov over mmapped SafeTensors). The C++ equivalents live in
     parser), writer, and ``ps_outer_step``: the WHOLE outer step over the
     delta files, zero-copy;
   * ``hypha_io.cpp``          — sendfile(2) file→socket fast path for bulk
-    tensor serving (the data node's io::copy role, tensor_data.rs:8-16).
+    tensor serving (the data node's io::copy role, tensor_data.rs:8-16);
+  * ``hypha_quant.cpp``       — chunkwise int8/int4 quantization for the
+    compressed delta transport (hypha_tpu.compress), bit-exact against
+    the numpy fallback there.
 
 Everything is compiled on first use with the system g++ into one shared
 library and cached. Environments without a toolchain transparently fall
@@ -35,6 +38,8 @@ __all__ = [
     "ps_outer_step",
     "send_file_fd",
     "SafeTensorsView",
+    "quant_chunks",
+    "dequant_chunks",
 ]
 
 log = logging.getLogger("hypha.native")
@@ -44,6 +49,7 @@ _SRCS = [
     _REPO / "native" / "hypha_ps.cpp",
     _REPO / "native" / "hypha_safetensors.cpp",
     _REPO / "native" / "hypha_io.cpp",
+    _REPO / "native" / "hypha_quant.cpp",
 ]
 _SO = _REPO / "native" / "build" / "libhypha_native.so"
 
@@ -103,6 +109,15 @@ def _load() -> ctypes.CDLL | None:
         lib.ps_outer_step.restype = ctypes.c_int64
         lib.send_file_fd.argtypes = [ctypes.c_int, ctypes.c_char_p]
         lib.send_file_fd.restype = ctypes.c_int64
+        _U8P = ctypes.POINTER(ctypes.c_uint8)
+        lib.quant_chunks_f32.argtypes = [
+            _F32P, ctypes.c_int64, ctypes.c_int64, ctypes.c_int, _U8P, _F32P,
+        ]
+        lib.quant_chunks_f32.restype = ctypes.c_int64
+        lib.dequant_chunks_f32.argtypes = [
+            _U8P, _F32P, ctypes.c_int64, ctypes.c_int64, ctypes.c_int, _F32P,
+        ]
+        lib.dequant_chunks_f32.restype = ctypes.c_int64
         _lib = lib
     except (subprocess.SubprocessError, OSError, FileNotFoundError) as e:
         log.info("native kernels unavailable (%s); using numpy", e)
@@ -319,6 +334,49 @@ def ps_outer_step(
     if total < 0:
         raise ValueError(f"ps_outer_step failed: {err.value.decode()}")
     return int(total)
+
+
+_QUANT_BITS = {"int8": 8, "int4": 4}
+
+
+def _u8ptr(a: np.ndarray) -> "ctypes._Pointer":
+    return a.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8))
+
+
+def quant_chunks(
+    src: np.ndarray, chunk: int, codec: str,
+    payload_out: np.ndarray, scales_out: np.ndarray,
+) -> bool:
+    """Chunkwise quantize ``src`` (contiguous f32) in place into the
+    caller's payload/scales buffers. Returns False when the native library
+    is unavailable (caller runs the bit-exact numpy spec instead)."""
+    lib = _load()
+    if lib is None:
+        return False
+    wrote = lib.quant_chunks_f32(
+        _ptr(src), src.size, chunk, _QUANT_BITS[codec],
+        _u8ptr(payload_out), _ptr(scales_out),
+    )
+    if wrote < 0:
+        raise ValueError(f"quant_chunks_f32 rejected args (codec {codec})")
+    return True
+
+
+def dequant_chunks(
+    payload: np.ndarray, scales: np.ndarray, n: int, chunk: int, codec: str,
+    dst: np.ndarray,
+) -> bool:
+    """Invert :func:`quant_chunks` into ``dst`` (f32, ``n`` elements).
+    Returns False when the native library is unavailable."""
+    lib = _load()
+    if lib is None:
+        return False
+    got = lib.dequant_chunks_f32(
+        _u8ptr(payload), _ptr(scales), n, chunk, _QUANT_BITS[codec], _ptr(dst)
+    )
+    if got < 0:
+        raise ValueError(f"dequant_chunks_f32 rejected args (codec {codec})")
+    return True
 
 
 def send_file_fd(fd: int, path: str | Path) -> int | None:

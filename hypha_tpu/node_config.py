@@ -317,6 +317,13 @@ class JobSection:
         default=8.0,
         metadata={"doc": "phi-accrual suspicion threshold (Cassandra-style)"},
     )
+    delta_codec: str = field(
+        default="none",
+        metadata={
+            "doc": "outer-round wire codec: none | bf16 | int8 | int4 "
+            "(int8/int4 = chunkwise quantization + error feedback)"
+        },
+    )
 
     def validate(self) -> None:
         if self.kind not in ("train", "serve"):
@@ -341,6 +348,13 @@ class JobSection:
             raise ConfigError("job.max_attempts must be >= 1")
         if not 0.0 <= self.quorum_fraction <= 1.0:
             raise ConfigError("job.quorum_fraction must be in [0, 1]")
+        from .compress import CODECS
+
+        if self.delta_codec not in CODECS:
+            raise ConfigError(
+                f"job.delta_codec must be one of {'|'.join(CODECS)}, "
+                f"got {self.delta_codec!r}"
+            )
         if self.round_deadline_s < 0:
             raise ConfigError("job.round_deadline_s must be >= 0")
         if self.phi_threshold <= 0:
@@ -405,6 +419,7 @@ class JobSection:
             sharding=dict(self.sharding) or None,
             checkpoint_dir=self.checkpoint_dir or None,
             checkpoint_every=self.checkpoint_every,
+            delta_codec=self.delta_codec,
             ft=(
                 FTConfig(
                     quorum_fraction=self.quorum_fraction,

@@ -81,7 +81,15 @@ class DiLoCoJob:
     lora: dict | None = None
     # Wire dtype for shipped Δθ ("float32" | "bfloat16"): bf16 halves a 7B
     # round's upload; the PS accumulates/keeps state in f32 either way.
+    # Superseded by delta_codec — kept so existing specs keep working.
     delta_dtype: str = "float32"
+    # Wire codec for the outer round (hypha_tpu.compress):
+    # none | bf16 | int8 | int4. int8/int4 quantize chunkwise (per-chunk
+    # max-abs f32 scales, HQD1 frames) with error-feedback residuals on
+    # both ends — worker uploads AND the PS broadcast — cutting
+    # bytes-on-wire ~4x / ~8x vs f32 at no convergence cost. "none" defers
+    # to delta_dtype (back-compat).
+    delta_codec: str = "none"
     # Net-new checkpoint/resume: workers save under
     # <checkpoint_dir>/<peer_id>, the PS under <checkpoint_dir>/ps (paths are
     # per-host). Unset checkpoint_dir — or checkpoint_every <= 0 — disables.
@@ -97,6 +105,12 @@ class DiLoCoJob:
         if self.delta_dtype not in ("float32", "bfloat16"):
             raise ValueError(
                 f"delta_dtype must be float32|bfloat16, got {self.delta_dtype!r}"
+            )
+        from ..compress import CODECS
+
+        if self.delta_codec not in CODECS:
+            raise ValueError(
+                f"delta_codec must be {'|'.join(CODECS)}, got {self.delta_codec!r}"
             )
         if self.rounds.update_rounds <= 0:
             raise ValueError("update_rounds must be positive")

@@ -312,6 +312,7 @@ class Orchestrator:
                     sharding=job.sharding,
                     lora=job.lora,
                     delta_dtype=job.delta_dtype,
+                    delta_codec=job.delta_codec,
                     rejoin=rejoin,
                     checkpoint=(
                         {
@@ -456,6 +457,10 @@ class Orchestrator:
                             ),
                             quorum_fraction=ft.quorum_fraction if ft else 0.0,
                             round_deadline_s=ft.round_deadline_s if ft else 0.0,
+                            # The broadcast mirrors the upload codec: the
+                            # receive side sniffs frames, so one field is
+                            # enough for both directions.
+                            delta_codec=job.delta_codec,
                         ),
                     ),
                 ),

@@ -4,17 +4,26 @@ Reference: crates/worker/src/executor/parameter_server.rs — the one
 executor that is *not* an external process (config runtime=parameter-server,
 crates/worker/src/config.rs:135-141). It:
 
-  * receives pseudo-gradient SafeTensors files from workers over
-    push-streams, names hashed against path injection (:133-135);
-  * aggregates once ``num_workers`` updates arrive — here as a single
-    sample-weighted mean (fixing the reference's order-dependent pairwise
-    averaging TODO :192-194) with a per-round double-send guard (fixing
-    TODO :215-218);
+  * receives pseudo-gradient files from workers over push-streams (plain
+    or bf16 SafeTensors, or quantized HQD1 frames — hypha_tpu.compress
+    sniffs the format per file), names hashed against path injection
+    (:133-135);
+  * aggregates **incrementally**: each arriving delta is decoded and
+    folded into a running sample-weighted f32 partial sum off the event
+    loop, so by the time the round closes only the Nesterov step remains
+    — the PS no longer sits idle while deltas trickle in and then
+    re-reads them all (single weighted mean fixes the reference's
+    order-dependent pairwise averaging TODO :192-194; the per-round
+    double-send guard fixes TODO :215-218 by un-folding the replaced
+    delta);
   * applies the Nesterov outer step ``m ← μ·m + ḡ; update = lr·(μ·m + ḡ)``,
     golden-tested against torch SGD(nesterov=True) like the reference
     (:386-446, test :448-524);
   * broadcasts the **update tensor** (not full weights) to all workers
-    (:232-269) and notifies the scheduler ``Progress::Updated`` (:274-283).
+    with bounded-concurrency fan-out (the reference pushes one peer at a
+    time, :232-269) — quantized per the job's ``delta_codec`` with the
+    PS's own error-feedback residual — and notifies the scheduler
+    ``Progress::Updated`` (:274-283).
 
 Tensor math runs on the C++ kernels (hypha_tpu.native) with numpy fallback;
 on TPU deployments the same step can run as the jitted tree-op in
@@ -35,6 +44,7 @@ import numpy as np
 from safetensors.numpy import load_file, save_file
 
 from .. import aio
+from .. import compress
 from .. import native
 from ..ft.membership import PROTOCOL_FT, MembershipUpdate, RoundMembership, quorum_size
 from ..ft.rejoin import CATCHUP_KEY, CatchupBuffer
@@ -59,6 +69,57 @@ log = logging.getLogger("hypha.worker.ps")
 # Elastic collect poll tick: upper bound on how long a membership change or
 # pending rejoin waits before the collect loop notices it.
 _ELASTIC_TICK_S = 0.5
+
+# Broadcast fan-out width: enough concurrent streams to fill the uplink
+# without opening one per peer on a wide job.
+_BROADCAST_CONCURRENCY = 8
+
+
+class _RoundAccum:
+    """Streaming sample-weighted fold of one round's delta files.
+
+    Holds ONE param-sized f32 tree (Σ samples·Δθ) instead of every
+    worker's decoded delta: ``fold`` runs as each push lands (off the
+    event loop via ``asyncio.to_thread``), ``fold(…, sign=-1)`` un-folds a
+    replaced duplicate, and :meth:`mean` finishes the weighted mean when
+    quorum closes — leaving only the Nesterov step on the critical path.
+    """
+
+    def __init__(self) -> None:
+        self._acc: dict[str, np.ndarray] = {}
+        self._shapes: dict[str, tuple] = {}
+        self.total_samples = 0.0
+        self.folds = 0
+
+    def fold(self, path: Path, samples: float, sign: float = 1.0) -> None:
+        tree = compress.read_delta(path)
+        if self._shapes:
+            if set(tree) != set(self._shapes):
+                raise ValueError("workers sent deltas with mismatched keys")
+        for key, value in tree.items():
+            arr = np.asarray(value, np.float32)
+            shape = self._shapes.get(key)
+            if shape is None:
+                self._shapes[key] = arr.shape
+            elif arr.shape != shape:
+                raise ValueError(
+                    f"delta {key!r}: mismatched shape {arr.shape} vs {shape}"
+                )
+            contrib = np.float32(sign * samples) * arr
+            prev = self._acc.get(key)
+            if prev is None:
+                self._acc[key] = contrib
+            else:
+                prev += contrib
+        self.total_samples += sign * samples
+        self.folds += 1 if sign > 0 else -1
+
+    def mean(self) -> dict[str, np.ndarray]:
+        """The sample-weighted mean ḡ = Σ samples·Δθ / Σ samples (f32)."""
+        if not self._acc:
+            raise ValueError("no deltas folded")
+        denom = np.float32(max(self.total_samples, 1e-20))
+        return {k: v / denom for k, v in self._acc.items()}
 
 
 class _ElasticState:
@@ -174,18 +235,37 @@ class ParameterServerExecutor(JobExecutor):
                 .match(lambda m: m.job_id == job_id)
                 .respond_with(on_membership)
             )
+        # Broadcast compression state: the job's delta_codec picks the wire
+        # format for the update push; quantized codecs feed their error back
+        # into the next outer update so the broadcast stream tracks the
+        # uncompressed trajectory exactly like the upload stream does.
+        bcast_codec = compress.effective_codec(getattr(cfg, "delta_codec", "none"))
+        bcast_ef = (
+            compress.ErrorFeedback()
+            if bcast_codec in compress.QUANT_CODECS
+            else None
+        )
         try:
             while True:
+                accum = _RoundAccum()
                 if elastic is not None:
                     received = await self._collect_round_elastic(
-                        consumer, job_id, elastic, cfg, work_dir, round_num
+                        consumer, job_id, elastic, cfg, work_dir, round_num,
+                        accum=accum,
                     )
                 else:
                     received = await self._collect_round(
-                        consumer, job_id, allowed, num_workers, work_dir, round_num
+                        consumer, job_id, allowed, num_workers, work_dir,
+                        round_num, accum=accum,
                     )
-                update_path = self._outer_step(
-                    received, momentum_file, lr, mu, work_dir, round_num
+                update_path = await asyncio.to_thread(
+                    self._outer_step,
+                    received, momentum_file, lr, mu, work_dir, round_num,
+                    accum,
+                )
+                wire_path, sent_update = await asyncio.to_thread(
+                    self._encode_broadcast,
+                    update_path, bcast_codec, bcast_ef, work_dir, round_num,
                 )
                 if ckpt_dir is not None:
                     self._checkpoint_momentum(momentum_file, ckpt_dir)
@@ -196,7 +276,7 @@ class ParameterServerExecutor(JobExecutor):
                 # starts a phantom extra round (the reference broadcasts
                 # first, parameter_server.rs:232-283, and carries this race).
                 response = await self._notify_updated(scheduler_peer, job_id, round_num)
-                await self._broadcast(cfg, update_path, round_num, elastic)
+                await self._broadcast(cfg, wire_path, round_num, elastic)
                 for path, _ in received.values():
                     path.unlink(missing_ok=True)
                 round_num += 1
@@ -205,8 +285,24 @@ class ParameterServerExecutor(JobExecutor):
                     # (θ_r = θ₀ + Σ); fold this round in, then serve anyone
                     # who joined — before the next round's first broadcast,
                     # so a rejoiner can never see an update it must skip.
-                    elastic.catchup.accumulate(update_path)
-                    update_path.unlink(missing_ok=True)
+                    # The DECODED update is accumulated, not the f32 one:
+                    # θ_r must equal what workers actually merged. The
+                    # encode already produced the decoded tree — never
+                    # re-read and re-dequantize a parameter-sized frame.
+                    if sent_update is None:
+                        await asyncio.to_thread(
+                            elastic.catchup.accumulate, wire_path
+                        )
+                    else:
+                        await asyncio.to_thread(
+                            elastic.catchup.accumulate_tree, sent_update
+                        )
+                # Broadcast done (and catch-up folded): a long job must not
+                # accumulate two parameter-sized files per round.
+                update_path.unlink(missing_ok=True)
+                if wire_path != update_path:
+                    wire_path.unlink(missing_ok=True)
+                if elastic is not None:
                     await self._serve_joins(elastic, cfg, round_num, work_dir)
                 if response.kind == ProgressResponseKind.DONE:
                     execution.finish("completed")
@@ -222,6 +318,19 @@ class ParameterServerExecutor(JobExecutor):
             consumer.close()
             await asyncio.to_thread(shutil.rmtree, work_dir, ignore_errors=True)
 
+    @staticmethod
+    async def _fold(
+        accum: "_RoundAccum | None", entry: tuple[Path, float], sign: float = 1.0
+    ) -> None:
+        """Fold one saved delta into the round's partial sum, off-loop.
+
+        Decode + fold overlap the next push's arrival — the streaming
+        aggregation that leaves only the Nesterov step at quorum close.
+        ``accum`` is None when a caller (tests) only wants collection.
+        """
+        if accum is not None:
+            await asyncio.to_thread(accum.fold, entry[0], entry[1], sign)
+
     async def _collect_round(
         self,
         consumer,
@@ -230,6 +339,7 @@ class ParameterServerExecutor(JobExecutor):
         num_workers: int,
         work_dir: Path,
         round_num: int,
+        accum: "_RoundAccum | None" = None,
     ) -> dict[str, tuple[Path, float]]:
         """Gather one pseudo-gradient per worker: peer -> (path, samples)."""
         received: dict[str, tuple[Path, float]] = {}
@@ -243,11 +353,14 @@ class ParameterServerExecutor(JobExecutor):
             if peer in received:
                 # Double-send guard (fixes reference TODO :215-218): a
                 # re-send replaces the previous delta instead of
-                # mis-counting the round.
+                # mis-counting the round — un-fold it before the file goes.
                 log.warning("ps %s: duplicate delta from %s; replacing", job_id, peer)
-                received[peer][0].unlink(missing_ok=True)
-                del received[peer]
-            received[peer] = await self._save_delta(push, work_dir, round_num)
+                old = received.pop(peer)
+                await self._fold(accum, old, sign=-1.0)
+                old[0].unlink(missing_ok=True)
+            entry = await self._save_delta(push, work_dir, round_num)
+            received[peer] = entry
+            await self._fold(accum, entry)
             log.info(
                 "ps %s: round %d delta %d/%d (from %s)",
                 job_id, round_num, len(received), num_workers, peer,
@@ -262,6 +375,7 @@ class ParameterServerExecutor(JobExecutor):
         cfg,
         work_dir: Path,
         round_num: int,
+        accum: "_RoundAccum | None" = None,
     ) -> dict[str, tuple[Path, float]]:
         """Quorum + deadline gather: peer -> (path, samples).
 
@@ -272,6 +386,10 @@ class ParameterServerExecutor(JobExecutor):
         tagged with a future round are parked and pre-credited to it.
         """
         received: dict[str, tuple[Path, float]] = dict(st.early.pop(round_num, {}))
+        for entry in received.values():
+            # Parked early arrivals were never folded (their round hadn't
+            # opened); fold them now that it has.
+            await self._fold(accum, entry)
         loop = asyncio.get_running_loop()
         deadline = (
             loop.time() + st.round_deadline_s if st.round_deadline_s > 0 else None
@@ -327,7 +445,10 @@ class ParameterServerExecutor(JobExecutor):
                 FT_METRICS.stale_deltas_dropped.add(1)
                 await push.read_all()
                 continue
-            entry = await self._save_delta(push, work_dir, delta_round)
+            # Retire any superseded duplicate BEFORE saving: _save_delta
+            # names files delta-{round}-{sha(peer)}, so a re-send lands on
+            # the SAME path — un-folding/unlinking after the save would read
+            # the new bytes and delete the just-saved file.
             if delta_round > round_num:
                 # Early: a fast worker already merged this round's broadcast
                 # and shipped the next pseudo-gradient; credit it forward.
@@ -335,14 +456,19 @@ class ParameterServerExecutor(JobExecutor):
                 old = bucket.pop(peer, None)
                 if old is not None:
                     old[0].unlink(missing_ok=True)
-                bucket[peer] = entry
+                bucket[peer] = await self._save_delta(push, work_dir, delta_round)
                 continue
             old = received.pop(peer, None)
             if old is not None:
-                # Double-send guard (reference TODO :215-218): replace.
+                # Double-send guard (reference TODO :215-218): replace —
+                # un-fold the superseded delta while its file still holds
+                # the ORIGINAL bytes.
                 log.warning("ps %s: duplicate delta from %s; replacing", job_id, peer)
+                await self._fold(accum, old, sign=-1.0)
                 old[0].unlink(missing_ok=True)
+            entry = await self._save_delta(push, work_dir, delta_round)
             received[peer] = entry
+            await self._fold(accum, entry)
             log.info(
                 "ps %s: round %d delta %d (quorum %d, active %d) from %s",
                 job_id, round_num, len(received), st.quorum(),
@@ -419,72 +545,69 @@ class ParameterServerExecutor(JobExecutor):
         mu: float,
         work_dir: Path,
         round_num: int,
+        accum: "_RoundAccum | None" = None,
     ) -> Path:
-        """Sample-weighted mean + Nesterov over the received delta files.
+        """Nesterov over the round's sample-weighted mean pseudo-gradient.
 
-        Fast path: the whole step runs in C++ over mmapped SafeTensors
-        (native.ps_outer_step — zero copies into Python). Fallback: per-
-        tensor numpy/kernels with the same validation and results.
+        The streaming path hands in an accumulator that already folded
+        every delta as it arrived — only ḡ/Σw and the Nesterov recurrence
+        run here (C++ flat kernel via native.nesterov_update, numpy
+        fallback). Callers without an accumulator (tests, the degenerate
+        path) fold the received files now, with the same validation.
         """
-        paths = [p for p, _ in received.values()]
-        weights = np.asarray([s for _, s in received.values()], np.float32)
-        weights = weights / max(weights.sum(), 1e-20)
+        if accum is None or accum.folds == 0:
+            accum = _RoundAccum() if accum is None else accum
+            for path, samples in received.values():
+                accum.fold(path, samples)
+        mean = accum.mean()
         out = work_dir / f"update-{round_num}.safetensors"
         momentum_tmp = work_dir / "momentum.next.safetensors"
-
-        total = native.ps_outer_step(
-            paths,
-            weights,
-            momentum_file if momentum_file.is_file() else None,
-            momentum_tmp,
-            out,
-            lr,
-            mu,
-        )
-        if total is not None:
-            os.replace(momentum_tmp, momentum_file)
-            return out
-
-        # ---- Python fallback (no native toolchain) ----------------------
         momentum: dict[str, np.ndarray] = {}
         if momentum_file.is_file():
             momentum = dict(load_file(str(momentum_file)))
-        trees = [load_file(str(p)) for p in paths]
-        keys = list(trees[0])
-        for t in trees[1:]:
-            if list(t) != keys:
-                raise ValueError("workers sent deltas with mismatched keys")
         update: dict[str, np.ndarray] = {}
-        for key in keys:
-            srcs = [t[key] for t in trees]
-            shape, dtype = srcs[0].shape, srcs[0].dtype
-            # The flat kernel trusts n = momentum.size; a short tensor from
-            # a buggy/malicious worker must fail here, not read out of bounds.
-            for t, s in zip(trees, srcs):
-                if s.shape != shape or s.dtype != dtype:
-                    raise ValueError(
-                        f"delta {key!r}: mismatched shape/dtype "
-                        f"{s.shape}/{s.dtype} vs {shape}/{dtype}"
-                    )
+        for key, g in mean.items():
             m = momentum.get(key)
             if m is None:
-                m = np.zeros(srcs[0].size, np.float32)
-            elif m.size != srcs[0].size:
+                m = np.zeros(g.size, np.float32)
+            elif m.size != g.size:
+                # The flat kernel trusts n = momentum.size; a short tensor
+                # from a buggy/malicious worker must fail here, not read
+                # out of bounds.
                 raise ValueError(
-                    f"delta {key!r}: size {srcs[0].size} != momentum {m.size}"
+                    f"delta {key!r}: size {g.size} != momentum {m.size}"
                 )
-            if dtype != np.float32:
-                # bf16 wire-format deltas (ml_dtypes.bfloat16 via
-                # safetensors): widen per-tensor for the f32 kernel — the
-                # accumulator/momentum stay f32 like the native path.
-                srcs = [np.asarray(s, np.float32) for s in srcs]
-            new_m, upd = native.fused_mean_nesterov(srcs, weights, m, lr, mu)
-            momentum[key] = new_m.reshape(shape)
-            update[key] = upd.reshape(shape)
+            new_m, upd = native.nesterov_update(m, g.ravel(), lr, mu)
+            momentum[key] = new_m.reshape(g.shape)
+            update[key] = upd.reshape(g.shape)
         save_file(update, str(out))
         save_file(momentum, str(momentum_tmp))
         os.replace(momentum_tmp, momentum_file)
         return out
+
+    @staticmethod
+    def _encode_broadcast(
+        update_path: Path,
+        codec: str,
+        ef: "compress.ErrorFeedback | None",
+        work_dir: Path,
+        round_num: int,
+    ) -> tuple[Path, "dict[str, np.ndarray] | None"]:
+        """Re-encode the f32 update for the wire per the job's codec.
+
+        int8/int4 write an HQD1 frame of Q(update + residual) and keep the
+        new residual; bf16 casts the SafeTensors payload. "none" broadcasts
+        the f32 file untouched (the seed's format). Returns the wire path
+        plus the update AS RECEIVERS WILL DECODE IT (None for "none") so
+        the catch-up sum never re-reads and re-dequantizes the frame.
+        """
+        if codec == "none":
+            return update_path, None
+        wire = work_dir / f"update-{round_num}.wire.safetensors"
+        sent = compress.write_delta(
+            wire, dict(load_file(str(update_path))), codec, ef=ef
+        )
+        return wire, sent
 
     @staticmethod
     def _checkpoint_momentum(momentum_file: Path, ckpt_dir: Path) -> None:
@@ -499,8 +622,13 @@ class ParameterServerExecutor(JobExecutor):
     async def _broadcast(
         self, cfg, update_path: Path, round_num: int, elastic: "_ElasticState | None" = None
     ) -> None:
-        """Push the update tensor to every worker (:232-269). Send failures
-        are tolerated — the worker can catch up next round (:265-268).
+        """Push the update tensor to every worker in parallel (:232-269 —
+        the reference pushes one peer at a time and the slowest link gates
+        the whole round). Fan-out is bounded at ``_BROADCAST_CONCURRENCY``
+        streams; per-peer send failures are tolerated — the worker can
+        catch up next round (:265-268). ``TransferStrategy.ANY`` keeps its
+        first-success semantics: the first push that lands cancels the
+        rest.
 
         Elastic mode broadcasts to the current membership's active set
         (rejoiners included, departed peers skipped) and stamps the
@@ -516,13 +644,44 @@ class ParameterServerExecutor(JobExecutor):
         if elastic is not None:
             peers = list(elastic.membership.active)
             header["epoch"] = elastic.membership.epoch
-        for peer in peers:
+        if not peers:
+            return
+        sem = asyncio.Semaphore(_BROADCAST_CONCURRENCY)
+
+        async def push_one(peer: str) -> bool:
+            async with sem:
+                try:
+                    await self.node.push(peer, header, update_path)
+                    return True
+                except RequestError as e:
+                    log.warning(
+                        "ps: broadcast to %s failed (%s); retry next round",
+                        peer, e,
+                    )
+                    return False
+
+        tasks = [
+            asyncio.create_task(push_one(p), name=f"ps-bcast-{p}")
+            for p in peers
+        ]
+        if strategy == TransferStrategy.ANY:
             try:
-                await self.node.push(peer, header, update_path)
-                if strategy == TransferStrategy.ANY:
-                    return
-            except RequestError as e:
-                log.warning("ps: broadcast to %s failed (%s); retry next round", peer, e)
+                for fut in asyncio.as_completed(tasks):
+                    if await fut:
+                        break
+            finally:
+                # First success (or caller cancellation): the losers of the
+                # race are cancelled and awaited, never abandoned.
+                await aio.reap(*(t for t in tasks if not t.done()))
+        else:
+            try:
+                await asyncio.gather(*tasks)
+            finally:
+                # push_one only absorbs RequestError; a raw transport error
+                # (ConnectionResetError out of a severed stream) escapes
+                # the gather — the siblings must not be left streaming a
+                # file the job teardown is about to rmtree.
+                await aio.reap(*(t for t in tasks if not t.done()))
 
     async def _notify_updated(
         self, scheduler_peer: str, job_id: str, round_num: int

@@ -1,0 +1,109 @@
+// Chunkwise max-abs quantization for the compressed delta transport
+// (hypha_tpu/compress). The Python fallback in compress/quant.py is the
+// semantic spec; these kernels must match it BIT-FOR-BIT so which path is
+// active never changes what lands on the wire (the parity corpus in
+// tests/test_compress.py pins this, like the CBOR codec pair).
+//
+// Exactness contract, mirrored operation-for-operation with numpy:
+//   inv   = qmax / maxabs          (one f32 divide per chunk)
+//   q     = rint(v * inv)          (f32 product then half-to-even round —
+//                                   nearbyintf under the default FP mode,
+//                                   identical to np.rint; a bare product
+//                                   cannot be FMA-contracted)
+//   scale = maxabs / qmax          (f32 divide)
+//   v'    = (float)q * scale
+// A chunk whose max-abs is zero, NaN (propagated like np.max) or Inf
+// encodes as all-zeros with scale 0 — a non-finite element never reaches
+// the int cast (float->int8 of NaN is UB in C++ and platform noise in
+// numpy), and both paths agree byte-for-byte.
+//
+// int4 packs two two's-complement nibbles per byte, element 2j in the low
+// nibble, independent of chunk boundaries (chunk is required even, so
+// chunks stay byte-aligned anyway).
+//
+// Built into libhypha_native.so with the other kernels (hypha_tpu/native.py
+// compiles all sources on first use).
+
+#include <cmath>
+#include <cstdint>
+
+namespace {
+
+inline float chunk_maxabs(const float *src, int64_t lo, int64_t hi) {
+  float maxabs = 0.0f;
+  for (int64_t i = lo; i < hi; ++i) {
+    float a = std::fabs(src[i]);
+    if (std::isnan(a)) return a;  // propagate like np.max over the chunk
+    if (a > maxabs) maxabs = a;
+  }
+  return maxabs;
+}
+
+}  // namespace
+
+extern "C" {
+
+// Quantize n f32 elements into q_out/scales_out. bits is 8 or 4.
+// q_out holds n bytes (int8) or (n+1)/2 bytes (int4); scales_out holds
+// ceil(n/chunk) floats. Returns bytes written to q_out, or -1 on bad args.
+int64_t quant_chunks_f32(const float *src, int64_t n, int64_t chunk, int bits,
+                         uint8_t *q_out, float *scales_out) {
+  if (n < 0 || chunk <= 0 || (bits != 8 && bits != 4) ||
+      (bits == 4 && (chunk & 1)))
+    return -1;
+  const float qmax = bits == 8 ? 127.0f : 7.0f;
+  const int64_t nchunks = (n + chunk - 1) / chunk;
+  const int64_t qbytes = bits == 8 ? n : (n + 1) / 2;
+  if (bits == 4) {
+    for (int64_t j = 0; j < qbytes; ++j) q_out[j] = 0;
+  }
+  for (int64_t c = 0; c < nchunks; ++c) {
+    const int64_t lo = c * chunk;
+    const int64_t hi = lo + chunk < n ? lo + chunk : n;
+    const float maxabs = chunk_maxabs(src, lo, hi);
+    if (!(maxabs > 0.0f) || !std::isfinite(maxabs)) {
+      scales_out[c] = 0.0f;
+      if (bits == 8) {
+        for (int64_t i = lo; i < hi; ++i) q_out[i] = 0;
+      }
+      continue;
+    }
+    const float inv = qmax / maxabs;
+    scales_out[c] = maxabs / qmax;
+    for (int64_t i = lo; i < hi; ++i) {
+      float r = nearbyintf(src[i] * inv);
+      if (r > qmax) r = qmax;
+      if (r < -qmax) r = -qmax;
+      const int8_t qi = static_cast<int8_t>(r);
+      if (bits == 8) {
+        q_out[i] = static_cast<uint8_t>(qi);
+      } else {
+        const uint8_t nib = static_cast<uint8_t>(qi) & 0xF;
+        q_out[i >> 1] |= (i & 1) ? static_cast<uint8_t>(nib << 4) : nib;
+      }
+    }
+  }
+  return qbytes;
+}
+
+// Invert quant_chunks_f32. Returns n, or -1 on bad args.
+int64_t dequant_chunks_f32(const uint8_t *q, const float *scales, int64_t n,
+                           int64_t chunk, int bits, float *dst) {
+  if (n < 0 || chunk <= 0 || (bits != 8 && bits != 4) ||
+      (bits == 4 && (chunk & 1)))
+    return -1;
+  for (int64_t i = 0; i < n; ++i) {
+    const float scale = scales[i / chunk];
+    int8_t qi;
+    if (bits == 8) {
+      qi = static_cast<int8_t>(q[i]);
+    } else {
+      const uint8_t nib = (i & 1) ? (q[i >> 1] >> 4) : (q[i >> 1] & 0xF);
+      qi = static_cast<int8_t>((nib ^ 8) - 8);  // sign-extend 4 bits
+    }
+    dst[i] = static_cast<float>(qi) * scale;
+  }
+  return n;
+}
+
+}  // extern "C"

@@ -333,7 +333,12 @@ def test_elastic_quorum_round_and_rejoin(tmp_path):
     round must aggregate at quorum (3 of 4) after the PS round deadline,
     the membership epoch must advance, and a restarted worker must rejoin
     via the catch-up protocol — all WITHOUT a full-job restart
-    (max_attempts=1: any restart would fail the run)."""
+    (max_attempts=1: any restart would fail the run).
+
+    Runs with ``delta_codec="int8"`` so quantized HQD1 deltas exercise the
+    same path: quorum close, stale-delta rejection, incremental folding,
+    the quantized broadcast, and rejoin catch-up over DECODED updates all
+    interoperate with compression + error feedback."""
     import dataclasses
 
     from hypha_tpu.ft import ChaosAction, ChaosController, FTConfig
@@ -393,6 +398,7 @@ def test_elastic_quorum_round_and_rejoin(tmp_path):
             rounds=DiLoCoRounds(
                 update_rounds=4, avg_samples_between_updates=24, max_batch_size=4
             ),
+            delta_codec="int8",
             ft=FTConfig(
                 quorum_fraction=0.75,
                 round_deadline_s=6.0,

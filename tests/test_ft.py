@@ -299,6 +299,68 @@ def test_early_delta_parked_and_credited_to_its_round(tmp_path):
     assert set(received1) == {"w0", "w1"}  # parked delta pre-credited
 
 
+def test_elastic_duplicate_resend_replaces_cleanly(tmp_path):
+    """A re-sent delta lands on the SAME deterministic path as the first
+    (delta-{round}-{sha(peer)}), so the replace must retire the old entry
+    BEFORE saving — the un-fold/unlink-after-save ordering crashed the PS
+    on the very double-send the guard exists to tolerate (review r6)."""
+    from hypha_tpu.worker.ps_executor import _RoundAccum
+
+    peers = ["w0", "w1"]
+    cfg = elastic_cfg(peers, quorum_fraction=0.5, round_deadline_s=0.3)
+    st = _ElasticState(cfg, "sched")
+    ps = ParameterServerExecutor(node=None, work_root=tmp_path)
+    accum = _RoundAccum()
+    consumer = FakeConsumer(
+        [
+            delta_push("w0", 0, 1.0, 10.0),  # superseded
+            delta_push("w0", 0, 5.0, 10.0),  # the re-send that must win
+            delta_push("w1", 0, 3.0, 10.0),
+        ]
+    )
+    received = run(
+        ps._collect_round_elastic(
+            consumer, "job", st, cfg, tmp_path, 0, accum=accum
+        )
+    )
+    assert set(received) == {"w0", "w1"}
+    assert received["w0"][0].is_file()  # the replacement survived on disk
+    assert accum.folds == 2
+    # Fold accounting: (5·10 + 3·10)/20 = 4.0, no trace of the first send.
+    np.testing.assert_allclose(accum.mean()["w"], np.full(3, 4.0), rtol=1e-6)
+    out = ps._outer_step(
+        received, tmp_path / "m.st", 0.7, 0.9, tmp_path, 0, accum
+    )
+    np.testing.assert_allclose(
+        load_file(str(out))["w"], np.full(3, 0.7 * 1.9 * 4.0), rtol=1e-6
+    )
+
+
+def test_elastic_duplicate_early_delta_parks_latest(tmp_path):
+    """Same path-collision hazard for the early-park bucket: a double-sent
+    future-round delta must leave a live file parked, not a dangling path."""
+    peers = ["w0", "w1"]
+    cfg = elastic_cfg(peers, quorum_fraction=0.5, round_deadline_s=0.3)
+    st = _ElasticState(cfg, "sched")
+    ps = ParameterServerExecutor(node=None, work_root=tmp_path)
+    received0 = run(
+        ps._collect_round_elastic(
+            FakeConsumer(
+                [
+                    delta_push("w0", 1, 1.0, 1.0),  # early, superseded
+                    delta_push("w0", 1, 7.0, 1.0),  # early re-send wins
+                    delta_push("w1", 0, 2.0, 1.0),
+                ]
+            ),
+            "job", st, cfg, tmp_path, 0,
+        )
+    )
+    assert set(received0) == {"w1"}
+    parked = st.early[1]["w0"]
+    assert parked[0].is_file()
+    np.testing.assert_allclose(load_file(str(parked[0]))["w"], np.full(3, 7.0))
+
+
 def test_non_member_push_dropped(tmp_path):
     peers = ["w0", "w1"]
     cfg = elastic_cfg(peers, quorum_fraction=0.5, round_deadline_s=0.3)
