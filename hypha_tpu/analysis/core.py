@@ -70,6 +70,10 @@ RULES: dict[str, str] = {
     "msg-missing-round-tag": (
         "FT-critical message lacks a round/epoch tag"
     ),
+    "msg-fragment-needs-round": (
+        "message carries a fragment_id but no round tag — an untagged "
+        "fragment folds into whichever round is open on the PS"
+    ),
     "msg-unmapped-protocol": (
         "registered wire message not claimed by any stream protocol"
     ),

@@ -15,10 +15,17 @@ syntactic check can't establish:
     server cannot reject stale deltas and catch-up pushes;
   * ``msg-unmapped-protocol`` — every registered message must be claimed by
     a protocol in ``messages.PROTOCOL_MESSAGES`` or as nested value
-    vocabulary, so a new message can't ship without an owning stream.
+    vocabulary, so a new message can't ship without an owning stream;
+  * ``msg-fragment-needs-round`` — any message carrying a ``fragment_id``
+    (the streaming outer sync's fragment identity, hypha_tpu.stream) must
+    also carry a round tag: a fragment delta without its round would fold
+    into whichever round happens to be open on the parameter server —
+    silent corruption, not a decode error. Same manifest mechanism as the
+    FT round-tag rule, applied structurally to every registered message.
 
-All three support the standard inline suppression, placed anywhere in the
-class's decorator block or on its ``class`` line in its defining module.
+All of these support the standard inline suppression, placed anywhere in
+the class's decorator block or on its ``class`` line in its defining
+module.
 """
 
 from __future__ import annotations
@@ -38,6 +45,11 @@ REQUIRES_ROUND_TAG: frozenset[str] = frozenset(
     {"ParameterPush", "Progress", "RoundMembership", "MembershipUpdate"}
 )
 _TAG_FIELDS = {"round", "epoch", "round_num"}
+
+# Field names that identify a streamed parameter fragment; their presence
+# obliges the message to carry one of _TAG_FIELDS too (the
+# ``msg-fragment-needs-round`` rule).
+_FRAGMENT_FIELDS = {"fragment_id", "fragment"}
 
 
 def _modules():
@@ -282,6 +294,37 @@ def check_round_tags(registry=None, required=REQUIRES_ROUND_TAG) -> list[Violati
     return out
 
 
+def check_fragment_tags(registry=None) -> list[Violation]:
+    """Any message with a fragment identity must carry a round tag.
+
+    Unlike :func:`check_round_tags` (a fixed manifest of FT-critical
+    names), this rule is structural: EVERY registered dataclass that grows
+    a ``fragment_id``/``fragment`` field is obliged to pair it with
+    ``round``/``epoch``/``round_num`` — an embedded ``RoundMembership``
+    does not count, because the fragment and its round must travel in the
+    same header the parameter server routes on.
+    """
+    messages, _ = _modules()
+    registry = registry if registry is not None else _package_registry(messages)
+    out: list[Violation] = []
+    for name, cls in sorted(registry.items()):
+        if not dataclasses.is_dataclass(cls):
+            continue
+        fields = {f.name for f in dataclasses.fields(cls)}
+        if fields & _FRAGMENT_FIELDS and not fields & _TAG_FIELDS:
+            out.append(
+                _violation(
+                    cls,
+                    "msg-fragment-needs-round",
+                    f"{name}: carries {sorted(fields & _FRAGMENT_FIELDS)} "
+                    f"but no round tag ({'/'.join(sorted(_TAG_FIELDS))}) — "
+                    f"an untagged fragment folds into whichever round is "
+                    f"open on the parameter server",
+                )
+            )
+    return out
+
+
 def check_protocol_map(registry=None, manifest=None, values=None) -> list[Violation]:
     messages, _ = _modules()
     registry = registry if registry is not None else _package_registry(messages)
@@ -338,4 +381,9 @@ def check_protocol_map(registry=None, manifest=None, values=None) -> list[Violat
 
 
 def check() -> list[Violation]:
-    return check_roundtrip() + check_round_tags() + check_protocol_map()
+    return (
+        check_roundtrip()
+        + check_round_tags()
+        + check_fragment_tags()
+        + check_protocol_map()
+    )

@@ -67,7 +67,10 @@ def _load_config(role: str, args) -> object:
 def _parse_cli_value(raw: str):
     """``--set`` values are strings; interpret them as TOML values so ints,
     floats, bools and arrays come through typed. Bare strings stay strings."""
-    import tomllib
+    try:  # py3.11+ stdlib; tomli on 3.10 (same fallback as config.py)
+        import tomllib
+    except ModuleNotFoundError:
+        import tomli as tomllib  # type: ignore[no-redef]
 
     try:
         return tomllib.loads(f"v = {raw}")["v"]

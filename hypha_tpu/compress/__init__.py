@@ -31,7 +31,15 @@ Codec selection is per job via ``JobSpec.delta_codec``
 from __future__ import annotations
 
 from .feedback import ErrorFeedback
-from .frame import MAGIC, is_frame, read_delta, read_frame, write_delta, write_frame
+from .frame import (
+    MAGIC,
+    frame_tag,
+    is_frame,
+    read_delta,
+    read_frame,
+    write_delta,
+    write_frame,
+)
 from .quant import DEFAULT_CHUNK, dequantize, quantize
 
 __all__ = [
@@ -48,6 +56,7 @@ __all__ = [
     "read_delta",
     "write_delta",
     "is_frame",
+    "frame_tag",
 ]
 
 # Every per-job wire codec. "none" ships f32 SafeTensors (the seed format),

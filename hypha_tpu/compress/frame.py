@@ -42,6 +42,7 @@ __all__ = [
     "read_frame",
     "read_delta",
     "write_delta",
+    "frame_tag",
 ]
 
 MAGIC = b"HQD1"
@@ -65,12 +66,16 @@ def write_frame(
     flat: dict[str, np.ndarray],
     codec: str,
     chunk: int = DEFAULT_CHUNK,
+    tag: dict[str, Any] | None = None,
 ) -> dict[str, np.ndarray]:
     """Quantize ``flat`` and write one HQD1 frame atomically.
 
     Returns the DEQUANTIZED tree — exactly what a receiver will decode —
     so the caller can compute its error-feedback residual without
-    re-reading the file.
+    re-reading the file. ``tag`` (e.g. a streaming sync's
+    ``FragmentTag.header()`` with round/fragment_id) rides the CBOR
+    header, making the frame self-identifying even off the push stream
+    that carried it; decoders that predate the field ignore it.
     """
     path = Path(path)
     table: list[dict[str, Any]] = []
@@ -97,7 +102,10 @@ def write_frame(
         chunks.append(qb)
         chunks.append(sb)
         off += len(qb) + len(sb)
-    header = cbor.dumps({"codec": codec, "chunk": chunk, "tensors": table})
+    head: dict[str, Any] = {"codec": codec, "chunk": chunk, "tensors": table}
+    if tag:
+        head["tag"] = dict(tag)
+    header = cbor.dumps(head)
     tmp = path.with_name(path.name + f".tmp.{os.getpid()}")
     with open(tmp, "wb") as fp:
         fp.write(MAGIC)
@@ -150,6 +158,7 @@ def write_delta(
     codec: str,
     chunk: int = DEFAULT_CHUNK,
     ef=None,
+    tag: dict[str, Any] | None = None,
 ) -> dict[str, np.ndarray]:
     """The one send-side entry point: encode ``flat`` per ``codec``.
 
@@ -157,6 +166,8 @@ def write_delta(
     (:class:`~hypha_tpu.compress.feedback.ErrorFeedback`) when given, so
     the quantization error rides the next send. bf16 casts f32 tensors
     (others pass through) into SafeTensors; "none" writes f32 SafeTensors.
+    ``tag`` stamps HQD1 frames with the sender's stream identity
+    (round/fragment); SafeTensors codecs rely on the push header alone.
     Returns the tree AS A RECEIVER WILL DECODE IT (for residuals, catch-up
     accounting, or tests).
     """
@@ -165,7 +176,7 @@ def write_delta(
     if codec in ("int8", "int4"):
         if ef is not None:
             flat = ef.compensate(flat)
-        decoded = write_frame(path, flat, codec, chunk)
+        decoded = write_frame(path, flat, codec, chunk, tag=tag)
         if ef is not None:
             ef.absorb(flat, decoded)
         return decoded
@@ -186,6 +197,30 @@ def write_delta(
         raise ValueError(f"unknown wire codec {codec!r}")
     save_file(norm, str(path))
     return norm
+
+
+def frame_tag(path: Path | str) -> dict[str, Any] | None:
+    """The stream tag an HQD1 frame carries (None: untagged / not a frame).
+
+    Reads only magic + header, never the payload — cheap enough for a
+    receiver to cross-check a push header's (round, fragment_id) against
+    what the sender baked into the frame itself.
+    """
+    try:
+        with open(path, "rb") as fp:
+            head = fp.read(8)
+            if head[:4] != MAGIC or len(head) < 8:
+                return None
+            (hlen,) = struct.unpack("<I", head[4:8])
+            if hlen > _MAX_HEADER:
+                return None
+            header = cbor.loads(fp.read(hlen))
+    except (OSError, ValueError):
+        return None
+    if not isinstance(header, dict):
+        return None
+    tag = header.get("tag")
+    return dict(tag) if isinstance(tag, dict) else None
 
 
 def read_delta(path: Path | str) -> dict[str, np.ndarray]:

@@ -24,6 +24,8 @@ from safetensors.numpy import load_file, save_file
 __all__ = [
     "path_name",
     "flatten_tree",
+    "flat_leaf_map",
+    "replace_leaves",
     "unflatten_like",
     "save_tree",
     "load_flat",
@@ -57,6 +59,42 @@ def flatten_tree(tree: Any) -> dict[str, np.ndarray]:
             raise ValueError(f"duplicate tensor name {name!r} in tree")
         flat[name] = np.asarray(leaf)
     return flat
+
+
+def flat_leaf_map(tree: Any) -> dict[str, Any]:
+    """{stable_name: leaf} WITHOUT materializing to numpy.
+
+    The streaming sync path (hypha_tpu.stream) addresses single fragments
+    of a device-resident param tree by wire name per round;
+    :func:`flatten_tree`'s ``np.asarray`` would device_get the WHOLE tree
+    each time. Leaves are aliases — callers copy what they keep.
+    """
+    flat: dict[str, Any] = {}
+    for path, leaf in jax.tree_util.tree_flatten_with_path(tree)[0]:
+        name = path_name(path)
+        if name in flat:
+            raise ValueError(f"duplicate tensor name {name!r} in tree")
+        flat[name] = leaf
+    return flat
+
+
+def replace_leaves(tree: Any, updates: dict[str, Any]) -> Any:
+    """A copy of ``tree`` with the named leaves swapped for ``updates``'.
+
+    Unnamed leaves alias the input tree's. Every update name must exist in
+    the tree — a leftover name means the caller's fragment map and the
+    tree disagree, which must fail loudly.
+    """
+    paths_leaves, treedef = jax.tree_util.tree_flatten_with_path(tree)
+    remaining = dict(updates)
+    leaves = [
+        remaining.pop(path_name(path), leaf) for path, leaf in paths_leaves
+    ]
+    if remaining:
+        raise KeyError(
+            f"replace_leaves: names not in tree: {sorted(remaining)}"
+        )
+    return jax.tree_util.tree_unflatten(treedef, leaves)
 
 
 def unflatten_like(flat: dict[str, np.ndarray], like: Any) -> Any:

@@ -32,6 +32,8 @@ import argparse
 import json
 import logging
 import math
+import os
+import threading
 import time
 from pathlib import Path
 from typing import Any, Callable, Iterator
@@ -40,6 +42,7 @@ import numpy as np
 
 from .. import messages
 from ..messages import (
+    FragmentTag,
     JobSpec,
     Loss,
     ModelType,
@@ -49,8 +52,11 @@ from ..messages import (
     TrainExecutorConfig,
 )
 from .. import compress
+from ..stream import SYNC_MODES, effective_fragments, fragment_due, merge_corrected
+from ..stream.partition import partition_names
+from ..telemetry.ft_metrics import STREAM_METRICS
 from .diloco import apply_updates, extract_delta, merge_update
-from .serialization import flatten_tree, unflatten_like
+from .serialization import flat_leaf_map, flatten_tree, replace_leaves, unflatten_like
 from .train import TrainState, build_optimizer, make_train_step
 
 __all__ = ["run_training", "main", "TrainResult"]
@@ -120,6 +126,281 @@ def _non_causal_types():
         ModelType.SEQUENCE_CLASSIFICATION,
         ModelType.TOKEN_CLASSIFICATION,
     } | HEAD_TYPES
+
+
+# Streaming sync poll wait (seconds): how long the inner loop blocks on the
+# in-flight sync before each batch. 0 (default) = pure overlap — never wait,
+# keep stepping. Positive values degrade toward blocking semantics; tests
+# use a large value to pin "zero flight drift == blocking bit-exactly".
+_STREAM_POLL_WAIT_ENV = "HYPHA_STREAM_POLL_WAIT"
+
+
+class _WorkerStream:
+    """Worker-side streaming outer sync: at most ONE fragment in flight.
+
+    ``begin`` snapshots the due fragment (θ_s), extracts Δ = θ_s − anchor
+    and hands encode → upload → await-broadcast to a daemon thread while
+    the inner loop keeps stepping; ``poll``/``finish`` (main thread) apply
+    the delayed-update correction when the broadcast lands:
+
+        θ ← θ_l + u          (live params keep the in-flight drift)
+        anchor ← θ_s + u     (anchor excludes it → next Δ ships the drift)
+
+    Updates for fragments NOT in flight (broadcasts this worker missed or
+    that raced ahead) are absorbed into params AND anchor — leaving
+    Δ = θ − anchor untouched, because an outer update is not local
+    progress. That rule keeps the worker live across lost broadcasts, the
+    failure the blocking path tolerates by merging whatever file arrives
+    next.
+
+    Error feedback is per fragment: ErrorFeedback.absorb replaces the
+    whole residual tree, so one shared instance would drop every other
+    fragment's residual each sync.
+    """
+
+    def __init__(
+        self, session, cfg, work_dir: Path, sync_mode: str, wire_codec: str
+    ) -> None:
+        self.session = session
+        self.cfg = cfg
+        self.work_dir = Path(work_dir)
+        self.codec = wire_codec
+        self.F = effective_fragments(
+            sync_mode, int(getattr(cfg, "fragments", 0) or 0)
+        )
+        self.fragments: list[tuple[str, ...]] | None = None
+        self.efs = [
+            compress.ErrorFeedback()
+            if wire_codec in compress.QUANT_CODECS
+            else None
+            for _ in range(self.F)
+        ]
+        self.flight: dict[str, Any] | None = None
+        self.poll_wait_s = float(
+            os.environ.get(_STREAM_POLL_WAIT_ENV, "0") or 0.0
+        )
+
+    @property
+    def in_flight(self) -> bool:
+        return self.flight is not None
+
+    # ------------------------------------------------------------- begin
+
+    def begin(self, round_num: int, params, anchor, num_samples: float) -> None:
+        """Snapshot + extract the due fragment; spawn the flight thread."""
+        import jax
+        import jax.numpy as jnp
+
+        if self.flight is not None:
+            raise RuntimeError(
+                "stream sync scheduled while a fragment is still in flight"
+            )
+        anchor_flat = flat_leaf_map(anchor)
+        if self.fragments is None:
+            # Deterministic by (name, size) only — the parameter server
+            # derives the identical partition from the delta frames.
+            self.fragments = partition_names(
+                {n: int(leaf.size) for n, leaf in anchor_flat.items()}, self.F
+            )
+        frag = fragment_due(round_num, self.F)
+        names = self.fragments[frag]
+        params_flat = flat_leaf_map(params)
+        # Deep copy, not an alias: the jitted step donates its input state,
+        # so live buffers die on the next inner step.
+        snap = {n: jnp.copy(params_flat[n]) for n in names}
+        delta = extract_delta(snap, {n: anchor_flat[n] for n in names})
+        host_delta = jax.device_get(delta)
+        tag = FragmentTag(round=round_num, fragment_id=frag, fragments=self.F)
+        flight: dict[str, Any] = {
+            "round": round_num,
+            "frag": frag,
+            "names": names,
+            "snap": snap,
+            "path": self.work_dir / f"delta-{round_num}-f{frag}.safetensors",
+            "box": {"absorbed": []},
+            "t0": time.monotonic(),
+            "compute_s": 0.0,
+            "bytes": 0,
+        }
+        thread = threading.Thread(
+            target=self._flight_main,
+            args=(flight, host_delta, tag, float(num_samples)),
+            daemon=True,
+            name=f"stream-sync-r{round_num}",
+        )
+        flight["thread"] = thread
+        self.flight = flight
+        thread.start()
+
+    # ----------------------------------------------------- flight thread
+
+    def _flight_main(
+        self, flight: dict, host_delta: dict, tag: FragmentTag, samples: float
+    ) -> None:
+        box = flight["box"]
+        try:
+            # host_delta is already wire-flat: {stable_name: np.ndarray}.
+            compress.write_delta(
+                flight["path"],
+                host_delta,
+                self.codec,
+                ef=self.efs[flight["frag"]],
+                tag=tag.header(),
+            )
+            nbytes = flight["path"].stat().st_size
+            flight["bytes"] = nbytes
+            STREAM_METRICS.flight_started(nbytes)
+            self.session.send_resource(
+                self.cfg.updates,
+                flight["path"].name,
+                resource=self.cfg.updates.ref.resource or "updates",
+                meta={"num_samples": samples, **tag.header()},
+            )
+            box["completion"] = self._await_broadcast(flight)
+        except BaseException as e:  # hypha-lint: disable=swallowed-cancel
+            box["error"] = e  # thread-bridge: re-raised at finish()
+        finally:
+            # Success or failure, this thread is done with the wire —
+            # release the gauge here so an errored/abandoned flight can
+            # never read as mid-upload for the rest of the process.
+            STREAM_METRICS.flight_landed(flight["bytes"])
+
+    def _await_broadcast(self, flight: dict) -> dict:
+        """Consume results-stream events until OUR fragment's update lands.
+
+        Other fragments' updates are recorded for the main thread's absorb
+        pass; stale rebroadcasts of our fragment are dropped. A LATER
+        round of our fragment completes the flight too (our round's
+        broadcast was lost — waiting for it would hang the worker where
+        blocking mode's merge-whatever-arrives keeps going).
+        """
+        from ..ft.rejoin import CATCHUP_KEY
+
+        with self.session.receive(self.cfg.results) as events:
+            for event in events:
+                meta = event.get("meta") or {}
+                if meta.get(CATCHUP_KEY):
+                    # Catch-ups target rejoiners; their content is folded
+                    # into every later broadcast — drop defensively.
+                    (self.work_dir / event["path"]).unlink(missing_ok=True)
+                    continue
+                etag = FragmentTag.from_header(meta)
+                try:
+                    eround = int(meta.get("round", flight["round"]))
+                except (TypeError, ValueError):
+                    eround = flight["round"]
+                if eround < flight["round"]:
+                    # Stale for ANY fragment, ours or not: the worker only
+                    # ships round r after merging every round < r, so an
+                    # older broadcast (a redelivery, or a round already
+                    # folded into this worker's rejoin catch-up) is applied
+                    # state — absorbing it would double-apply the update.
+                    (self.work_dir / event["path"]).unlink(missing_ok=True)
+                    continue
+                if etag is not None and etag.fragment_id != flight["frag"]:
+                    # A FUTURE round's other fragment (the quorum PS ran
+                    # ahead without us): genuinely unseen — absorb.
+                    flight["box"]["absorbed"].append(event)
+                    continue
+                if eround > flight["round"]:
+                    log.warning(
+                        "stream sync: round %d broadcast lost; completing "
+                        "with round %d's", flight["round"], eround,
+                    )
+                return event
+        raise RuntimeError(
+            "results stream ended before the fragment's update broadcast"
+        )
+
+    # ---------------------------------------------------------- progress
+
+    def poll(self) -> bool:
+        """True when the in-flight sync is ready to finish (non-blocking
+        unless $HYPHA_STREAM_POLL_WAIT asks to degrade toward blocking)."""
+        flight = self.flight
+        if flight is None:
+            return False
+        if self.poll_wait_s > 0:
+            flight["thread"].join(self.poll_wait_s)
+        return not flight["thread"].is_alive()
+
+    def note_compute(self, seconds: float) -> None:
+        """One inner step ran while the sync was in flight (overlap win)."""
+        if self.flight is not None:
+            self.flight["compute_s"] += seconds
+
+    # ------------------------------------------------------------ finish
+
+    def finish(self, params, anchor):
+        """Apply the landed broadcast; returns (params, anchor) trees."""
+        flight = self.flight
+        assert flight is not None
+        self.flight = None
+        flight["thread"].join()
+        box = flight["box"]
+        if "error" in box:
+            flight["path"].unlink(missing_ok=True)
+            raise box["error"]
+        for event in box["absorbed"]:
+            params, anchor = self._absorb(event, params, anchor)
+        event = box["completion"]
+        update_file = self.work_dir / event["path"]
+        flat = compress.read_delta(update_file)
+        names = flight["names"]
+        if set(flat) != set(names):
+            raise ValueError(
+                f"fragment {flight['frag']} partition mismatch: update "
+                f"carries {sorted(flat)}, worker expects {sorted(names)}"
+            )
+        params_flat = flat_leaf_map(params)
+        new_live, new_anchor = merge_corrected(
+            {n: params_flat[n] for n in names}, flight["snap"], flat
+        )
+        params = replace_leaves(params, new_live)
+        anchor = replace_leaves(anchor, new_anchor)
+        update_file.unlink(missing_ok=True)
+        flight["path"].unlink(missing_ok=True)
+        STREAM_METRICS.flight_finished(
+            time.monotonic() - flight["t0"], flight["compute_s"]
+        )
+        return params, anchor
+
+    def _absorb(self, event: dict, params, anchor):
+        """θ_q ← θ_q + u AND anchor_q ← anchor_q + u for a fragment not in
+        flight: Δ_q = θ_q − anchor_q is unchanged, because an outer update
+        is global progress, not this worker's."""
+        update_file = self.work_dir / event["path"]
+        flat = compress.read_delta(update_file)
+        params_flat = flat_leaf_map(params)
+        anchor_flat = flat_leaf_map(anchor)
+        unknown = set(flat) - set(params_flat)
+        if unknown:
+            raise ValueError(
+                f"broadcast update names unknown tensors: {sorted(unknown)}"
+            )
+        new_live = merge_update({n: params_flat[n] for n in flat}, flat)
+        new_anchor = merge_update({n: anchor_flat[n] for n in flat}, flat)
+        update_file.unlink(missing_ok=True)
+        return (
+            replace_leaves(params, new_live),
+            replace_leaves(anchor, new_anchor),
+        )
+
+    def abort(self) -> None:
+        """Loop is exiting with a sync still out: bounded join, then
+        abandon the daemon thread (the bridge teardown severs its SSE)."""
+        flight = self.flight
+        self.flight = None
+        if flight is None:
+            return
+        flight["thread"].join(5.0)
+        if flight["thread"].is_alive():
+            log.warning(
+                "stream sync round %d abandoned (broadcast never landed)",
+                flight["round"],
+            )
+            return
+        flight["path"].unlink(missing_ok=True)
 
 
 class TrainResult:
@@ -457,6 +738,30 @@ def run_training(
     delta_ef = (
         compress.ErrorFeedback() if wire_codec in compress.QUANT_CODECS else None
     )
+    # Streaming outer sync (hypha_tpu.stream): overlap/stream replace the
+    # blocking do_update with a background flight + delayed-update merge.
+    # The default stays "blocking" and takes the exact code path below.
+    sync_mode = getattr(cfg, "sync_mode", "blocking") or "blocking"
+    if sync_mode not in SYNC_MODES:
+        raise ValueError(
+            f"job {spec.job_id}: sync_mode must be {'|'.join(SYNC_MODES)}, "
+            f"got {sync_mode!r}"
+        )
+    stream_state: _WorkerStream | None = None
+    if sync_mode != "blocking":
+        if mh is not None:
+            # Multihost delta extraction is a collective gather the flight
+            # thread cannot drive; fail loudly like rejoin does.
+            _mh_done_bounded(mh)
+            raise ValueError(
+                f"job {spec.job_id}: streaming sync is not supported for "
+                "multihost replicas"
+            )
+        stream_state = _WorkerStream(session, cfg, work_dir, sync_mode, wire_codec)
+        log.info(
+            "streaming outer sync: mode=%s fragments=%d", sync_mode,
+            stream_state.F,
+        )
 
     if getattr(cfg, "rejoin", False):
         # Elastic rejoin (hypha_tpu.ft.rejoin): this replica was dispatched
@@ -610,14 +915,58 @@ def run_training(
                 )
         return resp.kind == ProgressResponseKind.CONTINUE
 
-    import os as _os
+    def begin_stream_sync() -> None:
+        """Ship the due fragment's Δ in the background; keep stepping.
+
+        Round accumulators reset HERE, not at merge time: batches run
+        while the sync is in flight belong to the NEXT delta (that is the
+        drift the correction preserves), so their samples and losses must
+        not be re-reported for this round.
+        """
+        nonlocal round_samples
+        assert stream_state is not None
+        session.send_status(Progress(kind=ProgressKind.UPDATE, job_id=spec.job_id))
+        stream_state.begin(round_num, state.params, anchor, round_samples)
+        mean_loss = float(np.mean(round_losses)) if round_losses else math.nan
+        session.send_status(
+            Progress(
+                kind=ProgressKind.METRICS,
+                job_id=spec.job_id,
+                round=round_num,
+                metrics={"loss": mean_loss, "samples": float(round_samples)},
+            )
+        )
+        round_samples = 0
+        round_losses.clear()
+
+    def finish_stream_sync() -> bool:
+        """The broadcast landed: merge with correction. True = continue."""
+        nonlocal state, anchor, round_num
+        assert stream_state is not None
+        new_params, new_anchor = stream_state.finish(state.params, anchor)
+        state = state.replace(params=new_params)
+        anchor = new_anchor
+        resp = session.send_status(
+            Progress(kind=ProgressKind.UPDATE_RECEIVED, job_id=spec.job_id)
+        )
+        round_num += 1
+        result.rounds = round_num
+        if ckpt_dir is not None and round_num % ckpt_every == 0:
+            save_train_checkpoint(
+                ckpt_dir,
+                state.params,
+                state.opt_state,
+                int(state.step),
+                round_offset + round_num,
+            )
+        return resp.kind == ProgressResponseKind.CONTINUE
 
     mh_timeout = float(
-        _os.environ.get(_MH_STEP_TIMEOUT_ENV, _MH_STEP_TIMEOUT_DEFAULT)
+        os.environ.get(_MH_STEP_TIMEOUT_ENV, _MH_STEP_TIMEOUT_DEFAULT)
     )
     mh_grace = max(
         mh_timeout,
-        float(_os.environ.get(_MH_COMPILE_GRACE_ENV, _MH_COMPILE_GRACE_DEFAULT)),
+        float(os.environ.get(_MH_COMPILE_GRACE_ENV, _MH_COMPILE_GRACE_DEFAULT)),
     )
     compiled_once = {"step": False, "merge": False, "gather": False}
 
@@ -638,13 +987,23 @@ def run_training(
             if should_stop is not None and should_stop():
                 log.info("cooperative stop requested; ending training loop")
                 break
+            # Merge a landed broadcast BEFORE the next step: a sync that
+            # completed with no intervening batch has zero drift and is
+            # bit-identical to blocking mode's merge.
+            if stream_state is not None and stream_state.poll():
+                if not finish_stream_sync():
+                    break
             if mh is not None:
                 state, metrics, loss = _with_deadline(
                     lambda b=batch: run_one(b), mh_bound("step"), "train step"
                 )
                 compiled_once["step"] = True
             else:
+                overlapping = stream_state is not None and stream_state.in_flight
+                bt0 = time.monotonic() if overlapping else 0.0
                 state, metrics, loss = run_one(batch)
+                if overlapping:
+                    stream_state.note_compute(time.monotonic() - bt0)
             round_losses.append(loss)
             result.losses.append(loss)
             result.batches += 1
@@ -664,7 +1023,9 @@ def run_training(
             if countdown is not None:
                 if countdown <= 0:
                     countdown = None
-                    if not do_update():
+                    if stream_state is not None:
+                        begin_stream_sync()
+                    elif not do_update():
                         break
                 else:
                     countdown -= 1
@@ -672,6 +1033,8 @@ def run_training(
                 log.warning("max_batches=%d reached; stopping", max_batches)
                 break
     finally:
+        if stream_state is not None:
+            stream_state.abort()
         if mh is not None:
             _mh_done_bounded(mh)  # followers must never hang on a dead leader
     log.info(

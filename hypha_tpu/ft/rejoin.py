@@ -51,14 +51,30 @@ class CatchupBuffer:
     when a rejoiner needs it. Empty until the first outer step — a worker
     joining during round 0 receives an empty catch-up (nothing to merge,
     θ₀ already is the global state).
+
+    Fragment-aware (hypha_tpu.stream): a streaming job's rounds each carry
+    ONE fragment's update, and the pipelined parameter server may fold
+    them as their broadcasts complete — not necessarily in global round
+    order. That is still exact: every tensor belongs to exactly one
+    fragment, so as long as each FRAGMENT's updates accumulate in its own
+    round order (they do — a fragment closes sequentially every F rounds),
+    each leaf's f32 additions happen in the same order a worker's merges
+    did, and θ₀ + Σ reproduces worker params bit-for-bit regardless of
+    how the fragments interleaved. ``fragment_rounds`` tracks the per-
+    fragment fold counts so tests (and the catch-up metadata) can assert
+    the interleaving never skipped a fragment round.
     """
 
     def __init__(self) -> None:
         self._cum: dict[str, np.ndarray] = {}
         self.rounds = 0  # outer updates accumulated so far
+        # fragment_id -> updates folded for it (None = unfragmented jobs).
+        self.fragment_rounds: dict[int | None, int] = {}
         self._written: tuple[int, str] | None = None  # (rounds, path) cache
 
-    def accumulate(self, update_path: Path | str) -> None:
+    def accumulate(
+        self, update_path: Path | str, fragment_id: int | None = None
+    ) -> None:
         """Fold one round's update file into the running sum.
 
         Decode-aware (hypha_tpu.compress.read_delta): a quantized (HQD1)
@@ -66,12 +82,17 @@ class CatchupBuffer:
         worker actually merged — so θ₀ + Σ reproduces their params
         exactly regardless of wire codec.
         """
-        self.accumulate_tree(read_delta(update_path))
+        self.accumulate_tree(read_delta(update_path), fragment_id=fragment_id)
 
-    def accumulate_tree(self, update: dict) -> None:
+    def accumulate_tree(
+        self, update: dict, fragment_id: int | None = None
+    ) -> None:
         """Fold one round's already-decoded update tree into the sum (the
         PS's broadcast encode returns exactly this tree — re-reading the
-        parameter-sized wire file would be pure waste)."""
+        parameter-sized wire file would be pure waste). ``fragment_id``
+        names the fragment a streaming round synced; leaves of other
+        fragments are untouched by construction (the update only carries
+        the due fragment's tensors)."""
         for key, value in update.items():
             arr = np.asarray(value, np.float32)
             prev = self._cum.get(key)
@@ -84,6 +105,9 @@ class CatchupBuffer:
             else:
                 prev += arr
         self.rounds += 1
+        self.fragment_rounds[fragment_id] = (
+            self.fragment_rounds.get(fragment_id, 0) + 1
+        )
 
     def write(self, path: Path | str) -> Path:
         """Materialize the sum for a catch-up push (atomic via temp name).

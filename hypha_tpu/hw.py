@@ -36,3 +36,27 @@ def interpret_default() -> bool:
     """Pallas interpret-mode default: interpret everywhere except on a
     TPU-class backend."""
     return not is_accelerator()
+
+
+def shard_map_compat(f, *, mesh, in_specs, out_specs, check_vma: bool = True):
+    """``jax.shard_map`` across jax versions — the ONE copy of the shim.
+
+    jax >= 0.6 exports ``shard_map`` at the top level with a ``check_vma``
+    kwarg; older releases keep it in ``jax.experimental.shard_map`` under
+    the ``check_rep`` spelling. Every shard_map site in the repo (ring
+    attention, pipeline parallelism, tests) goes through here so the next
+    jax API move is a one-line fix instead of a hunt.
+    """
+    try:
+        from jax import shard_map
+    except ImportError:
+        from jax.experimental.shard_map import shard_map as _experimental
+
+        return _experimental(
+            f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+            check_rep=check_vma,
+        )
+    return shard_map(
+        f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+        check_vma=check_vma,
+    )

@@ -33,6 +33,7 @@ __all__ = [
     "PROTOCOL_HEALTH",
     "PROTOCOL_PROGRESS",
     "PROTOCOL_GENERATE",
+    "PROTOCOL_STREAM",
     "TOPIC_WORKER",
     "TRAIN_EXECUTOR_NAME",
     "AGGREGATE_EXECUTOR_NAME",
@@ -63,6 +64,8 @@ __all__ = [
     # gossip
     "RequestWorker",
     "PriceRange",
+    # streaming outer sync
+    "FragmentTag",
     # value vocabulary
     "ExecutorDescriptor",
     "WorkerSpec",
@@ -90,6 +93,10 @@ PROTOCOL_API = "/hypha-api/0.0.1"
 PROTOCOL_HEALTH = "/hypha-health/0.0.1"
 PROTOCOL_PROGRESS = "/hypha-progress/0.0.1"
 PROTOCOL_GENERATE = "/hypha-generate/0.0.1"
+# Streaming outer sync (hypha_tpu.stream): the fragment-tagged tensor
+# pushes — fragment deltas up, per-fragment update broadcasts down — whose
+# headers carry a FragmentTag.
+PROTOCOL_STREAM = "/hypha-stream/0.0.1"
 TOPIC_WORKER = "hypha/worker"
 
 # Executor implementation names: what the scheduler asks for at auction and
@@ -504,6 +511,14 @@ class TrainExecutorConfig:
     # update + authoritative round counter) before entering the inner loop.
     # Additive field: absent on the wire = fresh start, old peers interop.
     rejoin: bool = False
+    # Streaming outer sync (hypha_tpu.stream): blocking | overlap | stream.
+    # overlap ships the round's delta in the background and keeps taking
+    # inner steps until the broadcast lands (delayed-update correction on
+    # merge); stream additionally partitions the tree into ``fragments``
+    # staggered fragments, one due per round. Additive fields: absent on
+    # the wire = blocking, bit-identical to pre-streaming peers.
+    sync_mode: str = "blocking"
+    fragments: int = 0  # stream mode: 0 = default (stream.DEFAULT_FRAGMENTS)
 
 
 @register
@@ -536,6 +551,12 @@ class AggregateExecutorConfig:
     # DECODED update — what workers actually merged — so θ_r stays exact.
     # Additive field: absent on the wire = f32 broadcast, old peers interop.
     delta_codec: str = "none"
+    # Streaming outer sync (hypha_tpu.stream), mirroring the train side:
+    # overlap/stream switch the PS to per-fragment round accumulators with
+    # pipelined (backgrounded) broadcast fan-out. Additive fields: absent
+    # on the wire = blocking, the seed's sequential round loop.
+    sync_mode: str = "blocking"
+    fragments: int = 0
 
 
 @register
@@ -835,6 +856,54 @@ class ProgressResponse:
 
 
 # --------------------------------------------------------------------------
+# /hypha-stream/0.0.1 — streaming outer sync (hypha_tpu.stream)
+# --------------------------------------------------------------------------
+
+
+@register
+@dataclass(slots=True)
+class FragmentTag:
+    """The (round, fragment) identity of one streamed tensor transfer.
+
+    Rides the push-stream resource header of every fragment delta upload
+    and per-fragment update broadcast (and, for HQD1 frames, is mirrored
+    into the frame header via ``compress.write_delta(tag=...)``), so the
+    parameter server can route a delta to the right per-fragment round
+    accumulator and a worker can match a broadcast to the sync it has in
+    flight. ``round`` is mandatory next to ``fragment_id`` — without it a
+    stale fragment could fold into the wrong round's mean (enforced
+    repo-wide by hypha-lint's ``msg-fragment-needs-round`` rule).
+    """
+
+    round: int = 0
+    fragment_id: int = 0
+    fragments: int = 1  # total fragment count (sanity cross-check)
+
+    def header(self) -> dict:
+        """The plain keys merged into a push resource header."""
+        return {
+            "round": self.round,
+            "fragment_id": self.fragment_id,
+            "fragments": self.fragments,
+        }
+
+    @classmethod
+    def from_header(cls, header: Any) -> "FragmentTag | None":
+        """Parse a push resource header; None when untagged (non-stream
+        senders) or malformed (treated as untagged, logged by callers)."""
+        if not isinstance(header, dict) or "fragment_id" not in header:
+            return None
+        try:
+            return cls(
+                round=int(header.get("round", 0)),
+                fragment_id=int(header["fragment_id"]),
+                fragments=max(int(header.get("fragments", 1)), 1),
+            )
+        except (TypeError, ValueError):
+            return None
+
+
+# --------------------------------------------------------------------------
 # Gossip: worker request ad (lib.rs:122-134)
 # --------------------------------------------------------------------------
 
@@ -874,6 +943,7 @@ declare_protocol(
 declare_protocol(PROTOCOL_HEALTH, "HealthRequest", "HealthResponse")
 declare_protocol(PROTOCOL_PROGRESS, "Progress", "ProgressResponse")
 declare_protocol(PROTOCOL_GENERATE, "GenerateRequest", "GenerateResponse")
+declare_protocol(PROTOCOL_STREAM, "FragmentTag")
 declare_protocol(f"gossip:{TOPIC_WORKER}", "RequestWorker")
 declare_values(
     "LRScheduler",

@@ -324,6 +324,21 @@ class JobSection:
             "(int8/int4 = chunkwise quantization + error feedback)"
         },
     )
+    sync_mode: str = field(
+        default="blocking",
+        metadata={
+            "doc": "outer sync: blocking (ship, wait, merge) | overlap "
+            "(upload + broadcast hidden behind inner steps) | stream "
+            "(overlap + staggered parameter fragments)"
+        },
+    )
+    num_fragments: int = field(
+        default=0,
+        metadata={
+            "doc": "stream mode: parameter fragments per round cycle "
+            "(0 = default 4); each fragment syncs every num_fragments rounds"
+        },
+    )
 
     def validate(self) -> None:
         if self.kind not in ("train", "serve"):
@@ -355,6 +370,15 @@ class JobSection:
                 f"job.delta_codec must be one of {'|'.join(CODECS)}, "
                 f"got {self.delta_codec!r}"
             )
+        from .stream import SYNC_MODES
+
+        if self.sync_mode not in SYNC_MODES:
+            raise ConfigError(
+                f"job.sync_mode must be one of {'|'.join(SYNC_MODES)}, "
+                f"got {self.sync_mode!r}"
+            )
+        if self.num_fragments < 0:
+            raise ConfigError("job.num_fragments must be >= 0 (0 = default)")
         if self.round_deadline_s < 0:
             raise ConfigError("job.round_deadline_s must be >= 0")
         if self.phi_threshold <= 0:
@@ -420,6 +444,8 @@ class JobSection:
             checkpoint_dir=self.checkpoint_dir or None,
             checkpoint_every=self.checkpoint_every,
             delta_codec=self.delta_codec,
+            sync_mode=self.sync_mode,
+            num_fragments=self.num_fragments,
             ft=(
                 FTConfig(
                     quorum_fraction=self.quorum_fraction,

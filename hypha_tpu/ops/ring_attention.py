@@ -20,7 +20,8 @@ import jax
 import jax.numpy as jnp
 from einops import repeat
 from jax.sharding import Mesh, PartitionSpec as P
-from jax import shard_map
+
+from ..hw import shard_map_compat as shard_map
 
 __all__ = ["make_ring_attention", "ring_attention"]
 

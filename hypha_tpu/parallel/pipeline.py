@@ -111,10 +111,11 @@ def merge_block_params(outer: Any, stacked: Any, prefix: str = "h_"):
 
 
 def _make_pipe(block_apply, mesh, n_micro: int, dp_axis: str):
-    from jax import shard_map
     from jax.sharding import PartitionSpec as P
 
-    return shard_map(
+    from ..hw import shard_map_compat
+
+    return shard_map_compat(
         lambda stacked, x: pipeline_blocks(block_apply, stacked, x, n_micro),
         mesh=mesh,
         in_specs=(P("pp"), P(dp_axis)),

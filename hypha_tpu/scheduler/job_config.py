@@ -100,6 +100,14 @@ class DiLoCoJob:
     # restart. None keeps the seed's all-or-abort semantics; max_attempts
     # full restarts remain the last resort either way.
     ft: FTConfig | None = None
+    # Streaming outer sync (hypha_tpu.stream): blocking | overlap | stream.
+    # "overlap" ships each round's Δθ in the background and keeps taking
+    # inner steps until the broadcast lands (delayed-update correction);
+    # "stream" additionally partitions the tree into num_fragments
+    # staggered fragments, one due per round, cutting peak bytes-in-flight
+    # ~F×. "blocking" (default) is bit-identical to pre-streaming rounds.
+    sync_mode: str = "blocking"
+    num_fragments: int = 0  # stream mode; 0 = stream.DEFAULT_FRAGMENTS
 
     def __post_init__(self) -> None:
         if self.delta_dtype not in ("float32", "bfloat16"):
@@ -112,6 +120,14 @@ class DiLoCoJob:
             raise ValueError(
                 f"delta_codec must be {'|'.join(CODECS)}, got {self.delta_codec!r}"
             )
+        from ..stream import SYNC_MODES
+
+        if self.sync_mode not in SYNC_MODES:
+            raise ValueError(
+                f"sync_mode must be {'|'.join(SYNC_MODES)}, got {self.sync_mode!r}"
+            )
+        if self.num_fragments < 0:
+            raise ValueError("num_fragments must be >= 0 (0 = default)")
         if self.rounds.update_rounds <= 0:
             raise ValueError("update_rounds must be positive")
         if self.rounds.avg_samples_between_updates <= 0:

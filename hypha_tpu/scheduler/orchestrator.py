@@ -313,6 +313,8 @@ class Orchestrator:
                     lora=job.lora,
                     delta_dtype=job.delta_dtype,
                     delta_codec=job.delta_codec,
+                    sync_mode=job.sync_mode,
+                    fragments=job.num_fragments,
                     rejoin=rejoin,
                     checkpoint=(
                         {
@@ -461,6 +463,10 @@ class Orchestrator:
                             # receive side sniffs frames, so one field is
                             # enough for both directions.
                             delta_codec=job.delta_codec,
+                            # Workers and the PS must agree on the fragment
+                            # schedule, so both sides get the same pair.
+                            sync_mode=job.sync_mode,
+                            fragments=job.num_fragments,
                         ),
                     ),
                 ),

@@ -1,0 +1,65 @@
+"""Deterministic, size-balanced partition of a parameter tree.
+
+The parameter server and every worker must agree on which tensors form
+fragment ``k`` WITHOUT exchanging a manifest: a rejoiner may be dispatched
+mid-job, and the PS never holds the full parameter tree (it learns tensor
+names from the first delta frames it decodes). So the partition is a pure
+function of the flat tensor names and element counts — both ends already
+share those exactly (serialization.flatten_tree names are the wire
+contract) — and of nothing else: no dict order, no hash seeds, no floats.
+
+Algorithm: greedy longest-processing-time bin packing. Tensors sorted by
+(size descending, name ascending) are assigned one by one to the lightest
+fragment (ties broken by fragment index). LPT keeps the largest fragment
+within ~4/3 of optimal, which is what bounds peak bytes-in-flight in
+stream mode; the name tiebreaks make the result reproducible across
+processes, Python versions and insertion orders.
+"""
+
+from __future__ import annotations
+
+from typing import Mapping
+
+__all__ = ["partition_names", "fragment_of"]
+
+
+def partition_names(
+    sizes: Mapping[str, int], fragments: int
+) -> list[tuple[str, ...]]:
+    """Split tensor names into ``fragments`` size-balanced groups.
+
+    ``sizes`` maps flat tensor name -> element count (any non-negative
+    weight works; byte counts give the same split for a uniform dtype).
+    Returns a list of ``fragments`` name tuples, each sorted by name;
+    every input name appears in exactly one tuple. Deterministic: the
+    result depends only on the (name, size) multiset.
+    """
+    if fragments < 1:
+        raise ValueError(f"fragments must be >= 1, got {fragments}")
+    if fragments > 1 and len(sizes) < fragments:
+        # An empty fragment's round would ship empty deltas and crash the
+        # parameter server's outer step ("no deltas folded") — refuse the
+        # misconfiguration up front, where the message can name the fix.
+        raise ValueError(
+            f"cannot split {len(sizes)} tensors into {fragments} fragments; "
+            f"lower the job's num_fragments to at most {max(len(sizes), 1)}"
+        )
+    bins: list[list[str]] = [[] for _ in range(fragments)]
+    loads = [0] * fragments
+    # Sort by size DESC then name ASC: LPT order, fully tie-stable.
+    for name in sorted(sizes, key=lambda n: (-int(sizes[n]), n)):
+        i = min(range(fragments), key=lambda k: (loads[k], k))
+        bins[i].append(name)
+        loads[i] += int(sizes[name])
+    return [tuple(sorted(b)) for b in bins]
+
+
+def fragment_of(
+    sizes: Mapping[str, int], fragments: int
+) -> dict[str, int]:
+    """Inverse view: flat tensor name -> fragment index."""
+    out: dict[str, int] = {}
+    for idx, names in enumerate(partition_names(sizes, fragments)):
+        for name in names:
+            out[name] = idx
+    return out

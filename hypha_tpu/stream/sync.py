@@ -1,0 +1,106 @@
+"""Fragment schedule and the delayed-update correction algebra.
+
+Blocking DiLoCo merges the broadcast update ``u`` while compute is paused:
+
+    θ ← θ_s + u ;  anchor ← θ            (θ_s = params at delta time)
+
+Overlapped sync keeps stepping while ``u`` is in flight, so by merge time
+the live params are θ_l = θ_s + d (``d`` = inner-step drift accrued during
+flight). Folding ``u`` into θ_l naively and re-anchoring there would fold
+``d`` into the anchor too — the drift would never be shipped, and the next
+pseudo-gradient would silently exclude it. The correction re-anchors at
+the SEND-TIME snapshot instead:
+
+    θ      ← θ_l + u          (drift kept in the live params)
+    anchor ← θ_s + u          (drift excluded from the anchor)
+
+so Δθ_next = θ − anchor starts at exactly ``d``: the in-flight work rides
+the next delta rather than vanishing. With zero flight time (d = 0) both
+assignments coincide with blocking's — streaming is bit-identical to
+blocking in that limit (pinned by tests/test_stream.py).
+
+Everything operates on FLAT ``{name: array}`` dicts (the wire format's
+view of the tree), restricted to one fragment's names, and reuses the
+jitted tree ops from :mod:`hypha_tpu.executor.diloco` so the merge math is
+the same compiled code blocking mode runs.
+"""
+
+from __future__ import annotations
+
+from typing import Mapping
+
+__all__ = [
+    "SYNC_MODES",
+    "DEFAULT_FRAGMENTS",
+    "fragment_due",
+    "effective_fragments",
+    "merge_corrected",
+]
+
+# Per-job outer-sync modes (DiLoCoJob.sync_mode / JobSection.sync_mode):
+#   blocking — ship Δθ, wait for the broadcast, merge (the seed behavior);
+#   overlap  — one fragment (the whole tree) synced in the background while
+#              inner steps continue;
+#   stream   — F staggered fragments, one due per round, overlapped.
+SYNC_MODES = ("blocking", "overlap", "stream")
+
+# Streaming DiLoCo's ablations hold up to ~F=8; 4 is the paper's headline
+# configuration and what `stream` uses when the job doesn't pick.
+DEFAULT_FRAGMENTS = 4
+
+
+def fragment_due(round_num: int, fragments: int) -> int:
+    """The staggered schedule: fragment ``r mod F`` syncs at round ``r``.
+
+    Every fragment syncs exactly once per F consecutive rounds, and its
+    delta covers the F rounds of inner steps since its previous sync.
+    """
+    if fragments < 1:
+        raise ValueError(f"fragments must be >= 1, got {fragments}")
+    return round_num % fragments
+
+
+def effective_fragments(sync_mode: str, fragments: int = 0) -> int:
+    """Resolve the fragment count for a job's sync mode.
+
+    ``blocking`` and ``overlap`` sync the whole tree as one fragment;
+    ``stream`` uses the job's ``fragments`` (0 = :data:`DEFAULT_FRAGMENTS`).
+    """
+    if sync_mode not in SYNC_MODES:
+        raise ValueError(
+            f"sync_mode must be {'|'.join(SYNC_MODES)}, got {sync_mode!r}"
+        )
+    if sync_mode != "stream":
+        return 1
+    if fragments < 0:
+        raise ValueError(f"fragments must be >= 0, got {fragments}")
+    return int(fragments) or DEFAULT_FRAGMENTS
+
+
+def merge_corrected(
+    live: Mapping[str, object],
+    snapshot: Mapping[str, object],
+    update: Mapping[str, object],
+) -> tuple[dict, dict]:
+    """Apply one fragment's outer update with the delayed-update correction.
+
+    ``live``     — the fragment's CURRENT params (θ_l = θ_s + drift);
+    ``snapshot`` — the fragment's params when its delta was taken (θ_s);
+    ``update``   — the decoded broadcast update ``u`` for the fragment.
+
+    Returns ``(new_live, new_anchor)`` = (θ_l + u, θ_s + u) as flat dicts.
+    Keys must match exactly — a mismatch means the two ends disagreed on
+    the partition, which must fail loudly, not merge a partial fragment.
+    """
+    if set(live) != set(update) or set(snapshot) != set(update):
+        raise ValueError(
+            "fragment key mismatch: "
+            f"live={sorted(live)} snapshot={sorted(snapshot)} "
+            f"update={sorted(update)}"
+        )
+    from ..executor.diloco import merge_update
+
+    live_d = {k: live[k] for k in update}
+    snap_d = {k: snapshot[k] for k in update}
+    upd_d = dict(update)
+    return merge_update(live_d, upd_d), merge_update(snap_d, upd_d)

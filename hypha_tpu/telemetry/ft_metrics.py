@@ -1,17 +1,29 @@
-"""Fault-tolerance instruments: one shared bundle for the ft subsystem.
+"""Fault-tolerance and streaming-sync instruments: shared bundles.
 
 The φ detector, elastic parameter server and rejoin path all record into a
 process-global :data:`FT_METRICS` bundle so in-process tests and ``bench.py
 --chaos`` can read one snapshot regardless of which component did the work.
-``register_on`` exposes the same values as observable gauges on a real
+:data:`STREAM_METRICS` does the same for the streaming outer sync
+(hypha_tpu.stream): the training executor's flight thread and the
+parameter server's per-fragment round loop both record here, and
+``benchmarks/streambench.py`` reads one snapshot per mode. ``register_on``
+exposes both bundles as observable gauges on a real
 :class:`~hypha_tpu.telemetry.Meter` for OTLP export.
 """
 
 from __future__ import annotations
 
+import threading
+
 from . import Counter, Histogram, Meter
 
-__all__ = ["FTMetrics", "FT_METRICS", "register_on"]
+__all__ = [
+    "FTMetrics",
+    "FT_METRICS",
+    "StreamMetrics",
+    "STREAM_METRICS",
+    "register_on",
+]
 
 
 class FTMetrics:
@@ -44,8 +56,129 @@ class FTMetrics:
 FT_METRICS = FTMetrics()
 
 
-def register_on(meter: Meter, metrics: FTMetrics = FT_METRICS) -> None:
-    """Export the bundle through a Meter as observable gauges."""
+class StreamMetrics:
+    """Streaming outer-sync instruments (hypha_tpu.stream).
+
+    * ``bytes_in_flight``      — encoded delta bytes currently uploading /
+      awaiting their broadcast on this worker (gauge semantics: flights
+      add on launch, subtract on merge); ``peak_bytes_in_flight`` keeps
+      the high-water mark — the number stream mode's F-way staggering is
+      built to shrink.
+    * ``overlap_fraction``     — of the wall-clock the sync spent in
+      flight, the fraction the worker was computing inner steps instead
+      of idling (0 in blocking mode, →1 when flight fully hides behind
+      compute).
+    * ``fragment_closes``      — per-fragment round-close counters on the
+      parameter server (a stuck fragment shows up as one counter falling
+      behind its siblings).
+    """
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()  # flight thread + loop both record
+        self._in_flight = 0.0
+        self.peak_bytes_in_flight = 0.0
+        self.flight_seconds = 0.0
+        self.overlapped_seconds = 0.0
+        self.synced_fragments = Counter("hypha.stream.synced_fragments")
+        self.fragment_closes: dict[int, Counter] = {}
+        # Meters registered via register_on: fragment ids only become known
+        # as rounds close, so their counters attach to every registered
+        # meter lazily at creation time.
+        self._meters: list[Meter] = []
+
+    def flight_started(self, nbytes: float) -> None:
+        with self._lock:
+            self._in_flight += nbytes
+            self.peak_bytes_in_flight = max(
+                self.peak_bytes_in_flight, self._in_flight
+            )
+
+    def flight_landed(self, nbytes: float) -> None:
+        """The flight thread is done with the wire — broadcast received OR
+        the flight died (send error / severed bridge). Always paired with
+        :meth:`flight_started` from the thread's exit path, so a failed
+        job can never read as mid-upload for the process lifetime."""
+        with self._lock:
+            self._in_flight = max(0.0, self._in_flight - nbytes)
+
+    def flight_finished(self, flight_s: float, overlapped_s: float) -> None:
+        """One sync completed end to end (merge applied)."""
+        with self._lock:
+            self.flight_seconds += flight_s
+            # Compute can't overlap more than the flight lasted (timer skew).
+            self.overlapped_seconds += min(max(overlapped_s, 0.0), flight_s)
+        self.synced_fragments.add(1)
+
+    def bytes_in_flight(self) -> float:
+        with self._lock:
+            return self._in_flight
+
+    def overlap_fraction(self) -> float:
+        with self._lock:
+            if self.flight_seconds <= 0.0:
+                return 0.0
+            return self.overlapped_seconds / self.flight_seconds
+
+    def fragment_closed(self, fragment_id: int) -> None:
+        """One (round, fragment) closed on the parameter server."""
+        with self._lock:
+            counter = self.fragment_closes.get(fragment_id)
+            created = counter is None
+            if created:
+                counter = Counter(
+                    f"hypha.stream.fragment_closes.{fragment_id}"
+                )
+                self.fragment_closes[fragment_id] = counter
+            meters = list(self._meters) if created else []
+        for meter in meters:
+            meter.observable_gauge(counter.name, counter.value)
+        counter.add(1)
+
+    def attach_meter(self, meter: Meter) -> None:
+        """Export per-fragment close counters on ``meter``, including any
+        fragment that only closes after this call (OTLP surface for 'one
+        fragment falling behind its siblings')."""
+        with self._lock:
+            self._meters.append(meter)
+            existing = list(self.fragment_closes.values())
+        for counter in existing:
+            meter.observable_gauge(counter.name, counter.value)
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            closes = {
+                fid: c.value() for fid, c in sorted(self.fragment_closes.items())
+            }
+            flight_s = self.flight_seconds
+            overlapped_s = self.overlapped_seconds
+            in_flight = self._in_flight
+            peak = self.peak_bytes_in_flight
+        return {
+            "bytes_in_flight": in_flight,
+            "peak_bytes_in_flight": peak,
+            "flight_seconds": flight_s,
+            "overlapped_seconds": overlapped_s,
+            "overlap_fraction": (
+                overlapped_s / flight_s if flight_s > 0 else 0.0
+            ),
+            "synced_fragments": self.synced_fragments.value(),
+            "fragment_closes": closes,
+        }
+
+    def reset(self) -> None:
+        """Fresh instruments (tests and streambench isolate runs this way)."""
+        self.__init__()
+
+
+STREAM_METRICS = StreamMetrics()
+
+
+def register_on(
+    meter: Meter,
+    metrics: FTMetrics = FT_METRICS,
+    stream: StreamMetrics = STREAM_METRICS,
+) -> None:
+    """Export the bundles through a Meter as observable gauges."""
     meter.observable_gauge(
         "hypha.ft.suspected_peers", metrics.suspected_peers.value
     )
@@ -56,3 +189,19 @@ def register_on(meter: Meter, metrics: FTMetrics = FT_METRICS) -> None:
         "hypha.ft.stale_deltas_dropped", metrics.stale_deltas_dropped.value
     )
     meter.observable_gauge("hypha.ft.rejoins", metrics.rejoins.value)
+    meter.observable_gauge(
+        "hypha.stream.bytes_in_flight", stream.bytes_in_flight
+    )
+    meter.observable_gauge(
+        "hypha.stream.peak_bytes_in_flight",
+        lambda: stream.peak_bytes_in_flight,
+    )
+    meter.observable_gauge(
+        "hypha.stream.overlap_fraction", stream.overlap_fraction
+    )
+    meter.observable_gauge(
+        "hypha.stream.synced_fragments", stream.synced_fragments.value
+    )
+    # Per-fragment close counters attach lazily — fragment ids only exist
+    # once the PS closes their first round.
+    stream.attach_meter(meter)

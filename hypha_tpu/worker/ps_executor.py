@@ -51,6 +51,7 @@ from ..ft.rejoin import CATCHUP_KEY, CatchupBuffer
 from ..messages import (
     PROTOCOL_PROGRESS,
     Ack,
+    FragmentTag,
     JobSpec,
     Progress,
     ProgressKind,
@@ -59,7 +60,8 @@ from ..messages import (
     TransferStrategy,
 )
 from ..network.node import Node, RequestError
-from ..telemetry.ft_metrics import FT_METRICS
+from ..stream import effective_fragments, fragment_due
+from ..telemetry.ft_metrics import FT_METRICS, STREAM_METRICS
 from .job_manager import Execution, JobExecutor
 
 __all__ = ["ParameterServerExecutor"]
@@ -245,7 +247,19 @@ class ParameterServerExecutor(JobExecutor):
             if bcast_codec in compress.QUANT_CODECS
             else None
         )
+        sync_mode = getattr(cfg, "sync_mode", "blocking") or "blocking"
         try:
+            if sync_mode != "blocking":
+                # Streaming outer sync (hypha_tpu.stream): per-fragment
+                # round accumulators, pipelined broadcast fan-out. The
+                # blocking loop below stays byte-identical for the default.
+                await self._stream_rounds(
+                    execution, job_id, cfg, scheduler_peer, work_dir,
+                    consumer, elastic, allowed, num_workers,
+                    momentum_file, ckpt_dir, lr, mu, bcast_codec,
+                    effective_fragments(sync_mode, getattr(cfg, "fragments", 0)),
+                )
+                return
             while True:
                 accum = _RoundAccum()
                 if elastic is not None:
@@ -317,6 +331,43 @@ class ParameterServerExecutor(JobExecutor):
                 membership_reg.close()
             consumer.close()
             await asyncio.to_thread(shutil.rmtree, work_dir, ignore_errors=True)
+
+    @staticmethod
+    async def _classify_push(push, job_id: str, members, round_num: int):
+        """Shared triage for the elastic and streaming collectors.
+
+        Returns the round the delta claims, or None when the push was
+        dropped (non-member sender, or stale — its round already
+        aggregated); dropped pushes are drained so the sender's accept
+        slot is released. One copy of these checks, so a fix (like PR 1's
+        epoch gating) cannot silently reach only one sync mode.
+
+        ``members=None`` means "no allowlist" (a plain job whose config
+        names no peers). An EMPTY set stays strict — elastic membership
+        with every worker evicted must drop everything, not open up.
+        """
+        peer = push.peer
+        if members is not None and peer not in members:
+            log.warning(
+                "ps %s: push from non-member peer %s dropped", job_id, peer
+            )
+            await push.read_all()
+            return None
+        delta_round = round_num
+        if isinstance(push.resource, dict) and "round" in push.resource:
+            try:
+                delta_round = int(push.resource["round"])
+            except (TypeError, ValueError):
+                delta_round = round_num
+        if delta_round < round_num:
+            log.warning(
+                "ps %s: stale delta for round %d from %s dropped (now %d)",
+                job_id, delta_round, peer, round_num,
+            )
+            FT_METRICS.stale_deltas_dropped.add(1)
+            await push.read_all()
+            return None
+        return delta_round
 
     @staticmethod
     async def _fold(
@@ -422,28 +473,13 @@ class ParameterServerExecutor(JobExecutor):
             except asyncio.TimeoutError:
                 continue
             peer = push.peer
-            if peer not in st.membership.active:
-                log.warning(
-                    "ps %s: push from non-member peer %s dropped", job_id, peer
-                )
-                await push.read_all()
-                continue
-            delta_round = round_num
-            if isinstance(push.resource, dict) and "round" in push.resource:
-                try:
-                    delta_round = int(push.resource["round"])
-                except (TypeError, ValueError):
-                    delta_round = round_num
-            if delta_round < round_num:
-                # Stale: the round it belongs to already aggregated (its
-                # sender was past the deadline / partitioned). Folding it
-                # into the current mean would double-apply old progress.
-                log.warning(
-                    "ps %s: stale delta for round %d from %s dropped (now %d)",
-                    job_id, delta_round, peer, round_num,
-                )
-                FT_METRICS.stale_deltas_dropped.add(1)
-                await push.read_all()
+            # Stale = the round it belongs to already aggregated (its
+            # sender was past the deadline / partitioned); folding it into
+            # the current mean would double-apply old progress.
+            delta_round = await self._classify_push(
+                push, job_id, st.membership.active, round_num
+            )
+            if delta_round is None:
                 continue
             # Retire any superseded duplicate BEFORE saving: _save_delta
             # names files delta-{round}-{sha(peer)}, so a re-send lands on
@@ -486,13 +522,350 @@ class ParameterServerExecutor(JobExecutor):
             )
         return received
 
+    # ------------------------------------------------------- streaming sync
+
+    async def _stream_rounds(
+        self,
+        execution,
+        job_id: str,
+        cfg,
+        scheduler_peer: str,
+        work_dir: Path,
+        consumer,
+        elastic: "_ElasticState | None",
+        allowed: set[str],
+        num_workers: int,
+        momentum_file: Path,
+        ckpt_dir: Path | None,
+        lr: float,
+        mu: float,
+        bcast_codec: str,
+        fragments: int,
+    ) -> None:
+        """The pipelined round loop for ``sync_mode: overlap | stream``.
+
+        Differences from the blocking loop above:
+
+          * deltas fold into PER-ROUND accumulators keyed by their
+            ``FragmentTag`` the moment they land — a delta for a round
+            that has not opened yet (its sender merged the previous
+            broadcast before a straggler shipped) folds into that round's
+            own accumulator instead of parking un-aggregated;
+          * the broadcast fan-out runs as a BACKGROUND task: the loop
+            proceeds to collecting the next round's fragment while the
+            previous update is still streaming to slow peers, so one slow
+            link no longer gates every round. Fan-outs of the SAME
+            fragment are chained (round r+F waits for round r) so a
+            worker can never receive them out of round order; different
+            fragments overlap freely, and total in-flight fan-outs are
+            capped at the fragment count as memory backpressure;
+          * the rejoin catch-up accumulates at round-close time, in round
+            order, so θ₀ + Σ stays exact even when fragment broadcasts
+            complete out of order (CatchupBuffer's fragment-wise argument).
+
+        Error feedback is per fragment on the broadcast side: one shared
+        residual would be clobbered by the next fragment's absorb.
+        """
+        accums: dict[int, _RoundAccum] = {}
+        pending: dict[int, dict[str, tuple[Path, float]]] = {}
+        bcast_efs: dict[int, "compress.ErrorFeedback | None"] = {}
+        bcast_tasks: set[asyncio.Task] = set()
+        last_bcast: dict[int, asyncio.Task] = {}  # fragment -> newest fan-out
+        quant = bcast_codec in compress.QUANT_CODECS
+        round_num = 0
+        try:
+            while True:
+                received = await self._collect_round_stream(
+                    consumer, job_id, cfg, elastic, allowed, num_workers,
+                    work_dir, round_num, fragments, accums, pending,
+                )
+                frag = fragment_due(round_num, fragments)
+                tag = FragmentTag(
+                    round=round_num, fragment_id=frag, fragments=fragments
+                )
+                accum = accums.pop(round_num, None)
+                update_path = await asyncio.to_thread(
+                    self._outer_step,
+                    received, momentum_file, lr, mu, work_dir, round_num,
+                    accum,
+                )
+                if frag not in bcast_efs:
+                    bcast_efs[frag] = (
+                        compress.ErrorFeedback() if quant else None
+                    )
+                wire_path, sent_update = await asyncio.to_thread(
+                    self._encode_broadcast,
+                    update_path, bcast_codec, bcast_efs[frag], work_dir,
+                    round_num, tag.header(),
+                )
+                if ckpt_dir is not None:
+                    self._checkpoint_momentum(momentum_file, ckpt_dir)
+                # Notify BEFORE broadcasting (same race note as the
+                # blocking loop: the scheduler must have advanced the
+                # round before any worker's UpdateReceived).
+                response = await self._notify_updated(
+                    scheduler_peer, job_id, round_num
+                )
+                # Freeze the fan-out's peer set at CLOSE time: the
+                # backgrounded push must not pick up a rejoiner who joins
+                # while it is pending — that peer's catch-up (served
+                # below) already folds this round, and the blocking loop's
+                # "a rejoiner never sees an update it must skip" invariant
+                # should survive the pipelining. (The worker additionally
+                # stale-drops by round tag, as defense in depth.)
+                bcast_peers = (
+                    list(elastic.membership.active)
+                    if elastic is not None
+                    else None
+                )
+                if elastic is not None:
+                    # Catch-up accumulation at CLOSE time, in close order —
+                    # never from the background broadcast, whose completion
+                    # order is unordered across fragments.
+                    if sent_update is None:
+                        await asyncio.to_thread(
+                            elastic.catchup.accumulate, wire_path, frag
+                        )
+                    else:
+                        await asyncio.to_thread(
+                            elastic.catchup.accumulate_tree, sent_update, frag
+                        )
+                last_bcast[frag] = aio.spawn(
+                    self._broadcast_and_cleanup(
+                        cfg, update_path, wire_path, received, round_num,
+                        tag, elastic,
+                        # Per-fragment ordering barrier: round r+F's fan-out
+                        # for fragment p waits for round r's (see
+                        # _broadcast_and_cleanup).
+                        after=last_bcast.get(frag),
+                        peers=bcast_peers,
+                    ),
+                    tasks=bcast_tasks,
+                    what=f"stream broadcast r{round_num}",
+                    logger=log,
+                )
+                STREAM_METRICS.fragment_closed(frag)
+                round_num += 1
+                if elastic is not None:
+                    await self._serve_joins(elastic, cfg, round_num, work_dir)
+                # Memory backpressure only (ordering is the chain above):
+                # bound the round files held by un-finished fan-outs to
+                # roughly one cycle of fragments.
+                live = [t for t in bcast_tasks if not t.done()]
+                if len(live) >= max(fragments, 1) + 1:
+                    await asyncio.wait(
+                        live, return_when=asyncio.FIRST_COMPLETED
+                    )
+                if response.kind == ProgressResponseKind.DONE:
+                    # The final update must still reach the workers — their
+                    # DONE comes with the UpdateReceived it triggers.
+                    await aio.wait_quiet(*bcast_tasks, timeout=60.0)
+                    execution.finish("completed")
+                    return
+        finally:
+            await aio.reap(*bcast_tasks)
+
+    async def _collect_round_stream(
+        self,
+        consumer,
+        job_id: str,
+        cfg,
+        st: "_ElasticState | None",
+        allowed: set[str],
+        num_workers: int,
+        work_dir: Path,
+        round_num: int,
+        fragments: int,
+        accums: dict[int, "_RoundAccum"],
+        pending: dict[int, dict[str, tuple[Path, float]]],
+    ) -> dict[str, tuple[Path, float]]:
+        """Gather one round's FRAGMENT deltas: peer -> (path, samples).
+
+        Every arriving delta folds into the accumulator of the round its
+        ``FragmentTag`` names — the current round or a future one (whose
+        collect hasn't opened yet) — so aggregation work always overlaps
+        the wire. Close conditions match the non-stream paths: all of
+        ``num_workers`` reported (plain), or quorum+deadline (elastic).
+        """
+        received = pending.pop(round_num, {})
+        frag = fragment_due(round_num, fragments)
+        loop = asyncio.get_running_loop()
+        deadline = None
+        if st is not None and st.round_deadline_s > 0:
+            deadline = loop.time() + st.round_deadline_s
+        deadline_logged = False
+        while True:
+            if st is not None:
+                await self._serve_joins(st, cfg, round_num, work_dir)
+                expected = st.membership.expected() | set(received)
+                quorate = len(received) >= st.quorum()
+                if received and quorate and set(received) >= expected:
+                    break
+                now = loop.time()
+                if deadline is not None and now >= deadline:
+                    if quorate:
+                        break
+                    if not deadline_logged:
+                        deadline_logged = True
+                        log.warning(
+                            "ps %s: round %d (fragment %d) deadline passed "
+                            "with %d/%d deltas; waiting for quorum",
+                            job_id, round_num, frag, len(received),
+                            st.quorum(),
+                        )
+                timeout = _ELASTIC_TICK_S
+                if deadline is not None and now < deadline:
+                    timeout = min(timeout, max(deadline - now, 0.05))
+            else:
+                if len(received) >= num_workers:
+                    break
+                timeout = None
+            try:
+                push = await consumer.next(timeout=timeout)
+            except asyncio.TimeoutError:
+                continue
+            peer = push.peer
+            members = (
+                st.membership.active
+                if st is not None
+                else (allowed or None)  # empty allowlist = unrestricted
+            )
+            delta_round = await self._classify_push(
+                push, job_id, members, round_num
+            )
+            if delta_round is None:
+                continue
+            tag = FragmentTag.from_header(push.resource)
+            if tag is not None and (
+                tag.fragments != fragments
+                or tag.fragment_id != fragment_due(delta_round, fragments)
+            ):
+                # A mis-partitioned sender would fold the wrong tensors
+                # into the mean — drop loudly rather than corrupt a round.
+                log.warning(
+                    "ps %s: fragment tag mismatch from %s "
+                    "(round %d fragment %d/%d, expected %d/%d); dropped",
+                    job_id, peer, delta_round, tag.fragment_id,
+                    tag.fragments, fragment_due(delta_round, fragments),
+                    fragments,
+                )
+                await push.read_all()
+                continue
+            accum = accums.setdefault(delta_round, _RoundAccum())
+            bucket = (
+                received
+                if delta_round == round_num
+                else pending.setdefault(delta_round, {})
+            )
+            # Save under a UNIQUE name, then validate, then retire any
+            # duplicate: validating first means a corrupt/relabeled
+            # re-send can never destroy the peer's already-folded good
+            # delta (retiring before save — the elastic path's rule — is
+            # only safe because that path has no post-save validation).
+            entry = await self._save_delta(
+                push, work_dir, delta_round,
+                name_suffix=f"-{uuid.uuid4().hex[:8]}",
+            )
+            if tag is not None and not await asyncio.to_thread(
+                self._frame_tag_matches, entry[0], tag
+            ):
+                # The sender's push header and what it baked into the HQD1
+                # frame disagree — a relabeled/replayed file. Trust neither.
+                log.warning(
+                    "ps %s: frame tag mismatch from %s (header %s); dropped",
+                    job_id, peer, tag,
+                )
+                entry[0].unlink(missing_ok=True)
+                continue
+            old = bucket.pop(peer, None)
+            if old is not None:
+                log.warning(
+                    "ps %s: duplicate delta from %s; replacing", job_id, peer
+                )
+                await self._fold(accum, old, sign=-1.0)
+                old[0].unlink(missing_ok=True)
+            bucket[peer] = entry
+            await self._fold(accum, entry)
+            log.info(
+                "ps %s: round %d fragment %d delta %d (from %s%s)",
+                job_id, round_num, frag,
+                len(received), peer,
+                "" if delta_round == round_num else f", parked r{delta_round}",
+            )
+        if st is not None:
+            full = max(cfg.num_workers, len(st.membership.active))
+            if len(received) < full:
+                FT_METRICS.degraded_rounds.add(1)
+                log.warning(
+                    "ps %s: round %d DEGRADED — aggregating %d of %d",
+                    job_id, round_num, len(received), full,
+                )
+        return received
+
+    @staticmethod
+    def _frame_tag_matches(path: Path, tag: FragmentTag) -> bool:
+        """Cross-check an HQD1 frame's baked-in tag against the push
+        header's (runs off-loop). Untagged frames (SafeTensors codecs,
+        pre-tag senders) pass — the header is then the only identity."""
+        baked = compress.frame_tag(path)
+        if baked is None:
+            return True
+        try:
+            return (
+                int(baked.get("round", tag.round)) == tag.round
+                and int(baked.get("fragment_id", tag.fragment_id))
+                == tag.fragment_id
+            )
+        except (TypeError, ValueError):
+            return False
+
+    async def _broadcast_and_cleanup(
+        self,
+        cfg,
+        update_path: Path,
+        wire_path: Path,
+        received: dict[str, tuple[Path, float]],
+        round_num: int,
+        tag: FragmentTag,
+        elastic: "_ElasticState | None",
+        after: "asyncio.Task | None" = None,
+        peers: list[str] | None = None,
+    ) -> None:
+        """One round's backgrounded fan-out plus its file retirement.
+
+        ``after`` chains this fan-out behind the SAME fragment's previous
+        broadcast: without the barrier, a slow peer link could deliver
+        round r+F's update for fragment p before round r's, and the
+        worker would merge the newer one and drop the older as stale —
+        silently losing an outer update. Different fragments still fan
+        out concurrently (disjoint tensors, the worker absorbs them in
+        any order). ``peers`` is the membership frozen at round close."""
+        if after is not None:
+            await aio.wait_quiet(after)
+        try:
+            await self._broadcast(
+                cfg, wire_path, round_num, elastic, extra_header=tag.header(),
+                peers_override=peers,
+            )
+        finally:
+            for path, _ in received.values():
+                path.unlink(missing_ok=True)
+            update_path.unlink(missing_ok=True)
+            if wire_path != update_path:
+                wire_path.unlink(missing_ok=True)
+
     @staticmethod
     async def _save_delta(
-        push, work_dir: Path, round_num: int
+        push, work_dir: Path, round_num: int, name_suffix: str = ""
     ) -> tuple[Path, float]:
-        """Save one pseudo-gradient push; returns (path, sample weight)."""
+        """Save one pseudo-gradient push; returns (path, sample weight).
+
+        ``name_suffix`` de-collides re-sends for callers that validate
+        after saving (the streaming collector) — without it a duplicate
+        lands on the SAME deterministic path as the entry it supersedes.
+        """
         name = hashlib.sha256(push.peer.encode()).hexdigest()[:24]
-        dest = work_dir / f"delta-{round_num}-{name}.safetensors"
+        dest = work_dir / f"delta-{round_num}-{name}{name_suffix}.safetensors"
         await push.save_to(dest)
         samples = 1.0
         if isinstance(push.resource, dict):
@@ -592,20 +965,23 @@ class ParameterServerExecutor(JobExecutor):
         ef: "compress.ErrorFeedback | None",
         work_dir: Path,
         round_num: int,
+        tag: dict | None = None,
     ) -> tuple[Path, "dict[str, np.ndarray] | None"]:
         """Re-encode the f32 update for the wire per the job's codec.
 
         int8/int4 write an HQD1 frame of Q(update + residual) and keep the
         new residual; bf16 casts the SafeTensors payload. "none" broadcasts
-        the f32 file untouched (the seed's format). Returns the wire path
-        plus the update AS RECEIVERS WILL DECODE IT (None for "none") so
-        the catch-up sum never re-reads and re-dequantizes the frame.
+        the f32 file untouched (the seed's format). ``tag`` stamps a
+        streaming round's (round, fragment) identity into HQD1 frames.
+        Returns the wire path plus the update AS RECEIVERS WILL DECODE IT
+        (None for "none") so the catch-up sum never re-reads and
+        re-dequantizes the frame.
         """
         if codec == "none":
             return update_path, None
         wire = work_dir / f"update-{round_num}.wire.safetensors"
         sent = compress.write_delta(
-            wire, dict(load_file(str(update_path))), codec, ef=ef
+            wire, dict(load_file(str(update_path))), codec, ef=ef, tag=tag
         )
         return wire, sent
 
@@ -620,7 +996,13 @@ class ParameterServerExecutor(JobExecutor):
         os.replace(tmp, ckpt_dir / "momentum.safetensors")
 
     async def _broadcast(
-        self, cfg, update_path: Path, round_num: int, elastic: "_ElasticState | None" = None
+        self,
+        cfg,
+        update_path: Path,
+        round_num: int,
+        elastic: "_ElasticState | None" = None,
+        extra_header: dict | None = None,
+        peers_override: list[str] | None = None,
     ) -> None:
         """Push the update tensor to every worker in parallel (:232-269 —
         the reference pushes one peer at a time and the slowest link gates
@@ -641,9 +1023,16 @@ class ParameterServerExecutor(JobExecutor):
             "name": update_path.name,
             "round": round_num,
         }
+        if extra_header:
+            header.update(extra_header)
         if elastic is not None:
             peers = list(elastic.membership.active)
             header["epoch"] = elastic.membership.epoch
+        if peers_override is not None:
+            # Pipelined rounds freeze the peer set at close time — a
+            # rejoiner joining mid-fan-out gets its catch-up, not this
+            # round's update (its catch-up already contains it).
+            peers = peers_override
         if not peers:
             return
         sem = asyncio.Semaphore(_BROADCAST_CONCURRENCY)

@@ -1,0 +1,33 @@
+"""Seeded fixture pair for hypha-lint's ``msg-fragment-needs-round`` rule.
+
+Deliberately NOT registered with hypha_tpu.messages (registration would
+leak into the live registry other tests lint); tests/test_lint.py passes
+these classes to ``proto_rules.check_fragment_tags`` as an explicit
+registry. ``FragBad`` must trip the rule — a fragment delta whose header
+has no round would fold into whichever round happens to be open on the
+parameter server. ``FragGood`` is the clean twin.
+"""
+
+# No `from __future__ import annotations`: stringified annotations make
+# dataclasses.fields() resolve against sys.modules[cls.__module__], which
+# an exec'd fixture module is deliberately absent from.
+from dataclasses import dataclass
+
+
+@dataclass(slots=True)
+class FragBad:
+    """Fragment identity with NO round tag: the rule must fire."""
+
+    fragment_id: int = 0
+    fragments: int = 4
+    payload_len: int = 0
+
+
+@dataclass(slots=True)
+class FragGood:
+    """Fragment identity paired with its round: the rule must stay quiet."""
+
+    round: int = 0
+    fragment_id: int = 0
+    fragments: int = 4
+    payload_len: int = 0

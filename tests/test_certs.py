@@ -13,6 +13,14 @@ import ssl
 
 import pytest
 
+# The PKI layer is built on the `cryptography` package; environments
+# without it (the jax_graft CI image) must skip cleanly instead of erroring
+# at collection — hypha_tpu.certs imports it at module scope.
+pytest.importorskip(
+    "cryptography",
+    reason="hypha_tpu.certs requires the 'cryptography' package",
+)
+
 from hypha_tpu import certs, certutil
 from hypha_tpu.messages import PROTOCOL_HEALTH, HealthRequest, HealthResponse
 from hypha_tpu.network.secure import secure_node

@@ -463,6 +463,9 @@ def test_flash_attention_matches_xla_reference():
         flash_attention(q, k, v, causal=True, block_k_bwd=200)
 
 
+@pytest.mark.slow  # 15-27 s each: recovered by the shard_map compat
+# shim but too heavy for the tier-1 wall-clock budget; `make test` minus
+# the marker filter still runs them
 def test_flash_attention_grad_matches_xla_reference():
     """jax.grad through the pallas flash kernel (custom VJP, interpret mode
     on CPU) vs grads of the dense XLA path — the differentiated train-step
@@ -521,6 +524,9 @@ def test_flash_attention_in_train_step():
     assert bool(jnp.isfinite(metrics["grad_norm"])) and float(metrics["grad_norm"]) > 0
 
 
+@pytest.mark.slow  # 15-27 s each: recovered by the shard_map compat
+# shim but too heavy for the tier-1 wall-clock budget; `make test` minus
+# the marker filter still runs them
 def test_moe_expert_parallel_matches_single_device():
     """ep>1 must actually EXECUTE (VERDICT r3 weak #2): on a dp2-ep2-tp2
     mesh the stacked expert tensors shard their leading axis over ep, and
@@ -566,6 +572,9 @@ def test_moe_expert_parallel_matches_single_device():
     )
 
 
+@pytest.mark.slow  # 15-27 s each: recovered by the shard_map compat
+# shim but too heavy for the tier-1 wall-clock budget; `make test` minus
+# the marker filter still runs them
 def test_chunked_causal_ce_matches_dense_loss_and_grads():
     """The fused hidden->CE path (no full-width logits) must reproduce the
     standard CE loss AND its gradients — it exists purely to cut the

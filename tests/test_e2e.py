@@ -154,6 +154,50 @@ def test_full_diloco_job(tmp_path):
 
 
 @pytest.mark.slow
+def test_full_diloco_job_streaming(tmp_path):
+    """The whole topology on sync_mode="stream" (F=2): fragment deltas up,
+    per-fragment broadcasts down, compute overlapping every flight —
+    through the real auction/dispatch/bridge protocols end to end."""
+
+    async def main():
+        hub, gw, data, workers, sched = await start_cluster(tmp_path)
+        tracked = []
+        orch = Orchestrator(
+            sched,
+            metrics_connector=CallbackConnector(
+                lambda w, r, n, v: tracked.append((w, r, n, v))
+            ),
+        )
+        job = dataclasses.replace(
+            diloco_job(rounds=4), sync_mode="stream", num_fragments=2
+        )
+        try:
+            result = await orch.run(job, auction_timeout=1.5)
+        finally:
+            for w in workers:
+                await w.stop()
+            await data.stop()
+            await sched.stop()
+            await gw.stop()
+        return result, tracked
+
+    from hypha_tpu.telemetry.ft_metrics import STREAM_METRICS
+
+    STREAM_METRICS.reset()
+    result, tracked = run(main())
+    assert result.rounds == 4
+    losses = [(w, r, v) for (w, r, n, v) in tracked if n == "loss"]
+    assert {w for w, _, _ in losses} == {"w0", "w1"}
+    assert all(np.isfinite(v) for _, _, v in losses)
+    # The PS closed both fragments twice (4 rounds, F=2), and the workers'
+    # flights all completed through the streaming path.
+    snap = STREAM_METRICS.snapshot()
+    assert snap["fragment_closes"] == {0: 2, 1: 2}, snap
+    assert snap["synced_fragments"] == 8, snap  # 2 workers x 4 rounds
+    assert snap["bytes_in_flight"] == 0, snap
+
+
+@pytest.mark.slow
 def test_diloco_heterogeneous_batch_sizing(tmp_path):
     """Batch sizes follow offered capacity: whole-strategy workers offer all
     their chips, so w0 (4 tpu) gets batch 4, w1 (2 tpu) gets batch 2
@@ -567,16 +611,17 @@ def test_full_diloco_lora_job(tmp_path, monkeypatch):
     shipped delta contains exclusively _lora_ tensors (the round traffic
     shrinks by the base/adapter ratio), and rounds still complete."""
     import hypha_tpu.executor.training as tr
-    from hypha_tpu.executor.serialization import flatten_tree
 
     shipped: list[list[str]] = []
-    orig_save = tr.save_tree
+    # The send side goes through the one compress.write_delta entry point
+    # (it replaced the old save_tree in the quantized-transport PR).
+    orig_write = tr.compress.write_delta
 
-    def spy(path, tree):
-        shipped.append(sorted(flatten_tree(tree)))
-        return orig_save(path, tree)
+    def spy(path, flat, codec, *args, **kwargs):
+        shipped.append(sorted(flat))
+        return orig_write(path, flat, codec, *args, **kwargs)
 
-    monkeypatch.setattr(tr, "save_tree", spy)
+    monkeypatch.setattr(tr.compress, "write_delta", spy)
 
     async def main():
         hub, gw, data, workers, sched = await start_cluster(tmp_path)

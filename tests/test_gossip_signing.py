@@ -11,6 +11,15 @@ from __future__ import annotations
 
 import asyncio
 
+import pytest
+
+# Signing rides Ed25519 from the `cryptography` package; collection must
+# skip cleanly where it isn't installed (the jax_graft CI image).
+pytest.importorskip(
+    "cryptography",
+    reason="gossip signing requires the 'cryptography' package",
+)
+
 from cryptography.hazmat.primitives.asymmetric import ed25519
 
 from hypha_tpu.certs import peer_id_from_spki_der

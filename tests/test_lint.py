@@ -204,6 +204,43 @@ def test_proto_round_tag_catches_renamed_required_class():
     assert "REQUIRES_ROUND_TAG" in bad[0].message
 
 
+def test_proto_fragment_rule_on_fixture_pair():
+    """The seeded fixture pair: FragBad (fragment_id, no round) fires the
+    rule, clean twin FragGood stays quiet. The fixtures are deliberately
+    unregistered — they reach the rule as an explicit registry."""
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "proto_fragment", FIXTURES / "proto_fragment.py"
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    bad = proto_rules.check_fragment_tags(
+        registry={"FragBad": mod.FragBad, "FragGood": mod.FragGood}
+    )
+    assert [v.rule for v in bad] == ["msg-fragment-needs-round"]
+    assert "FragBad" in bad[0].message
+    assert proto_rules.check_fragment_tags(
+        registry={"FragGood": mod.FragGood}
+    ) == []
+
+
+def test_proto_fragment_rule_accepts_epoch_as_round_tag():
+    @dataclasses.dataclass
+    class EpochTagged:
+        epoch: int = 0
+        fragment_id: int = 0
+
+    assert proto_rules.check_fragment_tags(
+        registry={"EpochTagged": EpochTagged}
+    ) == []
+
+
+def test_proto_fragment_rule_live_registry_clean():
+    """The shipping registry (FragmentTag et al.) satisfies the rule."""
+    assert proto_rules.check_fragment_tags() == []
+
+
 def test_proto_manifest_catches_stale_value_vocabulary():
     bad = proto_rules.check_protocol_map(
         registry={}, manifest={}, values={"GhostValue"}

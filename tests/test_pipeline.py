@@ -61,7 +61,9 @@ def test_pipeline_forward_matches_plain_model():
     mesh = create_mesh({"dp": 2, "pp": 4})
     from jax.sharding import PartitionSpec as P
 
-    pipe = jax.shard_map(
+    from hypha_tpu.hw import shard_map_compat
+
+    pipe = shard_map_compat(
         lambda s, x: pipeline_blocks(block_apply, s, x, n_micro=2),
         mesh=mesh, in_specs=(P("pp"), P("dp")), out_specs=P("dp"),
         check_vma=False,
@@ -75,6 +77,9 @@ def test_pipeline_forward_matches_plain_model():
     np.testing.assert_allclose(h_pipe, np.asarray(h_ref), rtol=2e-5, atol=2e-5)
 
 
+@pytest.mark.slow  # 15-27 s each: recovered by the shard_map compat
+# shim but too heavy for the tier-1 wall-clock budget; `make test` minus
+# the marker filter still runs them
 def test_pp_train_step_matches_plain_loss_and_grads():
     cfg = _tiny_cfg()
     model = GPT2(cfg)
@@ -123,6 +128,9 @@ def test_pipeline_rejects_indivisible_shapes():
         make_gpt2_pp_train_step(cfg, mesh, n_micro=2)
 
 
+@pytest.mark.slow  # 15-27 s each: recovered by the shard_map compat
+# shim but too heavy for the tier-1 wall-clock budget; `make test` minus
+# the marker filter still runs them
 def test_llama_pp_train_step_matches_plain_model():
     """The Llama-family pipeline (GQA + RoPE + tied-head Gemma config)
     computes the plain model's loss."""
@@ -155,6 +163,9 @@ def test_llama_pp_train_step_matches_plain_model():
     assert float(metrics["loss"]) < loss_ref
 
 
+@pytest.mark.slow  # 15-27 s each: recovered by the shard_map compat
+# shim but too heavy for the tier-1 wall-clock budget; `make test` minus
+# the marker filter still runs them
 def test_pp_honors_remat():
     """cfg.remat changes nothing numerically under the pipeline either —
     both builders (GPT-2 and the Llama family's RoPE-closure block)."""

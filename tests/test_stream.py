@@ -1,0 +1,786 @@
+"""Streaming outer sync (hypha_tpu.stream): fragment-wise, overlapped rounds.
+
+Covers the ISSUE-4 checklist:
+
+  * partition determinism — the parameter server and workers must derive
+    the SAME fragments from names+sizes alone, across dict orders and
+    across separate Python processes;
+  * staggered schedule — every fragment syncs exactly once per F rounds;
+  * delayed-update correction — bit-exactly equal to blocking mode when
+    flight time is zero (unit level AND end-to-end through run_training);
+  * out-of-order fragment close — the rejoin catch-up sum stays exact;
+  * chaos: a worker killed mid-fragment degrades the round at quorum
+    instead of wedging the stream.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import math
+import queue
+import subprocess
+import sys
+import threading
+from contextlib import contextmanager
+from pathlib import Path
+
+import numpy as np
+import pytest
+from safetensors.numpy import save_file
+
+from hypha_tpu.stream import (
+    effective_fragments,
+    fragment_due,
+    merge_corrected,
+    partition_names,
+)
+
+REPO = Path(__file__).resolve().parent.parent
+
+
+# ------------------------------------------------------------ partitioning
+
+
+def test_partition_covers_exactly_and_is_dict_order_independent():
+    sizes = {f"t{i}": (i * 37) % 11 + 1 for i in range(23)}
+    frags = partition_names(sizes, 4)
+    names = [n for f in frags for n in f]
+    assert sorted(names) == sorted(sizes)
+    assert len(names) == len(set(names))
+    # Insertion order must not matter — only the (name, size) multiset.
+    shuffled = dict(sorted(sizes.items(), key=lambda kv: kv[1]))
+    assert partition_names(shuffled, 4) == frags
+    reversed_ = dict(reversed(list(sizes.items())))
+    assert partition_names(reversed_, 4) == frags
+
+
+def test_partition_is_size_balanced():
+    sizes = {f"w{i}": 100 for i in range(16)}
+    frags = partition_names(sizes, 4)
+    loads = [sum(sizes[n] for n in f) for f in frags]
+    assert max(loads) == min(loads) == 400
+    # LPT bound with one giant tensor: it gets a bin to itself.
+    sizes["embed"] = 10_000
+    frags = partition_names(sizes, 4)
+    giant = [f for f in frags if "embed" in f]
+    assert len(giant) == 1
+
+
+def test_partition_agrees_across_processes():
+    """The PS/worker contract: a separate interpreter derives the same
+    fragments from the same names+sizes (no hash seeds, no dict order)."""
+    sizes = {f"layer_{i}/w": (7 * i) % 13 + 1 for i in range(17)}
+    code = (
+        "import json, sys; from hypha_tpu.stream import partition_names; "
+        "sizes = json.load(sys.stdin); "
+        "print(json.dumps(partition_names(sizes, 5)))"
+    )
+    proc = subprocess.run(
+        [sys.executable, "-c", code],
+        input=json.dumps(sizes),
+        capture_output=True,
+        text=True,
+        cwd=REPO,
+        timeout=60,
+        env={"PYTHONHASHSEED": "12345", "PATH": "/usr/bin:/bin", "JAX_PLATFORMS": "cpu"},
+    )
+    assert proc.returncode == 0, proc.stderr
+    theirs = [tuple(f) for f in json.loads(proc.stdout)]
+    assert theirs == partition_names(sizes, 5)
+
+
+def test_partition_rejects_bad_inputs():
+    with pytest.raises(ValueError):
+        partition_names({"a": 1}, 0)
+    with pytest.raises(ValueError):
+        partition_names({}, 2)
+
+
+def test_partition_rejects_more_fragments_than_tensors():
+    """An empty fragment's round would ship empty deltas and crash the
+    PS outer step — the misconfiguration must fail loudly at the source,
+    naming the fix."""
+    with pytest.raises(ValueError, match="num_fragments"):
+        partition_names({"a": 10, "b": 5, "c": 1}, 4)
+    # The boundary case (one tensor per fragment) is fine.
+    assert len(partition_names({"a": 10, "b": 5, "c": 1}, 3)) == 3
+
+
+def test_frame_tag_roundtrips_through_hqd1():
+    """write_delta(tag=) bakes the stream identity into the frame header;
+    frame_tag reads it back; SafeTensors codecs carry no frame tag."""
+    import tempfile
+
+    from hypha_tpu.compress import frame_tag, write_delta
+
+    tmp = Path(tempfile.mkdtemp())
+    flat = {"w": np.ones(16, np.float32)}
+    tag = {"round": 7, "fragment_id": 2, "fragments": 4}
+    write_delta(tmp / "q.bin", flat, "int8", tag=tag)
+    assert frame_tag(tmp / "q.bin") == tag
+    write_delta(tmp / "f.bin", flat, "none", tag=tag)
+    assert frame_tag(tmp / "f.bin") is None  # not an HQD1 frame
+    assert frame_tag(tmp / "missing.bin") is None
+
+
+def test_ps_drops_delta_whose_frame_tag_contradicts_header(tmp_path):
+    """A relabeled/replayed HQD1 file (push header says round 1, frame
+    says round 0) must not fold into round 1's mean."""
+    from hypha_tpu.compress import write_delta
+    from hypha_tpu.messages import FragmentTag
+    from hypha_tpu.worker.ps_executor import ParameterServerExecutor
+
+    path = tmp_path / "relabel.bin"
+    write_delta(
+        path,
+        {"w": np.ones(8, np.float32)},
+        "int8",
+        tag={"round": 0, "fragment_id": 0, "fragments": 1},
+    )
+    ok = ParameterServerExecutor._frame_tag_matches(
+        path, FragmentTag(round=0, fragment_id=0, fragments=1)
+    )
+    relabeled = ParameterServerExecutor._frame_tag_matches(
+        path, FragmentTag(round=1, fragment_id=0, fragments=1)
+    )
+    assert ok and not relabeled
+
+
+def test_flight_drops_stale_other_fragment_broadcast(tmp_path):
+    """A broadcast for an OLDER round must be dropped even when it names
+    a different fragment: the worker only ships round r after merging
+    every round < r (or receiving them inside its rejoin catch-up), so
+    absorbing it would double-apply the update. Future rounds of other
+    fragments (the quorum PS running ahead) are the legitimate absorbs."""
+    from hypha_tpu.executor.training import _WorkerStream
+    from hypha_tpu.messages import Receive, Reference, Send
+
+    events = [
+        # round 1 < flight round 2, other fragment: STALE — drop.
+        {"path": "stale.bin", "meta": {"round": 1, "fragment_id": 1, "fragments": 2}},
+        # round 3 > flight round 2, other fragment: PS ran ahead — absorb.
+        {"path": "future.bin", "meta": {"round": 3, "fragment_id": 1, "fragments": 2}},
+        # round 2, our fragment: the completion.
+        {"path": "ours.bin", "meta": {"round": 2, "fragment_id": 0, "fragments": 2}},
+    ]
+    for e in events:
+        (tmp_path / e["path"]).write_bytes(b"x")
+
+    class _Cfg:
+        updates = Send(Reference.from_peers(["ps"], "updates"))
+        results = Receive(Reference.from_peers(["ps"], "results"))
+        sync_mode = "stream"
+        fragments = 2
+
+    class _Sess:
+        @contextmanager
+        def receive(self, receive):
+            yield iter(events)
+
+    ws = _WorkerStream(_Sess(), _Cfg(), tmp_path, "stream", "none")
+    flight = {"round": 2, "frag": 0, "box": {"absorbed": []}}
+    completion = ws._await_broadcast(flight)
+    assert completion["path"] == "ours.bin"
+    assert [e["path"] for e in flight["box"]["absorbed"]] == ["future.bin"]
+    assert not (tmp_path / "stale.bin").exists()  # dropped AND unlinked
+    assert (tmp_path / "future.bin").exists()  # kept for the absorb pass
+
+
+def test_stream_metrics_release_bytes_on_flight_error():
+    """A flight that dies after reporting bytes must release the gauge —
+    a failed job may not read as mid-upload for the process lifetime."""
+    from hypha_tpu.telemetry.ft_metrics import StreamMetrics
+
+    m = StreamMetrics()
+    m.flight_started(1000.0)
+    assert m.bytes_in_flight() == 1000.0
+    m.flight_landed(1000.0)  # the thread's finally — error or success
+    assert m.bytes_in_flight() == 0.0
+    assert m.peak_bytes_in_flight == 1000.0
+    assert m.snapshot()["synced_fragments"] == 0  # no phantom sync counted
+
+
+# ---------------------------------------------------------------- schedule
+
+
+def test_staggered_schedule_covers_every_fragment_every_f_rounds():
+    for fragments in (1, 3, 4, 7):
+        for start in (0, 5, 11):
+            window = {
+                fragment_due(r, fragments)
+                for r in range(start, start + fragments)
+            }
+            assert window == set(range(fragments))
+
+
+def test_effective_fragments_resolution():
+    assert effective_fragments("blocking") == 1
+    assert effective_fragments("overlap", 8) == 1
+    assert effective_fragments("stream", 0) == 4  # paper default
+    assert effective_fragments("stream", 6) == 6
+    with pytest.raises(ValueError):
+        effective_fragments("sometimes")
+
+
+# ---------------------------------------------- delayed-update correction
+
+
+def _rand_tree(rng, names, shape=(5,)):
+    return {n: rng.standard_normal(shape).astype(np.float32) for n in names}
+
+
+def test_zero_flight_merge_is_bit_exact_vs_blocking():
+    """With no drift (θ_l == θ_s) the corrected merge must produce the
+    EXACT arrays blocking mode produces: merged params == new anchor ==
+    θ_s + u, computed by the same jitted tree op."""
+    from hypha_tpu.executor.diloco import merge_update
+
+    rng = np.random.default_rng(0)
+    names = ["a/w", "a/b", "h/k"]
+    theta_s = _rand_tree(rng, names)
+    update = _rand_tree(rng, names)
+    blocking = merge_update(dict(theta_s), dict(update))
+    new_live, new_anchor = merge_corrected(theta_s, theta_s, update)
+    for n in names:
+        np.testing.assert_array_equal(
+            np.asarray(new_live[n]), np.asarray(blocking[n])
+        )
+        np.testing.assert_array_equal(
+            np.asarray(new_anchor[n]), np.asarray(blocking[n])
+        )
+
+
+def test_corrected_merge_keeps_drift_out_of_the_anchor():
+    """θ − anchor after the merge must be (θ_l + u) − (θ_s + u): the
+    in-flight drift survives to ride the NEXT delta, instead of being
+    folded into the anchor (where it would never be shipped)."""
+    rng = np.random.default_rng(1)
+    names = ["x", "y"]
+    theta_s = _rand_tree(rng, names)
+    drift = _rand_tree(rng, names)
+    update = _rand_tree(rng, names)
+    theta_l = {n: theta_s[n] + drift[n] for n in names}
+    new_live, new_anchor = merge_corrected(theta_l, theta_s, update)
+    for n in names:
+        residual = np.asarray(new_live[n]) - np.asarray(new_anchor[n])
+        np.testing.assert_allclose(residual, drift[n], rtol=1e-5, atol=1e-6)
+        assert float(np.abs(residual).max()) > 0  # drift NOT swallowed
+
+
+def test_corrected_merge_rejects_partition_mismatch():
+    rng = np.random.default_rng(2)
+    a = _rand_tree(rng, ["a"])
+    b = _rand_tree(rng, ["b"])
+    with pytest.raises(ValueError):
+        merge_corrected(a, a, b)
+
+
+# --------------------------------------------------- fake-session harness
+
+
+class _FakeSession:
+    """A deterministic single-worker scheduler + parameter server behind
+    the bridge-client API, driving run_training without a cluster.
+
+    The scheduler side runs ``batches_per_round`` inner batches per round
+    then schedules the sync; the PS side answers every shipped delta with
+    ``update = outer_lr * delta`` immediately (flight time ~ 0), echoing
+    the sender's (round, fragment) tag.
+    """
+
+    def __init__(self, work_dir: Path, rounds: int, batches_per_round: int = 2):
+        self.work_dir = Path(work_dir)
+        self.target_rounds = rounds
+        self.batches_per_round = batches_per_round
+        self.rounds_done = 0
+        self.batches_this_round = 0
+        self.scheduled = False
+        self.events: "queue.Queue[dict]" = queue.Queue()
+        self.deltas: list[dict] = []
+        self.lock = threading.Lock()
+
+    # -- bridge-client API -------------------------------------------------
+
+    def fetch(self, fetch):
+        d = self.work_dir / "artifacts"
+        d.mkdir(parents=True, exist_ok=True)
+        path = d / "slice.safetensors"
+        if not path.exists():
+            rng = np.random.default_rng(42)
+            ids = rng.integers(0, 16, (8, 8)).astype(np.int32)
+            save_file({"input_ids": ids}, str(path))
+        return ["artifacts/slice.safetensors"]
+
+    def send_status(self, progress):
+        from hypha_tpu.messages import (
+            ProgressKind,
+            ProgressResponse,
+            ProgressResponseKind,
+        )
+
+        kind = progress.kind
+        with self.lock:
+            if kind == ProgressKind.STATUS:
+                if self.rounds_done >= self.target_rounds:
+                    return ProgressResponse(kind=ProgressResponseKind.DONE)
+                self.batches_this_round += 1
+                if (
+                    not self.scheduled
+                    and self.batches_this_round >= self.batches_per_round
+                ):
+                    self.scheduled = True
+                    return ProgressResponse(
+                        kind=ProgressResponseKind.SCHEDULE_UPDATE, counter=0
+                    )
+                return ProgressResponse(kind=ProgressResponseKind.CONTINUE)
+            if kind == ProgressKind.UPDATE_RECEIVED:
+                self.rounds_done += 1
+                self.batches_this_round = 0
+                self.scheduled = False
+                done = self.rounds_done >= self.target_rounds
+                return ProgressResponse(
+                    kind=(
+                        ProgressResponseKind.DONE
+                        if done
+                        else ProgressResponseKind.CONTINUE
+                    )
+                )
+            return ProgressResponse(kind=ProgressResponseKind.OK)
+
+    def send_resource(self, send, path, resource="updates", meta=None):
+        from hypha_tpu import compress
+
+        meta = meta or {}
+        delta = compress.read_delta(self.work_dir / path)
+        self.deltas.append({"meta": dict(meta), "delta": delta})
+        update = {k: (0.7 * np.asarray(v, np.float32)) for k, v in delta.items()}
+        incoming = self.work_dir / "incoming"
+        incoming.mkdir(exist_ok=True)
+        round_num = int(meta.get("round", len(self.deltas) - 1))
+        out = incoming / f"update-{round_num}.safetensors"
+        save_file(update, str(out))
+        event_meta = {"round": round_num}
+        for key in ("fragment_id", "fragments"):
+            if key in meta:
+                event_meta[key] = meta[key]
+        self.events.put(
+            {"path": f"incoming/{out.name}", "meta": event_meta, "size": 0}
+        )
+
+    @contextmanager
+    def receive(self, receive):
+        def gen():
+            while True:
+                try:
+                    yield self.events.get(timeout=30)
+                except queue.Empty:
+                    return
+
+        yield gen()
+
+
+def _tiny_train_cfg(work_dir, ckpt_dir, **overrides):
+    from hypha_tpu.messages import (
+        Adam,
+        Executor,
+        Fetch,
+        JobSpec,
+        Receive,
+        Reference,
+        Send,
+        TrainExecutorConfig,
+    )
+
+    cfg = TrainExecutorConfig(
+        model={
+            "model_type": "causal-lm",
+            "family": "gpt2",
+            "config": {
+                "vocab_size": 16,
+                "n_positions": 8,
+                "n_embd": 8,
+                "n_layer": 1,
+                "n_head": 2,
+            },
+            "seed": 3,
+        },
+        data=Fetch(Reference.from_uri("file:///unused")),
+        updates=Send(Reference.from_peers(["ps"], "updates")),
+        results=Receive(Reference.from_peers(["ps"], "results")),
+        optimizer=Adam(lr=1e-3),
+        batch_size=4,
+        checkpoint={"dir": str(ckpt_dir), "every_rounds": 1},
+        **overrides,
+    )
+    return JobSpec(
+        job_id="stream-test",
+        executor=Executor(kind="train", name="diloco-transformer", train=cfg),
+    )
+
+
+def _run_job(tmp_path, tag, rounds=2, **overrides):
+    from hypha_tpu.executor.checkpoint import load_train_checkpoint
+    from hypha_tpu.executor.training import run_training
+    from hypha_tpu.executor.train import TrainState, build_optimizer
+    from hypha_tpu.messages import Adam
+
+    work = tmp_path / tag
+    work.mkdir()
+    ckpt = work / "ckpt"
+    session = _FakeSession(work, rounds=rounds)
+    spec = _tiny_train_cfg(work, ckpt, **overrides)
+    result = run_training(session, work, spec, max_batches=64)
+    # Pull the final round's params back out of the checkpoint.
+    import jax
+
+    from hypha_tpu.models import build_model
+
+    model, _ = build_model(dict(spec.executor.train.model), None)
+    params = model.init(jax.random.key(3), np.zeros((1, 8), np.int32))
+    state = TrainState.create(params, build_optimizer(Adam(lr=1e-3)))
+    restored = load_train_checkpoint(ckpt, state.params, state.opt_state)
+    assert restored is not None
+    return result, restored[0], session
+
+
+@pytest.mark.slow
+def test_run_training_overlap_matches_blocking_bit_exactly(tmp_path, monkeypatch):
+    """End-to-end regression for the acceptance criterion: with flight
+    time forced to zero (the poll blocks until the broadcast lands —
+    $HYPHA_STREAM_POLL_WAIT), overlap mode's whole trajectory is
+    bit-identical to blocking mode's."""
+    import jax
+
+    result_b, params_b, _ = _run_job(tmp_path, "blocking", sync_mode="blocking")
+    monkeypatch.setenv("HYPHA_STREAM_POLL_WAIT", "30")
+    result_o, params_o, session_o = _run_job(tmp_path, "overlap", sync_mode="overlap")
+    assert result_b.rounds == result_o.rounds == 2
+    assert result_b.batches == result_o.batches
+    np.testing.assert_array_equal(
+        np.asarray(result_b.losses, np.float32),
+        np.asarray(result_o.losses, np.float32),
+    )
+    for (pa, a), (pb, b) in zip(
+        sorted(
+            ((p, l) for p, l in _leaves(params_b)), key=lambda t: t[0]
+        ),
+        sorted(
+            ((p, l) for p, l in _leaves(params_o)), key=lambda t: t[0]
+        ),
+    ):
+        assert pa == pb
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    # The worker tagged every shipped delta with its (round, fragment).
+    for i, d in enumerate(session_o.deltas):
+        assert d["meta"]["round"] == i
+        assert d["meta"]["fragment_id"] == 0
+        assert d["meta"]["fragments"] == 1
+
+
+def _leaves(tree):
+    import jax
+
+    from hypha_tpu.executor.serialization import path_name
+
+    return [
+        (path_name(p), l)
+        for p, l in jax.tree_util.tree_flatten_with_path(tree)[0]
+    ]
+
+
+@pytest.mark.slow
+def test_run_training_stream_fragments(tmp_path):
+    """stream mode (F=2): each round ships exactly one fragment's tensors,
+    alternating fragments; training still completes and converges sanely."""
+    result, params, session = _run_job(
+        tmp_path, "stream", rounds=4, sync_mode="stream", fragments=2
+    )
+    assert result.rounds == 4
+    assert all(math.isfinite(l) for l in result.losses)
+    all_names = {n for n, _ in _leaves(params)}
+    frags = [set(d["delta"].keys()) for d in session.deltas]
+    assert len(frags) == 4
+    # Staggered: round r ships fragment r % 2; the two fragments tile the
+    # full tree and repeat with period 2.
+    assert frags[0] == frags[2] and frags[1] == frags[3]
+    assert frags[0] | frags[1] == all_names
+    assert frags[0].isdisjoint(frags[1])
+    for i, d in enumerate(session.deltas):
+        assert d["meta"]["round"] == i
+        assert d["meta"]["fragment_id"] == i % 2
+        assert d["meta"]["fragments"] == 2
+
+
+# ----------------------------------------- catch-up out-of-order exactness
+
+
+def test_catchup_exact_when_fragments_close_out_of_order():
+    """θ₀ + Σ must be bit-exact however fragment CLOSES interleave, as
+    long as each fragment's own updates stay ordered — the pipelined PS's
+    actual guarantee."""
+    from hypha_tpu.ft.rejoin import CatchupBuffer, merge_catchup_arrays
+
+    rng = np.random.default_rng(7)
+    frag_names = {0: ["a", "b"], 1: ["c"], 2: ["d", "e"]}
+    rounds = 9  # 3 per fragment
+    updates = []  # (fragment, {name: update})
+    for r in range(rounds):
+        f = r % 3
+        updates.append(
+            (f, {n: rng.standard_normal(4).astype(np.float32) for n in frag_names[f]})
+        )
+
+    ordered = CatchupBuffer()
+    for f, u in updates:
+        ordered.accumulate_tree(u, fragment_id=f)
+
+    # Interleave fragments out of global round order but keep each
+    # fragment's internal order (e.g. f2's updates all land late).
+    scrambled = CatchupBuffer()
+    by_frag = {f: [u for g, u in updates if g == f] for f in frag_names}
+    order = [0, 1, 0, 0, 1, 2, 1, 2, 2]
+    taken = {f: 0 for f in frag_names}
+    for f in order:
+        scrambled.accumulate_tree(by_frag[f][taken[f]], fragment_id=f)
+        taken[f] += 1
+
+    theta0 = {
+        n: rng.standard_normal(4).astype(np.float32)
+        for names in frag_names.values()
+        for n in names
+    }
+    a = merge_catchup_arrays(theta0, ordered._cum)
+    b = merge_catchup_arrays(theta0, scrambled._cum)
+    for n in theta0:
+        np.testing.assert_array_equal(a[n], b[n])
+    assert scrambled.rounds == rounds
+    assert scrambled.fragment_rounds == {0: 3, 1: 3, 2: 3}
+
+
+# ------------------------------------------------- parameter-server rounds
+
+
+def _run(coro, timeout=60):
+    return asyncio.run(asyncio.wait_for(coro, timeout=timeout))
+
+
+async def _mesh(peer_ids):
+    from hypha_tpu.network import MemoryTransport, Node
+
+    hub = MemoryTransport()
+    nodes = {p: Node(hub.shared(), peer_id=p) for p in peer_ids}
+    for n in nodes.values():
+        await n.start()
+    for x in nodes.values():
+        for y in nodes.values():
+            if x is not y:
+                x.add_peer_addr(y.peer_id, y.listen_addrs[0])
+    return nodes
+
+
+def _agg_spec(job_id, workers, **kwargs):
+    from hypha_tpu.messages import (
+        AggregateExecutorConfig,
+        Executor,
+        JobSpec,
+        Nesterov,
+        Receive,
+        Reference,
+        Send,
+    )
+
+    ref = Reference.from_peers(list(workers), "updates")
+    return JobSpec(
+        job_id=job_id,
+        executor=Executor(
+            kind="aggregate",
+            name="parameter-server",
+            aggregate=AggregateExecutorConfig(
+                updates=Receive(ref),
+                results=Send(ref),
+                optimizer=Nesterov(lr=0.7, momentum=0.9),
+                num_workers=len(workers),
+                **kwargs,
+            ),
+        ),
+    )
+
+
+def test_ps_stream_rounds_alternate_fragments(tmp_path):
+    """The streaming PS closes per-fragment rounds, tags its broadcasts,
+    and applies Nesterov only to the due fragment's tensors."""
+    from safetensors.numpy import load_file
+
+    from hypha_tpu.messages import (
+        PROTOCOL_PROGRESS,
+        Progress,
+        ProgressKind,
+        ProgressResponse,
+        ProgressResponseKind,
+    )
+    from hypha_tpu.stream import partition_names
+    from hypha_tpu.telemetry.ft_metrics import STREAM_METRICS
+    from hypha_tpu.worker.ps_executor import ParameterServerExecutor
+
+    STREAM_METRICS.reset()
+    full = {
+        "w": np.ones(8, np.float32),
+        "b": np.full(4, 2.0, np.float32),
+        "k": np.full(8, -1.0, np.float32),
+    }
+    frags = partition_names({n: v.size for n, v in full.items()}, 2)
+
+    async def main():
+        nodes = await _mesh(["ps", "w1", "sched"])
+        ps, w1, sched = nodes["ps"], nodes["w1"], nodes["sched"]
+
+        async def on_progress(peer, progress):
+            assert progress.kind == ProgressKind.UPDATED
+            if progress.round >= 3:
+                return ProgressResponse(kind=ProgressResponseKind.DONE)
+            return ProgressResponse(kind=ProgressResponseKind.OK)
+
+        sched.on(PROTOCOL_PROGRESS, Progress).respond_with(on_progress)
+        spec = _agg_spec("agg-s", ["w1"], sync_mode="stream", fragments=2)
+        pse = ParameterServerExecutor(ps, tmp_path)
+        execution = await pse.execute("agg-s", spec, "sched")
+
+        seen = []
+        for r in range(4):
+            f = r % 2
+            names = frags[f]
+            delta = {n: full[n] for n in names}
+            fpath = tmp_path / f"d{r}.st"
+            save_file(delta, str(fpath))
+            header = {
+                "resource": "updates",
+                "name": f"delta-{r}",
+                "num_samples": 10.0,
+                "round": r,
+                "fragment_id": f,
+                "fragments": 2,
+            }
+            await w1.push("ps", header, fpath)
+            push = await w1.next_push(timeout=10)
+            dest = tmp_path / f"u{r}.st"
+            await push.save_to(dest)
+            seen.append((dict(push.resource), dict(load_file(str(dest)))))
+        status = await asyncio.wait_for(execution.wait(), 10)
+        assert status.state == "completed"
+        for n in nodes.values():
+            await n.stop()
+        return seen
+
+    seen = _run(main())
+    for r, (header, update) in enumerate(seen):
+        assert header["round"] == r
+        assert header["fragment_id"] == r % 2
+        assert header["fragments"] == 2
+        assert set(update) == set(frags[r % 2])
+    # Nesterov per fragment: the FIRST close of each fragment sees zero
+    # momentum, so update = lr*(mu*g + g) = 0.7*1.9*g for its tensors.
+    for r in (0, 1):
+        for name, arr in seen[r][1].items():
+            np.testing.assert_allclose(
+                arr, 0.7 * 1.9 * full[name], rtol=1e-5
+            )
+    # Per-fragment close counters advanced on the PS.
+    from hypha_tpu.telemetry.ft_metrics import STREAM_METRICS as SM
+
+    closes = {fid: c.value() for fid, c in SM.fragment_closes.items()}
+    assert closes == {0: 2, 1: 2}
+
+
+def test_ps_stream_chaos_kill_worker_mid_fragment(tmp_path):
+    """Elastic + stream: one worker ships fragment deltas, the other dies
+    after round 0 — rounds keep closing at quorum past the deadline, the
+    job completes, and the dead peer's missing fragments never wedge the
+    pipeline."""
+    from hypha_tpu.messages import (
+        PROTOCOL_PROGRESS,
+        Progress,
+        ProgressKind,
+        ProgressResponse,
+        ProgressResponseKind,
+    )
+    from hypha_tpu.stream import partition_names
+    from hypha_tpu.telemetry.ft_metrics import FT_METRICS
+    from hypha_tpu.worker.ps_executor import ParameterServerExecutor
+
+    FT_METRICS.reset()
+    full = {"w": np.ones(8, np.float32), "b": np.full(4, 2.0, np.float32)}
+    frags = partition_names({n: v.size for n, v in full.items()}, 2)
+
+    async def main():
+        nodes = await _mesh(["ps", "w1", "w2", "sched"])
+        ps, w1, w2, sched = (
+            nodes["ps"], nodes["w1"], nodes["w2"], nodes["sched"],
+        )
+
+        async def on_progress(peer, progress):
+            if progress.round >= 2:
+                return ProgressResponse(kind=ProgressResponseKind.DONE)
+            return ProgressResponse(kind=ProgressResponseKind.OK)
+
+        sched.on(PROTOCOL_PROGRESS, Progress).respond_with(on_progress)
+        spec = _agg_spec(
+            "agg-c", ["w1", "w2"],
+            sync_mode="stream", fragments=2,
+            quorum_fraction=0.5, round_deadline_s=0.4,
+        )
+        pse = ParameterServerExecutor(ps, tmp_path)
+        execution = await pse.execute("agg-c", spec, "sched")
+
+        async def ship(node, r):
+            f = r % 2
+            delta = {n: full[n] for n in frags[f]}
+            fpath = tmp_path / f"{node.peer_id}-d{r}.st"
+            save_file(delta, str(fpath))
+            await node.push(
+                "ps",
+                {
+                    "resource": "updates",
+                    "name": f"delta-{r}",
+                    "num_samples": 5.0,
+                    "round": r,
+                    "fragment_id": f,
+                    "fragments": 2,
+                },
+                fpath,
+            )
+
+        # Round 0: both workers report; then w2 is killed mid-stream.
+        await asyncio.gather(ship(w1, 0), ship(w2, 0))
+        await w1.next_push(timeout=10)
+        await w2.next_push(timeout=10)
+        await w2.stop()
+        # Rounds 1 and 2: only w1 ships — quorum (1 of 2) closes each
+        # round after the 0.4 s deadline.
+        for r in (1, 2):
+            await ship(w1, r)
+            await w1.next_push(timeout=10)
+        status = await asyncio.wait_for(execution.wait(), 15)
+        assert status.state == "completed"
+        for name in ("ps", "w1", "sched"):
+            await nodes[name].stop()
+
+    _run(main(), timeout=60)
+    assert FT_METRICS.degraded_rounds.value() >= 2
+
+
+def test_configs_default_to_blocking():
+    """The regression guard for bit-compat: nothing streams unless asked."""
+    from hypha_tpu.messages import AggregateExecutorConfig, TrainExecutorConfig
+    from hypha_tpu.node_config import JobSection
+    from hypha_tpu.scheduler.job_config import DiLoCoJob
+
+    assert TrainExecutorConfig.__dataclass_fields__["sync_mode"].default == "blocking"
+    assert AggregateExecutorConfig.__dataclass_fields__["sync_mode"].default == "blocking"
+    job = DiLoCoJob(model={}, dataset="d")
+    assert job.sync_mode == "blocking" and job.num_fragments == 0
+    section = JobSection()
+    section.validate()
+    assert section.to_job().sync_mode == "blocking"
+    with pytest.raises(ValueError):
+        DiLoCoJob(model={}, dataset="d", sync_mode="half-duplex")
