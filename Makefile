@@ -3,7 +3,7 @@
 
 PYTHON ?= python
 
-.PHONY: lint lint-fixtures test compressbench streambench
+.PHONY: lint lint-fixtures test compressbench streambench ftbench-ps
 
 lint:
 	$(PYTHON) -m hypha_tpu.analysis hypha_tpu/
@@ -39,3 +39,9 @@ compressbench:
 streambench:
 	JAX_PLATFORMS=cpu $(PYTHON) benchmarks/streambench.py \
 		--out STREAMBENCH_r07.json
+
+# Durable PS: kill the parameter server mid-round, restart it, and prove
+# the job completes with bounded recovery wall-clock (ft.durable journal +
+# generation handshake). Writes FTBENCH_kill-ps-2.json.
+ftbench-ps:
+	$(PYTHON) bench.py --chaos kill-ps:2

@@ -345,10 +345,11 @@ def main() -> None:
 
 
 def _chaos_main(spec: str) -> int:
-    """``bench.py --chaos kill-worker:<round>``: run the orchestrated
-    fault-injection scenario (benchmarks/ft_chaos.py — 4 workers, elastic
-    membership, scripted kill/delay/partition) on the CPU backend and
-    persist the result as FTBENCH_<scenario>.json next to this script."""
+    """``bench.py --chaos <spec>`` (kill-worker:<round>, kill-ps:<round>,
+    partition-ps:<round>:<s>, ...): run the orchestrated fault-injection
+    scenario (benchmarks/ft_chaos.py — 4 workers, elastic membership,
+    durable PS for the ps scenarios) on the CPU backend and persist the
+    result as FTBENCH_<scenario>.json next to this script."""
     os.environ["JAX_PLATFORMS"] = "cpu"  # control-plane bench: no accelerator
     sys.path.insert(0, os.path.join(_REPO, "benchmarks"))
     from ft_chaos import run_chaos_scenario

@@ -13,8 +13,15 @@ scripted fault via :mod:`hypha_tpu.ft.chaos`, and reports:
   * ``rejoins`` / ``rejoin_latency_ms`` — replacement workers caught up via
                                 the cumulative-update protocol
 
-Invoked by ``bench.py --chaos kill-worker:<round>`` which persists the
-result as ``FTBENCH_<scenario>.json``.
+PS scenarios (``kill-ps:<round>`` / ``partition-ps:<round>:<seconds>``)
+target the parameter server instead: the job runs with a checkpoint dir
+(durable journal, hypha_tpu.ft.durable), the harness restarts the PS node
+under the same peer id after a kill, and the result additionally reports
+``ps_recoveries`` / ``retry_attempts`` / ``ps_journal_bytes`` /
+``recovery_wall_s`` (chaos fire → the next round closing).
+
+Invoked by ``bench.py --chaos <spec>`` which persists the result as
+``FTBENCH_<scenario>.json``.
 """
 
 from __future__ import annotations
@@ -57,7 +64,10 @@ def run_chaos_scenario(
     from hypha_tpu.worker.runtime import WorkerNode
 
     FT_METRICS.reset()
-    victim = "w1"  # deterministic target: second allocated worker
+    # PS scenarios (kill-ps / partition-ps) target the parameter server's
+    # worker node; worker scenarios target the second allocated worker.
+    ps_scenario = spec.startswith(("kill-ps", "partition-ps"))
+    victim = "psw" if ps_scenario else "w1"
     action = parse_chaos_spec(spec, victim)
     tmp = Path(tempfile.mkdtemp(prefix="hypha-ftbench-"))
 
@@ -103,10 +113,12 @@ def run_chaos_scenario(
         await sched.start()
         await sched.wait_for_bootstrap()
 
-        chaos = ChaosController([action], workers)
+        chaos = ChaosController([action], {**workers, "psw": psw})
         rounds_seen: set[int] = set()
+        metric_times: list[tuple[int, float]] = []
 
         def on_metric(w, r, n, v):
+            metric_times.append((r, time.monotonic()))
             chaos.on_round_metrics(r)
             rounds_seen.add(r)
 
@@ -140,10 +152,17 @@ def run_chaos_scenario(
                 round_deadline_s=round_deadline_s,
                 rejoin_attempts=8,
                 rejoin_backoff_s=1.0,
+                ps_restart_attempts=4,
+                ps_restart_backoff_s=0.5,
             ),
+            # Durable PS state lives under the checkpoint dir — required
+            # for the kill-ps recovery path (journal + outer checkpoint).
+            checkpoint_dir=str(tmp / "ckpt") if ps_scenario else None,
         )
 
         replacement = mk_worker(f"{victim}b") if action.kind == "kill" else None
+        ps_addr = None  # captured before the kill; the restart re-binds it
+        replacement_ps: dict = {}
 
         async def restarter() -> None:
             while not chaos.fired:
@@ -151,7 +170,28 @@ def run_chaos_scenario(
             if replacement is not None:
                 _log(f"restarting victim as {victim}b")
                 await replacement.start([f"mem:restart-{victim}b"])
+            if action.kind == "kill-ps":
+                # The PS process "restarts": a fresh node under the SAME
+                # peer id and listen address (workers' push targets were
+                # wired to it at dispatch). Its durable journal under the
+                # job checkpoint dir is what makes this a recovery, not a
+                # round-zero restart.
+                await asyncio.sleep(0.3)  # let the kill finish severing
+                _log("restarting parameter server node psw")
+                new_psw = WorkerNode(
+                    hub.shared(), resources=Resources(cpu=2, memory=200),
+                    peer_id="psw", bootstrap=boot, work_root=tmp / "psw2",
+                )
+                for _ in range(25):
+                    try:
+                        await new_psw.start([ps_addr] if ps_addr else None)
+                        break
+                    except OSError:
+                        # The dying node still holds its listen address.
+                        await asyncio.sleep(0.2)
+                replacement_ps["node"] = new_psw
 
+        ps_addr = psw.node.listen_addrs[0]
         restart_task = asyncio.create_task(restarter())
         t0 = time.monotonic()
         try:
@@ -163,6 +203,8 @@ def run_chaos_scenario(
             stops = list(workers.values()) + [psw]
             if replacement is not None:
                 stops.append(replacement)
+            if replacement_ps.get("node") is not None:
+                stops.append(replacement_ps["node"])
             for w in stops:
                 try:
                     await w.stop()
@@ -172,6 +214,18 @@ def run_chaos_scenario(
             await sched.stop()
             await gw.stop()
         wall_s = time.monotonic() - t0
+        # Recovery wall-clock: chaos fire -> the first metric of a round
+        # that COMPLETED after the fire (a same-round metric racing the
+        # fire is pre-fault progress, not recovery).
+        fired_at = chaos.fired_at(victim)
+        recovery_wall_s = None
+        if fired_at is not None:
+            floor = max(
+                (r for r, t in metric_times if t <= fired_at), default=-1
+            )
+            after = [t for r, t in metric_times if t > fired_at and r > floor]
+            if after:
+                recovery_wall_s = after[0] - fired_at
         snap = FT_METRICS.snapshot()
         latency_ms = (
             snap["rejoin_latency_ms_sum"] / snap["rejoin_latency_ms_count"]
@@ -194,6 +248,12 @@ def run_chaos_scenario(
             "stale_deltas_dropped": snap["stale_deltas_dropped"],
             "suspected_peers": snap["suspected_peers"],
             "rejoins": snap["rejoins"],
+            "ps_recoveries": snap["ps_recoveries"],
+            "retry_attempts": snap["retry_attempts"],
+            "ps_journal_bytes": snap["ps_journal_bytes"],
+            "recovery_wall_s": (
+                round(recovery_wall_s, 2) if recovery_wall_s is not None else None
+            ),
             "rejoin_latency_ms": round(latency_ms, 1) if latency_ms else None,
             "membership": result.ft,
             "wall_s": round(wall_s, 1),

@@ -15,7 +15,13 @@ site:
     ``except (CancelledError, Exception): pass`` site got wrong;
   * :func:`wait_quiet` — await something whose outcome you don't care
     about, bounded by an optional timeout, again without eating the
-    caller's own cancellation.
+    caller's own cancellation;
+  * :func:`retry` — jittered exponential backoff around a transient
+    operation (a fabric push across a parameter-server restart, a
+    catch-up send to a rejoiner), with a per-attempt timeout and an
+    overall deadline so a dead peer fails the caller in bounded time
+    instead of parking it forever.  Worker executors must route fabric
+    pushes through this (hypha-lint ``naked-stream-push``).
 
 ``asyncio.gather(..., return_exceptions=True)`` is the primitive that makes
 the cancellation semantics right: child outcomes become return values, but
@@ -26,11 +32,12 @@ from __future__ import annotations
 
 import asyncio
 import logging
-from typing import Any, Awaitable, Coroutine, MutableSet
+import random
+from typing import Any, Awaitable, Callable, Coroutine, MutableSet, TypeVar
 
 from .telemetry import Counter
 
-__all__ = ["TASK_FAILURES", "spawn", "reap", "wait_quiet"]
+__all__ = ["TASK_FAILURES", "spawn", "reap", "wait_quiet", "retry"]
 
 log = logging.getLogger("hypha.aio")
 
@@ -107,3 +114,77 @@ async def wait_quiet(
         await asyncio.wait_for(gathered, timeout)
     except asyncio.TimeoutError:
         pass
+
+
+_T = TypeVar("_T")
+
+
+async def retry(
+    fn: Callable[[], Awaitable[_T]],
+    *,
+    attempts: int = 0,
+    base_delay: float = 0.25,
+    max_delay: float = 10.0,
+    attempt_timeout: float | None = None,
+    deadline: float | None = None,
+    retry_on: tuple[type[BaseException], ...] = (Exception,),
+    what: str = "",
+    logger: logging.Logger | None = None,
+) -> _T:
+    """Call ``fn()`` until it succeeds, with jittered exponential backoff.
+
+    The shape every worker-executor fabric push must take (hypha-lint
+    ``naked-stream-push``): a parameter-server restart or a transient
+    partition then costs a few backed-off re-attempts instead of a lost
+    delta and a wedged round.
+
+      * ``attempts``        — total tries; 0 = unbounded (the deadline is
+        then the only stop);
+      * ``attempt_timeout`` — wall-clock bound per try (``wait_for``
+        semantics: the in-flight attempt is cancelled);
+      * ``deadline``        — overall seconds budget from the first try;
+        when it cannot fit another attempt, the last error re-raises;
+      * ``retry_on``        — exception classes worth re-trying.
+        ``CancelledError`` always propagates immediately: a cancelled
+        caller must never be held hostage by backoff sleeps.
+
+    Each re-attempt bumps ``hypha.ft.retry_attempts`` (telemetry) so an
+    outage shows up as a counter spike, not just log spam.
+    """
+    from .telemetry.ft_metrics import FT_METRICS  # lazy: no import cycle
+
+    loop = asyncio.get_running_loop()
+    stop_at = None if deadline is None else loop.time() + deadline
+    label = what or getattr(fn, "__qualname__", "operation")
+    lg = logger or log
+    # A per-attempt timeout is retryable regardless of ``retry_on`` — it is
+    # this function's own signal, not the operation's failure mode.
+    catchable = tuple(retry_on) + (asyncio.TimeoutError,)
+    attempt = 0
+    while True:
+        attempt += 1
+        try:
+            if attempt_timeout is None:
+                return await fn()
+            return await asyncio.wait_for(fn(), attempt_timeout)
+        except asyncio.CancelledError:
+            raise
+        except catchable as e:
+            out_of_attempts = attempts > 0 and attempt >= attempts
+            delay = min(max_delay, base_delay * (2 ** (attempt - 1)))
+            delay *= 0.5 + random.random()  # jitter: 0.5x..1.5x
+            out_of_time = (
+                stop_at is not None and loop.time() + delay >= stop_at
+            )
+            if out_of_attempts or out_of_time:
+                lg.warning(
+                    "retry %r: giving up after %d attempt(s): %s",
+                    label, attempt, e,
+                )
+                raise
+            FT_METRICS.retry_attempts.add(1)
+            lg.info(
+                "retry %r: attempt %d failed (%s); next in %.2fs",
+                label, attempt, e, delay,
+            )
+            await asyncio.sleep(delay)

@@ -12,7 +12,11 @@ way (see docs/development.md for the incident-by-incident rationale):
     breaks cooperative shutdown: ``stop()`` hangs until the RPC timeout;
   * ``lock-held-await``      — a network round-trip awaited under an
     ``asyncio.Lock`` serializes the control plane on its slowest peer and
-    deadlocks if the peer's reply needs the same lock.
+    deadlocks if the peer's reply needs the same lock;
+  * ``naked-stream-push``    — a fabric push awaited raw in a worker
+    executor turns a parameter-server restart into a lost delta; routed
+    through ``aio.retry`` (or a ``*_once`` retry body) it is re-attempted
+    with backoff instead (the PS journal makes re-sends idempotent).
 """
 
 from __future__ import annotations
@@ -115,6 +119,7 @@ class _AsyncVisitor(ast.NodeVisitor):
         self.src = src
         self.violations: list[Violation] = []
         self._func_stack: list[bool] = []  # True = async frame
+        self._name_stack: list[str] = []  # enclosing function names
         self._lock_depth = 0
 
     # ------------------------------------------------------------- scoping
@@ -123,23 +128,25 @@ class _AsyncVisitor(ast.NodeVisitor):
     def _in_async(self) -> bool:
         return bool(self._func_stack) and self._func_stack[-1]
 
-    def _enter_func(self, node: ast.AST, is_async: bool) -> None:
+    def _enter_func(self, node: ast.AST, is_async: bool, name: str = "") -> None:
         # A nested function body runs later, not under any lock the
         # enclosing frame currently holds.
         held, self._lock_depth = self._lock_depth, 0
         self._func_stack.append(is_async)
+        self._name_stack.append(name)
         self.generic_visit(node)
+        self._name_stack.pop()
         self._func_stack.pop()
         self._lock_depth = held
 
     def visit_FunctionDef(self, node: ast.FunctionDef) -> None:
-        self._enter_func(node, False)
+        self._enter_func(node, False, node.name)
 
     def visit_AsyncFunctionDef(self, node: ast.AsyncFunctionDef) -> None:
-        self._enter_func(node, True)
+        self._enter_func(node, True, node.name)
 
     def visit_Lambda(self, node: ast.Lambda) -> None:
-        self._enter_func(node, False)
+        self._enter_func(node, False, "<lambda>")
 
     # ------------------------------------------------- async-blocking-call
 
@@ -230,7 +237,41 @@ class _AsyncVisitor(ast.NodeVisitor):
                         f"needs the lock deadlocks)",
                     )
                 )
+        self._check_naked_push(node)
         self.generic_visit(node)
+
+    # ---------------------------------------------------- naked-stream-push
+
+    def _check_naked_push(self, node: ast.Await) -> None:
+        """``await <...>.node.push(...)`` outside the retry wrapper.
+
+        A fabric push awaited raw fails the round on the first transient
+        error — a restarting parameter server, a blip of partition — when
+        ``aio.retry`` would have parked and re-pushed. The blessed shapes:
+
+          * ``await aio.retry(lambda: node.push(...), ...)`` — the push in
+            a lambda is not awaited, so it never trips this rule;
+          * a retry body: a (nested) function whose name ends in ``_once``
+            passed to ``aio.retry`` may await the push directly.
+        """
+        if not isinstance(node.value, ast.Call):
+            return
+        name = _dotted(node.value.func)
+        if not name or not (
+            name == "node.push" or name.endswith(".node.push")
+        ):
+            return
+        if any(n.endswith("_once") for n in self._name_stack):
+            return  # retry body by convention (passed to aio.retry)
+        self.violations.append(
+            self.src.violation(
+                "naked-stream-push",
+                node,
+                f"await {name}(...) without a retry wrapper: route fabric "
+                f"pushes through hypha_tpu.aio.retry (or a *_once retry "
+                f"body) so a receiver restart is re-attempted, not fatal",
+            )
+        )
 
 
 def check(src: FileSource) -> list[Violation]:

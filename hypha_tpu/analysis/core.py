@@ -52,6 +52,10 @@ RULES: dict[str, str] = {
     "lock-held-await": (
         "network round-trip awaited while holding an asyncio.Lock"
     ),
+    "naked-stream-push": (
+        "fabric push awaited outside the aio.retry wrapper — a receiver "
+        "restart becomes a lost delta instead of a re-attempt"
+    ),
     # -- JAX discipline -----------------------------------------------------
     "jit-host-sync": (
         "host sync (.item() / np.asarray / float() / device_get) on a "

@@ -60,6 +60,18 @@ class ErrorFeedback:
     def reset(self) -> None:
         self._residual.clear()
 
+    def state(self) -> dict[str, np.ndarray]:
+        """The residual tree, for durable checkpointing (ft.durable): a PS
+        restart that dropped the residual would silently discard one
+        round's quantization error from the broadcast stream."""
+        return dict(self._residual)
+
+    def restore(self, residual: dict[str, np.ndarray]) -> None:
+        self._residual = {
+            name: np.asarray(value, np.float32)
+            for name, value in residual.items()
+        }
+
     @property
     def tensors(self) -> int:
         return len(self._residual)

@@ -52,6 +52,8 @@ from ..messages import (
     TrainExecutorConfig,
 )
 from .. import compress
+from ..ft.durable import RESYNC_KEY, restart_signal
+from ..ft.rejoin import CATCHUP_KEY
 from ..stream import SYNC_MODES, effective_fragments, fragment_due, merge_corrected
 from ..stream.partition import partition_names
 from ..telemetry.ft_metrics import STREAM_METRICS
@@ -179,6 +181,10 @@ class _WorkerStream:
         self.poll_wait_s = float(
             os.environ.get(_STREAM_POLL_WAIT_ENV, "0") or 0.0
         )
+        # Last PS generation observed on the results stream (flight-thread
+        # confined): a change means the parameter server restarted and the
+        # in-flight delta may have died unjournaled — re-send it.
+        self._gen: Any = None
 
     @property
     def in_flight(self) -> bool:
@@ -221,6 +227,7 @@ class _WorkerStream:
             "t0": time.monotonic(),
             "compute_s": 0.0,
             "bytes": 0,
+            "samples": float(num_samples),
         }
         thread = threading.Thread(
             target=self._flight_main,
@@ -265,6 +272,26 @@ class _WorkerStream:
             # never read as mid-upload for the rest of the process.
             STREAM_METRICS.flight_landed(flight["bytes"])
 
+    def _resend(self, flight: dict) -> None:
+        """The PS restarted: our un-acknowledged fragment delta may have
+        died with it unjournaled — re-push the wire file (the PS's journal
+        dedup makes the copy idempotent when the original DID land)."""
+        if not flight["path"].is_file():
+            return
+        tag = FragmentTag(
+            round=flight["round"], fragment_id=flight["frag"], fragments=self.F
+        )
+        log.warning(
+            "stream sync: ps restart detected; re-sending round %d fragment %d",
+            flight["round"], flight["frag"],
+        )
+        self.session.send_resource(
+            self.cfg.updates,
+            flight["path"].name,
+            resource=self.cfg.updates.ref.resource or "updates",
+            meta={"num_samples": flight["samples"], **tag.header()},
+        )
+
     def _await_broadcast(self, flight: dict) -> dict:
         """Consume results-stream events until OUR fragment's update lands.
 
@@ -272,13 +299,19 @@ class _WorkerStream:
         pass; stale rebroadcasts of our fragment are dropped. A LATER
         round of our fragment completes the flight too (our round's
         broadcast was lost — waiting for it would hang the worker where
-        blocking mode's merge-whatever-arrives keeps going).
+        blocking mode's merge-whatever-arrives keeps going). A PS
+        generation change (or an explicit resync announcement) re-sends
+        the in-flight delta — the restart may have lost it.
         """
-        from ..ft.rejoin import CATCHUP_KEY
-
         with self.session.receive(self.cfg.results) as events:
             for event in events:
                 meta = event.get("meta") or {}
+                self._gen, resend = restart_signal(meta, self._gen)
+                if resend:
+                    self._resend(flight)
+                if meta.get(RESYNC_KEY):
+                    (self.work_dir / event["path"]).unlink(missing_ok=True)
+                    continue
                 if meta.get(CATCHUP_KEY):
                     # Catch-ups target rejoiners; their content is folded
                     # into every later broadcast — drop defensively.
@@ -728,6 +761,10 @@ def run_training(
     round_num = 0
     round_samples = 0
     round_losses: list[float] = []
+    # Last PS generation seen on the results stream (ft.durable): a change
+    # mid-wait means the parameter server restarted — the shipped delta may
+    # have died with it unjournaled, so the worker re-pushes it.
+    ps_generation: Any = None
     # Outer-round wire codec (hypha_tpu.compress): delta_codec wins, the
     # legacy delta_dtype="bfloat16" maps onto the bf16 codec. Quantized
     # codecs carry an error-feedback residual across rounds so the
@@ -803,6 +840,7 @@ def run_training(
     def do_update() -> bool:
         """Ship Δθ, wait for the PS broadcast, merge. True = next round."""
         nonlocal state, anchor, host_anchor, round_num, round_samples
+        nonlocal ps_generation
         session.send_status(Progress(kind=ProgressKind.UPDATE, job_id=spec.job_id))
         host_params = None
         if mh is not None:
@@ -851,14 +889,53 @@ def run_training(
             )
         )
         with session.receive(cfg.results) as events:
-            # Not bare next(): a severed bridge ends the SSE stream, and a
-            # StopIteration escaping through asyncio.to_thread turns into
-            # an unraisable TypeError instead of a clean job failure.
-            event = next(events, None)
-        if event is None:
-            raise RuntimeError(
-                "results stream ended before the round's update broadcast"
-            )
+            while True:
+                # Not bare next(): a severed bridge ends the SSE stream,
+                # and a StopIteration escaping through asyncio.to_thread
+                # turns into an unraisable TypeError instead of a clean
+                # job failure.
+                event = next(events, None)
+                if event is None:
+                    raise RuntimeError(
+                        "results stream ended before the round's update "
+                        "broadcast"
+                    )
+                meta = event.get("meta") or {}
+                ps_generation, resend = restart_signal(meta, ps_generation)
+                if resend and delta_path.is_file():
+                    # PS restart: the shipped delta may have died with it
+                    # unjournaled. Re-push — the PS's journal dedup makes
+                    # the copy idempotent when it DID land.
+                    log.warning(
+                        "ps restart detected (generation %s); re-sending "
+                        "round %d delta", ps_generation, round_num,
+                    )
+                    session.send_resource(
+                        cfg.updates,
+                        delta_path.name,
+                        resource=cfg.updates.ref.resource or "updates",
+                        meta={
+                            "num_samples": float(round_samples),
+                            "round": round_num,
+                        },
+                    )
+                if meta.get(RESYNC_KEY) or meta.get(CATCHUP_KEY):
+                    # Resync announcements carry no tensor payload; stray
+                    # catch-ups target rejoiners and are folded into every
+                    # later broadcast anyway.
+                    (work_dir / event["path"]).unlink(missing_ok=True)
+                    continue
+                try:
+                    eround = int(meta.get("round", round_num))
+                except (TypeError, ValueError):
+                    eround = round_num
+                if eround < round_num:
+                    # A recovered PS re-broadcasts its last committed round
+                    # so un-wedged workers can proceed; this worker already
+                    # merged it — absorbing again would double-apply.
+                    (work_dir / event["path"]).unlink(missing_ok=True)
+                    continue
+                break
         update_file = work_dir / event["path"]
         # read_delta sniffs the format: a quantized (HQD1) broadcast
         # dequantizes to f32, a SafeTensors one loads as before.

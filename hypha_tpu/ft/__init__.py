@@ -8,6 +8,9 @@ defined over whichever replicas actually reported:
   detector.py   — φ-accrual failure detector over heartbeats/lease renewals
   membership.py — epoch-numbered RoundMembership + the FT wire vocabulary
   rejoin.py     — catch-up protocol (θ_r = θ₀ + Σ updates) for replacements
+  durable.py    — parameter-server round journal + outer-state checkpoint:
+                  a PS crash resumes the interrupted round (generation ids
+                  + client retry make re-sent deltas idempotent)
   chaos.py      — deterministic fault injection for tests and bench.py
 
 See docs/fault_tolerance.md for the full protocol description.
@@ -15,6 +18,7 @@ See docs/fault_tolerance.md for the full protocol description.
 
 from .chaos import ChaosAction, ChaosController, parse_chaos_spec
 from .detector import PHI_THRESHOLD_DEFAULT, PhiAccrualDetector
+from .durable import GENERATION_KEY, DurablePS, RoundJournal
 from .membership import (
     PROTOCOL_FT,
     FTConfig,
@@ -35,7 +39,10 @@ __all__ = [
     "RoundMembership",
     "quorum_size",
     "CATCHUP_KEY",
+    "GENERATION_KEY",
     "CatchupBuffer",
+    "DurablePS",
+    "RoundJournal",
     "await_catchup",
     "ChaosAction",
     "ChaosController",

@@ -13,7 +13,14 @@ WorkerNode` objects a test or ``bench.py --chaos`` holds:
     stale);
   * ``partition``  — fail every outbound push *and* request from the worker
     (uplink loss: the worker computes but cannot report; the φ detector is
-    the only thing that can see this one).
+    the only thing that can see this one);
+  * ``kill-ps``      — stop the PARAMETER SERVER's worker node mid-round
+    (the durable-PS recovery scenario, ft.durable: the harness restarts
+    the node and the journal + generation handshake resume the round);
+  * ``partition-ps`` — for ``delay_s`` seconds, drop every push between
+    the PS and the workers (both directions): workers must park and
+    re-push with backoff (aio.retry), and the PS journal must dedup the
+    copies whose first attempt actually landed.
 
 Trigger semantics: action ``at_round=r`` fires the first time a METRICS
 event for round ``r-1`` is observed — i.e. while round ``r`` is running —
@@ -35,7 +42,7 @@ __all__ = ["ChaosAction", "ChaosController", "parse_chaos_spec"]
 
 log = logging.getLogger("hypha.ft.chaos")
 
-_KINDS = ("kill", "delay", "partition")
+_KINDS = ("kill", "delay", "partition", "kill-ps", "partition-ps")
 
 
 @dataclass(slots=True)
@@ -64,10 +71,13 @@ def parse_chaos_spec(spec: str, target: str) -> ChaosAction:
         kind = "delay"
     elif head in ("partition-worker", "partition"):
         kind = "partition"
+    elif head in ("kill-ps", "partition-ps"):
+        kind = head
     else:
         raise ValueError(f"unknown chaos spec {spec!r}")
     at_round = int(parts[1]) if len(parts) > 1 else 1
-    delay_s = float(parts[2]) if len(parts) > 2 else 1.0
+    default_delay = 3.0 if kind == "partition-ps" else 1.0
+    delay_s = float(parts[2]) if len(parts) > 2 else default_delay
     return ChaosAction(kind=kind, target=target, at_round=at_round, delay_s=delay_s)
 
 
@@ -122,7 +132,7 @@ class ChaosController:
             log.warning("chaos: no worker %r to %s", action.target, action.kind)
             return
         log.info("chaos: %s %s (round trigger %d)", action.kind, action.target, action.at_round)
-        if action.kind == "kill":
+        if action.kind in ("kill", "kill-ps"):
             aio.spawn(
                 self._kill(worker), tasks=self._tasks, what="chaos kill", logger=log
             )
@@ -130,6 +140,8 @@ class ChaosController:
             self._wrap_push_delay(worker.node, action.delay_s)
         elif action.kind == "partition":
             self._partition(worker.node)
+        elif action.kind == "partition-ps":
+            self._partition_ps(action.target, action.delay_s)
 
     @staticmethod
     async def _kill(worker: Any) -> None:
@@ -157,6 +169,50 @@ class ChaosController:
             return await orig_push(peer_id, resource, source)
 
         node.push = delayed_push
+
+    def _partition_ps(self, ps_peer: str, duration_s: float) -> None:
+        """Sever the data plane between ``ps_peer`` and every other worker
+        for ``duration_s`` seconds, then heal. Workers' pushes toward the
+        PS (and the PS's broadcasts out) fail with RequestError — the
+        exact shape a mid-restart PS presents — so the client retry path
+        (aio.retry in the connectors) is what keeps the round alive."""
+        from ..network.node import RequestError
+
+        undo: list[tuple[Any, Any]] = []
+        for name, worker in self.workers.items():
+            node = getattr(worker, "node", None)
+            if node is None:
+                continue
+            orig_push = node.push
+            if name == ps_peer:
+
+                async def ps_push(peer_id: str, resource: Any, source) -> int:
+                    raise RequestError(
+                        "chaos partition-ps: broadcast push dropped"
+                    )
+
+                node.push = ps_push
+            else:
+
+                async def worker_push(
+                    peer_id: str, resource: Any, source, _orig=orig_push
+                ) -> int:
+                    if peer_id == ps_peer:
+                        raise RequestError(
+                            f"chaos partition-ps: push to {ps_peer} dropped"
+                        )
+                    return await _orig(peer_id, resource, source)
+
+                node.push = worker_push
+            undo.append((node, orig_push))
+
+        async def heal() -> None:
+            await asyncio.sleep(duration_s)
+            for node, orig_push in undo:
+                node.push = orig_push
+            log.info("chaos: partition-ps around %s healed", ps_peer)
+
+        aio.spawn(heal(), tasks=self._tasks, what="chaos heal", logger=log)
 
     @staticmethod
     def _partition(node: Any) -> None:

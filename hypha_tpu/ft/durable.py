@@ -1,0 +1,711 @@
+"""Durable parameter server: round journal, outer-state checkpoint, recovery.
+
+PR 1 made *workers* elastic, but the parameter server stayed a single point
+of failure: the in-flight round accumulators, the Nesterov momentum, the
+broadcast error-feedback residuals, the rejoin catch-up sum and the round
+counter all lived in memory, so a PS crash killed the job. This module is
+the classic async-PS answer (Li et al., OSDI'14; the fault-tolerance
+assumption in DiLoCo, Douillard et al., 2023): make the *server state*
+durable and the *clients* retry, and a PS restart costs bounded wall-clock
+instead of the run.
+
+Three pieces, all rooted in the job's ``checkpoint_dir``:
+
+  * :class:`RoundJournal` — a write-ahead log of the round protocol:
+    ``gen`` (one per PS process start — the **generation id** workers use
+    to detect a restart), ``open``, one ``fold`` per accepted delta
+    (peer, round, fragment, sample weight, wire-file sha — the saved wire
+    files under ``deltas/`` are the payload), ``close`` at quorum,
+    ``commit`` after the outer step, ``notified`` after the scheduler ack.
+    Records are length-prefixed CBOR, appended and fsync'd
+    (``$HYPHA_JOURNAL_FSYNC_EVERY`` batches the fsyncs; commits always
+    sync). A torn tail — the crash mid-append — parses as end-of-log.
+
+  * the **outer-state checkpoint** — an atomic snapshot (SafeTensors +
+    pointer-file rename) of everything the next outer step depends on:
+    momentum, the rejoin catch-up Σ, per-fragment broadcast EF residuals,
+    the next round number and membership epoch. Written every
+    ``ps_checkpoint_every_rounds`` commits; the journal is compacted to
+    the records after it.
+
+  * :class:`DurablePS` — the recovery driver. On restart it loads the
+    checkpoint, *re-plays* the journal after it (committed rounds re-run
+    their outer step from the journaled folds — bit-exact, because folds
+    re-apply in arrival order against the checkpointed momentum/EF), and
+    rebuilds the un-committed rounds' accumulator inputs so the executor
+    resumes the interrupted round instead of restarting the job. The
+    journal's (round, fragment, peer, sha) index makes client re-sends
+    idempotent: a delta the journal already holds folds zero more times.
+
+The executor-side wiring lives in :mod:`hypha_tpu.worker.ps_executor`;
+workers detect the restart via the :data:`GENERATION_KEY` header on every
+broadcast and re-send their un-acknowledged delta (see
+``executor/training.py``).
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import shutil
+import struct
+from dataclasses import dataclass, field
+from pathlib import Path
+from typing import Any, Iterable
+
+import numpy as np
+from safetensors.numpy import load_file, save_file
+
+from .. import codec
+from ..telemetry.ft_metrics import FT_METRICS
+
+__all__ = [
+    "GENERATION_KEY",
+    "RESYNC_KEY",
+    "JOURNAL_FSYNC_ENV",
+    "RoundJournal",
+    "DurablePS",
+    "FoldRecord",
+    "restart_signal",
+]
+
+log = logging.getLogger("hypha.ft.durable")
+
+# Push/broadcast header key carrying the PS process generation. A worker
+# that sees the value change re-sends its last un-acknowledged delta — the
+# restart may have lost a delta that was received but not yet journaled.
+GENERATION_KEY = "ps_generation"
+
+# Header key of the restart announcement a recovered PS pushes on the
+# results stream (an empty payload): "I am generation g — re-send anything
+# I have not journaled". Needed because a crash before the FIRST commit has
+# no broadcast to re-send the generation on.
+RESYNC_KEY = "ps_resync"
+
+# Batch journal fsyncs: every N appends (default 1 = every record). Commit
+# and generation records always sync — they gate externally visible
+# protocol steps. <= 0 disables fsync entirely (tests on tmpfs).
+JOURNAL_FSYNC_ENV = "HYPHA_JOURNAL_FSYNC_EVERY"
+
+# A journal record larger than this is a torn/corrupt length prefix, not a
+# real record (folds are ~200 bytes).
+_MAX_RECORD = 1 << 20
+
+_JOURNAL_NAME = "journal.cbor"
+_STATE_POINTER = "ps-state.json"
+
+
+def _fsync_every() -> int:
+    try:
+        return int(os.environ.get(JOURNAL_FSYNC_ENV, "1") or 1)
+    except ValueError:
+        return 1
+
+
+def restart_signal(meta: dict, last_gen: Any) -> tuple[Any, bool]:
+    """Detect a PS restart from one results-stream event header.
+
+    Returns ``(new_last_gen, resend)``: the generation to remember, and
+    whether the worker must re-send its un-acknowledged delta — on a
+    generation bump, or on an explicit resync announcement (which asks
+    unconditionally: a worker that never saw a broadcast has no baseline).
+    The ONE implementation both worker receive loops (blocking
+    ``do_update`` and the streaming flight thread) share, so the handshake
+    cannot silently diverge between sync modes.
+    """
+    gen = meta.get(GENERATION_KEY)
+    resync = bool(meta.get(RESYNC_KEY))
+    if gen is None:
+        return last_gen, resync
+    return gen, resync or (last_gen is not None and gen != last_gen)
+
+
+class RoundJournal:
+    """Append-only, length-prefixed CBOR record log with batched fsync."""
+
+    def __init__(self, path: Path | str, fsync_every: int | None = None) -> None:
+        self.path = Path(path)
+        self.fsync_every = _fsync_every() if fsync_every is None else fsync_every
+        self._f = open(self.path, "ab")
+        self._since_sync = 0
+        self.bytes_written = 0
+
+    def append(self, record: dict, *, sync: bool = False) -> None:
+        body = codec.dumps(record)
+        frame = struct.pack("<I", len(body)) + body
+        self._f.write(frame)
+        self.bytes_written += len(frame)
+        FT_METRICS.ps_journal_bytes.add(len(frame))
+        self._since_sync += 1
+        self._f.flush()
+        if sync or (0 < self.fsync_every <= self._since_sync):
+            # fsync_every <= 0 disables ALL fsyncs (tmpfs test runs) —
+            # even the commit records' forced ones.
+            if self.fsync_every > 0:
+                os.fsync(self._f.fileno())
+            self._since_sync = 0
+
+    def sync(self) -> None:
+        self._f.flush()
+        os.fsync(self._f.fileno())
+        self._since_sync = 0
+
+    def close(self) -> None:
+        try:
+            self._f.flush()
+        finally:
+            self._f.close()
+
+    def replace_with(self, records: Iterable[dict]) -> None:
+        """Compact: atomically rewrite the log to just ``records``.
+
+        Called at checkpoint time with the records the checkpoint does NOT
+        cover, so the journal stays proportional to the in-flight window
+        instead of the job's lifetime.
+        """
+        tmp = self.path.with_suffix(".tmp")
+        with open(tmp, "wb") as f:
+            for record in records:
+                body = codec.dumps(record)
+                f.write(struct.pack("<I", len(body)) + body)
+            f.flush()
+            os.fsync(f.fileno())
+        self._f.close()
+        os.replace(tmp, self.path)
+        self._f = open(self.path, "ab")
+        self._since_sync = 0
+
+    @staticmethod
+    def read_all(path: Path | str) -> list[dict]:
+        """Parse the log; a torn tail (crash mid-append) ends it cleanly."""
+        records: list[dict] = []
+        try:
+            data = Path(path).read_bytes()
+        except OSError:
+            return records
+        off = 0
+        while off + 4 <= len(data):
+            (length,) = struct.unpack_from("<I", data, off)
+            if length > _MAX_RECORD or off + 4 + length > len(data):
+                break  # torn tail: the append the crash interrupted
+            try:
+                record = codec.loads(data[off + 4 : off + 4 + length])
+            except ValueError:
+                break
+            if not isinstance(record, dict):
+                break
+            records.append(record)
+            off += 4 + length
+        return records
+
+
+@dataclass(slots=True)
+class FoldRecord:
+    """One accepted delta, as the journal remembers it."""
+
+    round: int
+    fragment: int
+    peer: str
+    samples: float
+    sha: str
+    file: str
+
+    def record(self) -> dict:
+        return {
+            "t": "fold",
+            "round": self.round,
+            "fragment": self.fragment,
+            "peer": self.peer,
+            "samples": self.samples,
+            "sha": self.sha,
+            "file": self.file,
+        }
+
+
+@dataclass(slots=True)
+class _Resume:
+    """What recovery hands back to the executor."""
+
+    next_round: int  # checkpointed next round (before journal replay)
+    epoch: int
+    active: list[str]
+    catchup_rounds: int
+    fragment_rounds: dict
+    state_file: str | None
+    # Commit records newer than the checkpoint, in round order:
+    # the executor re-runs their outer steps from the journaled folds.
+    committed: list[dict] = field(default_factory=list)
+    notified: dict[int, bool] = field(default_factory=dict)
+
+
+class DurablePS:
+    """The parameter server's durable state root (one job's ``ps/`` dir).
+
+    Construction (via :meth:`open`, blocking — run off-loop) appends this
+    process's ``gen`` record and, when the directory already holds state
+    for the SAME job id, parses checkpoint + journal into a
+    :class:`_Resume`. State from a *different* job id (a full job restart
+    re-dispatches under a fresh id) is wiped — the legacy momentum warm
+    start in the executor covers that path.
+    """
+
+    def __init__(
+        self,
+        root: Path,
+        job_id: str,
+        ckpt_every: int = 1,
+        fsync_every: int | None = None,
+    ) -> None:
+        self.root = Path(root)
+        self.job_id = job_id
+        self.ckpt_every = max(int(ckpt_every), 1)
+        self.deltas_dir = self.root / "deltas"
+        self.wires_dir = self.root / "wires"
+        self.generation = 1
+        self.resume: _Resume | None = None
+        self.journal: RoundJournal
+        self._fsync_every = fsync_every
+        # (round, fragment, peer) -> sha of the delta already folded.
+        self._dedup: dict[tuple[int, int, str], str] = {}
+        # round -> journaled fold records in arrival order (replacements
+        # appear as later records for the same peer).
+        self._folds: dict[int, list[FoldRecord]] = {}
+        # fragment -> (round, wire file name) of the newest committed round.
+        self._last_wire: dict[int, tuple[int, str]] = {}
+        # Records the current checkpoint does not cover (journal window).
+        self._window: list[dict] = []
+        self._ckpt_next_round = 0
+
+    # ------------------------------------------------------------- opening
+
+    @classmethod
+    def open(
+        cls,
+        root: Path | str,
+        job_id: str,
+        ckpt_every: int = 1,
+        fsync_every: int | None = None,
+    ) -> "DurablePS":
+        dur = cls(Path(root), job_id, ckpt_every, fsync_every)
+        dur.root.mkdir(parents=True, exist_ok=True)
+        dur.deltas_dir.mkdir(exist_ok=True)
+        dur.wires_dir.mkdir(exist_ok=True)
+        meta = dur._read_pointer()
+        if meta is not None and meta.get("job_id") != job_id:
+            log.info(
+                "durable ps state at %s belongs to job %s; starting fresh",
+                dur.root, meta.get("job_id"),
+            )
+            dur._wipe()
+            meta = None
+        records = RoundJournal.read_all(dur.root / _JOURNAL_NAME)
+        if meta is None and records:
+            # Journal without a matching pointer: a foreign/partial layout.
+            # Only trust it when its own job stamp matches.
+            stamps = [r for r in records if r.get("t") == "gen"]
+            if not stamps or stamps[0].get("job_id") != job_id:
+                dur._wipe()
+                records = []
+        dur.journal = RoundJournal(dur.root / _JOURNAL_NAME, fsync_every)
+        # Monotonic across ANY number of restarts: take the max of the
+        # recorded values, not a record count — checkpoint compaction
+        # rewrites the journal with a single gen record, so counting would
+        # collide successive generations and break the worker handshake.
+        prev_gen = max(
+            (int(r.get("generation", 0)) for r in records if r.get("t") == "gen"),
+            default=0,
+        )
+        if meta is not None:
+            prev_gen = max(prev_gen, int(meta.get("generation", 0)))
+        dur.generation = prev_gen + 1
+        dur.journal.append(
+            {"t": "gen", "generation": dur.generation, "job_id": job_id},
+            sync=True,
+        )
+        if meta is not None or records:
+            dur.resume = dur._build_resume(meta, records)
+            dur._gc_unreferenced()
+        return dur
+
+    def _gc_unreferenced(self) -> None:
+        """Drop files a crash stranded between checkpoint and cleanup."""
+        live_deltas = {
+            fold.file for folds in self._folds.values() for fold in folds
+        }
+        for f in self.deltas_dir.glob("*"):
+            if f.name not in live_deltas:
+                f.unlink(missing_ok=True)
+        live_wires = {name for _, name in self._last_wire.values()}
+        for f in self.wires_dir.glob("*"):
+            if f.name not in live_wires:
+                f.unlink(missing_ok=True)
+        keep_state = self.resume.state_file if self.resume else None
+        for f in self.root.glob("state-*.safetensors"):
+            if f.name != keep_state:
+                f.unlink(missing_ok=True)
+
+    def _read_pointer(self) -> dict | None:
+        try:
+            return json.loads((self.root / _STATE_POINTER).read_text())
+        except (OSError, ValueError):
+            return None
+
+    def _wipe(self) -> None:
+        for name in (_JOURNAL_NAME, _STATE_POINTER):
+            (self.root / name).unlink(missing_ok=True)
+        for d in (self.deltas_dir, self.wires_dir):
+            for f in d.glob("*"):
+                f.unlink(missing_ok=True)
+        for f in self.root.glob("state-*.safetensors"):
+            f.unlink(missing_ok=True)
+
+    def _build_resume(self, meta: dict | None, records: list[dict]) -> _Resume:
+        meta = meta or {}
+        resume = _Resume(
+            next_round=int(meta.get("next_round", 0)),
+            epoch=int(meta.get("epoch", 0)),
+            active=list(meta.get("active", [])),
+            catchup_rounds=int(meta.get("catchup_rounds", 0)),
+            fragment_rounds={
+                (None if k == "-" else int(k)): v
+                for k, v in (meta.get("fragment_rounds") or {}).items()
+            },
+            state_file=meta.get("state_file"),
+        )
+        self._ckpt_next_round = resume.next_round
+        # Checkpointed last-wire table: commit records older than the
+        # checkpoint are compacted away, so the meta carries each
+        # fragment's newest committed broadcast for re-send.
+        for frag, (rnd, name) in (meta.get("last_wires") or {}).items():
+            self._last_wire[int(frag)] = (int(rnd), str(name))
+        committed: dict[int, dict] = {}
+        for rec in records:
+            t = rec.get("t")
+            if t == "fold":
+                rnd = int(rec.get("round", -1))
+                if rnd < resume.next_round:
+                    continue  # covered by the checkpoint
+                fold = FoldRecord(
+                    round=rnd,
+                    fragment=int(rec.get("fragment", 0)),
+                    peer=str(rec.get("peer", "")),
+                    samples=float(rec.get("samples", 1.0)),
+                    sha=str(rec.get("sha", "")),
+                    file=str(rec.get("file", "")),
+                )
+                self._folds.setdefault(rnd, []).append(fold)
+                self._dedup[(rnd, fold.fragment, fold.peer)] = fold.sha
+                self._window.append(rec)
+            elif t == "commit":
+                rnd = int(rec.get("round", -1))
+                frag = int(rec.get("fragment", 0))
+                wire = str(rec.get("wire", ""))
+                prev = self._last_wire.get(frag)
+                if prev is None or rnd > prev[0]:
+                    self._last_wire[frag] = (rnd, wire)
+                if rnd >= resume.next_round:
+                    committed[rnd] = rec
+                    self._window.append(rec)
+            elif t == "notified":
+                rnd = int(rec.get("round", -1))
+                resume.notified[rnd] = bool(rec.get("done", False))
+                if rnd >= resume.next_round:
+                    self._window.append(rec)
+        resume.committed = [committed[r] for r in sorted(committed)]
+        # Sanity: committed rounds must be contiguous from the checkpoint —
+        # a gap means journal loss; refuse to silently skip outer steps.
+        expect = resume.next_round
+        for rec in resume.committed:
+            if int(rec["round"]) != expect:
+                raise ValueError(
+                    f"durable ps journal gap: commit for round {rec['round']} "
+                    f"but checkpoint resumes at {expect}"
+                )
+            expect += 1
+        return resume
+
+    # -------------------------------------------------------------- folding
+
+    def already_folded(
+        self, round_num: int, fragment: int, peer: str, sha: str
+    ) -> bool:
+        """True when this exact delta is in the journal — a client re-send
+        after a PS restart (or a retried push whose first copy landed).
+        Folding it again would double-count the worker in the mean."""
+        return self._dedup.get((round_num, fragment, peer)) == sha
+
+    def note_fold(self, fold: FoldRecord, *, sync: bool = False) -> None:
+        self._folds.setdefault(fold.round, []).append(fold)
+        self._dedup[(fold.round, fold.fragment, fold.peer)] = fold.sha
+        rec = fold.record()
+        self._window.append(rec)
+        self.journal.append(rec, sync=sync)
+
+    def note_open(self, round_num: int) -> None:
+        self.journal.append({"t": "open", "round": round_num})
+
+    def note_close(self, round_num: int, peers: list[str]) -> None:
+        self.journal.append(
+            {"t": "close", "round": round_num, "peers": sorted(peers)}
+        )
+
+    def note_notified(self, round_num: int, done: bool) -> None:
+        rec = {"t": "notified", "round": round_num, "done": done}
+        self._window.append(rec)
+        self.journal.append(rec, sync=True)
+
+    def folds_for(self, round_num: int) -> list[FoldRecord]:
+        """Journaled folds for ``round_num``, LAST send per peer winning
+        (a replacement supersedes the superseded delta's bytes), in the
+        order of the winning records — the round's final (peer → delta)
+        table, for rebuilding received/parked buckets."""
+        latest: dict[str, FoldRecord] = {}
+        for fold in self._folds.get(round_num, []):
+            latest[fold.peer] = fold
+        order = {id(f): i for i, f in enumerate(self._folds.get(round_num, []))}
+        return sorted(latest.values(), key=lambda f: order[id(f)])
+
+    def replay_ops(self, round_num: int) -> list[tuple[FoldRecord, float]]:
+        """The exact (record, sign) fold sequence that built the round's
+        live accumulator: +1 per record in arrival order, preceded by a
+        -1 un-fold of the record it replaces (the live collector retires a
+        duplicate at the moment the replacement lands). Float addition is
+        order-sensitive, so re-applying THIS sequence — not the last-wins
+        table — is what makes recovery's outer steps bit-equal to the
+        crashed process's; superseded delta files are retained until
+        checkpoint GC precisely so their un-fold can re-read the original
+        bytes. A superseded file that is nonetheless gone (pre-fix
+        journals) degrades that one pair to last-wins (value-correct,
+        ulp-level drift only)."""
+        ops: list[tuple[FoldRecord, float]] = []
+        last: dict[str, FoldRecord] = {}
+        for fold in self._folds.get(round_num, []):
+            prev = last.get(fold.peer)
+            if prev is not None:
+                if (self.deltas_dir / prev.file).is_file():
+                    ops.append((prev, -1.0))
+                else:
+                    # Cannot un-fold what we cannot re-read: drop the
+                    # superseded +/- pair instead (they net to ~zero).
+                    ops = [
+                        op for op in ops
+                        if not (op[0] is prev and op[1] > 0)
+                    ]
+            ops.append((fold, 1.0))
+            last[fold.peer] = fold
+        return ops
+
+    def pending_rounds(self, from_round: int) -> list[int]:
+        """Rounds >= ``from_round`` with journaled folds (the interrupted
+        round plus any early/parked future rounds)."""
+        return sorted(r for r in self._folds if r >= from_round)
+
+    # ------------------------------------------------------------ committing
+
+    def wire_path(self, round_num: int) -> Path:
+        return self.wires_dir / f"wire-{round_num}.safetensors"
+
+    def store_wire(self, round_num: int, wire_src: Path) -> str:
+        """Retain one round's broadcast wire file for restart re-send
+        (hard-linked when the work dir shares a filesystem, copied
+        otherwise). Returns the stored name for the commit record."""
+        dest = self.wire_path(round_num)
+        tmp = dest.with_suffix(".tmp")
+        tmp.unlink(missing_ok=True)
+        try:
+            os.link(wire_src, tmp)
+        except OSError:
+            shutil.copyfile(wire_src, tmp)
+        os.replace(tmp, dest)
+        return dest.name
+
+    def newest_commit(self, fragment: int) -> int:
+        """Round of the fragment's newest committed broadcast (-1: none).
+        Only that round's wire is ever re-sent, so recovery replay skips
+        re-storing the older committed rounds' wires — they would sit
+        un-GC'd (parameter-sized each) until the next crash's sweep."""
+        return self._last_wire.get(fragment, (-1, ""))[0]
+
+    def last_wires(self) -> list[tuple[int, int, Path]]:
+        """(round, fragment, path) of each fragment's newest committed
+        broadcast, in round order — what recovery re-broadcasts so a
+        worker whose round never reached it is un-wedged."""
+        out = []
+        for frag, (rnd, name) in self._last_wire.items():
+            path = self.wires_dir / name
+            if path.is_file():
+                out.append((rnd, frag, path))
+        return sorted(out)
+
+    def commit_round(
+        self,
+        round_num: int,
+        fragment: int,
+        wire_name: str,
+        *,
+        epoch: int,
+        momentum_file: Path,
+        catchup=None,
+        efs: dict[int, Any] | None = None,
+        active: list[str] | None = None,
+    ) -> None:
+        """Durably commit one outer step (blocking; run off-loop).
+
+        Order matters: the checkpoint (when due) lands BEFORE the commit
+        record, so a commit in the journal always has a state snapshot at
+        or before it to replay from.
+        """
+        prev = self._last_wire.get(fragment)
+        self._last_wire[fragment] = (round_num, wire_name)
+        if (round_num + 1) % self.ckpt_every == 0:
+            self._checkpoint(
+                next_round=round_num + 1,
+                epoch=epoch,
+                momentum_file=momentum_file,
+                catchup=catchup,
+                efs=efs or {},
+                active=active or [],
+            )
+        rec = {
+            "t": "commit",
+            "round": round_num,
+            "fragment": fragment,
+            "wire": wire_name,
+            "epoch": epoch,
+        }
+        self._window.append(rec)
+        self.journal.append(rec, sync=True)
+        # The superseded wire of this fragment can go now — only the newest
+        # committed broadcast per fragment is ever re-sent.
+        if prev is not None and prev[1] != wire_name:
+            (self.wires_dir / prev[1]).unlink(missing_ok=True)
+
+    def _checkpoint(
+        self,
+        *,
+        next_round: int,
+        epoch: int,
+        momentum_file: Path,
+        catchup,
+        efs: dict[int, Any],
+        active: list[str],
+    ) -> None:
+        tensors: dict[str, np.ndarray] = {}
+        if momentum_file.is_file():
+            for key, value in load_file(str(momentum_file)).items():
+                tensors[f"momentum/{key}"] = value
+        catchup_rounds = 0
+        fragment_rounds: dict = {}
+        if catchup is not None:
+            cum, catchup_rounds, fragment_rounds = catchup.state()
+            for key, value in cum.items():
+                tensors[f"catchup/{key}"] = value
+        for frag, ef in efs.items():
+            if ef is None:
+                continue
+            for key, value in ef.state().items():
+                tensors[f"ef/{frag}/{key}"] = value
+        state_file = f"state-{next_round}.safetensors"
+        tmp = self.root / (state_file + ".tmp")
+        save_file(tensors, str(tmp))
+        with open(tmp, "rb+") as f:
+            os.fsync(f.fileno())
+        os.replace(tmp, self.root / state_file)
+        meta = {
+            "job_id": self.job_id,
+            "next_round": next_round,
+            "epoch": epoch,
+            "active": list(active),
+            "catchup_rounds": catchup_rounds,
+            "fragment_rounds": {
+                ("-" if k is None else str(k)): v
+                for k, v in fragment_rounds.items()
+            },
+            "state_file": state_file,
+            "generation": self.generation,
+            "last_wires": {
+                str(frag): [rnd, name]
+                for frag, (rnd, name) in self._last_wire.items()
+            },
+        }
+        pointer_tmp = self.root / (_STATE_POINTER + ".tmp")
+        pointer_tmp.write_text(json.dumps(meta, indent=1))
+        with open(pointer_tmp, "rb+") as f:
+            os.fsync(f.fileno())
+        # THE commit point: readers see either the old snapshot or this one.
+        os.replace(pointer_tmp, self.root / _STATE_POINTER)
+        old_next = self._ckpt_next_round
+        self._ckpt_next_round = next_round
+        # GC: everything the snapshot covers — old state files, delta wire
+        # files of checkpointed rounds, and the journal window.
+        for f in self.root.glob("state-*.safetensors"):
+            if f.name != state_file:
+                f.unlink(missing_ok=True)
+        for rnd in [r for r in self._folds if r < next_round]:
+            for fold in self._folds.pop(rnd):
+                (self.deltas_dir / fold.file).unlink(missing_ok=True)
+                self._dedup.pop((rnd, fold.fragment, fold.peer), None)
+        self._window = [
+            r
+            for r in self._window
+            if int(r.get("round", -1)) >= next_round
+        ]
+        self.journal.replace_with(
+            [{"t": "gen", "generation": self.generation, "job_id": self.job_id}]
+            + self._window
+        )
+        log.info(
+            "durable ps checkpoint: next_round %d -> %d (%d tensors, "
+            "journal window %d records)",
+            old_next, next_round, len(tensors), len(self._window),
+        )
+
+    # ------------------------------------------------------------- recovery
+
+    def restore_momentum(self, momentum_file: Path) -> None:
+        tensors = self._state_tensors("momentum/")
+        if tensors:
+            tmp = momentum_file.with_suffix(".tmp")
+            save_file(tensors, str(tmp))
+            os.replace(tmp, momentum_file)
+        else:
+            momentum_file.unlink(missing_ok=True)
+
+    def restore_catchup(self, catchup) -> None:
+        assert self.resume is not None
+        catchup.restore(
+            self._state_tensors("catchup/"),
+            self.resume.catchup_rounds,
+            self.resume.fragment_rounds,
+        )
+
+    def restore_efs(self) -> dict[int, dict[str, np.ndarray]]:
+        """fragment id -> residual tree (empty dict when none saved)."""
+        out: dict[int, dict[str, np.ndarray]] = {}
+        if self.resume is None or self.resume.state_file is None:
+            return out
+        for key, value in self._raw_state().items():
+            if not key.startswith("ef/"):
+                continue
+            _, frag, name = key.split("/", 2)
+            out.setdefault(int(frag), {})[name] = value
+        return out
+
+    def _raw_state(self) -> dict[str, np.ndarray]:
+        if self.resume is None or self.resume.state_file is None:
+            return {}
+        path = self.root / self.resume.state_file
+        if not path.is_file():
+            return {}
+        return dict(load_file(str(path)))
+
+    def _state_tensors(self, prefix: str) -> dict[str, np.ndarray]:
+        return {
+            key[len(prefix):]: value
+            for key, value in self._raw_state().items()
+            if key.startswith(prefix)
+        }
+
+    def close(self) -> None:
+        self.journal.close()

@@ -65,6 +65,14 @@ class FTConfig:
     # as a permanently degraded round set.
     rejoin_attempts: int = 3
     rejoin_backoff_s: float = 2.0
+    # Parameter-server crash recovery (ft.durable): how many times the
+    # orchestrator re-auctions + re-dispatches the aggregate job after a PS
+    # failure before falling back to a full job restart. Requires the job
+    # to have a checkpoint_dir (the durable journal lives there) and the PS
+    # to come back under the SAME peer id — worker push targets are wired
+    # at dispatch, so recovery models a process restart, not a migration.
+    ps_restart_attempts: int = 2
+    ps_restart_backoff_s: float = 1.0
 
     def __post_init__(self) -> None:
         if not 0.0 <= self.quorum_fraction <= 1.0:

@@ -109,6 +109,23 @@ class CatchupBuffer:
             self.fragment_rounds.get(fragment_id, 0) + 1
         )
 
+    def state(self) -> tuple[dict[str, np.ndarray], int, dict[int | None, int]]:
+        """(cumulative sum, rounds, fragment_rounds) for ft.durable's
+        outer-state checkpoint — a recovered PS must serve rejoiners the
+        same Σ its predecessor held."""
+        return dict(self._cum), self.rounds, dict(self.fragment_rounds)
+
+    def restore(
+        self,
+        cum: dict[str, np.ndarray],
+        rounds: int,
+        fragment_rounds: dict[int | None, int],
+    ) -> None:
+        self._cum = {k: np.asarray(v, np.float32).copy() for k, v in cum.items()}
+        self.rounds = int(rounds)
+        self.fragment_rounds = dict(fragment_rounds)
+        self._written = None
+
     def write(self, path: Path | str) -> Path:
         """Materialize the sum for a catch-up push (atomic via temp name).
 

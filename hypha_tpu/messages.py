@@ -557,6 +557,11 @@ class AggregateExecutorConfig:
     # on the wire = blocking, the seed's sequential round loop.
     sync_mode: str = "blocking"
     fragments: int = 0
+    # Durable PS (hypha_tpu.ft.durable), active whenever checkpoint_dir is
+    # set: how many committed rounds between outer-state checkpoints. The
+    # round journal covers the gap — a larger value trades cheaper commits
+    # for a longer replay on recovery. Additive field: absent = 1.
+    ps_checkpoint_every_rounds: int = 1
 
 
 @register

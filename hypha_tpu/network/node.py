@@ -371,6 +371,13 @@ class PushConsumer:
             push.finish()
 
 
+def _write_and_hash(f, data: bytes, hasher) -> None:
+    """One executor hop for write + digest (hashlib releases the GIL on
+    large buffers, so both stay off the event loop)."""
+    f.write(data)
+    hasher.update(data)
+
+
 def _drain_socket_to_file(sock, buffered: bytes, path) -> int:
     """Blocking drain: recv_into an mmap of ``path`` until EOF.
 
@@ -445,7 +452,7 @@ class PushStream:
         self.finish()
         return b"".join(parts)
 
-    async def save_to(self, path, chunk: int = 1 << 22) -> int:
+    async def save_to(self, path, chunk: int = 1 << 22, hasher=None) -> int:
         """Stream to disk without buffering the whole payload (the reference
         file-mediates all tensor transfers, bridge.rs:392-504).
 
@@ -463,11 +470,17 @@ class PushStream:
         ~220-530 vs ~760-780 sustained) — so it stays off by default and
         is the right switch only for hosts with fast local disks. TLS /
         mux / relay streams always use the buffered path (their bytes
-        must pass through the event loop)."""
+        must pass through the event loop).
+
+        ``hasher``: optional hashlib object updated with every chunk as it
+        is written — a receiver that needs a digest of the payload (the
+        durable PS journal's dedup key) gets it in the same pass instead
+        of re-reading the file; requesting one forces the buffered path,
+        since the raw-drain handoff never surfaces the bytes."""
         import os as _os
 
         handoff = None
-        if _os.environ.get("HYPHA_RAW_DRAIN") == "1":
+        if hasher is None and _os.environ.get("HYPHA_RAW_DRAIN") == "1":
             handoff = getattr(self.stream, "raw_socket_handoff", None)
         handoff = handoff() if handoff is not None else None
         if handoff is not None:
@@ -495,7 +508,12 @@ class PushStream:
                     data = await self.stream.read(chunk)
                     if not data:
                         break
-                    await loop.run_in_executor(None, f.write, data)
+                    if hasher is None:
+                        await loop.run_in_executor(None, f.write, data)
+                    else:
+                        await loop.run_in_executor(
+                            None, _write_and_hash, f, data, hasher
+                        )
                     total += len(data)
             finally:
                 await asyncio.to_thread(f.close)
@@ -876,6 +894,25 @@ class Node:
         return stream
 
     async def _stream_to(self, peer_id: str, proto: str) -> Stream:
+        try:
+            return await self._stream_to_known(peer_id, proto)
+        except RequestError as first:
+            # Every known route failed. A peer that RESTARTED (PS crash
+            # recovery, ft.durable) re-registers with the gateway under
+            # fresh addresses, but a stale peerstore entry would otherwise
+            # shadow the lookup forever — purge and re-resolve once.
+            stale = self._peers.pop(peer_id, None)
+            found = await self._lookup_peer(peer_id)
+            if not any(a for a in found if not stale or a not in stale):
+                if stale:
+                    self._peers.setdefault(peer_id, stale)
+                raise
+            try:
+                return await self._stream_to_known(peer_id, proto)
+            except RequestError:
+                raise first
+
+    async def _stream_to_known(self, peer_id: str, proto: str) -> Stream:
         addrs = list(self._peers.get(peer_id, []))
         if not addrs:
             found = await self._lookup_peer(peer_id)

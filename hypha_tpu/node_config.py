@@ -298,6 +298,13 @@ class JobSection:
     checkpoint_every: int = field(
         default=1, metadata={"doc": "checkpoint every N completed rounds"}
     )
+    ps_checkpoint_every_rounds: int = field(
+        default=1,
+        metadata={
+            "doc": "durable PS: outer-state checkpoint every N committed "
+            "rounds (journal covers the gap; needs checkpoint_dir)"
+        },
+    )
     max_attempts: int = field(
         default=1,
         metadata={"doc": "re-run a failed job up to N times (elastic recovery)"},
@@ -361,6 +368,8 @@ class JobSection:
             raise ConfigError("job.dataset is required")
         if self.max_attempts < 1:
             raise ConfigError("job.max_attempts must be >= 1")
+        if self.ps_checkpoint_every_rounds < 1:
+            raise ConfigError("job.ps_checkpoint_every_rounds must be >= 1")
         if not 0.0 <= self.quorum_fraction <= 1.0:
             raise ConfigError("job.quorum_fraction must be in [0, 1]")
         from .compress import CODECS
@@ -443,6 +452,7 @@ class JobSection:
             sharding=dict(self.sharding) or None,
             checkpoint_dir=self.checkpoint_dir or None,
             checkpoint_every=self.checkpoint_every,
+            ps_checkpoint_every_rounds=self.ps_checkpoint_every_rounds,
             delta_codec=self.delta_codec,
             sync_mode=self.sync_mode,
             num_fragments=self.num_fragments,

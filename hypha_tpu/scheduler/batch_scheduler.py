@@ -80,6 +80,15 @@ class BatchScheduler:
                 return ProgressResponse(
                     kind=ProgressResponseKind.ERROR, message="not the parameter server"
                 )
+            if progress.round < self.tracker.round:
+                # Idempotent by round: a recovered parameter server cannot
+                # know whether its predecessor's notify landed before the
+                # crash, so it re-sends — advancing again would eat a round.
+                return (
+                    _DONE
+                    if self.tracker.round >= self.tracker.update_epochs
+                    else _OK
+                )
             self.tracker.advance_round()
             if self.tracker.round >= self.tracker.update_epochs:
                 # That was the final outer step: the PS's aggregation loop

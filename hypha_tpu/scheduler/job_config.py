@@ -95,6 +95,10 @@ class DiLoCoJob:
     # per-host). Unset checkpoint_dir — or checkpoint_every <= 0 — disables.
     checkpoint_dir: str | None = None
     checkpoint_every: int = 1
+    # Durable PS (ft.durable; needs checkpoint_dir): committed rounds
+    # between outer-state checkpoints. The round journal covers the gap, so
+    # a larger value trades cheaper commits for a longer recovery replay.
+    ps_checkpoint_every_rounds: int = 1
     # Elastic round membership (hypha_tpu.ft): quorum + deadline
     # aggregation, φ-accrual suspicion and worker rejoin without a job
     # restart. None keeps the seed's all-or-abort semantics; max_attempts
@@ -128,6 +132,8 @@ class DiLoCoJob:
             )
         if self.num_fragments < 0:
             raise ValueError("num_fragments must be >= 0 (0 = default)")
+        if self.ps_checkpoint_every_rounds < 1:
+            raise ValueError("ps_checkpoint_every_rounds must be >= 1")
         if self.rounds.update_rounds <= 0:
             raise ValueError("update_rounds must be positive")
         if self.rounds.avg_samples_between_updates <= 0:

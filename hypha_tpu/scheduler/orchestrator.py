@@ -144,6 +144,12 @@ class _RunContext:
         self.membership: MembershipView | None = None
         self.rejoin_count = 0
         self.notify_tasks: set[asyncio.Task] = set()
+        # PS crash recovery (ft.durable): the dispatched aggregate spec is
+        # re-used verbatim on restart (same job id + stream tags, so the
+        # recovered PS resumes its own durable state).
+        self.ps_spec: JobSpec | None = None
+        self.ps_restarts = 0
+        self.ps_restarting = False
 
 
 class Orchestrator:
@@ -435,42 +441,41 @@ class Orchestrator:
             ctx.results_tag = f"results:{ctx.base_id}"
             ctx.ps_job_id = f"{ctx.base_id}-ps"
 
-            ps_task = await Task.dispatch(
-                self.node,
-                ctx.router,
-                JobSpec(
-                    job_id=ctx.ps_job_id,
-                    executor=Executor(
-                        kind="aggregate",
-                        name=AGGREGATE_EXECUTOR_NAME,
-                        aggregate=AggregateExecutorConfig(
-                            updates=Receive(
-                                Reference.from_peers(worker_peers, ctx.updates_tag)
-                            ),
-                            results=Send(
-                                Reference.from_peers(worker_peers, ctx.results_tag)
-                            ),
-                            optimizer=job.outer_optimizer,
-                            num_workers=len(worker_peers),
-                            checkpoint_dir=(
-                                f"{job.checkpoint_dir}/ps"
-                                if job.checkpoint_dir
-                                else None
-                            ),
-                            quorum_fraction=ft.quorum_fraction if ft else 0.0,
-                            round_deadline_s=ft.round_deadline_s if ft else 0.0,
-                            # The broadcast mirrors the upload codec: the
-                            # receive side sniffs frames, so one field is
-                            # enough for both directions.
-                            delta_codec=job.delta_codec,
-                            # Workers and the PS must agree on the fragment
-                            # schedule, so both sides get the same pair.
-                            sync_mode=job.sync_mode,
-                            fragments=job.num_fragments,
+            ctx.ps_spec = JobSpec(
+                job_id=ctx.ps_job_id,
+                executor=Executor(
+                    kind="aggregate",
+                    name=AGGREGATE_EXECUTOR_NAME,
+                    aggregate=AggregateExecutorConfig(
+                        updates=Receive(
+                            Reference.from_peers(worker_peers, ctx.updates_tag)
                         ),
+                        results=Send(
+                            Reference.from_peers(worker_peers, ctx.results_tag)
+                        ),
+                        optimizer=job.outer_optimizer,
+                        num_workers=len(worker_peers),
+                        checkpoint_dir=(
+                            f"{job.checkpoint_dir}/ps"
+                            if job.checkpoint_dir
+                            else None
+                        ),
+                        ps_checkpoint_every_rounds=job.ps_checkpoint_every_rounds,
+                        quorum_fraction=ft.quorum_fraction if ft else 0.0,
+                        round_deadline_s=ft.round_deadline_s if ft else 0.0,
+                        # The broadcast mirrors the upload codec: the
+                        # receive side sniffs frames, so one field is
+                        # enough for both directions.
+                        delta_codec=job.delta_codec,
+                        # Workers and the PS must agree on the fragment
+                        # schedule, so both sides get the same pair.
+                        sync_mode=job.sync_mode,
+                        fragments=job.num_fragments,
                     ),
                 ),
-                [ctx.ps_handle],
+            )
+            ps_task = await Task.dispatch(
+                self.node, ctx.router, ctx.ps_spec, [ctx.ps_handle]
             )
             tasks.append(ps_task)
             for i, (peer, handle) in enumerate(ctx.handles.items()):
@@ -595,19 +600,35 @@ class Orchestrator:
                         continue
                     if kind == "status":
                         peer, job_id, reason = t.result()
-                        if ctx.ft is None or job_id == ctx.ps_job_id:
+                        if job_id == ctx.ps_job_id:
+                            self._request_ps_restart(
+                                ctx, f"{job_id} failed on {peer}: {reason}", add
+                            )
+                        elif ctx.ft is None:
                             raise JobFailed(f"{job_id} failed on {peer}: {reason}")
-                        await self._depart(ctx, peer, f"{job_id}: {reason}", add)
+                        else:
+                            await self._depart(ctx, peer, f"{job_id}: {reason}", add)
                     elif kind == "worker":
                         failure = t.result()
                         peer = getattr(failure, "peer_id", "")
-                        is_ps = (
-                            ctx.ps_handle is not None
-                            and payload is ctx.ps_handle
-                        )
-                        if ctx.ft is None or is_ps:
+                        is_ps = payload is not None and payload is ctx.ps_handle
+                        if is_ps:
+                            self._request_ps_restart(ctx, str(failure), add)
+                        elif ctx.ft is None:
                             raise JobFailed(str(failure))
-                        await self._depart(ctx, peer, str(failure), add)
+                        else:
+                            await self._depart(ctx, peer, str(failure), add)
+                    elif kind == "ps-restart":
+                        ctx.ps_restarting = False
+                        revived = t.result()
+                        if revived is None:
+                            raise JobFailed(
+                                "parameter server restart failed "
+                                f"(after {ctx.ps_restarts} attempt(s))"
+                            )
+                        handle, task = revived
+                        add("status", task, self._watch_status(task))
+                        add("worker", handle, _await_failure(handle))
                     elif kind == "rejoin":
                         joined = t.result()
                         if joined is not None:
@@ -626,6 +647,115 @@ class Orchestrator:
             for t in waiters:
                 t.cancel()
             await asyncio.gather(*waiters, return_exceptions=True)
+
+    # ----------------------------------------------------- PS crash recovery
+
+    def _request_ps_restart(self, ctx: _RunContext, reason: str, add) -> None:
+        """PS failure signal → queue a restart attempt, or fail the attempt.
+
+        Eligible only when the job is elastic, has ``ps_restart_attempts``
+        left, and carries a checkpoint_dir — without the durable journal
+        (ft.durable) a re-dispatched PS would restart the round counter
+        while workers sit mid-round, which is worse than the full restart.
+        A second failure signal for the same outage (lease failure + failed
+        job status) folds into the in-flight attempt.
+        """
+        if ctx.ps_restarting:
+            log.info("ps failure signal during restart (%s); ignored", reason)
+            return
+        eligible = (
+            ctx.ft is not None
+            and ctx.ft.ps_restart_attempts > 0
+            and ctx.ps_restarts < ctx.ft.ps_restart_attempts
+            and ctx.job is not None
+            and bool(ctx.job.checkpoint_dir)
+            and ctx.ps_spec is not None
+        )
+        if not eligible:
+            raise JobFailed(f"parameter server failed: {reason}")
+        ctx.ps_restarts += 1
+        ctx.ps_restarting = True
+        log.warning(
+            "parameter server failed (%s); restart attempt %d/%d",
+            reason, ctx.ps_restarts, ctx.ft.ps_restart_attempts,
+        )
+        add("ps-restart", None, self._restart_ps(ctx))
+
+    async def _restart_ps(
+        self, ctx: _RunContext
+    ) -> tuple[WorkerHandle, Task] | None:
+        """Re-auction the SAME peer and re-dispatch the aggregate job.
+
+        The peer id must match the failed PS's: every worker's
+        updates/results reference was wired to it at dispatch, so recovery
+        models the process restarting on its host (the classic parameter-
+        server deployment), not a migration. The re-dispatched job (same
+        job id) finds its durable journal under checkpoint_dir and resumes
+        the interrupted round (ps_executor recovery path).
+        """
+        assert ctx.ft is not None and ctx.job is not None
+        assert ctx.ps_spec is not None
+        old_peer = ctx.ps_handle.peer_id if ctx.ps_handle is not None else ""
+        if ctx.ps_handle is not None:
+            await ctx.ps_handle.release()
+            ctx.ps_handle = None
+        res = ctx.job.resources
+        ps_spec = WorkerSpec(
+            resources=res.parameter_server,
+            executor=[
+                ExecutorDescriptor(
+                    executor_class="aggregate", name=AGGREGATE_EXECUTOR_NAME
+                )
+            ],
+        )
+        # The restarted node needs a beat to bind + re-register before it
+        # can hear the auction.
+        deadline = (
+            asyncio.get_running_loop().time()
+            + max(ctx.ft.ps_restart_backoff_s, 0.1) * 20
+        )
+        attempt = 0
+        while asyncio.get_running_loop().time() < deadline:
+            if attempt:
+                await asyncio.sleep(ctx.ft.ps_restart_backoff_s)
+            attempt += 1
+            try:
+                offers = await self.allocator.request(
+                    ps_spec, res.parameter_server_price, ctx.auction_timeout, 8
+                )
+            except Exception as e:
+                log.warning("ps restart auction failed: %s", e)
+                continue
+            same = [o for o in offers if o.peer_id == old_peer]
+            if not same:
+                log.info(
+                    "ps restart: no offer from %s yet (%d others)",
+                    old_peer, len(offers),
+                )
+                continue
+            handle: WorkerHandle | None = None
+            try:
+                handle = await WorkerHandle.create(self.node, same[0])
+                task = await Task.dispatch(
+                    self.node, ctx.router, ctx.ps_spec, [handle]
+                )
+            except asyncio.CancelledError:
+                if handle is not None:
+                    await handle.release()
+                raise
+            except (RequestError, DispatchError) as e:
+                log.warning("ps restart dispatch failed: %s", e)
+                if handle is not None:
+                    await handle.release()
+                continue
+            ctx.ps_handle = handle
+            if ctx.membership is not None:
+                # Bring the recovered PS's (checkpoint-restored) view up to
+                # date, including any rejoiners it still owes catch-ups.
+                self._notify_membership_soon(ctx)
+            log.warning("parameter server restarted on %s", old_peer)
+            return handle, task
+        return None
 
     # ------------------------------------------------------- elastic details
 

@@ -32,6 +32,12 @@ class FTMetrics:
         self.degraded_rounds = Counter("hypha.ft.degraded_rounds")
         self.stale_deltas_dropped = Counter("hypha.ft.stale_deltas_dropped")
         self.rejoins = Counter("hypha.ft.rejoins")
+        # Durable-PS instruments (hypha_tpu.ft.durable): re-attempted fabric
+        # operations (aio.retry), write-ahead journal bytes appended, and
+        # completed parameter-server crash recoveries.
+        self.retry_attempts = Counter("hypha.ft.retry_attempts")
+        self.ps_journal_bytes = Counter("hypha.ps.journal_bytes")
+        self.ps_recoveries = Counter("hypha.ps.recoveries")
         self.rejoin_latency_ms = Histogram(
             "hypha.ft.rejoin_latency", unit="ms",
             bounds=(50, 100, 250, 500, 1000, 2500, 5000, 10000, 30000),
@@ -44,6 +50,9 @@ class FTMetrics:
             "degraded_rounds": self.degraded_rounds.value(),
             "stale_deltas_dropped": self.stale_deltas_dropped.value(),
             "rejoins": self.rejoins.value(),
+            "retry_attempts": self.retry_attempts.value(),
+            "ps_journal_bytes": self.ps_journal_bytes.value(),
+            "ps_recoveries": self.ps_recoveries.value(),
             "rejoin_latency_ms_sum": hist["sum"],
             "rejoin_latency_ms_count": hist["count"],
         }
@@ -189,6 +198,13 @@ def register_on(
         "hypha.ft.stale_deltas_dropped", metrics.stale_deltas_dropped.value
     )
     meter.observable_gauge("hypha.ft.rejoins", metrics.rejoins.value)
+    meter.observable_gauge(
+        "hypha.ft.retry_attempts", metrics.retry_attempts.value
+    )
+    meter.observable_gauge(
+        "hypha.ps.journal_bytes", metrics.ps_journal_bytes.value
+    )
+    meter.observable_gauge("hypha.ps.recoveries", metrics.ps_recoveries.value)
     meter.observable_gauge(
         "hypha.stream.bytes_in_flight", stream.bytes_in_flight
     )

@@ -20,12 +20,14 @@ from __future__ import annotations
 import asyncio
 import hashlib
 import logging
+import os
 import shutil
 import urllib.parse
 import urllib.request
 from pathlib import Path
 from typing import AsyncIterator
 
+from .. import aio
 from ..messages import (
     PROTOCOL_API,
     DataRequest,
@@ -47,6 +49,45 @@ log = logging.getLogger("hypha.worker.connector")
 def _safe_name(name: str) -> str:
     """Collapse any peer-supplied name to a flat digest-based filename."""
     return hashlib.sha256(name.encode()).hexdigest()[:32]
+
+
+# Outbound tensor pushes retry with jittered backoff (aio.retry) for up to
+# this many seconds: a parameter-server restart or a transient partition
+# costs a few re-attempts, not a lost delta and a wedged round. The PS's
+# journal dedups any copy whose first attempt actually landed.
+PUSH_RETRY_DEADLINE_ENV = "HYPHA_PUSH_RETRY_DEADLINE"
+PUSH_RETRY_DEADLINE_DEFAULT = 120.0
+
+
+def _push_deadline() -> float:
+    try:
+        return float(
+            os.environ.get(PUSH_RETRY_DEADLINE_ENV, "")
+            or PUSH_RETRY_DEADLINE_DEFAULT
+        )
+    except ValueError:
+        return PUSH_RETRY_DEADLINE_DEFAULT
+
+
+def push_timeout(path: Path, base: float = 60.0) -> float:
+    """Per-attempt wall-clock bound for a parameter-sized push: a push
+    black-holed by a partition that drops packets without RST must fail
+    fast enough to retry (the deadline is only consulted BETWEEN
+    attempts), but a legitimately slow multi-GB transfer must never be
+    cancelled mid-flight — so the bound grows with the payload at a
+    conservative floor rate (10 MB/s) over ``base``.
+    ``$HYPHA_PUSH_ATTEMPT_TIMEOUT`` overrides outright."""
+    env = os.environ.get("HYPHA_PUSH_ATTEMPT_TIMEOUT")
+    if env:
+        try:
+            return float(env)
+        except ValueError:
+            pass
+    try:
+        size = path.stat().st_size
+    except OSError:
+        size = 0
+    return base + size / (10 * 1024 * 1024)
 
 
 class ReceivedFile:
@@ -171,25 +212,70 @@ class Connector:
         """Push a local file to the reference's peers. ALL: every peer must
         get it; ANY: first success wins (connector/mod.rs:305-433).
         ``meta`` keys ride the stream header (the parameter server reads
-        ``num_samples`` for its weighted mean); the reserved keys win."""
+        ``num_samples`` for its weighted mean); the reserved keys win.
+
+        Failed pushes retry with jittered backoff up to
+        ``$HYPHA_PUSH_RETRY_DEADLINE`` seconds (default 120): the worker
+        *parks and re-pushes* across a receiver outage — a restarting
+        parameter server — instead of failing the round on first contact.
+        """
         ref = send.ref
         peers = ref.peers or []
         strategy = ref.strategy or TransferStrategy.ALL
         header = {**(meta or {}), "resource": resource, "name": path.name}
+        deadline = _push_deadline()
+        # Per-attempt bound: a push black-holed by a silent partition (no
+        # RST, TCP retransmitting forever) must be cancelled and retried —
+        # the deadline alone cannot interrupt an attempt in flight.
+        attempt_timeout = push_timeout(path)
         if strategy == TransferStrategy.ANY:
-            last: Exception | None = None
-            for peer in peers:
-                try:
-                    await self.node.push(peer, header, path)
-                    return
-                except RequestError as e:
-                    last = e
-            raise RequestError(f"no peer accepted {resource}: {last}")
+
+            async def any_once() -> None:
+                last: Exception | None = None
+                for peer in peers:
+                    try:
+                        await self.node.push(peer, header, path)
+                        return
+                    except (RequestError, OSError) as e:
+                        # OSError too: a peer that accepts the dial but
+                        # resets mid-push must not stop the failover —
+                        # the next peer gets its try within THIS attempt.
+                        last = e
+                raise RequestError(f"no peer accepted {resource}: {last}")
+
+            try:
+                await aio.retry(
+                    any_once,
+                    base_delay=0.25, max_delay=5.0, deadline=deadline,
+                    attempt_timeout=attempt_timeout * max(len(peers), 1),
+                    retry_on=(RequestError, OSError),
+                    what=f"push {resource} (any)", logger=log,
+                )
+            except asyncio.TimeoutError as e:
+                raise RequestError(
+                    f"push {resource} (any) timed out after {deadline}s"
+                ) from e
+            return
         failures = []
+        # ONE retry budget shared across the whole peer list — the peers
+        # are pushed sequentially, so a per-peer deadline would multiply
+        # the promised bound by the number of dead peers. Every peer still
+        # gets at least one attempt (retry only consults the deadline
+        # before SLEEPING, never before the first try).
+        stop_at = asyncio.get_running_loop().time() + deadline
         for peer in peers:
             try:
-                await self.node.push(peer, header, path)
-            except RequestError as e:
+                await aio.retry(
+                    lambda p=peer: self.node.push(p, header, path),
+                    base_delay=0.25, max_delay=5.0,
+                    attempt_timeout=attempt_timeout,
+                    deadline=max(
+                        stop_at - asyncio.get_running_loop().time(), 0.0
+                    ),
+                    retry_on=(RequestError, OSError),
+                    what=f"push {resource} to {peer}", logger=log,
+                )
+            except (RequestError, OSError, asyncio.TimeoutError) as e:
                 failures.append((peer, e))
         if failures:
             raise RequestError(f"send failures: {failures}")

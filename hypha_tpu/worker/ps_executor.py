@@ -23,7 +23,14 @@ crates/worker/src/config.rs:135-141). It:
     with bounded-concurrency fan-out (the reference pushes one peer at a
     time, :232-269) — quantized per the job's ``delta_codec`` with the
     PS's own error-feedback residual — and notifies the scheduler
-    ``Progress::Updated`` (:274-283).
+    ``Progress::Updated`` (:274-283);
+  * is **durable** when the job checkpoints (hypha_tpu.ft.durable,
+    net-new vs the reference): every accepted delta is journaled, every
+    committed round's broadcast retained, and the outer state (momentum,
+    catch-up Σ, EF residuals, round counter, epoch) checkpointed — a PS
+    restart replays the journal, re-announces itself under a bumped
+    generation id, and resumes the interrupted round instead of killing
+    the job.
 
 Tensor math runs on the C++ kernels (hypha_tpu.native) with numpy fallback;
 on TPU deployments the same step can run as the jitted tree-op in
@@ -46,6 +53,7 @@ from safetensors.numpy import load_file, save_file
 from .. import aio
 from .. import compress
 from .. import native
+from ..ft.durable import GENERATION_KEY, RESYNC_KEY, DurablePS, FoldRecord
 from ..ft.membership import PROTOCOL_FT, MembershipUpdate, RoundMembership, quorum_size
 from ..ft.rejoin import CATCHUP_KEY, CatchupBuffer
 from ..messages import (
@@ -60,6 +68,7 @@ from ..messages import (
     TransferStrategy,
 )
 from ..network.node import Node, RequestError
+from .connectors import push_timeout
 from ..stream import effective_fragments, fragment_due
 from ..telemetry.ft_metrics import FT_METRICS, STREAM_METRICS
 from .job_manager import Execution, JobExecutor
@@ -75,6 +84,19 @@ _ELASTIC_TICK_S = 0.5
 # Broadcast fan-out width: enough concurrent streams to fill the uplink
 # without opening one per peer on a wide job.
 _BROADCAST_CONCURRENCY = 8
+
+
+def _file_sha(path: Path) -> str:
+    """sha256 of a saved wire file (blocking; run off-loop) — the identity
+    the round journal dedups client re-sends on."""
+    h = hashlib.sha256()
+    with open(path, "rb") as f:
+        while True:
+            chunk = f.read(1 << 20)
+            if not chunk:
+                break
+            h.update(chunk)
+    return h.hexdigest()
 
 
 class _RoundAccum:
@@ -143,6 +165,10 @@ class _ElasticState:
         self.pending_joins: dict[str, int] = {}
         # early deltas: round -> peer -> (path, samples)
         self.early: dict[int, dict[str, tuple[Path, float]]] = {}
+        # Durable-state root when the job checkpoints (ft.durable); the
+        # catch-up push stamps its generation so rejoiners share the
+        # restart-detection protocol.
+        self.dur: "DurablePS | None" = None
 
     def quorum(self) -> int:
         return quorum_size(self.quorum_fraction, len(self.membership.active))
@@ -197,11 +223,40 @@ class ParameterServerExecutor(JobExecutor):
         # it; the checkpoint dir keeps a copy across PS restarts (net-new).
         momentum_file = work_dir / "momentum.safetensors"
         ckpt_dir = Path(cfg.checkpoint_dir) if cfg.checkpoint_dir else None
-        if ckpt_dir is not None:
-            saved = ckpt_dir / "momentum.safetensors"
-            if saved.is_file():
-                shutil.copyfile(saved, momentum_file)
-                log.info("ps %s: momentum restored from %s", job_id, saved)
+        # Durable PS state (ft.durable): a checkpointing job gets a round
+        # journal + outer-state checkpoints under the checkpoint dir, so a
+        # PS crash resumes the interrupted round instead of killing the job.
+        dur: DurablePS | None = None
+        try:
+            if ckpt_dir is not None:
+                dur = await asyncio.to_thread(
+                    DurablePS.open,
+                    ckpt_dir,
+                    job_id,
+                    max(int(getattr(cfg, "ps_checkpoint_every_rounds", 1) or 1), 1),
+                )
+            if ckpt_dir is not None and (dur is None or dur.resume is None):
+                # Cross-attempt warm start (a full job restart runs under a
+                # NEW job id, so durable recovery does not apply): momentum
+                # is the only outer state that transfers.
+                saved = ckpt_dir / "momentum.safetensors"
+                if saved.is_file():
+                    shutil.copyfile(saved, momentum_file)
+                    log.info("ps %s: momentum restored from %s", job_id, saved)
+        except Exception as e:
+            # A corrupt durable root (gapped journal) or an unwritable /
+            # full checkpoint disk must FAIL the job visibly — an exception
+            # escaping before the main try would leave the Execution
+            # unresolved and the scheduler watching a healthy lease on a
+            # job that never completes.
+            log.exception(
+                "parameter server job %s failed opening durable state", job_id
+            )
+            execution.finish("failed", str(e))
+            if dur is not None:
+                await asyncio.to_thread(dur.close)
+            await asyncio.to_thread(shutil.rmtree, work_dir, ignore_errors=True)
+            return
         round_num = 0
         # Routed consumer: only this job's pseudo-gradients (matched on the
         # Receive reference's resource tag) reach this loop, so a colocated
@@ -248,7 +303,35 @@ class ParameterServerExecutor(JobExecutor):
             else None
         )
         sync_mode = getattr(cfg, "sync_mode", "blocking") or "blocking"
+        if elastic is not None:
+            elastic.dur = dur
+        stream_fragments = effective_fragments(
+            sync_mode, getattr(cfg, "fragments", 0)
+        )
         try:
+            # Crash recovery (ft.durable): restore the outer-state
+            # checkpoint, replay committed rounds from the journal, re-send
+            # the last broadcasts, and seed the interrupted round's inputs.
+            preload: dict[int, dict[str, tuple[Path, float]]] = {}
+            recovered_accums: dict[int, _RoundAccum] = {}
+            recovery_done = False
+            if dur is not None and dur.resume is not None:
+                (
+                    round_num, rec_efs, preload, recovered_accums,
+                    recovery_done,
+                ) = await self._recover(
+                    dur, job_id, cfg, scheduler_peer, work_dir,
+                    momentum_file, elastic, lr, mu, bcast_codec,
+                    stream=(sync_mode != "blocking"),
+                    fragments=stream_fragments,
+                )
+                if bcast_ef is not None and 0 in rec_efs:
+                    bcast_ef = rec_efs[0]
+            else:
+                rec_efs = {}
+            if recovery_done:
+                execution.finish("completed")
+                return
             if sync_mode != "blocking":
                 # Streaming outer sync (hypha_tpu.stream): per-fragment
                 # round accumulators, pipelined broadcast fan-out. The
@@ -257,20 +340,36 @@ class ParameterServerExecutor(JobExecutor):
                     execution, job_id, cfg, scheduler_peer, work_dir,
                     consumer, elastic, allowed, num_workers,
                     momentum_file, ckpt_dir, lr, mu, bcast_codec,
-                    effective_fragments(sync_mode, getattr(cfg, "fragments", 0)),
+                    stream_fragments,
+                    dur=dur, round_start=round_num,
+                    init_accums=recovered_accums, init_pending=preload,
+                    init_efs=rec_efs,
                 )
                 return
             while True:
-                accum = _RoundAccum()
+                # A recovered round resumes its replayed accumulator (its
+                # preloaded entries are already folded in, bit-exactly).
+                accum = recovered_accums.pop(round_num, None)
+                preloaded_folded = accum is not None
+                if accum is None:
+                    accum = _RoundAccum()
+                if dur is not None:
+                    await asyncio.to_thread(dur.note_open, round_num)
                 if elastic is not None:
                     received = await self._collect_round_elastic(
                         consumer, job_id, elastic, cfg, work_dir, round_num,
-                        accum=accum,
+                        accum=accum, dur=dur,
                     )
                 else:
                     received = await self._collect_round(
                         consumer, job_id, allowed, num_workers, work_dir,
-                        round_num, accum=accum,
+                        round_num, accum=accum, dur=dur,
+                        preloaded=preload.pop(round_num, None),
+                        preloaded_folded=preloaded_folded,
+                    )
+                if dur is not None:
+                    await asyncio.to_thread(
+                        dur.note_close, round_num, list(received)
                     )
                 update_path = await asyncio.to_thread(
                     self._outer_step,
@@ -281,25 +380,11 @@ class ParameterServerExecutor(JobExecutor):
                     self._encode_broadcast,
                     update_path, bcast_codec, bcast_ef, work_dir, round_num,
                 )
-                if ckpt_dir is not None:
-                    self._checkpoint_momentum(momentum_file, ckpt_dir)
-                # Notify BEFORE broadcasting: a worker can merge the update
-                # and send UpdateReceived the moment the broadcast lands, and
-                # the scheduler must already have advanced the round by then —
-                # otherwise the worker is told Continue instead of Done and
-                # starts a phantom extra round (the reference broadcasts
-                # first, parameter_server.rs:232-283, and carries this race).
-                response = await self._notify_updated(scheduler_peer, job_id, round_num)
-                await self._broadcast(cfg, wire_path, round_num, elastic)
-                for path, _ in received.values():
-                    path.unlink(missing_ok=True)
-                round_num += 1
                 if elastic is not None:
                     # The running Σ of updates is the rejoin catch-up payload
-                    # (θ_r = θ₀ + Σ); fold this round in, then serve anyone
-                    # who joined — before the next round's first broadcast,
-                    # so a rejoiner can never see an update it must skip.
-                    # The DECODED update is accumulated, not the f32 one:
+                    # (θ_r = θ₀ + Σ); fold this round in BEFORE the durable
+                    # commit — the checkpoint must already contain it. The
+                    # DECODED update is accumulated, not the f32 one:
                     # θ_r must equal what workers actually merged. The
                     # encode already produced the decoded tree — never
                     # re-read and re-dequantize a parameter-sized frame.
@@ -311,6 +396,54 @@ class ParameterServerExecutor(JobExecutor):
                         await asyncio.to_thread(
                             elastic.catchup.accumulate_tree, sent_update
                         )
+                if dur is not None:
+                    # Durable commit: wire file retained for restart
+                    # re-broadcast, outer-state checkpoint when due, then
+                    # the fsync'd commit record.
+                    wire_name = await asyncio.to_thread(
+                        dur.store_wire, round_num, wire_path
+                    )
+                    await asyncio.to_thread(
+                        dur.commit_round, round_num, 0, wire_name,
+                        epoch=(
+                            elastic.membership.epoch
+                            if elastic is not None else 0
+                        ),
+                        momentum_file=momentum_file,
+                        catchup=elastic.catchup if elastic is not None else None,
+                        efs={0: bcast_ef},
+                        active=(
+                            list(elastic.membership.active)
+                            if elastic is not None else []
+                        ),
+                    )
+                if ckpt_dir is not None:
+                    self._checkpoint_momentum(momentum_file, ckpt_dir)
+                # Notify BEFORE broadcasting: a worker can merge the update
+                # and send UpdateReceived the moment the broadcast lands, and
+                # the scheduler must already have advanced the round by then —
+                # otherwise the worker is told Continue instead of Done and
+                # starts a phantom extra round (the reference broadcasts
+                # first, parameter_server.rs:232-283, and carries this race).
+                response = await self._notify_updated(scheduler_peer, job_id, round_num)
+                if dur is not None:
+                    await asyncio.to_thread(
+                        dur.note_notified, round_num,
+                        response.kind == ProgressResponseKind.DONE,
+                    )
+                await self._broadcast(
+                    cfg, wire_path, round_num, elastic,
+                    extra_header=(
+                        {GENERATION_KEY: dur.generation}
+                        if dur is not None else None
+                    ),
+                )
+                if dur is None:
+                    # Durable runs keep the delta files — the journal
+                    # references them until a checkpoint covers the round.
+                    for path, _ in received.values():
+                        path.unlink(missing_ok=True)
+                round_num += 1
                 # Broadcast done (and catch-up folded): a long job must not
                 # accumulate two parameter-sized files per round.
                 update_path.unlink(missing_ok=True)
@@ -330,7 +463,210 @@ class ParameterServerExecutor(JobExecutor):
             if membership_reg is not None:
                 membership_reg.close()
             consumer.close()
+            if dur is not None:
+                await asyncio.to_thread(dur.close)
             await asyncio.to_thread(shutil.rmtree, work_dir, ignore_errors=True)
+
+    # ------------------------------------------------------ crash recovery
+
+    async def _recover(
+        self,
+        dur: DurablePS,
+        job_id: str,
+        cfg,
+        scheduler_peer: str,
+        work_dir: Path,
+        momentum_file: Path,
+        elastic: "_ElasticState | None",
+        lr: float,
+        mu: float,
+        bcast_codec: str,
+        *,
+        stream: bool,
+        fragments: int,
+    ) -> tuple:
+        """Resume this job from its durable state after a PS restart.
+
+        Returns ``(round_num, bcast_efs, preload, accums, done)``:
+
+          * the outer-state checkpoint restores momentum, the rejoin
+            catch-up Σ, per-fragment broadcast EF residuals, the round
+            counter and membership epoch;
+          * rounds the journal committed AFTER the checkpoint re-run their
+            outer step from the journaled folds — bit-exact, because the
+            folds re-apply in arrival order against checkpointed state;
+          * the scheduler is re-notified for the last committed round iff
+            the journal lacks its ``notified`` record (the scheduler
+            de-duplicates by round either way);
+          * each fragment's newest committed broadcast is re-sent, stamped
+            with the NEW generation id — workers that already merged it
+            drop it by round; workers still waiting are un-wedged; every
+            worker sees the generation bump and re-sends its
+            un-acknowledged delta (journal dedup absorbs the copies);
+          * the interrupted round's (and any parked future rounds') folds
+            come back as ``preload``/``accums`` so the collect loops
+            resume instead of restarting the round.
+        """
+        resume = dur.resume
+        assert resume is not None
+        await asyncio.to_thread(dur.restore_momentum, momentum_file)
+        quant = bcast_codec in compress.QUANT_CODECS
+        bcast_efs: dict[int, "compress.ErrorFeedback | None"] = {}
+        if quant:
+            for frag, residual in (
+                await asyncio.to_thread(dur.restore_efs)
+            ).items():
+                ef = compress.ErrorFeedback()
+                ef.restore(residual)
+                bcast_efs[frag] = ef
+        if elastic is not None:
+            await asyncio.to_thread(dur.restore_catchup, elastic.catchup)
+            if resume.epoch >= elastic.membership.epoch and resume.active:
+                # The checkpointed view holds until the scheduler's next
+                # (epoch-gated) membership push supersedes it.
+                elastic.membership = RoundMembership(
+                    epoch=resume.epoch, active=sorted(resume.active)
+                )
+        round_num = resume.next_round
+        for rec in resume.committed:
+            rnd = int(rec["round"])
+            frag = int(rec.get("fragment", 0))
+            accum = _RoundAccum()
+            for fold, sign in dur.replay_ops(rnd):
+                await asyncio.to_thread(
+                    accum.fold, dur.deltas_dir / fold.file, fold.samples, sign
+                )
+            update_path = await asyncio.to_thread(
+                self._outer_step,
+                {}, momentum_file, lr, mu, work_dir, rnd, accum,
+            )
+            if quant and frag not in bcast_efs:
+                bcast_efs[frag] = compress.ErrorFeedback()
+            tag = (
+                FragmentTag(
+                    round=rnd, fragment_id=frag, fragments=fragments
+                ).header()
+                if stream
+                else None
+            )
+            wire_path, sent = await asyncio.to_thread(
+                self._encode_broadcast,
+                update_path, bcast_codec, bcast_efs.get(frag), work_dir,
+                rnd, tag,
+            )
+            if rnd == dur.newest_commit(frag):
+                await asyncio.to_thread(dur.store_wire, rnd, wire_path)
+            if elastic is not None:
+                frag_id = frag if stream else None
+                if sent is None:
+                    await asyncio.to_thread(
+                        elastic.catchup.accumulate, wire_path, frag_id
+                    )
+                else:
+                    await asyncio.to_thread(
+                        elastic.catchup.accumulate_tree, sent, frag_id
+                    )
+            update_path.unlink(missing_ok=True)
+            if wire_path != update_path:
+                wire_path.unlink(missing_ok=True)
+            round_num = rnd + 1
+        FT_METRICS.ps_recoveries.add(1)
+        log.warning(
+            "ps %s: recovered durable state (generation %d): resuming round "
+            "%d (%d committed rounds replayed)",
+            job_id, dur.generation, round_num, len(resume.committed),
+        )
+        done = False
+        last_round = round_num - 1
+        if last_round >= 0:
+            notified = resume.notified.get(last_round)
+            if notified is None:
+                response = await self._notify_updated(
+                    scheduler_peer, job_id, last_round
+                )
+                done = response.kind == ProgressResponseKind.DONE
+                await asyncio.to_thread(dur.note_notified, last_round, done)
+            else:
+                done = bool(notified)
+        # Restart announcement: an empty "resync" push whose header carries
+        # the new generation — every worker re-sends its un-acknowledged
+        # delta (journal dedup absorbs the copies that did land). The
+        # re-broadcasts below carry the generation too, but a crash before
+        # the first commit has no broadcast to carry it on.
+        resync = work_dir / "resync.bin"
+        await asyncio.to_thread(resync.write_bytes, b"")
+        await self._broadcast(
+            cfg, resync, round_num, elastic,
+            extra_header={GENERATION_KEY: dur.generation, RESYNC_KEY: True},
+        )
+        for rnd, frag, path in dur.last_wires():
+            extra: dict = {GENERATION_KEY: dur.generation}
+            if stream:
+                extra.update(
+                    FragmentTag(
+                        round=rnd, fragment_id=frag, fragments=fragments
+                    ).header()
+                )
+            await self._broadcast(cfg, path, rnd, elastic, extra_header=extra)
+        preload: dict[int, dict[str, tuple[Path, float]]] = {}
+        accums: dict[int, _RoundAccum] = {}
+        for rnd in dur.pending_rounds(round_num):
+            bucket = preload.setdefault(rnd, {})
+            for fold in dur.folds_for(rnd):
+                bucket[fold.peer] = (dur.deltas_dir / fold.file, fold.samples)
+            if elastic is None or stream:
+                # Rebuild the in-flight accumulator by replaying the EXACT
+                # fold/un-fold sequence (replay_ops): bit-identical to the
+                # crashed process's partial sum, duplicates included.
+                accum = accums.setdefault(rnd, _RoundAccum())
+                for fold, sign in dur.replay_ops(rnd):
+                    await asyncio.to_thread(
+                        accum.fold, dur.deltas_dir / fold.file, fold.samples,
+                        sign,
+                    )
+        if elastic is not None and not stream:
+            # The elastic collector folds early-parked entries itself when
+            # their round opens (last-wins per peer — value-correct; exact
+            # bitwise resume is only claimed for the deterministic modes).
+            for rnd, bucket in preload.items():
+                elastic.early.setdefault(rnd, {}).update(bucket)
+            preload = {}
+        return round_num, bcast_efs, preload, accums, done
+
+    @staticmethod
+    async def _ingest(
+        dur: "DurablePS | None",
+        round_num: int,
+        fragment: int,
+        peer: str,
+        entry: tuple[Path, float],
+        sha: "str | None" = None,
+    ) -> bool:
+        """Journal one accepted delta; False = exact re-send, skip the fold.
+
+        The dedup key is (round, fragment, peer, sha-of-bytes): after a PS
+        restart every worker re-sends its un-acknowledged delta, and the
+        copies whose original survived in the journal must fold zero more
+        times — folding them would double-count the worker in the mean.
+        ``sha`` comes from the save-time hasher when available; the
+        re-read fallback only covers callers without one.
+        """
+        if dur is None:
+            return True
+        path, samples = entry
+        if sha is None:
+            sha = await asyncio.to_thread(_file_sha, path)
+        if dur.already_folded(round_num, fragment, peer, sha):
+            path.unlink(missing_ok=True)
+            return False
+        await asyncio.to_thread(
+            dur.note_fold,
+            FoldRecord(
+                round=round_num, fragment=fragment, peer=peer,
+                samples=samples, sha=sha, file=path.name,
+            ),
+        )
+        return True
 
     @staticmethod
     async def _classify_push(push, job_id: str, members, round_num: int):
@@ -391,9 +727,22 @@ class ParameterServerExecutor(JobExecutor):
         work_dir: Path,
         round_num: int,
         accum: "_RoundAccum | None" = None,
+        dur: "DurablePS | None" = None,
+        preloaded: dict[str, tuple[Path, float]] | None = None,
+        preloaded_folded: bool = False,
     ) -> dict[str, tuple[Path, float]]:
-        """Gather one pseudo-gradient per worker: peer -> (path, samples)."""
-        received: dict[str, tuple[Path, float]] = {}
+        """Gather one pseudo-gradient per worker: peer -> (path, samples).
+
+        ``preloaded`` seeds the round with journaled folds a recovered PS
+        rebuilt; ``preloaded_folded`` says the caller's replayed
+        accumulator already contains them (the bit-exact resume path) so
+        only the missing workers are waited for.
+        """
+        received: dict[str, tuple[Path, float]] = dict(preloaded or {})
+        if not preloaded_folded:
+            for entry in received.values():
+                await self._fold(accum, entry)
+        dest_dir = dur.deltas_dir if dur is not None else work_dir
         while len(received) < num_workers:
             push = await consumer.next()
             peer = push.peer
@@ -401,15 +750,59 @@ class ParameterServerExecutor(JobExecutor):
                 log.warning("ps %s: push from disallowed peer %s", job_id, peer)
                 await push.read_all()
                 continue
-            if peer in received:
+            if dur is not None:
+                # Durable runs must be round-aware even in plain mode: a
+                # recovered PS's resync makes EVERY worker re-send its last
+                # delta, and ones for an already-committed round would
+                # otherwise fold into — and instantly close — the resumed
+                # round (their dedup key carries the OLD round, so the sha
+                # guard alone cannot catch them). A worker can never run
+                # AHEAD of the PS (broadcasts only follow commits), so
+                # stale is the only tag to drop.
+                delta_round = await self._classify_push(
+                    push, job_id, None, round_num
+                )
+                if delta_round is None:
+                    continue
+            if dur is None and peer in received:
                 # Double-send guard (fixes reference TODO :215-218): a
                 # re-send replaces the previous delta instead of
-                # mis-counting the round — un-fold it before the file goes.
+                # mis-counting the round. Non-durable saves land on the
+                # SAME deterministic path, so the superseded entry must be
+                # un-folded (reading its original bytes) BEFORE the save.
                 log.warning("ps %s: duplicate delta from %s; replacing", job_id, peer)
                 old = received.pop(peer)
                 await self._fold(accum, old, sign=-1.0)
                 old[0].unlink(missing_ok=True)
-            entry = await self._save_delta(push, work_dir, round_num)
+            # Unique names on durable runs: the journal references each
+            # accepted file by name, so a re-send must never overwrite the
+            # bytes a journaled fold points at.
+            hasher = hashlib.sha256() if dur is not None else None
+            entry = await self._save_delta(
+                push, dest_dir, round_num,
+                name_suffix=(
+                    f"-{uuid.uuid4().hex[:8]}" if dur is not None else ""
+                ),
+                hasher=hasher,
+            )
+            if not await self._ingest(
+                dur, round_num, 0, peer, entry,
+                sha=hasher.hexdigest() if hasher is not None else None,
+            ):
+                log.info(
+                    "ps %s: duplicate re-send from %s (journaled); dropped",
+                    job_id, peer,
+                )
+                continue
+            if peer in received:
+                # Durable path only (unique names): retire the superseded
+                # entry after the save — its file still holds the original
+                # bytes, so the un-fold is exact. The file itself STAYS on
+                # disk: recovery's replay_ops re-reads it to reproduce this
+                # very un-fold (checkpoint GC retires it later).
+                log.warning("ps %s: duplicate delta from %s; replacing", job_id, peer)
+                old = received.pop(peer)
+                await self._fold(accum, old, sign=-1.0)
             received[peer] = entry
             await self._fold(accum, entry)
             log.info(
@@ -427,6 +820,7 @@ class ParameterServerExecutor(JobExecutor):
         work_dir: Path,
         round_num: int,
         accum: "_RoundAccum | None" = None,
+        dur: "DurablePS | None" = None,
     ) -> dict[str, tuple[Path, float]]:
         """Quorum + deadline gather: peer -> (path, samples).
 
@@ -435,12 +829,15 @@ class ParameterServerExecutor(JobExecutor):
           * ``round_deadline_s`` expired since the round's collect began.
         Deltas tagged with an old round number are dropped as stale; ones
         tagged with a future round are parked and pre-credited to it.
+        A recovered PS seeds ``st.early`` with the journaled folds, so the
+        interrupted round's deltas re-fold here instead of being re-waited.
         """
         received: dict[str, tuple[Path, float]] = dict(st.early.pop(round_num, {}))
         for entry in received.values():
             # Parked early arrivals were never folded (their round hadn't
             # opened); fold them now that it has.
             await self._fold(accum, entry)
+        dest_dir = dur.deltas_dir if dur is not None else work_dir
         loop = asyncio.get_running_loop()
         deadline = (
             loop.time() + st.round_deadline_s if st.round_deadline_s > 0 else None
@@ -481,28 +878,70 @@ class ParameterServerExecutor(JobExecutor):
             )
             if delta_round is None:
                 continue
-            # Retire any superseded duplicate BEFORE saving: _save_delta
-            # names files delta-{round}-{sha(peer)}, so a re-send lands on
-            # the SAME path — un-folding/unlinking after the save would read
-            # the new bytes and delete the just-saved file.
+            # Non-durable saves land on the deterministic path
+            # delta-{round}-{sha(peer)}, so any superseded duplicate must
+            # be retired BEFORE saving — un-folding/unlinking after the
+            # save would read the new bytes and delete the just-saved
+            # file. Durable runs save under unique names (the journal
+            # references files by name) and retire after the dedup check.
+            suffix = f"-{uuid.uuid4().hex[:8]}" if dur is not None else ""
+            hasher = hashlib.sha256() if dur is not None else None
             if delta_round > round_num:
                 # Early: a fast worker already merged this round's broadcast
                 # and shipped the next pseudo-gradient; credit it forward.
                 bucket = st.early.setdefault(delta_round, {})
-                old = bucket.pop(peer, None)
-                if old is not None:
-                    old[0].unlink(missing_ok=True)
-                bucket[peer] = await self._save_delta(push, work_dir, delta_round)
+                if dur is None:
+                    old = bucket.pop(peer, None)
+                    if old is not None:
+                        old[0].unlink(missing_ok=True)
+                entry = await self._save_delta(
+                    push, dest_dir, delta_round, name_suffix=suffix,
+                    hasher=hasher,
+                )
+                if not await self._ingest(
+                    dur, delta_round, 0, peer, entry,
+                    sha=hasher.hexdigest() if hasher is not None else None,
+                ):
+                    continue
+                # Superseded durable files stay for replay_ops (GC'd at
+                # checkpoint); only the bucket entry is replaced.
+                bucket.pop(peer, None)
+                bucket[peer] = entry
                 continue
-            old = received.pop(peer, None)
-            if old is not None:
-                # Double-send guard (reference TODO :215-218): replace —
-                # un-fold the superseded delta while its file still holds
-                # the ORIGINAL bytes.
-                log.warning("ps %s: duplicate delta from %s; replacing", job_id, peer)
-                await self._fold(accum, old, sign=-1.0)
-                old[0].unlink(missing_ok=True)
-            entry = await self._save_delta(push, work_dir, delta_round)
+            if dur is None:
+                old = received.pop(peer, None)
+                if old is not None:
+                    # Double-send guard (reference TODO :215-218): replace —
+                    # un-fold the superseded delta while its file still
+                    # holds the ORIGINAL bytes.
+                    log.warning(
+                        "ps %s: duplicate delta from %s; replacing", job_id, peer
+                    )
+                    await self._fold(accum, old, sign=-1.0)
+                    old[0].unlink(missing_ok=True)
+            entry = await self._save_delta(
+                push, dest_dir, delta_round, name_suffix=suffix,
+                hasher=hasher,
+            )
+            if not await self._ingest(
+                dur, delta_round, 0, peer, entry,
+                sha=hasher.hexdigest() if hasher is not None else None,
+            ):
+                log.info(
+                    "ps %s: duplicate re-send from %s (journaled); dropped",
+                    job_id, peer,
+                )
+                continue
+            if dur is not None:
+                old = received.pop(peer, None)
+                if old is not None:
+                    # Un-fold reads the superseded file's original bytes;
+                    # the file stays for recovery's replay_ops (GC'd at
+                    # checkpoint).
+                    log.warning(
+                        "ps %s: duplicate delta from %s; replacing", job_id, peer
+                    )
+                    await self._fold(accum, old, sign=-1.0)
             received[peer] = entry
             await self._fold(accum, entry)
             log.info(
@@ -541,6 +980,11 @@ class ParameterServerExecutor(JobExecutor):
         mu: float,
         bcast_codec: str,
         fragments: int,
+        dur: "DurablePS | None" = None,
+        round_start: int = 0,
+        init_accums: dict[int, "_RoundAccum"] | None = None,
+        init_pending: dict[int, dict[str, tuple[Path, float]]] | None = None,
+        init_efs: dict[int, "compress.ErrorFeedback | None"] | None = None,
     ) -> None:
         """The pipelined round loop for ``sync_mode: overlap | stream``.
 
@@ -566,19 +1010,30 @@ class ParameterServerExecutor(JobExecutor):
         Error feedback is per fragment on the broadcast side: one shared
         residual would be clobbered by the next fragment's absorb.
         """
-        accums: dict[int, _RoundAccum] = {}
-        pending: dict[int, dict[str, tuple[Path, float]]] = {}
-        bcast_efs: dict[int, "compress.ErrorFeedback | None"] = {}
+        accums: dict[int, _RoundAccum] = dict(init_accums or {})
+        pending: dict[int, dict[str, tuple[Path, float]]] = dict(
+            init_pending or {}
+        )
+        bcast_efs: dict[int, "compress.ErrorFeedback | None"] = dict(
+            init_efs or {}
+        )
         bcast_tasks: set[asyncio.Task] = set()
         last_bcast: dict[int, asyncio.Task] = {}  # fragment -> newest fan-out
         quant = bcast_codec in compress.QUANT_CODECS
-        round_num = 0
+        round_num = round_start
         try:
             while True:
+                if dur is not None:
+                    await asyncio.to_thread(dur.note_open, round_num)
                 received = await self._collect_round_stream(
                     consumer, job_id, cfg, elastic, allowed, num_workers,
                     work_dir, round_num, fragments, accums, pending,
+                    dur=dur,
                 )
+                if dur is not None:
+                    await asyncio.to_thread(
+                        dur.note_close, round_num, list(received)
+                    )
                 frag = fragment_due(round_num, fragments)
                 tag = FragmentTag(
                     round=round_num, fragment_id=frag, fragments=fragments
@@ -598,6 +1053,39 @@ class ParameterServerExecutor(JobExecutor):
                     update_path, bcast_codec, bcast_efs[frag], work_dir,
                     round_num, tag.header(),
                 )
+                if elastic is not None:
+                    # Catch-up accumulation at CLOSE time, in close order —
+                    # never from the background broadcast, whose completion
+                    # order is unordered across fragments. Before the
+                    # durable commit, whose checkpoint must contain it.
+                    if sent_update is None:
+                        await asyncio.to_thread(
+                            elastic.catchup.accumulate, wire_path, frag
+                        )
+                    else:
+                        await asyncio.to_thread(
+                            elastic.catchup.accumulate_tree, sent_update, frag
+                        )
+                if dur is not None:
+                    wire_name = await asyncio.to_thread(
+                        dur.store_wire, round_num, wire_path
+                    )
+                    await asyncio.to_thread(
+                        dur.commit_round, round_num, frag, wire_name,
+                        epoch=(
+                            elastic.membership.epoch
+                            if elastic is not None else 0
+                        ),
+                        momentum_file=momentum_file,
+                        catchup=(
+                            elastic.catchup if elastic is not None else None
+                        ),
+                        efs=bcast_efs,
+                        active=(
+                            list(elastic.membership.active)
+                            if elastic is not None else []
+                        ),
+                    )
                 if ckpt_dir is not None:
                     self._checkpoint_momentum(momentum_file, ckpt_dir)
                 # Notify BEFORE broadcasting (same race note as the
@@ -606,6 +1094,11 @@ class ParameterServerExecutor(JobExecutor):
                 response = await self._notify_updated(
                     scheduler_peer, job_id, round_num
                 )
+                if dur is not None:
+                    await asyncio.to_thread(
+                        dur.note_notified, round_num,
+                        response.kind == ProgressResponseKind.DONE,
+                    )
                 # Freeze the fan-out's peer set at CLOSE time: the
                 # backgrounded push must not pick up a rejoiner who joins
                 # while it is pending — that peer's catch-up (served
@@ -618,18 +1111,9 @@ class ParameterServerExecutor(JobExecutor):
                     if elastic is not None
                     else None
                 )
-                if elastic is not None:
-                    # Catch-up accumulation at CLOSE time, in close order —
-                    # never from the background broadcast, whose completion
-                    # order is unordered across fragments.
-                    if sent_update is None:
-                        await asyncio.to_thread(
-                            elastic.catchup.accumulate, wire_path, frag
-                        )
-                    else:
-                        await asyncio.to_thread(
-                            elastic.catchup.accumulate_tree, sent_update, frag
-                        )
+                bcast_header = dict(tag.header())
+                if dur is not None:
+                    bcast_header[GENERATION_KEY] = dur.generation
                 last_bcast[frag] = aio.spawn(
                     self._broadcast_and_cleanup(
                         cfg, update_path, wire_path, received, round_num,
@@ -639,6 +1123,10 @@ class ParameterServerExecutor(JobExecutor):
                         # _broadcast_and_cleanup).
                         after=last_bcast.get(frag),
                         peers=bcast_peers,
+                        header=bcast_header,
+                        # Durable runs keep the delta files — the journal
+                        # references them until a checkpoint covers them.
+                        keep_received=dur is not None,
                     ),
                     tasks=bcast_tasks,
                     what=f"stream broadcast r{round_num}",
@@ -678,6 +1166,7 @@ class ParameterServerExecutor(JobExecutor):
         fragments: int,
         accums: dict[int, "_RoundAccum"],
         pending: dict[int, dict[str, tuple[Path, float]]],
+        dur: "DurablePS | None" = None,
     ) -> dict[str, tuple[Path, float]]:
         """Gather one round's FRAGMENT deltas: peer -> (path, samples).
 
@@ -689,6 +1178,7 @@ class ParameterServerExecutor(JobExecutor):
         """
         received = pending.pop(round_num, {})
         frag = fragment_due(round_num, fragments)
+        dest_dir = dur.deltas_dir if dur is not None else work_dir
         loop = asyncio.get_running_loop()
         deadline = None
         if st is not None and st.round_deadline_s > 0:
@@ -762,9 +1252,11 @@ class ParameterServerExecutor(JobExecutor):
             # re-send can never destroy the peer's already-folded good
             # delta (retiring before save — the elastic path's rule — is
             # only safe because that path has no post-save validation).
+            hasher = hashlib.sha256() if dur is not None else None
             entry = await self._save_delta(
-                push, work_dir, delta_round,
+                push, dest_dir, delta_round,
                 name_suffix=f"-{uuid.uuid4().hex[:8]}",
+                hasher=hasher,
             )
             if tag is not None and not await asyncio.to_thread(
                 self._frame_tag_matches, entry[0], tag
@@ -777,13 +1269,25 @@ class ParameterServerExecutor(JobExecutor):
                 )
                 entry[0].unlink(missing_ok=True)
                 continue
+            if not await self._ingest(
+                dur, delta_round, fragment_due(delta_round, fragments),
+                peer, entry,
+                sha=hasher.hexdigest() if hasher is not None else None,
+            ):
+                log.info(
+                    "ps %s: duplicate re-send from %s (journaled); dropped",
+                    job_id, peer,
+                )
+                continue
             old = bucket.pop(peer, None)
             if old is not None:
                 log.warning(
                     "ps %s: duplicate delta from %s; replacing", job_id, peer
                 )
                 await self._fold(accum, old, sign=-1.0)
-                old[0].unlink(missing_ok=True)
+                if dur is None:
+                    # Durable files stay for replay_ops (checkpoint GC).
+                    old[0].unlink(missing_ok=True)
             bucket[peer] = entry
             await self._fold(accum, entry)
             log.info(
@@ -830,6 +1334,8 @@ class ParameterServerExecutor(JobExecutor):
         elastic: "_ElasticState | None",
         after: "asyncio.Task | None" = None,
         peers: list[str] | None = None,
+        header: dict | None = None,
+        keep_received: bool = False,
     ) -> None:
         """One round's backgrounded fan-out plus its file retirement.
 
@@ -839,34 +1345,42 @@ class ParameterServerExecutor(JobExecutor):
         worker would merge the newer one and drop the older as stale —
         silently losing an outer update. Different fragments still fan
         out concurrently (disjoint tensors, the worker absorbs them in
-        any order). ``peers`` is the membership frozen at round close."""
+        any order). ``peers`` is the membership frozen at round close.
+        ``keep_received`` leaves the delta files to the durable journal's
+        checkpoint GC instead of retiring them here."""
         if after is not None:
             await aio.wait_quiet(after)
         try:
             await self._broadcast(
-                cfg, wire_path, round_num, elastic, extra_header=tag.header(),
+                cfg, wire_path, round_num, elastic,
+                extra_header=header if header is not None else tag.header(),
                 peers_override=peers,
             )
         finally:
-            for path, _ in received.values():
-                path.unlink(missing_ok=True)
+            if not keep_received:
+                for path, _ in received.values():
+                    path.unlink(missing_ok=True)
             update_path.unlink(missing_ok=True)
             if wire_path != update_path:
                 wire_path.unlink(missing_ok=True)
 
     @staticmethod
     async def _save_delta(
-        push, work_dir: Path, round_num: int, name_suffix: str = ""
+        push, work_dir: Path, round_num: int, name_suffix: str = "",
+        hasher=None,
     ) -> tuple[Path, float]:
         """Save one pseudo-gradient push; returns (path, sample weight).
 
         ``name_suffix`` de-collides re-sends for callers that validate
         after saving (the streaming collector) — without it a duplicate
         lands on the SAME deterministic path as the entry it supersedes.
+        ``hasher`` is updated with the payload as it streams to disk
+        (durable runs journal the sha — hashing inline avoids a second
+        parameter-sized read of the file just written).
         """
         name = hashlib.sha256(push.peer.encode()).hexdigest()[:24]
         dest = work_dir / f"delta-{round_num}-{name}{name_suffix}.safetensors"
-        await push.save_to(dest)
+        await push.save_to(dest, hasher=hasher)
         samples = 1.0
         if isinstance(push.resource, dict):
             try:
@@ -896,9 +1410,19 @@ class ParameterServerExecutor(JobExecutor):
                 "epoch": st.membership.epoch,
                 CATCHUP_KEY: True,
             }
+            if st.dur is not None:
+                header[GENERATION_KEY] = st.dur.generation
             try:
-                await self.node.push(peer, header, path)
-            except RequestError as e:
+                # A couple of backed-off tries per tick: a rejoiner's node
+                # may still be binding its listener when the join lands.
+                await aio.retry(
+                    lambda p=peer: self.node.push(p, header, path),
+                    attempts=2, base_delay=0.2,
+                    attempt_timeout=push_timeout(path, base=30.0),
+                    retry_on=(RequestError, OSError),
+                    what=f"catch-up to {peer}", logger=log,
+                )
+            except (RequestError, OSError, asyncio.TimeoutError) as e:
                 st.pending_joins[peer] -= 1
                 if st.pending_joins[peer] <= 0:
                     log.error("ps: catch-up to %s failed for good: %s", peer, e)
@@ -1040,9 +1564,18 @@ class ParameterServerExecutor(JobExecutor):
         async def push_one(peer: str) -> bool:
             async with sem:
                 try:
-                    await self.node.push(peer, header, update_path)
+                    # One backed-off re-try rides out a worker's transient
+                    # blip; a genuinely dead peer is still tolerated — it
+                    # catches up from the next round's broadcast.
+                    await aio.retry(
+                        lambda: self.node.push(peer, header, update_path),
+                        attempts=2, base_delay=0.25,
+                        attempt_timeout=push_timeout(update_path),
+                        retry_on=(RequestError, OSError),
+                        what=f"broadcast to {peer}", logger=log,
+                    )
                     return True
-                except RequestError as e:
+                except (RequestError, OSError, asyncio.TimeoutError) as e:
                     log.warning(
                         "ps: broadcast to %s failed (%s); retry next round",
                         peer, e,

@@ -1,0 +1,37 @@
+"""Seeded naked-stream-push violations: hypha-lint's regression fixture.
+
+A fabric push awaited raw fails the round on the first transient error —
+a restarting parameter server — where the aio.retry wrapper would have
+parked and re-pushed. tests/test_lint.py asserts the violations below are
+caught and the clean twins stay clean. This file is never imported.
+"""
+
+from hypha_tpu import aio
+
+
+class Executor:
+    def __init__(self, node):
+        self.node = node
+
+    async def ship_delta(self, peer, header, path):  # naked-stream-push
+        await self.node.push(peer, header, path)
+
+    async def ship_module_node(self, node, peer, header, path):  # naked-stream-push
+        await node.push(peer, header, path)
+
+    async def retry_lambda_is_fine(self, peer, header, path):
+        await aio.retry(
+            lambda: self.node.push(peer, header, path),
+            retry_on=(Exception,),
+        )
+
+    async def retry_body_is_fine(self, peers, header, path):
+        async def push_any_once():
+            for peer in peers:
+                await self.node.push(peer, header, path)
+
+        await aio.retry(push_any_once, retry_on=(Exception,))
+
+    async def other_push_is_fine(self, queue, item):
+        # Not a fabric push: only *.node.push is the retry-mandatory shape.
+        await queue.push(item)

@@ -170,8 +170,10 @@ class FakePush:
         self._tree = tree
         self.drained = False
 
-    async def save_to(self, dest):
+    async def save_to(self, dest, hasher=None):
         save_file(self._tree, str(dest))
+        if hasher is not None:
+            hasher.update(Path(dest).read_bytes())
         return 1
 
     async def read_all(self):
@@ -505,5 +507,84 @@ def test_parse_chaos_spec():
     assert (a.kind, a.target, a.at_round) == ("kill", "wX", 2)
     d = parse_chaos_spec("delay-worker:1:0.25", "wY")
     assert (d.kind, d.at_round, d.delay_s) == ("delay", 1, 0.25)
+    k = parse_chaos_spec("kill-ps:2", "psw")
+    assert (k.kind, k.target, k.at_round) == ("kill-ps", "psw", 2)
+    p = parse_chaos_spec("partition-ps:1:2.5", "psw")
+    assert (p.kind, p.at_round, p.delay_s) == ("partition-ps", 1, 2.5)
     with pytest.raises(ValueError):
         parse_chaos_spec("explode:1", "w")
+
+
+def test_chaos_partition_ps_severs_and_heals():
+    """partition-ps drops pushes between the PS and the workers for the
+    configured duration, both directions, then restores the originals."""
+    from hypha_tpu.network.node import RequestError
+
+    async def scenario():
+        class Node_:
+            def __init__(self):
+                self.sent = []
+
+            async def push(self, peer_id, resource, source):
+                self.sent.append(peer_id)
+                return 1
+
+        class W:
+            def __init__(self):
+                self.node = Node_()
+
+        ps, w1 = W(), W()
+        ctl = ChaosController(
+            [ChaosAction(kind="partition-ps", target="psw", at_round=0,
+                         delay_s=0.2)],
+            {"psw": ps, "w1": w1},
+        )
+        with pytest.raises(RequestError):
+            await w1.node.push("psw", {}, b"")  # worker -> PS dropped
+        with pytest.raises(RequestError):
+            await ps.node.push("w1", {}, b"")  # PS broadcast dropped
+        await w1.node.push("other", {}, b"")  # unrelated peers unaffected
+        await asyncio.sleep(0.4)
+        await ctl.drain()
+        await w1.node.push("psw", {}, b"")  # healed
+        assert w1.node.sent == ["other", "psw"]
+
+    run(scenario())
+
+
+# --------------------------------------------------------------------------
+# durable-PS telemetry (ft.durable satellites)
+# --------------------------------------------------------------------------
+
+
+def test_ft_metrics_snapshot_carries_durable_counters():
+    FT_METRICS.reset()
+    FT_METRICS.retry_attempts.add(3)
+    FT_METRICS.ps_journal_bytes.add(512)
+    FT_METRICS.ps_recoveries.add(1)
+    snap = FT_METRICS.snapshot()
+    assert snap["retry_attempts"] == 3
+    assert snap["ps_journal_bytes"] == 512
+    assert snap["ps_recoveries"] == 1
+    FT_METRICS.reset()
+
+
+def test_register_on_exports_durable_counters():
+    from hypha_tpu.telemetry.ft_metrics import FTMetrics, register_on
+
+    class SpyMeter:  # duck-typed: register_on only needs observable_gauge
+        def __init__(self):
+            self.gauges = {}
+
+        def observable_gauge(self, name, callback, unit=""):
+            self.gauges[name] = callback
+
+    metrics = FTMetrics()
+    metrics.retry_attempts.add(2)
+    metrics.ps_journal_bytes.add(64)
+    metrics.ps_recoveries.add(1)
+    meter = SpyMeter()
+    register_on(meter, metrics)
+    assert meter.gauges["hypha.ft.retry_attempts"]() == 2
+    assert meter.gauges["hypha.ps.journal_bytes"]() == 64
+    assert meter.gauges["hypha.ps.recoveries"]() == 1

@@ -63,6 +63,26 @@ def test_fixture_clean_twins_stay_clean(fixture):
         assert not enclosing.endswith("_is_fine"), (v.rule, v.line, enclosing)
 
 
+def test_naked_push_fixture_catches_rule():
+    counts = _rules_by_count(FIXTURES / "naked_push.py")
+    assert counts["naked-stream-push"] == 2  # self.node.push + node.push
+    assert counts.total() == 2  # twins (lambda, *_once body, queue) clean
+
+
+def test_naked_push_clean_twins_stay_clean():
+    path = FIXTURES / "naked_push.py"
+    lines = path.read_text().splitlines()
+    report = lint_paths([path], protocol_checks=False)
+    for v in report.active:
+        enclosing = ""
+        for line in reversed(lines[: v.line]):
+            stripped = line.strip()
+            if stripped.startswith(("def ", "async def ")):
+                enclosing = stripped.split("def ", 1)[1].split("(", 1)[0]
+                break
+        assert not enclosing.endswith("_is_fine"), (v.rule, v.line, enclosing)
+
+
 def test_jax_fixture_catches_each_rule():
     counts = _rules_by_count(FIXTURES / "jax_bad.py")
     assert counts["jit-host-sync"] == 3  # float(), .item(), np.asarray

