@@ -3,7 +3,7 @@
 
 PYTHON ?= python
 
-.PHONY: lint lint-fixtures test compressbench streambench ftbench-ps
+.PHONY: lint lint-fixtures test compressbench streambench ftbench-ps shardbench
 
 lint:
 	$(PYTHON) -m hypha_tpu.analysis hypha_tpu/
@@ -39,6 +39,15 @@ compressbench:
 streambench:
 	JAX_PLATFORMS=cpu $(PYTHON) benchmarks/streambench.py \
 		--out STREAMBENCH_r07.json
+
+# Sharded parameter service: aggregate delta bytes/s and round wall-clock
+# at 1/2/4 PS shards at a fixed worker count (asserts >=2.5x aggregate
+# bandwidth at 4 shards), plus a real-executor kill-one-shard recovery
+# run (bit-exact, surviving shards keep closing rounds). Writes
+# SHARDBENCH_r08.json (docs/performance.md "Sharded parameter service").
+shardbench:
+	JAX_PLATFORMS=cpu $(PYTHON) benchmarks/shardbench.py \
+		--chaos kill-ps --out SHARDBENCH_r08.json
 
 # Durable PS: kill the parameter server mid-round, restart it, and prove
 # the job completes with bounded recovery wall-clock (ft.durable journal +

@@ -46,6 +46,14 @@ REQUIRES_ROUND_TAG: frozenset[str] = frozenset(
 )
 _TAG_FIELDS = {"round", "epoch", "round_num"}
 
+# Field names that identify a PS shard / placement on the wire; their
+# presence obliges the message to carry a round tag
+# (``msg-shard-needs-round``). Deliberately names the IDENTITY fields only:
+# config COUNTS like ``num_ps_shards``/``shard_index`` live in executor
+# configs whose per-push identity travels separately as the SHARD_KEY
+# header next to ``round``.
+_SHARD_FIELDS = {"shard", "shards", "shard_id"}
+
 # Field names that identify a streamed parameter fragment; their presence
 # obliges the message to carry one of _TAG_FIELDS too (the
 # ``msg-fragment-needs-round`` rule).
@@ -325,6 +333,37 @@ def check_fragment_tags(registry=None) -> list[Violation]:
     return out
 
 
+def check_shard_tags(registry=None) -> list[Violation]:
+    """Any message with a shard/placement identity must carry a round tag.
+
+    Structural, like :func:`check_fragment_tags`: EVERY registered
+    dataclass that grows a ``shard``/``shards``/``shard_id`` field must
+    pair it with ``round``/``epoch``/``round_num`` — a placement (or a
+    shard-stamped progress report) without its round could re-route an
+    in-flight fragment to the wrong shard's journal, or advance the wrong
+    round's shard gate on the scheduler.
+    """
+    messages, _ = _modules()
+    registry = registry if registry is not None else _package_registry(messages)
+    out: list[Violation] = []
+    for name, cls in sorted(registry.items()):
+        if not dataclasses.is_dataclass(cls):
+            continue
+        fields = {f.name for f in dataclasses.fields(cls)}
+        if fields & _SHARD_FIELDS and not fields & _TAG_FIELDS:
+            out.append(
+                _violation(
+                    cls,
+                    "msg-shard-needs-round",
+                    f"{name}: carries {sorted(fields & _SHARD_FIELDS)} "
+                    f"but no round tag ({'/'.join(sorted(_TAG_FIELDS))}) — "
+                    f"an untagged placement/shard message can re-route an "
+                    f"in-flight fragment or gate the wrong round",
+                )
+            )
+    return out
+
+
 def check_protocol_map(registry=None, manifest=None, values=None) -> list[Violation]:
     messages, _ = _modules()
     registry = registry if registry is not None else _package_registry(messages)
@@ -385,5 +424,6 @@ def check() -> list[Violation]:
         check_roundtrip()
         + check_round_tags()
         + check_fragment_tags()
+        + check_shard_tags()
         + check_protocol_map()
     )

@@ -43,6 +43,22 @@ def merge_update(params, update):
     return jax.tree.map(lambda p, u: (p + u.astype(p.dtype)).astype(p.dtype), params, update)
 
 
+def merge_update_f32(params, update):
+    """θ_new = θ + update with the add in f32 before the per-leaf cast.
+
+    :func:`apply_updates`' precision discipline for flat name→leaf maps
+    covering any SUBSET of the tree — the sharded rejoin catch-up applies
+    per-shard cumulative Σs to disjoint leaf sets, and casting a long Σ
+    to bf16 before the add (plain :func:`merge_update`) would diverge
+    from the unsharded catch-up's f32 accumulation."""
+    return jax.tree.map(
+        lambda p, u: (
+            jnp.asarray(p, jnp.float32) + jnp.asarray(u, jnp.float32)
+        ).astype(p.dtype),
+        params, update,
+    )
+
+
 @jax.jit
 def _apply_updates(p, us):
     def leaf(x, *ys):

@@ -146,6 +146,11 @@ class DecodePool:
         self._free = list(range(slots))
         self._queue: "queue.Queue[_Group | None]" = queue.Queue()
         self._waiting: list[_Group] = []
+        # Guards the closed-check + enqueue in submit() against the serve
+        # thread's final drain in _fail_all(): without it, a submit that
+        # passed the check could enqueue AFTER the drain and its Future
+        # would never resolve.
+        self._submit_lock = threading.Lock()
         self._closed = False
         self.chunks = 0  # decode programs dispatched (test/bench hook)
         self.requests = 0
@@ -173,9 +178,6 @@ class DecodePool:
     def submit(self, prompts: list, n_new: int) -> Future:
         """Queue ``prompts`` for continuation; greedy, ``n_new`` tokens each."""
         fut: Future = Future()
-        if self._closed:
-            fut.set_exception(RuntimeError("pool is closed"))
-            return fut
         if not prompts or any(not p for p in prompts):
             fut.set_exception(ValueError("prompts must be non-empty"))
             return fut
@@ -193,8 +195,16 @@ class DecodePool:
                 )
             )
             return fut
-        self.requests += 1
-        self._queue.put(_Group(prompts, int(n_new), fut))
+        # closed-check + enqueue as ONE atomic step against _fail_all's
+        # drain: either this group lands before the drain (and is failed by
+        # it), or the check sees _closed (always set before the drain runs)
+        # and errors here — a caller's Future can never hang unresolved.
+        with self._submit_lock:
+            if self._closed:
+                fut.set_exception(RuntimeError("pool is closed"))
+                return fut
+            self.requests += 1
+            self._queue.put(_Group(prompts, int(n_new), fut))
         return fut
 
     def close(self, wait: bool = True) -> None:
@@ -208,14 +218,19 @@ class DecodePool:
             self._thread.join(timeout=30)
 
     def _fail_all(self, exc: Exception) -> None:
-        """Serve-thread-side sweep: waiting, queued, and in-flight groups."""
-        while True:
-            try:
-                item = self._queue.get_nowait()
-            except queue.Empty:
-                break
-            if item is not None:
-                self._waiting.append(item)
+        """Serve-thread-side sweep: waiting, queued, and in-flight groups.
+
+        Holds the submit lock for the drain: every submit that passed its
+        closed-check has already enqueued (the check + put are atomic under
+        the same lock), so nothing can slip in behind the sweep."""
+        with self._submit_lock:
+            while True:
+                try:
+                    item = self._queue.get_nowait()
+                except queue.Empty:
+                    break
+                if item is not None:
+                    self._waiting.append(item)
         for g in self._waiting:
             if not g.fut.done():
                 g.fut.set_exception(exc)

@@ -42,6 +42,7 @@ import numpy as np
 
 from .. import messages
 from ..messages import (
+    SHARD_KEY,
     FragmentTag,
     JobSpec,
     Loss,
@@ -55,9 +56,15 @@ from .. import compress
 from ..ft.durable import RESYNC_KEY, restart_signal
 from ..ft.rejoin import CATCHUP_KEY
 from ..stream import SYNC_MODES, effective_fragments, fragment_due, merge_corrected
-from ..stream.partition import partition_names
+from ..stream.partition import partition_names, shard_of
+from ..worker.connectors import shard_route
 from ..telemetry.ft_metrics import STREAM_METRICS
-from .diloco import apply_updates, extract_delta, merge_update
+from .diloco import (
+    apply_updates,
+    extract_delta,
+    merge_update,
+    merge_update_f32,
+)
 from .serialization import flat_leaf_map, flatten_tree, replace_leaves, unflatten_like
 from .train import TrainState, build_optimizer, make_train_step
 
@@ -181,10 +188,18 @@ class _WorkerStream:
         self.poll_wait_s = float(
             os.environ.get(_STREAM_POLL_WAIT_ENV, "0") or 0.0
         )
-        # Last PS generation observed on the results stream (flight-thread
-        # confined): a change means the parameter server restarted and the
-        # in-flight delta may have died unjournaled — re-send it.
-        self._gen: Any = None
+        # Last PS generation observed on the results stream, PER shard
+        # (flight-thread confined): a change means that parameter-server
+        # shard restarted and an in-flight delta it owned may have died
+        # unjournaled — re-send it. The unsharded PS is shard 0.
+        self._gens: dict[int, Any] = {}
+        # Sharded parameter service: the placement map this worker routes
+        # each fragment's push by (None = single PS, the pre-shard wire).
+        shard_map = getattr(cfg, "ps_shards", None)
+        if shard_map is not None and not getattr(shard_map, "shards", None):
+            shard_map = None
+        self.shard_map = shard_map
+        self.reduce_via = getattr(cfg, "reduce_via", None)
 
     @property
     def in_flight(self) -> bool:
@@ -209,6 +224,11 @@ class _WorkerStream:
                 {n: int(leaf.size) for n, leaf in anchor_flat.items()}, self.F
             )
         frag = fragment_due(round_num, self.F)
+        owner = (
+            shard_of(frag, len(self.shard_map.shards))
+            if self.shard_map is not None
+            else 0
+        )
         names = self.fragments[frag]
         params_flat = flat_leaf_map(params)
         # Deep copy, not an alias: the jitted step donates its input state,
@@ -220,6 +240,7 @@ class _WorkerStream:
         flight: dict[str, Any] = {
             "round": round_num,
             "frag": frag,
+            "owner": owner,
             "names": names,
             "snap": snap,
             "path": self.work_dir / f"delta-{round_num}-f{frag}.safetensors",
@@ -257,12 +278,7 @@ class _WorkerStream:
             nbytes = flight["path"].stat().st_size
             flight["bytes"] = nbytes
             STREAM_METRICS.flight_started(nbytes)
-            self.session.send_resource(
-                self.cfg.updates,
-                flight["path"].name,
-                resource=self.cfg.updates.ref.resource or "updates",
-                meta={"num_samples": samples, **tag.header()},
-            )
+            self._send_flight(flight, tag, samples)
             box["completion"] = self._await_broadcast(flight)
         except BaseException as e:  # hypha-lint: disable=swallowed-cancel
             box["error"] = e  # thread-bridge: re-raised at finish()
@@ -272,10 +288,35 @@ class _WorkerStream:
             # never read as mid-upload for the rest of the process.
             STREAM_METRICS.flight_landed(flight["bytes"])
 
+    def _send_flight(
+        self, flight: dict, tag: FragmentTag, samples: float
+    ) -> None:
+        """Ship the flight's wire file — to the single PS, or routed to
+        the fragment's owning shard (via the group reducer with ANY
+        failover when tree-reduce is on)."""
+        meta: dict[str, Any] = {"num_samples": samples, **tag.header()}
+        if self.shard_map is None:
+            self.session.send_resource(
+                self.cfg.updates,
+                flight["path"].name,
+                resource=self.cfg.updates.ref.resource or "updates",
+                meta=meta,
+            )
+            return
+        send, owner, res_tag = shard_route(
+            self.shard_map, flight["frag"], self.reduce_via
+        )
+        if len(self.shard_map.shards) > 1:
+            meta[SHARD_KEY] = owner
+        self.session.send_resource(
+            send, flight["path"].name, resource=res_tag, meta=meta
+        )
+
     def _resend(self, flight: dict) -> None:
-        """The PS restarted: our un-acknowledged fragment delta may have
-        died with it unjournaled — re-push the wire file (the PS's journal
-        dedup makes the copy idempotent when the original DID land)."""
+        """The PS (shard) restarted: our un-acknowledged fragment delta may
+        have died with it unjournaled — re-push the wire file (the PS's
+        journal dedup makes the copy idempotent when the original DID
+        land)."""
         if not flight["path"].is_file():
             return
         tag = FragmentTag(
@@ -285,12 +326,7 @@ class _WorkerStream:
             "stream sync: ps restart detected; re-sending round %d fragment %d",
             flight["round"], flight["frag"],
         )
-        self.session.send_resource(
-            self.cfg.updates,
-            flight["path"].name,
-            resource=self.cfg.updates.ref.resource or "updates",
-            meta={"num_samples": flight["samples"], **tag.header()},
-        )
+        self._send_flight(flight, tag, flight["samples"])
 
     def _await_broadcast(self, flight: dict) -> dict:
         """Consume results-stream events until OUR fragment's update lands.
@@ -306,8 +342,17 @@ class _WorkerStream:
         with self.session.receive(self.cfg.results) as events:
             for event in events:
                 meta = event.get("meta") or {}
-                self._gen, resend = restart_signal(meta, self._gen)
-                if resend:
+                try:
+                    shard_id = int(meta.get(SHARD_KEY, 0))
+                except (TypeError, ValueError):
+                    shard_id = 0
+                self._gens[shard_id], resend = restart_signal(
+                    meta, self._gens.get(shard_id)
+                )
+                if resend and shard_id == flight.get("owner", 0):
+                    # Only the restarted shard's own in-flight part can
+                    # have died unjournaled; re-sending to the healthy
+                    # shards would just churn their journals' dedup.
                     self._resend(flight)
                 if meta.get(RESYNC_KEY):
                     (self.work_dir / event["path"]).unlink(missing_ok=True)
@@ -784,6 +829,25 @@ def run_training(
             f"job {spec.job_id}: sync_mode must be {'|'.join(SYNC_MODES)}, "
             f"got {sync_mode!r}"
         )
+    # Sharded parameter service (hypha_tpu.stream placement): the worker
+    # routes each part's delta to its owning shard. None = single PS, the
+    # pre-shard wire.
+    shard_map = getattr(cfg, "ps_shards", None)
+    if shard_map is not None and not getattr(shard_map, "shards", None):
+        shard_map = None
+    if shard_map is not None and sync_mode == "overlap":
+        # Overlap's single whole-tree flight has no per-part schedule to
+        # route by; sharding composes with pipelining via sync_mode=stream.
+        raise ValueError(
+            f"job {spec.job_id}: ps_shards requires sync_mode blocking or "
+            "stream"
+        )
+    if shard_map is not None and mh is not None:
+        _mh_done_bounded(mh)
+        raise ValueError(
+            f"job {spec.job_id}: sharded parameter service is not supported "
+            "for multihost replicas"
+        )
     stream_state: _WorkerStream | None = None
     if sync_mode != "blocking":
         if mh is not None:
@@ -817,21 +881,71 @@ def run_training(
         def _drop(event: dict) -> None:
             (work_dir / event["path"]).unlink(missing_ok=True)
 
-        with session.receive(cfg.results) as events:
-            catchup = await_catchup(events, on_skip=_drop)
-        meta = catchup.get("meta") or {}
-        catchup_file = work_dir / catchup["path"]
-        flat = compress.read_delta(catchup_file)
-        if flat:
-            update = unflatten_like(flat, state.params)
-            state = state.replace(params=apply_updates(state.params, [update]))
-        anchor = snapshot(state.params)
-        catchup_file.unlink(missing_ok=True)
-        round_num = int(meta.get("round", 0))
-        log.info(
-            "rejoin: caught up to round %d (membership epoch %s, %d tensors)",
-            round_num, meta.get("epoch", "?"), len(flat),
-        )
+        if shard_map is not None and len(shard_map.shards) > 1:
+            # One catch-up PER shard: each covers only its own fragments'
+            # cumulative Σ (disjoint tensors), and the authoritative next
+            # round is the most advanced shard's frontier.
+            want = len(shard_map.shards)
+            got: dict[int, dict] = {}
+            with session.receive(cfg.results) as events:
+                while len(got) < want:
+                    catchup = await_catchup(events, on_skip=_drop)
+                    meta = catchup.get("meta") or {}
+                    try:
+                        sid = int(meta.get(SHARD_KEY, 0))
+                    except (TypeError, ValueError):
+                        sid = 0
+                    if sid in got:
+                        _drop(catchup)
+                        continue
+                    got[sid] = catchup
+            round_num = 0
+            epoch = "?"
+            merged: dict = {}
+            for sid, catchup in sorted(got.items()):
+                meta = catchup.get("meta") or {}
+                catchup_file = work_dir / catchup["path"]
+                # Shards own disjoint tensors, so the per-shard Σs union
+                # into one flat map — applied in a SINGLE tree pass below
+                # instead of P parameter-sized flatten/rebuild rounds.
+                merged.update(compress.read_delta(catchup_file))
+                catchup_file.unlink(missing_ok=True)
+                round_num = max(round_num, int(meta.get("round", 0)))
+                epoch = meta.get("epoch", epoch)
+            merged_tensors = len(merged)
+            if merged:
+                params_flat = flat_leaf_map(state.params)
+                # f32 accumulation — the unsharded catch-up's
+                # apply_updates discipline (a long Σ cast to bf16 before
+                # the add would compound rounding the other path avoids).
+                new_live = merge_update_f32(
+                    {n: params_flat[n] for n in merged}, merged
+                )
+                state = state.replace(
+                    params=replace_leaves(state.params, new_live)
+                )
+            anchor = snapshot(state.params)
+            log.info(
+                "rejoin: caught up to round %d from %d shards (membership "
+                "epoch %s, %d tensors)",
+                round_num, want, epoch, merged_tensors,
+            )
+        else:
+            with session.receive(cfg.results) as events:
+                catchup = await_catchup(events, on_skip=_drop)
+            meta = catchup.get("meta") or {}
+            catchup_file = work_dir / catchup["path"]
+            flat = compress.read_delta(catchup_file)
+            if flat:
+                update = unflatten_like(flat, state.params)
+                state = state.replace(params=apply_updates(state.params, [update]))
+            anchor = snapshot(state.params)
+            catchup_file.unlink(missing_ok=True)
+            round_num = int(meta.get("round", 0))
+            log.info(
+                "rejoin: caught up to round %d (membership epoch %s, %d tensors)",
+                round_num, meta.get("epoch", "?"), len(flat),
+            )
 
     def batches() -> Iterator[Any]:
         yield first_batch
@@ -992,6 +1106,159 @@ def run_training(
                 )
         return resp.kind == ProgressResponseKind.CONTINUE
 
+    # Sharded blocking sync state: the deterministic part partition, one
+    # error-feedback residual per part (absorb replaces the whole residual
+    # tree, so parts must not share one), and the last seen generation per
+    # PS shard.
+    shard_ctx: dict[str, Any] = {"parts": None, "efs": None, "gens": {}}
+
+    def _push_part(p: int, path: Path, samples: float) -> None:
+        tag = FragmentTag(
+            round=round_num, fragment_id=p,
+            fragments=len(shard_ctx["parts"]),
+        )
+        send, owner, res_tag = shard_route(
+            shard_map, p, getattr(cfg, "reduce_via", None)
+        )
+        meta = {"num_samples": samples, "round": round_num, **tag.header()}
+        if len(shard_map.shards) > 1:
+            meta[SHARD_KEY] = owner
+        session.send_resource(send, path.name, resource=res_tag, meta=meta)
+
+    def do_update_sharded() -> bool:
+        """Blocking sync against the sharded parameter service: split Δθ
+        into placement parts, push each part to its owning shard (via the
+        group reducer with ANY failover when tree-reduce is on), await
+        EVERY part's update broadcast, merge, re-anchor. True = continue.
+        """
+        nonlocal state, anchor, round_num, round_samples
+        assert shard_map is not None
+        session.send_status(Progress(kind=ProgressKind.UPDATE, job_id=spec.job_id))
+        delta = extract_delta(state.params, anchor)
+        host_delta = jax.device_get(delta)
+        wire_flat = flatten_tree(host_delta)
+        P = int(shard_map.fragments) or len(shard_map.shards)
+        if shard_ctx["parts"] is None:
+            # Deterministic by (name, size) only — shards, reducers and
+            # rejoiners derive the identical partition with no manifest.
+            shard_ctx["parts"] = partition_names(
+                {n: int(np.asarray(v).size) for n, v in wire_flat.items()}, P
+            )
+            shard_ctx["efs"] = [
+                compress.ErrorFeedback()
+                if wire_codec in compress.QUANT_CODECS
+                else None
+                for _ in range(P)
+            ]
+        parts = shard_ctx["parts"]
+        samples = float(round_samples)
+        paths: dict[int, Path] = {}
+        for p, names in enumerate(parts):
+            tag = FragmentTag(round=round_num, fragment_id=p, fragments=P)
+            path = work_dir / f"delta-{round_num}-p{p}.safetensors"
+            compress.write_delta(
+                path, {n: wire_flat[n] for n in names}, wire_codec,
+                ef=shard_ctx["efs"][p], tag=tag.header(),
+            )
+            paths[p] = path
+            _push_part(p, path, samples)
+        mean_loss = float(np.mean(round_losses)) if round_losses else math.nan
+        session.send_status(
+            Progress(
+                kind=ProgressKind.METRICS,
+                job_id=spec.job_id,
+                round=round_num,
+                metrics={"loss": mean_loss, "samples": samples},
+            )
+        )
+        gens = shard_ctx["gens"]
+        got: dict[int, Path] = {}
+        with session.receive(cfg.results) as events:
+            while len(got) < P:
+                event = next(events, None)
+                if event is None:
+                    raise RuntimeError(
+                        "results stream ended before every part's update "
+                        "broadcast"
+                    )
+                meta = event.get("meta") or {}
+                try:
+                    sid = int(meta.get(SHARD_KEY, 0))
+                except (TypeError, ValueError):
+                    sid = 0
+                gens[sid], resend = restart_signal(meta, gens.get(sid))
+                if resend:
+                    # That shard restarted: re-send its still-un-acked
+                    # parts — the shard's journal dedup absorbs any copy
+                    # whose original did land.
+                    for p, path in paths.items():
+                        if (
+                            p in got
+                            or not path.is_file()
+                            or shard_of(p, len(shard_map.shards)) != sid
+                        ):
+                            continue
+                        log.warning(
+                            "ps shard %d restart detected; re-sending "
+                            "round %d part %d", sid, round_num, p,
+                        )
+                        _push_part(p, path, samples)
+                if meta.get(RESYNC_KEY) or meta.get(CATCHUP_KEY):
+                    (work_dir / event["path"]).unlink(missing_ok=True)
+                    continue
+                try:
+                    eround = int(meta.get("round", round_num))
+                except (TypeError, ValueError):
+                    eround = round_num
+                if eround < round_num:
+                    # A recovered shard's re-broadcast of a merged round.
+                    (work_dir / event["path"]).unlink(missing_ok=True)
+                    continue
+                etag = FragmentTag.from_header(meta)
+                p = int(etag.fragment_id) if etag is not None else sid
+                if p in got or p not in paths:
+                    (work_dir / event["path"]).unlink(missing_ok=True)
+                    continue
+                got[p] = work_dir / event["path"]
+        # Merge every part — disjoint tensors, so their flat maps union
+        # into ONE combined merge/replace pass (P separate passes would
+        # re-flatten and rebuild the whole parameter tree per part) —
+        # then re-anchor ONCE (blocking semantics: no drift correction).
+        combined: dict = {}
+        for p in sorted(got):
+            flat = compress.read_delta(got[p])
+            if set(flat) != set(parts[p]):
+                raise ValueError(
+                    f"part {p} placement mismatch: update carries "
+                    f"{len(flat)} tensors, worker expects {len(parts[p])}"
+                )
+            combined.update(flat)
+            got[p].unlink(missing_ok=True)
+        params_flat = flat_leaf_map(state.params)
+        new_live = merge_update(
+            {n: params_flat[n] for n in combined}, combined
+        )
+        state = state.replace(params=replace_leaves(state.params, new_live))
+        anchor = snapshot(state.params)
+        for path in paths.values():
+            path.unlink(missing_ok=True)
+        resp = session.send_status(
+            Progress(kind=ProgressKind.UPDATE_RECEIVED, job_id=spec.job_id)
+        )
+        round_num += 1
+        result.rounds = round_num
+        round_samples = 0
+        round_losses.clear()
+        if ckpt_dir is not None and round_num % ckpt_every == 0:
+            save_train_checkpoint(
+                ckpt_dir,
+                state.params,
+                state.opt_state,
+                int(state.step),
+                round_offset + round_num,
+            )
+        return resp.kind == ProgressResponseKind.CONTINUE
+
     def begin_stream_sync() -> None:
         """Ship the due fragment's Δ in the background; keep stepping.
 
@@ -1102,6 +1369,9 @@ def run_training(
                     countdown = None
                     if stream_state is not None:
                         begin_stream_sync()
+                    elif shard_map is not None:
+                        if not do_update_sharded():
+                            break
                     elif not do_update():
                         break
                 else:

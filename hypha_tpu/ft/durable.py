@@ -202,7 +202,15 @@ class RoundJournal:
 
 @dataclass(slots=True)
 class FoldRecord:
-    """One accepted delta, as the journal remembers it."""
+    """One accepted delta, as the journal remembers it.
+
+    ``prefold`` marks a tree-reduce partial sum (hypha_tpu.stream.reduce):
+    its payload is already Σ samples·Δθ, so recovery's replay must fold it
+    verbatim instead of re-weighting. ``covers`` lists the worker peers the
+    partial represents — the round's close condition counts covered
+    workers, not accepted files. Both default empty/False so pre-shard
+    journals parse unchanged.
+    """
 
     round: int
     fragment: int
@@ -210,9 +218,11 @@ class FoldRecord:
     samples: float
     sha: str
     file: str
+    prefold: bool = False
+    covers: list = field(default_factory=list)
 
     def record(self) -> dict:
-        return {
+        rec = {
             "t": "fold",
             "round": self.round,
             "fragment": self.fragment,
@@ -221,6 +231,10 @@ class FoldRecord:
             "sha": self.sha,
             "file": self.file,
         }
+        if self.prefold:
+            rec["prefold"] = True
+            rec["covers"] = list(self.covers)
+        return rec
 
 
 @dataclass(slots=True)
@@ -256,10 +270,17 @@ class DurablePS:
         job_id: str,
         ckpt_every: int = 1,
         fsync_every: int | None = None,
+        owned=None,
     ) -> None:
         self.root = Path(root)
         self.job_id = job_id
         self.ckpt_every = max(int(ckpt_every), 1)
+        # Sharded parameter service (hypha_tpu.stream placement): a stream
+        # shard aggregates only the rounds whose due fragment it owns, so
+        # its journal legitimately skips the others. ``owned(round)`` tells
+        # the resume contiguity check which rounds to expect commits for;
+        # None (the single-PS default) means every round.
+        self._owned = owned
         self.deltas_dir = self.root / "deltas"
         self.wires_dir = self.root / "wires"
         self.generation = 1
@@ -276,6 +297,7 @@ class DurablePS:
         # Records the current checkpoint does not cover (journal window).
         self._window: list[dict] = []
         self._ckpt_next_round = 0
+        self._commits_since_ckpt = 0
 
     # ------------------------------------------------------------- opening
 
@@ -286,8 +308,9 @@ class DurablePS:
         job_id: str,
         ckpt_every: int = 1,
         fsync_every: int | None = None,
+        owned=None,
     ) -> "DurablePS":
-        dur = cls(Path(root), job_id, ckpt_every, fsync_every)
+        dur = cls(Path(root), job_id, ckpt_every, fsync_every, owned=owned)
         dur.root.mkdir(parents=True, exist_ok=True)
         dur.deltas_dir.mkdir(exist_ok=True)
         dur.wires_dir.mkdir(exist_ok=True)
@@ -393,6 +416,8 @@ class DurablePS:
                     samples=float(rec.get("samples", 1.0)),
                     sha=str(rec.get("sha", "")),
                     file=str(rec.get("file", "")),
+                    prefold=bool(rec.get("prefold", False)),
+                    covers=[str(p) for p in rec.get("covers", [])],
                 )
                 self._folds.setdefault(rnd, []).append(fold)
                 self._dedup[(rnd, fold.fragment, fold.peer)] = fold.sha
@@ -415,8 +440,14 @@ class DurablePS:
         resume.committed = [committed[r] for r in sorted(committed)]
         # Sanity: committed rounds must be contiguous from the checkpoint —
         # a gap means journal loss; refuse to silently skip outer steps.
+        # A stream shard's journal legitimately skips the rounds it does
+        # not own (``owned``); only owned gaps are loss.
         expect = resume.next_round
         for rec in resume.committed:
+            if self._owned is not None:
+                guard = expect + 4096  # malformed owned() must not spin
+                while expect < guard and not self._owned(expect):
+                    expect += 1
             if int(rec["round"]) != expect:
                 raise ValueError(
                     f"durable ps journal gap: commit for round {rec['round']} "
@@ -480,18 +511,32 @@ class DurablePS:
         ulp-level drift only)."""
         ops: list[tuple[FoldRecord, float]] = []
         last: dict[str, FoldRecord] = {}
+
+        def unfold(prev: FoldRecord) -> None:
+            if (self.deltas_dir / prev.file).is_file():
+                ops.append((prev, -1.0))
+            else:
+                # Cannot un-fold what we cannot re-read: drop the
+                # superseded +/- pair instead (they net to ~zero).
+                ops[:] = [
+                    op for op in ops
+                    if not (op[0] is prev and op[1] > 0)
+                ]
+
         for fold in self._folds.get(round_num, []):
             prev = last.get(fold.peer)
             if prev is not None:
-                if (self.deltas_dir / prev.file).is_file():
-                    ops.append((prev, -1.0))
-                else:
-                    # Cannot un-fold what we cannot re-read: drop the
-                    # superseded +/- pair instead (they net to ~zero).
-                    ops = [
-                        op for op in ops
-                        if not (op[0] is prev and op[1] > 0)
-                    ]
+                unfold(prev)
+            if fold.prefold and fold.covers:
+                # Mirror of the collector's _retire_covered: a partial
+                # supersedes its members' earlier failed-over direct
+                # entries (same sorted order, so the replayed fold
+                # sequence is bit-identical to the live one's).
+                for member in sorted(fold.covers):
+                    mprev = last.get(member)
+                    if mprev is not None and not mprev.prefold:
+                        unfold(mprev)
+                        del last[member]
             ops.append((fold, 1.0))
             last[fold.peer] = fold
         return ops
@@ -558,7 +603,17 @@ class DurablePS:
         """
         prev = self._last_wire.get(fragment)
         self._last_wire[fragment] = (round_num, wire_name)
-        if (round_num + 1) % self.ckpt_every == 0:
+        # Checkpoint cadence: the single-PS path keeps the round-parity rule
+        # (bit-compatible with pre-shard runs); a shard that owns only some
+        # rounds counts its own commits instead — round parity could
+        # otherwise never fire for it and the journal would grow unbounded.
+        self._commits_since_ckpt += 1
+        ckpt_due = (
+            (round_num + 1) % self.ckpt_every == 0
+            if self._owned is None
+            else self._commits_since_ckpt >= self.ckpt_every
+        )
+        if ckpt_due:
             self._checkpoint(
                 next_round=round_num + 1,
                 epoch=epoch,
@@ -637,6 +692,7 @@ class DurablePS:
         os.replace(pointer_tmp, self.root / _STATE_POINTER)
         old_next = self._ckpt_next_round
         self._ckpt_next_round = next_round
+        self._commits_since_ckpt = 0
         # GC: everything the snapshot covers — old state files, delta wire
         # files of checkpointed rounds, and the journal window.
         for f in self.root.glob("state-*.safetensors"):

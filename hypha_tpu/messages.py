@@ -34,6 +34,7 @@ __all__ = [
     "PROTOCOL_PROGRESS",
     "PROTOCOL_GENERATE",
     "PROTOCOL_STREAM",
+    "PROTOCOL_SHARD",
     "TOPIC_WORKER",
     "TRAIN_EXECUTOR_NAME",
     "AGGREGATE_EXECUTOR_NAME",
@@ -66,6 +67,10 @@ __all__ = [
     "PriceRange",
     # streaming outer sync
     "FragmentTag",
+    # sharded parameter service
+    "ShardMap",
+    "SHARD_KEY",
+    "PREFOLD_KEY",
     # value vocabulary
     "ExecutorDescriptor",
     "WorkerSpec",
@@ -97,6 +102,12 @@ PROTOCOL_GENERATE = "/hypha-generate/0.0.1"
 # pushes — fragment deltas up, per-fragment update broadcasts down — whose
 # headers carry a FragmentTag.
 PROTOCOL_STREAM = "/hypha-stream/0.0.1"
+# Sharded parameter service (hypha_tpu.stream placement): the same tensor
+# streams, extended with a shard identity — delta pushes routed to the
+# fragment's owning PS shard, per-shard update broadcasts and resyncs.
+# ShardMap is the placement announcement riding inside job specs; the
+# per-push shard id travels as the ``shard`` header key next to ``round``.
+PROTOCOL_SHARD = "/hypha-shard/0.0.1"
 TOPIC_WORKER = "hypha/worker"
 
 # Executor implementation names: what the scheduler asks for at auction and
@@ -519,6 +530,23 @@ class TrainExecutorConfig:
     # the wire = blocking, bit-identical to pre-streaming peers.
     sync_mode: str = "blocking"
     fragments: int = 0  # stream mode: 0 = default (stream.DEFAULT_FRAGMENTS)
+    # Sharded parameter service (hypha_tpu.stream placement): the shard
+    # placement this worker routes its delta pushes by — fragment f goes
+    # to ps_shards.shards[shard_of(f)] under ps_shards.tags[...]. None =
+    # single parameter server, the exact pre-shard path. Additive field:
+    # absent on the wire = unsharded, old peers interop.
+    ps_shards: ShardMap | None = None
+    # Tree-reduce (optional, needs ps_shards): the peer id of THIS
+    # worker's group reducer — deltas are pushed [reducer, shard] with
+    # ANY failover, so a dead reducer degrades the group to direct
+    # shard pushes instead of wedging the round. None = push direct.
+    reduce_via: str | None = None
+    # Tree-reduce, reducer side: the OTHER group members whose deltas this
+    # worker's runtime pre-folds (stream.reduce.GroupReducer) into one
+    # partial sum per shard. Non-empty only on the group's first member;
+    # the reducer's own delta goes direct to the shard (a node cannot
+    # push to itself), so shard ingress per group is the partial + one.
+    reduce_members: list = field(default_factory=list)
 
 
 @register
@@ -562,6 +590,16 @@ class AggregateExecutorConfig:
     # round journal covers the gap — a larger value trades cheaper commits
     # for a longer replay on recovery. Additive field: absent = 1.
     ps_checkpoint_every_rounds: int = 1
+    # Sharded parameter service (hypha_tpu.stream placement): this
+    # executor is shard ``shard_index`` of ``num_ps_shards`` — it owns the
+    # fragments ``{f : shard_of(f, num_ps_shards) == shard_index}``, runs
+    # its own journal/checkpoint/generation under its own checkpoint_dir,
+    # and stamps SHARD_KEY into every broadcast. Named like ``fragments``
+    # (a config count, not a stream identity — the per-push identity is
+    # the SHARD_KEY header, which always travels next to ``round``).
+    # Additive fields: absent on the wire = the single pre-shard PS.
+    shard_index: int = 0
+    num_ps_shards: int = 1
 
 
 @register
@@ -840,6 +878,11 @@ class Progress:
     batch_size: int = 0
     round: int = 0
     metrics: dict = field(default_factory=dict)
+    # Sharded parameter service: which PS shard reports UPDATED — the
+    # scheduler advances the round once every shard due that round has
+    # reported. Additive field: absent on the wire = shard 0, so a
+    # single-PS job's control plane is byte-compatible.
+    shard: int = 0
 
 
 @_enum
@@ -909,6 +952,60 @@ class FragmentTag:
 
 
 # --------------------------------------------------------------------------
+# /hypha-shard/0.0.1 — sharded parameter service (hypha_tpu.stream placement)
+# --------------------------------------------------------------------------
+
+# Push/broadcast header key carrying the sending (or target) PS shard's
+# index. Only sharded jobs stamp it — a single-PS job's headers stay
+# byte-identical to the pre-shard wire.
+SHARD_KEY = "shard"
+
+# Push header key marking a tree-reduce partial sum: the payload is ALREADY
+# Σ samples·Δθ over the reducer's group (its ``num_samples`` carries the
+# summed weight), so the shard folds it verbatim instead of re-weighting.
+PREFOLD_KEY = "prefold"
+
+
+@register
+@dataclass(slots=True)
+class ShardMap:
+    """The placement announcement: which PS shard owns which fragment.
+
+    The deterministic fragment partition (``stream.partition``) already
+    gives every peer the same fragment → tensor-name map from (name, size)
+    alone; this message adds the fragment → *shard* dimension: shard ``k``
+    is the peer ``shards[k]`` reachable under the updates resource tag
+    ``tags[k]``, and fragment ``f`` is owned by shard
+    ``stream.shard_of(f, len(shards))``. Rides inside dispatched job specs
+    (and any future mid-job re-placement push), stamped with the ``round``
+    it takes effect — a placement without its round could re-route an
+    in-flight fragment to the wrong shard's journal.
+
+    ``groups`` is the optional tree-reduce plan: worker peer ids chunked
+    into deterministic groups, first member of each group acting as the
+    group's reducer (pre-folding its group's deltas into one partial sum
+    per shard). Empty = every worker pushes directly to the shards.
+    """
+
+    round: int = 0  # round the placement takes effect (0 = from dispatch)
+    shards: list = field(default_factory=list)  # peer ids, shard k at [k]
+    tags: list = field(default_factory=list)  # per-shard updates tags
+    fragments: int = 1  # total placed fragment count (sanity cross-check)
+    groups: list = field(default_factory=list)  # tree-reduce: list[list[str]]
+
+    def __post_init__(self) -> None:
+        if self.tags and len(self.tags) != len(self.shards):
+            raise ValueError(
+                f"ShardMap has {len(self.shards)} shards but "
+                f"{len(self.tags)} tags"
+            )
+
+    @property
+    def num_shards(self) -> int:
+        return len(self.shards)
+
+
+# --------------------------------------------------------------------------
 # Gossip: worker request ad (lib.rs:122-134)
 # --------------------------------------------------------------------------
 
@@ -949,6 +1046,7 @@ declare_protocol(PROTOCOL_HEALTH, "HealthRequest", "HealthResponse")
 declare_protocol(PROTOCOL_PROGRESS, "Progress", "ProgressResponse")
 declare_protocol(PROTOCOL_GENERATE, "GenerateRequest", "GenerateResponse")
 declare_protocol(PROTOCOL_STREAM, "FragmentTag")
+declare_protocol(PROTOCOL_SHARD, "ShardMap")
 declare_protocol(f"gossip:{TOPIC_WORKER}", "RequestWorker")
 declare_values(
     "LRScheduler",

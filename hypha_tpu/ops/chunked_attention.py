@@ -149,6 +149,15 @@ def chunked_attention(
     without mask/window/q_offset) with flash's memory profile. GQA expands
     via broadcast; XLA fuses the repeat into the block einsums, and its
     transpose sums group gradients back onto the kv heads."""
+    if causal and q.shape[1] != k.shape[1]:
+        # The causal mask compares query index i against absolute kv index
+        # j with no offset, so Sq != Sk would silently mask the wrong
+        # diagonal (e.g. a decode step attending to a prefix would see a
+        # future-shifted window) instead of erroring.
+        raise ValueError(
+            f"causal chunked_attention requires Sq == Sk, got "
+            f"{q.shape[1]} != {k.shape[1]}"
+        )
     H, Hkv = q.shape[2], k.shape[2]
     if H != Hkv:
         if H % Hkv:

@@ -51,6 +51,7 @@ class BatchScheduler:
         on_complete: Callable[[], None] | None = None,
         time_cap_ms: float = TIME_CAP_MS,
         updates_cap: int = UPDATES_CAP,
+        shards_due: "Callable[[int], tuple[int, ...]] | None" = None,
     ) -> None:
         self.tracker = tracker
         self._on_metrics = on_metrics
@@ -58,6 +59,12 @@ class BatchScheduler:
         self.time_cap_ms = time_cap_ms
         self.updates_cap = updates_cap
         self.completed = False
+        # Sharded parameter service: which PS shards must report UPDATED
+        # before round r advances (stream.shards_due_at). None = the
+        # single pre-shard PS (shard 0, every round).
+        self.shards_due = shards_due
+        # round -> shards that have reported UPDATED for it.
+        self._updated: dict[int, set[int]] = {}
 
     # ------------------------------------------------------------------
     def on_progress(self, peer: str, progress: Progress) -> ProgressResponse:
@@ -75,32 +82,57 @@ class BatchScheduler:
             return _OK
         if kind == ProgressKind.UPDATED:
             # Parameter server applied the outer step and broadcast weights.
-            # Only the designated PS peer may advance the round.
-            if peer != self.tracker.parameter_server:
+            # Only designated PS (shard) peers may advance the round.
+            if peer not in self.tracker.parameter_servers:
                 return ProgressResponse(
                     kind=ProgressResponseKind.ERROR, message="not the parameter server"
                 )
-            if progress.round < self.tracker.round:
-                # Idempotent by round: a recovered parameter server cannot
-                # know whether its predecessor's notify landed before the
-                # crash, so it re-sends — advancing again would eat a round.
-                return (
-                    _DONE
-                    if self.tracker.round >= self.tracker.update_epochs
-                    else _OK
-                )
-            self.tracker.advance_round()
-            if self.tracker.round >= self.tracker.update_epochs:
-                # That was the final outer step: the PS's aggregation loop
-                # terminates on DONE (the workers' own DONE comes with their
-                # UpdateReceived).
-                return _DONE
-            return _OK
+            return self._on_updated(progress)
         if kind == ProgressKind.UPDATE_RECEIVED:
             return self._on_update_received(peer)
         return ProgressResponse(
             kind=ProgressResponseKind.ERROR, message=f"unknown progress kind {kind}"
         )
+
+    # ------------------------------------------------------------------
+    def _due(self, round_num: int) -> set:
+        if self.shards_due is None:
+            return {0}
+        return set(self.shards_due(round_num))
+
+    def _shard_done(self, shard: int, after_round: int) -> bool:
+        """No owned round left for ``shard`` after ``after_round``: its
+        aggregation loop should terminate. In stream mode a shard's LAST
+        owned round can come before the job's final round — the scheduler
+        owns ``update_epochs``, so it makes this call, not the shard."""
+        return all(
+            shard not in self._due(r)
+            for r in range(after_round + 1, self.tracker.update_epochs)
+        )
+
+    def _on_updated(self, progress: Progress) -> ProgressResponse:
+        shard = int(getattr(progress, "shard", 0) or 0)
+        rnd = progress.round
+        if rnd < self.tracker.round:
+            # Idempotent by (shard, round): a recovered parameter server
+            # (shard) cannot know whether its predecessor's notify landed
+            # before the crash, so it re-sends — advancing again would eat
+            # a round.
+            return _DONE if self._shard_done(shard, rnd) else _OK
+        self._updated.setdefault(rnd, set()).add(shard)
+        # Advance while the frontier round has every due shard reported
+        # (single PS: exactly the old one-notify-one-advance behavior).
+        while (
+            self.tracker.round < self.tracker.update_epochs
+            and self._updated.get(self.tracker.round, set())
+            >= self._due(self.tracker.round)
+        ):
+            self._updated.pop(self.tracker.round, None)
+            self.tracker.advance_round()
+        # DONE terminates THIS shard's aggregation loop; the workers' own
+        # DONE comes with their UpdateReceived once the global round
+        # reaches update_epochs.
+        return _DONE if self._shard_done(shard, rnd) else _OK
 
     # ------------------------------------------------------------------
     def _on_status(self, peer: str, progress: Progress) -> ProgressResponse:

@@ -112,6 +112,20 @@ class DiLoCoJob:
     # ~F×. "blocking" (default) is bit-identical to pre-streaming rounds.
     sync_mode: str = "blocking"
     num_fragments: int = 0  # stream mode; 0 = stream.DEFAULT_FRAGMENTS
+    # Sharded parameter service (hypha_tpu.stream placement): N PS shards,
+    # each owning a disjoint fragment set with its own journal, checkpoint
+    # and generation id. Workers route each fragment's delta to its owning
+    # shard, so aggregate outer-sync bandwidth scales with the shard count
+    # instead of one peer's NIC. 1 = today's single (durable) parameter
+    # server, behavior-compatible.
+    num_ps_shards: int = 1
+    # Tree-reduce (optional, needs num_ps_shards >= 1 to matter): workers
+    # are deterministically grouped in sorted-peer-id chunks of this size;
+    # the first member of each group pre-folds the group's deltas and
+    # ships ONE partial sum + sample weight per shard, cutting shard
+    # ingress from W pushes to ~W/G. A dead reducer degrades its group to
+    # direct shard pushes (ANY failover). 0/1 = disabled.
+    reduce_group_size: int = 0
 
     def __post_init__(self) -> None:
         if self.delta_dtype not in ("float32", "bfloat16"):
@@ -132,6 +146,29 @@ class DiLoCoJob:
             )
         if self.num_fragments < 0:
             raise ValueError("num_fragments must be >= 0 (0 = default)")
+        if self.num_ps_shards < 1:
+            raise ValueError("num_ps_shards must be >= 1")
+        if self.reduce_group_size < 0:
+            raise ValueError("reduce_group_size must be >= 0 (0 = disabled)")
+        if self.num_ps_shards > 1 and self.sync_mode == "overlap":
+            # Overlap's one whole-tree flight has no per-part schedule to
+            # route by; pipelining + sharding compose via sync_mode=stream.
+            raise ValueError(
+                "num_ps_shards > 1 requires sync_mode blocking or stream "
+                "(use stream to combine compute overlap with sharding)"
+            )
+        if self.num_ps_shards > 1 and self.sync_mode == "stream":
+            from ..stream import effective_fragments
+
+            frags = effective_fragments(self.sync_mode, self.num_fragments)
+            if self.num_ps_shards > frags:
+                # A shard owning zero fragments would hold a lease and a
+                # journal for rounds that never come.
+                raise ValueError(
+                    f"num_ps_shards={self.num_ps_shards} exceeds the "
+                    f"{frags} stream fragments; every shard must own at "
+                    "least one fragment"
+                )
         if self.ps_checkpoint_every_rounds < 1:
             raise ValueError("ps_checkpoint_every_rounds must be >= 1")
         if self.rounds.update_rounds <= 0:

@@ -67,10 +67,12 @@ from ..messages import (
     Receive,
     Reference,
     Send,
+    ShardMap,
     TrainExecutorConfig,
     WorkerSpec,
 )
 from ..network.node import Node, RequestError
+from ..stream import placement_parts, shards_due_at
 from ..telemetry.ft_metrics import FT_METRICS
 from .allocator import GreedyWorkerAllocator
 from .batch_scheduler import BatchScheduler
@@ -131,8 +133,15 @@ class _RunContext:
         self.updates_tag = ""
         self.results_tag = ""
         self.handles: dict[str, WorkerHandle] = {}
-        self.ps_handle: WorkerHandle | None = None
-        self.ps_job_id = ""
+        # One handle / job id / updates tag per PS shard (index = shard
+        # index; a single-PS job has exactly one of each, with the exact
+        # pre-shard job id and tag). A slot is None while that shard is
+        # being restarted.
+        self.ps_handles: list[WorkerHandle | None] = []
+        self.ps_job_ids: list[str] = []
+        self.shard_tags: list[str] = []
+        self.shard_map: ShardMap | None = None
+        self.reduce_groups: list[list[str]] = []
         self.router: StatusRouter | None = None
         self.tracker: ProgressTracker | None = None
         self.data_scheduler: DataScheduler | None = None
@@ -144,12 +153,14 @@ class _RunContext:
         self.membership: MembershipView | None = None
         self.rejoin_count = 0
         self.notify_tasks: set[asyncio.Task] = set()
-        # PS crash recovery (ft.durable): the dispatched aggregate spec is
-        # re-used verbatim on restart (same job id + stream tags, so the
-        # recovered PS resumes its own durable state).
-        self.ps_spec: JobSpec | None = None
+        # PS crash recovery (ft.durable): each shard's dispatched aggregate
+        # spec is re-used verbatim on restart (same job id + stream tags,
+        # so the recovered shard resumes its own durable state). A dead
+        # shard is re-auctioned INDIVIDUALLY — the other shards keep
+        # closing their rounds throughout.
+        self.ps_specs: list[JobSpec] = []
         self.ps_restarts = 0
-        self.ps_restarting = False
+        self.ps_restarting: set[int] = set()
 
 
 class Orchestrator:
@@ -191,8 +202,22 @@ class Orchestrator:
         raise AllocationError(f"could not allocate {res.num_workers} train workers")
 
     async def _allocate_ps(
-        self, job: DiLoCoJob, taken: set, *, auction_timeout: float, attempts: int
-    ):
+        self,
+        job: DiLoCoJob,
+        taken: set,
+        *,
+        auction_timeout: float,
+        attempts: int,
+        count: int = 1,
+    ) -> list:
+        """Auction ``count`` parameter-server (shard) executions.
+
+        Distinct peers are preferred — the whole point of sharding is that
+        each shard's deltas leave a different NIC — first distinct from
+        the train workers, then from each other; when the mesh is smaller
+        than the shard count, peers are reused (each shard still runs its
+        own executor/journal under its own updates tag).
+        """
         res = job.resources
         ps_spec = WorkerSpec(
             resources=res.parameter_server,
@@ -202,16 +227,31 @@ class Orchestrator:
         )
         for _attempt in range(attempts):
             offers = await self.allocator.request(
-                ps_spec, res.parameter_server_price, auction_timeout, 1 + len(taken)
+                ps_spec, res.parameter_server_price, auction_timeout,
+                count + len(taken),
             )
-            # A peer already sold as a train worker can also host the PS if
-            # its capacity covers both leases; prefer a distinct peer.
+            if not offers:
+                continue
+            # A peer already sold as a train worker can also host a PS if
+            # its capacity covers both leases; prefer distinct peers.
             distinct = [o for o in offers if o.peer_id not in taken]
-            if distinct:
-                return distinct[0]
-            if offers:
-                return offers[0]
-        raise AllocationError("could not allocate a parameter server")
+            ranked = distinct + [o for o in offers if o.peer_id in taken]
+            picked: list = []
+            seen: set = set()
+            for offer in ranked:  # one offer per distinct peer first
+                if offer.peer_id not in seen:
+                    picked.append(offer)
+                    seen.add(offer.peer_id)
+                if len(picked) == count:
+                    return picked
+            while picked and len(picked) < count:
+                # Reuse peers round-robin when the mesh is small.
+                picked.append(ranked[len(picked) % len(ranked)])
+            if len(picked) == count:
+                return picked
+        raise AllocationError(
+            f"could not allocate {count} parameter server shard(s)"
+        )
 
     @staticmethod
     def batch_size_for(offered, required, max_batch: int | None) -> int:
@@ -289,7 +329,27 @@ class Orchestrator:
         rejoin: bool = False,
     ) -> JobSpec:
         job = ctx.job
-        assert job is not None and ctx.ps_handle is not None
+        assert job is not None and ctx.ps_handles
+        # Placement peers, NOT live handles: a shard mid-restart comes back
+        # on the SAME peer id (_restart_ps), so a worker dispatched during
+        # the outage must still wire every shard's results stream —
+        # compacting out the restarting slot would make it wait on a
+        # catch-up/broadcast source it never registered.
+        if ctx.shard_map is not None and ctx.shard_map.shards:
+            ps_peers = list(ctx.shard_map.shards)
+        else:
+            ps_peers = [h.peer_id for h in ctx.ps_handles if h is not None]
+        assert ps_peers, "train spec needs at least one parameter server peer"
+        # Tree-reduce role for THIS worker: the first member of its group
+        # pre-folds the others' deltas (reduce_members); the rest route
+        # their pushes [reducer, shard] with ANY failover (reduce_via).
+        reduce_via = None
+        reduce_members: list[str] = []
+        for group in ctx.reduce_groups:
+            if handle.peer_id == group[0]:
+                reduce_members = [p for p in group[1:]]
+            elif handle.peer_id in group:
+                reduce_via = group[0]
         return JobSpec(
             job_id=f"{ctx.base_id}-{suffix}",
             executor=Executor(
@@ -301,15 +361,17 @@ class Orchestrator:
                         Reference.from_scheduler(self.node.peer_id, job.dataset)
                     ),
                     updates=Send(
-                        Reference.from_peers(
-                            [ctx.ps_handle.peer_id], ctx.updates_tag
-                        )
+                        Reference.from_peers([ps_peers[0]], ctx.updates_tag)
                     ),
                     results=Receive(
-                        Reference.from_peers(
-                            [ctx.ps_handle.peer_id], ctx.results_tag
-                        )
+                        # Every shard broadcasts on the shared results tag;
+                        # tree-reduce jobs also accept the reducer-relayed
+                        # streams (same tag, shard peers only).
+                        Reference.from_peers(ps_peers, ctx.results_tag)
                     ),
+                    ps_shards=ctx.shard_map,
+                    reduce_via=reduce_via,
+                    reduce_members=reduce_members,
                     optimizer=job.inner_optimizer,
                     batch_size=handle.batch_size,
                     preprocessor=job.preprocessor,
@@ -360,13 +422,18 @@ class Orchestrator:
             for offer in worker_offers:
                 handle = await WorkerHandle.create(self.node, offer)
                 ctx.handles[handle.peer_id] = handle
-            ps_offer = await self._allocate_ps(
+            num_shards = max(int(getattr(job, "num_ps_shards", 1) or 1), 1)
+            ps_offers = await self._allocate_ps(
                 job,
                 set(ctx.handles),
                 auction_timeout=auction_timeout,
                 attempts=allocation_attempts,
+                count=num_shards,
             )
-            ctx.ps_handle = await WorkerHandle.create(self.node, ps_offer)
+            for offer in ps_offers:
+                ctx.ps_handles.append(
+                    await WorkerHandle.create(self.node, offer)
+                )
 
             for handle in ctx.handles.values():
                 handle.batch_size = self.batch_size_for(
@@ -393,7 +460,7 @@ class Orchestrator:
             ctx.data_scheduler.start()
 
             ctx.tracker = ProgressTracker(
-                parameter_server=ctx.ps_handle.peer_id,
+                parameter_server=[h.peer_id for h in ctx.ps_handles],
                 update_target=job.rounds.avg_samples_between_updates,
                 update_epochs=job.rounds.update_rounds,
             )
@@ -414,8 +481,20 @@ class Orchestrator:
                 collected.append((peer, round_num, metrics))
                 self.metrics_bridge.on_metrics(peer, round_num, metrics)
 
+            parts = placement_parts(
+                job.sync_mode, job.num_fragments, num_shards
+            )
             batch_scheduler = BatchScheduler(
-                ctx.tracker, on_metrics=on_metrics, on_complete=ctx.complete.set
+                ctx.tracker, on_metrics=on_metrics, on_complete=ctx.complete.set,
+                shards_due=(
+                    (
+                        lambda r: shards_due_at(
+                            job.sync_mode, r, parts, num_shards
+                        )
+                    )
+                    if num_shards > 1
+                    else None
+                ),
             )
 
             async def on_progress(peer: str, progress: Progress):
@@ -436,48 +515,99 @@ class Orchestrator:
             worker_peers = list(ctx.handles)
             # Job-unique stream tags: push routing keys on these, so several
             # jobs (or a PS colocated with a train job) can share worker
-            # nodes without consuming each other's tensor streams.
+            # nodes without consuming each other's tensor streams. With N
+            # shards, each shard gets its OWN updates tag so colocated
+            # shard executors never consume each other's parts.
             ctx.updates_tag = f"updates:{ctx.base_id}"
             ctx.results_tag = f"results:{ctx.base_id}"
-            ctx.ps_job_id = f"{ctx.base_id}-ps"
+            if num_shards == 1:
+                ctx.shard_tags = [ctx.updates_tag]
+                ctx.ps_job_ids = [f"{ctx.base_id}-ps"]
+            else:
+                ctx.shard_tags = [
+                    f"{ctx.updates_tag}.s{k}" for k in range(num_shards)
+                ]
+                ctx.ps_job_ids = [
+                    f"{ctx.base_id}-ps{k}" for k in range(num_shards)
+                ]
 
-            ctx.ps_spec = JobSpec(
-                job_id=ctx.ps_job_id,
-                executor=Executor(
-                    kind="aggregate",
-                    name=AGGREGATE_EXECUTOR_NAME,
-                    aggregate=AggregateExecutorConfig(
-                        updates=Receive(
-                            Reference.from_peers(worker_peers, ctx.updates_tag)
+            # Tree-reduce plan: deterministic sorted-peer-id chunks; the
+            # first member of each group is its reducer. Singleton groups
+            # are dropped (nothing to pre-fold).
+            group_size = int(getattr(job, "reduce_group_size", 0) or 0)
+            if group_size >= 2:
+                ordered = sorted(worker_peers)
+                ctx.reduce_groups = [
+                    g
+                    for g in (
+                        ordered[i : i + group_size]
+                        for i in range(0, len(ordered), group_size)
+                    )
+                    if len(g) >= 2
+                ]
+            # The placement announcement workers route by. Built for any
+            # sharded OR tree-reduced job; plain single-PS jobs ship None
+            # and keep the exact pre-shard wire.
+            if num_shards > 1 or ctx.reduce_groups:
+                ctx.shard_map = ShardMap(
+                    round=0,
+                    shards=[h.peer_id for h in ctx.ps_handles],
+                    tags=list(ctx.shard_tags),
+                    fragments=parts,
+                    groups=[list(g) for g in ctx.reduce_groups],
+                )
+
+            ctx.ps_specs = [
+                JobSpec(
+                    job_id=ctx.ps_job_ids[k],
+                    executor=Executor(
+                        kind="aggregate",
+                        name=AGGREGATE_EXECUTOR_NAME,
+                        aggregate=AggregateExecutorConfig(
+                            updates=Receive(
+                                Reference.from_peers(
+                                    worker_peers, ctx.shard_tags[k]
+                                )
+                            ),
+                            results=Send(
+                                Reference.from_peers(
+                                    worker_peers, ctx.results_tag
+                                )
+                            ),
+                            optimizer=job.outer_optimizer,
+                            num_workers=len(worker_peers),
+                            checkpoint_dir=(
+                                (
+                                    f"{job.checkpoint_dir}/ps"
+                                    if num_shards == 1
+                                    else f"{job.checkpoint_dir}/ps{k}"
+                                )
+                                if job.checkpoint_dir
+                                else None
+                            ),
+                            ps_checkpoint_every_rounds=job.ps_checkpoint_every_rounds,
+                            quorum_fraction=ft.quorum_fraction if ft else 0.0,
+                            round_deadline_s=ft.round_deadline_s if ft else 0.0,
+                            # The broadcast mirrors the upload codec: the
+                            # receive side sniffs frames, so one field is
+                            # enough for both directions.
+                            delta_codec=job.delta_codec,
+                            # Workers and the PS must agree on the fragment
+                            # schedule, so both sides get the same pair.
+                            sync_mode=job.sync_mode,
+                            fragments=job.num_fragments,
+                            shard_index=k,
+                            num_ps_shards=num_shards,
                         ),
-                        results=Send(
-                            Reference.from_peers(worker_peers, ctx.results_tag)
-                        ),
-                        optimizer=job.outer_optimizer,
-                        num_workers=len(worker_peers),
-                        checkpoint_dir=(
-                            f"{job.checkpoint_dir}/ps"
-                            if job.checkpoint_dir
-                            else None
-                        ),
-                        ps_checkpoint_every_rounds=job.ps_checkpoint_every_rounds,
-                        quorum_fraction=ft.quorum_fraction if ft else 0.0,
-                        round_deadline_s=ft.round_deadline_s if ft else 0.0,
-                        # The broadcast mirrors the upload codec: the
-                        # receive side sniffs frames, so one field is
-                        # enough for both directions.
-                        delta_codec=job.delta_codec,
-                        # Workers and the PS must agree on the fragment
-                        # schedule, so both sides get the same pair.
-                        sync_mode=job.sync_mode,
-                        fragments=job.num_fragments,
                     ),
-                ),
-            )
-            ps_task = await Task.dispatch(
-                self.node, ctx.router, ctx.ps_spec, [ctx.ps_handle]
-            )
-            tasks.append(ps_task)
+                )
+                for k in range(num_shards)
+            ]
+            for k, spec in enumerate(ctx.ps_specs):
+                ps_task = await Task.dispatch(
+                    self.node, ctx.router, spec, [ctx.ps_handles[k]]
+                )
+                tasks.append(ps_task)
             for i, (peer, handle) in enumerate(ctx.handles.items()):
                 spec = self._train_spec(ctx, f"w{i}", handle)
                 tasks.append(
@@ -507,8 +637,9 @@ class Orchestrator:
                 ctx.router.close()
             for handle in ctx.handles.values():
                 await handle.release()
-            if ctx.ps_handle is not None:
-                await ctx.ps_handle.release()
+            for ps_handle in ctx.ps_handles:
+                if ps_handle is not None:
+                    await ps_handle.release()
             await self.metrics_bridge.close()
 
     # ------------------------------------------------------------ supervision
@@ -567,8 +698,11 @@ class Orchestrator:
         add("complete", None, ctx.complete.wait())
         for task in tasks:
             add("status", task, self._watch_status(task))
-        for handle in list(ctx.handles.values()) + [ctx.ps_handle]:
+        for handle in ctx.handles.values():
             add("worker", handle, _await_failure(handle))
+        for ps_handle in ctx.ps_handles:
+            if ps_handle is not None:
+                add("ps-worker", ps_handle, _await_failure(ps_handle))
         loop = asyncio.get_running_loop()
         try:
             while True:
@@ -600,35 +734,42 @@ class Orchestrator:
                         continue
                     if kind == "status":
                         peer, job_id, reason = t.result()
-                        if job_id == ctx.ps_job_id:
+                        if job_id in ctx.ps_job_ids:
                             self._request_ps_restart(
-                                ctx, f"{job_id} failed on {peer}: {reason}", add
+                                ctx, ctx.ps_job_ids.index(job_id),
+                                f"{job_id} failed on {peer}: {reason}", add,
                             )
                         elif ctx.ft is None:
                             raise JobFailed(f"{job_id} failed on {peer}: {reason}")
                         else:
                             await self._depart(ctx, peer, f"{job_id}: {reason}", add)
+                    elif kind == "ps-worker":
+                        failure = t.result()
+                        if payload not in ctx.ps_handles:
+                            # A released shard handle's stale signal (its
+                            # restart is already in flight on a new handle).
+                            continue
+                        self._request_ps_restart(
+                            ctx, ctx.ps_handles.index(payload),
+                            str(failure), add,
+                        )
                     elif kind == "worker":
                         failure = t.result()
                         peer = getattr(failure, "peer_id", "")
-                        is_ps = payload is not None and payload is ctx.ps_handle
-                        if is_ps:
-                            self._request_ps_restart(ctx, str(failure), add)
-                        elif ctx.ft is None:
+                        if ctx.ft is None:
                             raise JobFailed(str(failure))
-                        else:
-                            await self._depart(ctx, peer, str(failure), add)
+                        await self._depart(ctx, peer, str(failure), add)
                     elif kind == "ps-restart":
-                        ctx.ps_restarting = False
+                        ctx.ps_restarting.discard(payload)
                         revived = t.result()
                         if revived is None:
                             raise JobFailed(
-                                "parameter server restart failed "
-                                f"(after {ctx.ps_restarts} attempt(s))"
+                                f"parameter server shard {payload} restart "
+                                f"failed (after {ctx.ps_restarts} attempt(s))"
                             )
                         handle, task = revived
                         add("status", task, self._watch_status(task))
-                        add("worker", handle, _await_failure(handle))
+                        add("ps-worker", handle, _await_failure(handle))
                     elif kind == "rejoin":
                         joined = t.result()
                         if joined is not None:
@@ -650,18 +791,26 @@ class Orchestrator:
 
     # ----------------------------------------------------- PS crash recovery
 
-    def _request_ps_restart(self, ctx: _RunContext, reason: str, add) -> None:
-        """PS failure signal → queue a restart attempt, or fail the attempt.
+    def _request_ps_restart(
+        self, ctx: _RunContext, shard: int, reason: str, add
+    ) -> None:
+        """PS shard failure signal → queue a restart attempt for THAT
+        shard only, or fail the attempt.
 
         Eligible only when the job is elastic, has ``ps_restart_attempts``
         left, and carries a checkpoint_dir — without the durable journal
         (ft.durable) a re-dispatched PS would restart the round counter
         while workers sit mid-round, which is worse than the full restart.
         A second failure signal for the same outage (lease failure + failed
-        job status) folds into the in-flight attempt.
+        job status) folds into the in-flight attempt. The OTHER shards are
+        untouched throughout: they keep closing the rounds they own while
+        this one recovers.
         """
-        if ctx.ps_restarting:
-            log.info("ps failure signal during restart (%s); ignored", reason)
+        if shard in ctx.ps_restarting:
+            log.info(
+                "ps shard %d failure signal during restart (%s); ignored",
+                shard, reason,
+            )
             return
         eligible = (
             ctx.ft is not None
@@ -669,36 +818,41 @@ class Orchestrator:
             and ctx.ps_restarts < ctx.ft.ps_restart_attempts
             and ctx.job is not None
             and bool(ctx.job.checkpoint_dir)
-            and ctx.ps_spec is not None
+            and len(ctx.ps_specs) > shard
         )
         if not eligible:
-            raise JobFailed(f"parameter server failed: {reason}")
+            raise JobFailed(
+                f"parameter server shard {shard} failed: {reason}"
+            )
         ctx.ps_restarts += 1
-        ctx.ps_restarting = True
+        ctx.ps_restarting.add(shard)
         log.warning(
-            "parameter server failed (%s); restart attempt %d/%d",
-            reason, ctx.ps_restarts, ctx.ft.ps_restart_attempts,
+            "parameter server shard %d failed (%s); restart attempt %d/%d",
+            shard, reason, ctx.ps_restarts, ctx.ft.ps_restart_attempts,
         )
-        add("ps-restart", None, self._restart_ps(ctx))
+        add("ps-restart", shard, self._restart_ps(ctx, shard))
 
     async def _restart_ps(
-        self, ctx: _RunContext
+        self, ctx: _RunContext, shard: int
     ) -> tuple[WorkerHandle, Task] | None:
-        """Re-auction the SAME peer and re-dispatch the aggregate job.
+        """Re-auction the SAME peer and re-dispatch one shard's aggregate
+        job.
 
-        The peer id must match the failed PS's: every worker's
-        updates/results reference was wired to it at dispatch, so recovery
-        models the process restarting on its host (the classic parameter-
-        server deployment), not a migration. The re-dispatched job (same
-        job id) finds its durable journal under checkpoint_dir and resumes
-        the interrupted round (ps_executor recovery path).
+        The peer id must match the failed shard's: every worker's
+        updates/results reference (and the ShardMap placement) was wired
+        to it at dispatch, so recovery models the process restarting on
+        its host (the classic parameter-server deployment), not a
+        migration. The re-dispatched job (same job id + shard tag) finds
+        its durable journal under its own checkpoint_dir and resumes the
+        interrupted round (ps_executor recovery path).
         """
         assert ctx.ft is not None and ctx.job is not None
-        assert ctx.ps_spec is not None
-        old_peer = ctx.ps_handle.peer_id if ctx.ps_handle is not None else ""
-        if ctx.ps_handle is not None:
-            await ctx.ps_handle.release()
-            ctx.ps_handle = None
+        assert len(ctx.ps_specs) > shard
+        failed = ctx.ps_handles[shard]
+        old_peer = failed.peer_id if failed is not None else ""
+        if failed is not None:
+            await failed.release()
+            ctx.ps_handles[shard] = None
         res = ctx.job.resources
         ps_spec = WorkerSpec(
             resources=res.parameter_server,
@@ -737,23 +891,25 @@ class Orchestrator:
             try:
                 handle = await WorkerHandle.create(self.node, same[0])
                 task = await Task.dispatch(
-                    self.node, ctx.router, ctx.ps_spec, [handle]
+                    self.node, ctx.router, ctx.ps_specs[shard], [handle]
                 )
             except asyncio.CancelledError:
                 if handle is not None:
                     await handle.release()
                 raise
             except (RequestError, DispatchError) as e:
-                log.warning("ps restart dispatch failed: %s", e)
+                log.warning("ps shard %d restart dispatch failed: %s", shard, e)
                 if handle is not None:
                     await handle.release()
                 continue
-            ctx.ps_handle = handle
+            ctx.ps_handles[shard] = handle
             if ctx.membership is not None:
-                # Bring the recovered PS's (checkpoint-restored) view up to
-                # date, including any rejoiners it still owes catch-ups.
+                # Bring the recovered shard's (checkpoint-restored) view up
+                # to date, including any rejoiners it still owes catch-ups.
                 self._notify_membership_soon(ctx)
-            log.warning("parameter server restarted on %s", old_peer)
+            log.warning(
+                "parameter server shard %d restarted on %s", shard, old_peer
+            )
             return handle, task
         return None
 
@@ -808,26 +964,43 @@ class Orchestrator:
     async def _notify_membership(
         self, ctx: _RunContext, joined: list[str] | None = None
     ) -> bool:
-        """Push the current membership snapshot to the PS; False on failure.
+        """Push the current membership snapshot to every PS shard; False
+        when ANY shard's push failed.
 
         Plain suspicion/departure updates tolerate a loss (the next update
         carries the full snapshot, and the PS epoch-gates stale ones), but
         a ``joined`` notification is load-bearing: it is the only message
-        that queues the rejoiner's catch-up, so its caller must check."""
-        assert ctx.membership is not None and ctx.ps_handle is not None
-        update = MembershipUpdate(
-            job_id=ctx.ps_job_id,
-            membership=ctx.membership.snapshot(),
-            joined=list(joined or []),
-        )
-        try:
-            await self.node.request(
-                ctx.ps_handle.peer_id, PROTOCOL_FT, update, timeout=10
+        that queues the rejoiner's catch-up — and a sharded job's rejoiner
+        needs one catch-up from EVERY shard, so its caller must check."""
+        assert ctx.membership is not None and ctx.ps_handles
+        ok = True
+        snapshot = ctx.membership.snapshot()
+        for k, handle in enumerate(ctx.ps_handles):
+            if handle is None:
+                # Shard mid-restart: a plain snapshot loss is repaired by
+                # the next (epoch-gated) update after re-dispatch, but a
+                # JOINED notification is load-bearing — this shard would
+                # never queue the rejoiner's catch-up and the rejoiner
+                # would wait on it forever. Report failure so the rejoin
+                # attempt rolls back and retries once the shard is back.
+                if joined:
+                    ok = False
+                continue
+            update = MembershipUpdate(
+                job_id=ctx.ps_job_ids[k],
+                membership=snapshot,
+                joined=list(joined or []),
             )
-        except RequestError as e:
-            log.warning("membership update to PS failed: %s", e)
-            return False
-        return True
+            try:
+                await self.node.request(
+                    handle.peer_id, PROTOCOL_FT, update, timeout=10
+                )
+            except RequestError as e:
+                log.warning(
+                    "membership update to PS shard %d failed: %s", k, e
+                )
+                ok = False
+        return ok
 
     async def _depart(self, ctx: _RunContext, peer: str, reason: str, add) -> None:
         """A train worker is gone: degrade the round set, maybe rejoin."""

@@ -40,13 +40,22 @@ class ProgressTracker:
 
     def __init__(
         self,
-        parameter_server: str,
+        parameter_server: "str | list[str]",
         update_target: int,
         update_epochs: int,
         stat_factory: Callable[[], RuntimeStatistic] = RunningMean,
         clock: Callable[[], float] = time.monotonic,
     ) -> None:
-        self.parameter_server = parameter_server
+        # Sharded parameter service: a list names every shard peer (any of
+        # them may report UPDATED); a plain string is the single-PS form.
+        # ``parameter_server`` stays the first peer for existing callers.
+        servers = (
+            [parameter_server]
+            if isinstance(parameter_server, str)
+            else list(parameter_server)
+        )
+        self.parameter_servers: list[str] = servers
+        self.parameter_server = servers[0] if servers else ""
         self.update_target = update_target  # avg_samples_between_updates
         self.update_epochs = update_epochs  # number of outer rounds
         self.counter = update_target  # samples left in the current round

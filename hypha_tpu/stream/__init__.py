@@ -33,12 +33,16 @@ bit-identical to the pre-streaming behavior).
 
 from __future__ import annotations
 
-from .partition import fragment_of, partition_names
+from .partition import fragment_of, partition_names, shard_names, shard_of
 from .sync import (
     SYNC_MODES,
     effective_fragments,
     fragment_due,
     merge_corrected,
+    next_owned_round,
+    placement_parts,
+    shard_owns_round,
+    shards_due_at,
 )
 
 __all__ = [
@@ -48,4 +52,10 @@ __all__ = [
     "fragment_due",
     "effective_fragments",
     "merge_corrected",
+    "shard_of",
+    "shard_names",
+    "placement_parts",
+    "shard_owns_round",
+    "shards_due_at",
+    "next_owned_round",
 ]

@@ -20,7 +20,7 @@ from __future__ import annotations
 
 from typing import Mapping
 
-__all__ = ["partition_names", "fragment_of"]
+__all__ = ["partition_names", "fragment_of", "shard_of", "shard_names"]
 
 
 def partition_names(
@@ -63,3 +63,40 @@ def fragment_of(
         for name in names:
             out[name] = idx
     return out
+
+
+def shard_of(fragment_id: int, num_shards: int) -> int:
+    """Owning PS shard of a fragment: fragments round-robin over shards.
+
+    The placement dimension of the sharded parameter service: as
+    deterministic as the partition itself (a pure function of the indices),
+    so the parameter-server shards, every worker, every reducer and every
+    rejoiner agree on ownership with no manifest exchange. With the
+    staggered stream schedule (fragment ``r mod F`` due at round ``r``)
+    round-robin also spreads consecutive rounds across shards, so the
+    pipelined broadcasts of adjacent rounds leave different shards' NICs.
+    """
+    if num_shards < 1:
+        raise ValueError(f"num_shards must be >= 1, got {num_shards}")
+    if fragment_id < 0:
+        raise ValueError(f"fragment_id must be >= 0, got {fragment_id}")
+    return fragment_id % num_shards
+
+
+def shard_names(
+    sizes: Mapping[str, int], fragments: int, num_shards: int, shard_id: int
+) -> tuple[str, ...]:
+    """All tensor names shard ``shard_id`` owns (its fragments' union)."""
+    if not 0 <= shard_id < num_shards:
+        raise ValueError(
+            f"shard_id {shard_id} out of range for {num_shards} shards"
+        )
+    parts = partition_names(sizes, fragments)
+    return tuple(
+        sorted(
+            name
+            for f, names in enumerate(parts)
+            if shard_of(f, num_shards) == shard_id
+            for name in names
+        )
+    )

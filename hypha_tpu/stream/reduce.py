@@ -1,0 +1,368 @@
+"""Tree-reduce: a group reducer pre-folds worker deltas before the shards.
+
+Sharding the parameter service (fragment-owned PS shards) scales the
+*aggregate* outer-sync bandwidth, but each shard still takes one push per
+worker per owned round — ingress fan-in grows linearly with the worker
+count. The classic fix is hierarchical reduction (tree/ring all-reduce):
+workers are deterministically grouped, one peer per group *pre-folds* its
+group's deltas into a single sample-weighted partial sum and ships that —
+cutting a shard's ingress from W pushes to roughly W/G partials (plus each
+reducer's own direct delta; a node cannot push to itself).
+
+Mechanics:
+
+  * group members route their delta pushes ``[reducer, shard]`` with ANY
+    failover (``TrainExecutorConfig.reduce_via``): a dead reducer degrades
+    the group to direct-to-shard pushes instead of wedging the round;
+  * the reducer (``reduce_members`` non-empty on its train spec) runs a
+    :class:`GroupReducer` next to its training executor: it consumes
+    pushes tagged with the job's per-shard updates tags, folds them with
+    the SAME :class:`~hypha_tpu.stream.accum.RoundAccum` arithmetic the
+    shards use (duplicate member re-sends un-fold the superseded delta
+    first), and forwards the partial stamped ``prefold`` + the summed
+    sample weight;
+  * a partial flushes when every expected member reported, and again
+    whenever a straggler or re-send lands later — each flush carries the
+    CUMULATIVE partial, so the shard's duplicate-replacement path
+    (un-fold the old partial, fold the new) keeps the round value-exact
+    no matter how the group's arrivals interleave with the deadline;
+  * members that never arrive are simply absent from the partial: the
+    weighted-mean algebra composes over any subset split between the
+    reducer and direct pushes, so quorum/deadline semantics at the shard
+    are unchanged.
+
+Quantized jobs re-encode the partial with the job's ``delta_codec`` and a
+per-part error-feedback residual — the partial stream per part is as much
+a time series as a worker's delta stream, so EF is unbiased for exactly
+the reason it is on the PS broadcast path.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import os
+import shutil
+import tempfile
+import uuid
+from pathlib import Path
+
+from .. import aio, compress
+from ..messages import PREFOLD_KEY, SHARD_KEY, FragmentTag
+from ..telemetry.ft_metrics import SHARD_METRICS
+from .accum import RoundAccum
+from .partition import shard_of
+
+__all__ = ["GroupReducer", "maybe_start_reducer", "REDUCE_FLUSH_ENV"]
+
+log = logging.getLogger("hypha.stream.reduce")
+
+# Seconds after a (round, part) bucket's first delta before an incomplete
+# partial is flushed anyway — a dead member must not park the group's
+# progress past the shard's own round deadline.
+REDUCE_FLUSH_ENV = "HYPHA_REDUCE_FLUSH_S"
+_FLUSH_DEFAULT = 5.0
+_TICK_S = 0.25
+
+
+def _flush_after() -> float:
+    try:
+        return float(os.environ.get(REDUCE_FLUSH_ENV, "") or _FLUSH_DEFAULT)
+    except ValueError:
+        return _FLUSH_DEFAULT
+
+
+def maybe_start_reducer(node, spec) -> "GroupReducer | None":
+    """Start a :class:`GroupReducer` next to a dispatched train job when
+    its spec names THIS worker as its group's reducer (non-empty
+    ``reduce_members`` + a placement map). Returns the started reducer, or
+    None for every other job — the worker runtimes call this on dispatch
+    and ``await reducer.stop()`` on job teardown.
+
+    Lives runtime-side (not in the training executor process): the
+    reducer consumes fabric pushes, and the node lives in the runtime.
+    """
+    cfg = getattr(getattr(spec, "executor", None), "train", None)
+    if cfg is None:
+        return None
+    members = getattr(cfg, "reduce_members", None)
+    shard_map = getattr(cfg, "ps_shards", None)
+    if not members or shard_map is None or not getattr(shard_map, "shards", None):
+        return None
+    reducer = GroupReducer(node, cfg)
+    reducer.start()
+    log.info(
+        "group reducer started: %d members, %d shard(s)",
+        len(members), len(shard_map.shards),
+    )
+    return reducer
+
+
+class _Bucket:
+    """One (round, part)'s group state on the reducer."""
+
+    def __init__(self) -> None:
+        self.accum = RoundAccum()
+        self.entries: dict[str, tuple[Path, float]] = {}  # peer -> file
+        self.first_at: float | None = None
+        self.flushed = 0  # partials shipped so far (re-flushes included)
+        self.dirty = False  # folds since the last flush
+
+
+class GroupReducer:
+    """Pre-fold this worker's group's deltas; forward partials per shard.
+
+    ``cfg`` is the reducer worker's own ``TrainExecutorConfig`` — it
+    carries the placement (``ps_shards``), the wire codec, and the group
+    members (``reduce_members``, the OTHER members whose pushes land
+    here). The reducer's own delta goes direct to the shard via its
+    training loop, so it is never expected in a bucket.
+    """
+
+    def __init__(self, node, cfg, work_dir: Path | str | None = None) -> None:
+        shard_map = cfg.ps_shards
+        if shard_map is None or not shard_map.shards:
+            raise ValueError("GroupReducer needs cfg.ps_shards placement")
+        self.node = node
+        self.cfg = cfg
+        self.members = set(cfg.reduce_members or [])
+        self.shards: list[str] = list(shard_map.shards)
+        self.tags: list[str] = list(shard_map.tags)
+        self.num_shards = len(self.shards)
+        self.parts = int(shard_map.fragments) or 1
+        self._own_dir = work_dir is None
+        self.work_dir = Path(
+            work_dir
+            if work_dir is not None
+            else tempfile.mkdtemp(prefix="hypha-reduce-")
+        )
+        self.codec = compress.effective_codec(
+            getattr(cfg, "delta_codec", "none"), getattr(cfg, "delta_dtype", "float32")
+        )
+        self._efs: dict[int, compress.ErrorFeedback | None] = {}
+        self._buckets: dict[tuple[int, int], _Bucket] = {}
+        self._flush_after = _flush_after()
+        self._task: asyncio.Task | None = None
+        self._consumer = None
+        # test/bench hooks
+        self.folds = 0
+        self.unfolds = 0
+        self.partials = 0
+
+    # ---------------------------------------------------------- lifecycle
+
+    def start(self) -> None:
+        tags = set(self.tags)
+        members = set(self.members)
+
+        def wants(push) -> bool:
+            # Tag AND sender: a small mesh can colocate this reducer with
+            # a PS shard executor on one node (peer reuse), and consumers
+            # route first-match — matching by tag alone would steal (and
+            # drop) direct-to-shard deltas from workers outside the group.
+            r = push.resource
+            return (
+                isinstance(r, dict)
+                and r.get("resource") in tags
+                and push.peer in members
+            )
+
+        self.work_dir.mkdir(parents=True, exist_ok=True)
+        self._consumer = self.node.consume_pushes(wants)
+        self._task = aio.spawn(
+            self._run(), what="group reducer", logger=log
+        )
+
+    async def stop(self) -> None:
+        if self._task is not None:
+            await aio.reap(self._task)
+            self._task = None
+        if self._consumer is not None:
+            self._consumer.close()
+            self._consumer = None
+        if self._own_dir:
+            await asyncio.to_thread(
+                shutil.rmtree, self.work_dir, ignore_errors=True
+            )
+
+    # --------------------------------------------------------------- loop
+
+    async def _run(self) -> None:
+        assert self._consumer is not None
+        while True:
+            try:
+                push = await self._consumer.next(timeout=_TICK_S)
+            except asyncio.TimeoutError:
+                await self._flush_due()
+                continue
+            await self._ingest(push)
+            await self._flush_due()
+
+    async def _ingest(self, push) -> None:
+        peer = push.peer
+        meta = push.resource if isinstance(push.resource, dict) else {}
+        if peer not in self.members:
+            # Not ours to fold (mis-routed, or a peer outside the group):
+            # drain so the sender's accept slot is released.
+            log.warning("reducer: push from non-member %s dropped", peer)
+            await push.read_all()
+            return
+        try:
+            round_num = int(meta.get("round", 0))
+        except (TypeError, ValueError):
+            round_num = 0
+        part = self._part_of(meta)
+        if part is None:
+            log.warning("reducer: untagged push from %s dropped", peer)
+            await push.read_all()
+            return
+        dest = self.work_dir / f"in-{round_num}-{part}-{uuid.uuid4().hex[:8]}"
+        await push.save_to(dest)
+        try:
+            samples = float(meta.get("num_samples", 1.0))
+        except (TypeError, ValueError):
+            samples = 1.0
+        bucket = self._buckets.setdefault((round_num, part), _Bucket())
+        if bucket.first_at is None:
+            bucket.first_at = asyncio.get_running_loop().time()
+        old = bucket.entries.pop(peer, None)
+        if old is not None:
+            # Duplicate re-send: un-fold the superseded delta while its
+            # file still holds the original bytes, exactly like the shard
+            # does — the next flush ships the corrected cumulative sum.
+            log.warning(
+                "reducer: duplicate delta from %s (round %d part %d); "
+                "replacing", peer, round_num, part,
+            )
+            await asyncio.to_thread(
+                bucket.accum.fold, old[0], old[1], -1.0
+            )
+            self.unfolds += 1
+            old[0].unlink(missing_ok=True)
+        await asyncio.to_thread(bucket.accum.fold, dest, samples)
+        self.folds += 1
+        SHARD_METRICS.reduced_deltas.add(1)
+        bucket.entries[peer] = (dest, samples)
+        bucket.dirty = True
+
+    def _part_of(self, meta: dict) -> int | None:
+        tag = FragmentTag.from_header(meta)
+        if tag is not None:
+            return int(tag.fragment_id)
+        if SHARD_KEY in meta:
+            # Blocking/overlap sharded pushes carry the target shard, and
+            # part k is owned by shard k by construction (shard_of is the
+            # identity when parts == num_shards).
+            try:
+                return int(meta[SHARD_KEY])
+            except (TypeError, ValueError):
+                return None
+        resource = meta.get("resource")
+        if resource in self.tags:
+            return self.tags.index(resource)
+        return None
+
+    async def _flush_due(self) -> None:
+        now = asyncio.get_running_loop().time()
+        for (round_num, part), bucket in list(self._buckets.items()):
+            if not bucket.dirty:
+                continue
+            complete = set(bucket.entries) >= self.members
+            overdue = (
+                bucket.first_at is not None
+                and now - bucket.first_at >= self._flush_after
+            )
+            if complete or overdue or bucket.flushed:
+                # bucket.flushed: a straggler landing after a deadline
+                # flush re-ships the cumulative partial immediately — the
+                # shard replaces the previous one, no second wait.
+                await self._flush(round_num, part, bucket)
+
+    async def _flush(self, round_num: int, part: int, bucket: _Bucket) -> None:
+        owner = shard_of(part, self.num_shards)
+        tag_header = None
+        if self.parts > 1 or getattr(self.cfg, "sync_mode", "blocking") == "stream":
+            tag_header = FragmentTag(
+                round=round_num, fragment_id=part, fragments=self.parts
+            ).header()
+        if part not in self._efs:
+            self._efs[part] = (
+                compress.ErrorFeedback()
+                if self.codec in compress.QUANT_CODECS
+                else None
+            )
+        wire = self.work_dir / (
+            f"partial-{round_num}-{part}-{bucket.flushed}.safetensors"
+        )
+
+        def encode() -> None:
+            partial = bucket.accum.partial()
+            if self.codec == "none":
+                from safetensors.numpy import save_file
+
+                save_file(partial, str(wire))
+            else:
+                compress.write_delta(
+                    wire, partial, self.codec, ef=self._efs[part],
+                    tag=tag_header,
+                )
+
+        await asyncio.to_thread(encode)
+        header: dict = {
+            "resource": self.tags[owner],
+            "name": wire.name,
+            "round": round_num,
+            "num_samples": float(bucket.accum.total_samples),
+            PREFOLD_KEY: True,
+            # The worker peers this partial represents: the shard's close
+            # condition counts covered WORKERS, not accepted files.
+            "covers": sorted(bucket.entries),
+        }
+        if tag_header:
+            header.update(tag_header)
+        if self.num_shards > 1:
+            header[SHARD_KEY] = owner
+        peer = self.shards[owner]
+        from ..network.node import RequestError
+        from ..worker.connectors import push_timeout
+
+        try:
+            await aio.retry(
+                lambda: self.node.push(peer, header, wire),
+                attempts=3, base_delay=0.25,
+                attempt_timeout=push_timeout(wire),
+                retry_on=(RequestError, OSError),
+                what=f"reduce partial to {peer}", logger=log,
+            )
+        except (RequestError, OSError, asyncio.TimeoutError) as e:
+            # Tolerated: the members' ANY failover (and the shard's
+            # quorum/deadline) own liveness; the reducer re-tries on the
+            # next dirty flush.
+            log.warning(
+                "reducer: partial push r%d part %d to %s failed: %s",
+                round_num, part, peer, e,
+            )
+            wire.unlink(missing_ok=True)
+            return
+        bucket.flushed += 1
+        bucket.dirty = False
+        self.partials += 1
+        wire.unlink(missing_ok=True)
+        log.info(
+            "reducer: shipped partial r%d part %d -> shard %d "
+            "(%d members, weight %.1f)",
+            round_num, part, owner, len(bucket.entries),
+            bucket.accum.total_samples,
+        )
+        self._gc(round_num, part)
+
+    def _gc(self, round_num: int, part: int) -> None:
+        """Retire buckets for older rounds of the same part: workers ship
+        a part's round r+1 only after merging round r, so anything older
+        can no longer receive a late member delta worth folding."""
+        for key in [
+            k for k in self._buckets if k[1] == part and k[0] < round_num
+        ]:
+            for path, _ in self._buckets[key].entries.values():
+                path.unlink(missing_ok=True)
+            del self._buckets[key]

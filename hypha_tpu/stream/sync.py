@@ -34,6 +34,10 @@ __all__ = [
     "DEFAULT_FRAGMENTS",
     "fragment_due",
     "effective_fragments",
+    "placement_parts",
+    "shard_owns_round",
+    "shards_due_at",
+    "next_owned_round",
     "merge_corrected",
 ]
 
@@ -75,6 +79,91 @@ def effective_fragments(sync_mode: str, fragments: int = 0) -> int:
     if fragments < 0:
         raise ValueError(f"fragments must be >= 0, got {fragments}")
     return int(fragments) or DEFAULT_FRAGMENTS
+
+
+def placement_parts(
+    sync_mode: str, fragments: int = 0, num_shards: int = 1
+) -> int:
+    """How many placement parts the parameter tree splits into.
+
+    The unit of shard ownership (hypha_tpu.stream.partition.shard_of):
+
+      * ``stream``           — the F staggered fragments, exactly as before
+        (fragment ``r mod F`` due at round ``r``, owned by shard
+        ``f mod N``);
+      * ``blocking/overlap`` — with N > 1 shards the whole tree still syncs
+        EVERY round, but as N sub-deltas: one part per shard, all due each
+        round. N == 1 keeps the single whole-tree part (the seed path).
+    """
+    if num_shards < 1:
+        raise ValueError(f"num_shards must be >= 1, got {num_shards}")
+    if num_shards == 1 or sync_mode == "stream":
+        return effective_fragments(sync_mode, fragments)
+    if sync_mode not in SYNC_MODES:
+        raise ValueError(
+            f"sync_mode must be {'|'.join(SYNC_MODES)}, got {sync_mode!r}"
+        )
+    return num_shards
+
+
+def shard_owns_round(
+    sync_mode: str,
+    round_num: int,
+    fragments: int,
+    num_shards: int,
+    shard_id: int,
+) -> bool:
+    """Does shard ``shard_id`` aggregate anything at round ``round_num``?
+
+    In blocking/overlap every shard owns a part of EVERY round; in stream
+    mode only the due fragment's owner closes the round — the other shards
+    skip it entirely (their own fragments come due on their own rounds).
+    """
+    if num_shards <= 1 or sync_mode != "stream":
+        return True
+    from .partition import shard_of
+
+    return shard_of(fragment_due(round_num, fragments), num_shards) == shard_id
+
+
+def shards_due_at(
+    sync_mode: str, round_num: int, fragments: int, num_shards: int
+) -> tuple[int, ...]:
+    """The PS shards that close round ``round_num`` (the scheduler's round
+    gate: UPDATED from every due shard advances the round).
+
+    Stream mode: exactly one — the due fragment's owner. Blocking with
+    N > 1 shards: all of them, each closing its own part-delta. N == 1:
+    the single pre-shard PS.
+    """
+    if num_shards <= 1:
+        return (0,)
+    if sync_mode == "stream":
+        from .partition import shard_of
+
+        return (shard_of(fragment_due(round_num, fragments), num_shards),)
+    return tuple(range(num_shards))
+
+
+def next_owned_round(
+    sync_mode: str,
+    from_round: int,
+    fragments: int,
+    num_shards: int,
+    shard_id: int,
+) -> int:
+    """The first round >= ``from_round`` that ``shard_id`` aggregates.
+
+    Bounded: the stream schedule cycles every ``fragments`` rounds and
+    round-robin placement gives every shard at least one fragment when
+    ``fragments >= num_shards`` (validated at job construction)."""
+    for r in range(from_round, from_round + max(fragments, 1)):
+        if shard_owns_round(sync_mode, r, fragments, num_shards, shard_id):
+            return r
+    raise ValueError(
+        f"shard {shard_id} owns no round in a cycle of {fragments} fragments "
+        f"over {num_shards} shards"
+    )
 
 
 def merge_corrected(

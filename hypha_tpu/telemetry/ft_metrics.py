@@ -22,6 +22,8 @@ __all__ = [
     "FT_METRICS",
     "StreamMetrics",
     "STREAM_METRICS",
+    "ShardMetrics",
+    "SHARD_METRICS",
     "register_on",
 ]
 
@@ -182,10 +184,49 @@ class StreamMetrics:
 STREAM_METRICS = StreamMetrics()
 
 
+class ShardMetrics:
+    """Sharded parameter-service instruments (hypha_tpu.stream placement).
+
+    * ``shard_rounds_closed``  — rounds this process closed as a PS shard
+      (each shard closes only its owned rounds; on a worker node running
+      several shard executors in tests the counter is their sum).
+    * ``prefold_partials``     — tree-reduce partial sums accepted by the
+      shard collectors (``PREFOLD_KEY`` pushes).
+    * ``misrouted_pushes``     — deltas that arrived at a shard which does
+      not own their round's fragment (a worker with a stale/mismatched
+      placement map); dropped, never folded.
+    * ``reduced_deltas``       — member deltas folded by group reducers on
+      this node before anything reached a shard (the ingress the
+      tree-reduce layer saved).
+    """
+
+    def __init__(self) -> None:
+        self.shard_rounds_closed = Counter("hypha.shard.rounds_closed")
+        self.prefold_partials = Counter("hypha.shard.prefold_partials")
+        self.misrouted_pushes = Counter("hypha.shard.misrouted_pushes")
+        self.reduced_deltas = Counter("hypha.shard.reduced_deltas")
+
+    def snapshot(self) -> dict:
+        return {
+            "shard_rounds_closed": self.shard_rounds_closed.value(),
+            "prefold_partials": self.prefold_partials.value(),
+            "misrouted_pushes": self.misrouted_pushes.value(),
+            "reduced_deltas": self.reduced_deltas.value(),
+        }
+
+    def reset(self) -> None:
+        """Fresh instruments (tests and shardbench isolate runs this way)."""
+        self.__init__()
+
+
+SHARD_METRICS = ShardMetrics()
+
+
 def register_on(
     meter: Meter,
     metrics: FTMetrics = FT_METRICS,
     stream: StreamMetrics = STREAM_METRICS,
+    shard: ShardMetrics = SHARD_METRICS,
 ) -> None:
     """Export the bundles through a Meter as observable gauges."""
     meter.observable_gauge(
@@ -217,6 +258,18 @@ def register_on(
     )
     meter.observable_gauge(
         "hypha.stream.synced_fragments", stream.synced_fragments.value
+    )
+    meter.observable_gauge(
+        "hypha.shard.rounds_closed", shard.shard_rounds_closed.value
+    )
+    meter.observable_gauge(
+        "hypha.shard.prefold_partials", shard.prefold_partials.value
+    )
+    meter.observable_gauge(
+        "hypha.shard.misrouted_pushes", shard.misrouted_pushes.value
+    )
+    meter.observable_gauge(
+        "hypha.shard.reduced_deltas", shard.reduced_deltas.value
     )
     # Per-fragment close counters attach lazily — fragment ids only exist
     # once the PS closes their first round.

@@ -37,11 +37,12 @@ from ..messages import (
     Receive,
     Reference,
     Send,
+    ShardMap,
     TransferStrategy,
 )
 from ..network.node import Node, PushStream, RequestError
 
-__all__ = ["Connector", "ReceivedFile", "fetch_uri"]
+__all__ = ["Connector", "ReceivedFile", "fetch_uri", "shard_route"]
 
 log = logging.getLogger("hypha.worker.connector")
 
@@ -88,6 +89,42 @@ def push_timeout(path: Path, base: float = 60.0) -> float:
     except OSError:
         size = 0
     return base + size / (10 * 1024 * 1024)
+
+
+def shard_route(
+    shard_map: ShardMap, part: int, reduce_via: str | None = None
+) -> tuple[Send, int, str]:
+    """The Send reference for one placement part's delta push.
+
+    Sharded parameter service (hypha_tpu.stream placement): part ``p`` is
+    owned by shard ``shard_of(p, N)`` and must land on that shard's peer
+    under that shard's updates tag — every peer derives the same owner
+    from the same deterministic partition, so no manifest is exchanged.
+
+    Returns ``(send, owner_shard, tag)``. With tree-reduce, the group's
+    reducer peer is tried FIRST with ANY failover: a dead reducer degrades
+    this worker to direct-to-shard pushes instead of wedging the round
+    (the shard accepts both forms — a pre-folded partial and the raw
+    delta — and reconciles any at-least-once overlap by cover sets; see
+    ParameterServerExecutor._direct_covered/_retire_covered).
+    """
+    from ..stream.partition import shard_of
+
+    if not shard_map.shards:
+        raise ValueError("shard_route needs a populated ShardMap")
+    owner = shard_of(part, len(shard_map.shards))
+    owner_peer = shard_map.shards[owner]
+    tag = (
+        shard_map.tags[owner]
+        if shard_map.tags
+        else "updates"
+    )
+    peers = [owner_peer]
+    strategy = TransferStrategy.ALL
+    if reduce_via and reduce_via != owner_peer:
+        peers = [reduce_via, owner_peer]
+        strategy = TransferStrategy.ANY
+    return Send(Reference.from_peers(peers, tag, strategy)), owner, tag
 
 
 class ReceivedFile:

@@ -42,6 +42,7 @@ class PoolServer:
         max_len: int,
         steps_per_call: int = 8,
         eos_token_id: int | None = None,
+        fallback_concurrency: int = 2,
     ) -> None:
         self.pool = DecodePool(
             model,
@@ -52,6 +53,12 @@ class PoolServer:
             eos_token_id=eos_token_id,
         )
         self._run_fallback = run_fallback
+        # Bounded one-shot decode concurrency: each distinct fallback shape
+        # compiles its own program, so a burst of oversized/sampled
+        # requests would otherwise pile unbounded device decodes AND
+        # per-shape compiles behind the pool's chunks. The pool path is
+        # never gated by this — only the fallbacks queue.
+        self._fallback_sem = asyncio.Semaphore(max(int(fallback_concurrency), 1))
         self._closed = False
         # stats, read by tests and the serving bench (names mirror
         # RequestBatcher where the meaning carries over)
@@ -82,9 +89,10 @@ class PoolServer:
         # the window batcher served any prompt up to the model limit, and
         # pooling must not regress that.
         self.fallbacks += 1
-        return await asyncio.to_thread(
-            self._run_fallback, prompts, n_new, temperature, top_k, seed
-        )
+        async with self._fallback_sem:
+            return await asyncio.to_thread(
+                self._run_fallback, prompts, n_new, temperature, top_k, seed
+            )
 
     def close(self) -> None:
         # wait=False: called from the job's async cancel path — the serve

@@ -73,7 +73,16 @@ class ProcessExecutor(JobExecutor):
             cwd=str(work_dir),
         )
         log.info("job %s: spawned pid %s: %s", job_id, proc.pid, argv[:2])
-        execution = _ProcessExecution(job_id, proc, bridge, work_dir, self.keep_work_dir)
+        # Tree-reduce (hypha_tpu.stream.reduce): the reducer consumes
+        # fabric pushes, so it lives HERE in the runtime, not in the
+        # spawned executor process.
+        from ..stream.reduce import maybe_start_reducer
+
+        reducer = maybe_start_reducer(self.node, spec)
+        execution = _ProcessExecution(
+            job_id, proc, bridge, work_dir, self.keep_work_dir,
+            reducer=reducer,
+        )
         execution.start_supervision()
         return execution
 
@@ -100,12 +109,14 @@ class _ProcessExecution(Execution):
         bridge: Bridge,
         work_dir: Path,
         keep_work_dir: bool,
+        reducer=None,
     ) -> None:
         super().__init__(job_id)
         self.proc = proc
         self.bridge = bridge
         self.work_dir = work_dir
         self.keep_work_dir = keep_work_dir
+        self.reducer = reducer
         self._cancelled = False
         self._tasks: list[asyncio.Task] = []
 
@@ -128,6 +139,8 @@ class _ProcessExecution(Execution):
 
     async def _supervise(self) -> None:
         rc = await self.proc.wait()
+        if self.reducer is not None:
+            await self.reducer.stop()
         await self.bridge.stop()
         if not self.keep_work_dir:
             await asyncio.to_thread(  # process.rs:191-192

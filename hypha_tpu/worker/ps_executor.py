@@ -30,7 +30,18 @@ crates/worker/src/config.rs:135-141). It:
     catch-up Σ, EF residuals, round counter, epoch) checkpointed — a PS
     restart replays the journal, re-announces itself under a bumped
     generation id, and resumes the interrupted round instead of killing
-    the job.
+    the job;
+  * can run as **one shard of N** (``AggregateExecutorConfig.shard_index``
+    / ``num_ps_shards``, hypha_tpu.stream placement): the executor then
+    owns a disjoint part of the parameter tree — in stream mode the
+    fragments ``f`` with ``shard_of(f, N) == shard_index`` (it aggregates
+    only the rounds whose due fragment it owns and skips the rest), in
+    blocking mode the fixed part ``shard_index`` of every round — with its
+    own journal, checkpoint, generation id and catch-up buffer, so
+    aggregate outer-sync bandwidth scales with the shard count instead of
+    one peer's NIC. Tree-reduce partials (``PREFOLD_KEY`` pushes from
+    hypha_tpu.stream.reduce) fold verbatim and count the workers they
+    ``covers`` toward the round's close.
 
 Tensor math runs on the C++ kernels (hypha_tpu.native) with numpy fallback;
 on TPU deployments the same step can run as the jitted tree-op in
@@ -57,7 +68,9 @@ from ..ft.durable import GENERATION_KEY, RESYNC_KEY, DurablePS, FoldRecord
 from ..ft.membership import PROTOCOL_FT, MembershipUpdate, RoundMembership, quorum_size
 from ..ft.rejoin import CATCHUP_KEY, CatchupBuffer
 from ..messages import (
+    PREFOLD_KEY,
     PROTOCOL_PROGRESS,
+    SHARD_KEY,
     Ack,
     FragmentTag,
     JobSpec,
@@ -69,8 +82,15 @@ from ..messages import (
 )
 from ..network.node import Node, RequestError
 from .connectors import push_timeout
-from ..stream import effective_fragments, fragment_due
-from ..telemetry.ft_metrics import FT_METRICS, STREAM_METRICS
+from ..stream import (
+    effective_fragments,
+    fragment_due,
+    next_owned_round,
+    placement_parts,
+    shard_owns_round,
+)
+from ..stream.accum import RoundAccum
+from ..telemetry.ft_metrics import FT_METRICS, SHARD_METRICS, STREAM_METRICS
 from .job_manager import Execution, JobExecutor
 
 __all__ = ["ParameterServerExecutor"]
@@ -99,51 +119,17 @@ def _file_sha(path: Path) -> str:
     return h.hexdigest()
 
 
-class _RoundAccum:
-    """Streaming sample-weighted fold of one round's delta files.
+# The streaming fold/un-fold accumulator moved to hypha_tpu.stream.accum so
+# the tree-reduce group reducer shares the exact arithmetic (its partial sum
+# must be bit-equal to what the shard would have folded itself). The private
+# alias keeps existing imports/tests working.
+_RoundAccum = RoundAccum
 
-    Holds ONE param-sized f32 tree (Σ samples·Δθ) instead of every
-    worker's decoded delta: ``fold`` runs as each push lands (off the
-    event loop via ``asyncio.to_thread``), ``fold(…, sign=-1)`` un-folds a
-    replaced duplicate, and :meth:`mean` finishes the weighted mean when
-    quorum closes — leaving only the Nesterov step on the critical path.
-    """
-
-    def __init__(self) -> None:
-        self._acc: dict[str, np.ndarray] = {}
-        self._shapes: dict[str, tuple] = {}
-        self.total_samples = 0.0
-        self.folds = 0
-
-    def fold(self, path: Path, samples: float, sign: float = 1.0) -> None:
-        tree = compress.read_delta(path)
-        if self._shapes:
-            if set(tree) != set(self._shapes):
-                raise ValueError("workers sent deltas with mismatched keys")
-        for key, value in tree.items():
-            arr = np.asarray(value, np.float32)
-            shape = self._shapes.get(key)
-            if shape is None:
-                self._shapes[key] = arr.shape
-            elif arr.shape != shape:
-                raise ValueError(
-                    f"delta {key!r}: mismatched shape {arr.shape} vs {shape}"
-                )
-            contrib = np.float32(sign * samples) * arr
-            prev = self._acc.get(key)
-            if prev is None:
-                self._acc[key] = contrib
-            else:
-                prev += contrib
-        self.total_samples += sign * samples
-        self.folds += 1 if sign > 0 else -1
-
-    def mean(self) -> dict[str, np.ndarray]:
-        """The sample-weighted mean ḡ = Σ samples·Δθ / Σ samples (f32)."""
-        if not self._acc:
-            raise ValueError("no deltas folded")
-        denom = np.float32(max(self.total_samples, 1e-20))
-        return {k: v / denom for k, v in self._acc.items()}
+# A tree-reduce partial's entry in the round's received table is keyed
+# separately from the reducer's OWN direct delta (same sending peer, two
+# distinct contributions — peer-keying alone would make one replace the
+# other).
+_PREFOLD_PREFIX = "prefold:"
 
 
 class _ElasticState:
@@ -165,10 +151,17 @@ class _ElasticState:
         self.pending_joins: dict[str, int] = {}
         # early deltas: round -> peer -> (path, samples)
         self.early: dict[int, dict[str, tuple[Path, float]]] = {}
+        # tree-reduce cover info for early entries: round -> entry key ->
+        # (prefolded, covered worker peers)
+        self.early_covers: dict[int, dict[str, tuple[bool, frozenset]]] = {}
         # Durable-state root when the job checkpoints (ft.durable); the
         # catch-up push stamps its generation so rejoiners share the
         # restart-detection protocol.
         self.dur: "DurablePS | None" = None
+        # Sharded parameter service: stamped into catch-up headers so a
+        # rejoiner can tell the N per-shard catch-ups apart.
+        self.shard = 0
+        self.num_shards = 1
 
     def quorum(self) -> int:
         return quorum_size(self.quorum_fraction, len(self.membership.active))
@@ -218,6 +211,25 @@ class ParameterServerExecutor(JobExecutor):
             return
         elastic = _ElasticState(cfg, scheduler_peer) if cfg.quorum_fraction > 0 else None
         lr, mu = cfg.optimizer.lr, cfg.optimizer.momentum
+        sync_mode = getattr(cfg, "sync_mode", "blocking") or "blocking"
+        # Sharded parameter service (hypha_tpu.stream placement): this
+        # executor may be one shard of N, owning a disjoint set of
+        # placement parts. ``parts`` is the total part count every peer
+        # derives (stream fragments, or N blocking sub-deltas); N == 1
+        # keeps the exact pre-shard value of effective_fragments.
+        num_shards = max(int(getattr(cfg, "num_ps_shards", 1) or 1), 1)
+        shard = int(getattr(cfg, "shard_index", 0) or 0)
+        sharded = num_shards > 1
+        parts = placement_parts(
+            sync_mode, getattr(cfg, "fragments", 0), num_shards
+        )
+        # A stream shard aggregates only the rounds whose due fragment it
+        # owns; its journal legitimately skips the others (the durable
+        # resume contiguity check consults this).
+        owned = None
+        if sharded and sync_mode == "stream":
+            def owned(r, _p=parts, _n=num_shards, _s=shard):
+                return shard_owns_round("stream", r, _p, _n, _s)
         # Momentum lives as a SafeTensors FILE (like the reference,
         # parameter_server.rs:392-397) so the native C++ outer step can mmap
         # it; the checkpoint dir keeps a copy across PS restarts (net-new).
@@ -230,10 +242,19 @@ class ParameterServerExecutor(JobExecutor):
         try:
             if ckpt_dir is not None:
                 dur = await asyncio.to_thread(
-                    DurablePS.open,
-                    ckpt_dir,
-                    job_id,
-                    max(int(getattr(cfg, "ps_checkpoint_every_rounds", 1) or 1), 1),
+                    lambda: DurablePS.open(
+                        ckpt_dir,
+                        job_id,
+                        max(
+                            int(
+                                getattr(
+                                    cfg, "ps_checkpoint_every_rounds", 1
+                                ) or 1
+                            ),
+                            1,
+                        ),
+                        owned=owned,
+                    )
                 )
             if ckpt_dir is not None and (dur is None or dur.resume is None):
                 # Cross-attempt warm start (a full job restart runs under a
@@ -302,12 +323,11 @@ class ParameterServerExecutor(JobExecutor):
             if bcast_codec in compress.QUANT_CODECS
             else None
         )
-        sync_mode = getattr(cfg, "sync_mode", "blocking") or "blocking"
         if elastic is not None:
             elastic.dur = dur
-        stream_fragments = effective_fragments(
-            sync_mode, getattr(cfg, "fragments", 0)
-        )
+            elastic.shard = shard
+            elastic.num_shards = num_shards
+        stream_fragments = parts
         try:
             # Crash recovery (ft.durable): restore the outer-state
             # checkpoint, replay committed rounds from the journal, re-send
@@ -322,8 +342,9 @@ class ParameterServerExecutor(JobExecutor):
                 ) = await self._recover(
                     dur, job_id, cfg, scheduler_peer, work_dir,
                     momentum_file, elastic, lr, mu, bcast_codec,
-                    stream=(sync_mode != "blocking"),
+                    stream=(sync_mode != "blocking") or sharded,
                     fragments=stream_fragments,
+                    shard=shard, num_shards=num_shards,
                 )
                 if bcast_ef is not None and 0 in rec_efs:
                     bcast_ef = rec_efs[0]
@@ -332,10 +353,13 @@ class ParameterServerExecutor(JobExecutor):
             if recovery_done:
                 execution.finish("completed")
                 return
-            if sync_mode != "blocking":
+            if sync_mode != "blocking" or sharded:
                 # Streaming outer sync (hypha_tpu.stream): per-fragment
-                # round accumulators, pipelined broadcast fan-out. The
-                # blocking loop below stays byte-identical for the default.
+                # round accumulators, pipelined broadcast fan-out. A
+                # sharded blocking job ALSO runs this loop (its parts are
+                # tagged sub-deltas, the due part is fixed at shard_index);
+                # the blocking loop below stays byte-identical for the
+                # unsharded default.
                 await self._stream_rounds(
                     execution, job_id, cfg, scheduler_peer, work_dir,
                     consumer, elastic, allowed, num_workers,
@@ -344,6 +368,8 @@ class ParameterServerExecutor(JobExecutor):
                     dur=dur, round_start=round_num,
                     init_accums=recovered_accums, init_pending=preload,
                     init_efs=rec_efs,
+                    shard=shard, num_shards=num_shards,
+                    sync_mode=sync_mode,
                 )
                 return
             while True:
@@ -484,6 +510,8 @@ class ParameterServerExecutor(JobExecutor):
         *,
         stream: bool,
         fragments: int,
+        shard: int = 0,
+        num_shards: int = 1,
     ) -> tuple:
         """Resume this job from its durable state after a PS restart.
 
@@ -534,7 +562,8 @@ class ParameterServerExecutor(JobExecutor):
             accum = _RoundAccum()
             for fold, sign in dur.replay_ops(rnd):
                 await asyncio.to_thread(
-                    accum.fold, dur.deltas_dir / fold.file, fold.samples, sign
+                    accum.fold, dur.deltas_dir / fold.file, fold.samples,
+                    sign, fold.prefold,
                 )
             update_path = await asyncio.to_thread(
                 self._outer_step,
@@ -582,7 +611,7 @@ class ParameterServerExecutor(JobExecutor):
             notified = resume.notified.get(last_round)
             if notified is None:
                 response = await self._notify_updated(
-                    scheduler_peer, job_id, last_round
+                    scheduler_peer, job_id, last_round, shard=shard
                 )
                 done = response.kind == ProgressResponseKind.DONE
                 await asyncio.to_thread(dur.note_notified, last_round, done)
@@ -593,14 +622,22 @@ class ParameterServerExecutor(JobExecutor):
         # delta (journal dedup absorbs the copies that did land). The
         # re-broadcasts below carry the generation too, but a crash before
         # the first commit has no broadcast to carry it on.
+        resync_extra: dict = {GENERATION_KEY: dur.generation, RESYNC_KEY: True}
+        if num_shards > 1:
+            # Per-shard generation handshake: workers track one generation
+            # PER shard, so the announcement must say which shard restarted
+            # (re-sending every part on one shard's bump would spam the
+            # healthy shards with re-sends their journals then dedup).
+            resync_extra[SHARD_KEY] = shard
         resync = work_dir / "resync.bin"
         await asyncio.to_thread(resync.write_bytes, b"")
         await self._broadcast(
-            cfg, resync, round_num, elastic,
-            extra_header={GENERATION_KEY: dur.generation, RESYNC_KEY: True},
+            cfg, resync, round_num, elastic, extra_header=resync_extra
         )
         for rnd, frag, path in dur.last_wires():
             extra: dict = {GENERATION_KEY: dur.generation}
+            if num_shards > 1:
+                extra[SHARD_KEY] = shard
             if stream:
                 extra.update(
                     FragmentTag(
@@ -622,7 +659,7 @@ class ParameterServerExecutor(JobExecutor):
                 for fold, sign in dur.replay_ops(rnd):
                     await asyncio.to_thread(
                         accum.fold, dur.deltas_dir / fold.file, fold.samples,
-                        sign,
+                        sign, fold.prefold,
                     )
         if elastic is not None and not stream:
             # The elastic collector folds early-parked entries itself when
@@ -641,6 +678,8 @@ class ParameterServerExecutor(JobExecutor):
         peer: str,
         entry: tuple[Path, float],
         sha: "str | None" = None,
+        prefold: bool = False,
+        covers=(),
     ) -> bool:
         """Journal one accepted delta; False = exact re-send, skip the fold.
 
@@ -664,9 +703,82 @@ class ParameterServerExecutor(JobExecutor):
             FoldRecord(
                 round=round_num, fragment=fragment, peer=peer,
                 samples=samples, sha=sha, file=path.name,
+                prefold=prefold, covers=list(covers),
             ),
         )
         return True
+
+    @staticmethod
+    def _push_cover(meta, peer: str) -> tuple[bool, frozenset]:
+        """(prefolded, covered workers) of one push.
+
+        A tree-reduce partial (``PREFOLD_KEY``) covers the group members
+        listed in its ``covers`` header — the round's close condition
+        counts covered WORKERS, not accepted files. A direct delta covers
+        its sender. An unlabeled prefold defensively covers nothing extra
+        beyond crediting the file (empty set keeps liveness: the members
+        it silently contains will re-send and dedup/replace)."""
+        if isinstance(meta, dict) and meta.get(PREFOLD_KEY):
+            return True, frozenset(
+                str(p) for p in (meta.get("covers") or [])
+            )
+        return False, frozenset((peer,))
+
+    @staticmethod
+    def _covered(
+        received, covers: dict[str, tuple[bool, frozenset]]
+    ) -> set:
+        """Union of worker peers the round's accepted entries represent."""
+        out: set = set()
+        for key in received:
+            _, cov = covers.get(key, (False, frozenset((key,))))
+            out |= cov
+        return out
+
+    @staticmethod
+    def _entry_key(prefolded: bool, peer: str) -> str:
+        """Received-table key: a reducer's forwarded partial must not
+        collide with the reducer's OWN direct delta."""
+        return f"{_PREFOLD_PREFIX}{peer}" if prefolded else peer
+
+    @staticmethod
+    def _direct_covered(covers, peer: str) -> bool:
+        """Is a direct delta from ``peer`` already represented by an
+        accepted tree-reduce partial?
+
+        The ANY-failover wire is at-least-once: a member's push can time
+        out against its reducer (yet be delivered), fail over to the
+        shard, AND arrive inside the reducer's partial. The journal's
+        (round, fragment, peer, sha) dedup cannot see this overlap — the
+        partial is journaled under the REDUCER's key with different bytes
+        — so the cover sets are the reconciliation: a covered direct
+        arrival is dropped, never folded or journaled (replay stays
+        consistent for free)."""
+        return any(p and peer in c for p, c in covers.values())
+
+    async def _retire_covered(
+        self, job_id: str, accum, bucket, covers, cov, durable: bool
+    ) -> None:
+        """The mirror overlap: a partial arriving AFTER its members'
+        failed-over direct deltas supersedes them — its cumulative sum
+        already contains their contributions, so the direct entries are
+        un-folded and retired (sorted member order; recovery's
+        ``replay_ops`` re-derives exactly these un-folds from the
+        journaled partial's ``covers``, keeping the replay bit-exact).
+        Durable files stay on disk for that replay (checkpoint GC)."""
+        for member in sorted(cov):
+            info = covers.get(member)
+            if member not in bucket or (info is not None and info[0]):
+                continue  # absent, or a partial (groups are disjoint)
+            log.warning(
+                "ps %s: delta from %s superseded by a tree-reduce partial "
+                "covering it; un-folding", job_id, member,
+            )
+            old = bucket.pop(member)
+            covers.pop(member, None)
+            await self._fold(accum, old, sign=-1.0, prefolded=False)
+            if not durable:
+                old[0].unlink(missing_ok=True)
 
     @staticmethod
     async def _classify_push(push, job_id: str, members, round_num: int):
@@ -707,16 +819,23 @@ class ParameterServerExecutor(JobExecutor):
 
     @staticmethod
     async def _fold(
-        accum: "_RoundAccum | None", entry: tuple[Path, float], sign: float = 1.0
+        accum: "_RoundAccum | None",
+        entry: tuple[Path, float],
+        sign: float = 1.0,
+        prefolded: bool = False,
     ) -> None:
         """Fold one saved delta into the round's partial sum, off-loop.
 
         Decode + fold overlap the next push's arrival — the streaming
         aggregation that leaves only the Nesterov step at quorum close.
         ``accum`` is None when a caller (tests) only wants collection.
+        ``prefolded`` marks a tree-reduce partial: already Σ samples·Δθ,
+        added verbatim (scaled only by ``sign``).
         """
         if accum is not None:
-            await asyncio.to_thread(accum.fold, entry[0], entry[1], sign)
+            await asyncio.to_thread(
+                accum.fold, entry[0], entry[1], sign, prefolded
+            )
 
     async def _collect_round(
         self,
@@ -739,11 +858,21 @@ class ParameterServerExecutor(JobExecutor):
         only the missing workers are waited for.
         """
         received: dict[str, tuple[Path, float]] = dict(preloaded or {})
+        # Tree-reduce cover info: entry key -> (prefolded, covered worker
+        # peers). Journaled entries rebuild theirs from the fold records;
+        # everything else covers its sender.
+        covers: dict[str, tuple[bool, frozenset]] = {}
+        if dur is not None:
+            for f in dur.folds_for(round_num):
+                covers[f.peer] = (f.prefold, frozenset(f.covers or (f.peer,)))
         if not preloaded_folded:
-            for entry in received.values():
-                await self._fold(accum, entry)
+            for key, entry in received.items():
+                await self._fold(
+                    accum, entry,
+                    prefolded=covers.get(key, (False, frozenset()))[0],
+                )
         dest_dir = dur.deltas_dir if dur is not None else work_dir
-        while len(received) < num_workers:
+        while len(self._covered(received, covers)) < num_workers:
             push = await consumer.next()
             peer = push.peer
             if allowed and peer not in allowed:
@@ -764,15 +893,27 @@ class ParameterServerExecutor(JobExecutor):
                 )
                 if delta_round is None:
                     continue
-            if dur is None and peer in received:
+            meta = push.resource if isinstance(push.resource, dict) else {}
+            prefolded, cov = self._push_cover(meta, peer)
+            key = self._entry_key(prefolded, peer)
+            if prefolded:
+                SHARD_METRICS.prefold_partials.add(1)
+            elif self._direct_covered(covers, peer):
+                log.info(
+                    "ps %s: delta from %s already covered by a tree-reduce "
+                    "partial; dropped", job_id, peer,
+                )
+                await push.read_all()
+                continue
+            if dur is None and key in received:
                 # Double-send guard (fixes reference TODO :215-218): a
                 # re-send replaces the previous delta instead of
                 # mis-counting the round. Non-durable saves land on the
                 # SAME deterministic path, so the superseded entry must be
                 # un-folded (reading its original bytes) BEFORE the save.
                 log.warning("ps %s: duplicate delta from %s; replacing", job_id, peer)
-                old = received.pop(peer)
-                await self._fold(accum, old, sign=-1.0)
+                old = received.pop(key)
+                await self._fold(accum, old, sign=-1.0, prefolded=prefolded)
                 old[0].unlink(missing_ok=True)
             # Unique names on durable runs: the journal references each
             # accepted file by name, so a re-send must never overwrite the
@@ -783,28 +924,35 @@ class ParameterServerExecutor(JobExecutor):
                 name_suffix=(
                     f"-{uuid.uuid4().hex[:8]}" if dur is not None else ""
                 ),
-                hasher=hasher,
+                hasher=hasher, name_key=key,
             )
             if not await self._ingest(
-                dur, round_num, 0, peer, entry,
+                dur, round_num, 0, key, entry,
                 sha=hasher.hexdigest() if hasher is not None else None,
+                prefold=prefolded, covers=cov,
             ):
                 log.info(
                     "ps %s: duplicate re-send from %s (journaled); dropped",
                     job_id, peer,
                 )
                 continue
-            if peer in received:
+            if key in received:
                 # Durable path only (unique names): retire the superseded
                 # entry after the save — its file still holds the original
                 # bytes, so the un-fold is exact. The file itself STAYS on
                 # disk: recovery's replay_ops re-reads it to reproduce this
                 # very un-fold (checkpoint GC retires it later).
                 log.warning("ps %s: duplicate delta from %s; replacing", job_id, peer)
-                old = received.pop(peer)
-                await self._fold(accum, old, sign=-1.0)
-            received[peer] = entry
-            await self._fold(accum, entry)
+                old = received.pop(key)
+                await self._fold(accum, old, sign=-1.0, prefolded=prefolded)
+            if prefolded and cov:
+                await self._retire_covered(
+                    job_id, accum, received, covers, cov,
+                    durable=dur is not None,
+                )
+            received[key] = entry
+            covers[key] = (prefolded, cov)
+            await self._fold(accum, entry, prefolded=prefolded)
             log.info(
                 "ps %s: round %d delta %d/%d (from %s)",
                 job_id, round_num, len(received), num_workers, peer,
@@ -833,10 +981,32 @@ class ParameterServerExecutor(JobExecutor):
         interrupted round's deltas re-fold here instead of being re-waited.
         """
         received: dict[str, tuple[Path, float]] = dict(st.early.pop(round_num, {}))
-        for entry in received.values():
+        # Tree-reduce cover info: entry key -> (prefolded, covered workers).
+        covers: dict[str, tuple[bool, frozenset]] = dict(
+            st.early_covers.pop(round_num, {})
+        )
+        if dur is not None:
+            for f in dur.folds_for(round_num):
+                covers.setdefault(
+                    f.peer, (f.prefold, frozenset(f.covers or (f.peer,)))
+                )
+        for key, (p, c) in list(covers.items()):
+            # A recovery-seeded bucket is a last-wins table: it can hold
+            # both a partial and a direct entry the live collector had
+            # retired as covered — drop the directs before folding.
+            if p and c:
+                for member in sorted(c):
+                    info = covers.get(member)
+                    if member in received and not (info and info[0]):
+                        received.pop(member)
+                        covers.pop(member, None)
+        for key, entry in received.items():
             # Parked early arrivals were never folded (their round hadn't
             # opened); fold them now that it has.
-            await self._fold(accum, entry)
+            await self._fold(
+                accum, entry,
+                prefolded=covers.get(key, (False, frozenset()))[0],
+            )
         dest_dir = dur.deltas_dir if dur is not None else work_dir
         loop = asyncio.get_running_loop()
         deadline = (
@@ -847,9 +1017,10 @@ class ParameterServerExecutor(JobExecutor):
             # A rejoiner announced mid-round starts contributing to THIS
             # round: serve its catch-up from inside the wait loop.
             await self._serve_joins(st, cfg, round_num, work_dir)
-            expected = st.membership.expected() | set(received)
-            quorate = len(received) >= st.quorum()
-            if received and quorate and set(received) >= expected:
+            covered = self._covered(received, covers)
+            expected = st.membership.expected() | covered
+            quorate = len(covered) >= st.quorum()
+            if received and quorate and covered >= expected:
                 break
             now = loop.time()
             if deadline is not None and now >= deadline:
@@ -878,8 +1049,25 @@ class ParameterServerExecutor(JobExecutor):
             )
             if delta_round is None:
                 continue
+            meta = push.resource if isinstance(push.resource, dict) else {}
+            prefolded, cov = self._push_cover(meta, peer)
+            key = self._entry_key(prefolded, peer)
+            if prefolded:
+                SHARD_METRICS.prefold_partials.add(1)
+            elif self._direct_covered(
+                covers
+                if delta_round == round_num
+                else st.early_covers.get(delta_round, {}),
+                peer,
+            ):
+                log.info(
+                    "ps %s: delta from %s already covered by a tree-reduce "
+                    "partial; dropped", job_id, peer,
+                )
+                await push.read_all()
+                continue
             # Non-durable saves land on the deterministic path
-            # delta-{round}-{sha(peer)}, so any superseded duplicate must
+            # delta-{round}-{sha(key)}, so any superseded duplicate must
             # be retired BEFORE saving — un-folding/unlinking after the
             # save would read the new bytes and delete the just-saved
             # file. Durable runs save under unique names (the journal
@@ -891,25 +1079,35 @@ class ParameterServerExecutor(JobExecutor):
                 # and shipped the next pseudo-gradient; credit it forward.
                 bucket = st.early.setdefault(delta_round, {})
                 if dur is None:
-                    old = bucket.pop(peer, None)
+                    old = bucket.pop(key, None)
                     if old is not None:
                         old[0].unlink(missing_ok=True)
                 entry = await self._save_delta(
                     push, dest_dir, delta_round, name_suffix=suffix,
-                    hasher=hasher,
+                    hasher=hasher, name_key=key,
                 )
                 if not await self._ingest(
-                    dur, delta_round, 0, peer, entry,
+                    dur, delta_round, 0, key, entry,
                     sha=hasher.hexdigest() if hasher is not None else None,
+                    prefold=prefolded, covers=cov,
                 ):
                     continue
                 # Superseded durable files stay for replay_ops (GC'd at
                 # checkpoint); only the bucket entry is replaced.
-                bucket.pop(peer, None)
-                bucket[peer] = entry
+                bucket.pop(key, None)
+                early_cov = st.early_covers.setdefault(delta_round, {})
+                if prefolded and cov:
+                    # Nothing in a parked bucket has folded yet, so the
+                    # covered directs just leave the table (accum=None).
+                    await self._retire_covered(
+                        job_id, None, bucket, early_cov, cov,
+                        durable=dur is not None,
+                    )
+                bucket[key] = entry
+                early_cov[key] = (prefolded, cov)
                 continue
             if dur is None:
-                old = received.pop(peer, None)
+                old = received.pop(key, None)
                 if old is not None:
                     # Double-send guard (reference TODO :215-218): replace —
                     # un-fold the superseded delta while its file still
@@ -917,15 +1115,16 @@ class ParameterServerExecutor(JobExecutor):
                     log.warning(
                         "ps %s: duplicate delta from %s; replacing", job_id, peer
                     )
-                    await self._fold(accum, old, sign=-1.0)
+                    await self._fold(accum, old, sign=-1.0, prefolded=prefolded)
                     old[0].unlink(missing_ok=True)
             entry = await self._save_delta(
                 push, dest_dir, delta_round, name_suffix=suffix,
-                hasher=hasher,
+                hasher=hasher, name_key=key,
             )
             if not await self._ingest(
-                dur, delta_round, 0, peer, entry,
+                dur, delta_round, 0, key, entry,
                 sha=hasher.hexdigest() if hasher is not None else None,
+                prefold=prefolded, covers=cov,
             ):
                 log.info(
                     "ps %s: duplicate re-send from %s (journaled); dropped",
@@ -933,7 +1132,7 @@ class ParameterServerExecutor(JobExecutor):
                 )
                 continue
             if dur is not None:
-                old = received.pop(peer, None)
+                old = received.pop(key, None)
                 if old is not None:
                     # Un-fold reads the superseded file's original bytes;
                     # the file stays for recovery's replay_ops (GC'd at
@@ -941,19 +1140,25 @@ class ParameterServerExecutor(JobExecutor):
                     log.warning(
                         "ps %s: duplicate delta from %s; replacing", job_id, peer
                     )
-                    await self._fold(accum, old, sign=-1.0)
-            received[peer] = entry
-            await self._fold(accum, entry)
+                    await self._fold(accum, old, sign=-1.0, prefolded=prefolded)
+            if prefolded and cov:
+                await self._retire_covered(
+                    job_id, accum, received, covers, cov,
+                    durable=dur is not None,
+                )
+            received[key] = entry
+            covers[key] = (prefolded, cov)
+            await self._fold(accum, entry, prefolded=prefolded)
             log.info(
                 "ps %s: round %d delta %d (quorum %d, active %d) from %s",
                 job_id, round_num, len(received), st.quorum(),
                 len(st.membership.active), peer,
             )
-        # Degraded = fewer deltas than the job bought replicas (a departed
-        # worker that was never replaced keeps every round degraded, even
-        # though the shrunken active set reported "in full").
+        # Degraded = fewer covered WORKERS than the job bought replicas (a
+        # departed worker that was never replaced keeps every round
+        # degraded, even though the shrunken active set reported "in full").
         full = max(cfg.num_workers, len(st.membership.active))
-        if len(received) < full:
+        if len(self._covered(received, covers)) < full:
             FT_METRICS.degraded_rounds.add(1)
             log.warning(
                 "ps %s: round %d DEGRADED — aggregating %d of %d",
@@ -985,6 +1190,9 @@ class ParameterServerExecutor(JobExecutor):
         init_accums: dict[int, "_RoundAccum"] | None = None,
         init_pending: dict[int, dict[str, tuple[Path, float]]] | None = None,
         init_efs: dict[int, "compress.ErrorFeedback | None"] | None = None,
+        shard: int = 0,
+        num_shards: int = 1,
+        sync_mode: str = "stream",
     ) -> None:
         """The pipelined round loop for ``sync_mode: overlap | stream``.
 
@@ -1009,18 +1217,40 @@ class ParameterServerExecutor(JobExecutor):
 
         Error feedback is per fragment on the broadcast side: one shared
         residual would be clobbered by the next fragment's absorb.
+
+        Sharded runs (``num_shards > 1``) reuse this loop for EVERY sync
+        mode: in stream mode the shard iterates only the rounds whose due
+        fragment it owns (the other shards close the rest concurrently);
+        in blocking mode its due part is fixed at ``shard_index`` and
+        every round is owned. Broadcast and notify headers then carry
+        ``SHARD_KEY`` so workers track generations per shard.
         """
         accums: dict[int, _RoundAccum] = dict(init_accums or {})
         pending: dict[int, dict[str, tuple[Path, float]]] = dict(
             init_pending or {}
         )
+        pending_covers: dict[int, dict[str, tuple[bool, frozenset]]] = {}
         bcast_efs: dict[int, "compress.ErrorFeedback | None"] = dict(
             init_efs or {}
         )
         bcast_tasks: set[asyncio.Task] = set()
         last_bcast: dict[int, asyncio.Task] = {}  # fragment -> newest fan-out
         quant = bcast_codec in compress.QUANT_CODECS
-        round_num = round_start
+        sharded = num_shards > 1
+
+        def due_fn(r: int) -> int:
+            # Stream: the staggered schedule (fragment r mod F). Sharded
+            # blocking: this shard's fixed part, every round.
+            if sharded and sync_mode != "stream":
+                return shard
+            return fragment_due(r, fragments)
+
+        def next_owned(r: int) -> int:
+            if not sharded or sync_mode != "stream":
+                return r
+            return next_owned_round(sync_mode, r, fragments, num_shards, shard)
+
+        round_num = next_owned(round_start)
         try:
             while True:
                 if dur is not None:
@@ -1028,13 +1258,21 @@ class ParameterServerExecutor(JobExecutor):
                 received = await self._collect_round_stream(
                     consumer, job_id, cfg, elastic, allowed, num_workers,
                     work_dir, round_num, fragments, accums, pending,
-                    dur=dur,
+                    dur=dur, due_fn=due_fn, pending_covers=pending_covers,
+                    sharded=sharded,
+                    owned_fn=(
+                        (lambda r: shard_owns_round(
+                            sync_mode, r, fragments, num_shards, shard
+                        ))
+                        if sharded and sync_mode == "stream"
+                        else None
+                    ),
                 )
                 if dur is not None:
                     await asyncio.to_thread(
                         dur.note_close, round_num, list(received)
                     )
-                frag = fragment_due(round_num, fragments)
+                frag = due_fn(round_num)
                 tag = FragmentTag(
                     round=round_num, fragment_id=frag, fragments=fragments
                 )
@@ -1092,7 +1330,7 @@ class ParameterServerExecutor(JobExecutor):
                 # blocking loop: the scheduler must have advanced the
                 # round before any worker's UpdateReceived).
                 response = await self._notify_updated(
-                    scheduler_peer, job_id, round_num
+                    scheduler_peer, job_id, round_num, shard=shard
                 )
                 if dur is not None:
                     await asyncio.to_thread(
@@ -1114,6 +1352,8 @@ class ParameterServerExecutor(JobExecutor):
                 bcast_header = dict(tag.header())
                 if dur is not None:
                     bcast_header[GENERATION_KEY] = dur.generation
+                if sharded:
+                    bcast_header[SHARD_KEY] = shard
                 last_bcast[frag] = aio.spawn(
                     self._broadcast_and_cleanup(
                         cfg, update_path, wire_path, received, round_num,
@@ -1133,7 +1373,9 @@ class ParameterServerExecutor(JobExecutor):
                     logger=log,
                 )
                 STREAM_METRICS.fragment_closed(frag)
-                round_num += 1
+                if sharded:
+                    SHARD_METRICS.shard_rounds_closed.add(1)
+                round_num = next_owned(round_num + 1)
                 if elastic is not None:
                     await self._serve_joins(elastic, cfg, round_num, work_dir)
                 # Memory backpressure only (ordering is the chain above):
@@ -1167,6 +1409,10 @@ class ParameterServerExecutor(JobExecutor):
         accums: dict[int, "_RoundAccum"],
         pending: dict[int, dict[str, tuple[Path, float]]],
         dur: "DurablePS | None" = None,
+        due_fn=None,
+        pending_covers: "dict | None" = None,
+        owned_fn=None,
+        sharded: bool = False,
     ) -> dict[str, tuple[Path, float]]:
         """Gather one round's FRAGMENT deltas: peer -> (path, samples).
 
@@ -1174,10 +1420,26 @@ class ParameterServerExecutor(JobExecutor):
         ``FragmentTag`` names — the current round or a future one (whose
         collect hasn't opened yet) — so aggregation work always overlaps
         the wire. Close conditions match the non-stream paths: all of
-        ``num_workers`` reported (plain), or quorum+deadline (elastic).
+        ``num_workers`` COVERED (plain — a tree-reduce partial covers its
+        group), or quorum+deadline (elastic). ``due_fn`` maps a round to
+        its due part (default: the staggered stream schedule; a sharded
+        blocking run fixes it at the shard index).
         """
+        if due_fn is None:
+            def due_fn(r: int) -> int:
+                return fragment_due(r, fragments)
+        if pending_covers is None:
+            pending_covers = {}
         received = pending.pop(round_num, {})
-        frag = fragment_due(round_num, fragments)
+        covers: dict[str, tuple[bool, frozenset]] = pending_covers.pop(
+            round_num, {}
+        )
+        if dur is not None:
+            for f in dur.folds_for(round_num):
+                covers.setdefault(
+                    f.peer, (f.prefold, frozenset(f.covers or (f.peer,)))
+                )
+        frag = due_fn(round_num)
         dest_dir = dur.deltas_dir if dur is not None else work_dir
         loop = asyncio.get_running_loop()
         deadline = None
@@ -1187,9 +1449,10 @@ class ParameterServerExecutor(JobExecutor):
         while True:
             if st is not None:
                 await self._serve_joins(st, cfg, round_num, work_dir)
-                expected = st.membership.expected() | set(received)
-                quorate = len(received) >= st.quorum()
-                if received and quorate and set(received) >= expected:
+                covered = self._covered(received, covers)
+                expected = st.membership.expected() | covered
+                quorate = len(covered) >= st.quorum()
+                if received and quorate and covered >= expected:
                     break
                 now = loop.time()
                 if deadline is not None and now >= deadline:
@@ -1207,7 +1470,7 @@ class ParameterServerExecutor(JobExecutor):
                 if deadline is not None and now < deadline:
                     timeout = min(timeout, max(deadline - now, 0.05))
             else:
-                if len(received) >= num_workers:
+                if len(self._covered(received, covers)) >= num_workers:
                     break
                 timeout = None
             try:
@@ -1225,18 +1488,40 @@ class ParameterServerExecutor(JobExecutor):
             )
             if delta_round is None:
                 continue
+            if owned_fn is not None and not owned_fn(delta_round):
+                # Mis-routed: this round's due fragment belongs to another
+                # shard — parking it here would leak it forever (this shard
+                # never opens that round).
+                SHARD_METRICS.misrouted_pushes.add(1)
+                log.warning(
+                    "ps %s: push for round %d from %s is another shard's; "
+                    "dropped", job_id, delta_round, peer,
+                )
+                await push.read_all()
+                continue
+            meta = push.resource if isinstance(push.resource, dict) else {}
+            prefolded, cov = self._push_cover(meta, peer)
+            key = self._entry_key(prefolded, peer)
+            if prefolded:
+                SHARD_METRICS.prefold_partials.add(1)
             tag = FragmentTag.from_header(push.resource)
             if tag is not None and (
                 tag.fragments != fragments
-                or tag.fragment_id != fragment_due(delta_round, fragments)
+                or tag.fragment_id != due_fn(delta_round)
             ):
-                # A mis-partitioned sender would fold the wrong tensors
-                # into the mean — drop loudly rather than corrupt a round.
+                # A mis-partitioned (or mis-ROUTED — another shard's part)
+                # sender would fold the wrong tensors into the mean — drop
+                # loudly rather than corrupt a round. On a sharded run this
+                # IS the stale-placement signal (in blocking mode there is
+                # no owned_fn path — every round is owned — so the metric
+                # must fire here too).
+                if sharded:
+                    SHARD_METRICS.misrouted_pushes.add(1)
                 log.warning(
                     "ps %s: fragment tag mismatch from %s "
                     "(round %d fragment %d/%d, expected %d/%d); dropped",
                     job_id, peer, delta_round, tag.fragment_id,
-                    tag.fragments, fragment_due(delta_round, fragments),
+                    tag.fragments, due_fn(delta_round),
                     fragments,
                 )
                 await push.read_all()
@@ -1247,6 +1532,18 @@ class ParameterServerExecutor(JobExecutor):
                 if delta_round == round_num
                 else pending.setdefault(delta_round, {})
             )
+            cov_table = (
+                covers
+                if delta_round == round_num
+                else pending_covers.setdefault(delta_round, {})
+            )
+            if not prefolded and self._direct_covered(cov_table, peer):
+                log.info(
+                    "ps %s: delta from %s already covered by a tree-reduce "
+                    "partial; dropped", job_id, peer,
+                )
+                await push.read_all()
+                continue
             # Save under a UNIQUE name, then validate, then retire any
             # duplicate: validating first means a corrupt/relabeled
             # re-send can never destroy the peer's already-folded good
@@ -1256,7 +1553,7 @@ class ParameterServerExecutor(JobExecutor):
             entry = await self._save_delta(
                 push, dest_dir, delta_round,
                 name_suffix=f"-{uuid.uuid4().hex[:8]}",
-                hasher=hasher,
+                hasher=hasher, name_key=key,
             )
             if tag is not None and not await asyncio.to_thread(
                 self._frame_tag_matches, entry[0], tag
@@ -1270,26 +1567,33 @@ class ParameterServerExecutor(JobExecutor):
                 entry[0].unlink(missing_ok=True)
                 continue
             if not await self._ingest(
-                dur, delta_round, fragment_due(delta_round, fragments),
-                peer, entry,
+                dur, delta_round, due_fn(delta_round),
+                key, entry,
                 sha=hasher.hexdigest() if hasher is not None else None,
+                prefold=prefolded, covers=cov,
             ):
                 log.info(
                     "ps %s: duplicate re-send from %s (journaled); dropped",
                     job_id, peer,
                 )
                 continue
-            old = bucket.pop(peer, None)
+            old = bucket.pop(key, None)
             if old is not None:
                 log.warning(
                     "ps %s: duplicate delta from %s; replacing", job_id, peer
                 )
-                await self._fold(accum, old, sign=-1.0)
+                await self._fold(accum, old, sign=-1.0, prefolded=prefolded)
                 if dur is None:
                     # Durable files stay for replay_ops (checkpoint GC).
                     old[0].unlink(missing_ok=True)
-            bucket[peer] = entry
-            await self._fold(accum, entry)
+            if prefolded and cov:
+                await self._retire_covered(
+                    job_id, accum, bucket, cov_table, cov,
+                    durable=dur is not None,
+                )
+            bucket[key] = entry
+            cov_table[key] = (prefolded, cov)
+            await self._fold(accum, entry, prefolded=prefolded)
             log.info(
                 "ps %s: round %d fragment %d delta %d (from %s%s)",
                 job_id, round_num, frag,
@@ -1298,7 +1602,7 @@ class ParameterServerExecutor(JobExecutor):
             )
         if st is not None:
             full = max(cfg.num_workers, len(st.membership.active))
-            if len(received) < full:
+            if len(self._covered(received, covers)) < full:
                 FT_METRICS.degraded_rounds.add(1)
                 log.warning(
                     "ps %s: round %d DEGRADED — aggregating %d of %d",
@@ -1367,7 +1671,7 @@ class ParameterServerExecutor(JobExecutor):
     @staticmethod
     async def _save_delta(
         push, work_dir: Path, round_num: int, name_suffix: str = "",
-        hasher=None,
+        hasher=None, name_key: "str | None" = None,
     ) -> tuple[Path, float]:
         """Save one pseudo-gradient push; returns (path, sample weight).
 
@@ -1376,9 +1680,12 @@ class ParameterServerExecutor(JobExecutor):
         lands on the SAME deterministic path as the entry it supersedes.
         ``hasher`` is updated with the payload as it streams to disk
         (durable runs journal the sha — hashing inline avoids a second
-        parameter-sized read of the file just written).
+        parameter-sized read of the file just written). ``name_key``
+        overrides the peer id in the deterministic name — a reducer's
+        forwarded partial must not land on the same path as the reducer's
+        own direct delta.
         """
-        name = hashlib.sha256(push.peer.encode()).hexdigest()[:24]
+        name = hashlib.sha256((name_key or push.peer).encode()).hexdigest()[:24]
         dest = work_dir / f"delta-{round_num}-{name}{name_suffix}.safetensors"
         await push.save_to(dest, hasher=hasher)
         samples = 1.0
@@ -1410,6 +1717,10 @@ class ParameterServerExecutor(JobExecutor):
                 "epoch": st.membership.epoch,
                 CATCHUP_KEY: True,
             }
+            if st.num_shards > 1:
+                # A sharded job's rejoiner needs one catch-up PER shard
+                # (each covers only its own fragments' Σ).
+                header[SHARD_KEY] = st.shard
             if st.dur is not None:
                 header[GENERATION_KEY] = st.dur.generation
             try:
@@ -1606,9 +1917,12 @@ class ParameterServerExecutor(JobExecutor):
                 await aio.reap(*(t for t in tasks if not t.done()))
 
     async def _notify_updated(
-        self, scheduler_peer: str, job_id: str, round_num: int
+        self, scheduler_peer: str, job_id: str, round_num: int, shard: int = 0
     ) -> ProgressResponse:
-        progress = Progress(kind=ProgressKind.UPDATED, job_id=job_id, round=round_num)
+        progress = Progress(
+            kind=ProgressKind.UPDATED, job_id=job_id, round=round_num,
+            shard=shard,
+        )
         resp = await self.node.request(
             scheduler_peer, PROTOCOL_PROGRESS, progress, timeout=30
         )

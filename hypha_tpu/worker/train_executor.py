@@ -53,10 +53,20 @@ class InProcessTrainExecutor(JobExecutor):
             Connector(self.node, scheduler_peer),
         )
         socket_path = await bridge.start()
+        # Tree-reduce (hypha_tpu.stream.reduce): a job that names this
+        # worker as its group's reducer runs a GroupReducer NEXT TO the
+        # training loop, runtime-side — it consumes the group members'
+        # fabric pushes and forwards pre-folded partials to the shards.
+        from ..stream.reduce import maybe_start_reducer
+
+        reducer = maybe_start_reducer(self.node, spec)
         execution = Execution(job_id)
         stop_flag = threading.Event()
         runner = asyncio.create_task(
-            self._run(execution, spec, socket_path, work_dir, bridge, stop_flag)
+            self._run(
+                execution, spec, socket_path, work_dir, bridge, stop_flag,
+                reducer,
+            )
         )
 
         async def cancel() -> None:
@@ -100,6 +110,7 @@ class InProcessTrainExecutor(JobExecutor):
         work_dir: Path,
         bridge: Bridge,
         stop_flag: threading.Event,
+        reducer=None,
     ) -> None:
         from ..executor.bridge_client import Session
         from ..executor.training import run_training
@@ -129,6 +140,8 @@ class InProcessTrainExecutor(JobExecutor):
                 log.exception("in-process training job %s failed", spec.job_id)
                 execution.finish("failed", str(e))
         finally:
+            if reducer is not None:
+                await reducer.stop()
             await bridge.stop()
             if not self.keep_work_dir:
                 await asyncio.to_thread(

@@ -1,0 +1,33 @@
+"""Seeded fixture pair for hypha-lint's ``msg-shard-needs-round`` rule.
+
+Deliberately NOT registered with hypha_tpu.messages (registration would
+leak into the live registry other tests lint); tests/test_lint.py passes
+these classes to ``proto_rules.check_shard_tags`` as an explicit registry.
+``ShardBad`` must trip the rule — a placement/shard message whose header
+has no round could re-route an in-flight fragment to the wrong shard's
+journal. ``ShardGood`` is the clean twin.
+"""
+
+# No `from __future__ import annotations`: stringified annotations make
+# dataclasses.fields() resolve against sys.modules[cls.__module__], which
+# an exec'd fixture module is deliberately absent from.
+from dataclasses import dataclass, field
+
+
+@dataclass(slots=True)
+class ShardBad:
+    """Shard identity with NO round tag: the rule must fire."""
+
+    shard: int = 0
+    shards: list = field(default_factory=list)
+    payload_len: int = 0
+
+
+@dataclass(slots=True)
+class ShardGood:
+    """Shard identity paired with its round: the rule must stay quiet."""
+
+    round: int = 0
+    shard: int = 0
+    shards: list = field(default_factory=list)
+    payload_len: int = 0

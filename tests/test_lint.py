@@ -261,6 +261,47 @@ def test_proto_fragment_rule_live_registry_clean():
     assert proto_rules.check_fragment_tags() == []
 
 
+def test_proto_shard_rule_on_fixture_pair():
+    """The seeded fixture pair: ShardBad (shard identity, no round) fires
+    the rule, clean twin ShardGood stays quiet. The fixtures are
+    deliberately unregistered — they reach the rule as an explicit
+    registry."""
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "proto_shard", FIXTURES / "proto_shard.py"
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    bad = proto_rules.check_shard_tags(
+        registry={"ShardBad": mod.ShardBad, "ShardGood": mod.ShardGood}
+    )
+    assert [v.rule for v in bad] == ["msg-shard-needs-round"]
+    assert "ShardBad" in bad[0].message
+    assert proto_rules.check_shard_tags(
+        registry={"ShardGood": mod.ShardGood}
+    ) == []
+
+
+def test_proto_shard_rule_ignores_config_counts():
+    """shard_index/num_ps_shards are config COUNTS, not wire identities —
+    the per-push identity travels as the SHARD_KEY header next to round
+    (messages.AggregateExecutorConfig's documented contract)."""
+
+    @dataclasses.dataclass
+    class ConfigLike:
+        shard_index: int = 0
+        num_ps_shards: int = 1
+
+    assert proto_rules.check_shard_tags(registry={"ConfigLike": ConfigLike}) == []
+
+
+def test_proto_shard_rule_live_registry_clean():
+    """The shipping registry (ShardMap, shard-stamped Progress) satisfies
+    the rule."""
+    assert proto_rules.check_shard_tags() == []
+
+
 def test_proto_manifest_catches_stale_value_vocabulary():
     bad = proto_rules.check_protocol_map(
         registry={}, manifest={}, values={"GhostValue"}

@@ -1,0 +1,1260 @@
+"""Sharded parameter service (fragment-owned PS shards + tree-reduce).
+
+Covers the ISSUE-6 checklist:
+
+  * placement determinism — every peer (and a separate interpreter)
+    derives the same fragment → shard ownership from (name, size) alone;
+  * per-shard journal isolation — each shard's durable root journals and
+    bumps generations independently;
+  * kill-one-shard recovery — a stream shard killed mid-round restarts
+    bit-exactly from its own journal while the OTHER shard keeps closing
+    its rounds during the outage;
+  * tree-reduce — a reducer's pre-folded partial is bit-equal to folding
+    the member deltas directly at the shard, and a duplicate member
+    re-send un-folds at the reducer;
+  * sharded blocking aggregation — per-part updates bit-equal to the
+    single-PS run over the same deltas;
+  * scheduler shard gating — the round advances only when every due shard
+    reported UPDATED, and each shard is told DONE after its LAST owned
+    round;
+  * the executor/pool.py submit()/close() race regression (ADVICE.md).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import subprocess
+import sys
+import threading
+import types
+from pathlib import Path
+
+import numpy as np
+import pytest
+from safetensors.numpy import load_file, save_file
+
+from hypha_tpu.messages import (
+    PREFOLD_KEY,
+    PROTOCOL_PROGRESS,
+    SHARD_KEY,
+    AggregateExecutorConfig,
+    Executor,
+    JobSpec,
+    Nesterov,
+    Progress,
+    ProgressKind,
+    ProgressResponse,
+    ProgressResponseKind,
+    Receive,
+    Reference,
+    Send,
+    ShardMap,
+)
+from hypha_tpu.network import MemoryTransport, Node
+from hypha_tpu.stream import (
+    fragment_due,
+    next_owned_round,
+    partition_names,
+    placement_parts,
+    shard_names,
+    shard_of,
+    shard_owns_round,
+    shards_due_at,
+)
+from hypha_tpu.stream.accum import RoundAccum
+
+REPO = Path(__file__).resolve().parent.parent
+
+
+def _run(coro, timeout=90):
+    return asyncio.run(asyncio.wait_for(coro, timeout))
+
+
+async def _mesh(peer_ids):
+    hub = MemoryTransport()
+    nodes = {p: Node(hub.shared(), peer_id=p) for p in peer_ids}
+    for n in nodes.values():
+        await n.start()
+    for a in nodes.values():
+        for b in nodes.values():
+            if a is not b:
+                a.add_peer_addr(b.peer_id, b.listen_addrs[0])
+    return nodes
+
+
+# ---------------------------------------------------------------- placement
+
+
+def test_shard_of_round_robin_and_validation():
+    assert [shard_of(f, 3) for f in range(6)] == [0, 1, 2, 0, 1, 2]
+    assert shard_of(5, 1) == 0
+    with pytest.raises(ValueError):
+        shard_of(0, 0)
+    with pytest.raises(ValueError):
+        shard_of(-1, 2)
+
+
+def test_shard_names_cover_exactly_and_disjointly():
+    sizes = {f"t{i}": (i % 5) + 1 for i in range(12)}
+    frags = 4
+    num_shards = 2
+    per_shard = [
+        shard_names(sizes, frags, num_shards, s) for s in range(num_shards)
+    ]
+    union = set(per_shard[0]) | set(per_shard[1])
+    assert union == set(sizes)
+    assert not set(per_shard[0]) & set(per_shard[1])
+    # consistency with partition + shard_of
+    parts = partition_names(sizes, frags)
+    for f, names in enumerate(parts):
+        owner = shard_of(f, num_shards)
+        for name in names:
+            assert name in per_shard[owner]
+    with pytest.raises(ValueError):
+        shard_names(sizes, frags, 2, 2)
+
+
+def test_placement_agrees_across_processes():
+    """The placement contract: a separate interpreter derives the same
+    fragment → shard ownership from the same names+sizes."""
+    sizes = {f"layer_{i}/w": (11 * i) % 17 + 1 for i in range(19)}
+    code = (
+        "import json, sys; from hypha_tpu.stream import shard_names; "
+        "sizes = json.load(sys.stdin); "
+        "print(json.dumps([list(shard_names(sizes, 4, 2, s)) "
+        "for s in range(2)]))"
+    )
+    proc = subprocess.run(
+        [sys.executable, "-c", code],
+        input=json.dumps(sizes),
+        capture_output=True,
+        text=True,
+        cwd=REPO,
+        timeout=60,
+        env={
+            "PYTHONHASHSEED": "4242",
+            "PATH": "/usr/bin:/bin",
+            "JAX_PLATFORMS": "cpu",
+        },
+    )
+    assert proc.returncode == 0, proc.stderr
+    theirs = [tuple(s) for s in json.loads(proc.stdout)]
+    assert theirs == [shard_names(sizes, 4, 2, s) for s in range(2)]
+
+
+def test_placement_parts_and_round_ownership():
+    # stream: parts = fragments, one due shard per round (round-robin).
+    assert placement_parts("stream", 4, 2) == 4
+    assert shards_due_at("stream", 0, 4, 2) == (0,)
+    assert shards_due_at("stream", 1, 4, 2) == (1,)
+    assert shards_due_at("stream", 2, 4, 2) == (0,)
+    # blocking with N shards: N parts, ALL due each round.
+    assert placement_parts("blocking", 0, 3) == 3
+    assert shards_due_at("blocking", 7, 3, 3) == (0, 1, 2)
+    # N == 1 keeps the single pre-shard schedule.
+    assert placement_parts("blocking", 0, 1) == 1
+    assert shards_due_at("blocking", 0, 1, 1) == (0,)
+    # ownership + next owned round agree with the due schedule.
+    for r in range(8):
+        due = shards_due_at("stream", r, 4, 2)[0]
+        assert shard_owns_round("stream", r, 4, 2, due)
+        assert not shard_owns_round("stream", r, 4, 2, 1 - due)
+    assert next_owned_round("stream", 1, 4, 2, 0) == 2
+    assert next_owned_round("stream", 2, 4, 2, 0) == 2
+
+
+def test_job_config_shard_validation():
+    from hypha_tpu.scheduler.job_config import DiLoCoJob
+
+    def make(**kw):
+        return DiLoCoJob(model={"family": "gpt2"}, dataset="d", **kw)
+
+    make(num_ps_shards=2, sync_mode="stream", num_fragments=4)
+    make(num_ps_shards=2, sync_mode="blocking")
+    make(reduce_group_size=2)
+    with pytest.raises(ValueError, match="blocking or stream"):
+        make(num_ps_shards=2, sync_mode="overlap")
+    with pytest.raises(ValueError, match="must own at least one fragment"):
+        make(num_ps_shards=8, sync_mode="stream", num_fragments=4)
+    with pytest.raises(ValueError, match="num_ps_shards"):
+        make(num_ps_shards=0)
+    with pytest.raises(ValueError, match="reduce_group_size"):
+        make(reduce_group_size=-1)
+
+
+def test_shard_route_owner_and_reducer_failover():
+    from hypha_tpu.messages import TransferStrategy
+    from hypha_tpu.worker.connectors import shard_route
+
+    smap = ShardMap(
+        round=0, shards=["psA", "psB"], tags=["u.s0", "u.s1"], fragments=4
+    )
+    send, owner, tag = shard_route(smap, 3)
+    assert (owner, tag) == (1, "u.s1")
+    assert send.ref.peers == ["psB"]
+    assert send.ref.strategy == TransferStrategy.ALL
+    # tree-reduce: reducer first, owner shard as ANY failover.
+    send, owner, tag = shard_route(smap, 2, reduce_via="red")
+    assert (owner, tag) == (0, "u.s0")
+    assert send.ref.peers == ["red", "psA"]
+    assert send.ref.strategy == TransferStrategy.ANY
+
+
+# ------------------------------------------------------------- tree-reduce
+
+
+def test_round_accum_prefold_bit_equal_to_direct_folds():
+    """The tree-reduce correctness property: a shard folding the group's
+    pre-folded partial (verbatim, weight = Σ samples) is BIT-equal to
+    having folded the member deltas directly in the same order."""
+    rng = np.random.default_rng(7)
+    deltas = [
+        {"w": rng.standard_normal(64).astype(np.float32)} for _ in range(3)
+    ]
+    weights = [8.0, 4.0, 2.0]
+
+    direct = RoundAccum()
+    for d, w in zip(deltas, weights):
+        direct.fold_tree(d, w)
+
+    reducer = RoundAccum()
+    for d, w in zip(deltas, weights):
+        reducer.fold_tree(d, w)
+    shard = RoundAccum()
+    shard.fold_tree(reducer.partial(), reducer.total_samples, prefolded=True)
+
+    assert shard.total_samples == direct.total_samples
+    np.testing.assert_array_equal(shard.mean()["w"], direct.mean()["w"])
+    # un-fold of a prefolded partial reverses it exactly
+    shard.fold_tree(reducer.partial(), reducer.total_samples, -1.0, True)
+    assert shard.total_samples == 0.0
+
+
+def _reducer_cfg(shards, tags, members):
+    return types.SimpleNamespace(
+        ps_shards=ShardMap(round=0, shards=shards, tags=tags, fragments=1),
+        reduce_members=list(members),
+        reduce_via=None,
+        delta_codec="none",
+        delta_dtype="float32",
+        sync_mode="blocking",
+    )
+
+
+def test_group_reducer_partial_and_duplicate_unfold(tmp_path):
+    """The reducer pre-folds its members' deltas into ONE prefold-tagged
+    partial per shard (covers header = the members), and a duplicate
+    member re-send un-folds the superseded delta before re-flushing the
+    corrected cumulative sum."""
+    from hypha_tpu.stream.reduce import GroupReducer
+
+    d1 = {"w": np.full(8, 1.0, np.float32)}
+    d2 = {"w": np.full(8, 3.0, np.float32)}
+    d1b = {"w": np.full(8, 5.0, np.float32)}  # w1's corrected re-send
+
+    async def main():
+        nodes = await _mesh(["red", "ps0", "w1", "w2"])
+        cfg = _reducer_cfg(["ps0"], ["u.s0"], ["w1", "w2"])
+        reducer = GroupReducer(nodes["red"], cfg, work_dir=tmp_path / "red")
+        reducer.start()
+
+        async def push(node, tree, label):
+            f = tmp_path / f"{label}.st"
+            save_file(tree, str(f))
+            await node.push(
+                "red",
+                {"resource": "u.s0", "name": f.name, "round": 0,
+                 "num_samples": 4.0},
+                f,
+            )
+
+        await push(nodes["w1"], d1, "d1")
+        await push(nodes["w2"], d2, "d2")
+        push1 = await nodes["ps0"].next_push(timeout=20)
+        meta1 = dict(push1.resource)
+        p1 = tmp_path / "partial1.st"
+        await push1.save_to(p1)
+
+        # duplicate re-send from w1: un-fold d1, fold d1b, re-flush.
+        await push(nodes["w1"], d1b, "d1b")
+        push2 = await nodes["ps0"].next_push(timeout=20)
+        meta2 = dict(push2.resource)
+        p2 = tmp_path / "partial2.st"
+        await push2.save_to(p2)
+
+        await reducer.stop()
+        for n in nodes.values():
+            await n.stop()
+        return meta1, load_file(str(p1)), meta2, load_file(str(p2)), reducer
+
+    meta1, part1, meta2, part2, reducer = _run(main())
+    assert meta1[PREFOLD_KEY] is True
+    assert sorted(meta1["covers"]) == ["w1", "w2"]
+    assert meta1["round"] == 0
+    assert meta1["num_samples"] == 8.0
+    # partial = Σ samples·Δ, bit-equal to folding the members directly.
+    np.testing.assert_array_equal(
+        part1["w"], np.float32(4.0) * d1["w"] + np.float32(4.0) * d2["w"]
+    )
+    # after the duplicate: d1 un-folded, d1b folded; weight unchanged.
+    assert reducer.unfolds == 1
+    assert meta2["num_samples"] == 8.0
+    np.testing.assert_array_equal(
+        part2["w"],
+        np.float32(4.0) * d1["w"]
+        + np.float32(4.0) * d2["w"]
+        - np.float32(4.0) * d1["w"]
+        + np.float32(4.0) * d1b["w"],
+    )
+
+
+# ------------------------------------------- sharded blocking aggregation
+
+
+def _agg_spec(job_id, workers, tag, **kwargs):
+    return JobSpec(
+        job_id=job_id,
+        executor=Executor(
+            kind="aggregate",
+            name="parameter-server",
+            aggregate=AggregateExecutorConfig(
+                updates=Receive(Reference.from_peers(list(workers), tag)),
+                results=Send(Reference.from_peers(list(workers), "results")),
+                optimizer=Nesterov(lr=0.7, momentum=0.9),
+                num_workers=len(workers),
+                **kwargs,
+            ),
+        ),
+    )
+
+
+def _worker_delta(peer, rnd, sizes):
+    rng = np.random.default_rng(hash((peer, rnd)) % (2**32))
+    return {
+        n: rng.standard_normal(s).astype(np.float32) for n, s in sizes.items()
+    }
+
+
+def test_sharded_blocking_round_bit_equal_to_single_ps(tmp_path):
+    """Two blocking PS shards over part sub-deltas produce, per tensor,
+    updates BIT-equal to the single PS over the full deltas (Nesterov is
+    per-tensor and the partition is by whole tensors)."""
+    from hypha_tpu.worker.ps_executor import ParameterServerExecutor
+
+    sizes = {"a": 8, "b": 4, "c": 8, "d": 4}
+    rounds = 2
+    parts = partition_names(sizes, 2)  # 2 parts == 2 shards (blocking)
+    samples = {"w1": 8.0, "w2": 4.0}
+
+    async def single_run():
+        nodes = await _mesh(["ps", "w1", "w2", "sched"])
+
+        async def on_progress(peer, progress):
+            if progress.round >= rounds - 1:
+                return ProgressResponse(kind=ProgressResponseKind.DONE)
+            return ProgressResponse(kind=ProgressResponseKind.OK)
+
+        reg = nodes["sched"].on(PROTOCOL_PROGRESS, Progress).respond_with(
+            on_progress
+        )
+        spec = _agg_spec("agg-1", ["w1", "w2"], "updates")
+        pse = ParameterServerExecutor(nodes["ps"], tmp_path / "single")
+        execution = await pse.execute("agg-1", spec, "sched")
+        updates = []
+        for r in range(rounds):
+            for w in ("w1", "w2"):
+                f = tmp_path / f"s-{w}-{r}.st"
+                save_file(_worker_delta(w, r, sizes), str(f))
+                await nodes[w].push(
+                    "ps",
+                    {"resource": "updates", "name": f.name, "round": r,
+                     "num_samples": samples[w]},
+                    f,
+                )
+            per_round = {}
+            for w in ("w1", "w2"):
+                push = await nodes[w].next_push(timeout=20)
+                dest = tmp_path / f"su-{w}-{r}.st"
+                await push.save_to(dest)
+                if w == "w1":
+                    per_round = dict(load_file(str(dest)))
+            updates.append(per_round)
+        status = await asyncio.wait_for(execution.wait(), 15)
+        assert status.state == "completed"
+        reg.close()
+        for n in nodes.values():
+            await n.stop()
+        return updates
+
+    async def sharded_run():
+        nodes = await _mesh(["ps0", "ps1", "w1", "w2", "sched"])
+
+        async def on_progress(peer, progress):
+            # blocking-sharded: every shard owns every round; DONE after
+            # its final round's notify (the real BatchScheduler's
+            # _shard_done semantics).
+            if progress.round >= rounds - 1:
+                return ProgressResponse(kind=ProgressResponseKind.DONE)
+            return ProgressResponse(kind=ProgressResponseKind.OK)
+
+        reg = nodes["sched"].on(PROTOCOL_PROGRESS, Progress).respond_with(
+            on_progress
+        )
+        executions = []
+        for k in (0, 1):
+            spec = _agg_spec(
+                f"agg-s{k}", ["w1", "w2"], f"updates.s{k}",
+                sync_mode="blocking", shard_index=k, num_ps_shards=2,
+            )
+            pse = ParameterServerExecutor(
+                nodes[f"ps{k}"], tmp_path / f"shard{k}"
+            )
+            executions.append(await pse.execute(f"agg-s{k}", spec, "sched"))
+        updates: list[dict] = []
+        for r in range(rounds):
+            for w in ("w1", "w2"):
+                full = _worker_delta(w, r, sizes)
+                for p, names in enumerate(parts):
+                    f = tmp_path / f"p-{w}-{r}-{p}.st"
+                    save_file({n: full[n] for n in names}, str(f))
+                    await nodes[w].push(
+                        f"ps{p}",
+                        {
+                            "resource": f"updates.s{p}",
+                            "name": f.name,
+                            "round": r,
+                            "num_samples": samples[w],
+                            SHARD_KEY: p,
+                            "fragment_id": p,
+                            "fragments": 2,
+                        },
+                        f,
+                    )
+            merged: dict = {}
+            got_w1 = 0
+            while got_w1 < 2:  # one broadcast per shard reaches each worker
+                push = await nodes["w1"].next_push(timeout=20)
+                meta = dict(push.resource)
+                dest = tmp_path / f"pu-{r}-{meta.get(SHARD_KEY)}.st"
+                await push.save_to(dest)
+                assert meta["round"] == r
+                merged.update(dict(load_file(str(dest))))
+                got_w1 += 1
+                other = await nodes["w2"].next_push(timeout=20)
+                await other.read_all()
+            updates.append(merged)
+        for execution in executions:
+            status = await asyncio.wait_for(execution.wait(), 15)
+            assert status.state == "completed"
+        reg.close()
+        for n in nodes.values():
+            await n.stop()
+        return updates
+
+    single = _run(single_run())
+    sharded = _run(sharded_run())
+    for r in range(rounds):
+        assert set(single[r]) == set(sizes)
+        assert set(sharded[r]) == set(sizes)
+        for name in sizes:
+            np.testing.assert_array_equal(
+                single[r][name], sharded[r][name],
+                err_msg=f"round {r} tensor {name} diverged under sharding",
+            )
+
+
+# ------------------------------------------------- kill-one-shard recovery
+
+
+def test_stream_kill_one_shard_recovers_bit_exact_others_progress(tmp_path):
+    """Stream F=2 over N=2 shards: shard 1 is killed mid-round; shard 0
+    keeps closing ITS rounds during the outage (no restart anywhere
+    else); the restarted shard 1 recovers from its own journal and the
+    full round sequence is BIT-equal to the no-kill run."""
+    from hypha_tpu.ft.durable import GENERATION_KEY, RESYNC_KEY
+    from hypha_tpu.worker.ps_executor import ParameterServerExecutor
+
+    sizes = {"a": 8, "b": 4, "c": 8, "d": 4}
+    frags = partition_names(sizes, 2)
+    rounds = 4  # due shard = r % 2; shard0 owns {0,2}, shard1 owns {1,3}
+
+    async def one_run(label, kill):
+        nodes = await _mesh(["ps0", "ps1", "w1", "sched"])
+
+        async def on_progress(peer, progress):
+            # a shard is DONE after its last owned round (2 or 3).
+            if progress.round >= rounds - 2:
+                return ProgressResponse(kind=ProgressResponseKind.DONE)
+            return ProgressResponse(kind=ProgressResponseKind.OK)
+
+        reg = nodes["sched"].on(PROTOCOL_PROGRESS, Progress).respond_with(
+            on_progress
+        )
+
+        def spec_for(k):
+            return _agg_spec(
+                f"agg-k{k}", ["w1"], f"updates.s{k}",
+                sync_mode="stream", fragments=2,
+                shard_index=k, num_ps_shards=2,
+                checkpoint_dir=str(tmp_path / label / f"ps{k}"),
+            )
+
+        executions = {}
+        for k in (0, 1):
+            pse = ParameterServerExecutor(
+                nodes[f"ps{k}"], tmp_path / f"work-{label}-{k}"
+            )
+            executions[k] = await pse.execute(f"agg-k{k}", spec_for(k), "sched")
+
+        async def push_frag(r):
+            f_id = fragment_due(r, 2)
+            owner = shard_of(f_id, 2)
+            delta = {
+                n: _worker_delta("w1", r, sizes)[n] for n in frags[f_id]
+            }
+            f = tmp_path / f"k-{label}-{r}.st"
+            save_file(delta, str(f))
+            await nodes["w1"].push(
+                f"ps{owner}",
+                {
+                    "resource": f"updates.s{owner}",
+                    "name": f.name,
+                    "round": r,
+                    "num_samples": 8.0,
+                    SHARD_KEY: owner,
+                    "fragment_id": f_id,
+                    "fragments": 2,
+                },
+                f,
+            )
+            return f
+
+        seen: dict[int, tuple[dict, dict]] = {}
+        counter = [0]
+
+        async def drain(expect_round):
+            # Broadcasts from different shards are concurrent — cache by
+            # round (first copy wins, like the worker's stale-drop) until
+            # the wanted round lands.
+            while expect_round not in seen:
+                push = await nodes["w1"].next_push(timeout=25)
+                meta = dict(push.resource)
+                counter[0] += 1
+                dest = tmp_path / f"ku-{label}-{counter[0]}.st"
+                await push.save_to(dest)
+                if meta.get(RESYNC_KEY):
+                    continue
+                rnd = int(meta.get("round", -1))
+                if rnd >= 0 and rnd not in seen:
+                    seen[rnd] = (meta, dict(load_file(str(dest))))
+            return seen[expect_round]
+
+        updates = []
+        # rounds 0 (shard0) and 1 (shard1): uninterrupted.
+        for r in (0, 1):
+            await push_frag(r)
+            meta, upd = await drain(r)
+            assert int(meta.get(SHARD_KEY, -1)) == r % 2
+            updates.append(upd)
+        if kill:
+            # Kill shard 1 (its round-1 state is in its own journal);
+            # NOTHING else is touched.
+            await executions[1].cancel()
+        # shard 0 closes ITS round 2 during the outage — no restarts
+        # anywhere else.
+        await push_frag(2)
+        meta2, upd2 = await drain(2)
+        assert int(meta2.get(SHARD_KEY, -1)) == 0
+        if kill:
+            # restart shard 1 against the same durable root: it replays
+            # its journal (round 1 committed), announces a bumped
+            # generation, re-broadcasts its newest wire, and resumes at
+            # its next owned round (3).
+            pse = ParameterServerExecutor(
+                nodes["ps1"], tmp_path / f"work-{label}-1b"
+            )
+            executions[1] = await pse.execute(
+                "agg-k1", spec_for(1), "sched"
+            )
+        await push_frag(3)
+        meta3, upd3 = await drain(3)
+        assert int(meta3.get(SHARD_KEY, -1)) == 1
+        if kill:
+            assert int(meta3.get(GENERATION_KEY, 1)) >= 2  # bumped gen
+        updates.extend([upd2, upd3])
+        for k in (0, 1):
+            status = await asyncio.wait_for(executions[k].wait(), 20)
+            assert status.state == "completed", (k, status.message)
+        reg.close()
+        for n in nodes.values():
+            await n.stop()
+        return updates
+
+    clean = _run(one_run("clean", kill=False), timeout=120)
+    killed = _run(one_run("killed", kill=True), timeout=120)
+    assert len(clean) == len(killed) == 4
+    for i, (a, b) in enumerate(zip(clean, killed)):
+        assert set(a) == set(b)
+        for name in a:
+            np.testing.assert_array_equal(
+                a[name], b[name],
+                err_msg=f"update {i} tensor {name} diverged after shard kill",
+            )
+
+
+def test_per_shard_journals_are_isolated(tmp_path):
+    """Each shard's durable root journals independently: re-opening ONE
+    shard's root bumps ONLY that shard's generation."""
+    from hypha_tpu.ft.durable import DurablePS, FoldRecord
+
+    d0 = DurablePS.open(tmp_path / "ps0", "job", owned=lambda r: r % 2 == 0)
+    d1 = DurablePS.open(tmp_path / "ps1", "job", owned=lambda r: r % 2 == 1)
+    assert d0.generation == d1.generation == 1
+    d0.note_fold(FoldRecord(0, 0, "w1", 8.0, "sha-a", "fa.st"))
+    d1.note_fold(FoldRecord(1, 1, "w1", 8.0, "sha-b", "fb.st"))
+    d0.close()
+    d1.close()
+    d1b = DurablePS.open(tmp_path / "ps1", "job", owned=lambda r: r % 2 == 1)
+    assert d1b.generation == 2
+    assert [f.peer for f in d1b.folds_for(1)] == ["w1"]
+    assert d1b.folds_for(0) == []
+    d1b.close()
+    d0b = DurablePS.open(tmp_path / "ps0", "job", owned=lambda r: r % 2 == 0)
+    assert d0b.generation == 2  # its own second open — not d1's
+    d0b.close()
+
+
+def test_owned_gating_skips_unowned_rounds_in_contiguity_check(tmp_path):
+    """A stream shard's journal commits only its owned rounds; the resume
+    contiguity check must not read the gaps as journal loss."""
+    import os
+
+    from hypha_tpu.ft.durable import DurablePS
+
+    root = tmp_path / "ps1"
+    os.environ.pop("HYPHA_JOURNAL_FSYNC_EVERY", None)
+    # ckpt_every high: commits must STAY in the journal window (a
+    # checkpoint would compact them away and hide the gap either way).
+    dur = DurablePS.open(
+        root, "job", ckpt_every=100, owned=lambda r: r % 2 == 1
+    )
+    # commits for rounds 1 and 3 only (shard of odd rounds).
+    momentum = root / "momentum.st"
+    for rnd in (1, 3):
+        wire = root / f"w{rnd}.st"
+        save_file({"w": np.ones(2, np.float32)}, str(wire))
+        name = dur.store_wire(rnd, wire)
+        dur.commit_round(
+            rnd, rnd % 2, name, epoch=0, momentum_file=momentum
+        )
+    dur.close()
+    dur2 = DurablePS.open(
+        root, "job", ckpt_every=100, owned=lambda r: r % 2 == 1
+    )
+    assert dur2.resume is not None
+    assert [int(r["round"]) for r in dur2.resume.committed] == [1, 3]
+    dur2.close()
+    # WITHOUT the owned hook the same journal is a hard error (gap).
+    with pytest.raises(ValueError, match="journal gap"):
+        DurablePS.open(root, "job", ckpt_every=100)
+
+
+# ------------------------------------------------------- scheduler gating
+
+
+def test_batch_scheduler_advances_on_all_due_shards():
+    from hypha_tpu.scheduler.batch_scheduler import BatchScheduler
+    from hypha_tpu.scheduler.trackers import ProgressTracker
+
+    tracker = ProgressTracker(["psA", "psB"], 10, 3, clock=lambda: 0.0)
+    assert tracker.parameter_server == "psA"
+    assert tracker.parameter_servers == ["psA", "psB"]
+    bs = BatchScheduler(tracker, shards_due=lambda r: (0, 1))
+
+    def updated(peer, rnd, shard):
+        return bs.on_progress(
+            peer,
+            Progress(
+                kind=ProgressKind.UPDATED, job_id="j", round=rnd, shard=shard
+            ),
+        )
+
+    # a non-PS peer cannot advance the round
+    resp = updated("stranger", 0, 0)
+    assert resp.kind == ProgressResponseKind.ERROR
+    # round advances only once BOTH shards reported
+    assert updated("psA", 0, 0).kind == ProgressResponseKind.OK
+    assert tracker.round == 0
+    assert updated("psB", 0, 1).kind == ProgressResponseKind.OK
+    assert tracker.round == 1
+    # idempotent re-notify by (shard, round)
+    assert updated("psA", 0, 0).kind == ProgressResponseKind.OK
+    assert tracker.round == 1
+    # final round: each shard gets DONE after ITS last owned round
+    updated("psA", 1, 0)
+    updated("psB", 1, 1)
+    assert tracker.round == 2
+    assert updated("psA", 2, 0).kind == ProgressResponseKind.DONE
+    assert tracker.round == 2  # psB still owed
+    assert updated("psB", 2, 1).kind == ProgressResponseKind.DONE
+    assert tracker.round == 3
+
+
+def test_batch_scheduler_stream_shard_done_before_final_round():
+    """Stream mode: a shard whose LAST owned round precedes the job's
+    final round is told DONE there — it must not wait for rounds it will
+    never close."""
+    from hypha_tpu.scheduler.batch_scheduler import BatchScheduler
+    from hypha_tpu.scheduler.trackers import ProgressTracker
+
+    # F=2, N=2 over 3 rounds: shard0 owns {0, 2}, shard1 owns {1} only.
+    tracker = ProgressTracker(["psA", "psB"], 10, 3, clock=lambda: 0.0)
+    bs = BatchScheduler(
+        tracker, shards_due=lambda r: shards_due_at("stream", r, 2, 2)
+    )
+
+    def updated(peer, rnd, shard):
+        return bs.on_progress(
+            peer,
+            Progress(
+                kind=ProgressKind.UPDATED, job_id="j", round=rnd, shard=shard
+            ),
+        )
+
+    assert updated("psA", 0, 0).kind == ProgressResponseKind.OK
+    assert tracker.round == 1
+    # shard1's ONLY owned round: DONE immediately, round advances.
+    assert updated("psB", 1, 1).kind == ProgressResponseKind.DONE
+    assert tracker.round == 2
+    assert updated("psA", 2, 0).kind == ProgressResponseKind.DONE
+    assert tracker.round == 3
+
+
+def test_batch_scheduler_single_ps_unchanged():
+    """num_ps_shards=1 compatibility: no shards_due → the exact pre-shard
+    one-notify-one-advance behavior."""
+    from hypha_tpu.scheduler.batch_scheduler import BatchScheduler
+    from hypha_tpu.scheduler.trackers import ProgressTracker
+
+    tracker = ProgressTracker("ps", 10, 2, clock=lambda: 0.0)
+    bs = BatchScheduler(tracker)
+    p = Progress(kind=ProgressKind.UPDATED, job_id="j", round=0)
+    assert bs.on_progress("ps", p).kind == ProgressResponseKind.OK
+    assert tracker.round == 1
+    p = Progress(kind=ProgressKind.UPDATED, job_id="j", round=1)
+    assert bs.on_progress("ps", p).kind == ProgressResponseKind.DONE
+    assert tracker.round == 2
+    # idempotent re-notify after completion
+    p = Progress(kind=ProgressKind.UPDATED, job_id="j", round=1)
+    assert bs.on_progress("ps", p).kind == ProgressResponseKind.DONE
+
+
+# ------------------------------------------------ worker loop, sharded
+
+
+class _ShardedFakeSession:
+    """Drives run_training's sharded blocking path without a cluster: every
+    part push is answered with ``update = outer_lr · Δpart``, echoing the
+    (round, fragment, shard) identity — and records where each part was
+    ROUTED (peers + resource tag) so the test can assert the placement."""
+
+    def __init__(self, work_dir: Path, rounds: int, batches_per_round: int = 2):
+        import queue as q
+
+        self.work_dir = Path(work_dir)
+        self.target_rounds = rounds
+        self.batches_per_round = batches_per_round
+        self.rounds_done = 0
+        self.batches_this_round = 0
+        self.scheduled = False
+        self.events: "q.Queue[dict]" = q.Queue()
+        self.routed: list[dict] = []
+        self.lock = threading.Lock()
+
+    def fetch(self, fetch):
+        d = self.work_dir / "artifacts"
+        d.mkdir(parents=True, exist_ok=True)
+        path = d / "slice.safetensors"
+        if not path.exists():
+            rng = np.random.default_rng(42)
+            ids = rng.integers(0, 16, (8, 8)).astype(np.int32)
+            save_file({"input_ids": ids}, str(path))
+        return ["artifacts/slice.safetensors"]
+
+    def send_status(self, progress):
+        kind = progress.kind
+        with self.lock:
+            if kind == ProgressKind.STATUS:
+                if self.rounds_done >= self.target_rounds:
+                    return ProgressResponse(kind=ProgressResponseKind.DONE)
+                self.batches_this_round += 1
+                if (
+                    not self.scheduled
+                    and self.batches_this_round >= self.batches_per_round
+                ):
+                    self.scheduled = True
+                    return ProgressResponse(
+                        kind=ProgressResponseKind.SCHEDULE_UPDATE, counter=0
+                    )
+                return ProgressResponse(kind=ProgressResponseKind.CONTINUE)
+            if kind == ProgressKind.UPDATE_RECEIVED:
+                self.rounds_done += 1
+                self.batches_this_round = 0
+                self.scheduled = False
+                done = self.rounds_done >= self.target_rounds
+                return ProgressResponse(
+                    kind=(
+                        ProgressResponseKind.DONE
+                        if done
+                        else ProgressResponseKind.CONTINUE
+                    )
+                )
+            return ProgressResponse(kind=ProgressResponseKind.OK)
+
+    def send_resource(self, send, path, resource="updates", meta=None):
+        from hypha_tpu import compress
+
+        meta = meta or {}
+        self.routed.append(
+            {
+                "peers": list(send.ref.peers or []),
+                "resource": resource,
+                "meta": dict(meta),
+            }
+        )
+        delta = compress.read_delta(self.work_dir / path)
+        update = {k: (0.7 * np.asarray(v, np.float32)) for k, v in delta.items()}
+        incoming = self.work_dir / "incoming"
+        incoming.mkdir(exist_ok=True)
+        rnd = int(meta.get("round", 0))
+        frag = int(meta.get("fragment_id", 0))
+        out = incoming / f"update-{rnd}-p{frag}.safetensors"
+        save_file(update, str(out))
+        event_meta = {"round": rnd}
+        for key in ("fragment_id", "fragments", SHARD_KEY):
+            if key in meta:
+                event_meta[key] = meta[key]
+        self.events.put(
+            {"path": f"incoming/{out.name}", "meta": event_meta, "size": 0}
+        )
+
+    def receive(self, receive):
+        import contextlib
+        import queue as q
+
+        @contextlib.contextmanager
+        def cm():
+            def gen():
+                while True:
+                    try:
+                        yield self.events.get(timeout=30)
+                    except q.Empty:
+                        return
+
+            yield gen()
+
+        return cm()
+
+
+@pytest.mark.slow
+def test_run_training_sharded_blocking_matches_unsharded(tmp_path):
+    """do_update_sharded end-to-end: the worker splits Δθ into placement
+    parts, routes each to its owning shard's peer+tag, merges every
+    part's update — and the final params are BIT-equal to the unsharded
+    blocking run over the same data."""
+    import jax
+
+    from hypha_tpu.executor.checkpoint import load_train_checkpoint
+    from hypha_tpu.executor.train import TrainState, build_optimizer
+    from hypha_tpu.executor.training import run_training
+    from hypha_tpu.messages import (
+        Adam,
+        Executor,
+        Fetch,
+        TrainExecutorConfig,
+    )
+    from hypha_tpu.models import build_model
+
+    def run_one(tag, shard_map):
+        work = tmp_path / tag
+        work.mkdir()
+        ckpt = work / "ckpt"
+        cfg = TrainExecutorConfig(
+            model={
+                "model_type": "causal-lm",
+                "family": "gpt2",
+                "config": {
+                    "vocab_size": 16,
+                    "n_positions": 8,
+                    "n_embd": 8,
+                    "n_layer": 1,
+                    "n_head": 2,
+                },
+                "seed": 3,
+            },
+            data=Fetch(Reference.from_uri("file:///unused")),
+            updates=Send(Reference.from_peers(["ps"], "updates")),
+            results=Receive(Reference.from_peers(["ps"], "results")),
+            optimizer=Adam(lr=1e-3),
+            batch_size=4,
+            checkpoint={"dir": str(ckpt), "every_rounds": 1},
+            ps_shards=shard_map,
+        )
+        spec = JobSpec(
+            job_id=f"shard-{tag}",
+            executor=Executor(kind="train", name="diloco-transformer", train=cfg),
+        )
+        session = _ShardedFakeSession(work, rounds=2)
+        result = run_training(session, work, spec, max_batches=64)
+        model, _ = build_model(dict(cfg.model), None)
+        params = model.init(jax.random.key(3), np.zeros((1, 8), np.int32))
+        state = TrainState.create(params, build_optimizer(Adam(lr=1e-3)))
+        restored = load_train_checkpoint(ckpt, state.params, state.opt_state)
+        assert restored is not None
+        return result, restored[0], session
+
+    smap = ShardMap(
+        round=0, shards=["psA", "psB"], tags=["u.s0", "u.s1"], fragments=2
+    )
+    result_u, params_u, _ = run_one("unsharded", None)
+    result_s, params_s, session_s = run_one("sharded", smap)
+    assert result_u.rounds == result_s.rounds == 2
+
+    # every part went to its owning shard's peer under its tag
+    assert len(session_s.routed) == 4  # 2 rounds x 2 parts
+    for sent in session_s.routed:
+        owner = shard_of(int(sent["meta"]["fragment_id"]), 2)
+        assert sent["peers"] == [smap.shards[owner]]
+        assert sent["resource"] == smap.tags[owner]
+        assert int(sent["meta"][SHARD_KEY]) == owner
+        assert "round" in sent["meta"]
+
+    import jax
+
+    flat_u = jax.tree_util.tree_leaves(params_u)
+    flat_s = jax.tree_util.tree_leaves(params_s)
+    assert len(flat_u) == len(flat_s)
+    for a, b in zip(flat_u, flat_s):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+# -------------------------------------------------------- pool race (ADVICE)
+
+
+def test_pool_submit_close_race_futures_always_resolve():
+    """ADVICE.md regression: a submit racing close() must never produce a
+    Future that hangs — either the pool serves it or fails it, but it
+    ALWAYS resolves."""
+    import dataclasses
+
+    import jax
+
+    from hypha_tpu.executor.pool import DecodePool
+    from hypha_tpu.models import Llama, LlamaConfig
+
+    cfg = dataclasses.replace(LlamaConfig.tiny(), dtype="float32")
+    model = Llama(cfg)
+    params = model.init(jax.random.key(0), np.zeros((1, 8), np.int32))
+
+    for _ in range(3):
+        pool = DecodePool(model, params, slots=2, max_len=32, steps_per_call=2)
+        futures = []
+        start = threading.Barrier(5)
+
+        def submitter():
+            start.wait()
+            for _ in range(4):
+                futures.append(pool.submit([[1, 2]], 2))
+
+        threads = [threading.Thread(target=submitter) for _ in range(4)]
+        for t in threads:
+            t.start()
+        start.wait()  # close races the submit burst
+        pool.close(wait=True)
+        for t in threads:
+            t.join(timeout=30)
+            assert not t.is_alive()
+        for fut in futures:
+            # resolves — result or exception — instead of hanging forever.
+            try:
+                fut.result(timeout=30)
+            except Exception:
+                pass
+            assert fut.done(), "submit() returned a Future that never resolves"
+
+
+# ------------------------------------- cover-set reconciliation (review)
+
+
+class _FakePush:
+    def __init__(self, peer, resource, tree):
+        self.peer = peer
+        self.resource = resource
+        self._tree = tree
+        self.drained = False
+
+    async def save_to(self, dest, hasher=None):
+        save_file(self._tree, str(dest))
+        if hasher is not None:
+            hasher.update(Path(dest).read_bytes())
+        return 1
+
+    async def read_all(self):
+        self.drained = True
+        return b""
+
+    def finish(self):
+        pass
+
+
+class _FakeConsumer:
+    def __init__(self, pushes):
+        self._pushes = list(pushes)
+
+    async def next(self, timeout=None):
+        if self._pushes:
+            return self._pushes.pop(0)
+        await asyncio.sleep(min(timeout or 0.01, 0.01))
+        raise asyncio.TimeoutError
+
+    def close(self):
+        pass
+
+
+def _direct(peer, rnd, tree, samples):
+    return _FakePush(
+        peer,
+        {"resource": "u", "name": f"d-{peer}", "round": rnd,
+         "num_samples": samples},
+        tree,
+    )
+
+
+def _partial(peer, rnd, tree, samples, covers):
+    return _FakePush(
+        peer,
+        {"resource": "u", "name": f"p-{peer}", "round": rnd,
+         "num_samples": samples, PREFOLD_KEY: True,
+         "covers": list(covers)},
+        tree,
+    )
+
+
+_D1 = {"w": np.full(4, 1.0, np.float32)}
+_D2 = {"w": np.full(4, 3.0, np.float32)}
+_D3 = {"w": np.full(4, -2.0, np.float32)}
+# reducer partial over w1 (4 samples) + w2 (4 samples): Σ samples·Δ
+_PART = {"w": np.float32(4.0) * _D1["w"] + np.float32(4.0) * _D2["w"]}
+
+
+def test_partial_after_direct_unfolds_covered_entry(tmp_path):
+    """At-least-once overlap, direct first: w1's failed-over direct delta
+    lands, then the reducer's partial covering {w1, w2} — the direct
+    entry must be un-folded and retired, not double-counted."""
+    from hypha_tpu.worker.ps_executor import ParameterServerExecutor
+
+    ps = ParameterServerExecutor(node=None, work_root=tmp_path)
+    accum = RoundAccum()
+    consumer = _FakeConsumer([
+        _direct("w1", 0, _D1, 4.0),
+        _partial("red", 0, _PART, 8.0, ["w1", "w2"]),
+    ])
+    received = _run(ps._collect_round(
+        consumer, "job", set(), 2, tmp_path, 0, accum=accum
+    ))
+    assert set(received) == {"prefold:red"}
+    assert accum.total_samples == 8.0
+    np.testing.assert_array_equal(
+        accum.mean()["w"], _PART["w"] / np.float32(8.0)
+    )
+
+
+def test_direct_after_partial_is_dropped_unfolded(tmp_path):
+    """At-least-once overlap, partial first: a direct delta whose sender
+    an accepted partial already covers is dropped (drained, never
+    folded); an uncovered worker's direct delta still folds."""
+    from hypha_tpu.worker.ps_executor import ParameterServerExecutor
+
+    ps = ParameterServerExecutor(node=None, work_root=tmp_path)
+    accum = RoundAccum()
+    covered = _direct("w1", 0, _D1, 4.0)
+    consumer = _FakeConsumer([
+        _partial("red", 0, _PART, 8.0, ["w1", "w2"]),
+        covered,
+        _direct("w3", 0, _D3, 4.0),
+    ])
+    received = _run(ps._collect_round(
+        consumer, "job", set(), 3, tmp_path, 0, accum=accum
+    ))
+    assert set(received) == {"prefold:red", "w3"}
+    assert covered.drained and "w1" not in received
+    assert accum.total_samples == 12.0
+    np.testing.assert_array_equal(
+        accum.mean()["w"],
+        (_PART["w"] + np.float32(4.0) * _D3["w"]) / np.float32(12.0),
+    )
+
+
+def test_cover_reconciliation_replays_bit_exact(tmp_path):
+    """The journal replay re-derives the partial's covered un-folds from
+    its ``covers`` record: a recovered shard's accumulator is BIT-equal
+    to the live one that reconciled at arrival."""
+    from hypha_tpu.ft.durable import DurablePS
+    from hypha_tpu.worker.ps_executor import ParameterServerExecutor
+
+    ps = ParameterServerExecutor(node=None, work_root=tmp_path / "w")
+    dur = DurablePS.open(tmp_path / "dur", "job")
+    dur.note_open(0)
+    accum = RoundAccum()
+    consumer = _FakeConsumer([
+        _direct("w1", 0, _D1, 4.0),
+        _partial("red", 0, _PART, 8.0, ["w1", "w2"]),
+    ])
+    received = _run(ps._collect_round(
+        consumer, "job", set(), 2, tmp_path / "w", 0, accum=accum, dur=dur
+    ))
+    assert set(received) == {"prefold:red"}
+
+    reopened = DurablePS.open(tmp_path / "dur", "job")
+    replayed = RoundAccum()
+    ops = reopened.replay_ops(0)
+    # +w1 direct, -w1 (covered by the partial), +partial
+    assert [(f.peer, s) for f, s in ops] == [
+        ("w1", 1.0), ("w1", -1.0), ("prefold:red", 1.0)
+    ]
+    for fold, sign in ops:
+        replayed.fold(
+            reopened.deltas_dir / fold.file, fold.samples, sign, fold.prefold
+        )
+    assert replayed.total_samples == accum.total_samples
+    np.testing.assert_array_equal(replayed.mean()["w"], accum.mean()["w"])
+
+
+def test_reducer_leaves_non_member_pushes_for_colocated_shard(tmp_path):
+    """A reducer colocated with a PS shard executor (small-mesh peer
+    reuse) must not steal direct-to-shard deltas from workers outside
+    its group: its consumer filters by sender, so the push stays on the
+    node's default queue."""
+    from hypha_tpu.stream.reduce import GroupReducer
+
+    async def main():
+        nodes = await _mesh(["red", "ps0", "w1", "w3"])
+        cfg = types.SimpleNamespace(
+            ps_shards=ShardMap(
+                round=0, shards=["ps0"], tags=["u.s0"], fragments=1
+            ),
+            reduce_members=["w1"],
+            reduce_via=None,
+            delta_codec="none",
+            delta_dtype="float32",
+            sync_mode="blocking",
+        )
+        reducer = GroupReducer(nodes["red"], cfg, work_dir=tmp_path / "red")
+        reducer.start()
+        f = tmp_path / "w3.st"
+        save_file(_D3, str(f))
+        await nodes["w3"].push(
+            "red",
+            {"resource": "u.s0", "name": f.name, "round": 0,
+             "num_samples": 4.0},
+            f,
+        )
+        # The non-member push must surface on the default queue, NOT be
+        # consumed (and dropped) by the reducer.
+        push = await nodes["red"].next_push(timeout=10)
+        assert push.peer == "w3"
+        await push.read_all()
+        await reducer.stop()
+        assert reducer.folds == 0
+        for n in nodes.values():
+            await n.stop()
+
+    _run(main())
+
+
+# ----------------------------------- orchestrator mid-restart (review)
+
+
+def test_notify_membership_fails_joined_while_shard_restarting():
+    """A JOINED notification is load-bearing (it queues the rejoiner's
+    catch-up on every shard): with any shard handle mid-restart (None)
+    it must report failure so the rejoin attempt retries — a silent
+    skip would leave the rejoiner waiting on that shard forever. Plain
+    snapshot updates still tolerate the gap."""
+    from hypha_tpu.scheduler.orchestrator import Orchestrator
+
+    sent = []
+
+    class _Node:
+        peer_id = "sched"
+
+        async def request(self, peer, proto, msg, timeout=None):
+            sent.append((peer, msg.job_id))
+
+    stub = types.SimpleNamespace(node=_Node())
+    ctx = types.SimpleNamespace(
+        membership=types.SimpleNamespace(
+            snapshot=lambda: types.SimpleNamespace(epoch=1)
+        ),
+        ps_handles=[types.SimpleNamespace(peer_id="psA"), None],
+        ps_job_ids=["j-ps0", "j-ps1"],
+    )
+    ok = _run(Orchestrator._notify_membership(stub, ctx, joined=["w9"]))
+    assert ok is False
+    assert sent == [("psA", "j-ps0")]  # the live shard still got it
+    sent.clear()
+    ok = _run(Orchestrator._notify_membership(stub, ctx, joined=None))
+    assert ok is True  # plain update: repaired by the next push
+
+
+def test_train_spec_routes_results_by_placement_not_live_handles():
+    """A worker dispatched while shard 1 is mid-restart must still wire
+    BOTH shards' results streams: the restarted shard comes back on the
+    same peer id, so the spec routes by the placement map, not by the
+    momentarily compacted live-handle list."""
+    from hypha_tpu.scheduler.job_config import (
+        DiLoCoJob,
+        DiLoCoRounds,
+        JobResources,
+    )
+    from hypha_tpu.messages import Adam, ModelType, PriceRange
+    from hypha_tpu.resources import Resources
+    from hypha_tpu.scheduler.orchestrator import Orchestrator
+
+    job = DiLoCoJob(
+        model={"model_type": ModelType.CAUSAL_LM, "family": "gpt2",
+               "config": {}, "seed": 1},
+        dataset="toy",
+        rounds=DiLoCoRounds(update_rounds=4, avg_samples_between_updates=8,
+                            max_batch_size=4),
+        inner_optimizer=Adam(lr=1e-3),
+        outer_optimizer=Nesterov(lr=0.7, momentum=0.9),
+        resources=JobResources(
+            num_workers=2,
+            worker=Resources(tpu=1.0, cpu=1.0, memory=10),
+            parameter_server=Resources(cpu=1.0, memory=10),
+            worker_price=PriceRange(bid=1.0, max=10.0),
+            parameter_server_price=PriceRange(bid=1.0, max=10.0),
+        ),
+        sync_mode="stream",
+        num_fragments=2,
+        num_ps_shards=2,
+    )
+    shard_map = ShardMap(
+        round=0, shards=["psA", "psB"], tags=["u.s0", "u.s1"], fragments=2
+    )
+    ctx = types.SimpleNamespace(
+        job=job,
+        base_id="base",
+        updates_tag="u",
+        results_tag="r",
+        shard_map=shard_map,
+        ps_handles=[types.SimpleNamespace(peer_id="psA"), None],
+        reduce_groups=[],
+    )
+    stub = types.SimpleNamespace(node=types.SimpleNamespace(peer_id="sched"))
+    handle = types.SimpleNamespace(peer_id="w0", batch_size=4)
+    spec = Orchestrator._train_spec(stub, ctx, "r1", handle, rejoin=True)
+    results_peers = list(spec.executor.train.results.ref.peers)
+    assert results_peers == ["psA", "psB"], results_peers
