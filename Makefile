@@ -3,7 +3,7 @@
 
 PYTHON ?= python
 
-.PHONY: lint lint-fixtures test compressbench streambench ftbench-ps shardbench
+.PHONY: lint lint-fixtures test compressbench streambench ftbench-ps shardbench servbench
 
 lint:
 	$(PYTHON) -m hypha_tpu.analysis hypha_tpu/
@@ -48,6 +48,16 @@ streambench:
 shardbench:
 	JAX_PLATFORMS=cpu $(PYTHON) benchmarks/shardbench.py \
 		--chaos kill-ps --out SHARDBENCH_r08.json
+
+# Paged KV serving + multi-worker routing: block-granular admission vs the
+# fixed-slot pool at equal KV memory (asserts >=1.5x concurrency, bounded
+# p99), late-arrival p50 under a concurrent 4k-token prompt (asserts <=2x,
+# chunked prefill), and routed 2-worker throughput under 100 clients
+# (asserts >=1.8x vs one worker). Writes SERVBENCH_r05.json
+# (docs/serving.md / docs/performance.md "Paged KV serving").
+servbench:
+	JAX_PLATFORMS=cpu $(PYTHON) benchmarks/servbench.py \
+		--out SERVBENCH_r05.json
 
 # Durable PS: kill the parameter server mid-round, restart it, and prove
 # the job completes with bounded recovery wall-clock (ft.durable journal +

@@ -278,8 +278,22 @@ async def _run_scheduler(conf: SchedulerConfig) -> None:
                 price=conf.job.worker_price(),
                 max_new_tokens=conf.job.serve_max_new_tokens,
                 max_batch=conf.job.serve_max_batch,
+                num_workers=conf.job.serve_workers,
+                queue_limit=conf.job.serve_queue_limit,
+                pool_block_size=conf.job.serve_block_size,
+                pool_blocks=conf.job.serve_blocks,
+                pool_prefill_chunk=conf.job.serve_prefill_chunk,
+                eos_token_id=(
+                    None
+                    if conf.job.serve_eos_token_id < 0
+                    else conf.job.serve_eos_token_id
+                ),
             )
-            print(f"serving {conf.job.serve_name!r}; ctrl-c to stop", flush=True)
+            print(
+                f"serving {conf.job.serve_name!r} "
+                f"x{conf.job.serve_workers}; ctrl-c to stop",
+                flush=True,
+            )
             runner = asyncio.create_task(sup.run())
             with tracer.span("serve_job", {"serve_name": conf.job.serve_name}):
                 # Watch the supervisor too: if it dies, surface the error

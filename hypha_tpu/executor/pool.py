@@ -25,6 +25,27 @@ Greedy only: sampled rows would draw from a shared key and their outputs
 would depend on batch composition, breaking seeded reproducibility (the
 same policy as worker.batcher, which remains the sampled/fallback path).
 
+**Paged mode** (``block_size > 0``, the vLLM/PagedAttention design): K/V
+live in a pool of ``num_blocks`` physical blocks of ``block_size``
+positions shared by every decode lane, mapped through per-lane block
+tables (``ops.kvcache`` paged layout — the table is cache *data*, so the
+one-compiled-program invariant holds). Admission is decided by **free
+blocks**, not free rows: a short request holds only the blocks its window
+actually needs, so the same KV memory admits several-fold more concurrent
+requests than whole-``max_len`` rows. A watermark reserve keeps blocks
+back for running requests to grow into; when growth would starve the pool
+anyway, the most recently admitted group is **preempted to the queue**
+(recompute resume, vLLM's policy — the youngest request carries the least
+sunk decode cost) and re-admitted later with its generated tokens folded
+into the prompt, reproducing the uncontended token stream exactly.
+**Chunked prefill**: prompts prefill ``prefill_chunk`` tokens per
+serve-loop iteration *interleaved* with decode chunks, so a long prompt
+no longer stalls every in-flight decode for one monolithic prefill
+program (bit-equal to monolithic prefill — the chunk attends to the same
+keys with the same positions). ``max_queue`` bounds the waiting line:
+beyond it ``submit`` fails fast with :class:`PoolBusy` carrying a
+retry-after hint instead of queueing unboundedly.
+
 The reference has no inference path at all (its Executor union is
 Train|Aggregate, crates/messages/src/lib.rs:627-631) — this is net-new
 capability, benchmarked in SERVBENCH (late-arrival p50 + aggregate tok/s).
@@ -36,6 +57,7 @@ import dataclasses
 import logging
 import queue
 import threading
+import time
 from concurrent.futures import Future
 from dataclasses import dataclass, field
 from typing import Any
@@ -44,9 +66,23 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-__all__ = ["DecodePool", "supports_pool"]
+from ..telemetry import SERVE_METRICS
+
+__all__ = ["DecodePool", "PoolBusy", "supports_pool", "supports_paging"]
 
 log = logging.getLogger("hypha.executor.pool")
+
+
+class PoolBusy(RuntimeError):
+    """Backpressure: the pool's waiting line is full. Callers should retry
+    after ``retry_after_s`` (surfaced on the wire as
+    ``GenerateResponse.retry_after_ms``) instead of piling onto the queue."""
+
+    def __init__(self, retry_after_s: float) -> None:
+        super().__init__(
+            f"pool queue is full; retry after {retry_after_s:.2f}s"
+        )
+        self.retry_after_s = retry_after_s
 
 
 def supports_pool(model: Any) -> bool:
@@ -54,6 +90,11 @@ def supports_pool(model: Any) -> bool:
     Llama/Mistral/Qwen2/Gemma configs — and Mixtral share the per-row
     attention; GPT-2's learned-position decode path is scalar-only.)"""
     return hasattr(model, "per_row_decode")
+
+
+def supports_paging(model: Any) -> bool:
+    """Per-row decode AND the paged cache layout fields (kv_blocks)."""
+    return supports_pool(model) and hasattr(model, "kv_blocks")
 
 
 def _bucket(n: int, lo: int = 16) -> int:
@@ -92,6 +133,30 @@ class _Group:
     rows: dict = field(default_factory=dict)  # lane -> slot
     admit_chunk: int = -1
     finish_chunk: int = -1
+    t_submit: float = 0.0  # request latency (SERVE_METRICS)
+    order: int = -1  # admission sequence; preemption picks the youngest
+
+
+@dataclass
+class _PRow:
+    """One prompt's state in the PAGED pool. Survives preemption: ``prompt``
+    and ``emitted`` persist, the lane/window/block state is rebuilt at
+    re-admission (recompute resume — the resume prompt is
+    ``prompt + emitted``, so greedy continuation reproduces the
+    uncontended stream exactly)."""
+
+    group: _Group
+    lane: int
+    prompt: list  # original token ids (never mutated)
+    budget: int
+    emitted: list = field(default_factory=list)
+    done: bool = False
+    # live-lane state, only meaningful while admitted
+    slot: int = -1
+    window: int = 0  # L: logical prompt-region length (multiple of P)
+    pos: int = 0  # logical write index: prefill progress, then decode
+    blocks: list = field(default_factory=list)
+    win_tokens: Any = None  # np[L] left-padded resume prompt
 
 
 class DecodePool:
@@ -112,15 +177,55 @@ class DecodePool:
         max_len: int = 512,
         steps_per_call: int = 8,
         eos_token_id: int | None = None,
+        block_size: int = 0,
+        num_blocks: int = 0,
+        prefill_chunk: int = 0,
+        reserve_blocks: int = -1,
+        max_queue: int = 0,
     ) -> None:
         if not supports_pool(model):
             raise ValueError(
                 f"{type(model).__name__} has no per-row decode path"
             )
+        self._paged = block_size > 0
+        if self._paged:
+            if not supports_paging(model):
+                raise ValueError(
+                    f"{type(model).__name__} has no paged KV cache fields"
+                )
+            if max_len % block_size != 0:
+                raise ValueError(
+                    f"max_len {max_len} must be a multiple of block_size "
+                    f"{block_size}"
+                )
+            if prefill_chunk <= 0:
+                prefill_chunk = min(max_len, 4 * block_size)
+            if max_len % prefill_chunk != 0:
+                raise ValueError(
+                    f"max_len {max_len} must be a multiple of prefill_chunk "
+                    f"{prefill_chunk}"
+                )
+            if prefill_chunk % block_size != 0:
+                # Windows are prefill_chunk-granular and block allocation
+                # counts L // block_size — a non-multiple would leave the
+                # prompt tail mapped to the garbage block (silently wrong
+                # tokens), so refuse the geometry outright.
+                raise ValueError(
+                    f"prefill_chunk {prefill_chunk} must be a multiple of "
+                    f"block_size {block_size}"
+                )
+            if num_blocks <= 0:
+                # Default: the same total KV positions the fixed-slot pool
+                # would hold — block admission then wins purely on packing.
+                num_blocks = slots * max_len // block_size
+        self.block_size = block_size
+        self.num_blocks = num_blocks if self._paged else 0
+        self.prefill_chunk = prefill_chunk if self._paged else 0
         self._model = model
-        self._dec = dataclasses.replace(
-            model, decode=True, decode_len=max_len, per_row_decode=True
-        )
+        dec_kw = dict(decode=True, decode_len=max_len, per_row_decode=True)
+        if self._paged:
+            dec_kw.update(kv_blocks=num_blocks, kv_block_size=block_size)
+        self._dec = dataclasses.replace(model, **dec_kw)
         if isinstance(params, dict) and "params" in params:
             self._vars = dict(params)
         else:
@@ -129,6 +234,12 @@ class DecodePool:
         self.max_len = max_len
         self.steps_per_call = steps_per_call
         self.eos_token_id = eos_token_id
+        # Watermark: blocks held back from admission so live requests can
+        # grow (one block per lane by default). Preemption backstops it.
+        if reserve_blocks < 0:
+            reserve_blocks = slots
+        self.reserve_blocks = reserve_blocks if self._paged else 0
+        self.max_queue = max(int(max_queue), 0)
 
         # Pool cache + current-token vector live on device for the whole
         # job; everything else is host bookkeeping.
@@ -144,6 +255,18 @@ class DecodePool:
 
         self._rows: dict[int, _Row] = {}
         self._free = list(range(slots))
+        # Paged host bookkeeping: lanes, blocks, and the row-variable
+        # mirrors pushed to device before every dispatched program.
+        self._lane_rows: dict[int, _PRow] = {}
+        self._free_lanes = list(range(slots))
+        self._free_blocks = list(range(self.num_blocks))
+        if self._paged:
+            max_blocks = max_len // block_size
+            self._h_idx = np.full((slots,), max_len, np.int32)
+            self._h_start = np.zeros((slots,), np.int32)
+            self._h_table = np.full(
+                (slots, max_blocks), self.num_blocks, np.int32
+            )
         self._queue: "queue.Queue[_Group | None]" = queue.Queue()
         self._waiting: list[_Group] = []
         # Guards the closed-check + enqueue in submit() against the serve
@@ -152,17 +275,69 @@ class DecodePool:
         # would never resolve.
         self._submit_lock = threading.Lock()
         self._closed = False
+        self._backlog = 0  # submitted, not yet admitted (queue-depth gauge)
+        self._admit_seq = 0
         self.chunks = 0  # decode programs dispatched (test/bench hook)
+        self.prefill_chunks = 0  # paged: chunked-prefill programs dispatched
+        self.preemptions = 0
         self.requests = 0
         self._prefill_cache: dict = {}
         self._insert_cache: dict = {}
         self._chunk_fn = None
+        self._prefill_paged_fn = None
+        self._sync_fn = None
         self._thread = threading.Thread(
             target=self._serve_loop, name="decode-pool", daemon=True
         )
         self._thread.start()
 
+    # ---------------------------------------------------------- load stats
+
+    def free_blocks(self) -> int:
+        """Free KV blocks (paged) / free rows (fixed-slot) — the admission
+        headroom reported on ServeLoad heartbeats for router balancing."""
+        return len(self._free_blocks) if self._paged else len(self._free)
+
+    def queue_depth(self) -> int:
+        """Groups submitted but not yet admitted."""
+        with self._submit_lock:
+            return self._backlog
+
+    def live_rows(self) -> int:
+        """Rows currently decoding/prefilling (either mode)."""
+        return len(self._rows) + len(self._lane_rows)
+
     # ------------------------------------------------------------ public
+
+    def _pwin(self, n: int) -> int:
+        """Paged window for an ``n``-token (resume) prompt: the smallest
+        multiple of ``prefill_chunk`` that holds it (P-granular, not
+        power-of-two — the paged prefill program has ONE shape)."""
+        P = self.prefill_chunk
+        return max(-(-max(n, 1) // P) * P, P)
+
+    def _paged_reject(self, prompts: list, n_new: int) -> str | None:
+        """Why the paged pool can never serve this request (None = fits).
+
+        The window bound reserves ``prefill_chunk`` of slack because a
+        preempted request resumes with its generated tokens folded into
+        the prompt — the resume window can round up to one more chunk
+        than the original (see _admit_paged)."""
+        P = self.prefill_chunk
+        longest = max(len(p) for p in prompts)
+        limit = self._pwin(longest) + n_new + P
+        if limit > self.max_len:
+            return (
+                f"paged window {self._pwin(longest)} + {n_new} new tokens "
+                f"+ {P} resume slack exceed the pool window {self.max_len}"
+            )
+        need = len(prompts) * (-(-limit // self.block_size))
+        if need > self.num_blocks:
+            return (
+                f"request needs up to {need} KV blocks but the pool has "
+                f"{self.num_blocks}"
+            )
+        return None
 
     def fits(self, prompts: list, n_new: int) -> bool:
         """Would ``submit`` accept this request? Callers with a one-shot
@@ -173,6 +348,8 @@ class DecodePool:
             return False
         if len(prompts) > self.slots:
             return False
+        if self._paged:
+            return self._paged_reject(prompts, n_new) is None
         return _bucket(max(len(p) for p in prompts)) + n_new <= self.max_len
 
     def submit(self, prompts: list, n_new: int) -> Future:
@@ -186,15 +363,21 @@ class DecodePool:
                 ValueError(f"{len(prompts)} prompts exceed {self.slots} slots")
             )
             return fut
-        too_long = max(len(p) for p in prompts)
-        if _bucket(too_long) + n_new > self.max_len:
-            fut.set_exception(
-                ValueError(
-                    f"prompt bucket {_bucket(too_long)} + {n_new} new tokens "
-                    f"exceed the pool window {self.max_len}"
+        if self._paged:
+            reason = self._paged_reject(prompts, n_new)
+            if reason is not None:
+                fut.set_exception(ValueError(reason))
+                return fut
+        else:
+            too_long = max(len(p) for p in prompts)
+            if _bucket(too_long) + n_new > self.max_len:
+                fut.set_exception(
+                    ValueError(
+                        f"prompt bucket {_bucket(too_long)} + {n_new} new "
+                        f"tokens exceed the pool window {self.max_len}"
+                    )
                 )
-            )
-            return fut
+                return fut
         # closed-check + enqueue as ONE atomic step against _fail_all's
         # drain: either this group lands before the drain (and is failed by
         # it), or the check sees _closed (always set before the drain runs)
@@ -203,8 +386,19 @@ class DecodePool:
             if self._closed:
                 fut.set_exception(RuntimeError("pool is closed"))
                 return fut
+            if self.max_queue and self._backlog >= self.max_queue:
+                # Reject-with-retry-after instead of unbounded queueing:
+                # the hint scales with how far over the line we are.
+                SERVE_METRICS.rejections.add(1)
+                fut.set_exception(
+                    PoolBusy(0.05 * (self._backlog - self.max_queue + 1))
+                )
+                return fut
             self.requests += 1
-            self._queue.put(_Group(prompts, int(n_new), fut))
+            self._backlog += 1
+            group = _Group(prompts, int(n_new), fut)
+            group.t_submit = time.monotonic()
+            self._queue.put(group)
         return fut
 
     def close(self, wait: bool = True) -> None:
@@ -231,6 +425,7 @@ class DecodePool:
                     break
                 if item is not None:
                     self._waiting.append(item)
+            self._backlog = 0
         for g in self._waiting:
             if not g.fut.done():
                 g.fut.set_exception(exc)
@@ -239,6 +434,10 @@ class DecodePool:
             if not row.group.fut.done():
                 row.group.fut.set_exception(exc)
         self._rows.clear()
+        for prow in self._lane_rows.values():
+            if not prow.group.fut.done():
+                prow.group.fut.set_exception(exc)
+        self._lane_rows.clear()
 
     # --------------------------------------------------------- jit pieces
 
@@ -309,12 +508,77 @@ class DecodePool:
         self._chunk_fn = jax.jit(chunk, donate_argnums=(1, 2))
         return self._chunk_fn
 
+    def _sync(self):
+        """One compiled setter for the host-owned row variables: idx, start
+        and (paged) block table are data the host rewrites before every
+        dispatched program."""
+        if self._sync_fn is not None:
+            return self._sync_fn
+
+        def sync(cache, idx, start, table):
+            def repl(path, leaf):
+                key = getattr(path[-1], "key", None)
+                if key == "idx":
+                    return jnp.broadcast_to(idx, leaf.shape).astype(leaf.dtype)
+                if key == "start":
+                    return jnp.broadcast_to(start, leaf.shape).astype(
+                        leaf.dtype
+                    )
+                if key == "table":
+                    return jnp.broadcast_to(table, leaf.shape).astype(
+                        leaf.dtype
+                    )
+                return leaf
+
+            return jax.tree_util.tree_map_with_path(repl, cache)
+
+        self._sync_fn = jax.jit(sync, donate_argnums=(0,))
+        return self._sync_fn
+
+    def _prefill_paged(self):
+        """The chunked-prefill program: ONE shape ([slots, prefill_chunk])
+        for every prompt length — it writes through the pool's block
+        tables at each lane's current position, attending to the lane's
+        already-prefilled keys. Idle lanes ride along parked at the
+        ``max_len`` sentinel (their writes land in the garbage block)."""
+        if self._prefill_paged_fn is not None:
+            return self._prefill_paged_fn
+        dec = self._dec
+
+        def prefill(variables, cache, toks):
+            out = dec.apply(
+                {**variables, "cache": cache}, toks, mutable=["cache"]
+            )
+            logits, vars_ = out
+            if isinstance(logits, tuple):  # MoE: (logits, aux)
+                logits = logits[0]
+            last = jnp.argmax(logits[:, -1], axis=-1).astype(jnp.int32)
+            return vars_["cache"], last
+
+        self._prefill_paged_fn = jax.jit(prefill, donate_argnums=(1,))
+        return self._prefill_paged_fn
+
+    def _push_rowvars(self) -> None:
+        self._cache = self._sync()(
+            self._cache,
+            jnp.asarray(self._h_idx),
+            jnp.asarray(self._h_start),
+            jnp.asarray(self._h_table),
+        )
+
     # --------------------------------------------------------- serve loop
 
     def _serve_loop(self) -> None:
         try:
             while True:
-                live = bool(self._rows)
+                # Waiting groups count as live work: a preempted group must
+                # be re-admitted when the pool drains, not when the NEXT
+                # submit happens to wake the loop.
+                live = (
+                    bool(self._rows)
+                    or bool(self._lane_rows)
+                    or bool(self._waiting)
+                )
                 stop = False
                 try:
                     item = self._queue.get(block=not live)
@@ -337,9 +601,12 @@ class DecodePool:
                 if stop:
                     self._fail_all(RuntimeError("pool is closed"))
                     return
-                self._admit()
-                if self._rows:
-                    self._run_chunk()
+                if self._paged:
+                    self._step_paged()
+                else:
+                    self._admit()
+                    if self._rows:
+                        self._run_chunk()
         except Exception:
             log.exception("decode pool crashed")
             self._closed = True
@@ -350,6 +617,8 @@ class DecodePool:
         request at the head must not starve behind later small ones)."""
         while self._waiting and len(self._free) >= len(self._waiting[0].prompts):
             group = self._waiting.pop(0)
+            with self._submit_lock:
+                self._backlog -= 1
             self._admit_group(group)
 
     def _admit_group(self, group: _Group) -> None:
@@ -394,26 +663,251 @@ class DecodePool:
                 row.emitted.append(int(t))
         self._finish_done_rows()
 
-    def _finish_done_rows(self) -> None:
+    def _row_finished(self, row) -> bool:
+        """Budget/EOS completion check shared by both modes; pads an EOS
+        row's emitted tokens to budget (matching generate())."""
+        full = len(row.emitted) >= row.budget
         eos = self.eos_token_id
+        saw_eos = eos is not None and eos in row.emitted
+        if not (full or saw_eos):
+            return False
+        if saw_eos:
+            cut = row.emitted.index(eos) + 1
+            row.emitted = row.emitted[:cut] + [eos] * (row.budget - cut)
+        row.done = True
+        return True
+
+    def _resolve_group(self, group: _Group) -> None:
+        """All rows done: record latency, hand the tokens to the caller.
+        One implementation for both modes — the completion contract (and
+        its accounting) must not diverge paged vs fixed-slot."""
+        group.finish_chunk = self.chunks
+        if group.fut.done():
+            return
+        if group.t_submit:
+            SERVE_METRICS.request_finished(
+                (time.monotonic() - group.t_submit) * 1e3
+            )
+        group.fut.set_result(
+            [group.rows[i].emitted for i in range(len(group.prompts))]
+        )
+
+    def _finish_done_rows(self) -> None:
         for slot, row in list(self._rows.items()):
-            full = len(row.emitted) >= row.budget
-            saw_eos = eos is not None and eos in row.emitted
-            if not (full or saw_eos):
+            if not self._row_finished(row):
                 continue
-            if saw_eos:  # pad to budget with eos, matching generate()
-                cut = row.emitted.index(eos) + 1
-                row.emitted = row.emitted[:cut] + [eos] * (
-                    row.budget - cut
-                )
-            row.done = True
             del self._rows[slot]
             self._free.append(slot)
             group = row.group
             group.rows[row.lane] = row
             if all(isinstance(r, _Row) and r.done for r in group.rows.values()):
-                group.finish_chunk = self.chunks
-                if not group.fut.done():
-                    group.fut.set_result(
-                        [group.rows[i].emitted for i in range(len(group.prompts))]
+                self._resolve_group(group)
+
+    # ------------------------------------------------------- paged serving
+
+    def _step_paged(self) -> None:
+        """One serve-loop iteration in paged mode: admit what fits, advance
+        chunked prefills, then run one decode chunk — prefill and decode
+        interleave, so a long prompt costs running requests at most one
+        ``prefill_chunk`` program per decode chunk, never a monolithic
+        prefill stall."""
+        self._admit_paged()
+        pre = [r for r in self._lane_rows.values() if r.pos < r.window]
+        if pre:
+            self._run_prefill_chunk(pre)
+            self._finish_paged()
+        dec = [
+            r
+            for r in self._lane_rows.values()
+            if r.pos >= r.window and not r.done
+        ]
+        if dec:
+            self._run_decode_chunk(dec)
+            self._finish_paged()
+        SERVE_METRICS.pool_state(len(self._free_blocks), self.queue_depth())
+
+    def _admit_paged(self) -> None:
+        """FIFO block-granular admission: the head group is admitted when
+        it has lanes AND its prompt-region blocks fit above the watermark
+        reserve (held back so live requests can grow). An empty pool
+        admits anything that fits the absolute bound — the reserve must
+        not park the only customer."""
+        while self._waiting:
+            group = self._waiting[0]
+            if not group.rows:
+                for lane, p in enumerate(group.prompts):
+                    group.rows[lane] = _PRow(
+                        group, lane, list(p), group.n_new
                     )
+            live = [r for r in group.rows.values() if not r.done]
+            if len(live) > len(self._free_lanes):
+                break
+            L = self._pwin(
+                max(len(r.prompt) + len(r.emitted) for r in live)
+            )
+            need = len(live) * (L // self.block_size)
+            free = len(self._free_blocks)
+            if free < need:
+                break
+            if self._lane_rows and free - need < self.reserve_blocks:
+                break
+            self._waiting.pop(0)
+            with self._submit_lock:
+                self._backlog -= 1
+            self._admit_seq += 1
+            group.order = self._admit_seq
+            group.admit_chunk = self.chunks
+            for r in live:
+                full = r.prompt + r.emitted  # recompute-resume prompt
+                r.slot = self._free_lanes.pop()
+                r.window = L
+                r.pos = 0
+                r.win_tokens = np.zeros((L,), np.int32)
+                r.win_tokens[L - len(full):] = full
+                r.blocks = [
+                    self._free_blocks.pop()
+                    for _ in range(L // self.block_size)
+                ]
+                self._lane_rows[r.slot] = r
+                self._h_start[r.slot] = L - len(full)
+                self._h_table[r.slot, :] = self.num_blocks
+                self._h_table[r.slot, : len(r.blocks)] = r.blocks
+            SERVE_METRICS.admissions.add(1)
+
+    def _run_prefill_chunk(self, pre: list) -> None:
+        P = self.prefill_chunk
+        toks = np.zeros((self.slots, P), np.int32)
+        self._h_idx[:] = self.max_len  # park every lane in the garbage block
+        for r in pre:
+            toks[r.slot] = r.win_tokens[r.pos : r.pos + P]
+            self._h_idx[r.slot] = r.pos
+        self._push_rowvars()
+        self._cache, last = self._prefill_paged()(
+            self._vars, self._cache, jnp.asarray(toks)
+        )
+        self.prefill_chunks += 1
+        last_host = np.asarray(last)
+        for r in pre:
+            r.pos += P
+            if r.pos >= r.window:
+                # The final chunk's last position is the last (resume)
+                # prompt token — its argmax is the next generated token,
+                # exactly the monolithic prefill's output.
+                r.emitted.append(int(last_host[r.slot]))
+
+    def _grow(self, r: _PRow) -> bool:
+        """Allocate the blocks the next decode chunk will write for ``r``,
+        preempting the youngest other group when the pool is dry."""
+        remaining = max(r.budget - len(r.emitted), 0)
+        target = r.pos + min(self.steps_per_call, remaining)
+        need = -(-target // self.block_size)
+        while len(r.blocks) < need:
+            if self._free_blocks:
+                b = self._free_blocks.pop()
+                self._h_table[r.slot, len(r.blocks)] = b
+                r.blocks.append(b)
+                continue
+            victim = self._pick_victim(exclude=r.group)
+            if victim is None:
+                return False
+            self._preempt(victim)
+        return True
+
+    def _pick_victim(self, exclude: _Group) -> _Group | None:
+        """The most recently admitted live group (vLLM's preemption order:
+        the youngest request has the least sunk decode cost to recompute)."""
+        victims: dict[int, _Group] = {}
+        for r in self._lane_rows.values():
+            if r.group is not exclude:
+                victims[id(r.group)] = r.group
+        if not victims:
+            return None
+        return max(victims.values(), key=lambda g: g.order)
+
+    def _preempt(self, group: _Group) -> None:
+        """Preemption-to-queue with recompute resume: free the group's
+        lanes and blocks, park it at the HEAD of the waiting line; its
+        emitted tokens fold into the resume prompt at re-admission, so
+        greedy continuation is token-identical to an uncontended run."""
+        for r in list(group.rows.values()):
+            if r.slot < 0 or r.done:
+                continue
+            self._free_blocks.extend(r.blocks)
+            self._h_table[r.slot, :] = self.num_blocks
+            self._h_idx[r.slot] = self.max_len
+            del self._lane_rows[r.slot]
+            self._free_lanes.append(r.slot)
+            r.slot = -1
+            r.blocks = []
+            r.pos = 0
+            r.window = 0
+            r.win_tokens = None
+        self._waiting.insert(0, group)
+        with self._submit_lock:
+            self._backlog += 1
+        self.preemptions += 1
+        SERVE_METRICS.preemptions.add(1)
+
+    def _run_decode_chunk(self, dec: list) -> None:
+        K = self.steps_per_call
+        for r in list(dec):
+            if r.slot < 0 or r.done:  # preempted by an earlier _grow
+                continue
+            if not self._grow(r):
+                # Defensive: fits() bounds every group's worst-case block
+                # need, so a sole live group always grows. Fail loudly
+                # rather than wedge the serve loop.
+                self._fail_group(
+                    r.group, RuntimeError("paged pool exhausted")
+                )
+        live = [r for r in dec if r.slot >= 0 and not r.done]
+        if not live:
+            return
+        tok = np.zeros((self.slots,), np.int32)
+        self._h_idx[:] = self.max_len
+        for r in live:
+            tok[r.slot] = r.emitted[-1]
+            self._h_idx[r.slot] = r.pos
+        self._push_rowvars()
+        chunk = self._chunk()
+        self._cache, _, toks = chunk(
+            self._vars, self._cache, jnp.asarray(tok)
+        )
+        self.chunks += 1
+        toks_host = np.asarray(toks)  # [K, slots]
+        for r in live:
+            for t in toks_host[:, r.slot]:
+                if len(r.emitted) >= r.budget:
+                    break
+                r.emitted.append(int(t))
+            r.pos += K
+
+    def _fail_group(self, group: _Group, exc: Exception) -> None:
+        for r in list(group.rows.values()):
+            if r.slot >= 0:
+                self._free_blocks.extend(r.blocks)
+                self._h_table[r.slot, :] = self.num_blocks
+                self._h_idx[r.slot] = self.max_len
+                self._lane_rows.pop(r.slot, None)
+                self._free_lanes.append(r.slot)
+                r.slot = -1
+                r.blocks = []
+        if not group.fut.done():
+            group.fut.set_exception(exc)
+
+    def _finish_paged(self) -> None:
+        for slot, r in list(self._lane_rows.items()):
+            if r.pos < r.window:
+                continue  # still prefilling
+            if not self._row_finished(r):
+                continue
+            self._free_blocks.extend(r.blocks)
+            self._h_table[slot, :] = self.num_blocks
+            self._h_idx[slot] = self.max_len
+            r.blocks = []
+            r.slot = -1
+            del self._lane_rows[slot]
+            self._free_lanes.append(slot)
+            group = r.group
+            if all(pr.done for pr in group.rows.values()):
+                self._resolve_group(group)

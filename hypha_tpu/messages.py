@@ -33,6 +33,7 @@ __all__ = [
     "PROTOCOL_HEALTH",
     "PROTOCOL_PROGRESS",
     "PROTOCOL_GENERATE",
+    "PROTOCOL_SERVE",
     "PROTOCOL_STREAM",
     "PROTOCOL_SHARD",
     "TOPIC_WORKER",
@@ -65,6 +66,9 @@ __all__ = [
     # gossip
     "RequestWorker",
     "PriceRange",
+    # serving plane (request router health/load)
+    "ServeLoad",
+    "ServeLoadAck",
     # streaming outer sync
     "FragmentTag",
     # sharded parameter service
@@ -98,6 +102,10 @@ PROTOCOL_API = "/hypha-api/0.0.1"
 PROTOCOL_HEALTH = "/hypha-health/0.0.1"
 PROTOCOL_PROGRESS = "/hypha-progress/0.0.1"
 PROTOCOL_GENERATE = "/hypha-generate/0.0.1"
+# Serving plane health/load (scheduler.serving request router): serving
+# workers heartbeat their queue depth + free KV blocks to the router so it
+# can load-balance, apply backpressure, and feed its φ-accrual ejector.
+PROTOCOL_SERVE = "/hypha-serve/0.0.1"
 # Streaming outer sync (hypha_tpu.stream): the fragment-tagged tensor
 # pushes — fragment deltas up, per-fragment update broadcasts down — whose
 # headers carry a FragmentTag.
@@ -642,6 +650,28 @@ class InferExecutorConfig:
     # Decode steps per dispatched program: admission/release latency is one
     # chunk; dispatch overhead amortizes over it.
     pool_chunk: int = 8
+    # Paged KV allocation (executor.pool paged mode, vLLM-style): > 0
+    # switches admission from whole KV rows to free BLOCKS of this many
+    # positions, with chunked prefill and preemption-to-queue. 0 = the
+    # fixed-slot pool, byte-identical to the pre-paging wire/behavior.
+    # Additive fields: absent on the wire = paging off, old peers interop.
+    pool_block_size: int = 0
+    # Physical KV blocks per layer (0 = derive: the same total positions
+    # the fixed-slot pool would hold, slots*max_len/block_size).
+    pool_blocks: int = 0
+    # Chunked prefill: prompt tokens prefilled per serve-loop iteration,
+    # interleaved with decode chunks (0 = derive: 4*block_size).
+    pool_prefill_chunk: int = 0
+    # Backpressure: reject-with-retry-after once this many requests are
+    # queued unadmitted (0 = unbounded queueing, the pre-router behavior).
+    queue_limit: int = 0
+    # EOS row release: rows emitting this token free their KV at the next
+    # chunk boundary instead of decoding to budget (None = fall back to
+    # the model config's eos_token_id, else no early release).
+    eos_token_id: int | None = None
+    # Load-report heartbeat cadence toward the scheduler-side router
+    # (ServeLoad on /hypha-serve/0.0.1; 0 disables reporting).
+    load_report_s: float = 1.0
 
 
 @register
@@ -661,6 +691,41 @@ class GenerateRequest:
 @dataclass(slots=True)
 class GenerateResponse:
     tokens: list  # list[list[int]], one continuation per prompt
+    # Backpressure (additive fields: absent on the wire = accepted, so old
+    # peers interop): ok=False means the server/router rejected the
+    # request under load — retry after ``retry_after_ms`` instead of
+    # queueing unboundedly (generate_remote honors this automatically).
+    ok: bool = True
+    retry_after_ms: float = 0.0
+
+
+@register
+@dataclass(slots=True)
+class ServeLoad:
+    """Serving worker → request router load heartbeat
+    (``/hypha-serve/0.0.1``).
+
+    Piggybacks the pool's admission headroom onto the liveness signal: the
+    router balances new requests by ``queue_depth`` (then ``free_blocks``),
+    feeds its φ-accrual detector with the arrival times, and ejects +
+    re-auctions a worker whose heartbeats stop. ``free_blocks`` counts KV
+    blocks in paged mode and free KV rows in fixed-slot mode — either way,
+    bigger = more admission headroom.
+    """
+
+    job_id: str = ""
+    serve_name: str = ""
+    queue_depth: int = 0
+    free_blocks: int = 0
+    live_requests: int = 0
+    requests: int = 0  # served since job start (monotonic)
+    rejections: int = 0  # backpressure rejections since job start
+
+
+@register
+@dataclass(slots=True)
+class ServeLoadAck:
+    ok: bool = True
 
 
 @register
@@ -1045,6 +1110,7 @@ declare_protocol(
 declare_protocol(PROTOCOL_HEALTH, "HealthRequest", "HealthResponse")
 declare_protocol(PROTOCOL_PROGRESS, "Progress", "ProgressResponse")
 declare_protocol(PROTOCOL_GENERATE, "GenerateRequest", "GenerateResponse")
+declare_protocol(PROTOCOL_SERVE, "ServeLoad", "ServeLoadAck")
 declare_protocol(PROTOCOL_STREAM, "FragmentTag")
 declare_protocol(PROTOCOL_SHARD, "ShardMap")
 declare_protocol(f"gossip:{TOPIC_WORKER}", "RequestWorker")

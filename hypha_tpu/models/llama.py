@@ -175,6 +175,12 @@ class _Attention(nn.Module):
     # and left-pad start (executor.pool.DecodePool admits/releases rows at
     # token boundaries, so rows sit at different positions).
     per_row_decode: bool = False
+    # Paged KV (executor.pool paged mode): kv_blocks > 0 re-layouts the
+    # cache as a shared block pool addressed through a per-lane block
+    # table (ops.kvcache paged mode). Attention math is unchanged — the
+    # cache update hands back the same dense per-lane views.
+    kv_blocks: int = 0
+    kv_block_size: int = 0
 
     def _proj(self, x, features, use_bias, dtype, name):
         """Dense projection, plus the low-rank LoRA path when enabled.
@@ -253,7 +259,8 @@ class _Attention(nn.Module):
 
                 full_k, full_v, offset, start = update_kv_cache(
                     self, k, v, self.decode_len, prepare=_rope_rows,
-                    per_row=True,
+                    per_row=True, blocks=self.kv_blocks,
+                    block_size=self.kv_block_size,
                 )
                 attn = dot_product_attention(
                     roped["q"], full_k, full_v, causal=True, q_offset=offset,
@@ -337,13 +344,16 @@ class _Block(nn.Module):
     decode: bool = False
     decode_len: int = 0
     per_row_decode: bool = False
+    kv_blocks: int = 0
+    kv_block_size: int = 0
 
     @nn.compact
     def __call__(self, x, cos, sin):
         cfg = self.config
         x = x + _Attention(
             cfg, self.attn_impl, self.decode, self.decode_len,
-            self.per_row_decode, name="self_attn"
+            self.per_row_decode, self.kv_blocks, self.kv_block_size,
+            name="self_attn"
         )(_RMSNorm(cfg.rms_eps, cfg.rms_offset, name="input_layernorm")(x), cos, sin)
         x = x + _MLP(cfg, name="mlp")(
             _RMSNorm(cfg.rms_eps, cfg.rms_offset, name="post_attention_layernorm")(x)
@@ -357,6 +367,9 @@ class Llama(nn.Module):
     decode: bool = False  # serving mode: KV-cached autoregressive forward
     decode_len: int = 0
     per_row_decode: bool = False  # continuous-batching pool (executor.pool)
+    # Paged KV serving (executor.pool paged mode): block-pool cache layout.
+    kv_blocks: int = 0
+    kv_block_size: int = 0
     # with_head=False returns final hidden states [B, S, E] — the
     # chunked-CE training path (executor.train.chunked_causal_ce) projects
     # to vocab inside the loss so [B, S, 32000] f32 logits never
@@ -385,7 +398,8 @@ class Llama(nn.Module):
         for i in range(cfg.num_layers):
             x = block_cls(
                 cfg, self.attn_impl, self.decode, self.decode_len,
-                self.per_row_decode, name=f"layers_{i}",
+                self.per_row_decode, self.kv_blocks, self.kv_block_size,
+                name=f"layers_{i}",
             )(x, cos, sin)
         x = _RMSNorm(cfg.rms_eps, cfg.rms_offset, name="norm")(x)
         if not self.with_head:

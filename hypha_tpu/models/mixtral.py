@@ -191,6 +191,8 @@ class _MoEBlock(nn.Module):
     decode_len: int = 0
     dropless: bool = False  # drop-free MoE routing (see MoELayer)
     per_row_decode: bool = False  # continuous-batching pool (executor.pool)
+    kv_blocks: int = 0  # paged KV serving (executor.pool paged mode)
+    kv_block_size: int = 0
 
     @nn.compact
     def __call__(self, x, cos, sin):
@@ -198,7 +200,8 @@ class _MoEBlock(nn.Module):
         lcfg = cfg.as_llama()
         x = x + _Attention(
             lcfg, self.attn_impl, self.decode, self.decode_len,
-            self.per_row_decode, name="self_attn"
+            self.per_row_decode, self.kv_blocks, self.kv_block_size,
+            name="self_attn"
         )(_RMSNorm(cfg.rms_eps, name="input_layernorm")(x), cos, sin)
         moe_out, aux = MoELayer(
             cfg,
@@ -217,6 +220,8 @@ class Mixtral(nn.Module):
     decode_len: int = 0
     dropless: bool = False  # drop-free routing in the plain forward too
     per_row_decode: bool = False  # continuous-batching pool (executor.pool)
+    kv_blocks: int = 0  # paged KV serving (executor.pool paged mode)
+    kv_block_size: int = 0
     # with_head=False returns (hidden [B, S, E], aux) for the chunked-CE
     # training path (see llama.py / gpt2.py).
     with_head: bool = True
@@ -245,7 +250,8 @@ class Mixtral(nn.Module):
         for i in range(cfg.num_layers):
             x, aux = block_cls(
                 cfg, self.attn_impl, self.decode, self.decode_len,
-                self.dropless, self.per_row_decode, name=f"layers_{i}",
+                self.dropless, self.per_row_decode, self.kv_blocks,
+                self.kv_block_size, name=f"layers_{i}",
             )(x, cos, sin)
             aux_total = aux_total + aux
         x = _RMSNorm(cfg.rms_eps, name="norm")(x)

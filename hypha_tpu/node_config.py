@@ -250,6 +250,45 @@ class JobSection:
     serve_max_batch: int = field(
         default=8, metadata={"doc": "serve jobs: prompts per request cap"}
     )
+    serve_workers: int = field(
+        default=1,
+        metadata={
+            "doc": "serve jobs: routed deployments to keep alive (>1 turns "
+            "the supervisor into a request router with health ejection)"
+        },
+    )
+    serve_queue_limit: int = field(
+        default=0,
+        metadata={
+            "doc": "serve jobs: queue-depth backpressure — reject with "
+            "retry-after beyond this many queued requests (0 = unbounded)"
+        },
+    )
+    serve_block_size: int = field(
+        default=0,
+        metadata={
+            "doc": "serve jobs: paged KV block size in positions "
+            "(0 = fixed-slot pool, the pre-paging behavior)"
+        },
+    )
+    serve_blocks: int = field(
+        default=0,
+        metadata={"doc": "serve jobs: physical KV blocks (0 = derive)"},
+    )
+    serve_prefill_chunk: int = field(
+        default=0,
+        metadata={
+            "doc": "serve jobs: chunked-prefill tokens per decode chunk "
+            "(0 = derive: 4x block size)"
+        },
+    )
+    serve_eos_token_id: int = field(
+        default=-1,
+        metadata={
+            "doc": "serve jobs: EOS token freeing KV rows early "
+            "(-1 = use the model config's eos_token_id)"
+        },
+    )
     dataset: str = field(
         default="mnist", metadata={"doc": "dataset name announced by a data node"}
     )
@@ -363,6 +402,12 @@ class JobSection:
                 raise ConfigError("job.serve_max_new_tokens must be >= 1")
             if self.serve_max_batch < 1:
                 raise ConfigError("job.serve_max_batch must be >= 1")
+            if self.serve_workers < 1:
+                raise ConfigError("job.serve_workers must be >= 1")
+            if self.serve_queue_limit < 0:
+                raise ConfigError("job.serve_queue_limit must be >= 0")
+            if self.serve_block_size < 0:
+                raise ConfigError("job.serve_block_size must be >= 0")
             return  # dataset/rounds are train-only concerns
         if not self.dataset:
             raise ConfigError("job.dataset is required")

@@ -5,7 +5,7 @@ dynamic_update_slice writes, index advance) used by every model family's
 decode branch (models.gpt2, models.llama) — a cache-layout change lands
 once, not per family.
 
-Two modes:
+Three modes:
 
 * **scalar** (default): one cache index shared by every row — the one-shot
   ``executor.generate`` path, where all rows prefill and decode in
@@ -16,6 +16,25 @@ Two modes:
   token boundaries and therefore sit at different positions. The ``start``
   vector marks where each row's left-padded prompt begins so attention can
   mask the pad slots (and RoPE can compute logical positions) per row.
+* **paged** (``per_row=True`` + ``blocks > 0``): the vLLM layout — K/V
+  live in a flat pool of ``blocks`` physical blocks of ``block_size``
+  positions shared by every lane, and each lane's logical window maps to
+  physical positions through a per-lane ``table`` of block ids. The table
+  is a *cache variable* — data, not shape — so one compiled program
+  serves every allocation state; the pool host rewrites idx/start/table
+  between dispatches. Attention still sees a dense [B, decode_len] view
+  (gathered through the table), so the masking/RoPE math is byte-for-byte
+  the per-row path's.
+
+Paged addressing safety: the pool allocates one extra *garbage block*
+(id ``blocks``) at the end of the K/V arrays. Any logical position not
+backed by an allocated block — an idle lane parked at ``idx >=
+decode_len``, table entries past a lane's allocation, writes beyond a
+finished request's budget — resolves to the garbage block: writes land in
+memory nothing reads meaningfully, and reads of it are masked (positions
+below ``start`` by the pad mask, positions at/after ``idx`` causally).
+Negative or wrapped indices can never occur: block ids are clamped into
+``[0, blocks]`` before the scatter/gather.
 """
 
 from __future__ import annotations
@@ -26,6 +45,21 @@ import jax.numpy as jnp
 __all__ = ["update_kv_cache"]
 
 
+def _physical(table, cols, block_size, max_blocks, blocks):
+    """Map logical window positions ``cols`` [B, S] to physical pool rows
+    through the per-lane block ``table`` [B, max_blocks]. Out-of-window
+    positions (idle-lane sentinels, chunk overruns) map into the garbage
+    block ``blocks``."""
+    bi = cols // block_size
+    safe = jnp.clip(bi, 0, max_blocks - 1)
+    blk = jnp.take_along_axis(table, safe, axis=1)
+    blk = jnp.where((cols >= 0) & (bi < max_blocks), blk, blocks)
+    # Unallocated table entries hold the sentinel ``blocks`` already; clamp
+    # defends against a corrupted table ever addressing past the pool.
+    blk = jnp.clip(blk, 0, blocks)
+    return blk * block_size + cols % block_size
+
+
 def update_kv_cache(
     module,
     k: jnp.ndarray,
@@ -34,6 +68,8 @@ def update_kv_cache(
     prepare=None,
     *,
     per_row: bool = False,
+    blocks: int = 0,
+    block_size: int = 0,
 ):
     """Append this step's K/V into ``module``'s cache collection.
 
@@ -56,8 +92,62 @@ def update_kv_cache(
     (row, idx_row + j); out-of-range indices (a released row decoding
     past ``decode_len``) are DROPPED by XLA scatter semantics, so stale
     rows can never corrupt live ones.
+
+    Paged mode (``blocks > 0``, requires ``per_row``): K/V pools are
+    [(blocks+1)*block_size, Hkv, D] shared across lanes (last block =
+    garbage sink), and a ``table`` cache variable [B, decode_len //
+    block_size] of physical block ids maps each lane's logical window
+    into the pool. Writes scatter through the table; the returned
+    ``full_k``/``full_v`` are the dense per-lane views gathered back out,
+    so downstream attention is unchanged.
     """
     B, S, Hkv, D = k.shape
+    if blocks > 0:
+        if not per_row:
+            raise ValueError("paged KV cache requires per_row=True")
+        if block_size <= 0 or decode_len % block_size != 0:
+            raise ValueError(
+                f"decode_len {decode_len} must be a positive multiple of "
+                f"block_size {block_size}"
+            )
+        max_blocks = decode_len // block_size
+        idx = module.variable(
+            "cache", "idx", lambda: jnp.zeros((B,), jnp.int32)
+        )
+        start = module.variable(
+            "cache", "start", lambda: jnp.zeros((B,), jnp.int32)
+        )
+        # Unallocated entries hold the garbage-block sentinel, so a fresh
+        # (or host-cleared) table can never alias a real block.
+        table = module.variable(
+            "cache",
+            "table",
+            lambda: jnp.full((B, max_blocks), blocks, jnp.int32),
+        )
+        offset = idx.value
+        if prepare is not None:
+            k, v = prepare(offset, start.value)
+        dtype = k.dtype
+        pool_rows = (blocks + 1) * block_size
+        ck = module.variable(
+            "cache", "k", jnp.zeros, (pool_rows, Hkv, D), dtype
+        )
+        cv = module.variable(
+            "cache", "v", jnp.zeros, (pool_rows, Hkv, D), dtype
+        )
+        cols = offset[:, None] + jnp.arange(S)[None, :]  # [B, S]
+        phys = _physical(table.value, cols, block_size, max_blocks, blocks)
+        ck.value = ck.value.at[phys.reshape(-1)].set(k.reshape(B * S, Hkv, D))
+        cv.value = cv.value.at[phys.reshape(-1)].set(v.reshape(B * S, Hkv, D))
+        # Dense per-lane views for the (unchanged) attention math. Window
+        # positions are always in-range, so only table sentinels route to
+        # the garbage block — and those positions are masked.
+        win = jnp.broadcast_to(jnp.arange(decode_len)[None, :], (B, decode_len))
+        phys_win = _physical(table.value, win, block_size, max_blocks, blocks)
+        full_k = ck.value[phys_win]  # [B, decode_len, Hkv, D]
+        full_v = cv.value[phys_win]
+        idx.value = offset + S
+        return full_k, full_v, offset, start.value
     if per_row:
         idx = module.variable(
             "cache", "idx", lambda: jnp.zeros((B,), jnp.int32)

@@ -1,46 +1,97 @@
-"""Scheduler-side serving supervisor: buy a worker, dispatch an infer job,
-keep it alive.
+"""Scheduler-side serving plane: N routed deployments, kept alive.
 
 The serving analog of the orchestrator's training supervision (BASELINE
 config 4 — "inference serving via the gateway on a TPU worker pool", a
-scenario the reference names but ships no code for): auction a worker with
-the infer executor, dispatch ``Executor(kind="infer")``, hold the lease via
-the renewal loop, and on worker failure re-auction and re-dispatch — the
+scenario the reference names but ships no code for): auction workers with
+the infer executor, dispatch ``Executor(kind="infer")``, hold the leases
+via the renewal loops, and on failure re-auction and re-dispatch — the
 same elastic-recovery shape the training orchestrator uses for replicas
 (scheduler/orchestrator.py).
+
+``num_workers > 1`` turns the supervisor into a **request router**:
+
+  * each deployment serves under an internal backend name
+    (``<name>@<slot>``) so clients never discover it directly; the
+    supervisor itself announces ``serve:<name>`` and answers
+    ``/hypha-generate/0.0.1`` by forwarding to the least-loaded backend
+    (queue depth + in-flight count, free KV blocks as the tiebreak);
+  * backends piggyback queue depth + free blocks on ``ServeLoad``
+    heartbeats (``/hypha-serve/0.0.1``), which double as the liveness
+    stream for a φ-accrual detector (hypha_tpu.ft.detector) — a worker
+    whose heartbeats stop is EJECTED (its lease handle is failed, the
+    supervision loop re-auctions the slot) even when lease renewals
+    still limp along. Renewals deliberately do NOT feed φ: they would
+    re-heal the suspicion of a worker whose serve path is wedged while
+    its lease loop stays alive — the exact case ejection exists for —
+    and their multi-second cadence would pollute the heartbeat
+    inter-arrival fit;
+  * ``queue_limit`` applies queue-depth backpressure at the router:
+    when every live backend is over the line, clients get
+    ``ok=False + retry_after_ms`` instead of an unbounded queue
+    (generate_remote retries on the hint).
+
+``num_workers=1`` (the default) keeps the exact single-deployment
+behavior this class always had: no router registration, the one backend
+announces ``serve:<name>`` itself, clients connect directly.
 """
 
 from __future__ import annotations
 
 import asyncio
+import dataclasses
 import logging
+import time
 import uuid
+from dataclasses import dataclass
 
 from .. import aio
+from ..ft.detector import PhiAccrualDetector
 from ..messages import (
     INFER_EXECUTOR_NAME,
     PROTOCOL_API,
+    PROTOCOL_GENERATE,
+    PROTOCOL_SERVE,
     CancelJob,
     Executor,
     ExecutorDescriptor,
+    GenerateRequest,
+    GenerateResponse,
     InferExecutorConfig,
     JobSpec,
     PriceRange,
+    ServeLoad,
+    ServeLoadAck,
     WorkerSpec,
 )
-from ..network.node import Node
+from ..network.node import Node, RequestError
 from ..resources import Resources
+from ..telemetry import SERVE_METRICS
+from ..worker.infer_executor import serve_key
 from .allocator import GreedyWorkerAllocator
 from .task import StatusRouter, Task
-from .worker_handle import WorkerHandle
+from .worker_handle import WorkerFailure, WorkerHandle
 
 __all__ = ["ServingSupervisor"]
 
 log = logging.getLogger("hypha.scheduler.serving")
 
 
+@dataclass
+class _Deployment:
+    slot: int
+    handle: WorkerHandle
+    task: Task
+    job_id: str
+    backend_name: str
+    status_wait: asyncio.Task | None = None
+    load: ServeLoad | None = None
+    load_at: float = 0.0
+    inflight: int = 0
+
+
 class ServingSupervisor:
-    """Keeps one serving deployment alive across worker failures."""
+    """Keeps ``num_workers`` serving deployments alive across worker
+    failures, routing requests across them when there is more than one."""
 
     def __init__(
         self,
@@ -54,92 +105,328 @@ class ServingSupervisor:
         max_batch: int = 8,
         auction_timeout: float = 2.0,
         retry_pause: float = 1.0,
+        num_workers: int = 1,
+        route: bool | None = None,
+        queue_limit: int = 0,
+        pool_block_size: int = 0,
+        pool_blocks: int = 0,
+        pool_prefill_chunk: int = 0,
+        eos_token_id: int | None = None,
+        load_report_s: float = 1.0,
+        phi_threshold: float = 8.0,
+        eject_check_s: float = 0.25,
+        request_timeout: float = 120.0,
     ) -> None:
         self.node = node
         self.serve_name = serve_name
+        self.num_workers = max(int(num_workers), 1)
+        # Routing defaults on exactly when there is something to balance;
+        # num_workers=1 without an explicit route=True is the pre-router
+        # supervisor, wire-identical.
+        self.route = (self.num_workers > 1) if route is None else bool(route)
         self._config = InferExecutorConfig(
             model=model,
             serve_name=serve_name,
             max_new_tokens=max_new_tokens,
             max_batch=max_batch,
+            pool_block_size=pool_block_size,
+            pool_blocks=pool_blocks,
+            pool_prefill_chunk=pool_prefill_chunk,
+            queue_limit=queue_limit,
+            eos_token_id=eos_token_id,
+            load_report_s=load_report_s if self.route else 0.0,
         )
+        self.queue_limit = max(int(queue_limit), 0)
         self._resources = resources or Resources(tpu=1.0, memory=100.0)
         self._price = price or PriceRange(bid=1.0, max=10.0)
         self._auction_timeout = auction_timeout
         self._retry_pause = retry_pause
+        self._request_timeout = request_timeout
         self._allocator = GreedyWorkerAllocator(node)
         self._router = StatusRouter(node)
+        self._detector = PhiAccrualDetector(threshold=phi_threshold)
+        self._eject_check_s = eject_check_s
+        # Ejection grace: φ alone fires on sub-second hiccups when the
+        # heartbeat cadence is fast (a GIL stall on a loaded host looks
+        # like death at 100 ms intervals) — require a minimum absolute
+        # silence too. The 5 s floor rides out XLA tracing/compiles of a
+        # first paged-pool submit, which starve the worker's event loop
+        # for seconds; a really dead worker blows through both gates.
+        self._eject_grace_s = max(10.0 * load_report_s, 5.0)
+        self._deployments: list[_Deployment | None] = [None] * self.num_workers
+        self._regs: list = []
+        self._announced = False
         self._stop = asyncio.Event()
         self.redeployments = 0  # failures recovered (observability/tests)
+        self.ejections = 0  # φ-accrual ejections (a subset of the above)
+
+    # ------------------------------------------------------------------ run
 
     async def run(self) -> None:
         """Supervise until :meth:`stop`; returns after teardown."""
-        handle: WorkerHandle | None = None
-        task: Task | None = None
-        job_id: str | None = None
+        eject_task: asyncio.Task | None = None
+        if self.route:
+            self._regs.append(
+                self.node.on(PROTOCOL_SERVE, ServeLoad)
+                # Backends report under their internal `<name>@<slot>`
+                # names; RPC dispatch is first-handler-wins per protocol,
+                # so without this match a second supervisor on the same
+                # scheduler node would starve this one of its heartbeats.
+                .match(
+                    lambda m: m.serve_name.split("@", 1)[0] == self.serve_name
+                )
+                .respond_with(self._on_load)
+            )
+            self._regs.append(
+                self.node.on(PROTOCOL_GENERATE, GenerateRequest)
+                .match(lambda m: m.serve_name == self.serve_name)
+                .concurrency(64)
+                .respond_with(self._route_request)
+            )
+            eject_task = aio.spawn(
+                self._eject_loop(), what="serving ejector", logger=log
+            )
         try:
             while not self._stop.is_set():
-                if handle is None:
+                await self._fill_slots()
+                if not any(d is not None for d in self._deployments):
+                    await self._pause()
+                    continue
+                if self.route and not self._announced:
+                    # Announce once at least one backend exists (the guard
+                    # above) — clients discovering the router before any
+                    # backend would spin on retry-after. Re-attempted every
+                    # iteration until it lands, so one transient registry
+                    # failure can't leave the service undiscoverable.
                     try:
-                        handle, task, job_id = await self._deploy()
-                    except asyncio.CancelledError:
-                        raise
-                    except Exception as e:
-                        # A worker dying mid-acceptance (or any transient
-                        # dispatch error) must not kill the supervisor whose
-                        # whole job is elastic recovery.
+                        await self.node.provide(serve_key(self.serve_name))
+                        self._announced = True
+                    except RequestError as e:
                         log.warning(
-                            "deploy of %s failed (%s); retrying",
+                            "router announce for %s failed: %s",
                             self.serve_name, e,
                         )
-                        handle = task = job_id = None
-                    if handle is None:
-                        await self._pause()
+                stop_wait = aio.spawn(
+                    self._stop.wait(), what="serving stop waiter"
+                )
+                waiters: dict[asyncio.Task | asyncio.Future, _Deployment] = {}
+                for dep in self._deployments:
+                    if dep is None:
                         continue
-                stop_wait = aio.spawn(self._stop.wait(), what="serving stop waiter")
-                # Watch BOTH failure channels: lease-renewal liveness
-                # (handle.failed) and the job's status stream — a job that
-                # fails while its worker stays healthy (e.g. model load
-                # error) reports JobStatus("failed") and must redeploy too.
-                status_wait = aio.spawn(
-                    task.next_status(), what="serving status waiter", logger=log
+                    if dep.status_wait is None or dep.status_wait.done():
+                        dep.status_wait = aio.spawn(
+                            dep.task.next_status(),
+                            what="serving status waiter",
+                            logger=log,
+                        )
+                    waiters[dep.status_wait] = dep
+                    waiters[dep.handle.failed] = dep
+                # An empty slot retries its auction (and an unannounced
+                # router retries its provide) on the pause cadence even
+                # while the healthy slots stay quiet.
+                needs_tick = any(d is None for d in self._deployments) or (
+                    self.route and not self._announced
                 )
                 done, _ = await asyncio.wait(
-                    {stop_wait, status_wait, handle.failed},
+                    {stop_wait, *waiters},
                     return_when=asyncio.FIRST_COMPLETED,
+                    timeout=self._retry_pause if needs_tick else None,
                 )
                 stop_wait.cancel()
-                redeploy = False
-                if handle.failed in done:
-                    log.warning(
-                        "serving worker %s failed (%s); redeploying",
-                        handle.peer_id, handle.failed.result(),
-                    )
-                    redeploy = True
-                elif status_wait in done and not status_wait.cancelled():
-                    peer, status = status_wait.result()
-                    if status.state == "running":
-                        continue  # informational; keep watching
-                    log.warning(
-                        "serving job %s reported %s on %s; redeploying",
-                        job_id, status.state, peer,
-                    )
-                    redeploy = True
-                status_wait.cancel()
-                if redeploy:
-                    self.redeployments += 1
-                    await self._teardown(handle, task, job_id)
-                    handle = task = job_id = None
+                if self._stop.is_set():
+                    return
+                for waiter in done:
+                    if waiter is stop_wait:
+                        continue
+                    dep = waiters.get(waiter)
+                    if dep is None or self._deployments[dep.slot] is not dep:
+                        continue
+                    if await self._handle_event(dep, waiter):
+                        self.redeployments += 1
+                        await self._teardown(dep)
+                        self._deployments[dep.slot] = None
         finally:
-            await self._teardown(handle, task, job_id)
+            await aio.reap(eject_task)
+            for dep in self._deployments:
+                if dep is not None:
+                    await self._teardown(dep)
+            self._deployments = [None] * self.num_workers
+            for reg in self._regs:
+                reg.close()
+            self._regs.clear()
+            if self._announced:
+                try:
+                    await self.node.unprovide(serve_key(self.serve_name))
+                except Exception:
+                    pass
+                self._announced = False
             self._router.close()
 
     async def stop(self) -> None:
         self._stop.set()
 
+    # ------------------------------------------------------------- routing
+
+    def _live_backends(self) -> list[_Deployment]:
+        return [d for d in self._deployments if d is not None]
+
+    def _score(self, dep: _Deployment) -> tuple:
+        """Lower is better: queued + in-flight work first, then the least
+        admission headroom last (free blocks as reported on ServeLoad).
+        Only called on backends whose ``load`` is set (the routable set)."""
+        return (dep.load.queue_depth + dep.inflight, -dep.load.free_blocks)
+
+    async def _route_request(
+        self, peer: str, req: GenerateRequest
+    ) -> GenerateResponse:
+        # Only backends that have reported a ServeLoad heartbeat are
+        # routable — a freshly dispatched job is still loading its model
+        # (no /hypha-generate handler yet). Until one is ready, clients
+        # get retry-after, the same contract as overload.
+        reported = [d for d in self._live_backends() if d.load is not None]
+        # Prefer FRESH loads: a backend whose reporter died keeps a frozen
+        # (usually flattering) score forever — route around it while any
+        # peer is reporting, but fall back to stale-but-live backends
+        # rather than turn a telemetry gap into an outage.
+        now = time.monotonic()
+        fresh = [
+            d for d in reported if now - d.load_at <= self._eject_grace_s
+        ]
+        backends = sorted(fresh or reported, key=self._score)
+        if not backends:
+            return GenerateResponse(tokens=[], ok=False, retry_after_ms=250.0)
+        if self.queue_limit:
+            depths = [d.load.queue_depth + d.inflight for d in backends]
+            if min(depths) >= self.queue_limit:
+                # Reject-with-retry-after: every backend is over the
+                # line; scale the hint with how deep the best one is.
+                SERVE_METRICS.rejections.add(1)
+                return GenerateResponse(
+                    tokens=[],
+                    ok=False,
+                    retry_after_ms=50.0 * (min(depths) - self.queue_limit + 1),
+                )
+        busy_hint = 0.0
+        last: Exception | None = None
+        for dep in backends:
+            fwd = dataclasses.replace(req, serve_name=dep.backend_name)
+            dep.inflight += 1
+            try:
+                resp = await self.node.request(
+                    dep.handle.peer_id,
+                    PROTOCOL_GENERATE,
+                    fwd,
+                    timeout=self._request_timeout,
+                )
+            except RequestError as e:
+                last = e
+                continue
+            finally:
+                dep.inflight -= 1
+            if getattr(resp, "ok", True):
+                SERVE_METRICS.routed_requests.add(1)
+                return resp
+            busy_hint = max(busy_hint, resp.retry_after_ms)
+        if busy_hint > 0.0:
+            return GenerateResponse(
+                tokens=[], ok=False, retry_after_ms=busy_hint
+            )
+        raise RequestError(
+            f"all {len(backends)} backends of {self.serve_name!r} "
+            f"failed: {last}"
+        )
+
+    async def _on_load(self, peer: str, load: ServeLoad) -> ServeLoadAck:
+        for dep in self._live_backends():
+            if dep.job_id == load.job_id and dep.handle.peer_id == peer:
+                dep.load = load
+                dep.load_at = time.monotonic()
+                self._detector.heartbeat(peer)
+                return ServeLoadAck(ok=True)
+        return ServeLoadAck(ok=False)  # stale job (already torn down)
+
+    async def _eject_loop(self) -> None:
+        """Health-based ejection: a backend whose ServeLoad heartbeats (or
+        lease renewals — both feed φ) go silent is failed through its
+        lease handle, which the supervision loop already treats as a
+        worker death: teardown, re-auction, re-dispatch."""
+        while True:
+            await asyncio.sleep(self._eject_check_s)
+            self._eject_pass()
+
+    def _eject_pass(self) -> None:
+        now = time.monotonic()
+        for dep in self._live_backends():
+            peer = dep.handle.peer_id
+            if dep.load is None:
+                # Still loading its model (minutes for a 7B) — no
+                # heartbeats to judge by; a real death there fails the
+                # lease renewal instead.
+                continue
+            if now - dep.load_at < self._eject_grace_s:
+                continue
+            if not self._detector.suspected(peer):
+                continue
+            self.ejections += 1
+            SERVE_METRICS.ejections.add(1)
+            self._detector.remove(peer)
+            log.warning(
+                "ejecting serving worker %s (phi over threshold %.1f)",
+                peer, self._detector.threshold,
+            )
+            if not dep.handle.failed.done():
+                dep.handle.failed.set_result(
+                    WorkerFailure(peer, "phi-accrual ejection")
+                )
+
     # ------------------------------------------------------------------ impl
 
-    async def _deploy(self) -> tuple[WorkerHandle | None, Task | None, str | None]:
+    async def _fill_slots(self) -> None:
+        """Deploy into every empty slot."""
+        for slot in range(self.num_workers):
+            if self._deployments[slot] is not None or self._stop.is_set():
+                continue
+            try:
+                dep = await self._deploy(slot)
+            except asyncio.CancelledError:
+                raise
+            except Exception as e:
+                # A worker dying mid-acceptance (or any transient dispatch
+                # error) must not kill the supervisor whose whole job is
+                # elastic recovery.
+                log.warning(
+                    "deploy of %s slot %d failed (%s); retrying",
+                    self.serve_name, slot, e,
+                )
+                dep = None
+            if dep is not None:
+                self._deployments[slot] = dep
+
+    async def _handle_event(self, dep: _Deployment, waiter) -> bool:
+        """True when the deployment must be torn down and replaced."""
+        if waiter is dep.handle.failed:
+            log.warning(
+                "serving worker %s failed (%s); redeploying",
+                dep.handle.peer_id, dep.handle.failed.result(),
+            )
+            return True
+        if waiter is dep.status_wait and not waiter.cancelled():
+            peer, status = waiter.result()
+            if status.state == "running":
+                return False  # informational; keep watching
+            log.warning(
+                "serving job %s reported %s on %s; redeploying",
+                dep.job_id, status.state, peer,
+            )
+            return True
+        return False
+
+    def _backend_name(self, slot: int) -> str:
+        # Routed backends serve under an internal name so clients only
+        # ever discover the router's serve:<name> announcement.
+        return f"{self.serve_name}@{slot}" if self.route else self.serve_name
+
+    async def _deploy(self, slot: int) -> _Deployment | None:
         spec = WorkerSpec(
             resources=self._resources,
             executor=[
@@ -148,17 +435,30 @@ class ServingSupervisor:
                 )
             ],
         )
+        # Distinct peers first: ask for enough offers that an unused worker
+        # can outbid stacking a second replica on an already-taken one
+        # (same-peer is still allowed when nothing else offers — capacity
+        # beats placement). The auction returns early once that many
+        # offers land, so single-deployment latency is unchanged.
+        taken = {d.handle.peer_id for d in self._live_backends()}
         offers = await self._allocator.request(
-            spec, self._price, timeout=self._auction_timeout, num_workers=1
+            spec, self._price, timeout=self._auction_timeout,
+            num_workers=len(taken) + 1,
         )
+        offers.sort(key=lambda o: o.peer_id in taken)
         if not offers:
-            log.info("no offers for serving %s; retrying", self.serve_name)
-            return None, None, None
+            log.info(
+                "no offers for serving %s slot %d; retrying",
+                self.serve_name, slot,
+            )
+            return None
         handle = await WorkerHandle.create(self.node, offers[0])
+        backend = self._backend_name(slot)
+        config = dataclasses.replace(self._config, serve_name=backend)
         job = JobSpec(
-            job_id=f"serve-{self.serve_name}-{uuid.uuid4().hex[:8]}",
+            job_id=f"serve-{self.serve_name}-{slot}-{uuid.uuid4().hex[:8]}",
             executor=Executor(
-                kind="infer", name=INFER_EXECUTOR_NAME, infer=self._config
+                kind="infer", name=INFER_EXECUTOR_NAME, infer=config
             ),
         )
         dispatched = False
@@ -177,10 +477,16 @@ class ServingSupervisor:
             if not dispatched:
                 await handle.release()
         log.info(
-            "serving %s deployed on %s (job %s)",
-            self.serve_name, handle.peer_id, job.job_id,
+            "serving %s slot %d deployed on %s (job %s)",
+            self.serve_name, slot, handle.peer_id, job.job_id,
         )
-        return handle, task, job.job_id
+        return _Deployment(
+            slot=slot,
+            handle=handle,
+            task=task,
+            job_id=job.job_id,
+            backend_name=backend,
+        )
 
     async def _pause(self) -> None:
         try:
@@ -188,25 +494,25 @@ class ServingSupervisor:
         except asyncio.TimeoutError:
             pass
 
-    async def _teardown(
-        self,
-        handle: WorkerHandle | None,
-        task: Task | None,
-        job_id: str | None,
-    ) -> None:
-        if task is not None:
-            task.close()
-        if handle is not None and job_id is not None:
-            try:  # stop serving now; lease expiry backstops a dead worker
-                await self.node.request(
-                    handle.peer_id, PROTOCOL_API,
-                    CancelJob(lease_id=handle.lease_id, job_id=job_id),
-                    timeout=10,
-                )
-            except Exception as e:
-                log.debug("cancel of %s on %s failed: %s", job_id, handle.peer_id, e)
-        if handle is not None:
-            try:
-                await handle.release()
-            except Exception:
-                pass
+    async def _teardown(self, dep: _Deployment | None) -> None:
+        if dep is None:
+            return
+        if dep.status_wait is not None:
+            dep.status_wait.cancel()
+        self._detector.remove(dep.handle.peer_id)
+        dep.task.close()
+        try:  # stop serving now; lease expiry backstops a dead worker
+            await self.node.request(
+                dep.handle.peer_id, PROTOCOL_API,
+                CancelJob(lease_id=dep.handle.lease_id, job_id=dep.job_id),
+                timeout=10,
+            )
+        except Exception as e:
+            log.debug(
+                "cancel of %s on %s failed: %s",
+                dep.job_id, dep.handle.peer_id, e,
+            )
+        try:
+            await dep.handle.release()
+        except Exception:
+            pass

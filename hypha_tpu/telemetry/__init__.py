@@ -454,6 +454,11 @@ def instrument_node(meter: Meter, node) -> None:
 
 # Fault-tolerance instruments (import at the bottom: ft_metrics uses the
 # Counter/Histogram classes defined above).
-from .ft_metrics import FT_METRICS, FTMetrics  # noqa: E402
+from .ft_metrics import (  # noqa: E402
+    FT_METRICS,
+    SERVE_METRICS,
+    FTMetrics,
+    ServeMetrics,
+)
 
-__all__ += ["FT_METRICS", "FTMetrics"]
+__all__ += ["FT_METRICS", "FTMetrics", "SERVE_METRICS", "ServeMetrics"]

@@ -24,6 +24,8 @@ __all__ = [
     "STREAM_METRICS",
     "ShardMetrics",
     "SHARD_METRICS",
+    "ServeMetrics",
+    "SERVE_METRICS",
     "register_on",
 ]
 
@@ -222,11 +224,98 @@ class ShardMetrics:
 SHARD_METRICS = ShardMetrics()
 
 
+class ServeMetrics:
+    """Serving-plane instruments (executor.pool paged mode + the request
+    router in scheduler.serving).
+
+    * ``free_blocks`` / ``queue_depth`` — gauges snapshotted by the live
+      :class:`~hypha_tpu.executor.pool.DecodePool` at every serve-loop
+      iteration (last-writer-wins across pools in one process; tests and
+      servbench run one pool at a time).
+    * ``admissions`` / ``preemptions`` / ``rejections`` — admitted groups,
+      preempted-to-queue groups (recompute resume), and backpressure
+      rejections (pool queue limit + router retry-after).
+    * ``request latency`` — submit→resolve wall time per request, kept
+      both as an OTLP histogram and as a bounded reservoir so
+      :meth:`snapshot` can report p50/p95 directly (what SERVBENCH and
+      the tests assert).
+    """
+
+    _RESERVOIR = 2048
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._free_blocks = 0.0
+        self._queue_depth = 0.0
+        self.admissions = Counter("hypha.serve.admissions")
+        self.preemptions = Counter("hypha.serve.preemptions")
+        self.rejections = Counter("hypha.serve.rejections")
+        self.routed_requests = Counter("hypha.serve.routed_requests")
+        self.ejections = Counter("hypha.serve.ejections")
+        self.request_latency_ms = Histogram(
+            "hypha.serve.request_latency", unit="ms",
+            bounds=(5, 10, 25, 50, 100, 250, 500, 1000, 2500, 5000, 10000),
+        )
+        self._latencies: list[float] = []
+
+    def pool_state(self, free_blocks: float, queue_depth: float) -> None:
+        with self._lock:
+            self._free_blocks = float(free_blocks)
+            self._queue_depth = float(queue_depth)
+
+    def request_finished(self, latency_ms: float) -> None:
+        self.request_latency_ms.record(latency_ms)
+        with self._lock:
+            self._latencies.append(float(latency_ms))
+            if len(self._latencies) > self._RESERVOIR:
+                del self._latencies[: len(self._latencies) - self._RESERVOIR]
+
+    def free_blocks(self) -> float:
+        with self._lock:
+            return self._free_blocks
+
+    def queue_depth(self) -> float:
+        with self._lock:
+            return self._queue_depth
+
+    def _quantile(self, q: float) -> float:
+        with self._lock:
+            lat = sorted(self._latencies)
+        if not lat:
+            return 0.0
+        i = min(int(q * len(lat)), len(lat) - 1)
+        return lat[i]
+
+    def snapshot(self) -> dict:
+        hist = self.request_latency_ms.snapshot()
+        return {
+            "free_blocks": self.free_blocks(),
+            "queue_depth": self.queue_depth(),
+            "admissions": self.admissions.value(),
+            "preemptions": self.preemptions.value(),
+            "rejections": self.rejections.value(),
+            "routed_requests": self.routed_requests.value(),
+            "ejections": self.ejections.value(),
+            "request_latency_ms_count": hist["count"],
+            "request_latency_ms_sum": hist["sum"],
+            "request_latency_ms_p50": self._quantile(0.50),
+            "request_latency_ms_p95": self._quantile(0.95),
+        }
+
+    def reset(self) -> None:
+        """Fresh instruments (tests and servbench isolate runs this way)."""
+        self.__init__()
+
+
+SERVE_METRICS = ServeMetrics()
+
+
 def register_on(
     meter: Meter,
     metrics: FTMetrics = FT_METRICS,
     stream: StreamMetrics = STREAM_METRICS,
     shard: ShardMetrics = SHARD_METRICS,
+    serve: "ServeMetrics" = None,
 ) -> None:
     """Export the bundles through a Meter as observable gauges."""
     meter.observable_gauge(
@@ -271,6 +360,16 @@ def register_on(
     meter.observable_gauge(
         "hypha.shard.reduced_deltas", shard.reduced_deltas.value
     )
+    serve = serve if serve is not None else SERVE_METRICS
+    meter.observable_gauge("hypha.serve.free_blocks", serve.free_blocks)
+    meter.observable_gauge("hypha.serve.queue_depth", serve.queue_depth)
+    meter.observable_gauge("hypha.serve.admissions", serve.admissions.value)
+    meter.observable_gauge("hypha.serve.preemptions", serve.preemptions.value)
+    meter.observable_gauge("hypha.serve.rejections", serve.rejections.value)
+    meter.observable_gauge(
+        "hypha.serve.routed_requests", serve.routed_requests.value
+    )
+    meter.observable_gauge("hypha.serve.ejections", serve.ejections.value)
     # Per-fragment close counters attach lazily — fragment ids only exist
     # once the PS closes their first round.
     stream.attach_meter(meter)

@@ -15,7 +15,7 @@ import asyncio
 import logging
 from typing import Any, Callable
 
-from ..executor.pool import DecodePool
+from ..executor.pool import DecodePool, PoolBusy
 
 __all__ = ["PoolServer"]
 
@@ -43,6 +43,10 @@ class PoolServer:
         steps_per_call: int = 8,
         eos_token_id: int | None = None,
         fallback_concurrency: int = 2,
+        block_size: int = 0,
+        num_blocks: int = 0,
+        prefill_chunk: int = 0,
+        max_queue: int = 0,
     ) -> None:
         self.pool = DecodePool(
             model,
@@ -51,6 +55,10 @@ class PoolServer:
             max_len=max_len,
             steps_per_call=steps_per_call,
             eos_token_id=eos_token_id,
+            block_size=block_size,
+            num_blocks=num_blocks,
+            prefill_chunk=prefill_chunk,
+            max_queue=max_queue,
         )
         self._run_fallback = run_fallback
         # Bounded one-shot decode concurrency: each distinct fallback shape
@@ -64,10 +72,22 @@ class PoolServer:
         # RequestBatcher where the meaning carries over)
         self.requests = 0
         self.fallbacks = 0  # sampled + oversized-greedy one-shot decodes
+        self.rejections = 0  # PoolBusy backpressure rejections
 
     @property
     def chunks(self) -> int:
         return self.pool.chunks
+
+    def load(self) -> dict:
+        """The admission-headroom snapshot piggybacked on ServeLoad
+        heartbeats (scheduler.serving router balancing)."""
+        return {
+            "queue_depth": self.pool.queue_depth(),
+            "free_blocks": self.pool.free_blocks(),
+            "live_requests": self.pool.live_rows(),
+            "requests": self.requests,
+            "rejections": self.rejections,
+        }
 
     async def submit(
         self,
@@ -81,9 +101,16 @@ class PoolServer:
             raise RuntimeError("server is closed")
         self.requests += 1
         if temperature == 0.0 and self.pool.fits(prompts, n_new):
-            return await asyncio.wrap_future(
-                self.pool.submit([list(p) for p in prompts], n_new)
-            )
+            try:
+                return await asyncio.wrap_future(
+                    self.pool.submit([list(p) for p in prompts], n_new)
+                )
+            except PoolBusy:
+                # Backpressure surfaces to the RPC layer (ok=False +
+                # retry_after) instead of silently taking the fallback —
+                # the fallback path is for SHAPE misfits, not load.
+                self.rejections += 1
+                raise
         # Sampled requests (shared-key reproducibility) AND greedy requests
         # that exceed the pool window/slots both take the one-shot path —
         # the window batcher served any prompt up to the model limit, and

@@ -20,11 +20,15 @@ import logging
 from dataclasses import dataclass, field
 from pathlib import Path
 
+from .. import aio
+from ..executor.pool import PoolBusy
 from ..messages import (
     PROTOCOL_GENERATE,
+    PROTOCOL_SERVE,
     GenerateRequest,
     GenerateResponse,
     JobSpec,
+    ServeLoad,
 )
 from ..network.node import Node, RequestError
 from .batcher import RequestBatcher
@@ -85,9 +89,19 @@ class InProcessInferExecutor(JobExecutor):
                     req.prompts, n_new, temperature, top_k, req.seed,
                 )
             else:
-                tokens = await batcher.submit(
-                    req.prompts, n_new, temperature, top_k, req.seed
-                )
+                try:
+                    tokens = await batcher.submit(
+                        req.prompts, n_new, temperature, top_k, req.seed
+                    )
+                except PoolBusy as busy:
+                    # Backpressure is a RESPONSE, not an error: the client
+                    # (or router) retries after the hint instead of
+                    # queueing unboundedly server-side.
+                    return GenerateResponse(
+                        tokens=[],
+                        ok=False,
+                        retry_after_ms=busy.retry_after_s * 1e3,
+                    )
             return GenerateResponse(tokens=tokens)
 
         registration: dict = {}
@@ -105,6 +119,25 @@ class InProcessInferExecutor(JobExecutor):
                 return
             if cancelled.is_set():
                 return
+            try:
+                _serve(model, params)
+            except asyncio.CancelledError:
+                raise
+            except Exception as e:
+                # A bad pool geometry (e.g. serve_block_size that does not
+                # divide the window) must report "failed" like a bad model
+                # spec — an escaped exception here would leave the job
+                # wedged with no handler and no terminal status.
+                log.exception("infer job %s bring-up failed", job_id)
+                execution.finish("failed", str(e))
+                return
+            try:
+                await self.node.provide(serve_key(cfg.serve_name))
+            except RequestError as e:
+                log.warning("serve announce for %s failed: %s", cfg.serve_name, e)
+            log.info("job %s serving %s", job_id, cfg.serve_name)
+
+        def _serve(model, params) -> None:
             loaded["model"], loaded["params"] = model, params
             # Request scheduling (VERDICT r3 weak #3, r4 weak #4):
             #   * continuous — iteration-level admission over a fixed
@@ -141,11 +174,23 @@ class InProcessInferExecutor(JobExecutor):
                     or getattr(model.config, "max_seq_len", None)
                     or 1024
                 )
+                # EOS threading (satellite fix): the config wins, else the
+                # model config's token — before this, PoolServer accepted
+                # eos_token_id but nothing ever supplied it, so EOS rows
+                # decoded to their full budget instead of freeing KV.
+                eos = cfg.eos_token_id
+                if eos is None:
+                    eos = getattr(model.config, "eos_token_id", None)
                 loaded["batcher"] = self.batchers[job_id] = PoolServer(
                     model, params, fallback,
                     slots=cfg.pool_slots or cfg.max_batch,
                     max_len=cfg.pool_max_len or min(int(limit), 1024),
                     steps_per_call=cfg.pool_chunk,
+                    eos_token_id=None if eos is None else int(eos),
+                    block_size=cfg.pool_block_size,
+                    num_blocks=cfg.pool_blocks,
+                    prefill_chunk=cfg.pool_prefill_chunk,
+                    max_queue=cfg.queue_limit,
                 )
             elif cfg.batch_window_ms >= 0:
                 loaded["batcher"] = self.batchers[job_id] = RequestBatcher(
@@ -159,11 +204,17 @@ class InProcessInferExecutor(JobExecutor):
                 .concurrency(64 if "batcher" in loaded else 4)
                 .respond_with(handle)
             )
-            try:
-                await self.node.provide(serve_key(cfg.serve_name))
-            except RequestError as e:
-                log.warning("serve announce for %s failed: %s", cfg.serve_name, e)
-            log.info("job %s serving %s", job_id, cfg.serve_name)
+            if cfg.load_report_s > 0 and scheduler_peer:
+                # Every scheduling mode heartbeats: the router treats the
+                # FIRST ServeLoad as "backend ready" (the handler above is
+                # registered), so reporting must not depend on the pool.
+                registration["load"] = aio.spawn(
+                    self._report_load(
+                        job_id, cfg, loaded.get("batcher"), scheduler_peer
+                    ),
+                    what="serve load reporter",
+                    logger=log,
+                )
 
         loader = asyncio.create_task(bring_up())
 
@@ -172,6 +223,7 @@ class InProcessInferExecutor(JobExecutor):
             cancelled.set()
             if registration.get("reg") is not None:
                 registration["reg"].close()
+            await aio.reap(registration.get("load"))
             batcher = self.batchers.pop(job_id, None)
             if batcher is not None:
                 # Drop the batcher's closure over model/params too — a
@@ -188,6 +240,47 @@ class InProcessInferExecutor(JobExecutor):
 
         execution.cancel = cancel  # type: ignore[method-assign]
         return execution
+
+    async def _report_load(
+        self, job_id: str, cfg, batcher, scheduler_peer: str
+    ) -> None:
+        """Heartbeat the pool's admission headroom to the request router
+        (scheduler.serving): queue depth + free blocks ride the liveness
+        signal its φ-accrual detector feeds on. Best-effort — a scheduler
+        without the serve-load handler (single-deployment supervisor, old
+        peers) just refuses the RPC and serving continues."""
+        while True:
+            await asyncio.sleep(cfg.load_report_s)
+            if batcher is not None and hasattr(batcher, "load"):
+                stats = batcher.load()
+            else:
+                # Window batcher / independent decodes: no pool headroom to
+                # report; the heartbeat itself still carries readiness +
+                # liveness, and request totals when the batcher keeps them.
+                stats = {
+                    "queue_depth": 0,
+                    "free_blocks": 0,
+                    "live_requests": 0,
+                    "requests": getattr(batcher, "requests", 0),
+                    "rejections": 0,
+                }
+            try:
+                await self.node.request(
+                    scheduler_peer,
+                    PROTOCOL_SERVE,
+                    ServeLoad(
+                        job_id=job_id,
+                        serve_name=cfg.serve_name,
+                        queue_depth=int(stats["queue_depth"]),
+                        free_blocks=int(stats["free_blocks"]),
+                        live_requests=int(stats["live_requests"]),
+                        requests=int(stats["requests"]),
+                        rejections=int(stats["rejections"]),
+                    ),
+                    timeout=max(cfg.load_report_s, 2.0),
+                )
+            except (RequestError, asyncio.TimeoutError, OSError) as e:
+                log.debug("serve load report for %s failed: %s", job_id, e)
 
     # -- blocking helpers (run in worker threads) ---------------------------
 
@@ -310,32 +403,48 @@ async def generate_remote(
 ) -> list:
     """Client side: discover a server of ``serve_name`` via the registry and
     RPC it. Returns one token-id list per prompt. Discovery polls briefly —
-    a freshly dispatched serve job announces only once its model is loaded."""
-    deadline = asyncio.get_running_loop().time() + min(timeout, 30.0)
+    a freshly dispatched serve job announces only once its model is loaded.
+    A backpressure rejection (``ok=False``) is retried after the server's
+    ``retry_after_ms`` hint until ``timeout`` is exhausted."""
+    loop = asyncio.get_running_loop()
+    deadline = loop.time() + min(timeout, 30.0)
     while True:
         providers = await node.find_providers(serve_key(serve_name))
         if providers:
             break
-        if asyncio.get_running_loop().time() >= deadline:
+        if loop.time() >= deadline:
             raise RequestError(f"no provider serving {serve_name!r}")
         await asyncio.sleep(0.2)
+    req = GenerateRequest(
+        serve_name=serve_name,
+        prompts=[list(map(int, p)) for p in prompts],
+        max_new_tokens=max_new_tokens,
+        temperature=temperature,
+        top_k=top_k,
+        seed=seed,
+    )
+    busy_deadline = loop.time() + timeout
     last: Exception | None = None
-    for peer in providers:
-        try:
-            resp = await node.request(
-                peer,
-                PROTOCOL_GENERATE,
-                GenerateRequest(
-                    serve_name=serve_name,
-                    prompts=[list(map(int, p)) for p in prompts],
-                    max_new_tokens=max_new_tokens,
-                    temperature=temperature,
-                    top_k=top_k,
-                    seed=seed,
-                ),
-                timeout=timeout,
+    while True:
+        busy_hint = 0.0
+        for peer in providers:
+            try:
+                resp = await node.request(
+                    peer, PROTOCOL_GENERATE, req, timeout=timeout
+                )
+            except RequestError as e:
+                last = e
+                continue
+            if getattr(resp, "ok", True):
+                return resp.tokens
+            busy_hint = max(busy_hint, resp.retry_after_ms / 1e3)
+        if busy_hint <= 0.0:
+            raise RequestError(
+                f"all providers of {serve_name!r} failed: {last}"
             )
-            return resp.tokens
-        except RequestError as e:
-            last = e
-    raise RequestError(f"all providers of {serve_name!r} failed: {last}")
+        if loop.time() + busy_hint >= busy_deadline:
+            raise RequestError(
+                f"{serve_name!r} is overloaded (retry-after exhausted "
+                f"the {timeout}s budget)"
+            )
+        await asyncio.sleep(busy_hint)

@@ -17,6 +17,9 @@ transformers = pytest.importorskip("transformers")
 import torch  # noqa: E402
 
 
+@pytest.mark.slow  # ~22 s torch+HF logit parity — tier-1 wall budget (the
+# PR 4 precedent); the conversion path stays covered by the faster
+# per-family convert tests below.
 def test_gpt2_conversion_matches_hf_logits():
     hf_cfg = transformers.GPT2Config(
         vocab_size=96, n_positions=32, n_embd=32, n_layer=2, n_head=2

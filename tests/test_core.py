@@ -475,6 +475,9 @@ def test_reserved_keys_in_user_dicts_roundtrip():
     assert isinstance(out.metrics, dict)  # no registry object materialized
 
 
+@pytest.mark.slow  # 45 s of re-fused forward passes — the single heaviest
+# tier-1 item; moved out to keep the suite under its 870 s wall (the PR 4
+# precedent) now that test_paged/test_router ride along.
 def test_remat_is_numerically_transparent():
     """Gradient checkpointing changes memory, never math: same params, same
     loss, same grads with remat on and off (GPT2 + Llama + Mixtral)."""

@@ -512,6 +512,73 @@ def test_batcher_splits_oversized_and_respects_cap():
     run(main())
 
 
+def test_eos_token_id_threads_into_the_pool():
+    """Satellite regression (ISSUE-7): PoolServer always ACCEPTED an
+    eos_token_id but infer_executor never supplied one, so EOS rows
+    decoded to their full budget holding their KV slot. The config field
+    must reach the DecodePool and release rows early."""
+    llama_model = {
+        "family": "llama",
+        "config": {
+            "vocab_size": 64, "hidden_size": 32, "intermediate_size": 64,
+            "num_layers": 1, "num_heads": 2, "num_kv_heads": 2,
+            "max_seq_len": 64, "dtype": "float32",
+        },
+        "seed": 5,
+    }
+
+    async def main():
+        hub = MemoryTransport()
+        gw = Node(hub.shared(), peer_id="gw", registry_server=True)
+        await gw.start()
+        worker = Node(hub.shared(), peer_id="w", bootstrap=[gw.listen_addrs[0]])
+        client = Node(hub.shared(), peer_id="c", bootstrap=[gw.listen_addrs[0]])
+        await worker.start(); await client.start()
+        await worker.wait_for_bootstrap(5); await client.wait_for_bootstrap(5)
+        ex = InProcessInferExecutor(worker)
+
+        # probe: what does greedy emit first? (becomes the "eos" token)
+        spec = JobSpec(
+            job_id="job-eos-probe",
+            executor=Executor(
+                kind="infer", name="generate",
+                infer=InferExecutorConfig(
+                    model=llama_model, serve_name="probe", pool_chunk=2,
+                ),
+            ),
+        )
+        execution = await ex.execute("job-eos-probe", spec, "")
+        first = (await generate_remote(client, "probe", [[3, 3, 3]], 2))[0][0]
+        await execution.cancel()
+
+        spec = JobSpec(
+            job_id="job-eos",
+            executor=Executor(
+                kind="infer", name="generate",
+                infer=InferExecutorConfig(
+                    model=llama_model, serve_name="eos", pool_chunk=2,
+                    eos_token_id=int(first),
+                ),
+            ),
+        )
+        execution = await ex.execute("job-eos", spec, "")
+        toks = (await generate_remote(client, "eos", [[3, 3, 3]], 16))[0]
+        batcher = ex.batchers["job-eos"]
+        assert batcher.pool.eos_token_id == int(first), "eos never reached the pool"
+        # padded to budget with eos, matching generate()'s contract
+        assert toks[0] == first and all(t == first for t in toks)
+        assert len(toks) == 16
+        # EARLY release: the row freed at the first chunk boundary instead
+        # of decoding 16 tokens (8 chunks of 2)
+        assert batcher.pool.chunks <= 2, (
+            f"EOS row decoded {batcher.pool.chunks} chunks — never released"
+        )
+        await execution.cancel()
+        await client.stop(); await worker.stop(); await gw.stop()
+
+    run(main())
+
+
 def test_serving_mixtral_from_hf_repo(tmp_path):
     """A converted HF Mixtral repo serves end to end: directory weights
     stream through the stacking converter, decode handles the MoE
