@@ -3,7 +3,7 @@
 
 PYTHON ?= python
 
-.PHONY: lint lint-fixtures test compressbench streambench ftbench-ps shardbench servbench
+.PHONY: lint lint-fixtures test compressbench streambench ftbench-ps shardbench servbench hetbench
 
 lint:
 	$(PYTHON) -m hypha_tpu.analysis hypha_tpu/
@@ -58,6 +58,16 @@ shardbench:
 servbench:
 	JAX_PLATFORMS=cpu $(PYTHON) benchmarks/servbench.py \
 		--out SERVBENCH_r05.json
+
+# WAN-adaptive outer rounds: a 4-worker pool with one bandwidth-capped +
+# one 4x slow-CPU peer, adaptive (straggler-adaptive inner steps +
+# per-link codec selection) vs static vs a uniform reference. Asserts
+# round wall <= 0.6x static, zero quorum drops adaptive vs >= 1/round
+# static, and final loss within 1e-3 of the uniform pool. Writes
+# HETBENCH_r09.json (docs/performance.md "Heterogeneous pools").
+hetbench:
+	JAX_PLATFORMS=cpu $(PYTHON) benchmarks/hetbench.py \
+		--out HETBENCH_r09.json
 
 # Durable PS: kill the parameter server mid-round, restart it, and prove
 # the job completes with bounded recovery wall-clock (ft.durable journal +

@@ -346,10 +346,13 @@ def main() -> None:
 
 def _chaos_main(spec: str) -> int:
     """``bench.py --chaos <spec>`` (kill-worker:<round>, kill-ps:<round>,
-    partition-ps:<round>:<s>, ...): run the orchestrated fault-injection
-    scenario (benchmarks/ft_chaos.py — 4 workers, elastic membership,
-    durable PS for the ps scenarios) on the CPU backend and persist the
-    result as FTBENCH_<scenario>.json next to this script."""
+    partition-ps:<round>:<s>, slow-worker:<x>, bw-cap:<peer>:<mbps>,
+    jitter:<peer>:<s>, ...): run the orchestrated fault-injection scenario
+    (benchmarks/ft_chaos.py — 4 workers, elastic membership, durable PS
+    for the ps scenarios) on the CPU backend and persist the result as
+    FTBENCH_<scenario>.json next to this script. Specs compose with
+    commas (``kill-worker:2,bw-cap:w1:10``) so one run can mix an event
+    with steady degrade conditions."""
     os.environ["JAX_PLATFORMS"] = "cpu"  # control-plane bench: no accelerator
     sys.path.insert(0, os.path.join(_REPO, "benchmarks"))
     from ft_chaos import run_chaos_scenario

@@ -59,16 +59,31 @@ def run_chaos_scenario(
     from hypha_tpu.scheduler.job_config import DiLoCoJob, DiLoCoRounds, JobResources
     from hypha_tpu.scheduler.metrics_bridge import CallbackConnector
     from hypha_tpu.scheduler.orchestrator import Orchestrator
-    from hypha_tpu.telemetry.ft_metrics import FT_METRICS
+    from hypha_tpu.telemetry.ft_metrics import FT_METRICS, HET_METRICS
     from hypha_tpu.worker.arbiter import OfferConfig
     from hypha_tpu.worker.runtime import WorkerNode
 
     FT_METRICS.reset()
+    HET_METRICS.reset()
     # PS scenarios (kill-ps / partition-ps) target the parameter server's
     # worker node; worker scenarios target the second allocated worker.
-    ps_scenario = spec.startswith(("kill-ps", "partition-ps"))
-    victim = "psw" if ps_scenario else "w1"
-    action = parse_chaos_spec(spec, victim)
+    # The spec may compose several comma-separated actions (degrade modes
+    # like bw-cap name their peer inline and ride along with an event).
+    parts = [p.strip() for p in spec.split(",") if p.strip()]
+    ps_scenario = any(
+        p.startswith(("kill-ps", "partition-ps")) for p in parts
+    )
+    actions = [
+        parse_chaos_spec(
+            p, "psw" if p.startswith(("kill-ps", "partition-ps")) else "w1"
+        )
+        for p in parts
+    ]
+    kill_actions = [a for a in actions if a.kind == "kill"]
+    victim = (
+        next((a.target for a in actions if a.kind.endswith("ps")), None)
+        or (kill_actions[0].target if kill_actions else actions[0].target)
+    )
     tmp = Path(tempfile.mkdtemp(prefix="hypha-ftbench-"))
 
     vocab, seq = 32, 16
@@ -113,7 +128,7 @@ def run_chaos_scenario(
         await sched.start()
         await sched.wait_for_bootstrap()
 
-        chaos = ChaosController([action], {**workers, "psw": psw})
+        chaos = ChaosController(list(actions), {**workers, "psw": psw})
         rounds_seen: set[int] = set()
         metric_times: list[tuple[int, float]] = []
 
@@ -160,17 +175,23 @@ def run_chaos_scenario(
             checkpoint_dir=str(tmp / "ckpt") if ps_scenario else None,
         )
 
-        replacement = mk_worker(f"{victim}b") if action.kind == "kill" else None
+        replacement = mk_worker(f"{victim}b") if kill_actions else None
         ps_addr = None  # captured before the kill; the restart re-binds it
         replacement_ps: dict = {}
 
         async def restarter() -> None:
-            while not chaos.fired:
+            if replacement is None and not any(
+                a.kind == "kill-ps" for a in actions
+            ):
+                return  # degrade-only scenarios have nothing to restart
+            # Degrade actions fire at attach (round 0); only a KILL firing
+            # should trigger the restart machinery.
+            while not any(a.kind in ("kill", "kill-ps") for a in chaos.fired):
                 await asyncio.sleep(0.05)
             if replacement is not None:
                 _log(f"restarting victim as {victim}b")
                 await replacement.start([f"mem:restart-{victim}b"])
-            if action.kind == "kill-ps":
+            if any(a.kind == "kill-ps" for a in chaos.fired):
                 # The PS process "restarts": a fresh node under the SAME
                 # peer id and listen address (workers' push targets were
                 # wired to it at dispatch). Its durable journal under the
@@ -245,6 +266,7 @@ def run_chaos_scenario(
             "quorum_fraction": quorum_fraction,
             "round_deadline_s": round_deadline_s,
             "degraded_rounds": snap["degraded_rounds"],
+            "quorum_drops": HET_METRICS.snapshot()["quorum_drops"],
             "stale_deltas_dropped": snap["stale_deltas_dropped"],
             "suspected_peers": snap["suspected_peers"],
             "rejoins": snap["rejoins"],

@@ -78,6 +78,11 @@ RULES: dict[str, str] = {
         "message carries a fragment_id but no round tag — an untagged "
         "fragment folds into whichever round is open on the PS"
     ),
+    "msg-adaptive-needs-round": (
+        "message carries per-peer inner_steps/codec assignments but no "
+        "round/epoch tag — a stale assignment could re-pace or re-encode "
+        "workers from an old view"
+    ),
     "msg-unmapped-protocol": (
         "registered wire message not claimed by any stream protocol"
     ),

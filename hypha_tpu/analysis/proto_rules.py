@@ -59,6 +59,13 @@ _SHARD_FIELDS = {"shard", "shards", "shard_id"}
 # ``msg-fragment-needs-round`` rule).
 _FRAGMENT_FIELDS = {"fragment_id", "fragment"}
 
+# Field names carrying per-peer ADAPTIVE assignments — inner-step counts or
+# wire-codec choices (hypha_tpu.ft.adaptive). Their presence obliges the
+# message to carry a round/epoch tag too (``msg-adaptive-needs-round``): an
+# assignment applied from a stale redelivery would re-pace a worker (or
+# re-encode its link) against a round that already closed.
+_ADAPTIVE_FIELDS = {"inner_steps", "codecs", "peer_codecs"}
+
 
 def _modules():
     from hypha_tpu import messages
@@ -364,6 +371,37 @@ def check_shard_tags(registry=None) -> list[Violation]:
     return out
 
 
+def check_adaptive_tags(registry=None) -> list[Violation]:
+    """Any message with per-peer adaptive assignments must carry a round tag.
+
+    Structural, like :func:`check_fragment_tags`: EVERY registered
+    dataclass that grows an ``inner_steps``/``codecs`` per-peer assignment
+    field must pair it with ``round``/``epoch``/``round_num`` — the
+    adaptive controller's assignments are per-round state, and applying
+    one from a stale redelivery would re-pace a worker (or re-select its
+    link codec) against a membership view that no longer exists.
+    """
+    messages, _ = _modules()
+    registry = registry if registry is not None else _package_registry(messages)
+    out: list[Violation] = []
+    for name, cls in sorted(registry.items()):
+        if not dataclasses.is_dataclass(cls):
+            continue
+        fields = {f.name for f in dataclasses.fields(cls)}
+        if fields & _ADAPTIVE_FIELDS and not fields & _TAG_FIELDS:
+            out.append(
+                _violation(
+                    cls,
+                    "msg-adaptive-needs-round",
+                    f"{name}: carries {sorted(fields & _ADAPTIVE_FIELDS)} "
+                    f"but no round tag ({'/'.join(sorted(_TAG_FIELDS))}) — "
+                    f"a stale per-peer assignment would re-pace/re-encode "
+                    f"workers against a closed round",
+                )
+            )
+    return out
+
+
 def check_protocol_map(registry=None, manifest=None, values=None) -> list[Violation]:
     messages, _ = _modules()
     registry = registry if registry is not None else _package_registry(messages)
@@ -425,5 +463,6 @@ def check() -> list[Violation]:
         + check_round_tags()
         + check_fragment_tags()
         + check_shard_tags()
+        + check_adaptive_tags()
         + check_protocol_map()
     )

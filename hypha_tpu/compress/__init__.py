@@ -49,6 +49,7 @@ __all__ = [
     "MAGIC",
     "ErrorFeedback",
     "effective_codec",
+    "codec_for_bandwidth",
     "quantize",
     "dequantize",
     "write_frame",
@@ -82,3 +83,29 @@ def effective_codec(delta_codec: str, delta_dtype: str = "float32") -> str:
     if delta_codec == "none" and delta_dtype == "bfloat16":
         return "bf16"
     return delta_codec
+
+
+# How many bits each codec ships per f32 parameter — the degradation order
+# codec_for_bandwidth walks (never "upgrades" past the job's base codec).
+_CODEC_BITS = {"none": 32, "bf16": 16, "int8": 8, "int4": 4}
+
+
+def codec_for_bandwidth(
+    bps: float, base: str, hi_bps: float, lo_bps: float
+) -> str:
+    """Per-link codec ladder for a measured bandwidth (ft.adaptive).
+
+    ``bps >= hi_bps`` keeps the job's base codec; below it the link
+    degrades to int8; below ``lo_bps`` to int4. A link never ships MORE
+    bits than the base codec asks for (a job already on int4 stays int4),
+    and every quantized choice keeps its per-peer error-feedback residual
+    on both transport ends, so degraded links stay unbiased.
+    """
+    if base not in CODECS:
+        raise ValueError(f"base codec must be one of {'|'.join(CODECS)}, got {base!r}")
+    if bps >= hi_bps:
+        return base
+    pick = "int8" if bps >= lo_bps else "int4"
+    if _CODEC_BITS[pick] >= _CODEC_BITS[base]:
+        return base
+    return pick

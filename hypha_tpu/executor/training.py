@@ -42,6 +42,7 @@ import numpy as np
 
 from .. import messages
 from ..messages import (
+    CODEC_KEY,
     SHARD_KEY,
     FragmentTag,
     JobSpec,
@@ -58,7 +59,7 @@ from ..ft.rejoin import CATCHUP_KEY
 from ..stream import SYNC_MODES, effective_fragments, fragment_due, merge_corrected
 from ..stream.partition import partition_names, shard_of
 from ..worker.connectors import shard_route
-from ..telemetry.ft_metrics import STREAM_METRICS
+from ..telemetry.ft_metrics import HET_METRICS, STREAM_METRICS
 from .diloco import (
     apply_updates,
     extract_delta,
@@ -820,6 +821,31 @@ def run_training(
     delta_ef = (
         compress.ErrorFeedback() if wire_codec in compress.QUANT_CODECS else None
     )
+
+    def apply_codec_hint(meta: dict) -> None:
+        """Per-link codec selection (ft.adaptive): an adaptive parameter
+        server stamps the codec it picked for THIS worker's link into the
+        broadcast header — switch the next upload to it. The error-
+        feedback residual carries across the switch (it is plain f32
+        error, codec-independent), so a degrading link keeps tracking the
+        uncompressed trajectory; a worker newly switched to a quantized
+        codec starts a fresh residual. Static jobs never see the key."""
+        nonlocal wire_codec, delta_ef
+        hint = meta.get(CODEC_KEY) if isinstance(meta, dict) else None
+        if (
+            not isinstance(hint, str)
+            or hint not in compress.CODECS
+            or hint == wire_codec
+        ):
+            return
+        log.info(
+            "per-link codec hint: switching upload codec %s -> %s",
+            wire_codec, hint,
+        )
+        HET_METRICS.codec_switches.add(1)
+        wire_codec = hint
+        if wire_codec in compress.QUANT_CODECS and delta_ef is None:
+            delta_ef = compress.ErrorFeedback()
     # Streaming outer sync (hypha_tpu.stream): overlap/stream replace the
     # blocking do_update with a background flight + delayed-update merge.
     # The default stays "blocking" and takes the exact code path below.
@@ -978,8 +1004,21 @@ def run_training(
         # e' = (Δθ + e) − Q(Δθ + e) for the next round (quantization error
         # is re-shipped, never dropped); bf16 halves the upload; the PS
         # widens/accumulates in f32 in every case.
+        wire_flat = flatten_tree(host_delta)
+        if (
+            delta_ef is not None
+            and wire_codec not in compress.QUANT_CODECS
+            and delta_ef.tensors
+        ):
+            # The link recovered (per-link hint switched quant -> base
+            # codec) with a residual still pending: fold it into this
+            # upload — EF's promise is that quantization error is
+            # re-shipped, never dropped, and an uncompressed wire can
+            # carry it exactly.
+            wire_flat = delta_ef.compensate(wire_flat)
+            delta_ef.reset()
         compress.write_delta(
-            delta_path, flatten_tree(host_delta), wire_codec, ef=delta_ef
+            delta_path, wire_flat, wire_codec, ef=delta_ef
         )
         session.send_resource(
             cfg.updates,
@@ -1050,6 +1089,7 @@ def run_training(
                     (work_dir / event["path"]).unlink(missing_ok=True)
                     continue
                 break
+        apply_codec_hint(meta)
         update_file = work_dir / event["path"]
         # read_delta sniffs the format: a quantized (HQD1) broadcast
         # dequantizes to f32, a SafeTensors one loads as before.

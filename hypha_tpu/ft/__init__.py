@@ -11,12 +11,23 @@ defined over whichever replicas actually reported:
   durable.py    — parameter-server round journal + outer-state checkpoint:
                   a PS crash resumes the interrupted round (generation ids
                   + client retry make re-sent deltas idempotent)
+  adaptive.py   — WAN-adaptive outer rounds: straggler-adaptive per-worker
+                  inner steps (EWMA round-trip history) + per-link codec
+                  selection from a measured-bandwidth table
   chaos.py      — deterministic fault injection for tests and bench.py
+                  (kill / delay / partition events + steady degrade modes:
+                  slow-CPU workers, per-link bandwidth caps, jitter)
 
 See docs/fault_tolerance.md for the full protocol description.
 """
 
-from .chaos import ChaosAction, ChaosController, parse_chaos_spec
+from .adaptive import Ewma, LinkTable, StragglerController
+from .chaos import (
+    ChaosAction,
+    ChaosController,
+    parse_chaos_spec,
+    parse_chaos_specs,
+)
 from .detector import PHI_THRESHOLD_DEFAULT, PhiAccrualDetector
 from .durable import GENERATION_KEY, DurablePS, RoundJournal
 from .membership import (
@@ -47,4 +58,8 @@ __all__ = [
     "ChaosAction",
     "ChaosController",
     "parse_chaos_spec",
+    "parse_chaos_specs",
+    "Ewma",
+    "LinkTable",
+    "StragglerController",
 ]

@@ -1,0 +1,359 @@
+"""WAN-adaptive outer rounds: straggler-adaptive inner steps + per-link codecs.
+
+Every benchmark to date ran on uniform loopback peers, but the system's
+raison d'être is a volunteer pool with 10-100x bandwidth spread and
+persistent stragglers. Today such a peer is either quorum-dropped (its
+whole round of compute is wasted) or gates the round at the deadline
+(everyone's wall-clock is wasted). This module makes both per-worker work
+and per-link bytes adapt to *measured* conditions:
+
+  * :class:`StragglerController` (scheduler side) — keeps an EWMA of each
+    worker's per-inner-step round-trip cost (inner compute + upload, from
+    the per-peer arrival lags the parameter server reports with every
+    ``Updated``) and assigns per-worker inner-step counts for the next
+    round: a 4x slower worker runs ~k/4 local steps and lands its delta
+    inside the deadline instead of being dropped. Aggregation stays
+    unbiased because the parameter server's fold is sample-weighted
+    (hypha_tpu.stream.accum: weight = tokens actually processed).
+    Assignments are published with the round membership
+    (``RoundMembership.inner_steps``) and applied through the existing
+    ``ScheduleUpdate{counter}`` control channel — no new wire messages.
+
+  * :class:`LinkTable` (parameter-server side) — an EWMA of each peer's
+    measured upload bandwidth (timed around the delta save as the push
+    streams in), mapped onto a wire codec per link: fast links keep the
+    job codec, slow links degrade to int8, the slowest to int4
+    (:func:`hypha_tpu.compress.codec_for_bandwidth`). The selected codec
+    is stamped into that peer's update broadcast header (``CODEC_KEY``)
+    so the worker switches its next upload; the HQD1 frame is
+    self-describing per file, so the receive side needs no negotiation.
+    Per-peer :class:`~hypha_tpu.compress.ErrorFeedback` residuals keep
+    every link unbiased. Until a peer has been measured at all, the
+    elastic round deadline is extended by ``first_round_grace`` — a peer
+    must never be quorum-dropped before the system has seen one upload
+    from it.
+
+Both controllers are pure logic with injectable clocks (deterministic
+tests) and record into :data:`~hypha_tpu.telemetry.ft_metrics.HET_METRICS`.
+``adaptive_steps`` / ``adaptive_codec`` default OFF on every config
+surface, keeping today's wire and rounds bit-exact.
+"""
+
+from __future__ import annotations
+
+import math
+import statistics
+import time
+from typing import Callable
+
+from ..telemetry.ft_metrics import HET_METRICS
+
+__all__ = ["Ewma", "StragglerController", "LinkTable"]
+
+
+class Ewma:
+    """Exponentially weighted moving average; None until first sample."""
+
+    __slots__ = ("alpha", "_value")
+
+    def __init__(self, alpha: float = 0.4) -> None:
+        if not 0.0 < alpha <= 1.0:
+            raise ValueError("ewma alpha must be in (0, 1]")
+        self.alpha = alpha
+        self._value: float | None = None
+
+    def update(self, sample: float) -> float:
+        if self._value is None:
+            self._value = float(sample)
+        else:
+            self._value = self.alpha * float(sample) + (1.0 - self.alpha) * self._value
+        return self._value
+
+    def scale(self, factor: float) -> None:
+        """Multiplicative penalty (a quorum-dropped peer yields no arrival
+        sample, but its estimate must still move toward "slower")."""
+        if self._value is not None:
+            self._value *= float(factor)
+
+    @property
+    def value(self) -> float | None:
+        return self._value
+
+
+class StragglerController:
+    """Per-worker inner-step assignment from measured round-trip history.
+
+    The reference scheduler's synchronization simulation already balances
+    *remaining* samples by batch speed, but it is blind to upload time and
+    its RunningMean reacts slowly to a peer that becomes slow mid-job. The
+    controller replaces the projection when ``adaptive_steps`` is on:
+
+      * per-step cost estimate = ``max`` of two EWMAs: the parameter
+        server's per-peer arrival report (``arrival_lag / steps_run`` —
+        inner compute + upload, measured where it matters, at the
+        aggregation point) and the scheduler-observed per-batch cadence.
+        The max matters: a worker that starts its round during the
+        previous round's broadcast window can land with near-zero
+        arrival lag no matter how slow its CPU is, but its batch cadence
+        cannot be masked; conversely a bandwidth-starved peer batches at
+        full speed and only the arrival lag sees its upload. The first
+        round's arrivals are skipped entirely (``warmup_rounds``): they
+        are dominated by one-time jit compile, not steady-state cost;
+      * per-round assignment: a slowness ratio ``t_peer / t_median``
+        inside the ``deadband`` keeps the base count (measurement noise
+        on a busy host must never change an assignment); beyond it the
+        count snaps to the nearest power-of-two divisor of the base —
+        quantized backoff levels, so a 4x straggler sits stably at
+        base/4 instead of flapping with every EWMA wiggle — clamped to
+        ``[min_steps, base · max_boost]``. Round cadence tracks the
+        MEDIAN peer; stragglers contribute partial-but-timely deltas;
+      * a peer whose delta never arrived (quorum-dropped) gets its
+        estimate scaled by ``drop_penalty`` so its assignment keeps
+        shrinking until it lands inside the deadline.
+    """
+
+    def __init__(
+        self,
+        base_steps: int,
+        min_steps: int = 1,
+        max_boost: float = 1.0,
+        alpha: float = 0.4,
+        drop_penalty: float = 1.5,
+        warmup_rounds: int = 1,
+        deadband: float = 1.5,
+        clock: Callable[[], float] = time.monotonic,
+    ) -> None:
+        if base_steps < 1:
+            raise ValueError("base_steps must be >= 1")
+        if min_steps < 1:
+            raise ValueError("min_steps must be >= 1")
+        if max_boost < 1.0:
+            raise ValueError("max_boost must be >= 1.0 (1.0 = never over-assign)")
+        if deadband < math.sqrt(2.0):
+            # Below sqrt(2) the deadband and the power-of-two snapping
+            # disagree at the boundary (a ratio just past the band would
+            # round to level 0 anyway).
+            raise ValueError("deadband must be >= sqrt(2)")
+        self.base_steps = int(base_steps)
+        self.min_steps = int(min_steps)
+        self.max_boost = float(max_boost)
+        self.drop_penalty = float(drop_penalty)
+        self.warmup_rounds = max(int(warmup_rounds), 0)
+        self.deadband = float(deadband)
+        self._alpha = alpha
+        self._clock = clock
+        self.round = 0
+        # peer -> EWMA of per-step round-trip seconds (arrival-lag derived).
+        self._per_step: dict[str, Ewma] = {}
+        # peer -> EWMA of scheduler-observed batch intervals (cold start).
+        self._batch: dict[str, Ewma] = {}
+        self._batch_ts: dict[str, float] = {}
+        # This round's state: batches run, frozen assignments, and — per
+        # ROUND — the union of peers whose arrival ANY close report
+        # credited. A sharded service sends one report per shard, and a
+        # stream-mode shard can legitimately report a LATER round before
+        # the round-owning shard reports the current one; penalizing from
+        # one shard's view (or discarding the early report) would punish
+        # peers that landed elsewhere.
+        self._run: dict[str, int] = {}
+        self._assigned: dict[str, int] = {}
+        self._arrived: dict[int, set[str]] = {}
+
+    # -------------------------------------------------------------- feeding
+    def note_batch(self, peer: str) -> None:
+        """One Status heartbeat from ``peer`` (a completed batch)."""
+        now = self._clock()
+        prev = self._batch_ts.get(peer)
+        self._batch_ts[peer] = now
+        if prev is not None and now > prev:
+            self._batch.setdefault(peer, Ewma(self._alpha)).update(now - prev)
+        self._run[peer] = self._run.get(peer, 0) + 1
+
+    def note_round_closed(self, round_num: int, arrivals: dict) -> None:
+        """One close report for ``round_num``; ``arrivals`` maps peer ->
+        seconds from collect start to its delta's acceptance (compute +
+        upload). A sharded parameter service sends one report per shard,
+        so reports for the same round ACCUMULATE: EWMAs update per
+        report, while the dropped-peer penalty waits for
+        :meth:`start_round` — only a peer no report credited was really
+        quorum-dropped."""
+        if round_num < self.round:
+            return  # stale re-notify from a recovered parameter server
+        self._arrived.setdefault(round_num, set()).update(
+            str(p) for p in arrivals
+        )
+        if round_num < self.warmup_rounds:
+            # First-round arrivals are dominated by one-time jit compile,
+            # not steady-state cost: feeding them would make EVERY peer
+            # look equally slow for several EWMA half-lives. (The peers
+            # still count as arrived — no drop penalty either.)
+            return
+        for peer, lag in arrivals.items():
+            try:
+                lag_s = float(lag)
+            except (TypeError, ValueError):
+                continue
+            if lag_s <= 0:
+                continue
+            steps = self._assigned.get(peer) or self._run.get(peer) or self.base_steps
+            self._per_step.setdefault(peer, Ewma(self._alpha)).update(
+                lag_s / max(steps, 1)
+            )
+
+    def start_round(self, round_num: int, peers: list[str] | None = None) -> None:
+        """Freeze the next round's assignments from the current estimates.
+
+        Assigned peers that NO close report credited for the round just
+        ended were quorum-dropped: their estimate scales by
+        ``drop_penalty`` so their assignment keeps shrinking until their
+        delta lands inside the deadline."""
+        if self.round >= self.warmup_rounds:
+            # Dropped = assigned but credited by NO close report for any
+            # round since the assignment was frozen (shards may have
+            # reported several rounds between our start_round calls).
+            credited: set[str] = set()
+            for rnd, peers_seen in self._arrived.items():
+                if rnd >= self.round:
+                    credited |= peers_seen
+            for peer in set(self._assigned) - credited:
+                est = self._per_step.get(peer)
+                if est is None:
+                    # Never measured at the PS: seed from the batch
+                    # cadence so the penalty has something to act on.
+                    est = self._per_step.setdefault(peer, Ewma(self._alpha))
+                    fallback = self._batch.get(peer)
+                    if fallback is not None and fallback.value is not None:
+                        est.update(fallback.value)
+                est.scale(self.drop_penalty)
+        self.round = round_num
+        self._run.clear()
+        self._assigned.clear()
+        self._arrived = {
+            rnd: peers_seen
+            for rnd, peers_seen in self._arrived.items()
+            if rnd >= round_num
+        }
+        # Batch-cadence baselines reset per round: the gap from a round's
+        # last batch to the next round's first spans the broadcast wait,
+        # which is sync latency, not compute.
+        self._batch_ts.clear()
+        for peer in peers or ():
+            self.steps_for(peer)
+
+    # ------------------------------------------------------------- querying
+    def _estimate(self, peer: str) -> float | None:
+        """Per-step cost: max of the arrival-derived and batch-cadence
+        EWMAs (see the class docstring for why neither alone suffices)."""
+        arrival = self._per_step.get(peer)
+        cadence = self._batch.get(peer)
+        values = [
+            e.value
+            for e in (arrival, cadence)
+            if e is not None and e.value is not None
+        ]
+        return max(values) if values else None
+
+    def steps_for(self, peer: str) -> int:
+        """This round's inner-step assignment for ``peer`` (frozen at first
+        query per round, so every party sees one consistent value)."""
+        cached = self._assigned.get(peer)
+        if cached is not None:
+            return cached
+        t_peer = self._estimate(peer)
+        known = [
+            v
+            for v in (
+                self._estimate(p)
+                for p in set(self._per_step) | set(self._batch)
+            )
+            if v is not None
+        ]
+        if t_peer is None or not known:
+            steps = self.base_steps
+        else:
+            t_ref = statistics.median(known)
+            ratio = max(t_peer, 1e-9) / max(t_ref, 1e-9)  # >1 = slower
+            if 1.0 / self.deadband <= ratio <= self.deadband:
+                # Measurement noise, not a straggler: a busy host's EWMAs
+                # wiggle tens of percent run to run, and an assignment
+                # that flaps with them churns every round's weighting.
+                steps = self.base_steps
+            else:
+                # Quantized power-of-two backoff/boost levels: a 4x
+                # straggler sits stably at base/4 across the whole noise
+                # band instead of oscillating 11 <-> 13.
+                level = round(math.log2(ratio))
+                steps = round(self.base_steps / (2.0 ** level))
+            steps = max(
+                self.min_steps,
+                min(steps, max(round(self.base_steps * self.max_boost), 1)),
+            )
+        self._assigned[peer] = steps
+        HET_METRICS.note_assigned(peer, steps)
+        return steps
+
+    def counter_for(self, peer: str) -> int:
+        """Batches still to run before this peer's sync point (the
+        ``ScheduleUpdate{counter}`` payload)."""
+        return max(self.steps_for(peer) - self._run.get(peer, 0), 0)
+
+    def assignments(self) -> dict:
+        """This round's frozen assignments (published with the round
+        membership as ``RoundMembership.inner_steps``)."""
+        return dict(self._assigned)
+
+
+class LinkTable:
+    """Per-peer measured-bandwidth table driving per-link codec selection.
+
+    The parameter server times every accepted delta as it streams to disk
+    (``push.save_to``) — the only place the real link shows up — and keeps
+    an EWMA of bytes/second per peer. ``codec_for`` maps the estimate onto
+    a wire codec via :func:`hypha_tpu.compress.codec_for_bandwidth`.
+    ``measured`` gates the first-round deadline grace: an elastic round
+    must not quorum-drop a peer the table has never seen upload.
+    """
+
+    def __init__(
+        self,
+        base_codec: str = "none",
+        hi_mbps: float = 100.0,
+        lo_mbps: float = 10.0,
+        alpha: float = 0.4,
+        first_round_grace: float = 6.0,
+    ) -> None:
+        if lo_mbps > hi_mbps:
+            raise ValueError("codec bandwidth thresholds need lo <= hi")
+        self.base_codec = base_codec
+        self.hi_bps = float(hi_mbps) * 1e6
+        self.lo_bps = float(lo_mbps) * 1e6
+        self.first_round_grace = max(float(first_round_grace), 1.0)
+        self._alpha = alpha
+        self._bw: dict[str, Ewma] = {}
+
+    def observe(self, peer: str, nbytes: int, seconds: float) -> float:
+        """Record one measured transfer; returns the updated bits/s EWMA."""
+        bps = (max(int(nbytes), 1) * 8.0) / max(float(seconds), 1e-6)
+        value = self._bw.setdefault(peer, Ewma(self._alpha)).update(bps)
+        HET_METRICS.note_bandwidth(peer, value)
+        return value
+
+    def measured(self, peer: str) -> bool:
+        est = self._bw.get(peer)
+        return est is not None and est.value is not None
+
+    def bandwidth_bps(self, peer: str) -> float | None:
+        est = self._bw.get(peer)
+        return est.value if est is not None else None
+
+    def codec_for(self, peer: str) -> str:
+        from .. import compress
+
+        bw = self.bandwidth_bps(peer)
+        if bw is None:
+            return self.base_codec
+        codec = compress.codec_for_bandwidth(
+            bw, self.base_codec, self.hi_bps, self.lo_bps
+        )
+        HET_METRICS.note_codec(peer, codec)
+        return codec

@@ -22,6 +22,27 @@ WorkerNode` objects a test or ``bench.py --chaos`` holds:
     re-push with backoff (aio.retry), and the PS journal must dedup the
     copies whose first attempt actually landed.
 
+Degrade modes (net-new, ROADMAP item 4 — heterogeneity is a steady state,
+not an event, so these default to ``at_round=0`` and fire on attach):
+
+  * ``slow-worker:<x>`` / ``slow-worker:<peer>:<x>`` — a slow-CPU worker:
+    every per-batch Status round-trip is stretched so each inner batch
+    takes ~``x``× its natural wall-clock (the training thread blocks on
+    the Status response between batches, so the slowdown is real to every
+    observer: the scheduler's timing stats, the round deadline, the
+    worker itself);
+  * ``bw-cap:<peer>:<mbps>`` — cap the peer's LINK at ``mbps``: every
+    push from the peer (delta uploads) and to the peer (update
+    broadcasts) is streamed through a chunk-throttled source, so the
+    RECEIVER measures the cap mid-transfer — exactly what the parameter
+    server's LinkTable (ft.adaptive) keys its per-link codec choice on;
+  * ``jitter:<peer>:<s>`` — add deterministic pseudo-random delay in
+    ``[0, s]`` to every push touching the peer (seeded per target, so a
+    re-run sees the identical delay sequence).
+
+Specs compose: ``bench.py --chaos kill-worker:2,bw-cap:w1:10`` runs both
+(:func:`parse_chaos_specs`).
+
 Trigger semantics: action ``at_round=r`` fires the first time a METRICS
 event for round ``r-1`` is observed — i.e. while round ``r`` is running —
 so "kill worker X mid-round r" is reproducible to the batch. ``at_round=0``
@@ -32,25 +53,44 @@ from __future__ import annotations
 
 import asyncio
 import logging
+import random
 import time
 from dataclasses import dataclass, field
 from typing import Any, Callable
 
 from .. import aio
 
-__all__ = ["ChaosAction", "ChaosController", "parse_chaos_spec"]
+__all__ = [
+    "ChaosAction",
+    "ChaosController",
+    "parse_chaos_spec",
+    "parse_chaos_specs",
+]
 
 log = logging.getLogger("hypha.ft.chaos")
 
-_KINDS = ("kill", "delay", "partition", "kill-ps", "partition-ps")
+_KINDS = (
+    "kill", "delay", "partition", "kill-ps", "partition-ps",
+    "slow", "bw-cap", "jitter",
+)
+
+# Kinds that model a steady condition rather than an event: they attach
+# immediately unless the spec pins a round.
+_DEGRADE_KINDS = ("slow", "bw-cap", "jitter")
+
+# Throttled-push chunk: small enough that a capped toy-scale delta still
+# spreads over several sleeps (the receiver must SEE the cap mid-stream).
+_THROTTLE_CHUNK = 16 * 1024
 
 
 @dataclass(slots=True)
 class ChaosAction:
-    kind: str  # "kill" | "delay" | "partition"
+    kind: str  # one of _KINDS
     target: str  # worker peer id
     at_round: int = 1
-    delay_s: float = 0.0  # kind == "delay"
+    delay_s: float = 0.0  # kind == "delay" | "partition-ps" | "jitter"
+    factor: float = 1.0  # kind == "slow": per-batch wall-clock multiplier
+    rate_bps: float = 0.0  # kind == "bw-cap": link cap in BITS/second
     fired_at: float | None = None  # monotonic time the action ran
 
     def __post_init__(self) -> None:
@@ -58,11 +98,28 @@ class ChaosAction:
             raise ValueError(f"unknown chaos kind {self.kind!r}")
         if self.at_round < 0:
             raise ValueError("at_round must be >= 0")
+        if self.kind == "slow" and self.factor < 1.0:
+            raise ValueError("slow-worker factor must be >= 1.0")
+        if self.kind == "bw-cap" and self.rate_bps <= 0:
+            raise ValueError("bw-cap rate must be positive")
+
+
+def _is_number(token: str) -> bool:
+    try:
+        float(token)
+    except ValueError:
+        return False
+    return True
 
 
 def parse_chaos_spec(spec: str, target: str) -> ChaosAction:
-    """Parse a CLI chaos spec like ``kill-worker:1`` or ``delay-worker:2:0.5``
-    into an action against ``target``."""
+    """Parse ONE CLI chaos spec into an action.
+
+    ``target`` is the harness's default victim; specs that name a peer
+    inline (``bw-cap:w1:10``, ``slow-worker:w2:4``) override it. Numeric
+    second fields keep their historical meaning (round for the event
+    kinds, factor/rate for the degrade kinds).
+    """
     parts = spec.split(":")
     head = parts[0]
     if head in ("kill-worker", "kill"):
@@ -73,12 +130,60 @@ def parse_chaos_spec(spec: str, target: str) -> ChaosAction:
         kind = "partition"
     elif head in ("kill-ps", "partition-ps"):
         kind = head
+    elif head in ("slow-worker", "slow"):
+        kind = "slow"
+    elif head == "bw-cap":
+        kind = "bw-cap"
+    elif head in ("jitter", "jitter-link"):
+        kind = "jitter"
     else:
         raise ValueError(f"unknown chaos spec {spec!r}")
-    at_round = int(parts[1]) if len(parts) > 1 else 1
+    args = parts[1:]
+    if kind in _DEGRADE_KINDS:
+        # Optional inline peer first (bw-cap REQUIRES one — a bandwidth cap
+        # on "the default victim" is too easy to point at the wrong link).
+        if args and not _is_number(args[0]):
+            target = args[0]
+            args = args[1:]
+        elif kind == "bw-cap":
+            raise ValueError(f"bw-cap needs a peer: bw-cap:<peer>:<mbps> ({spec!r})")
+        if kind == "slow":
+            factor = float(args[0]) if args else 4.0
+            at_round = int(args[1]) if len(args) > 1 else 0
+            return ChaosAction(
+                kind=kind, target=target, at_round=at_round, factor=factor
+            )
+        if kind == "bw-cap":
+            if not args:
+                raise ValueError(f"bw-cap needs a rate: bw-cap:<peer>:<mbps> ({spec!r})")
+            rate_bps = float(args[0]) * 1e6
+            at_round = int(args[1]) if len(args) > 1 else 0
+            return ChaosAction(
+                kind=kind, target=target, at_round=at_round, rate_bps=rate_bps
+            )
+        delay_s = float(args[0]) if args else 0.25
+        at_round = int(args[1]) if len(args) > 1 else 0
+        return ChaosAction(
+            kind=kind, target=target, at_round=at_round, delay_s=delay_s
+        )
+    at_round = int(args[0]) if args else 1
     default_delay = 3.0 if kind == "partition-ps" else 1.0
-    delay_s = float(parts[2]) if len(parts) > 2 else default_delay
+    delay_s = float(args[1]) if len(args) > 1 else default_delay
     return ChaosAction(kind=kind, target=target, at_round=at_round, delay_s=delay_s)
+
+
+def parse_chaos_specs(spec: str, target: str) -> list[ChaosAction]:
+    """Parse a comma-composed CLI chaos spec (``kill-worker:2,bw-cap:w1:10``)
+    into the action list — one scenario can now mix an event with steady
+    degrade conditions instead of exactly one action per run."""
+    actions = [
+        parse_chaos_spec(part.strip(), target)
+        for part in spec.split(",")
+        if part.strip()
+    ]
+    if not actions:
+        raise ValueError(f"empty chaos spec {spec!r}")
+    return actions
 
 
 class ChaosController:
@@ -142,6 +247,12 @@ class ChaosController:
             self._partition(worker.node)
         elif action.kind == "partition-ps":
             self._partition_ps(action.target, action.delay_s)
+        elif action.kind == "slow":
+            self._wrap_slow_cpu(worker.node, action.factor)
+        elif action.kind == "bw-cap":
+            self._wrap_bw_cap(action.target, action.rate_bps)
+        elif action.kind == "jitter":
+            self._wrap_jitter(action.target, action.delay_s)
 
     @staticmethod
     async def _kill(worker: Any) -> None:
@@ -169,6 +280,132 @@ class ChaosController:
             return await orig_push(peer_id, resource, source)
 
         node.push = delayed_push
+
+    # ------------------------------------------------------- degrade wraps
+
+    @staticmethod
+    def _wrap_slow_cpu(node: Any, factor: float) -> None:
+        """A slow-CPU worker: stretch every per-batch Status round-trip.
+
+        The training thread synchronously awaits each Status response
+        between batches, so sleeping ``(factor - 1) × compute`` in the
+        request path makes every inner batch take ~``factor``× its
+        natural wall-clock — real to the scheduler's timing statistics,
+        the PS round deadline, and the worker alike. The compute estimate
+        is the gap since we released the PREVIOUS Status (excluding our
+        own injected sleeps, so the slowdown is a stable multiplier
+        instead of compounding geometrically)."""
+        from ..messages import PROTOCOL_PROGRESS, ProgressKind
+
+        orig_request = node.request
+        state = {"last": None}
+
+        async def slow_request(peer_id: str, protocol: str, msg: Any, **kw) -> Any:
+            if protocol == PROTOCOL_PROGRESS:
+                if getattr(msg, "kind", None) != ProgressKind.STATUS:
+                    # Round boundary (update / metrics / update-received):
+                    # the gap to the NEXT status is broadcast wait, not
+                    # compute — stretching it would model a slow NETWORK
+                    # (and make the φ detector see huge one-off stalls),
+                    # not a slow CPU. Drop the baseline instead.
+                    state["last"] = None
+                    return await orig_request(peer_id, protocol, msg, **kw)
+                now = time.monotonic()
+                last = state["last"]
+                if last is not None and now > last:
+                    await asyncio.sleep((factor - 1.0) * (now - last))
+                result = await orig_request(peer_id, protocol, msg, **kw)
+                state["last"] = time.monotonic()
+                return result
+            return await orig_request(peer_id, protocol, msg, **kw)
+
+        node.request = slow_request
+
+    @staticmethod
+    def _throttled_source(source, rate_bps: float):
+        """Wrap a push source (bytes | file path) in an async iterator that
+        trickles chunks at ``rate_bps`` BITS/second — the receiver sees
+        the cap DURING the transfer (its save_to measures it), not as an
+        up-front delay it cannot attribute to the link."""
+
+        async def gen():
+            if isinstance(source, (bytes, bytearray, memoryview)):
+                data = bytes(source)
+                for i in range(0, max(len(data), 1), _THROTTLE_CHUNK):
+                    chunk = data[i : i + _THROTTLE_CHUNK]
+                    await asyncio.sleep(len(chunk) * 8.0 / rate_bps)
+                    if chunk:
+                        yield chunk
+                return
+            f = await asyncio.to_thread(open, source, "rb")
+            try:
+                while True:
+                    chunk = await asyncio.to_thread(f.read, _THROTTLE_CHUNK)
+                    if not chunk:
+                        break
+                    await asyncio.sleep(len(chunk) * 8.0 / rate_bps)
+                    yield chunk
+            finally:
+                await asyncio.to_thread(f.close)
+
+        return gen()
+
+    def _wrap_bw_cap(self, target: str, rate_bps: float) -> None:
+        """Cap every push on the target's LINK (both directions): its own
+        uploads (delta pushes) and pushes toward it from every other node
+        the controller holds (update broadcasts, catch-ups)."""
+        for name, worker in self.workers.items():
+            node = getattr(worker, "node", None)
+            if node is None:
+                continue
+            orig_push = node.push
+
+            if name == target:
+
+                async def capped_push(
+                    peer_id: str, resource: Any, source, _orig=orig_push
+                ) -> int:
+                    return await _orig(
+                        peer_id, resource,
+                        self._throttled_source(source, rate_bps),
+                    )
+
+            else:
+
+                async def capped_push(
+                    peer_id: str, resource: Any, source, _orig=orig_push
+                ) -> int:
+                    if peer_id != target:
+                        return await _orig(peer_id, resource, source)
+                    return await _orig(
+                        peer_id, resource,
+                        self._throttled_source(source, rate_bps),
+                    )
+
+            node.push = capped_push
+
+    def _wrap_jitter(self, target: str, max_delay_s: float) -> None:
+        """Deterministic pseudo-random delay in [0, max_delay_s] on every
+        push touching the target's link — seeded per target, so a re-run
+        sees the identical delay sequence."""
+        rng = random.Random(f"hypha-chaos-jitter:{target}:{max_delay_s}")
+
+        for name, worker in self.workers.items():
+            node = getattr(worker, "node", None)
+            if node is None:
+                continue
+            orig_push = node.push
+            mine = name == target
+
+            async def jittery_push(
+                peer_id: str, resource: Any, source,
+                _orig=orig_push, _mine=mine,
+            ) -> int:
+                if _mine or peer_id == target:
+                    await asyncio.sleep(rng.uniform(0.0, max_delay_s))
+                return await _orig(peer_id, resource, source)
+
+            node.push = jittery_push
 
     def _partition_ps(self, ps_peer: str, duration_s: float) -> None:
         """Sever the data plane between ``ps_peer`` and every other worker

@@ -90,12 +90,24 @@ class FTConfig:
 @register
 @dataclass(slots=True)
 class RoundMembership:
-    """One agreed view of the round's participants."""
+    """One agreed view of the round's participants.
+
+    ``inner_steps`` is the straggler-adaptive controller's per-worker
+    inner-step assignment for the current round (hypha_tpu.ft.adaptive),
+    published so the parameter server can account expected contributions
+    and export the HET telemetry gauges. ``None`` — the default, and the
+    only value a non-adaptive job ever ships — is omitted from the wire
+    entirely, so ``adaptive_steps: off`` keeps today's exact bytes. The
+    assignment always travels with its ``epoch`` (hypha-lint's
+    ``msg-adaptive-needs-round`` rule): an un-epoch'd assignment could
+    re-pace workers from a stale membership snapshot.
+    """
 
     epoch: int = 0
     active: list = field(default_factory=list)  # list[str] peer ids
     suspected: list = field(default_factory=list)
     departed: list = field(default_factory=list)
+    inner_steps: dict | None = None  # peer id -> assigned inner steps
 
     def expected(self) -> set:
         """Peers whose delta the round should wait for (past quorum)."""

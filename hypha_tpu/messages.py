@@ -75,6 +75,8 @@ __all__ = [
     "ShardMap",
     "SHARD_KEY",
     "PREFOLD_KEY",
+    # WAN-adaptive outer rounds (hypha_tpu.ft.adaptive)
+    "CODEC_KEY",
     # value vocabulary
     "ExecutorDescriptor",
     "WorkerSpec",
@@ -608,6 +610,21 @@ class AggregateExecutorConfig:
     # Additive fields: absent on the wire = the single pre-shard PS.
     shard_index: int = 0
     num_ps_shards: int = 1
+    # WAN-adaptive outer rounds (hypha_tpu.ft.adaptive). adaptive_steps
+    # makes the PS report per-peer arrival lags (collect start -> delta
+    # accepted, i.e. inner compute + upload) inside its Updated progress so
+    # the scheduler's straggler controller can EWMA them. adaptive_codec
+    # turns on the PS-side measured-bandwidth table: per-peer broadcast
+    # codecs with per-peer error-feedback residuals, and a CODEC_KEY hint
+    # in each peer's broadcast header switching that worker's next upload.
+    # None — the only value a static job ships — is omitted from the wire
+    # entirely, so `adaptive_steps: off` keeps today's exact bytes.
+    adaptive_steps: bool | None = None
+    adaptive_codec: bool | None = None
+    # adaptive_codec thresholds (megabits/s): >= hi keeps the job codec,
+    # [lo, hi) degrades the link to int8, < lo to int4. None = defaults.
+    codec_bw_hi_mbps: float | None = None
+    codec_bw_lo_mbps: float | None = None
 
 
 @register
@@ -1029,6 +1046,16 @@ SHARD_KEY = "shard"
 # Σ samples·Δθ over the reducer's group (its ``num_samples`` carries the
 # summed weight), so the shard folds it verbatim instead of re-weighting.
 PREFOLD_KEY = "prefold"
+
+# Per-link codec hint (hypha_tpu.ft.adaptive): the parameter server stamps
+# the codec it selected for a peer's LINK — from its measured-bandwidth
+# table — into that peer's update-broadcast header; the worker switches its
+# next delta upload to it. Only adaptive-codec jobs stamp it (a static job's
+# headers stay byte-identical to the pre-adaptive wire), and it always
+# travels next to ``round`` — an un-rounded codec hint could re-configure a
+# worker from a stale redelivery (enforced structurally for registered
+# messages by hypha-lint's ``msg-adaptive-needs-round`` rule).
+CODEC_KEY = "codec"
 
 
 @register

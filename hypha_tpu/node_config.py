@@ -385,6 +385,29 @@ class JobSection:
             "(0 = default 4); each fragment syncs every num_fragments rounds"
         },
     )
+    adaptive_steps: bool = field(
+        default=False,
+        metadata={
+            "doc": "straggler-adaptive inner steps: per-worker step counts "
+            "from EWMA round-trip history (off = the reference projection)"
+        },
+    )
+    adaptive_codec: bool = field(
+        default=False,
+        metadata={
+            "doc": "per-link codec selection: slow links degrade to "
+            "int8/int4 from the PS's measured-bandwidth table (off = one "
+            "job-wide delta_codec)"
+        },
+    )
+    codec_bw_hi_mbps: float = field(
+        default=100.0,
+        metadata={"doc": "adaptive_codec: links >= this keep the job codec"},
+    )
+    codec_bw_lo_mbps: float = field(
+        default=10.0,
+        metadata={"doc": "adaptive_codec: links below this ship int4"},
+    )
 
     def validate(self) -> None:
         if self.kind not in ("train", "serve"):
@@ -433,6 +456,18 @@ class JobSection:
             )
         if self.num_fragments < 0:
             raise ConfigError("job.num_fragments must be >= 0 (0 = default)")
+        if self.adaptive_codec and self.sync_mode != "blocking":
+            raise ConfigError(
+                "job.adaptive_codec requires sync_mode = blocking"
+            )
+        if self.adaptive_codec and self.checkpoint_dir:
+            raise ConfigError(
+                "job.adaptive_codec is not supported with checkpoint_dir yet"
+            )
+        if self.codec_bw_lo_mbps > self.codec_bw_hi_mbps:
+            raise ConfigError(
+                "job.codec_bw_lo_mbps must be <= job.codec_bw_hi_mbps"
+            )
         if self.round_deadline_s < 0:
             raise ConfigError("job.round_deadline_s must be >= 0")
         if self.phi_threshold <= 0:
@@ -501,6 +536,10 @@ class JobSection:
             delta_codec=self.delta_codec,
             sync_mode=self.sync_mode,
             num_fragments=self.num_fragments,
+            adaptive_steps=self.adaptive_steps,
+            adaptive_codec=self.adaptive_codec,
+            codec_bw_hi_mbps=self.codec_bw_hi_mbps,
+            codec_bw_lo_mbps=self.codec_bw_lo_mbps,
             ft=(
                 FTConfig(
                     quorum_fraction=self.quorum_fraction,

@@ -52,6 +52,7 @@ class BatchScheduler:
         time_cap_ms: float = TIME_CAP_MS,
         updates_cap: int = UPDATES_CAP,
         shards_due: "Callable[[int], tuple[int, ...]] | None" = None,
+        adaptive=None,
     ) -> None:
         self.tracker = tracker
         self._on_metrics = on_metrics
@@ -65,6 +66,13 @@ class BatchScheduler:
         self.shards_due = shards_due
         # round -> shards that have reported UPDATED for it.
         self._updated: dict[int, set[int]] = {}
+        # Straggler-adaptive inner steps (hypha_tpu.ft.adaptive): when set,
+        # per-worker sync points come from the controller's EWMA-derived
+        # assignment instead of the synchronization simulation — a 4x
+        # slower worker runs ~k/4 local steps and lands inside the round
+        # deadline instead of being quorum-dropped. None (the default)
+        # keeps the reference projection path bit-exactly.
+        self.adaptive = adaptive
 
     # ------------------------------------------------------------------
     def on_progress(self, peer: str, progress: Progress) -> ProgressResponse:
@@ -119,9 +127,20 @@ class BatchScheduler:
             # before the crash, so it re-sends — advancing again would eat
             # a round.
             return _DONE if self._shard_done(shard, rnd) else _OK
+        if self.adaptive is not None:
+            # The PS reports per-peer arrival lags (collect start -> delta
+            # accepted: inner compute + upload) with its Updated — the
+            # round-trip history the straggler controller EWMAs. A notify
+            # WITHOUT the key (a recovered PS re-announcing a committed
+            # round) is no evidence anyone was dropped — skip the feed
+            # entirely rather than penalize every assigned peer.
+            arrival_s = dict(progress.metrics).get("arrival_s")
+            if arrival_s is not None:
+                self.adaptive.note_round_closed(rnd, arrival_s)
         self._updated.setdefault(rnd, set()).add(shard)
         # Advance while the frontier round has every due shard reported
         # (single PS: exactly the old one-notify-one-advance behavior).
+        advanced = False
         while (
             self.tracker.round < self.tracker.update_epochs
             and self._updated.get(self.tracker.round, set())
@@ -129,6 +148,11 @@ class BatchScheduler:
         ):
             self._updated.pop(self.tracker.round, None)
             self.tracker.advance_round()
+            advanced = True
+        if advanced and self.adaptive is not None:
+            # Freeze the next round's per-worker assignments NOW, before
+            # any worker's first Status of the round asks for its counter.
+            self.adaptive.start_round(self.tracker.round, list(self.tracker.peers))
         # DONE terminates THIS shard's aggregation loop; the workers' own
         # DONE comes with their UpdateReceived once the global round
         # reaches update_epochs.
@@ -144,9 +168,21 @@ class BatchScheduler:
         if state == WorkerState.DONE:
             return _DONE
         self.tracker.update(peer, progress.batch_size)
+        if self.adaptive is not None:
+            self.adaptive.note_batch(peer)
         if state != WorkerState.TRAINING:
             # Already counting down / mid-update: keep going.
             return _CONTINUE
+        if self.adaptive is not None:
+            # Adaptive assignment: the worker's sync point is fixed for the
+            # round the moment it first reports — stragglers get fewer
+            # inner steps so their delta lands inside the deadline, and the
+            # sample-weighted fold (stream.accum) keeps the mean unbiased.
+            counter = self.adaptive.counter_for(peer)
+            self.tracker.set_state(peer, WorkerState.UPDATE_SCHEDULED)
+            return ProgressResponse(
+                kind=ProgressResponseKind.SCHEDULE_UPDATE, counter=counter
+            )
 
         # Simulate all workers still producing batches this round.
         sim_peers = [

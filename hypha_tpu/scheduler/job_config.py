@@ -126,6 +126,24 @@ class DiLoCoJob:
     # ingress from W pushes to ~W/G. A dead reducer degrades its group to
     # direct shard pushes (ANY failover). 0/1 = disabled.
     reduce_group_size: int = 0
+    # WAN-adaptive outer rounds (hypha_tpu.ft.adaptive). adaptive_steps
+    # replaces the synchronization simulation with an EWMA round-trip
+    # controller: per-worker inner-step counts are published with the
+    # round membership so a 4x slower worker runs ~k/4 local steps and
+    # lands inside the deadline instead of being quorum-dropped (the
+    # sample-weighted fold keeps the mean unbiased). adaptive_codec
+    # promotes delta_codec from per-job to per-LINK: the parameter server
+    # measures each peer's upload bandwidth and degrades slow links to
+    # int8/int4 (per-peer error-feedback residuals keep every link
+    # unbiased), stamping the choice into that peer's broadcast header so
+    # the worker switches its next upload. Both default OFF — today's
+    # wire and rounds stay bit-exact.
+    adaptive_steps: bool = False
+    adaptive_codec: bool = False
+    # adaptive_codec bandwidth thresholds (megabits/s): >= hi keeps the
+    # job codec, [lo, hi) degrades to int8, < lo to int4.
+    codec_bw_hi_mbps: float = 100.0
+    codec_bw_lo_mbps: float = 10.0
 
     def __post_init__(self) -> None:
         if self.delta_dtype not in ("float32", "bfloat16"):
@@ -171,6 +189,29 @@ class DiLoCoJob:
                 )
         if self.ps_checkpoint_every_rounds < 1:
             raise ValueError("ps_checkpoint_every_rounds must be >= 1")
+        if self.adaptive_codec and self.sync_mode != "blocking":
+            # Per-link broadcast re-encode lives in the blocking round
+            # loop; the pipelined fan-out shares one wire file per
+            # fragment. Straggler-adaptive STEPS compose with any mode.
+            raise ValueError(
+                "adaptive_codec requires sync_mode blocking "
+                "(adaptive_steps works with every sync mode)"
+            )
+        if self.adaptive_codec and self.num_ps_shards > 1:
+            raise ValueError(
+                "adaptive_codec is not supported with a sharded parameter "
+                "service yet"
+            )
+        if self.adaptive_codec and self.checkpoint_dir:
+            # The durable journal retains ONE wire file per round for
+            # restart re-broadcast; per-peer wires (and per-peer broadcast
+            # EF residuals) have no checkpoint slot yet.
+            raise ValueError(
+                "adaptive_codec is not supported with checkpoint_dir "
+                "(durable PS) yet"
+            )
+        if self.codec_bw_lo_mbps > self.codec_bw_hi_mbps:
+            raise ValueError("codec_bw_lo_mbps must be <= codec_bw_hi_mbps")
         if self.rounds.update_rounds <= 0:
             raise ValueError("update_rounds must be positive")
         if self.rounds.avg_samples_between_updates <= 0:

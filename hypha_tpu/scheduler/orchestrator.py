@@ -45,6 +45,7 @@ import uuid
 from typing import Any
 
 from .. import aio, messages
+from ..ft.adaptive import StragglerController
 from ..ft.detector import PhiAccrualDetector
 from ..ft.membership import (
     PROTOCOL_FT,
@@ -144,6 +145,10 @@ class _RunContext:
         self.reduce_groups: list[list[str]] = []
         self.router: StatusRouter | None = None
         self.tracker: ProgressTracker | None = None
+        # Straggler-adaptive inner steps (hypha_tpu.ft.adaptive): the EWMA
+        # round-trip controller, when job.adaptive_steps is on.
+        self.adaptive: "StragglerController | None" = None
+        self.assign_published = -1  # last round whose assignment was pushed
         self.data_scheduler: DataScheduler | None = None
         self.complete: asyncio.Event | None = None
         self.activity: list[float] = []
@@ -484,6 +489,20 @@ class Orchestrator:
             parts = placement_parts(
                 job.sync_mode, job.num_fragments, num_shards
             )
+            if getattr(job, "adaptive_steps", False):
+                # Base inner-step count: the round's sample budget spread
+                # over one aggregate sweep of the fleet's batch sizes —
+                # what a uniform pool would run per worker per round.
+                total_batch = sum(h.batch_size for h in ctx.handles.values())
+                ctx.adaptive = StragglerController(
+                    base_steps=max(
+                        1,
+                        round(
+                            job.rounds.avg_samples_between_updates
+                            / max(total_batch, 1)
+                        ),
+                    )
+                )
             batch_scheduler = BatchScheduler(
                 ctx.tracker, on_metrics=on_metrics, on_complete=ctx.complete.set,
                 shards_due=(
@@ -495,6 +514,7 @@ class Orchestrator:
                     if num_shards > 1
                     else None
                 ),
+                adaptive=ctx.adaptive,
             )
 
             async def on_progress(peer: str, progress: Progress):
@@ -504,7 +524,22 @@ class Orchestrator:
                     # Status heartbeats mostly, but the PS's Updated and the
                     # round metrics count too.
                     ctx.detector.heartbeat(peer)
-                return batch_scheduler.on_progress(peer, progress)
+                response = batch_scheduler.on_progress(peer, progress)
+                if (
+                    ctx.adaptive is not None
+                    and ctx.membership is not None
+                    and ctx.tracker is not None
+                    and ctx.tracker.round > ctx.assign_published
+                ):
+                    # A round advanced: publish the fresh per-worker
+                    # inner-step assignment with the round membership so
+                    # the PS can account expected contributions (and the
+                    # HET telemetry gauges follow). Fire-and-forget like
+                    # every other membership push — a lost snapshot is
+                    # repaired by the next one.
+                    ctx.assign_published = ctx.tracker.round
+                    self._notify_membership_soon(ctx)
+                return response
 
             progress_reg = self.node.on(PROTOCOL_PROGRESS, Progress).respond_with(
                 on_progress
@@ -598,6 +633,27 @@ class Orchestrator:
                             fragments=job.num_fragments,
                             shard_index=k,
                             num_ps_shards=num_shards,
+                            # WAN-adaptive knobs (ft.adaptive): None — not
+                            # False — when off, so a static job's dispatched
+                            # spec carries no new wire fields at all.
+                            adaptive_steps=(
+                                True if getattr(job, "adaptive_steps", False)
+                                else None
+                            ),
+                            adaptive_codec=(
+                                True if getattr(job, "adaptive_codec", False)
+                                else None
+                            ),
+                            codec_bw_hi_mbps=(
+                                job.codec_bw_hi_mbps
+                                if getattr(job, "adaptive_codec", False)
+                                else None
+                            ),
+                            codec_bw_lo_mbps=(
+                                job.codec_bw_lo_mbps
+                                if getattr(job, "adaptive_codec", False)
+                                else None
+                            ),
                         ),
                     ),
                 )
@@ -975,6 +1031,13 @@ class Orchestrator:
         assert ctx.membership is not None and ctx.ps_handles
         ok = True
         snapshot = ctx.membership.snapshot()
+        if getattr(ctx, "adaptive", None) is not None:
+            # Publish the straggler controller's per-worker inner-step
+            # assignment with the membership (RoundMembership.inner_steps,
+            # epoch-tagged). None when empty: the wire stays byte-compatible
+            # until the first adaptive assignment exists.
+            assignments = ctx.adaptive.assignments()
+            snapshot.inner_steps = assignments or None
         for k, handle in enumerate(ctx.ps_handles):
             if handle is None:
                 # Shard mid-restart: a plain snapshot loss is repaired by

@@ -21,6 +21,12 @@ tree-reduce layer's correctness (and its tests) rest on.
 the payload adds verbatim (scaled only by ``sign`` for un-folds) while the
 shipped ``samples`` header still advances the weight total, so the final
 ``mean`` divides by the true Σ samples across every level of the tree.
+
+The sample weighting is also what keeps straggler-adaptive rounds
+(hypha_tpu.ft.adaptive) unbiased: a worker assigned k/4 inner steps ships
+``num_samples`` = the tokens it actually processed, so its delta enters
+the mean at exactly its share of the round's data — unequal step counts
+change the estimator's variance, never its expectation.
 """
 
 from __future__ import annotations

@@ -26,6 +26,8 @@ __all__ = [
     "SHARD_METRICS",
     "ServeMetrics",
     "SERVE_METRICS",
+    "HetMetrics",
+    "HET_METRICS",
     "register_on",
 ]
 
@@ -310,12 +312,135 @@ class ServeMetrics:
 SERVE_METRICS = ServeMetrics()
 
 
+class HetMetrics:
+    """WAN-heterogeneity instruments (hypha_tpu.ft.adaptive).
+
+    * ``bandwidth_bps``       — per-peer measured upload bandwidth EWMA
+      (the parameter server's LinkTable, timed around each delta save);
+      exported as one lazy observable gauge per peer, like the stream
+      bundle's per-fragment close counters.
+    * ``assigned_steps``      — per-peer inner-step assignment for the
+      current round (the StragglerController's output; on the PS side the
+      adopted ``RoundMembership.inner_steps`` records here too).
+    * ``codec counters``      — per-link codec selections: one counter per
+      codec name plus the current per-peer choice, and a ``codec_switches``
+      counter on the worker side (upload codec changed by a broadcast
+      hint).
+    * ``quorum_drops``        — workers whose delta missed an elastic
+      round's close (expected − covered at deadline), total and by round:
+      the number the straggler-adaptive controller exists to drive to 0.
+    """
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._bandwidth: dict[str, float] = {}
+        self._assigned: dict[str, int] = {}
+        self._peer_codecs: dict[str, str] = {}
+        self.codec_counts: dict[str, Counter] = {}
+        self.codec_switches = Counter("hypha.het.codec_switches")
+        self.quorum_drops = Counter("hypha.het.quorum_drops")
+        self._drops_by_round: dict[int, int] = {}
+        # Meters registered via register_on: peers and codecs only become
+        # known as rounds run, so their gauges attach lazily.
+        self._meters: list[Meter] = []
+
+    # ------------------------------------------------------------ recording
+    def note_bandwidth(self, peer: str, bps: float) -> None:
+        with self._lock:
+            created = peer not in self._bandwidth
+            self._bandwidth[peer] = float(bps)
+            meters = list(self._meters) if created else []
+        for meter in meters:
+            meter.observable_gauge(
+                f"hypha.het.bandwidth_bps.{peer}",
+                lambda p=peer: self._bandwidth.get(p, 0.0),
+            )
+
+    def note_assigned(self, peer: str, steps: int) -> None:
+        with self._lock:
+            created = peer not in self._assigned
+            self._assigned[peer] = int(steps)
+            meters = list(self._meters) if created else []
+        for meter in meters:
+            meter.observable_gauge(
+                f"hypha.het.assigned_steps.{peer}",
+                lambda p=peer: self._assigned.get(p, 0),
+            )
+
+    def note_codec(self, peer: str, codec: str) -> None:
+        with self._lock:
+            self._peer_codecs[peer] = codec
+            counter = self.codec_counts.get(codec)
+            created = counter is None
+            if created:
+                counter = Counter(f"hypha.het.codec.{codec}")
+                self.codec_counts[codec] = counter
+            meters = list(self._meters) if created else []
+        for meter in meters:
+            meter.observable_gauge(counter.name, counter.value)
+        counter.add(1)
+
+    def note_quorum_drop(self, round_num: int, peers) -> None:
+        dropped = list(peers)
+        if not dropped:
+            return
+        self.quorum_drops.add(len(dropped))
+        with self._lock:
+            self._drops_by_round[int(round_num)] = self._drops_by_round.get(
+                int(round_num), 0
+            ) + len(dropped)
+
+    # ------------------------------------------------------------- querying
+    def attach_meter(self, meter: Meter) -> None:
+        """Export the per-peer/per-codec instruments on ``meter``, including
+        peers first seen after this call."""
+        with self._lock:
+            self._meters.append(meter)
+            bw_peers = list(self._bandwidth)
+            step_peers = list(self._assigned)
+            counters = list(self.codec_counts.values())
+        for peer in bw_peers:
+            meter.observable_gauge(
+                f"hypha.het.bandwidth_bps.{peer}",
+                lambda p=peer: self._bandwidth.get(p, 0.0),
+            )
+        for peer in step_peers:
+            meter.observable_gauge(
+                f"hypha.het.assigned_steps.{peer}",
+                lambda p=peer: self._assigned.get(p, 0),
+            )
+        for counter in counters:
+            meter.observable_gauge(counter.name, counter.value)
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {
+                "bandwidth_bps": dict(self._bandwidth),
+                "assigned_steps": dict(self._assigned),
+                "peer_codecs": dict(self._peer_codecs),
+                "codec_counts": {
+                    c: k.value() for c, k in sorted(self.codec_counts.items())
+                },
+                "codec_switches": self.codec_switches.value(),
+                "quorum_drops": self.quorum_drops.value(),
+                "quorum_drops_by_round": dict(sorted(self._drops_by_round.items())),
+            }
+
+    def reset(self) -> None:
+        """Fresh instruments (tests and hetbench isolate runs this way)."""
+        self.__init__()
+
+
+HET_METRICS = HetMetrics()
+
+
 def register_on(
     meter: Meter,
     metrics: FTMetrics = FT_METRICS,
     stream: StreamMetrics = STREAM_METRICS,
     shard: ShardMetrics = SHARD_METRICS,
     serve: "ServeMetrics" = None,
+    het: "HetMetrics" = None,
 ) -> None:
     """Export the bundles through a Meter as observable gauges."""
     meter.observable_gauge(
@@ -370,6 +495,13 @@ def register_on(
         "hypha.serve.routed_requests", serve.routed_requests.value
     )
     meter.observable_gauge("hypha.serve.ejections", serve.ejections.value)
-    # Per-fragment close counters attach lazily — fragment ids only exist
-    # once the PS closes their first round.
+    het = het if het is not None else HET_METRICS
+    meter.observable_gauge("hypha.het.quorum_drops", het.quorum_drops.value)
+    meter.observable_gauge(
+        "hypha.het.codec_switches", het.codec_switches.value
+    )
+    # Per-fragment close counters (and the heterogeneity bundle's per-peer
+    # bandwidth / assigned-step gauges + per-codec counters) attach lazily
+    # — fragment ids and peers only exist once rounds run.
     stream.attach_meter(meter)
+    het.attach_meter(meter)

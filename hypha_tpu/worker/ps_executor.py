@@ -55,6 +55,7 @@ import hashlib
 import logging
 import os
 import shutil
+import time
 import uuid
 from pathlib import Path
 
@@ -64,10 +65,12 @@ from safetensors.numpy import load_file, save_file
 from .. import aio
 from .. import compress
 from .. import native
+from ..ft.adaptive import LinkTable
 from ..ft.durable import GENERATION_KEY, RESYNC_KEY, DurablePS, FoldRecord
 from ..ft.membership import PROTOCOL_FT, MembershipUpdate, RoundMembership, quorum_size
 from ..ft.rejoin import CATCHUP_KEY, CatchupBuffer
 from ..messages import (
+    CODEC_KEY,
     PREFOLD_KEY,
     PROTOCOL_PROGRESS,
     SHARD_KEY,
@@ -90,7 +93,12 @@ from ..stream import (
     shard_owns_round,
 )
 from ..stream.accum import RoundAccum
-from ..telemetry.ft_metrics import FT_METRICS, SHARD_METRICS, STREAM_METRICS
+from ..telemetry.ft_metrics import (
+    FT_METRICS,
+    HET_METRICS,
+    SHARD_METRICS,
+    STREAM_METRICS,
+)
 from .job_manager import Execution, JobExecutor
 
 __all__ = ["ParameterServerExecutor"]
@@ -104,6 +112,14 @@ _ELASTIC_TICK_S = 0.5
 # Broadcast fan-out width: enough concurrent streams to fill the uplink
 # without opening one per peer on a wide job.
 _BROADCAST_CONCURRENCY = 8
+
+# Elastic drain slack: a delta whose payload is still streaming when the
+# round deadline passes gets this much extra wall-clock to finish before
+# the collector abandons it. Pushes are queued at HEADER arrival, so
+# without a drain bound one bandwidth-starved link could hold every round
+# open for its whole multi-second transfer — the deadline must bound the
+# bytes, not just the header.
+_DRAIN_SLACK_S = 0.25
 
 
 def _file_sha(path: Path) -> str:
@@ -306,6 +322,15 @@ class ParameterServerExecutor(JobExecutor):
                     len(msg.membership.suspected), msg.joined,
                 )
                 elastic.adopt(msg)
+                if msg.membership.inner_steps:
+                    # Straggler-adaptive assignment published with the
+                    # membership (ft.adaptive): record the per-peer
+                    # inner-step gauges on the aggregation side too.
+                    for p, steps in msg.membership.inner_steps.items():
+                        try:
+                            HET_METRICS.note_assigned(str(p), int(steps))
+                        except (TypeError, ValueError):
+                            continue
                 return Ack(ok=True)
 
             membership_reg = (
@@ -323,6 +348,26 @@ class ParameterServerExecutor(JobExecutor):
             if bcast_codec in compress.QUANT_CODECS
             else None
         )
+        # WAN-adaptive outer rounds (ft.adaptive): report per-peer arrival
+        # lags with every Updated (straggler-adaptive inner steps), and/or
+        # run the per-LINK codec table — fast links keep the job codec,
+        # slow links degrade to int8/int4 with per-peer error-feedback
+        # residuals. Both default off; the durable/sharded paths keep the
+        # static wire (job_config validates the combinations).
+        adaptive_steps = bool(getattr(cfg, "adaptive_steps", False))
+        link: LinkTable | None = None
+        peer_efs: dict[str, "compress.ErrorFeedback | None"] = {}
+        if getattr(cfg, "adaptive_codec", False) and dur is None and not sharded:
+            cfg_hi = getattr(cfg, "codec_bw_hi_mbps", None)
+            cfg_lo = getattr(cfg, "codec_bw_lo_mbps", None)
+            link = LinkTable(
+                base_codec=bcast_codec,
+                # `is not None`, not `or`: an explicit 0.0 threshold means
+                # "never degrade past this tier" and must not silently
+                # become the default.
+                hi_mbps=float(cfg_hi) if cfg_hi is not None else 100.0,
+                lo_mbps=float(cfg_lo) if cfg_lo is not None else 10.0,
+            )
         if elastic is not None:
             elastic.dur = dur
             elastic.shard = shard
@@ -381,10 +426,17 @@ class ParameterServerExecutor(JobExecutor):
                     accum = _RoundAccum()
                 if dur is not None:
                     await asyncio.to_thread(dur.note_open, round_num)
+                # Per-peer arrival lags (collect start -> delta accepted):
+                # the straggler controller's round-trip signal, reported
+                # with the Updated notify below. Only adaptive jobs fill it
+                # — the Updated wire stays byte-identical otherwise.
+                arrivals: dict[str, float] | None = (
+                    {} if adaptive_steps else None
+                )
                 if elastic is not None:
                     received = await self._collect_round_elastic(
                         consumer, job_id, elastic, cfg, work_dir, round_num,
-                        accum=accum, dur=dur,
+                        accum=accum, dur=dur, link=link, arrivals=arrivals,
                     )
                 else:
                     received = await self._collect_round(
@@ -392,6 +444,7 @@ class ParameterServerExecutor(JobExecutor):
                         round_num, accum=accum, dur=dur,
                         preloaded=preload.pop(round_num, None),
                         preloaded_folded=preloaded_folded,
+                        link=link, arrivals=arrivals,
                     )
                 if dur is not None:
                     await asyncio.to_thread(
@@ -402,6 +455,38 @@ class ParameterServerExecutor(JobExecutor):
                     received, momentum_file, lr, mu, work_dir, round_num,
                     accum,
                 )
+                if link is not None:
+                    # Per-link codec selection: peers grouped by their
+                    # LINK's codec, each with its own error-feedback
+                    # residual. The rejoin catch-up accumulates the RAW
+                    # f32 update — each link tracks it within its own
+                    # (bounded, re-shipped) quantization error.
+                    # NOTE: this is a TWIN of the static close sequence
+                    # below (catch-up -> notify -> broadcast -> cleanup ->
+                    # DONE check); a change to either copy's ordering —
+                    # especially notify-BEFORE-broadcast, see the race
+                    # note below — must be mirrored here.
+                    if elastic is not None:
+                        await asyncio.to_thread(
+                            elastic.catchup.accumulate, update_path
+                        )
+                    response = await self._notify_updated(
+                        scheduler_peer, job_id, round_num, arrivals=arrivals
+                    )
+                    await self._broadcast_adaptive(
+                        cfg, update_path, round_num, elastic, link,
+                        peer_efs, work_dir,
+                    )
+                    for path, _ in received.values():
+                        path.unlink(missing_ok=True)
+                    round_num += 1
+                    update_path.unlink(missing_ok=True)
+                    if elastic is not None:
+                        await self._serve_joins(elastic, cfg, round_num, work_dir)
+                    if response.kind == ProgressResponseKind.DONE:
+                        execution.finish("completed")
+                        return
+                    continue
                 wire_path, sent_update = await asyncio.to_thread(
                     self._encode_broadcast,
                     update_path, bcast_codec, bcast_ef, work_dir, round_num,
@@ -451,7 +536,9 @@ class ParameterServerExecutor(JobExecutor):
                 # otherwise the worker is told Continue instead of Done and
                 # starts a phantom extra round (the reference broadcasts
                 # first, parameter_server.rs:232-283, and carries this race).
-                response = await self._notify_updated(scheduler_peer, job_id, round_num)
+                response = await self._notify_updated(
+                    scheduler_peer, job_id, round_num, arrivals=arrivals
+                )
                 if dur is not None:
                     await asyncio.to_thread(
                         dur.note_notified, round_num,
@@ -849,14 +936,19 @@ class ParameterServerExecutor(JobExecutor):
         dur: "DurablePS | None" = None,
         preloaded: dict[str, tuple[Path, float]] | None = None,
         preloaded_folded: bool = False,
+        link: "LinkTable | None" = None,
+        arrivals: "dict[str, float] | None" = None,
     ) -> dict[str, tuple[Path, float]]:
         """Gather one pseudo-gradient per worker: peer -> (path, samples).
 
         ``preloaded`` seeds the round with journaled folds a recovered PS
         rebuilt; ``preloaded_folded`` says the caller's replayed
         accumulator already contains them (the bit-exact resume path) so
-        only the missing workers are waited for.
+        only the missing workers are waited for. ``link`` feeds the
+        measured-bandwidth table as each delta streams in; ``arrivals``
+        (when given) records each peer's collect-start -> accepted lag.
         """
+        t_open = asyncio.get_running_loop().time()
         received: dict[str, tuple[Path, float]] = dict(preloaded or {})
         # Tree-reduce cover info: entry key -> (prefolded, covered worker
         # peers). Journaled entries rebuild theirs from the fold records;
@@ -871,6 +963,10 @@ class ParameterServerExecutor(JobExecutor):
                     accum, entry,
                     prefolded=covers.get(key, (False, frozenset()))[0],
                 )
+        if arrivals is not None:
+            # Journal-seeded folds landed before this collect: zero lag.
+            for covered_peer in self._covered(received, covers):
+                arrivals.setdefault(str(covered_peer), 0.0)
         dest_dir = dur.deltas_dir if dur is not None else work_dir
         while len(self._covered(received, covers)) < num_workers:
             push = await consumer.next()
@@ -924,8 +1020,15 @@ class ParameterServerExecutor(JobExecutor):
                 name_suffix=(
                     f"-{uuid.uuid4().hex[:8]}" if dur is not None else ""
                 ),
-                hasher=hasher, name_key=key,
+                hasher=hasher, name_key=key, link=link,
             )
+            if arrivals is not None:
+                lag = asyncio.get_running_loop().time() - t_open
+                if prefolded:
+                    for member in cov:
+                        arrivals.setdefault(str(member), lag)
+                else:
+                    arrivals[peer] = lag
             if not await self._ingest(
                 dur, round_num, 0, key, entry,
                 sha=hasher.hexdigest() if hasher is not None else None,
@@ -969,6 +1072,8 @@ class ParameterServerExecutor(JobExecutor):
         round_num: int,
         accum: "_RoundAccum | None" = None,
         dur: "DurablePS | None" = None,
+        link: "LinkTable | None" = None,
+        arrivals: "dict[str, float] | None" = None,
     ) -> dict[str, tuple[Path, float]]:
         """Quorum + deadline gather: peer -> (path, samples).
 
@@ -979,6 +1084,15 @@ class ParameterServerExecutor(JobExecutor):
         tagged with a future round are parked and pre-credited to it.
         A recovered PS seeds ``st.early`` with the journaled folds, so the
         interrupted round's deltas re-fold here instead of being re-waited.
+
+        Adaptive extensions (ft.adaptive, both None on static jobs):
+        ``link`` measures each accepted delta's bandwidth AND extends the
+        deadline by its ``first_round_grace`` while any expected peer is
+        still unmeasured — a peer must never be quorum-dropped before the
+        table has seen one upload from it (nothing adaptive could have
+        reacted yet). ``arrivals`` records per-peer collect->accept lags
+        for the straggler controller; expected peers missing at close are
+        counted as quorum drops (HET_METRICS).
         """
         received: dict[str, tuple[Path, float]] = dict(st.early.pop(round_num, {}))
         # Tree-reduce cover info: entry key -> (prefolded, covered workers).
@@ -1009,14 +1123,31 @@ class ParameterServerExecutor(JobExecutor):
             )
         dest_dir = dur.deltas_dir if dur is not None else work_dir
         loop = asyncio.get_running_loop()
-        deadline = (
-            loop.time() + st.round_deadline_s if st.round_deadline_s > 0 else None
-        )
+        t_open = loop.time()
+        if arrivals is not None:
+            # Early-parked deltas (and journal-seeded folds) landed before
+            # this collect even opened: zero lag, emphatically not a drop.
+            for covered_peer in self._covered(received, covers):
+                arrivals.setdefault(str(covered_peer), 0.0)
+
+        def deadline_at() -> float | None:
+            if st.round_deadline_s <= 0:
+                return None
+            if link is not None and any(
+                not link.measured(p) for p in st.membership.expected()
+            ):
+                # First-round grace: an expected peer the bandwidth table
+                # has never seen must get one chance to land an upload
+                # before the deadline can drop it.
+                return t_open + st.round_deadline_s * link.first_round_grace
+            return t_open + st.round_deadline_s
+
         deadline_logged = False
         while True:
             # A rejoiner announced mid-round starts contributing to THIS
             # round: serve its catch-up from inside the wait loop.
             await self._serve_joins(st, cfg, round_num, work_dir)
+            deadline = deadline_at()
             covered = self._covered(received, covers)
             expected = st.membership.expected() | covered
             quorate = len(covered) >= st.quorum()
@@ -1066,26 +1197,38 @@ class ParameterServerExecutor(JobExecutor):
                 )
                 await push.read_all()
                 continue
-            # Non-durable saves land on the deterministic path
-            # delta-{round}-{sha(key)}, so any superseded duplicate must
-            # be retired BEFORE saving — un-folding/unlinking after the
-            # save would read the new bytes and delete the just-saved
-            # file. Durable runs save under unique names (the journal
-            # references files by name) and retire after the dedup check.
-            suffix = f"-{uuid.uuid4().hex[:8]}" if dur is not None else ""
+            # ALWAYS save under a unique name, then retire any superseded
+            # duplicate AFTER the save succeeds. Saving onto the old
+            # deterministic path would truncate the already-folded
+            # original the moment the drain starts — and a drain the
+            # deadline then abandons (bounded_save) would have destroyed
+            # a contribution the round actually had. Durable runs need
+            # the unique names anyway (the journal references files by
+            # name).
+            suffix = f"-{uuid.uuid4().hex[:8]}"
             hasher = hashlib.sha256() if dur is not None else None
+            # The drain bound applies only once the round is already
+            # QUORATE: abandoning a surplus straggler's slow transfer
+            # merely trims it, but a quorum-REQUIRED delta must drain to
+            # completion however slow its link — abandoning it would
+            # starve the round of the very delta its close is waiting
+            # for (every retry would get an ever-smaller budget).
+            drain_deadline = (
+                deadline_at()
+                if len(self._covered(received, covers)) >= st.quorum()
+                else None
+            )
             if delta_round > round_num:
                 # Early: a fast worker already merged this round's broadcast
                 # and shipped the next pseudo-gradient; credit it forward.
                 bucket = st.early.setdefault(delta_round, {})
-                if dur is None:
-                    old = bucket.pop(key, None)
-                    if old is not None:
-                        old[0].unlink(missing_ok=True)
-                entry = await self._save_delta(
-                    push, dest_dir, delta_round, name_suffix=suffix,
-                    hasher=hasher, name_key=key,
+                entry = await self._save_delta_bounded(
+                    push, dest_dir, delta_round, suffix=suffix,
+                    hasher=hasher, key=key, link=link,
+                    deadline=drain_deadline, job_id=job_id,
                 )
+                if entry is None:
+                    continue
                 if not await self._ingest(
                     dur, delta_round, 0, key, entry,
                     sha=hasher.hexdigest() if hasher is not None else None,
@@ -1093,8 +1236,11 @@ class ParameterServerExecutor(JobExecutor):
                 ):
                     continue
                 # Superseded durable files stay for replay_ops (GC'd at
-                # checkpoint); only the bucket entry is replaced.
-                bucket.pop(key, None)
+                # checkpoint); a non-durable original is retired now that
+                # its replacement fully landed.
+                old = bucket.pop(key, None)
+                if old is not None and dur is None:
+                    old[0].unlink(missing_ok=True)
                 early_cov = st.early_covers.setdefault(delta_round, {})
                 if prefolded and cov:
                     # Nothing in a parked bucket has folded yet, so the
@@ -1106,21 +1252,24 @@ class ParameterServerExecutor(JobExecutor):
                 bucket[key] = entry
                 early_cov[key] = (prefolded, cov)
                 continue
-            if dur is None:
-                old = received.pop(key, None)
-                if old is not None:
-                    # Double-send guard (reference TODO :215-218): replace —
-                    # un-fold the superseded delta while its file still
-                    # holds the ORIGINAL bytes.
-                    log.warning(
-                        "ps %s: duplicate delta from %s; replacing", job_id, peer
-                    )
-                    await self._fold(accum, old, sign=-1.0, prefolded=prefolded)
-                    old[0].unlink(missing_ok=True)
-            entry = await self._save_delta(
-                push, dest_dir, delta_round, name_suffix=suffix,
-                hasher=hasher, name_key=key,
+            entry = await self._save_delta_bounded(
+                push, dest_dir, delta_round, suffix=suffix,
+                hasher=hasher, key=key, link=link,
+                deadline=drain_deadline, job_id=job_id,
             )
+            if entry is None:
+                continue
+            if arrivals is not None:
+                lag = loop.time() - t_open
+                if prefolded:
+                    # A tree-reduce partial carries its whole group: every
+                    # covered member arrived (inside the partial) at this
+                    # lag — without this, the straggler controller would
+                    # perpetually drop-penalize healthy reduced workers.
+                    for member in cov:
+                        arrivals.setdefault(str(member), lag)
+                else:
+                    arrivals[peer] = lag
             if not await self._ingest(
                 dur, delta_round, 0, key, entry,
                 sha=hasher.hexdigest() if hasher is not None else None,
@@ -1131,16 +1280,18 @@ class ParameterServerExecutor(JobExecutor):
                     job_id, peer,
                 )
                 continue
-            if dur is not None:
-                old = received.pop(key, None)
-                if old is not None:
-                    # Un-fold reads the superseded file's original bytes;
-                    # the file stays for recovery's replay_ops (GC'd at
-                    # checkpoint).
-                    log.warning(
-                        "ps %s: duplicate delta from %s; replacing", job_id, peer
-                    )
-                    await self._fold(accum, old, sign=-1.0, prefolded=prefolded)
+            old = received.pop(key, None)
+            if old is not None:
+                # Retire the superseded entry only AFTER its replacement
+                # fully landed (unique names — the un-fold reads the
+                # original bytes either way). Durable files stay on disk
+                # for recovery's replay_ops (checkpoint GC).
+                log.warning(
+                    "ps %s: duplicate delta from %s; replacing", job_id, peer
+                )
+                await self._fold(accum, old, sign=-1.0, prefolded=prefolded)
+                if dur is None:
+                    old[0].unlink(missing_ok=True)
             if prefolded and cov:
                 await self._retire_covered(
                     job_id, accum, received, covers, cov,
@@ -1157,13 +1308,20 @@ class ParameterServerExecutor(JobExecutor):
         # Degraded = fewer covered WORKERS than the job bought replicas (a
         # departed worker that was never replaced keeps every round
         # degraded, even though the shrunken active set reported "in full").
+        covered = self._covered(received, covers)
         full = max(cfg.num_workers, len(st.membership.active))
-        if len(self._covered(received, covers)) < full:
+        if len(covered) < full:
             FT_METRICS.degraded_rounds.add(1)
             log.warning(
                 "ps %s: round %d DEGRADED — aggregating %d of %d",
                 job_id, round_num, len(received), full,
             )
+        # Quorum drops: expected (live active) workers whose delta missed
+        # the close — wasted straggler compute, the count the adaptive
+        # controller exists to drive to zero.
+        dropped = st.membership.expected() - covered
+        if dropped:
+            HET_METRICS.note_quorum_drop(round_num, sorted(dropped))
         return received
 
     # ------------------------------------------------------- streaming sync
@@ -1233,6 +1391,7 @@ class ParameterServerExecutor(JobExecutor):
         bcast_efs: dict[int, "compress.ErrorFeedback | None"] = dict(
             init_efs or {}
         )
+        adaptive_steps = bool(getattr(cfg, "adaptive_steps", False))
         bcast_tasks: set[asyncio.Task] = set()
         last_bcast: dict[int, asyncio.Task] = {}  # fragment -> newest fan-out
         quant = bcast_codec in compress.QUANT_CODECS
@@ -1255,11 +1414,14 @@ class ParameterServerExecutor(JobExecutor):
             while True:
                 if dur is not None:
                     await asyncio.to_thread(dur.note_open, round_num)
+                arrivals: dict[str, float] | None = (
+                    {} if adaptive_steps else None
+                )
                 received = await self._collect_round_stream(
                     consumer, job_id, cfg, elastic, allowed, num_workers,
                     work_dir, round_num, fragments, accums, pending,
                     dur=dur, due_fn=due_fn, pending_covers=pending_covers,
-                    sharded=sharded,
+                    sharded=sharded, arrivals=arrivals,
                     owned_fn=(
                         (lambda r: shard_owns_round(
                             sync_mode, r, fragments, num_shards, shard
@@ -1330,7 +1492,8 @@ class ParameterServerExecutor(JobExecutor):
                 # blocking loop: the scheduler must have advanced the
                 # round before any worker's UpdateReceived).
                 response = await self._notify_updated(
-                    scheduler_peer, job_id, round_num, shard=shard
+                    scheduler_peer, job_id, round_num, shard=shard,
+                    arrivals=arrivals,
                 )
                 if dur is not None:
                     await asyncio.to_thread(
@@ -1413,6 +1576,7 @@ class ParameterServerExecutor(JobExecutor):
         pending_covers: "dict | None" = None,
         owned_fn=None,
         sharded: bool = False,
+        arrivals: "dict[str, float] | None" = None,
     ) -> dict[str, tuple[Path, float]]:
         """Gather one round's FRAGMENT deltas: peer -> (path, samples).
 
@@ -1442,6 +1606,12 @@ class ParameterServerExecutor(JobExecutor):
         frag = due_fn(round_num)
         dest_dir = dur.deltas_dir if dur is not None else work_dir
         loop = asyncio.get_running_loop()
+        t_open = loop.time()
+        if arrivals is not None:
+            # Deltas parked while earlier rounds collected (fast workers
+            # ran ahead) landed before this collect opened: zero lag.
+            for covered_peer in self._covered(received, covers):
+                arrivals.setdefault(str(covered_peer), 0.0)
         deadline = None
         if st is not None and st.round_deadline_s > 0:
             deadline = loop.time() + st.round_deadline_s
@@ -1550,11 +1720,21 @@ class ParameterServerExecutor(JobExecutor):
             # delta (retiring before save — the elastic path's rule — is
             # only safe because that path has no post-save validation).
             hasher = hashlib.sha256() if dur is not None else None
-            entry = await self._save_delta(
-                push, dest_dir, delta_round,
-                name_suffix=f"-{uuid.uuid4().hex[:8]}",
-                hasher=hasher, name_key=key,
+            suffix = f"-{uuid.uuid4().hex[:8]}"
+            # Drain bound only once quorate (see the elastic collector):
+            # a quorum-required delta must drain however slow its link.
+            drain_deadline = None
+            if st is not None and deadline is not None and (
+                len(self._covered(received, covers)) >= st.quorum()
+            ):
+                drain_deadline = deadline
+            entry = await self._save_delta_bounded(
+                push, dest_dir, delta_round, suffix=suffix,
+                hasher=hasher, key=key, deadline=drain_deadline,
+                job_id=job_id,
             )
+            if entry is None:
+                continue
             if tag is not None and not await asyncio.to_thread(
                 self._frame_tag_matches, entry[0], tag
             ):
@@ -1593,6 +1773,13 @@ class ParameterServerExecutor(JobExecutor):
                 )
             bucket[key] = entry
             cov_table[key] = (prefolded, cov)
+            if arrivals is not None and delta_round == round_num:
+                lag = loop.time() - t_open
+                if prefolded:
+                    for member in cov:
+                        arrivals.setdefault(str(member), lag)
+                else:
+                    arrivals[peer] = lag
             await self._fold(accum, entry, prefolded=prefolded)
             log.info(
                 "ps %s: round %d fragment %d delta %d (from %s%s)",
@@ -1601,13 +1788,17 @@ class ParameterServerExecutor(JobExecutor):
                 "" if delta_round == round_num else f", parked r{delta_round}",
             )
         if st is not None:
+            covered = self._covered(received, covers)
             full = max(cfg.num_workers, len(st.membership.active))
-            if len(self._covered(received, covers)) < full:
+            if len(covered) < full:
                 FT_METRICS.degraded_rounds.add(1)
                 log.warning(
                     "ps %s: round %d DEGRADED — aggregating %d of %d",
                     job_id, round_num, len(received), full,
                 )
+            dropped = st.membership.expected() - covered
+            if dropped:
+                HET_METRICS.note_quorum_drop(round_num, sorted(dropped))
         return received
 
     @staticmethod
@@ -1668,10 +1859,73 @@ class ParameterServerExecutor(JobExecutor):
             if wire_path != update_path:
                 wire_path.unlink(missing_ok=True)
 
+    async def _save_delta_bounded(
+        self, push, dest_dir: Path, delta_round: int, *,
+        suffix: str, hasher, key: str,
+        link: "LinkTable | None" = None,
+        deadline: "float | None" = None,
+        job_id: str = "",
+    ) -> "tuple[Path, float] | None":
+        """Save one delta with the DRAIN bounded by the round deadline.
+
+        A push is queued the moment its header frame lands; the payload
+        may still be streaming for many seconds on a bandwidth-starved
+        link. Without a bound, one such drain holds the round open past
+        the deadline for every peer (the close condition is only
+        re-checked between accepts) — the exact straggler pathology the
+        deadline exists to cut off. An abandoned drain counts as the
+        round's quorum drop at close; the sender's retry path re-ships
+        it and the stale guard (or the next round's collect) disposes of
+        the copy. Returns None when abandoned.
+        """
+        if deadline is None:
+            return await self._save_delta(
+                push, dest_dir, delta_round, name_suffix=suffix,
+                hasher=hasher, name_key=key, link=link,
+            )
+        loop = asyncio.get_running_loop()
+        budget = max(deadline - loop.time(), 0.0) + _DRAIN_SLACK_S
+        try:
+            return await asyncio.wait_for(
+                self._save_delta(
+                    push, dest_dir, delta_round, name_suffix=suffix,
+                    hasher=hasher, name_key=key, link=link,
+                ),
+                timeout=budget,
+            )
+        except asyncio.TimeoutError:
+            log.warning(
+                "ps %s: delta drain from %s for round %d abandoned "
+                "after %.1fs (deadline passed mid-transfer)",
+                job_id, push.peer, delta_round, budget,
+            )
+            push.finish()
+            name = hashlib.sha256(
+                (key or push.peer).encode()
+            ).hexdigest()[:24]
+            partial = (
+                dest_dir / f"delta-{delta_round}-{name}{suffix}.safetensors"
+            )
+            if link is not None:
+                # The abandoned drain IS a measurement: ``drained`` bytes
+                # in ``budget`` seconds bounds the link from above.
+                # Without it a link too slow to EVER finish inside the
+                # grace window would stay unmeasured forever — the grace
+                # would extend every round's deadline and the codec
+                # ladder would never engage.
+                try:
+                    drained = partial.stat().st_size
+                except OSError:
+                    drained = 0
+                link.observe(push.peer, max(drained, 1), budget)
+            partial.unlink(missing_ok=True)
+            return None
+
     @staticmethod
     async def _save_delta(
         push, work_dir: Path, round_num: int, name_suffix: str = "",
         hasher=None, name_key: "str | None" = None,
+        link: "LinkTable | None" = None,
     ) -> tuple[Path, float]:
         """Save one pseudo-gradient push; returns (path, sample weight).
 
@@ -1683,11 +1937,22 @@ class ParameterServerExecutor(JobExecutor):
         parameter-sized read of the file just written). ``name_key``
         overrides the peer id in the deterministic name — a reducer's
         forwarded partial must not land on the same path as the reducer's
-        own direct delta.
+        own direct delta. ``link`` (ft.adaptive) times the save — the
+        push streams the payload, so the wall-clock of draining it to
+        disk IS the link — and feeds the per-peer bandwidth EWMA the
+        codec ladder keys on.
         """
         name = hashlib.sha256((name_key or push.peer).encode()).hexdigest()[:24]
         dest = work_dir / f"delta-{round_num}-{name}{name_suffix}.safetensors"
-        await push.save_to(dest, hasher=hasher)
+        t0 = time.monotonic() if link is not None else 0.0
+        nbytes = await push.save_to(dest, hasher=hasher)
+        if link is not None:
+            try:
+                size = int(nbytes) if nbytes else dest.stat().st_size
+            except (TypeError, ValueError, OSError):
+                size = 0
+            if size > 0:
+                link.observe(push.peer, size, time.monotonic() - t0)
         samples = 1.0
         if isinstance(push.resource, dict):
             try:
@@ -1830,6 +2095,100 @@ class ParameterServerExecutor(JobExecutor):
         shutil.copyfile(momentum_file, tmp)
         os.replace(tmp, ckpt_dir / "momentum.safetensors")
 
+    async def _broadcast_adaptive(
+        self,
+        cfg,
+        update_path: Path,
+        round_num: int,
+        elastic: "_ElasticState | None",
+        link: "LinkTable",
+        peer_efs: dict,
+        work_dir: Path,
+    ) -> None:
+        """Per-LINK broadcast: peers grouped by the codec the measured-
+        bandwidth table picked for their link, one wire per GROUP.
+
+        Non-quantized codecs carry no residual, so their groups share one
+        encode ("none" ships the f32 update file itself, zero extra
+        work); only quantized links pay a per-peer encode, because their
+        error-feedback residuals are necessarily per-peer — residual
+        streams depend on the exact payload sequence a link saw, and one
+        shared residual would absorb another link's error and bias both.
+        The residual instance is kept across codec changes (f32 error is
+        codec-independent), so a link that degrades int8 -> int4 mid-job
+        keeps tracking the true trajectory. The selected codec is
+        stamped into the push header (``CODEC_KEY``) so the worker
+        switches its next UPLOAD to it — the HQD1 frame is
+        self-describing, so no other negotiation exists. Each group fans
+        out through the ordinary :meth:`_broadcast` (same retry /
+        bounded-concurrency / tolerated-failure semantics).
+        """
+        peers = (
+            list(elastic.membership.active)
+            if elastic is not None
+            else list(cfg.results.ref.peers or [])
+        )
+        if not peers:
+            return
+        by_codec: dict[str, list[str]] = {}
+        for peer in peers:
+            by_codec.setdefault(link.codec_for(peer), []).append(peer)
+        # The f32 tree is only materialized if some link needs a re-encode
+        # (a healthy pool at base codec "none" pays nothing).
+        tree_box: dict = {}
+
+        def update_tree() -> dict:
+            if "tree" not in tree_box:
+                tree_box["tree"] = dict(load_file(str(update_path)))
+            return tree_box["tree"]
+
+        sends: list[tuple[Path, str, list[str]]] = []
+        scratch: list[Path] = []
+        for codec, group in sorted(by_codec.items()):
+            if codec in compress.QUANT_CODECS:
+                for peer in group:
+                    ef = peer_efs.get(peer)
+                    if ef is None:
+                        ef = peer_efs[peer] = compress.ErrorFeedback()
+                    tag = hashlib.sha256(peer.encode()).hexdigest()[:12]
+                    wire = work_dir / (
+                        f"update-{round_num}.{tag}.wire.safetensors"
+                    )
+                    await asyncio.to_thread(
+                        compress.write_delta, wire, update_tree(), codec,
+                        ef=ef,
+                    )
+                    scratch.append(wire)
+                    sends.append((wire, codec, [peer]))
+            elif codec == "none":
+                sends.append((update_path, codec, list(group)))
+            else:
+                wire = work_dir / (
+                    f"update-{round_num}.{codec}.wire.safetensors"
+                )
+                await asyncio.to_thread(
+                    compress.write_delta, wire, update_tree(), codec
+                )
+                scratch.append(wire)
+                sends.append((wire, codec, list(group)))
+        tasks = [
+            asyncio.create_task(
+                self._broadcast(
+                    cfg, wire, round_num, elastic,
+                    extra_header={CODEC_KEY: codec},
+                    peers_override=group,
+                ),
+                name=f"ps-abcast-{codec}",
+            )
+            for wire, codec, group in sends
+        ]
+        try:
+            await asyncio.gather(*tasks)
+        finally:
+            await aio.reap(*(t for t in tasks if not t.done()))
+            for wire in scratch:
+                wire.unlink(missing_ok=True)
+
     async def _broadcast(
         self,
         cfg,
@@ -1917,12 +2276,21 @@ class ParameterServerExecutor(JobExecutor):
                 await aio.reap(*(t for t in tasks if not t.done()))
 
     async def _notify_updated(
-        self, scheduler_peer: str, job_id: str, round_num: int, shard: int = 0
+        self, scheduler_peer: str, job_id: str, round_num: int, shard: int = 0,
+        arrivals: "dict[str, float] | None" = None,
     ) -> ProgressResponse:
         progress = Progress(
             kind=ProgressKind.UPDATED, job_id=job_id, round=round_num,
             shard=shard,
         )
+        if arrivals is not None:
+            # Straggler-adaptive inner steps (ft.adaptive): per-peer
+            # round-trip lags for the scheduler's EWMA controller. Only
+            # adaptive jobs attach the key — a static job's Updated stays
+            # byte-identical to today's wire.
+            progress.metrics = {
+                "arrival_s": {p: round(t, 6) for p, t in arrivals.items()}
+            }
         resp = await self.node.request(
             scheduler_peer, PROTOCOL_PROGRESS, progress, timeout=30
         )

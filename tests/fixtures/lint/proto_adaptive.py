@@ -1,0 +1,33 @@
+"""Seeded fixture pair for hypha-lint's ``msg-adaptive-needs-round`` rule.
+
+Deliberately NOT registered with hypha_tpu.messages (registration would
+leak into the live registry other tests lint); tests/test_lint.py passes
+these classes to ``proto_rules.check_adaptive_tags`` as an explicit
+registry. ``AdaptiveBad`` must trip the rule — a per-peer inner-step /
+codec assignment without its round could re-pace a worker (or re-encode
+its link) from a stale redelivery. ``AdaptiveGood`` is the clean twin.
+"""
+
+# No `from __future__ import annotations`: stringified annotations make
+# dataclasses.fields() resolve against sys.modules[cls.__module__], which
+# an exec'd fixture module is deliberately absent from.
+from dataclasses import dataclass, field
+
+
+@dataclass(slots=True)
+class AdaptiveBad:
+    """Per-peer assignments with NO round tag: the rule must fire."""
+
+    inner_steps: dict = field(default_factory=dict)  # peer -> steps
+    codecs: dict = field(default_factory=dict)  # peer -> wire codec
+    note: str = ""
+
+
+@dataclass(slots=True)
+class AdaptiveGood:
+    """Per-peer assignments paired with their epoch: the rule stays quiet."""
+
+    epoch: int = 0
+    inner_steps: dict = field(default_factory=dict)
+    codecs: dict = field(default_factory=dict)
+    note: str = ""

@@ -302,6 +302,34 @@ def test_proto_shard_rule_live_registry_clean():
     assert proto_rules.check_shard_tags() == []
 
 
+def test_proto_adaptive_rule_on_fixture_pair():
+    """The seeded fixture pair: AdaptiveBad (per-peer inner_steps/codecs,
+    no round tag) fires the rule, clean twin AdaptiveGood stays quiet. The
+    fixtures are deliberately unregistered — they reach the rule as an
+    explicit registry."""
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "proto_adaptive", FIXTURES / "proto_adaptive.py"
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    bad = proto_rules.check_adaptive_tags(
+        registry={"AdaptiveBad": mod.AdaptiveBad, "AdaptiveGood": mod.AdaptiveGood}
+    )
+    assert [v.rule for v in bad] == ["msg-adaptive-needs-round"]
+    assert "AdaptiveBad" in bad[0].message
+    assert proto_rules.check_adaptive_tags(
+        registry={"AdaptiveGood": mod.AdaptiveGood}
+    ) == []
+
+
+def test_proto_adaptive_rule_live_registry_clean():
+    """The shipping registry (RoundMembership.inner_steps rides its epoch)
+    satisfies the rule."""
+    assert proto_rules.check_adaptive_tags() == []
+
+
 def test_proto_manifest_catches_stale_value_vocabulary():
     bad = proto_rules.check_protocol_map(
         registry={}, manifest={}, values={"GhostValue"}
