@@ -3,7 +3,7 @@
 
 PYTHON ?= python
 
-.PHONY: lint lint-fixtures test compressbench streambench ftbench-ps shardbench servbench hetbench
+.PHONY: lint lint-fixtures test compressbench streambench ftbench-ps shardbench servbench hetbench obsbench
 
 lint:
 	$(PYTHON) -m hypha_tpu.analysis hypha_tpu/
@@ -74,3 +74,10 @@ hetbench:
 # generation handshake). Writes FTBENCH_kill-ps-2.json.
 ftbench-ps:
 	$(PYTHON) bench.py --chaos kill-ps:2
+
+# Observability plane: end-to-end round tracing overhead (traced round
+# wall within 3% of untraced) and critical-path attribution (a bw-capped
+# peer's upload span named as the stall by the merged timeline). Writes
+# OBSBENCH_r10.json + OBSBENCH_r10.telemetry.json (docs/observability.md).
+obsbench:
+	JAX_PLATFORMS=cpu $(PYTHON) benchmarks/obsbench.py

@@ -344,26 +344,48 @@ def main() -> None:
     print(json.dumps(line))
 
 
-def _chaos_main(spec: str) -> int:
-    """``bench.py --chaos <spec>`` (kill-worker:<round>, kill-ps:<round>,
-    partition-ps:<round>:<s>, slow-worker:<x>, bw-cap:<peer>:<mbps>,
-    jitter:<peer>:<s>, ...): run the orchestrated fault-injection scenario
-    (benchmarks/ft_chaos.py — 4 workers, elastic membership, durable PS
-    for the ps scenarios) on the CPU backend and persist the result as
-    FTBENCH_<scenario>.json next to this script. Specs compose with
-    commas (``kill-worker:2,bw-cap:w1:10``) so one run can mix an event
-    with steady degrade conditions."""
+def _chaos_main(spec: str, trace_dir: str | None = None) -> int:
+    """``bench.py --chaos <spec> [--trace <dir>]`` (kill-worker:<round>,
+    kill-ps:<round>, partition-ps:<round>:<s>, slow-worker:<x>,
+    bw-cap:<peer>:<mbps>, jitter:<peer>:<s>, ...): run the orchestrated
+    fault-injection scenario (benchmarks/ft_chaos.py — 4 workers, elastic
+    membership, durable PS for the ps scenarios) on the CPU backend and
+    persist the result as FTBENCH_<scenario>.json next to this script.
+    Specs compose with commas (``kill-worker:2,bw-cap:w1:10``) so one run
+    can mix an event with steady degrade conditions.
+
+    ``--trace <dir>`` turns on end-to-end round tracing + flight-recorder
+    spill into ``dir`` and runs the timeline merger over it afterward
+    (``python -m hypha_tpu.telemetry.timeline <dir>`` re-renders it any
+    time). A telemetry metrics snapshot is dumped next to the artifact
+    either way, so every chaos bench gets metrics for free."""
     os.environ["JAX_PLATFORMS"] = "cpu"  # control-plane bench: no accelerator
     sys.path.insert(0, os.path.join(_REPO, "benchmarks"))
     from ft_chaos import run_chaos_scenario
 
-    line = run_chaos_scenario(spec)
+    line = run_chaos_scenario(spec, trace_dir=trace_dir)
     safe = "".join(c if (c.isalnum() or c in "-_") else "-" for c in spec)
     out_path = os.path.join(_REPO, f"FTBENCH_{safe}.json")
     with open(out_path, "w") as f:
         json.dump(line, f, indent=2)
         f.write("\n")
     _log(f"wrote {out_path}")
+    from hypha_tpu.telemetry import metrics_snapshot
+
+    snap_path = os.path.join(_REPO, f"FTBENCH_{safe}.telemetry.json")
+    with open(snap_path, "w") as f:
+        json.dump(metrics_snapshot(), f, indent=2)
+        f.write("\n")
+    _log(f"wrote {snap_path}")
+    if trace_dir:
+        from hypha_tpu.telemetry import timeline as tl
+
+        merged = tl.build_timeline(trace_dir)
+        with open(os.path.join(trace_dir, "timeline.json"), "w") as f:
+            json.dump(merged, f, indent=2)
+            f.write("\n")
+        print(tl.render_text(merged), file=sys.stderr)
+        _log(f"wrote {os.path.join(trace_dir, 'timeline.json')}")
     print(json.dumps(line))
     return 0
 
@@ -373,7 +395,19 @@ if __name__ == "__main__":
         if len(sys.argv) >= 3 and sys.argv[1] == "--run":
             sys.exit(_child_main(sys.argv[2]))
         if len(sys.argv) >= 2 and sys.argv[1] == "--chaos":
-            sys.exit(_chaos_main(sys.argv[2] if len(sys.argv) > 2 else "kill-worker:1"))
+            args = sys.argv[2:]
+            trace_dir = None
+            if "--trace" in args:
+                i = args.index("--trace")
+                if i + 1 >= len(args):
+                    raise SystemExit("--trace needs a directory")
+                trace_dir = args[i + 1]
+                del args[i : i + 2]
+            sys.exit(
+                _chaos_main(
+                    args[0] if args else "kill-worker:1", trace_dir=trace_dir
+                )
+            )
         main()
     except Exception as e:  # always emit a parseable line
         # The full traceback goes to STDERR — in child mode that is the

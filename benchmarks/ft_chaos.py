@@ -41,13 +41,24 @@ def _log(msg: str) -> None:
 
 
 def run_chaos_scenario(
-    spec: str = "kill-worker:1",
+    spec: "str | None" = "kill-worker:1",
     num_workers: int = 4,
     rounds: int = 4,
     quorum_fraction: float = 0.75,
     round_deadline_s: float = 6.0,
+    trace_dir: "str | None" = None,
+    model_scale: int = 1,
 ) -> dict:
-    """Run one chaos scenario; returns the FTBENCH result dict."""
+    """Run one chaos scenario; returns the FTBENCH result dict.
+
+    ``spec=None`` runs the same orchestrated topology with NO fault
+    injected — the baseline the observability bench (obsbench) compares
+    traced runs against. ``trace_dir`` turns on end-to-end round tracing
+    (telemetry.trace) and flight-recorder spill into that directory for
+    the run's duration. ``model_scale`` multiplies the toy model's width
+    so the delta grows (obsbench's bw-cap run needs uploads that dwarf
+    compute).
+    """
     from safetensors.numpy import save_file
 
     from hypha_tpu.data_node import DataNode
@@ -63,13 +74,20 @@ def run_chaos_scenario(
     from hypha_tpu.worker.arbiter import OfferConfig
     from hypha_tpu.worker.runtime import WorkerNode
 
+    from hypha_tpu.telemetry import trace
+    from hypha_tpu.telemetry.flight import FLIGHT
+
     FT_METRICS.reset()
     HET_METRICS.reset()
+    if trace_dir is not None:
+        trace.enable(trace_dir, node="bench")
+        FLIGHT.clear()
+        FLIGHT.configure(node="bench", spill_dir=trace_dir)
     # PS scenarios (kill-ps / partition-ps) target the parameter server's
     # worker node; worker scenarios target the second allocated worker.
     # The spec may compose several comma-separated actions (degrade modes
     # like bw-cap name their peer inline and ride along with an event).
-    parts = [p.strip() for p in spec.split(",") if p.strip()]
+    parts = [p.strip() for p in (spec or "").split(",") if p.strip()]
     ps_scenario = any(
         p.startswith(("kill-ps", "partition-ps")) for p in parts
     )
@@ -82,7 +100,8 @@ def run_chaos_scenario(
     kill_actions = [a for a in actions if a.kind == "kill"]
     victim = (
         next((a.target for a in actions if a.kind.endswith("ps")), None)
-        or (kill_actions[0].target if kill_actions else actions[0].target)
+        or (kill_actions[0].target if kill_actions else None)
+        or (actions[0].target if actions else None)
     )
     tmp = Path(tempfile.mkdtemp(prefix="hypha-ftbench-"))
 
@@ -144,7 +163,8 @@ def run_chaos_scenario(
                 "family": "gpt2",
                 "config": {
                     "vocab_size": vocab, "n_positions": seq,
-                    "n_embd": 16, "n_layer": 1, "n_head": 2,
+                    "n_embd": 16 * max(int(model_scale), 1),
+                    "n_layer": 1, "n_head": 2,
                 },
                 "seed": 7,
             },
@@ -253,6 +273,17 @@ def run_chaos_scenario(
             if snap["rejoin_latency_ms_count"]
             else None
         )
+        # Per-round walls from the FIRST metric event of each round (the
+        # interval between successive round closes): what obsbench compares
+        # traced vs untraced, immune to the auction/startup fixed cost.
+        first_metric: dict[int, float] = {}
+        for r, t in metric_times:
+            first_metric.setdefault(r, t)
+        ordered = sorted(first_metric)
+        round_walls = [
+            round(first_metric[b] - first_metric[a], 4)
+            for a, b in zip(ordered, ordered[1:])
+        ]
         return {
             "metric": "ft_chaos_rounds_completed",
             "value": result.rounds,
@@ -279,10 +310,18 @@ def run_chaos_scenario(
             "rejoin_latency_ms": round(latency_ms, 1) if latency_ms else None,
             "membership": result.ft,
             "wall_s": round(wall_s, 1),
+            "round_walls_s": round_walls,
+            "trace_dir": trace_dir,
             "vs_baseline": None,  # the seed aborts the whole job here
         }
 
-    return asyncio.run(asyncio.wait_for(main(), timeout=600))
+    try:
+        return asyncio.run(asyncio.wait_for(main(), timeout=600))
+    finally:
+        if trace_dir is not None:
+            FLIGHT.spill()
+            FLIGHT.disarm()  # a later untraced run must not spill here
+            trace.disable()
 
 
 def main() -> int:

@@ -183,6 +183,13 @@ async def retry(
                 )
                 raise
             FT_METRICS.retry_attempts.add(1)
+            # Flight-recorder breadcrumb: post-mortems of a late round need
+            # the retry storm visible next to the chaos/drop events.
+            from .telemetry.flight import FLIGHT  # lazy: no import cycle
+
+            FLIGHT.record(
+                "retry", what=label, attempt=attempt, error=str(e)[:200],
+            )
             lg.info(
                 "retry %r: attempt %d failed (%s); next in %.2fs",
                 label, attempt, e, delay,

@@ -67,6 +67,11 @@ RULES: dict[str, str] = {
     "donated-buffer-reuse": (
         "argument donated to a jitted call is used again afterwards"
     ),
+    # -- tracing discipline -------------------------------------------------
+    "span-not-scoped": (
+        "tracer.span(...) result not entered by a `with` block — the span "
+        "is never ended (never exported, wrong duration)"
+    ),
     # -- protocol schema ----------------------------------------------------
     "msg-roundtrip": (
         "registered wire message does not encode/decode round-trip"
@@ -214,7 +219,7 @@ def lint_source(
     path: str, text: str, rules: set[str] | None = None
 ) -> LintReport:
     """Run the AST rule families over one in-memory source (test entry)."""
-    from . import async_rules, jax_rules
+    from . import async_rules, jax_rules, trace_rules
 
     report = LintReport()
     try:
@@ -222,7 +227,9 @@ def lint_source(
     except (SyntaxError, ValueError) as e:  # ValueError: e.g. null bytes
         report.parse_errors.append(f"{path}: {e}")
         return report
-    found = async_rules.check(src) + jax_rules.check(src)
+    found = (
+        async_rules.check(src) + jax_rules.check(src) + trace_rules.check(src)
+    )
     for v in found:
         if rules is None or v.rule in rules:
             report.violations.append(v)

@@ -1,0 +1,73 @@
+"""Tracing discipline rules.
+
+  * ``span-not-scoped`` — a ``tracer.span(...)`` / ``trace.span(...)``
+    call whose result is not entered by a ``with`` block leaks an
+    unended span: it is never exported (contextvar tracers) or records a
+    zero/garbage duration (file tracers), silently corrupting the round
+    timeline the observability plane exists to produce.  The blessed
+    shapes:
+
+      - ``with tracer.span("op"): ...`` — the context manager ends it;
+      - the explicit begin/finish pair (``trace.begin`` / ``trace.finish``)
+        for spans that start on one call path and end on another — those
+        entry points are named so precisely to stay outside this rule.
+
+    A call assigned to a name and entered later (``cm = tracer.span(…)``
+    … ``with cm:``) is still flagged: the deferred-entry shape has no
+    leak-free failure mode (an exception between the two statements
+    abandons the span), and the begin/finish API exists for exactly that
+    need.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from .core import FileSource, Violation, dotted_name
+
+__all__ = ["check", "SPAN_RECEIVERS"]
+
+# A `.span(...)` call is tracing when its receiver's final dotted segment
+# looks like a tracer handle: `tracer`, `self._tracer`, the `trace` /
+# `tracing` module helpers. `span` attributes on unrelated objects
+# (tokenizer spans, text spans) don't match these names.
+SPAN_RECEIVERS = ("trace", "tracer", "tracing")
+
+
+def _is_tracing_receiver(node: ast.AST) -> bool:
+    name = dotted_name(node)
+    if not name:
+        return False
+    last = name.rsplit(".", 1)[-1].lower().lstrip("_")
+    return last in SPAN_RECEIVERS or last.endswith("tracer")
+
+
+def check(src: FileSource) -> list[Violation]:
+    # Calls that ARE a with-item context expression are the blessed shape.
+    with_calls: set[int] = set()
+    for node in ast.walk(src.tree):
+        if isinstance(node, (ast.With, ast.AsyncWith)):
+            for item in node.items:
+                if isinstance(item.context_expr, ast.Call):
+                    with_calls.add(id(item.context_expr))
+    violations: list[Violation] = []
+    for node in ast.walk(src.tree):
+        if (
+            isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Attribute)
+            and node.func.attr == "span"
+            and _is_tracing_receiver(node.func.value)
+            and id(node) not in with_calls
+        ):
+            receiver = dotted_name(node.func.value) or "<tracer>"
+            violations.append(
+                src.violation(
+                    "span-not-scoped",
+                    node,
+                    f"{receiver}.span(...) outside a `with` block leaks an "
+                    f"unended span (never exported / wrong duration); enter "
+                    f"it with `with`, or use the explicit begin()/finish() "
+                    f"pair for cross-call spans",
+                )
+            )
+    return violations

@@ -67,6 +67,8 @@ import jax.numpy as jnp
 import numpy as np
 
 from ..telemetry import SERVE_METRICS
+from ..telemetry import trace
+from ..telemetry.flight import FLIGHT
 
 __all__ = ["DecodePool", "PoolBusy", "supports_pool", "supports_paging"]
 
@@ -135,6 +137,14 @@ class _Group:
     finish_chunk: int = -1
     t_submit: float = 0.0  # request latency (SERVE_METRICS)
     order: int = -1  # admission sequence; preemption picks the youngest
+    # Serve-path tracing (telemetry.trace): the request's ``decode`` span,
+    # opened at first admission and finished at resolve — it spans
+    # preempt/re-admit cycles, so its duration is the decode latency the
+    # caller actually saw. None while tracing is off. ``traceparent`` is
+    # the submitting request's context (the router's route span via the
+    # worker's serve span) so pool spans join the request's trace.
+    trace_span: Any = None
+    traceparent: "str | None" = None
 
 
 @dataclass
@@ -352,8 +362,12 @@ class DecodePool:
             return self._paged_reject(prompts, n_new) is None
         return _bucket(max(len(p) for p in prompts)) + n_new <= self.max_len
 
-    def submit(self, prompts: list, n_new: int) -> Future:
-        """Queue ``prompts`` for continuation; greedy, ``n_new`` tokens each."""
+    def submit(
+        self, prompts: list, n_new: int, traceparent: str | None = None
+    ) -> Future:
+        """Queue ``prompts`` for continuation; greedy, ``n_new`` tokens each.
+        ``traceparent`` (serve-path tracing) parents the group's
+        prefill/decode spans under the submitting request's trace."""
         fut: Future = Future()
         if not prompts or any(not p for p in prompts):
             fut.set_exception(ValueError("prompts must be non-empty"))
@@ -397,6 +411,7 @@ class DecodePool:
             self.requests += 1
             self._backlog += 1
             group = _Group(prompts, int(n_new), fut)
+            group.traceparent = traceparent
             group.t_submit = time.monotonic()
             self._queue.put(group)
         return fut
@@ -633,9 +648,17 @@ class DecodePool:
             padded[i, L - len(p):] = p  # left-pad into the window
             start[i] = L - len(p)
         prefill = self._prefill_fn(kb, L)
-        new_cache, first = prefill(
-            self._vars, jnp.asarray(padded), jnp.asarray(start)
-        )
+        with trace.span(
+            "prefill", parent=group.traceparent,
+            attrs={"rows": k, "window": L},
+        ):
+            new_cache, first = prefill(
+                self._vars, jnp.asarray(padded), jnp.asarray(start)
+            )
+        if group.trace_span is None:
+            group.trace_span = trace.begin(
+                "decode", parent=group.traceparent, attrs={"rows": k}
+            )
         rows = [self._free.pop() for _ in range(k)]
         insert = self._insert_fn(k)
         self._cache, self._tok = insert(
@@ -682,6 +705,8 @@ class DecodePool:
         One implementation for both modes — the completion contract (and
         its accounting) must not diverge paged vs fixed-slot."""
         group.finish_chunk = self.chunks
+        trace.finish(group.trace_span)
+        group.trace_span = None
         if group.fut.done():
             return
         if group.t_submit:
@@ -757,6 +782,11 @@ class DecodePool:
             self._admit_seq += 1
             group.order = self._admit_seq
             group.admit_chunk = self.chunks
+            if group.trace_span is None:
+                group.trace_span = trace.begin(
+                    "decode", parent=group.traceparent,
+                    attrs={"rows": len(live)},
+                )
             for r in live:
                 full = r.prompt + r.emitted  # recompute-resume prompt
                 r.slot = self._free_lanes.pop()
@@ -782,9 +812,16 @@ class DecodePool:
             toks[r.slot] = r.win_tokens[r.pos : r.pos + P]
             self._h_idx[r.slot] = r.pos
         self._push_rowvars()
-        self._cache, last = self._prefill_paged()(
-            self._vars, self._cache, jnp.asarray(toks)
-        )
+        # A paged prefill chunk can serve several groups; parent on the
+        # first row's request (chunks are FIFO, so it is the oldest).
+        with trace.span(
+            "prefill",
+            parent=pre[0].group.traceparent if pre else None,
+            attrs={"rows": len(pre), "chunk": P},
+        ):
+            self._cache, last = self._prefill_paged()(
+                self._vars, self._cache, jnp.asarray(toks)
+            )
         self.prefill_chunks += 1
         last_host = np.asarray(last)
         for r in pre:
@@ -847,6 +884,10 @@ class DecodePool:
             self._backlog += 1
         self.preemptions += 1
         SERVE_METRICS.preemptions.add(1)
+        FLIGHT.record(
+            "serve.preempt", rows=len(group.rows), order=group.order,
+            emitted=sum(len(r.emitted) for r in group.rows.values()),
+        )
 
     def _run_decode_chunk(self, dec: list) -> None:
         K = self.steps_per_call

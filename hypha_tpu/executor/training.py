@@ -44,6 +44,7 @@ from .. import messages
 from ..messages import (
     CODEC_KEY,
     SHARD_KEY,
+    TRACEPARENT_KEY,
     FragmentTag,
     JobSpec,
     Loss,
@@ -59,6 +60,7 @@ from ..ft.rejoin import CATCHUP_KEY
 from ..stream import SYNC_MODES, effective_fragments, fragment_due, merge_corrected
 from ..stream.partition import partition_names, shard_of
 from ..worker.connectors import shard_route
+from ..telemetry import trace
 from ..telemetry.ft_metrics import HET_METRICS, STREAM_METRICS
 from .diloco import (
     apply_updates,
@@ -145,6 +147,63 @@ def _non_causal_types():
 _STREAM_POLL_WAIT_ENV = "HYPHA_STREAM_POLL_WAIT"
 
 
+class _RoundTrace:
+    """Worker-side round-trace bookkeeping (every method no-ops when
+    tracing is off — call sites never branch on config).
+
+    The scheduler's per-round root context arrives on SCHEDULE_UPDATE /
+    Continue responses (:class:`~hypha_tpu.messages.ProgressResponse.
+    traceparent`); the worker parents its ``inner_steps`` / ``encode`` /
+    ``upload`` / ``merge`` spans under it, stamps it into delta push
+    headers so the parameter server's spans join the same trace, and
+    attaches it to its round-tagged Progress messages.
+    """
+
+    def __init__(self, node: str | None) -> None:
+        self.node = node
+        self.tp: str | None = None  # the round context last handed down
+        self.tp_round = -1
+        self.inner: "trace.TraceSpan | None" = None
+        self.inner_round = -1
+
+    @property
+    def on(self) -> bool:
+        return trace.active() is not None
+
+    def adopt(self, resp, round_num: int) -> None:
+        """Record the context a scheduler response handed down."""
+        tp = getattr(resp, "traceparent", None)
+        if tp:
+            self.tp, self.tp_round = tp, round_num
+
+    def ctx(self, round_num: int) -> str | None:
+        """The context for ``round_num`` (None when off / not yet seen)."""
+        return self.tp if self.tp_round == round_num else None
+
+    def stamp(self, meta: dict, round_num: int) -> dict:
+        """Inject the round context into a push header (no-op when off)."""
+        return trace.inject(meta, self.ctx(round_num))
+
+    def batch(self, round_num: int) -> None:
+        """First batch of a round opens its ``inner_steps`` span."""
+        if not self.on:
+            return
+        if self.inner is None or self.inner_round != round_num:
+            self.close_inner()
+            self.inner = trace.begin(
+                "inner_steps",
+                parent=self.ctx(round_num),
+                attrs={"round": round_num},
+                node=self.node,
+            )
+            self.inner_round = round_num
+
+    def close_inner(self) -> None:
+        if self.inner is not None:
+            trace.finish(self.inner)
+            self.inner = None
+
+
 class _WorkerStream:
     """Worker-side streaming outer sync: at most ONE fragment in flight.
 
@@ -169,12 +228,14 @@ class _WorkerStream:
     """
 
     def __init__(
-        self, session, cfg, work_dir: Path, sync_mode: str, wire_codec: str
+        self, session, cfg, work_dir: Path, sync_mode: str, wire_codec: str,
+        rtrace: "_RoundTrace | None" = None,
     ) -> None:
         self.session = session
         self.cfg = cfg
         self.work_dir = Path(work_dir)
         self.codec = wire_codec
+        self.rtrace = rtrace
         self.F = effective_fragments(
             sync_mode, int(getattr(cfg, "fragments", 0) or 0)
         )
@@ -250,6 +311,13 @@ class _WorkerStream:
             "compute_s": 0.0,
             "bytes": 0,
             "samples": float(num_samples),
+            # Round-trace context at flight launch, carried into the
+            # flight thread's encode/upload spans and push headers.
+            "tp": (
+                self.rtrace.ctx(round_num)
+                if self.rtrace is not None
+                else None
+            ),
         }
         thread = threading.Thread(
             target=self._flight_main,
@@ -267,19 +335,36 @@ class _WorkerStream:
         self, flight: dict, host_delta: dict, tag: FragmentTag, samples: float
     ) -> None:
         box = flight["box"]
+        tnode = self.rtrace.node if self.rtrace is not None else None
         try:
             # host_delta is already wire-flat: {stable_name: np.ndarray}.
-            compress.write_delta(
-                flight["path"],
-                host_delta,
-                self.codec,
-                ef=self.efs[flight["frag"]],
-                tag=tag.header(),
-            )
+            with trace.span(
+                "encode", parent=flight["tp"],
+                attrs={
+                    "round": flight["round"], "fragment": flight["frag"],
+                    "codec": self.codec,
+                },
+                node=tnode,
+            ):
+                compress.write_delta(
+                    flight["path"],
+                    host_delta,
+                    self.codec,
+                    ef=self.efs[flight["frag"]],
+                    tag=tag.header(),
+                )
             nbytes = flight["path"].stat().st_size
             flight["bytes"] = nbytes
             STREAM_METRICS.flight_started(nbytes)
-            self._send_flight(flight, tag, samples)
+            with trace.span(
+                "upload", parent=flight["tp"],
+                attrs={
+                    "round": flight["round"], "fragment": flight["frag"],
+                    "bytes": nbytes,
+                },
+                node=tnode,
+            ):
+                self._send_flight(flight, tag, samples)
             box["completion"] = self._await_broadcast(flight)
         except BaseException as e:  # hypha-lint: disable=swallowed-cancel
             box["error"] = e  # thread-bridge: re-raised at finish()
@@ -296,6 +381,7 @@ class _WorkerStream:
         the fragment's owning shard (via the group reducer with ANY
         failover when tree-reduce is on)."""
         meta: dict[str, Any] = {"num_samples": samples, **tag.header()}
+        trace.inject(meta, flight.get("tp"))
         if self.shard_map is None:
             self.session.send_resource(
                 self.cfg.updates,
@@ -423,6 +509,13 @@ class _WorkerStream:
         for event in box["absorbed"]:
             params, anchor = self._absorb(event, params, anchor)
         event = box["completion"]
+        meta = event.get("meta") or {}
+        merge_span = trace.begin(
+            "merge",
+            parent=meta.get(TRACEPARENT_KEY) or flight.get("tp"),
+            attrs={"round": flight["round"], "fragment": flight["frag"]},
+            node=self.rtrace.node if self.rtrace is not None else None,
+        )
         update_file = self.work_dir / event["path"]
         flat = compress.read_delta(update_file)
         names = flight["names"]
@@ -437,6 +530,7 @@ class _WorkerStream:
         )
         params = replace_leaves(params, new_live)
         anchor = replace_leaves(anchor, new_anchor)
+        trace.finish(merge_span)
         update_file.unlink(missing_ok=True)
         flight["path"].unlink(missing_ok=True)
         STREAM_METRICS.flight_finished(
@@ -607,6 +701,7 @@ def run_training(
     *,
     max_batches: int | None = None,
     should_stop: Callable[[], bool] | None = None,
+    trace_node: str | None = None,
 ) -> TrainResult:
     """Run the DiLoCo inner loop to completion over the given bridge session.
 
@@ -614,6 +709,9 @@ def run_training(
     send_status / receive — hypha_tpu.executor.bridge_client.Session).
     ``max_batches`` is a safety valve for tests. ``should_stop`` is polled
     between batches — the in-process executor's cooperative cancellation.
+    ``trace_node`` labels this worker's round-trace spans (telemetry.trace;
+    the in-process executor passes its peer id, subprocess executors label
+    via $HYPHA_TRACE_NODE) — ignored while tracing is off.
     """
     import jax
     import jax.numpy as jnp
@@ -805,6 +903,8 @@ def run_training(
     result = TrainResult()
     countdown: int | None = None
     round_num = 0
+    # End-to-end round tracing (telemetry.trace): all no-ops when off.
+    rtrace = _RoundTrace(trace_node)
     round_samples = 0
     round_losses: list[float] = []
     # Last PS generation seen on the results stream (ft.durable): a change
@@ -884,7 +984,9 @@ def run_training(
                 f"job {spec.job_id}: streaming sync is not supported for "
                 "multihost replicas"
             )
-        stream_state = _WorkerStream(session, cfg, work_dir, sync_mode, wire_codec)
+        stream_state = _WorkerStream(
+            session, cfg, work_dir, sync_mode, wire_codec, rtrace=rtrace
+        )
         log.info(
             "streaming outer sync: mode=%s fragments=%d", sync_mode,
             stream_state.F,
@@ -981,7 +1083,18 @@ def run_training(
         """Ship Δθ, wait for the PS broadcast, merge. True = next round."""
         nonlocal state, anchor, host_anchor, round_num, round_samples
         nonlocal ps_generation
-        session.send_status(Progress(kind=ProgressKind.UPDATE, job_id=spec.job_id))
+        rtrace.close_inner()
+        round_tp = rtrace.ctx(round_num)
+        session.send_status(
+            Progress(
+                kind=ProgressKind.UPDATE, job_id=spec.job_id,
+                traceparent=round_tp,
+            )
+        )
+        enc_span = trace.begin(
+            "encode", parent=round_tp,
+            attrs={"round": round_num, "codec": wire_codec}, node=rtrace.node,
+        )
         host_params = None
         if mh is not None:
             # Collective Δθ: the allgather every process joins (OP_GATHER),
@@ -1020,6 +1133,15 @@ def run_training(
         compress.write_delta(
             delta_path, wire_flat, wire_codec, ef=delta_ef
         )
+        trace.finish(enc_span)
+        up_span = trace.begin(
+            "upload", parent=round_tp,
+            attrs={
+                "round": round_num, "codec": wire_codec,
+                "bytes": delta_path.stat().st_size,
+            },
+            node=rtrace.node,
+        )
         session.send_resource(
             cfg.updates,
             delta_path.name,
@@ -1029,9 +1151,15 @@ def run_training(
             resource=cfg.updates.ref.resource or "updates",
             # round tags the delta so an elastic parameter server can
             # reject a stale one (arriving after its round aggregated at
-            # quorum) instead of folding it into the wrong mean.
-            meta={"num_samples": float(round_samples), "round": round_num},
+            # quorum) instead of folding it into the wrong mean. Traced
+            # jobs additionally stamp the round context so the parameter
+            # server's spans join the round's trace.
+            meta=rtrace.stamp(
+                {"num_samples": float(round_samples), "round": round_num},
+                round_num,
+            ),
         )
+        trace.finish(up_span)
         mean_loss = float(np.mean(round_losses)) if round_losses else math.nan
         session.send_status(
             Progress(
@@ -1039,6 +1167,7 @@ def run_training(
                 job_id=spec.job_id,
                 round=round_num,
                 metrics={"loss": mean_loss, "samples": float(round_samples)},
+                traceparent=round_tp,
             )
         )
         with session.receive(cfg.results) as events:
@@ -1067,10 +1196,13 @@ def run_training(
                         cfg.updates,
                         delta_path.name,
                         resource=cfg.updates.ref.resource or "updates",
-                        meta={
-                            "num_samples": float(round_samples),
-                            "round": round_num,
-                        },
+                        meta=rtrace.stamp(
+                            {
+                                "num_samples": float(round_samples),
+                                "round": round_num,
+                            },
+                            round_num,
+                        ),
                     )
                 if meta.get(RESYNC_KEY) or meta.get(CATCHUP_KEY):
                     # Resync announcements carry no tensor payload; stray
@@ -1090,6 +1222,13 @@ def run_training(
                     continue
                 break
         apply_codec_hint(meta)
+        merge_span = trace.begin(
+            "merge",
+            # Parent under the broadcast's context when the PS stamped
+            # one (the same round trace), else the scheduler's round.
+            parent=meta.get(TRACEPARENT_KEY) or round_tp,
+            attrs={"round": round_num}, node=rtrace.node,
+        )
         update_file = work_dir / event["path"]
         # read_delta sniffs the format: a quantized (HQD1) broadcast
         # dequantizes to f32, a SafeTensors one loads as before.
@@ -1112,14 +1251,19 @@ def run_training(
             )
         else:
             anchor = snapshot(state.params)
+        trace.finish(merge_span)
         delta_path.unlink(missing_ok=True)
         # The broadcast update is merged — drop it, or a long job accumulates
         # one full-parameter-sized file per round under work_dir/incoming.
         update_file.unlink(missing_ok=True)
         resp = session.send_status(
-            Progress(kind=ProgressKind.UPDATE_RECEIVED, job_id=spec.job_id)
+            Progress(
+                kind=ProgressKind.UPDATE_RECEIVED, job_id=spec.job_id,
+                traceparent=round_tp,
+            )
         )
         round_num += 1
+        rtrace.adopt(resp, round_num)
         result.rounds = round_num
         round_samples = 0
         round_losses.clear()
@@ -1163,7 +1307,10 @@ def run_training(
         meta = {"num_samples": samples, "round": round_num, **tag.header()}
         if len(shard_map.shards) > 1:
             meta[SHARD_KEY] = owner
-        session.send_resource(send, path.name, resource=res_tag, meta=meta)
+        session.send_resource(
+            send, path.name, resource=res_tag,
+            meta=rtrace.stamp(meta, round_num),
+        )
 
     def do_update_sharded() -> bool:
         """Blocking sync against the sharded parameter service: split Δθ
@@ -1173,7 +1320,18 @@ def run_training(
         """
         nonlocal state, anchor, round_num, round_samples
         assert shard_map is not None
-        session.send_status(Progress(kind=ProgressKind.UPDATE, job_id=spec.job_id))
+        rtrace.close_inner()
+        round_tp = rtrace.ctx(round_num)
+        session.send_status(
+            Progress(
+                kind=ProgressKind.UPDATE, job_id=spec.job_id,
+                traceparent=round_tp,
+            )
+        )
+        enc_span = trace.begin(
+            "encode", parent=round_tp,
+            attrs={"round": round_num, "codec": wire_codec}, node=rtrace.node,
+        )
         delta = extract_delta(state.params, anchor)
         host_delta = jax.device_get(delta)
         wire_flat = flatten_tree(host_delta)
@@ -1192,6 +1350,12 @@ def run_training(
             ]
         parts = shard_ctx["parts"]
         samples = float(round_samples)
+        trace.finish(enc_span)
+        up_span = trace.begin(
+            "upload", parent=round_tp,
+            attrs={"round": round_num, "codec": wire_codec, "parts": len(parts)},
+            node=rtrace.node,
+        )
         paths: dict[int, Path] = {}
         for p, names in enumerate(parts):
             tag = FragmentTag(round=round_num, fragment_id=p, fragments=P)
@@ -1202,6 +1366,7 @@ def run_training(
             )
             paths[p] = path
             _push_part(p, path, samples)
+        trace.finish(up_span)
         mean_loss = float(np.mean(round_losses)) if round_losses else math.nan
         session.send_status(
             Progress(
@@ -1209,6 +1374,7 @@ def run_training(
                 job_id=spec.job_id,
                 round=round_num,
                 metrics={"loss": mean_loss, "samples": samples},
+                traceparent=round_tp,
             )
         )
         gens = shard_ctx["gens"]
@@ -1264,6 +1430,10 @@ def run_training(
         # into ONE combined merge/replace pass (P separate passes would
         # re-flatten and rebuild the whole parameter tree per part) —
         # then re-anchor ONCE (blocking semantics: no drift correction).
+        merge_span = trace.begin(
+            "merge", parent=round_tp, attrs={"round": round_num},
+            node=rtrace.node,
+        )
         combined: dict = {}
         for p in sorted(got):
             flat = compress.read_delta(got[p])
@@ -1280,12 +1450,17 @@ def run_training(
         )
         state = state.replace(params=replace_leaves(state.params, new_live))
         anchor = snapshot(state.params)
+        trace.finish(merge_span)
         for path in paths.values():
             path.unlink(missing_ok=True)
         resp = session.send_status(
-            Progress(kind=ProgressKind.UPDATE_RECEIVED, job_id=spec.job_id)
+            Progress(
+                kind=ProgressKind.UPDATE_RECEIVED, job_id=spec.job_id,
+                traceparent=round_tp,
+            )
         )
         round_num += 1
+        rtrace.adopt(resp, round_num)
         result.rounds = round_num
         round_samples = 0
         round_losses.clear()
@@ -1309,7 +1484,14 @@ def run_training(
         """
         nonlocal round_samples
         assert stream_state is not None
-        session.send_status(Progress(kind=ProgressKind.UPDATE, job_id=spec.job_id))
+        rtrace.close_inner()
+        round_tp = rtrace.ctx(round_num)
+        session.send_status(
+            Progress(
+                kind=ProgressKind.UPDATE, job_id=spec.job_id,
+                traceparent=round_tp,
+            )
+        )
         stream_state.begin(round_num, state.params, anchor, round_samples)
         mean_loss = float(np.mean(round_losses)) if round_losses else math.nan
         session.send_status(
@@ -1318,6 +1500,7 @@ def run_training(
                 job_id=spec.job_id,
                 round=round_num,
                 metrics={"loss": mean_loss, "samples": float(round_samples)},
+                traceparent=round_tp,
             )
         )
         round_samples = 0
@@ -1331,9 +1514,13 @@ def run_training(
         state = state.replace(params=new_params)
         anchor = new_anchor
         resp = session.send_status(
-            Progress(kind=ProgressKind.UPDATE_RECEIVED, job_id=spec.job_id)
+            Progress(
+                kind=ProgressKind.UPDATE_RECEIVED, job_id=spec.job_id,
+                traceparent=rtrace.ctx(round_num),
+            )
         )
         round_num += 1
+        rtrace.adopt(resp, round_num)
         result.rounds = round_num
         if ckpt_dir is not None and round_num % ckpt_every == 0:
             save_train_checkpoint(
@@ -1377,6 +1564,7 @@ def run_training(
             if stream_state is not None and stream_state.poll():
                 if not finish_stream_sync():
                     break
+            rtrace.batch(round_num)
             if mh is not None:
                 state, metrics, loss = _with_deadline(
                     lambda b=batch: run_one(b), mh_bound("step"), "train step"
@@ -1404,6 +1592,7 @@ def run_training(
                 break
             if resp.kind == ProgressResponseKind.SCHEDULE_UPDATE:
                 countdown = resp.counter
+                rtrace.adopt(resp, round_num)
             if countdown is not None:
                 if countdown <= 0:
                     countdown = None
@@ -1420,6 +1609,7 @@ def run_training(
                 log.warning("max_batches=%d reached; stopping", max_batches)
                 break
     finally:
+        rtrace.close_inner()
         if stream_state is not None:
             stream_state.abort()
         if mh is not None:

@@ -342,6 +342,15 @@ class DurablePS:
         if meta is not None:
             prev_gen = max(prev_gen, int(meta.get("generation", 0)))
         dur.generation = prev_gen + 1
+        if dur.generation > 1:
+            # Generation bump = a PS process died and restarted: the event
+            # every worker re-send and journal dedup that follows traces
+            # back to. Generation 1 is just a fresh job — not an incident.
+            from ..telemetry.flight import FLIGHT
+
+            FLIGHT.record(
+                "ps.generation_bump", job=job_id, generation=dur.generation,
+            )
         dur.journal.append(
             {"t": "gen", "generation": dur.generation, "job_id": job_id},
             sync=True,

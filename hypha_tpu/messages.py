@@ -77,6 +77,8 @@ __all__ = [
     "PREFOLD_KEY",
     # WAN-adaptive outer rounds (hypha_tpu.ft.adaptive)
     "CODEC_KEY",
+    # end-to-end round tracing (hypha_tpu.telemetry.trace)
+    "TRACEPARENT_KEY",
     # value vocabulary
     "ExecutorDescriptor",
     "WorkerSpec",
@@ -702,6 +704,10 @@ class GenerateRequest:
     temperature: float | None = None  # None = server default
     top_k: int | None = None
     seed: int = 0
+    # End-to-end serve tracing: the request router's ``route`` span context
+    # rides to the serving worker so its prefill/decode spans join the
+    # request's trace. Additive field: None is omitted from the wire.
+    traceparent: str | None = None
 
 
 @register
@@ -965,6 +971,12 @@ class Progress:
     # reported. Additive field: absent on the wire = shard 0, so a
     # single-PS job's control plane is byte-compatible.
     shard: int = 0
+    # End-to-end round tracing (hypha_tpu.telemetry.trace): the sender's
+    # trace context, so a worker's UPDATE/METRICS and the PS's UPDATED all
+    # land in the round's trace. Additive field: None (the only value an
+    # untraced job ships) is omitted from the wire entirely, so tracing
+    # off keeps today's exact bytes.
+    traceparent: str | None = None
 
 
 @_enum
@@ -983,6 +995,13 @@ class ProgressResponse:
     kind: ProgressResponseKind
     counter: int = 0  # inner steps left before the update (SCHEDULE_UPDATE)
     message: str = ""
+    # End-to-end round tracing: the scheduler's per-round root span context
+    # rides SCHEDULE_UPDATE down to workers (and the UPDATED reply hands
+    # the next round's context to the parameter server) — the one response
+    # every peer already receives each round, so propagation needs no new
+    # message. Additive field: None is omitted from the wire, tracing off
+    # ships today's exact bytes.
+    traceparent: str | None = None
 
 
 # --------------------------------------------------------------------------
@@ -1046,6 +1065,16 @@ SHARD_KEY = "shard"
 # Σ samples·Δθ over the reducer's group (its ``num_samples`` carries the
 # summed weight), so the shard folds it verbatim instead of re-weighting.
 PREFOLD_KEY = "prefold"
+
+# Cross-peer trace propagation (hypha_tpu.telemetry.trace): the push /
+# broadcast header key carrying a ``<trace_id>-<parent_span_id>`` context
+# (32 + 16 lowercase hex chars, dash-separated — the W3C traceparent's two
+# live fields). Only traced jobs stamp it: with tracing off (the default)
+# no header carries the key and every registered message omits its
+# ``traceparent`` field, so the wire stays byte-identical to the untraced
+# build (pinned by tests/test_trace.py's bit-equality tests, the same
+# discipline as the adaptive fields above).
+TRACEPARENT_KEY = "traceparent"
 
 # Per-link codec hint (hypha_tpu.ft.adaptive): the parameter server stamps
 # the codec it selected for a peer's LINK — from its measured-bandwidth

@@ -29,6 +29,7 @@ from ..messages import (
     ProgressResponse,
     ProgressResponseKind,
 )
+from ..telemetry import trace
 from .simulation import project
 from .trackers import ProgressTracker, WorkerState
 
@@ -73,6 +74,21 @@ class BatchScheduler:
         # deadline instead of being quorum-dropped. None (the default)
         # keeps the reference projection path bit-exactly.
         self.adaptive = adaptive
+        # End-to-end round tracing (telemetry.trace): the scheduler owns
+        # the per-round ROOT span — opened when a round starts, closed
+        # when it advances — whose context rides SCHEDULE_UPDATE down to
+        # workers and the UPDATED reply over to the parameter server.
+        # With tracing off (_round_span stays None) every response keeps
+        # its traceparent at None, today's exact wire.
+        self._round_span: "trace.TraceSpan | None" = None
+        self._round_span_num = -1
+        if trace.active() is not None:
+            # Open round 0 EAGERLY: construction precedes dispatch, so the
+            # root span's start is a causal lower bound for every peer's
+            # round-0 spans — the anchor the timeline's clock realignment
+            # leans on (a lazy open would start at the first
+            # SCHEDULE_UPDATE, after workers already computed for seconds).
+            self._round_tp()
 
     # ------------------------------------------------------------------
     def on_progress(self, peer: str, progress: Progress) -> ProgressResponse:
@@ -101,6 +117,34 @@ class BatchScheduler:
         return ProgressResponse(
             kind=ProgressResponseKind.ERROR, message=f"unknown progress kind {kind}"
         )
+
+    # ------------------------------------------------------------------
+    def _round_tp(self) -> str | None:
+        """The current round's root-span context (opens it on first use)."""
+        tracing = trace.active()
+        if tracing is None:
+            return None
+        r = self.tracker.round
+        if self._round_span is None or self._round_span_num != r:
+            if self._round_span is not None:
+                tracing.finish(self._round_span)
+                self._round_span = None
+            if r < self.tracker.update_epochs:
+                self._round_span = tracing.begin(
+                    "round", attrs={"round": r}, node="scheduler"
+                )
+            self._round_span_num = r
+        return (
+            self._round_span.traceparent
+            if self._round_span is not None
+            else None
+        )
+
+    def _close_round_span(self) -> None:
+        tracing = trace.active()
+        if tracing is not None and self._round_span is not None:
+            tracing.finish(self._round_span)
+        self._round_span = None
 
     # ------------------------------------------------------------------
     def _due(self, round_num: int) -> set:
@@ -153,10 +197,20 @@ class BatchScheduler:
             # Freeze the next round's per-worker assignments NOW, before
             # any worker's first Status of the round asks for its counter.
             self.adaptive.start_round(self.tracker.round, list(self.tracker.peers))
+        # Rotate the round root span at the boundary (and hand the NEW
+        # round's context back to the parameter server, which has no other
+        # early hook: its next collect opens before any worker reports).
+        tp = self._round_tp()
         # DONE terminates THIS shard's aggregation loop; the workers' own
         # DONE comes with their UpdateReceived once the global round
         # reaches update_epochs.
-        return _DONE if self._shard_done(shard, rnd) else _OK
+        done = self._shard_done(shard, rnd)
+        if tp is None:
+            return _DONE if done else _OK
+        return ProgressResponse(
+            kind=ProgressResponseKind.DONE if done else ProgressResponseKind.OK,
+            traceparent=tp,
+        )
 
     # ------------------------------------------------------------------
     def _on_status(self, peer: str, progress: Progress) -> ProgressResponse:
@@ -181,7 +235,8 @@ class BatchScheduler:
             counter = self.adaptive.counter_for(peer)
             self.tracker.set_state(peer, WorkerState.UPDATE_SCHEDULED)
             return ProgressResponse(
-                kind=ProgressResponseKind.SCHEDULE_UPDATE, counter=counter
+                kind=ProgressResponseKind.SCHEDULE_UPDATE, counter=counter,
+                traceparent=self._round_tp(),
             )
 
         # Simulate all workers still producing batches this round.
@@ -200,7 +255,8 @@ class BatchScheduler:
         counter = projection.updates[sim_peers.index(peer)]
         self.tracker.set_state(peer, WorkerState.UPDATE_SCHEDULED)
         return ProgressResponse(
-            kind=ProgressResponseKind.SCHEDULE_UPDATE, counter=counter
+            kind=ProgressResponseKind.SCHEDULE_UPDATE, counter=counter,
+            traceparent=self._round_tp(),
         )
 
     # ------------------------------------------------------------------
@@ -213,6 +269,7 @@ class BatchScheduler:
             self.tracker.set_state(peer, WorkerState.DONE)
             if self.tracker.all_in(WorkerState.DONE) and not self.completed:
                 self.completed = True
+                self._close_round_span()
                 if self._on_complete is not None:
                     self._on_complete()
             return _DONE
@@ -220,4 +277,11 @@ class BatchScheduler:
         self.tracker.set_state(peer, WorkerState.TRAINING)
         i = self.tracker.index_of(peer)
         self.tracker.last_update[i] = self.tracker._clock()
-        return _CONTINUE
+        tp = self._round_tp()
+        if tp is None:
+            return _CONTINUE
+        # Traced jobs: hand the worker the NEW round's context with the
+        # Continue that starts it, so its inner_steps span parents right.
+        return ProgressResponse(
+            kind=ProgressResponseKind.CONTINUE, traceparent=tp
+        )

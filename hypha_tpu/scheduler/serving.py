@@ -65,7 +65,8 @@ from ..messages import (
 )
 from ..network.node import Node, RequestError
 from ..resources import Resources
-from ..telemetry import SERVE_METRICS
+from ..telemetry import SERVE_METRICS, instrument_node, global_telemetry
+from ..telemetry import trace
 from ..worker.infer_executor import serve_key
 from .allocator import GreedyWorkerAllocator
 from .task import StatusRouter, Task
@@ -164,6 +165,12 @@ class ServingSupervisor:
 
     async def run(self) -> None:
         """Supervise until :meth:`stop`; returns after teardown."""
+        # Router-fabric bandwidth gauges on the process-global registry —
+        # supervisors embedded in tests/benches bypass cli.py's wiring.
+        instrument_node(
+            global_telemetry().meter(f"hypha.node.{self.node.peer_id}"),
+            self.node,
+        )
         eject_task: asyncio.Task | None = None
         if self.route:
             self._regs.append(
@@ -246,6 +253,11 @@ class ServingSupervisor:
                         await self._teardown(dep)
                         self._deployments[dep.slot] = None
         finally:
+            # Mirror of the gauge registration above — the registry must
+            # not keep a closure over a torn-down supervisor's node.
+            global_telemetry().meter(
+                f"hypha.node.{self.node.peer_id}"
+            ).remove_gauges()
             await aio.reap(eject_task)
             for dep in self._deployments:
                 if dep is not None:
@@ -308,25 +320,43 @@ class ServingSupervisor:
                 )
         busy_hint = 0.0
         last: Exception | None = None
-        for dep in backends:
-            fwd = dataclasses.replace(req, serve_name=dep.backend_name)
-            dep.inflight += 1
-            try:
-                resp = await self.node.request(
-                    dep.handle.peer_id,
-                    PROTOCOL_GENERATE,
-                    fwd,
-                    timeout=self._request_timeout,
+        # Serve-path tracing (telemetry.trace, no-op when off): the router
+        # opens the request's ``route`` span and hands its context to the
+        # worker so prefill/decode spans join the request's trace.
+        route_span = trace.begin(
+            "route",
+            parent=getattr(req, "traceparent", None),
+            attrs={"serve_name": req.serve_name, "prompts": len(req.prompts)},
+        )
+        try:
+            for dep in backends:
+                fwd = dataclasses.replace(
+                    req,
+                    serve_name=dep.backend_name,
+                    traceparent=trace.traceparent_of(route_span)
+                    or req.traceparent,
                 )
-            except RequestError as e:
-                last = e
-                continue
-            finally:
-                dep.inflight -= 1
-            if getattr(resp, "ok", True):
-                SERVE_METRICS.routed_requests.add(1)
-                return resp
-            busy_hint = max(busy_hint, resp.retry_after_ms)
+                if route_span is not None:
+                    route_span.set_attribute("backend", dep.handle.peer_id)
+                dep.inflight += 1
+                try:
+                    resp = await self.node.request(
+                        dep.handle.peer_id,
+                        PROTOCOL_GENERATE,
+                        fwd,
+                        timeout=self._request_timeout,
+                    )
+                except RequestError as e:
+                    last = e
+                    continue
+                finally:
+                    dep.inflight -= 1
+                if getattr(resp, "ok", True):
+                    SERVE_METRICS.routed_requests.add(1)
+                    return resp
+                busy_hint = max(busy_hint, resp.retry_after_ms)
+        finally:
+            trace.finish(route_span)
         if busy_hint > 0.0:
             return GenerateResponse(
                 tokens=[], ok=False, retry_after_ms=busy_hint

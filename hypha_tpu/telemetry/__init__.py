@@ -44,6 +44,8 @@ __all__ = [
     "LogBridge",
     "init_telemetry",
     "instrument_node",
+    "global_telemetry",
+    "metrics_snapshot",
     "parse_attributes",
     "OtlpJsonExporter",
 ]
@@ -60,7 +62,10 @@ _current_span: contextvars.ContextVar["Span | None"] = contextvars.ContextVar(
 
 
 def _rand_id(nbytes: int) -> str:
-    return "".join(f"{random.getrandbits(8):02x}" for _ in range(nbytes))
+    # os.urandom, NOT the global random module: deterministic chaos runs
+    # (ft/chaos.py) seed the global RNG, which would make trace/span ids
+    # deterministic — and collide across nodes in one merged timeline.
+    return os.urandom(nbytes).hex()
 
 
 @dataclass
@@ -265,6 +270,13 @@ class Meter:
     def observable_gauge(self, name: str, callback: Callable[[], float], unit: str = "") -> None:
         self._telemetry._gauges[(self.scope, name)] = (callback, unit)
 
+    def remove_gauges(self) -> None:
+        """Drop every observable gauge under this meter's scope — called at
+        node teardown so the registry (and its callback closures over the
+        node) does not outlive the fabric it instruments."""
+        for key in [k for k in self._telemetry._gauges if k[0] == self.scope]:
+            del self._telemetry._gauges[key]
+
 
 class Telemetry:
     """Provider bundle: tracers, meters, the export loop, shutdown.
@@ -452,11 +464,61 @@ def instrument_node(meter: Meter, node) -> None:
     )
 
 
+# Process-global provider: components that create fabrics WITHOUT going
+# through a cli.py entrypoint (worker runtimes hosting PS shards, serving
+# workers, bench harnesses) register their bandwidth gauges here, so one
+# snapshot sees every fabric in the process. No exporter: recording only —
+# init_telemetry stays the export-wired path for real deployments.
+_GLOBAL: "Telemetry | None" = None
+_GLOBAL_LOCK = threading.Lock()
+
+
+def global_telemetry() -> Telemetry:
+    global _GLOBAL
+    with _GLOBAL_LOCK:
+        if _GLOBAL is None:
+            _GLOBAL = Telemetry(service_name="hypha", exporter=None)
+        return _GLOBAL
+
+
+def metrics_snapshot() -> dict:
+    """One JSON-safe snapshot of every process metrics surface: the FT /
+    stream / shard / serve / heterogeneity bundles plus the global
+    registry's observable gauges (per-node bandwidth among them). This is
+    what ``bench.py`` dumps next to every ``*BENCH_*.json`` artifact so
+    future benches get metrics without bespoke plumbing."""
+    gauges: dict[str, float] = {}
+    telemetry = global_telemetry()
+    for (scope, name), (cb, _unit) in sorted(telemetry._gauges.items()):
+        try:
+            gauges[f"{scope}/{name}"] = float(cb())
+        except Exception:  # a torn-down node's gauge must not kill the dump
+            continue
+    return {
+        "ft": FT_METRICS.snapshot(),
+        "stream": STREAM_METRICS.snapshot(),
+        "shard": SHARD_METRICS.snapshot(),
+        "serve": SERVE_METRICS.snapshot(),
+        "het": HET_METRICS.snapshot(),
+        "gauges": gauges,
+        "aio_task_failures": _aio_task_failures(),
+    }
+
+
+def _aio_task_failures() -> float:
+    from ..aio import TASK_FAILURES  # lazy: aio imports this package
+
+    return TASK_FAILURES.value()
+
+
 # Fault-tolerance instruments (import at the bottom: ft_metrics uses the
 # Counter/Histogram classes defined above).
 from .ft_metrics import (  # noqa: E402
     FT_METRICS,
+    HET_METRICS,
     SERVE_METRICS,
+    SHARD_METRICS,
+    STREAM_METRICS,
     FTMetrics,
     ServeMetrics,
 )

@@ -1,0 +1,146 @@
+"""Flight recorder: a per-node bounded ring of structured events.
+
+Crash forensics for the control plane. The durable journal
+(``ft/durable.py``) records *what* folded; the flight recorder records
+*when* and *why late*: chaos actions, quorum drops, fabric retries,
+serving preemptions, PS generation bumps — every discrete event that
+explains a stalled round after the fact. Events carry BOTH a monotonic
+timestamp (skew-free per-node ordering and durations) and a wall anchor
+(cross-node merge by the timeline tool), plus the same attribute
+vocabulary the round spans use (round / peer / fragment / shard / codec).
+
+Recording is always on: appending a dict to a bounded deque costs
+nanoseconds, so instrumentation sites never branch on config. Spilling is
+what gets configured — :meth:`FlightRecorder.configure` names the node and
+an optional spill directory, and the ring is written to
+``events-<node>.jsonl`` there on process exit (``atexit``, which also runs
+on an unhandled-exception death) and on demand via :meth:`spill`.
+``python -m hypha_tpu.telemetry.timeline`` merges these files with the
+span files into one critical-path timeline.
+"""
+
+from __future__ import annotations
+
+import atexit
+import json
+import re
+import threading
+import time
+from collections import deque
+from pathlib import Path
+from typing import Any
+
+__all__ = ["FlightRecorder", "FLIGHT", "DEFAULT_CAPACITY"]
+
+DEFAULT_CAPACITY = 4096
+
+_SAFE_NODE = re.compile(r"[^A-Za-z0-9._-]")
+
+
+def _clean(value: Any) -> Any:
+    """JSON-safe attribute values; containers shallow, everything else str."""
+    if isinstance(value, (str, int, float, bool)) or value is None:
+        return value
+    if isinstance(value, (list, tuple, set, frozenset)):
+        return [_clean(v) for v in value]
+    if isinstance(value, dict):
+        return {str(k): _clean(v) for k, v in value.items()}
+    return str(value)
+
+
+class FlightRecorder:
+    """Bounded in-memory event ring with per-node JSONL spill."""
+
+    def __init__(
+        self, capacity: int = DEFAULT_CAPACITY, node: str = "node"
+    ) -> None:
+        self._lock = threading.Lock()
+        self._ring: deque[dict] = deque(maxlen=max(int(capacity), 1))
+        self.node = str(node)
+        self.spill_dir: Path | None = None
+        self._atexit_registered = False
+
+    # ------------------------------------------------------------ config
+    def configure(
+        self, node: str | None = None, spill_dir: str | Path | None = None
+    ) -> None:
+        """Name this process's events and/or arm the exit spill."""
+        with self._lock:
+            if node:
+                self.node = str(node)
+            if spill_dir is not None:
+                self.spill_dir = Path(spill_dir)
+                if not self._atexit_registered:
+                    self._atexit_registered = True
+                    atexit.register(self._spill_quiet)
+
+    def disarm(self) -> None:
+        """Forget the spill directory: later (untraced) work in the same
+        process must not have its exit events appended into an earlier
+        run's trace directory. The atexit hook stays registered but
+        no-ops while disarmed."""
+        with self._lock:
+            self.spill_dir = None
+
+    # --------------------------------------------------------- recording
+    def record(self, event: str, node: str | None = None, **attrs: Any) -> None:
+        """Append one event. ``node`` overrides the process default —
+        the in-process bench harness labels each component's events."""
+        rec: dict[str, Any] = {
+            "t_mono_ns": time.monotonic_ns(),
+            "t_wall_ns": time.time_ns(),
+            "event": str(event),
+            "node": str(node) if node else self.node,
+        }
+        if attrs:
+            rec["attrs"] = {str(k): _clean(v) for k, v in attrs.items()}
+        with self._lock:
+            self._ring.append(rec)
+
+    def snapshot(self) -> list[dict]:
+        with self._lock:
+            return list(self._ring)
+
+    def clear(self) -> None:
+        with self._lock:
+            self._ring.clear()
+
+    # ------------------------------------------------------------- spill
+    def spill(self, spill_dir: str | Path | None = None) -> list[Path]:
+        """DRAIN the ring to ``events-<node>.jsonl`` files (one per node
+        label seen), appending; returns the paths written. Draining makes
+        spill idempotent across the on-demand + atexit pair — the exit
+        hook writes only what arrived since the last explicit spill,
+        never a duplicate of it."""
+        target = Path(spill_dir) if spill_dir is not None else self.spill_dir
+        if target is None:
+            return []
+        with self._lock:
+            events = list(self._ring)
+            self._ring.clear()
+        if not events:
+            return []
+        target.mkdir(parents=True, exist_ok=True)
+        by_node: dict[str, list[dict]] = {}
+        for rec in events:
+            by_node.setdefault(rec.get("node") or "node", []).append(rec)
+        written: list[Path] = []
+        for node, recs in sorted(by_node.items()):
+            safe = _SAFE_NODE.sub("-", node) or "node"
+            path = target / f"events-{safe}.jsonl"
+            with open(path, "a", encoding="utf-8") as f:
+                for rec in recs:
+                    f.write(json.dumps(rec, default=str) + "\n")
+            written.append(path)
+        return written
+
+    def _spill_quiet(self) -> None:
+        try:
+            self.spill()
+        except Exception:  # an exit hook must never mask the real exit
+            pass
+
+
+# The process ring every subsystem records into (chaos, retries, quorum
+# drops, preemptions, generation bumps).
+FLIGHT = FlightRecorder()

@@ -385,6 +385,13 @@ class HetMetrics:
         if not dropped:
             return
         self.quorum_drops.add(len(dropped))
+        # Flight-recorder breadcrumb: the drop is the symptom a stalled
+        # round's forensics start from — which peers, which round, when.
+        from .flight import FLIGHT
+
+        FLIGHT.record(
+            "ft.quorum_drop", round=int(round_num), peers=dropped,
+        )
         with self._lock:
             self._drops_by_round[int(round_num)] = self._drops_by_round.get(
                 int(round_num), 0

@@ -76,8 +76,14 @@ class RequestBatcher:
         temperature: float,
         top_k: int | None,
         seed: int,
+        traceparent: str | None = None,
     ) -> list:
-        """Queue ``prompts`` and await their continuations."""
+        """Queue ``prompts`` and await their continuations.
+
+        ``traceparent`` is accepted for API parity with the pool server
+        and deliberately unused: a coalesced window decode serves SEVERAL
+        requests' prompts in one dispatch, so no single request's trace
+        could own its span."""
         if self._closed:
             raise RuntimeError("batcher is closed")
         self.requests += 1

@@ -96,6 +96,7 @@ class PoolServer:
         temperature: float,
         top_k: int | None,
         seed: int,
+        traceparent: str | None = None,
     ) -> list:
         if self._closed:
             raise RuntimeError("server is closed")
@@ -103,7 +104,10 @@ class PoolServer:
         if temperature == 0.0 and self.pool.fits(prompts, n_new):
             try:
                 return await asyncio.wrap_future(
-                    self.pool.submit([list(p) for p in prompts], n_new)
+                    self.pool.submit(
+                        [list(p) for p in prompts], n_new,
+                        traceparent=traceparent,
+                    )
                 )
             except PoolBusy:
                 # Backpressure surfaces to the RPC layer (ok=False +

@@ -31,6 +31,7 @@ from ..messages import (
     ServeLoad,
 )
 from ..network.node import Node, RequestError
+from ..telemetry import trace
 from .batcher import RequestBatcher
 from .job_manager import Execution, JobExecutor
 
@@ -82,26 +83,35 @@ class InProcessInferExecutor(JobExecutor):
             )
             top_k = cfg.top_k if req.top_k is None else req.top_k
             batcher = loaded.get("batcher")
-            if batcher is None:  # batch_window_ms < 0: independent decodes
-                tokens = await asyncio.to_thread(
-                    self._generate_grouped,
-                    loaded["model"], loaded["params"],
-                    req.prompts, n_new, temperature, top_k, req.seed,
-                )
-            else:
-                try:
-                    tokens = await batcher.submit(
-                        req.prompts, n_new, temperature, top_k, req.seed
+            # Serve-path tracing: child of the router's ``route`` span
+            # (req.traceparent; None — and a no-op — when untraced).
+            with trace.span(
+                "serve",
+                parent=getattr(req, "traceparent", None),
+                attrs={"serve_name": req.serve_name, "prompts": len(req.prompts)},
+                node=self.node.peer_id,
+            ) as serve_span:
+                if batcher is None:  # batch_window_ms < 0: independent decodes
+                    tokens = await asyncio.to_thread(
+                        self._generate_grouped,
+                        loaded["model"], loaded["params"],
+                        req.prompts, n_new, temperature, top_k, req.seed,
                     )
-                except PoolBusy as busy:
-                    # Backpressure is a RESPONSE, not an error: the client
-                    # (or router) retries after the hint instead of
-                    # queueing unboundedly server-side.
-                    return GenerateResponse(
-                        tokens=[],
-                        ok=False,
-                        retry_after_ms=busy.retry_after_s * 1e3,
-                    )
+                else:
+                    try:
+                        tokens = await batcher.submit(
+                            req.prompts, n_new, temperature, top_k, req.seed,
+                            traceparent=trace.traceparent_of(serve_span),
+                        )
+                    except PoolBusy as busy:
+                        # Backpressure is a RESPONSE, not an error: the
+                        # client (or router) retries after the hint instead
+                        # of queueing unboundedly server-side.
+                        return GenerateResponse(
+                            tokens=[],
+                            ok=False,
+                            retry_after_ms=busy.retry_after_s * 1e3,
+                        )
             return GenerateResponse(tokens=tokens)
 
         registration: dict = {}

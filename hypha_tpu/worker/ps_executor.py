@@ -74,6 +74,7 @@ from ..messages import (
     PREFOLD_KEY,
     PROTOCOL_PROGRESS,
     SHARD_KEY,
+    TRACEPARENT_KEY,
     Ack,
     FragmentTag,
     JobSpec,
@@ -93,6 +94,8 @@ from ..stream import (
     shard_owns_round,
 )
 from ..stream.accum import RoundAccum
+from ..telemetry import trace
+from ..telemetry.flight import FLIGHT
 from ..telemetry.ft_metrics import (
     FT_METRICS,
     HET_METRICS,
@@ -148,6 +151,53 @@ _RoundAccum = RoundAccum
 _PREFOLD_PREFIX = "prefold:"
 
 
+class _PsTrace:
+    """Round-trace context on the parameter server (no-op when off).
+
+    The scheduler hands the NEXT round's root context back on every
+    Updated reply — the only message the PS exchanges with the scheduler
+    per round — so quorum_wait / outer_step / broadcast spans parent
+    under the round root from round 1 on (round 0 opens before any reply
+    exists and stays unparented; per-delta upload/fold spans always
+    parent on the context stamped in their own push header).
+    """
+
+    def __init__(self, node: str) -> None:
+        self.node = node
+        # Bounded per-round contexts: round r's broadcast still needs its
+        # context AFTER the Updated reply handed over round r+1's, and the
+        # pipelined stream loop keeps several rounds in flight at once.
+        self._by_round: dict[int, str] = {}
+
+    def ctx(self, round_num: int) -> str | None:
+        return self._by_round.get(round_num)
+
+    def adopt(self, response, round_num: int) -> None:
+        tp = getattr(response, "traceparent", None)
+        # Skip a context already filed under an earlier round: on a
+        # sharded job the scheduler only advances once EVERY due shard
+        # reported, so a non-final shard's Updated reply hands back the
+        # CURRENT round's root — filing it under round_num would parent
+        # the next round's spans into the previous round's trace.
+        if tp and tp not in self._by_round.values():
+            self._by_round[round_num] = tp
+            while len(self._by_round) > 16:
+                self._by_round.pop(min(self._by_round))
+
+    @staticmethod
+    def push_ctx(push) -> str | None:
+        """The context a delta push's header carries (None untraced)."""
+        r = push.resource
+        return r.get(TRACEPARENT_KEY) if isinstance(r, dict) else None
+
+    def adopt_push(self, push, round_num: int) -> None:
+        """First delta of a round also carries the round's context — the
+        PS's only source for round 0 (no Updated reply exists yet)."""
+        tp = self.push_ctx(push)
+        if tp and round_num not in self._by_round:
+            self._by_round[round_num] = tp
+
+
 class _ElasticState:
     """Per-job elastic-membership state on the parameter server.
 
@@ -199,6 +249,11 @@ class ParameterServerExecutor(JobExecutor):
     def __init__(self, node: Node, work_root: Path | str = "/tmp") -> None:
         self.node = node
         self.work_root = Path(work_root)
+
+    def _trace_node(self) -> str:
+        """Span/event node label; tests construct executors without a
+        node, and tracing must never be the thing that crashes them."""
+        return getattr(self.node, "peer_id", None) or "ps"
 
     async def execute(
         self, job_id: str, spec: JobSpec, scheduler_peer: str
@@ -308,6 +363,9 @@ class ParameterServerExecutor(JobExecutor):
             )
 
         consumer = self.node.consume_pushes(wants)
+        # End-to-end round tracing (telemetry.trace): every method below
+        # no-ops while tracing is off, and no header gains a key.
+        ptrace = _PsTrace(self._trace_node())
         membership_reg = None
         if elastic is not None:
             # The scheduler's membership snapshots arrive over /hypha-ft;
@@ -433,10 +491,15 @@ class ParameterServerExecutor(JobExecutor):
                 arrivals: dict[str, float] | None = (
                     {} if adaptive_steps else None
                 )
+                qw_span = trace.begin(
+                    "quorum_wait", parent=ptrace.ctx(round_num),
+                    attrs={"round": round_num}, node=ptrace.node,
+                )
                 if elastic is not None:
                     received = await self._collect_round_elastic(
                         consumer, job_id, elastic, cfg, work_dir, round_num,
                         accum=accum, dur=dur, link=link, arrivals=arrivals,
+                        ptrace=ptrace,
                     )
                 else:
                     received = await self._collect_round(
@@ -444,17 +507,26 @@ class ParameterServerExecutor(JobExecutor):
                         round_num, accum=accum, dur=dur,
                         preloaded=preload.pop(round_num, None),
                         preloaded_folded=preloaded_folded,
-                        link=link, arrivals=arrivals,
+                        link=link, arrivals=arrivals, ptrace=ptrace,
                     )
+                # Round 0's root context only arrives inside the first
+                # delta's header — late-bind the wait span to it.
+                trace.reparent(qw_span, ptrace.ctx(round_num))
+                trace.finish(qw_span)
                 if dur is not None:
                     await asyncio.to_thread(
                         dur.note_close, round_num, list(received)
                     )
+                outer_span = trace.begin(
+                    "outer_step", parent=ptrace.ctx(round_num),
+                    attrs={"round": round_num}, node=ptrace.node,
+                )
                 update_path = await asyncio.to_thread(
                     self._outer_step,
                     received, momentum_file, lr, mu, work_dir, round_num,
                     accum,
                 )
+                trace.finish(outer_span)
                 if link is not None:
                     # Per-link codec selection: peers grouped by their
                     # LINK's codec, each with its own error-feedback
@@ -471,11 +543,14 @@ class ParameterServerExecutor(JobExecutor):
                             elastic.catchup.accumulate, update_path
                         )
                     response = await self._notify_updated(
-                        scheduler_peer, job_id, round_num, arrivals=arrivals
+                        scheduler_peer, job_id, round_num, arrivals=arrivals,
+                        traceparent=ptrace.ctx(round_num),
                     )
+                    ptrace.adopt(response, round_num + 1)
                     await self._broadcast_adaptive(
                         cfg, update_path, round_num, elastic, link,
                         peer_efs, work_dir,
+                        traceparent=ptrace.ctx(round_num),
                     )
                     for path, _ in received.values():
                         path.unlink(missing_ok=True)
@@ -537,8 +612,10 @@ class ParameterServerExecutor(JobExecutor):
                 # starts a phantom extra round (the reference broadcasts
                 # first, parameter_server.rs:232-283, and carries this race).
                 response = await self._notify_updated(
-                    scheduler_peer, job_id, round_num, arrivals=arrivals
+                    scheduler_peer, job_id, round_num, arrivals=arrivals,
+                    traceparent=ptrace.ctx(round_num),
                 )
+                ptrace.adopt(response, round_num + 1)
                 if dur is not None:
                     await asyncio.to_thread(
                         dur.note_notified, round_num,
@@ -550,6 +627,8 @@ class ParameterServerExecutor(JobExecutor):
                         {GENERATION_KEY: dur.generation}
                         if dur is not None else None
                     ),
+                    traceparent=ptrace.ctx(round_num),
+                    span_round=round_num,
                 )
                 if dur is None:
                     # Durable runs keep the delta files — the journal
@@ -687,6 +766,11 @@ class ParameterServerExecutor(JobExecutor):
                 wire_path.unlink(missing_ok=True)
             round_num = rnd + 1
         FT_METRICS.ps_recoveries.add(1)
+        FLIGHT.record(
+            "ps.recovered", node=self._trace_node(), job=job_id,
+            generation=dur.generation, round=round_num,
+            replayed=len(resume.committed),
+        )
         log.warning(
             "ps %s: recovered durable state (generation %d): resuming round "
             "%d (%d committed rounds replayed)",
@@ -910,6 +994,9 @@ class ParameterServerExecutor(JobExecutor):
         entry: tuple[Path, float],
         sign: float = 1.0,
         prefolded: bool = False,
+        span_attrs: dict | None = None,
+        parent: str | None = None,
+        trace_node: str | None = None,
     ) -> None:
         """Fold one saved delta into the round's partial sum, off-loop.
 
@@ -917,12 +1004,21 @@ class ParameterServerExecutor(JobExecutor):
         aggregation that leaves only the Nesterov step at quorum close.
         ``accum`` is None when a caller (tests) only wants collection.
         ``prefolded`` marks a tree-reduce partial: already Σ samples·Δθ,
-        added verbatim (scaled only by ``sign``).
+        added verbatim (scaled only by ``sign``). ``span_attrs`` opens a
+        round-trace ``fold`` span around the work (accept-path folds
+        only; un-folds and replays stay spanless).
         """
-        if accum is not None:
-            await asyncio.to_thread(
-                accum.fold, entry[0], entry[1], sign, prefolded
-            )
+        if accum is None:
+            return
+        fold_span = (
+            trace.begin("fold", parent=parent, attrs=span_attrs, node=trace_node)
+            if span_attrs is not None and sign > 0
+            else None
+        )
+        await asyncio.to_thread(
+            accum.fold, entry[0], entry[1], sign, prefolded
+        )
+        trace.finish(fold_span)
 
     async def _collect_round(
         self,
@@ -938,6 +1034,7 @@ class ParameterServerExecutor(JobExecutor):
         preloaded_folded: bool = False,
         link: "LinkTable | None" = None,
         arrivals: "dict[str, float] | None" = None,
+        ptrace: "_PsTrace | None" = None,
     ) -> dict[str, tuple[Path, float]]:
         """Gather one pseudo-gradient per worker: peer -> (path, samples).
 
@@ -1015,12 +1112,15 @@ class ParameterServerExecutor(JobExecutor):
             # accepted file by name, so a re-send must never overwrite the
             # bytes a journaled fold points at.
             hasher = hashlib.sha256() if dur is not None else None
+            if ptrace is not None:
+                ptrace.adopt_push(push, round_num)
             entry = await self._save_delta(
                 push, dest_dir, round_num,
                 name_suffix=(
                     f"-{uuid.uuid4().hex[:8]}" if dur is not None else ""
                 ),
                 hasher=hasher, name_key=key, link=link,
+                trace_node=ptrace.node if ptrace is not None else None,
             )
             if arrivals is not None:
                 lag = asyncio.get_running_loop().time() - t_open
@@ -1055,7 +1155,12 @@ class ParameterServerExecutor(JobExecutor):
                 )
             received[key] = entry
             covers[key] = (prefolded, cov)
-            await self._fold(accum, entry, prefolded=prefolded)
+            await self._fold(
+                accum, entry, prefolded=prefolded,
+                span_attrs={"round": round_num, "peer": peer},
+                parent=_PsTrace.push_ctx(push),
+                trace_node=ptrace.node if ptrace is not None else None,
+            )
             log.info(
                 "ps %s: round %d delta %d/%d (from %s)",
                 job_id, round_num, len(received), num_workers, peer,
@@ -1074,6 +1179,7 @@ class ParameterServerExecutor(JobExecutor):
         dur: "DurablePS | None" = None,
         link: "LinkTable | None" = None,
         arrivals: "dict[str, float] | None" = None,
+        ptrace: "_PsTrace | None" = None,
     ) -> dict[str, tuple[Path, float]]:
         """Quorum + deadline gather: peer -> (path, samples).
 
@@ -1180,6 +1286,8 @@ class ParameterServerExecutor(JobExecutor):
             )
             if delta_round is None:
                 continue
+            if ptrace is not None:
+                ptrace.adopt_push(push, delta_round)
             meta = push.resource if isinstance(push.resource, dict) else {}
             prefolded, cov = self._push_cover(meta, peer)
             key = self._entry_key(prefolded, peer)
@@ -1299,7 +1407,12 @@ class ParameterServerExecutor(JobExecutor):
                 )
             received[key] = entry
             covers[key] = (prefolded, cov)
-            await self._fold(accum, entry, prefolded=prefolded)
+            await self._fold(
+                accum, entry, prefolded=prefolded,
+                span_attrs={"round": round_num, "peer": peer},
+                parent=_PsTrace.push_ctx(push),
+                trace_node=ptrace.node if ptrace is not None else None,
+            )
             log.info(
                 "ps %s: round %d delta %d (quorum %d, active %d) from %s",
                 job_id, round_num, len(received), st.quorum(),
@@ -1410,12 +1523,18 @@ class ParameterServerExecutor(JobExecutor):
             return next_owned_round(sync_mode, r, fragments, num_shards, shard)
 
         round_num = next_owned(round_start)
+        ptrace = _PsTrace(self._trace_node())
         try:
             while True:
                 if dur is not None:
                     await asyncio.to_thread(dur.note_open, round_num)
                 arrivals: dict[str, float] | None = (
                     {} if adaptive_steps else None
+                )
+                qw_span = trace.begin(
+                    "quorum_wait", parent=ptrace.ctx(round_num),
+                    attrs={"round": round_num, "fragment": due_fn(round_num)},
+                    node=ptrace.node,
                 )
                 received = await self._collect_round_stream(
                     consumer, job_id, cfg, elastic, allowed, num_workers,
@@ -1429,7 +1548,10 @@ class ParameterServerExecutor(JobExecutor):
                         if sharded and sync_mode == "stream"
                         else None
                     ),
+                    ptrace=ptrace,
                 )
+                trace.reparent(qw_span, ptrace.ctx(round_num))
+                trace.finish(qw_span)
                 if dur is not None:
                     await asyncio.to_thread(
                         dur.note_close, round_num, list(received)
@@ -1439,11 +1561,17 @@ class ParameterServerExecutor(JobExecutor):
                     round=round_num, fragment_id=frag, fragments=fragments
                 )
                 accum = accums.pop(round_num, None)
+                outer_span = trace.begin(
+                    "outer_step", parent=ptrace.ctx(round_num),
+                    attrs={"round": round_num, "fragment": frag},
+                    node=ptrace.node,
+                )
                 update_path = await asyncio.to_thread(
                     self._outer_step,
                     received, momentum_file, lr, mu, work_dir, round_num,
                     accum,
                 )
+                trace.finish(outer_span)
                 if frag not in bcast_efs:
                     bcast_efs[frag] = (
                         compress.ErrorFeedback() if quant else None
@@ -1494,7 +1622,9 @@ class ParameterServerExecutor(JobExecutor):
                 response = await self._notify_updated(
                     scheduler_peer, job_id, round_num, shard=shard,
                     arrivals=arrivals,
+                    traceparent=ptrace.ctx(round_num),
                 )
+                ptrace.adopt(response, next_owned(round_num + 1))
                 if dur is not None:
                     await asyncio.to_thread(
                         dur.note_notified, round_num,
@@ -1530,6 +1660,7 @@ class ParameterServerExecutor(JobExecutor):
                         # Durable runs keep the delta files — the journal
                         # references them until a checkpoint covers them.
                         keep_received=dur is not None,
+                        traceparent=ptrace.ctx(round_num),
                     ),
                     tasks=bcast_tasks,
                     what=f"stream broadcast r{round_num}",
@@ -1577,6 +1708,7 @@ class ParameterServerExecutor(JobExecutor):
         owned_fn=None,
         sharded: bool = False,
         arrivals: "dict[str, float] | None" = None,
+        ptrace: "_PsTrace | None" = None,
     ) -> dict[str, tuple[Path, float]]:
         """Gather one round's FRAGMENT deltas: peer -> (path, samples).
 
@@ -1658,6 +1790,8 @@ class ParameterServerExecutor(JobExecutor):
             )
             if delta_round is None:
                 continue
+            if ptrace is not None:
+                ptrace.adopt_push(push, delta_round)
             if owned_fn is not None and not owned_fn(delta_round):
                 # Mis-routed: this round's due fragment belongs to another
                 # shard — parking it here would leak it forever (this shard
@@ -1780,7 +1914,15 @@ class ParameterServerExecutor(JobExecutor):
                         arrivals.setdefault(str(member), lag)
                 else:
                     arrivals[peer] = lag
-            await self._fold(accum, entry, prefolded=prefolded)
+            await self._fold(
+                accum, entry, prefolded=prefolded,
+                span_attrs={
+                    "round": delta_round, "peer": peer,
+                    "fragment": due_fn(delta_round),
+                },
+                parent=_PsTrace.push_ctx(push),
+                trace_node=ptrace.node if ptrace is not None else None,
+            )
             log.info(
                 "ps %s: round %d fragment %d delta %d (from %s%s)",
                 job_id, round_num, frag,
@@ -1831,6 +1973,7 @@ class ParameterServerExecutor(JobExecutor):
         peers: list[str] | None = None,
         header: dict | None = None,
         keep_received: bool = False,
+        traceparent: str | None = None,
     ) -> None:
         """One round's backgrounded fan-out plus its file retirement.
 
@@ -1850,6 +1993,8 @@ class ParameterServerExecutor(JobExecutor):
                 cfg, wire_path, round_num, elastic,
                 extra_header=header if header is not None else tag.header(),
                 peers_override=peers,
+                traceparent=traceparent,
+                span_round=round_num,
             )
         finally:
             if not keep_received:
@@ -1878,10 +2023,12 @@ class ParameterServerExecutor(JobExecutor):
         it and the stale guard (or the next round's collect) disposes of
         the copy. Returns None when abandoned.
         """
+        trace_node = self._trace_node()
         if deadline is None:
             return await self._save_delta(
                 push, dest_dir, delta_round, name_suffix=suffix,
                 hasher=hasher, name_key=key, link=link,
+                trace_node=trace_node,
             )
         loop = asyncio.get_running_loop()
         budget = max(deadline - loop.time(), 0.0) + _DRAIN_SLACK_S
@@ -1890,6 +2037,7 @@ class ParameterServerExecutor(JobExecutor):
                 self._save_delta(
                     push, dest_dir, delta_round, name_suffix=suffix,
                     hasher=hasher, name_key=key, link=link,
+                    trace_node=trace_node,
                 ),
                 timeout=budget,
             )
@@ -1898,6 +2046,10 @@ class ParameterServerExecutor(JobExecutor):
                 "ps %s: delta drain from %s for round %d abandoned "
                 "after %.1fs (deadline passed mid-transfer)",
                 job_id, push.peer, delta_round, budget,
+            )
+            FLIGHT.record(
+                "ps.drain_abandoned", node=trace_node, peer=push.peer,
+                round=delta_round, budget_s=round(budget, 3), job=job_id,
             )
             push.finish()
             name = hashlib.sha256(
@@ -1926,6 +2078,7 @@ class ParameterServerExecutor(JobExecutor):
         push, work_dir: Path, round_num: int, name_suffix: str = "",
         hasher=None, name_key: "str | None" = None,
         link: "LinkTable | None" = None,
+        trace_node: "str | None" = None,
     ) -> tuple[Path, float]:
         """Save one pseudo-gradient push; returns (path, sample weight).
 
@@ -1944,8 +2097,26 @@ class ParameterServerExecutor(JobExecutor):
         """
         name = hashlib.sha256((name_key or push.peer).encode()).hexdigest()[:24]
         dest = work_dir / f"delta-{round_num}-{name}{name_suffix}.safetensors"
+        # The receiver-side ``upload`` span: header arrival → payload
+        # drained, i.e. the sender's LINK — the span the timeline's
+        # straggler attribution keys on (the sender names itself in
+        # ``peer``, its header carries the round's trace context).
+        up_span = trace.begin(
+            "upload",
+            parent=_PsTrace.push_ctx(push),
+            attrs={"round": round_num, "peer": push.peer},
+            node=trace_node,
+        )
         t0 = time.monotonic() if link is not None else 0.0
         nbytes = await push.save_to(dest, hasher=hasher)
+        if up_span is not None:
+            try:
+                up_span.set_attribute(
+                    "bytes", int(nbytes) if nbytes else dest.stat().st_size
+                )
+            except (TypeError, ValueError, OSError):
+                pass
+        trace.finish(up_span)
         if link is not None:
             try:
                 size = int(nbytes) if nbytes else dest.stat().st_size
@@ -2005,6 +2176,10 @@ class ParameterServerExecutor(JobExecutor):
                     del st.pending_joins[peer]
                 continue
             del st.pending_joins[peer]
+            FLIGHT.record(
+                "ft.catchup_served", node=self._trace_node(), peer=peer,
+                round=round_num, rounds=st.catchup.rounds,
+            )
             log.info(
                 "ps: served catch-up (%d rounds, next %d) to rejoiner %s",
                 st.catchup.rounds, round_num, peer,
@@ -2104,6 +2279,7 @@ class ParameterServerExecutor(JobExecutor):
         link: "LinkTable",
         peer_efs: dict,
         work_dir: Path,
+        traceparent: str | None = None,
     ) -> None:
         """Per-LINK broadcast: peers grouped by the codec the measured-
         bandwidth table picked for their link, one wire per GROUP.
@@ -2177,6 +2353,8 @@ class ParameterServerExecutor(JobExecutor):
                     cfg, wire, round_num, elastic,
                     extra_header={CODEC_KEY: codec},
                     peers_override=group,
+                    traceparent=traceparent,
+                    span_round=round_num,
                 ),
                 name=f"ps-abcast-{codec}",
             )
@@ -2197,6 +2375,8 @@ class ParameterServerExecutor(JobExecutor):
         elastic: "_ElasticState | None" = None,
         extra_header: dict | None = None,
         peers_override: list[str] | None = None,
+        traceparent: str | None = None,
+        span_round: int | None = None,
     ) -> None:
         """Push the update tensor to every worker in parallel (:232-269 —
         the reference pushes one peer at a time and the slowest link gates
@@ -2209,7 +2389,13 @@ class ParameterServerExecutor(JobExecutor):
         Elastic mode broadcasts to the current membership's active set
         (rejoiners included, departed peers skipped) and stamps the
         membership epoch into the header so every worker knows which view
-        of the round produced this update."""
+        of the round produced this update.
+
+        ``traceparent`` (round-update broadcasts on traced jobs only)
+        stamps the round's trace context into the push header and, with
+        ``span_round``, wraps the fan-out in a ``broadcast`` span —
+        resync/catch-up/re-broadcast callers pass neither and keep their
+        exact header bytes."""
         peers = cfg.results.ref.peers or []
         strategy = cfg.results.ref.strategy or TransferStrategy.ALL
         header = {
@@ -2219,6 +2405,7 @@ class ParameterServerExecutor(JobExecutor):
         }
         if extra_header:
             header.update(extra_header)
+        trace.inject(header, traceparent)
         if elastic is not None:
             peers = list(elastic.membership.active)
             header["epoch"] = elastic.membership.epoch
@@ -2229,6 +2416,15 @@ class ParameterServerExecutor(JobExecutor):
             peers = peers_override
         if not peers:
             return
+        bcast_span = (
+            trace.begin(
+                "broadcast", parent=traceparent,
+                attrs={"round": span_round, "peers": len(peers)},
+                node=self._trace_node(),
+            )
+            if span_round is not None
+            else None
+        )
         sem = asyncio.Semaphore(_BROADCAST_CONCURRENCY)
 
         async def push_one(peer: str) -> bool:
@@ -2256,32 +2452,36 @@ class ParameterServerExecutor(JobExecutor):
             asyncio.create_task(push_one(p), name=f"ps-bcast-{p}")
             for p in peers
         ]
-        if strategy == TransferStrategy.ANY:
-            try:
-                for fut in asyncio.as_completed(tasks):
-                    if await fut:
-                        break
-            finally:
-                # First success (or caller cancellation): the losers of the
-                # race are cancelled and awaited, never abandoned.
-                await aio.reap(*(t for t in tasks if not t.done()))
-        else:
-            try:
-                await asyncio.gather(*tasks)
-            finally:
-                # push_one only absorbs RequestError; a raw transport error
-                # (ConnectionResetError out of a severed stream) escapes
-                # the gather — the siblings must not be left streaming a
-                # file the job teardown is about to rmtree.
-                await aio.reap(*(t for t in tasks if not t.done()))
+        try:
+            if strategy == TransferStrategy.ANY:
+                try:
+                    for fut in asyncio.as_completed(tasks):
+                        if await fut:
+                            break
+                finally:
+                    # First success (or caller cancellation): the losers of
+                    # the race are cancelled and awaited, never abandoned.
+                    await aio.reap(*(t for t in tasks if not t.done()))
+            else:
+                try:
+                    await asyncio.gather(*tasks)
+                finally:
+                    # push_one only absorbs RequestError; a raw transport
+                    # error (ConnectionResetError out of a severed stream)
+                    # escapes the gather — the siblings must not be left
+                    # streaming a file the job teardown is about to rmtree.
+                    await aio.reap(*(t for t in tasks if not t.done()))
+        finally:
+            trace.finish(bcast_span)
 
     async def _notify_updated(
         self, scheduler_peer: str, job_id: str, round_num: int, shard: int = 0,
         arrivals: "dict[str, float] | None" = None,
+        traceparent: str | None = None,
     ) -> ProgressResponse:
         progress = Progress(
             kind=ProgressKind.UPDATED, job_id=job_id, round=round_num,
-            shard=shard,
+            shard=shard, traceparent=traceparent,
         )
         if arrivals is not None:
             # Straggler-adaptive inner steps (ft.adaptive): per-peer

@@ -112,6 +112,15 @@ class WorkerNode:
 
     async def start(self, listen: list[str] | None = None) -> None:
         await self.node.start(listen)
+        # Bandwidth gauges on the process-global registry: worker fabrics
+        # hosting PS shards and serving executors never pass through a
+        # cli.py entrypoint in tests/benches, yet their inbound/outbound
+        # byte counters are exactly what shard/serve benches read.
+        from ..telemetry import global_telemetry, instrument_node
+
+        instrument_node(
+            global_telemetry().meter(f"hypha.node.{self.peer_id}"), self.node
+        )
         self._health = serve_health(self.node, lambda: self._ready)
         await self.node.wait_for_bootstrap()
         await self.arbiter.start()
@@ -120,6 +129,13 @@ class WorkerNode:
 
     async def stop(self) -> None:
         self._ready = False
+        from ..telemetry import global_telemetry
+
+        # Mirror of start()'s gauge registration: a long pytest/bench
+        # process starts hundreds of workers, and leaked gauge closures
+        # would pin every dead Node (and report its frozen byte counters
+        # as a live fabric) for process lifetime.
+        global_telemetry().meter(f"hypha.node.{self.peer_id}").remove_gauges()
         if self._health is not None:
             self._health.close()
         await self.arbiter.stop()

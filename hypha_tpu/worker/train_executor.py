@@ -123,6 +123,10 @@ class InProcessTrainExecutor(JobExecutor):
                     spec,
                     max_batches=self.max_batches,
                     should_stop=stop_flag.is_set,
+                    # Round-trace spans carry this worker's peer id, so an
+                    # in-process pool's merged timeline can tell w0's
+                    # upload from w1's (telemetry.trace; no-op untraced).
+                    trace_node=self.node.peer_id,
                 )
 
         try:

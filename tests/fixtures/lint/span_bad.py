@@ -1,0 +1,39 @@
+"""Seeded span-not-scoped violations + clean twins (_is_fine)."""
+
+from hypha_tpu.telemetry import trace
+
+
+def leaks_bare_call(tracer):
+    tracer.span("op")  # VIOLATION: result discarded, span never ends
+    return 1
+
+
+def leaks_assigned(tracer):
+    cm = tracer.span("op", {"k": 1})  # VIOLATION: deferred entry leaks on error
+    with cm:
+        return 2
+
+
+def leaks_module_helper():
+    trace.span("op")  # VIOLATION: module helper leaks the same way
+    return 3
+
+
+def with_block_is_fine(tracer):
+    with tracer.span("op") as s:
+        return s
+
+
+def module_helper_with_is_fine():
+    with trace.span("op"):
+        return 4
+
+
+def begin_finish_is_fine():
+    s = trace.begin("op")
+    trace.finish(s)
+    return s
+
+
+def unrelated_span_attr_is_fine(tokenizer):
+    return tokenizer.span("not tracing")

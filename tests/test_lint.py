@@ -47,7 +47,15 @@ def test_async_fixture_catches_each_rule():
     assert counts["lock-held-await"] == 1
 
 
-@pytest.mark.parametrize("fixture", ["async_bad.py", "jax_bad.py"])
+def test_span_fixture_catches_rule():
+    counts = _rules_by_count(FIXTURES / "span_bad.py")
+    # bare call, assigned-then-entered, module helper — with-blocks,
+    # begin/finish pairs and non-tracing .span receivers stay quiet.
+    assert counts["span-not-scoped"] == 3
+    assert set(counts) == {"span-not-scoped"}
+
+
+@pytest.mark.parametrize("fixture", ["async_bad.py", "jax_bad.py", "span_bad.py"])
 def test_fixture_clean_twins_stay_clean(fixture):
     """No violation may land inside a function whose name ends _is_fine."""
     path = FIXTURES / fixture

@@ -59,16 +59,26 @@ def test_pool_mid_decode_admission(tiny_llama):
             assert time.time() - t0 < deadline
             time.sleep(0.01)
         short_fut = pool.submit([[4, 5]], 4)
+        # Capture the pool's chunk counter AT THE MOMENT the short request
+        # resolves (the callback runs in the serve thread, synchronously
+        # with set_result). Checking long_fut.done() from THIS thread
+        # instead is a GIL race on a 1-core box: the serve thread can run
+        # the long decode to completion before the waiter is scheduled,
+        # failing the assert even though admission overlapped perfectly.
+        chunks_at_short_done: list[int] = []
+        short_fut.add_done_callback(
+            lambda _f: chunks_at_short_done.append(pool.chunks)
+        )
         short = short_fut.result(timeout=300)
         assert len(short[0]) == 4
-        # the short request must finish while the long one still runs
-        assert not long_fut.done(), "short request waited for the long decode"
+        # the short request must finish while the long one still runs:
+        # when it resolved, the long decode (16 chunks) had chunks left.
+        assert chunks_at_short_done and chunks_at_short_done[0] < 16, (
+            "short request waited for the long decode: resolved at chunk "
+            f"{chunks_at_short_done}"
+        )
         long_ = long_fut.result(timeout=300)
         assert len(long_[0]) == 64
-        # scheduling evidence: admitted chunks after the long one started,
-        # finished chunks before it ended
-        groups = [short_fut, long_fut]
-        del groups
     finally:
         pool.close()
 

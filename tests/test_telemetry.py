@@ -104,6 +104,57 @@ def test_instrument_node_bandwidth_gauges():
     t.shutdown()
 
 
+def test_worker_fabrics_register_bandwidth_gauges():
+    """PS-shard and serving-worker fabrics run inside WorkerNodes that
+    never pass through a cli.py entrypoint — WorkerNode.start must wire
+    their bandwidth gauges onto the process-global registry so one
+    metrics snapshot sees every fabric (ISSUE 10 satellite)."""
+    from hypha_tpu.network import MemoryTransport
+    from hypha_tpu.resources import Resources
+    from hypha_tpu.telemetry import metrics_snapshot
+    from hypha_tpu.worker.runtime import WorkerNode
+
+    async def main():
+        hub = MemoryTransport()
+        worker = WorkerNode(
+            hub.shared(),
+            resources=Resources(cpu=1, memory=10),
+            peer_id="gauge-worker",
+        )
+        await worker.start()
+        try:
+            return metrics_snapshot()
+        finally:
+            await worker.stop()
+
+    snap = asyncio.run(asyncio.wait_for(main(), 30))
+    gauges = snap["gauges"]
+    scope = "hypha.node.gauge-worker"
+    assert f"{scope}/hypha.bandwidth.inbound.bytes" in gauges
+    assert f"{scope}/hypha.bandwidth.outbound.bytes" in gauges
+    # The snapshot is the bench dump format: JSON-clean, bundles included.
+    json.dumps(snap)
+    for key in ("ft", "stream", "shard", "serve", "het"):
+        assert key in snap
+
+
+def test_rand_id_not_seeded_by_global_rng():
+    """ft/chaos.py seeds the global random module for deterministic runs;
+    trace/span ids must come from os.urandom or two nodes replaying the
+    same seed would collide in one merged timeline (ISSUE 10 satellite)."""
+    import random
+
+    from hypha_tpu.telemetry import _rand_id
+
+    random.seed(42)
+    first = _rand_id(16)
+    random.seed(42)
+    second = _rand_id(16)
+    assert first != second
+    assert len(first) == 32
+    int(first, 16)  # lowercase hex
+
+
 def test_parse_attributes():
     assert parse_attributes("service.name=x, env=prod") == {
         "service.name": "x",

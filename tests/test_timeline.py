@@ -1,0 +1,195 @@
+"""Timeline merger tests: clock realignment on round anchors, torn-tail
+tolerance (the durable journal's rule applied to trace files), the
+critical-path breakdown, and the OTLP JSON golden shape for exported
+spans."""
+
+from __future__ import annotations
+
+import json
+
+from hypha_tpu.telemetry import timeline
+
+
+def _span(
+    node: str,
+    name: str,
+    start_s: float,
+    dur_s: float,
+    *,
+    rnd: int | None = None,
+    peer: str | None = None,
+    trace_id: str = "ab" * 16,
+    parent: str | None = None,
+) -> dict:
+    attrs: dict = {}
+    if rnd is not None:
+        attrs["round"] = rnd
+    if peer is not None:
+        attrs["peer"] = peer
+    start_ns = int(start_s * 1e9)
+    end_ns = int((start_s + dur_s) * 1e9)
+    return {
+        "node": node,
+        "name": name,
+        "trace_id": trace_id,
+        "span_id": "cd" * 8,
+        "parent_id": parent,
+        "start_ns": start_ns,
+        "end_ns": end_ns,
+        "mono_start_ns": start_ns,
+        "mono_end_ns": end_ns,
+        "ok": True,
+        "attrs": attrs,
+    }
+
+
+def _write_spans(tmp_path, node: str, spans: list[dict]) -> None:
+    path = tmp_path / f"spans-{node}.jsonl"
+    path.write_text("".join(json.dumps(s) + "\n" for s in spans))
+
+
+def _skewed_trace(tmp_path, skews: dict[str, float]) -> None:
+    """Scheduler + 2 workers + PS over 3 rounds; each node's wall clock is
+    shifted by its skew (monotonic stamps shift along — one process per
+    node)."""
+    t0 = 1000.0
+    sched = []
+    per_node: dict[str, list[dict]] = {n: [] for n in skews}
+    for r in range(3):
+        rs = t0 + r * 10.0
+        sched.append(_span("scheduler", "round", rs, 10.0, rnd=r))
+        for w, lag in (("w0", 0.05), ("w1", 0.10)):
+            s = skews[w]
+            per_node[w].append(
+                _span(w, "inner_steps", rs + lag + s, 4.0, rnd=r)
+            )
+            per_node[w].append(
+                _span(w, "encode", rs + lag + 4.0 + s, 0.5, rnd=r)
+            )
+            per_node[w].append(
+                _span(w, "upload", rs + lag + 4.5 + s, 0.3, rnd=r)
+            )
+            per_node[w].append(
+                _span(w, "merge", rs + 8.0 + s, 0.2, rnd=r)
+            )
+        ps = skews["psw"]
+        per_node["psw"].append(
+            _span("psw", "quorum_wait", rs + 0.02 + ps, 5.5, rnd=r)
+        )
+        per_node["psw"].append(
+            _span(
+                "psw", "upload", rs + 4.6 + ps, 0.9, rnd=r, peer="w1"
+            )
+        )
+        per_node["psw"].append(
+            _span(
+                "psw", "upload", rs + 4.6 + ps, 0.2, rnd=r, peer="w0"
+            )
+        )
+        per_node["psw"].append(
+            _span("psw", "outer_step", rs + 5.6 + ps, 0.4, rnd=r)
+        )
+        per_node["psw"].append(
+            _span("psw", "broadcast", rs + 6.0 + ps, 1.5, rnd=r)
+        )
+    _write_spans(tmp_path, "scheduler", sched)
+    for node, spans in per_node.items():
+        _write_spans(tmp_path, node, spans)
+
+
+def test_skewed_clocks_realigned_via_round_anchors(tmp_path):
+    """±5 s per-node skew recovered to within the genuine scheduling lag."""
+    skews = {"w0": +5.0, "w1": -5.0, "psw": +3.3}
+    _skewed_trace(tmp_path, skews)
+    tl = timeline.build_timeline(tmp_path)
+    assert tl["reference_node"] == "scheduler"
+    offs = tl["clock_offsets_s"]
+    assert offs["scheduler"] == 0.0
+    # The recovered offset cancels the skew up to the smallest per-round
+    # lag the node genuinely had (≤ 0.1 s in this trace).
+    for node, skew in skews.items():
+        assert abs(offs[node] + skew) < 0.25, (node, offs[node], skew)
+
+
+def test_critical_path_names_straggler_and_phases(tmp_path):
+    _skewed_trace(tmp_path, {"w0": 0.0, "w1": 0.0, "psw": 0.0})
+    tl = timeline.build_timeline(tmp_path)
+    assert len(tl["rounds"]) == 3
+    row = tl["rounds"][0]
+    assert row["wall_s"] == 10.0
+    # Phase maxima from the node's own clocks.
+    assert abs(row["phases_s"]["compute"] - 4.0) < 1e-6
+    assert abs(row["phases_s"]["quorum_wait"] - 5.5) < 1e-6
+    assert abs(row["phases_s"]["upload"] - 0.9) < 1e-6
+    # Straggler = peer of the slowest upload; stall excludes containers.
+    assert row["straggler"] == "w1"
+    assert row["stall_span"] == "inner_steps"  # 4.0 s compute dominates
+    # Dominant phase is the wait (it contains the uploads) — the stall
+    # field is the per-peer attribution.
+    assert row["dominant"] == "quorum_wait"
+
+
+def test_torn_tail_reads_as_clean_eof(tmp_path):
+    spans = [
+        _span("w0", "inner_steps", 10.0, 1.0, rnd=0),
+        _span("w0", "encode", 11.0, 0.5, rnd=0),
+    ]
+    path = tmp_path / "spans-w0.jsonl"
+    body = "".join(json.dumps(s) + "\n" for s in spans)
+    # A crash tore the third record mid-write.
+    path.write_text(body + '{"node": "w0", "name": "upl')
+    got = timeline.load_jsonl(path)
+    assert [s["name"] for s in got] == ["inner_steps", "encode"]
+
+    # Same rule for event files, exercised through load_dir.
+    (tmp_path / "events-w0.jsonl").write_text(
+        json.dumps({"event": "retry", "node": "w0", "t_wall_ns": 1}) + "\n"
+        + '{"event": "chao'
+    )
+    loaded_spans, events = timeline.load_dir(tmp_path)
+    assert len(loaded_spans) == 2
+    assert [e["event"] for e in events] == ["retry"]
+
+
+def test_empty_and_missing_files(tmp_path):
+    assert timeline.load_jsonl(tmp_path / "nope.jsonl") == []
+    (tmp_path / "spans-x.jsonl").write_text("")
+    tl = timeline.build_timeline(tmp_path)
+    assert tl["rounds"] == [] and tl["num_spans"] == 0
+
+
+def test_otlp_export_golden_shape(tmp_path):
+    """Merged spans → OTLP/JSON resourceSpans any OTEL collector ingests."""
+    spans = [
+        _span("w0", "upload", 10.0, 0.5, rnd=2, peer="w0", parent="ef" * 8),
+        _span("psw", "outer_step", 11.0, 0.1, rnd=2),
+    ]
+    payload = timeline.to_otlp(spans, {"service.name": "hypha-test"})
+    json.dumps(payload)  # JSON-clean end to end
+    (rs,) = payload["resourceSpans"]
+    res_attrs = {a["key"]: a["value"] for a in rs["resource"]["attributes"]}
+    assert res_attrs["service.name"] == {"stringValue": "hypha-test"}
+    scopes = {ss["scope"]["name"]: ss["spans"] for ss in rs["scopeSpans"]}
+    assert set(scopes) == {"hypha.node.w0", "hypha.node.psw"}
+    (up,) = scopes["hypha.node.w0"]
+    assert up["name"] == "upload"
+    assert len(up["traceId"]) == 32 and len(up["spanId"]) == 16
+    assert up["parentSpanId"] == "ef" * 8
+    assert up["startTimeUnixNano"] == str(int(10.0 * 1e9))
+    assert up["endTimeUnixNano"] == str(int(10.5 * 1e9))
+    attrs = {a["key"]: a["value"] for a in up["attributes"]}
+    assert attrs["round"] == {"intValue": "2"}
+    assert attrs["peer"] == {"stringValue": "w0"}
+    assert up["status"] == {"code": 1}
+    (outer,) = scopes["hypha.node.psw"]
+    assert "parentSpanId" not in outer  # parentless root omits the key
+
+
+def test_timeline_cli_writes_json(tmp_path, capsys):
+    _skewed_trace(tmp_path, {"w0": 0.0, "w1": 0.0, "psw": 0.0})
+    rc = timeline.main([str(tmp_path)])
+    assert rc == 0
+    out = json.loads((tmp_path / "timeline.json").read_text())
+    assert len(out["rounds"]) == 3
+    text = capsys.readouterr().out
+    assert "stall:" in text and "round" in text

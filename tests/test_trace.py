@@ -1,0 +1,308 @@
+"""End-to-end round tracing tests: the wire-bit-equality guarantee
+(tracing off ships today's exact bytes), the traceparent format, the
+per-node span recorder, the flight recorder ring, and the scheduler's
+per-round root-span propagation."""
+
+from __future__ import annotations
+
+import json
+import random
+
+import pytest
+
+from hypha_tpu import codec, messages
+from hypha_tpu.messages import (
+    TRACEPARENT_KEY,
+    GenerateRequest,
+    Progress,
+    ProgressKind,
+    ProgressResponse,
+    ProgressResponseKind,
+)
+from hypha_tpu.scheduler.batch_scheduler import BatchScheduler
+from hypha_tpu.scheduler.trackers import ProgressTracker
+from hypha_tpu.telemetry import trace
+from hypha_tpu.telemetry.flight import FlightRecorder
+
+
+@pytest.fixture
+def tracing_off():
+    """Guarantee tracing is globally OFF and reset state afterwards."""
+    trace._reset_for_tests()
+    trace.disable()
+    yield
+    trace._reset_for_tests()
+
+
+@pytest.fixture
+def tracing_on(tmp_path):
+    trace._reset_for_tests()
+    t = trace.enable(tmp_path, node="testnode")
+    yield t
+    trace._reset_for_tests()
+
+
+# -------------------------------------------------- wire-bit equality
+
+
+def test_progress_off_wire_bytes_are_pre_tracing_exact(tracing_off):
+    """The traceparent field is omitted entirely at None: byte-for-byte the
+    pre-tracing wire (the PR-8 additive-field discipline)."""
+    p = Progress(kind=ProgressKind.UPDATED, job_id="job-1", round=3)
+    golden = codec.dumps(
+        {
+            "_t": "Progress",
+            "kind": {"_e": "ProgressKind", "v": "updated"},
+            "job_id": "job-1",
+            "batch_size": 0,
+            "round": 3,
+            "metrics": {},
+            "shard": 0,
+        }
+    )
+    assert messages.encode(p) == golden
+    assert "traceparent" not in messages.to_json_dict(p)
+
+
+def test_progress_response_off_wire_bytes_exact(tracing_off):
+    r = ProgressResponse(
+        kind=ProgressResponseKind.SCHEDULE_UPDATE, counter=7
+    )
+    golden = codec.dumps(
+        {
+            "_t": "ProgressResponse",
+            "kind": {"_e": "ProgressResponseKind", "v": "schedule-update"},
+            "counter": 7,
+            "message": "",
+        }
+    )
+    assert messages.encode(r) == golden
+
+
+def test_generate_request_off_wire_bytes_exact(tracing_off):
+    req = GenerateRequest(serve_name="llm", prompts=[[1, 2]], seed=4)
+    golden = codec.dumps(
+        {
+            "_t": "GenerateRequest",
+            "serve_name": "llm",
+            "prompts": [[1, 2]],
+            "max_new_tokens": 64,
+            "seed": 4,
+        }
+    )
+    assert messages.encode(req) == golden
+
+
+def test_push_header_gains_no_key_when_off(tracing_off):
+    header = {"round": 2, "num_samples": 8.0}
+    before = codec.dumps(header)
+    out = trace.inject(header, None)
+    assert out is header
+    assert codec.dumps(out) == before
+    assert TRACEPARENT_KEY not in out
+
+
+def test_traceparent_round_trips_when_set():
+    tp = "ab" * 16 + "-" + "cd" * 8
+    p = Progress(kind=ProgressKind.UPDATE, job_id="j", traceparent=tp)
+    back = messages.decode(messages.encode(p))
+    assert back.traceparent == tp
+    header = trace.inject({"round": 1}, tp)
+    assert header[TRACEPARENT_KEY] == tp
+
+
+# ----------------------------------------------------- traceparent fmt
+
+
+def test_parse_traceparent():
+    tp = "ab" * 16 + "-" + "cd" * 8
+    assert trace.parse_traceparent(tp) == ("ab" * 16, "cd" * 8)
+    for bad in (None, 7, "", "xx", "ab-cd", "g" * 32 + "-" + "cd" * 8,
+                "ab" * 16 + "cd" * 8, "ab" * 16 + "-" + "cd" * 7):
+        assert trace.parse_traceparent(bad) is None
+
+
+def test_ids_use_urandom_not_seeded_global_rng():
+    """Seeded deterministic chaos runs seed the GLOBAL rng; trace/span ids
+    must not become deterministic (they would collide across nodes in one
+    merged timeline). Regression for telemetry._rand_id too."""
+    from hypha_tpu.telemetry import _rand_id
+
+    random.seed(1234)
+    a = (_rand_id(16), trace._rand_hex(16))
+    random.seed(1234)
+    b = (_rand_id(16), trace._rand_hex(16))
+    assert a[0] != b[0] and a[1] != b[1]
+    assert len(a[0]) == 32 and len(a[1]) == 32
+
+
+# ----------------------------------------------------- span recorder
+
+
+def test_node_tracing_writes_per_node_jsonl(tmp_path, tracing_on):
+    t = tracing_on
+    root = t.begin("round", attrs={"round": 0}, node="scheduler")
+    child = t.begin("upload", parent=root.traceparent, attrs={"peer": "w0"})
+    assert child.trace_id == root.trace_id
+    assert child.parent_id == root.span_id
+    t.finish(child)
+    t.finish(root)
+    with t.span("merge", parent=root, attrs={"round": 0}) as s:
+        assert s.trace_id == root.trace_id
+    sched = [
+        json.loads(line)
+        for line in (tmp_path / "spans-scheduler.jsonl").read_text().splitlines()
+    ]
+    local = [
+        json.loads(line)
+        for line in (tmp_path / "spans-testnode.jsonl").read_text().splitlines()
+    ]
+    assert [s["name"] for s in sched] == ["round"]
+    assert [s["name"] for s in local] == ["upload", "merge"]
+    up = local[0]
+    assert up["trace_id"] == root.trace_id
+    assert up["end_ns"] >= up["start_ns"]
+    assert up["attrs"] == {"peer": "w0"}
+
+
+def test_module_helpers_noop_when_off(tracing_off):
+    assert trace.active() is None
+    assert trace.begin("x") is None
+    trace.finish(None)  # must not raise
+    with trace.span("y") as s:
+        assert s is None
+    assert trace.traceparent_of(None) is None
+
+
+def test_env_enables_tracing(tmp_path, monkeypatch):
+    trace._reset_for_tests()
+    monkeypatch.setenv("HYPHA_TRACE_DIR", str(tmp_path))
+    monkeypatch.setenv("HYPHA_TRACE_NODE", "envnode")
+    try:
+        t = trace.active()
+        assert t is not None and t.node == "envnode"
+    finally:
+        trace._reset_for_tests()
+
+
+def test_reparent_binds_only_parentless_spans(tracing_on):
+    t = tracing_on
+    orphan = t.begin("quorum_wait")
+    tp = "ab" * 16 + "-" + "cd" * 8
+    trace.reparent(orphan, tp)
+    assert orphan.trace_id == "ab" * 16 and orphan.parent_id == "cd" * 8
+    child = t.begin("fold", parent=orphan)
+    trace.reparent(child, "ef" * 16 + "-" + "12" * 8)  # keeps its parent
+    assert child.parent_id == orphan.span_id
+
+
+# --------------------------------------------------- flight recorder
+
+
+def test_flight_recorder_ring_and_spill(tmp_path):
+    fr = FlightRecorder(capacity=4, node="psw")
+    for i in range(7):
+        fr.record("retry", attempt=i)
+    events = fr.snapshot()
+    assert len(events) == 4  # bounded ring keeps the newest
+    assert [e["attrs"]["attempt"] for e in events] == [3, 4, 5, 6]
+    fr.record("chaos.kill", node="w1", target="w1")
+    paths = fr.spill(tmp_path)
+    assert sorted(p.name for p in paths) == [
+        "events-psw.jsonl", "events-w1.jsonl",
+    ]
+    w1 = [
+        json.loads(line)
+        for line in (tmp_path / "events-w1.jsonl").read_text().splitlines()
+    ]
+    assert w1[0]["event"] == "chaos.kill"
+    assert "t_mono_ns" in w1[0] and "t_wall_ns" in w1[0]
+    # Spill DRAINS: a second spill (the atexit hook) writes no duplicates.
+    assert fr.snapshot() == []
+    assert fr.spill(tmp_path) == []
+    # No spill dir configured and none passed: no-op.
+    assert FlightRecorder().spill() == []
+
+
+def test_flight_recorder_sanitizes_attrs(tmp_path):
+    fr = FlightRecorder(node="n")
+    fr.record("x", peers={"w1", "w0"}, err=ValueError("boom"))
+    (rec,) = fr.snapshot()
+    json.dumps(rec)  # JSON-clean
+    assert sorted(rec["attrs"]["peers"]) == ["w0", "w1"]
+    assert rec["attrs"]["err"] == "boom"
+
+
+# ------------------------------------- scheduler round-span propagation
+
+
+def _drive_round(bs, now):
+    from hypha_tpu.messages import Progress as P
+
+    def status(peer, t_ms):
+        now[0] = t_ms / 1000.0
+        return bs.on_progress(
+            peer, P(kind=ProgressKind.STATUS, batch_size=10)
+        )
+
+    return status
+
+
+def test_scheduler_hands_down_round_context_when_on(tmp_path, tracing_on):
+    now = [0.0]
+    tracker = ProgressTracker(
+        "ps", update_target=60, update_epochs=2, clock=lambda: now[0]
+    )
+    tracker.add_worker("w0", 10)
+    tracker.add_worker("w1", 10)
+    bs = BatchScheduler(tracker)
+    status = _drive_round(bs, now)
+    status("w0", 100)
+    scheduled = []
+    for t_ms in range(200, 1200, 100):
+        for w in ("w0", "w1"):
+            r = status(w, t_ms)
+            if r.kind is ProgressResponseKind.SCHEDULE_UPDATE:
+                scheduled.append(r)
+        if len(scheduled) >= 2:
+            break
+    assert scheduled, "no SCHEDULE_UPDATE produced"
+    tp0 = scheduled[0].traceparent
+    assert trace.parse_traceparent(tp0) is not None
+    assert all(s.traceparent == tp0 for s in scheduled)
+    for w in ("w0", "w1"):
+        bs.on_progress(w, Progress(kind=ProgressKind.UPDATE))
+    r = bs.on_progress("ps", Progress(kind=ProgressKind.UPDATED, round=0))
+    # The Updated reply hands the PS the NEXT round's context.
+    tp1 = r.traceparent
+    assert tp1 is not None and tp1 != tp0
+    # Workers' Continue also carries round 1's context.
+    r = bs.on_progress("w0", Progress(kind=ProgressKind.UPDATE_RECEIVED))
+    assert r.kind is ProgressResponseKind.CONTINUE
+    assert r.traceparent == tp1
+    # Round 0's root span was written at rotation, attributed round=0.
+    spans = [
+        json.loads(line)
+        for line in (tmp_path / "spans-scheduler.jsonl").read_text().splitlines()
+    ]
+    assert [(s["name"], s["attrs"]["round"]) for s in spans] == [("round", 0)]
+    assert f"{spans[0]['trace_id']}-{spans[0]['span_id']}" == tp0
+
+
+def test_scheduler_responses_untouched_when_off(tracing_off):
+    now = [0.0]
+    tracker = ProgressTracker(
+        "ps", update_target=60, update_epochs=1, clock=lambda: now[0]
+    )
+    tracker.add_worker("w0", 10)
+    bs = BatchScheduler(tracker)
+    status = _drive_round(bs, now)
+    resp = None
+    for t_ms in range(100, 1200, 100):
+        r = status("w0", t_ms)
+        if r.kind is ProgressResponseKind.SCHEDULE_UPDATE:
+            resp = r
+            break
+    assert resp is not None and resp.traceparent is None
+    r = bs.on_progress("ps", Progress(kind=ProgressKind.UPDATED, round=0))
+    assert r.traceparent is None
