@@ -3,7 +3,7 @@
 
 PYTHON ?= python
 
-.PHONY: lint lint-fixtures test compressbench streambench ftbench-ps shardbench servbench hetbench obsbench
+.PHONY: lint lint-fixtures test compressbench streambench ftbench-ps ftbench-scheduler shardbench servbench hetbench obsbench
 
 lint:
 	$(PYTHON) -m hypha_tpu.analysis hypha_tpu/
@@ -74,6 +74,15 @@ hetbench:
 # generation handshake). Writes FTBENCH_kill-ps-2.json.
 ftbench-ps:
 	$(PYTHON) bench.py --chaos kill-ps:2
+
+# Durable control plane: kill the SCHEDULER mid-round, restart it under the
+# same peer id, and prove the restarted generation re-adopts the live
+# executions in place (ft.durable DurableScheduler journal + the
+# SchedulerHello/AdoptAck handshake): zero lost rounds, zero full restarts,
+# final weights bit-equal to a no-kill baseline, added wall-clock at most
+# one round + a fixed restart budget. Writes FTBENCH_kill-scheduler-2.json.
+ftbench-scheduler:
+	$(PYTHON) bench.py --chaos kill-scheduler:2
 
 # Observability plane: end-to-end round tracing overhead (traced round
 # wall within 3% of untraced) and critical-path attribution (a bw-capped

@@ -346,13 +346,16 @@ def main() -> None:
 
 def _chaos_main(spec: str, trace_dir: str | None = None) -> int:
     """``bench.py --chaos <spec> [--trace <dir>]`` (kill-worker:<round>,
-    kill-ps:<round>, partition-ps:<round>:<s>, slow-worker:<x>,
+    kill-ps:<round>, partition-ps:<round>:<s>, kill-scheduler:<round>,
+    partition-scheduler:<round>:<s>, slow-worker:<x>,
     bw-cap:<peer>:<mbps>, jitter:<peer>:<s>, ...): run the orchestrated
     fault-injection scenario (benchmarks/ft_chaos.py — 4 workers, elastic
-    membership, durable PS for the ps scenarios) on the CPU backend and
-    persist the result as FTBENCH_<scenario>.json next to this script.
-    Specs compose with commas (``kill-worker:2,bw-cap:w1:10``) so one run
-    can mix an event with steady degrade conditions.
+    membership, durable PS for the ps scenarios; scheduler scenarios run
+    the two-pass bit-equality harness with a restarted scheduler
+    re-adopting the live executions) on the CPU backend and persist the
+    result as FTBENCH_<scenario>.json next to this script. Specs compose
+    with commas (``kill-worker:2,bw-cap:w1:10``) so one run can mix an
+    event with steady degrade conditions.
 
     ``--trace <dir>`` turns on end-to-end round tracing + flight-recorder
     spill into ``dir`` and runs the timeline merger over it afterward

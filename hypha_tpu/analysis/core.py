@@ -88,6 +88,11 @@ RULES: dict[str, str] = {
         "round/epoch tag — a stale assignment could re-pace or re-encode "
         "workers from an old view"
     ),
+    "msg-generation-needs-round": (
+        "message carries a generation/scheduler_generation id but no "
+        "round/epoch tag — an un-rounded generation can adopt or drop "
+        "control decisions against the wrong round"
+    ),
     "msg-unmapped-protocol": (
         "registered wire message not claimed by any stream protocol"
     ),

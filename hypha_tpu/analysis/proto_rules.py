@@ -66,6 +66,14 @@ _FRAGMENT_FIELDS = {"fragment_id", "fragment"}
 # re-encode its link) against a round that already closed.
 _ADAPTIVE_FIELDS = {"inner_steps", "codecs", "peer_codecs"}
 
+# Field names carrying a process GENERATION id (the PS and scheduler
+# restart handshakes, hypha_tpu.ft.durable). Their presence obliges the
+# message to carry a round/epoch tag too (``msg-generation-needs-round``):
+# generation gating exists precisely to order control decisions across
+# restarts, and a generation without the round it speaks for could adopt
+# (or drop) an execution against the wrong round.
+_GENERATION_FIELDS = {"generation", "scheduler_generation", "ps_generation"}
+
 
 def _modules():
     from hypha_tpu import messages
@@ -402,6 +410,38 @@ def check_adaptive_tags(registry=None) -> list[Violation]:
     return out
 
 
+def check_generation_tags(registry=None) -> list[Violation]:
+    """Any message with a generation id must carry a round/epoch tag.
+
+    Structural, like :func:`check_fragment_tags`: EVERY registered
+    dataclass that grows a ``generation``/``scheduler_generation``/
+    ``ps_generation`` field must pair it with ``round``/``epoch``/
+    ``round_num`` — the restart handshakes (ft.durable) use generations to
+    order control decisions across process restarts, and a generation
+    stamped without its round could re-adopt an execution, or drop a
+    Continue/ScheduleUpdate, against a round it never spoke for.
+    """
+    messages, _ = _modules()
+    registry = registry if registry is not None else _package_registry(messages)
+    out: list[Violation] = []
+    for name, cls in sorted(registry.items()):
+        if not dataclasses.is_dataclass(cls):
+            continue
+        fields = {f.name for f in dataclasses.fields(cls)}
+        if fields & _GENERATION_FIELDS and not fields & _TAG_FIELDS:
+            out.append(
+                _violation(
+                    cls,
+                    "msg-generation-needs-round",
+                    f"{name}: carries {sorted(fields & _GENERATION_FIELDS)} "
+                    f"but no round tag ({'/'.join(sorted(_TAG_FIELDS))}) — "
+                    f"an un-rounded generation can adopt or drop control "
+                    f"decisions against the wrong round",
+                )
+            )
+    return out
+
+
 def check_protocol_map(registry=None, manifest=None, values=None) -> list[Violation]:
     messages, _ = _modules()
     registry = registry if registry is not None else _package_registry(messages)
@@ -464,5 +504,6 @@ def check() -> list[Violation]:
         + check_fragment_tags()
         + check_shard_tags()
         + check_adaptive_tags()
+        + check_generation_tags()
         + check_protocol_map()
     )

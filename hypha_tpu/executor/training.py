@@ -51,17 +51,18 @@ from ..messages import (
     ModelType,
     Progress,
     ProgressKind,
+    ProgressResponse,
     ProgressResponseKind,
     TrainExecutorConfig,
 )
 from .. import compress
-from ..ft.durable import RESYNC_KEY, restart_signal
+from ..ft.durable import RESYNC_KEY, restart_signal, stale_scheduler_response
 from ..ft.rejoin import CATCHUP_KEY
 from ..stream import SYNC_MODES, effective_fragments, fragment_due, merge_corrected
 from ..stream.partition import partition_names, shard_of
 from ..worker.connectors import shard_route
 from ..telemetry import trace
-from ..telemetry.ft_metrics import HET_METRICS, STREAM_METRICS
+from ..telemetry.ft_metrics import FT_METRICS, HET_METRICS, STREAM_METRICS
 from .diloco import (
     apply_updates,
     extract_delta,
@@ -694,6 +695,23 @@ def _init_model(cfg: TrainExecutorConfig, session, work_dir: Path, first_batch):
     return model, params, causal_lm, has_aux
 
 
+def adopt_schedule(resp: ProgressResponse, countdown: "int | None") -> "int | None":
+    """Adopt a SCHEDULE_UPDATE's counter — idempotently.
+
+    A countdown already in progress stands: a restarted scheduler that
+    re-adopted this execution mid-round has a tracker that forgot the
+    first issue and re-schedules on the next Status, but re-adopting its
+    counter would re-run (or skip) inner steps the round already
+    accounted. Only a worker with NO active countdown (round start, or
+    just merged) takes the counter.
+    """
+    if resp.kind != ProgressResponseKind.SCHEDULE_UPDATE:
+        return countdown
+    if countdown is None:
+        return resp.counter
+    return countdown
+
+
 def run_training(
     session,
     work_dir: Path | str,
@@ -911,6 +929,36 @@ def run_training(
     # mid-wait means the parameter server restarted — the shipped delta may
     # have died with it unjournaled, so the worker re-pushes it.
     ps_generation: Any = None
+    # Last SCHEDULER generation adopted from stamped responses
+    # (ft.durable DurableScheduler). A response stamped with an OLDER
+    # generation is a zombie predecessor's control decision — dropped,
+    # never acted on; the live scheduler answers the re-send. Unstamped
+    # responses (every job that never restarts its scheduler) skip the
+    # gate entirely.
+    sched_gen: dict[str, Any] = {"v": None}
+
+    def send_status_gated(progress: Progress) -> ProgressResponse:
+        """session.send_status + the scheduler-generation gate."""
+        for _attempt in range(64):
+            gen = sched_gen["v"]
+            if gen is not None and int(gen) >= 2:
+                progress.scheduler_generation = int(gen)
+            resp = session.send_status(progress)
+            new_gen, stale = stale_scheduler_response(resp, sched_gen["v"])
+            sched_gen["v"] = new_gen
+            if not stale:
+                return resp
+            FT_METRICS.stale_generation_dropped.add(1)
+            log.warning(
+                "dropping %s response from stale scheduler generation %s "
+                "(adopted %s); re-sending",
+                progress.kind.value, getattr(resp, "generation", None),
+                sched_gen["v"],
+            )
+            time.sleep(0.2)
+        raise RuntimeError(
+            "scheduler kept answering from a stale generation"
+        )
     # Outer-round wire codec (hypha_tpu.compress): delta_codec wins, the
     # legacy delta_dtype="bfloat16" maps onto the bf16 codec. Quantized
     # codecs carry an error-feedback residual across rounds so the
@@ -1085,7 +1133,7 @@ def run_training(
         nonlocal ps_generation
         rtrace.close_inner()
         round_tp = rtrace.ctx(round_num)
-        session.send_status(
+        send_status_gated(
             Progress(
                 kind=ProgressKind.UPDATE, job_id=spec.job_id,
                 traceparent=round_tp,
@@ -1161,7 +1209,7 @@ def run_training(
         )
         trace.finish(up_span)
         mean_loss = float(np.mean(round_losses)) if round_losses else math.nan
-        session.send_status(
+        send_status_gated(
             Progress(
                 kind=ProgressKind.METRICS,
                 job_id=spec.job_id,
@@ -1256,7 +1304,7 @@ def run_training(
         # The broadcast update is merged — drop it, or a long job accumulates
         # one full-parameter-sized file per round under work_dir/incoming.
         update_file.unlink(missing_ok=True)
-        resp = session.send_status(
+        resp = send_status_gated(
             Progress(
                 kind=ProgressKind.UPDATE_RECEIVED, job_id=spec.job_id,
                 traceparent=round_tp,
@@ -1322,7 +1370,7 @@ def run_training(
         assert shard_map is not None
         rtrace.close_inner()
         round_tp = rtrace.ctx(round_num)
-        session.send_status(
+        send_status_gated(
             Progress(
                 kind=ProgressKind.UPDATE, job_id=spec.job_id,
                 traceparent=round_tp,
@@ -1368,7 +1416,7 @@ def run_training(
             _push_part(p, path, samples)
         trace.finish(up_span)
         mean_loss = float(np.mean(round_losses)) if round_losses else math.nan
-        session.send_status(
+        send_status_gated(
             Progress(
                 kind=ProgressKind.METRICS,
                 job_id=spec.job_id,
@@ -1453,7 +1501,7 @@ def run_training(
         trace.finish(merge_span)
         for path in paths.values():
             path.unlink(missing_ok=True)
-        resp = session.send_status(
+        resp = send_status_gated(
             Progress(
                 kind=ProgressKind.UPDATE_RECEIVED, job_id=spec.job_id,
                 traceparent=round_tp,
@@ -1486,7 +1534,7 @@ def run_training(
         assert stream_state is not None
         rtrace.close_inner()
         round_tp = rtrace.ctx(round_num)
-        session.send_status(
+        send_status_gated(
             Progress(
                 kind=ProgressKind.UPDATE, job_id=spec.job_id,
                 traceparent=round_tp,
@@ -1494,7 +1542,7 @@ def run_training(
         )
         stream_state.begin(round_num, state.params, anchor, round_samples)
         mean_loss = float(np.mean(round_losses)) if round_losses else math.nan
-        session.send_status(
+        send_status_gated(
             Progress(
                 kind=ProgressKind.METRICS,
                 job_id=spec.job_id,
@@ -1513,7 +1561,7 @@ def run_training(
         new_params, new_anchor = stream_state.finish(state.params, anchor)
         state = state.replace(params=new_params)
         anchor = new_anchor
-        resp = session.send_status(
+        resp = send_status_gated(
             Progress(
                 kind=ProgressKind.UPDATE_RECEIVED, job_id=spec.job_id,
                 traceparent=rtrace.ctx(round_num),
@@ -1581,7 +1629,7 @@ def run_training(
             result.batches += 1
             round_samples += cfg.batch_size
 
-            resp = session.send_status(
+            resp = send_status_gated(
                 Progress(
                     kind=ProgressKind.STATUS,
                     job_id=spec.job_id,
@@ -1591,8 +1639,10 @@ def run_training(
             if resp.kind == ProgressResponseKind.DONE:
                 break
             if resp.kind == ProgressResponseKind.SCHEDULE_UPDATE:
-                countdown = resp.counter
-                rtrace.adopt(resp, round_num)
+                adopted = adopt_schedule(resp, countdown)
+                if adopted is not countdown:
+                    countdown = adopted
+                    rtrace.adopt(resp, round_num)
             if countdown is not None:
                 if countdown <= 0:
                     countdown = None

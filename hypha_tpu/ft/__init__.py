@@ -29,7 +29,7 @@ from .chaos import (
     parse_chaos_specs,
 )
 from .detector import PHI_THRESHOLD_DEFAULT, PhiAccrualDetector
-from .durable import GENERATION_KEY, DurablePS, RoundJournal
+from .durable import GENERATION_KEY, DurablePS, DurableScheduler, RoundJournal
 from .membership import (
     PROTOCOL_FT,
     FTConfig,
@@ -53,6 +53,7 @@ __all__ = [
     "GENERATION_KEY",
     "CatchupBuffer",
     "DurablePS",
+    "DurableScheduler",
     "RoundJournal",
     "await_catchup",
     "ChaosAction",

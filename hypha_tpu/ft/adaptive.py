@@ -158,6 +158,11 @@ class StragglerController:
         self._run: dict[str, int] = {}
         self._assigned: dict[str, int] = {}
         self._arrived: dict[int, set[str]] = {}
+        # Scheduler crash recovery (ft.durable DurableScheduler): rounds
+        # <= this one span the outage — a rebuilt controller must treat
+        # them as warmup (base assignments, no EWMA feed, no drop
+        # penalty). -1 = never resumed, today's exact behavior.
+        self._resumed_at = -1
 
     # -------------------------------------------------------------- feeding
     def note_batch(self, peer: str) -> None:
@@ -182,6 +187,13 @@ class StragglerController:
         self._arrived.setdefault(round_num, set()).update(
             str(p) for p in arrivals
         )
+        if round_num <= self._resumed_at:
+            # Post-restart warmup (resume_warmup): this round spans the
+            # scheduler outage, so its arrival lags include parked
+            # uploads and adoption latency — feeding them would make
+            # every peer look like a straggler. Arrival CREDIT still
+            # counts (no drop penalty), exactly like the jit warmup.
+            return
         if round_num < self.warmup_rounds:
             # First-round arrivals are dominated by one-time jit compile,
             # not steady-state cost: feeding them would make EVERY peer
@@ -207,7 +219,7 @@ class StragglerController:
         ended were quorum-dropped: their estimate scales by
         ``drop_penalty`` so their assignment keeps shrinking until their
         delta lands inside the deadline."""
-        if self.round >= self.warmup_rounds:
+        if self.round >= self.warmup_rounds and self.round > self._resumed_at:
             # Dropped = assigned but credited by NO close report for any
             # round since the assignment was frozen (shards may have
             # reported several rounds between our start_round calls).
@@ -256,6 +268,12 @@ class StragglerController:
     def steps_for(self, peer: str) -> int:
         """This round's inner-step assignment for ``peer`` (frozen at first
         query per round, so every party sees one consistent value)."""
+        if self.round <= self._resumed_at:
+            # Post-restart warmup: base assignment for everyone, published
+            # as NO assignment (assignments() stays empty, so the round
+            # membership ships inner_steps=None) — a rebuilt controller
+            # must not re-pace the fleet until one full measured round.
+            return self.base_steps
         cached = self._assigned.get(peer)
         if cached is not None:
             return cached
@@ -301,6 +319,49 @@ class StragglerController:
         """This round's frozen assignments (published with the round
         membership as ``RoundMembership.inner_steps``)."""
         return dict(self._assigned)
+
+    # --------------------------------------------------------- crash recovery
+    def snapshot(self) -> dict:
+        """Journal-able controller state (ft.durable DurableScheduler):
+        the per-peer EWMA estimates and the round they speak for. Small
+        and plain — it rides inside the scheduler journal's round records."""
+        return {
+            "round": self.round,
+            "base_steps": self.base_steps,
+            "per_step": {
+                p: e.value
+                for p, e in self._per_step.items()
+                if e.value is not None
+            },
+        }
+
+    def resume_warmup(self, round_num: int, snapshot: dict | None = None) -> None:
+        """Adopt a journaled snapshot after a scheduler restart — in WARMUP.
+
+        A rebuilt controller must not punish healthy peers for state the
+        crash destroyed: until one full measured round completes
+        (``round_num`` itself), :meth:`steps_for` hands every peer the
+        base count, :meth:`assignments` publishes nothing, and
+        :meth:`start_round` applies NO drop penalty — the arrivals the
+        dead scheduler never saw are not evidence anyone was slow
+        (mirrors the PR 8 recovered-PS re-notify guard). The journaled
+        EWMAs seed the estimates so the first post-warmup round resumes
+        from measured history instead of from scratch.
+        """
+        self.round = max(int(round_num), 0)
+        self._resumed_at = self.round
+        self._run.clear()
+        self._assigned.clear()
+        self._arrived.clear()
+        self._batch_ts.clear()
+        self._batch.clear()
+        for peer, value in ((snapshot or {}).get("per_step") or {}).items():
+            try:
+                v = float(value)
+            except (TypeError, ValueError):
+                continue
+            if v > 0:
+                self._per_step.setdefault(str(peer), Ewma(self._alpha)).update(v)
 
 
 class LinkTable:
@@ -357,3 +418,32 @@ class LinkTable:
         )
         HET_METRICS.note_codec(peer, codec)
         return codec
+
+    # --------------------------------------------------------- crash recovery
+    def snapshot(self) -> dict:
+        """Journal-able per-peer bandwidth EWMAs (ft.durable): plain
+        peer -> bits/s, the same shape :meth:`restore` seeds from.
+
+        Not yet wired into a journal: the LinkTable lives on the PS, and
+        ``adaptive_codec`` is currently rejected alongside
+        ``checkpoint_dir`` (job_config — per-peer wires have no durable
+        slot). This pair is the snapshot surface that restriction will
+        lift through; until then it is exercised by tests only."""
+        return {
+            peer: est.value
+            for peer, est in self._bw.items()
+            if est.value is not None
+        }
+
+    def restore(self, snapshot: dict) -> None:
+        """Seed the table from a journaled snapshot. Restored estimates
+        count as MEASURED (codec selection resumes immediately) — unlike
+        the straggler controller, a bandwidth EWMA carries no drop-penalty
+        state that could punish a peer for the outage itself."""
+        for peer, value in (snapshot or {}).items():
+            try:
+                v = float(value)
+            except (TypeError, ValueError):
+                continue
+            if v > 0:
+                self._bw.setdefault(str(peer), Ewma(self._alpha)).update(v)

@@ -20,7 +20,16 @@ WorkerNode` objects a test or ``bench.py --chaos`` holds:
   * ``partition-ps`` — for ``delay_s`` seconds, drop every push between
     the PS and the workers (both directions): workers must park and
     re-push with backoff (aio.retry), and the PS journal must dedup the
-    copies whose first attempt actually landed.
+    copies whose first attempt actually landed;
+  * ``kill-scheduler`` — stop the SCHEDULER's node mid-round (the durable
+    control-plane recovery scenario, ft.durable DurableScheduler: the
+    harness restarts the scheduler under the same peer id, which replays
+    its journal and re-adopts the live executions in place);
+  * ``partition-scheduler`` — for ``delay_s`` seconds, fail every request
+    and push from the fleet TOWARD the scheduler (uplink loss: workers'
+    Status/UpdateReceived and the PS's Updated park in aio.retry; quorate
+    rounds keep closing; the scheduler's own renewals still flow, so no
+    lease lapses), then heal.
 
 Degrade modes (net-new, ROADMAP item 4 — heterogeneity is a steady state,
 not an event, so these default to ``at_round=0`` and fire on attach):
@@ -71,6 +80,7 @@ log = logging.getLogger("hypha.ft.chaos")
 
 _KINDS = (
     "kill", "delay", "partition", "kill-ps", "partition-ps",
+    "kill-scheduler", "partition-scheduler",
     "slow", "bw-cap", "jitter",
 )
 
@@ -128,7 +138,9 @@ def parse_chaos_spec(spec: str, target: str) -> ChaosAction:
         kind = "delay"
     elif head in ("partition-worker", "partition"):
         kind = "partition"
-    elif head in ("kill-ps", "partition-ps"):
+    elif head in (
+        "kill-ps", "partition-ps", "kill-scheduler", "partition-scheduler"
+    ):
         kind = head
     elif head in ("slow-worker", "slow"):
         kind = "slow"
@@ -167,7 +179,7 @@ def parse_chaos_spec(spec: str, target: str) -> ChaosAction:
             kind=kind, target=target, at_round=at_round, delay_s=delay_s
         )
     at_round = int(args[0]) if args else 1
-    default_delay = 3.0 if kind == "partition-ps" else 1.0
+    default_delay = 3.0 if kind in ("partition-ps", "partition-scheduler") else 1.0
     delay_s = float(args[1]) if len(args) > 1 else default_delay
     return ChaosAction(kind=kind, target=target, at_round=at_round, delay_s=delay_s)
 
@@ -247,7 +259,7 @@ class ChaosController:
             log.warning("chaos: no worker %r to %s", action.target, action.kind)
             return
         log.info("chaos: %s %s (round trigger %d)", action.kind, action.target, action.at_round)
-        if action.kind in ("kill", "kill-ps"):
+        if action.kind in ("kill", "kill-ps", "kill-scheduler"):
             aio.spawn(
                 self._kill(worker), tasks=self._tasks, what="chaos kill", logger=log
             )
@@ -257,6 +269,8 @@ class ChaosController:
             self._partition(worker.node)
         elif action.kind == "partition-ps":
             self._partition_ps(action.target, action.delay_s)
+        elif action.kind == "partition-scheduler":
+            self._partition_scheduler(action.target, action.delay_s)
         elif action.kind == "slow":
             self._wrap_slow_cpu(worker.node, action.factor)
         elif action.kind == "bw-cap":
@@ -458,6 +472,62 @@ class ChaosController:
             for node, orig_push in undo:
                 node.push = orig_push
             log.info("chaos: partition-ps around %s healed", ps_peer)
+
+        aio.spawn(heal(), tasks=self._tasks, what="chaos heal", logger=log)
+
+    def _partition_scheduler(self, sched_peer: str, duration_s: float) -> None:
+        """Sever the fleet's UPLINK to the scheduler for ``duration_s``
+        seconds, then heal. Every other node's requests (Status,
+        UpdateReceived, Updated, JobStatus) and pushes toward the
+        scheduler fail with RequestError — the exact shape a dead/restart-
+        ing scheduler presents — so the park-in-aio.retry paths (bridge
+        status sends, the PS's resilient Updated notify) are what keep the
+        job alive. The scheduler's own outbound renewals are untouched:
+        this models uplink loss, not the full crash (``kill-scheduler``
+        covers that one)."""
+        from ..network.node import RequestError
+
+        undo: list[tuple[Any, Any, Any]] = []
+        for name, worker in self.workers.items():
+            if name == sched_peer:
+                continue
+            node = getattr(worker, "node", None)
+            if node is None:
+                continue
+            orig_push = node.push
+            orig_request = node.request
+
+            async def cut_push(
+                peer_id: str, resource: Any, source, _orig=orig_push
+            ) -> int:
+                if peer_id == sched_peer:
+                    raise RequestError(
+                        f"chaos partition-scheduler: push to {sched_peer} dropped"
+                    )
+                return await _orig(peer_id, resource, source)
+
+            async def cut_request(
+                peer_id: str, protocol: str, msg: Any,
+                _orig=orig_request, **kw,
+            ) -> Any:
+                if peer_id == sched_peer:
+                    raise RequestError(
+                        f"chaos partition-scheduler: request to {sched_peer} dropped"
+                    )
+                return await _orig(peer_id, protocol, msg, **kw)
+
+            node.push = cut_push
+            node.request = cut_request
+            undo.append((node, orig_push, orig_request))
+
+        async def heal() -> None:
+            await asyncio.sleep(duration_s)
+            for node, orig_push, orig_request in undo:
+                node.push = orig_push
+                node.request = orig_request
+            log.info(
+                "chaos: partition-scheduler around %s healed", sched_peer
+            )
 
         aio.spawn(heal(), tasks=self._tasks, what="chaos heal", logger=log)
 

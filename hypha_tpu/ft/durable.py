@@ -50,6 +50,7 @@ import logging
 import os
 import shutil
 import struct
+import threading
 from dataclasses import dataclass, field
 from pathlib import Path
 from typing import Any, Iterable
@@ -66,8 +67,12 @@ __all__ = [
     "JOURNAL_FSYNC_ENV",
     "RoundJournal",
     "DurablePS",
+    "DurableScheduler",
     "FoldRecord",
     "restart_signal",
+    "stale_scheduler_response",
+    "DEFAULT_ADOPT_GRACE_S",
+    "DEFAULT_ADOPT_DEADLINE_S",
 ]
 
 log = logging.getLogger("hypha.ft.durable")
@@ -101,6 +106,38 @@ def _fsync_every() -> int:
         return int(os.environ.get(JOURNAL_FSYNC_ENV, "1") or 1)
     except ValueError:
         return 1
+
+
+# Worker-side adoption grace (seconds): how long a scheduler-recoverable
+# job's executions outlive a dead scheduler — leases survive expiry by this
+# much, Status/UpdateReceived/Updated sends park in aio.retry for it — so
+# the restarted scheduler can re-adopt them in place. Past it, the existing
+# lease-expiry cancellation (and scheduler-side re-auction) takes over.
+DEFAULT_ADOPT_GRACE_S = 120.0
+
+# Scheduler-side adoption deadline (seconds): how long recovery waits for an
+# execution's AdoptAck before treating it as dead and falling back to the
+# existing depart/rejoin (train) or ps-restart re-auction path.
+DEFAULT_ADOPT_DEADLINE_S = 20.0
+
+
+def stale_scheduler_response(resp: Any, last_gen: "int | None") -> tuple["int | None", bool]:
+    """Gate one scheduler response by its stamped generation.
+
+    Returns ``(new_last_gen, stale)``. A response stamped with a generation
+    OLDER than one already adopted is a zombie scheduler's control decision
+    (a Continue/ScheduleUpdate racing its successor's) and must be dropped,
+    not acted on. Unstamped responses (the off path, and every pre-restart
+    round) pass through untouched. The ONE implementation the worker
+    training loop and the parameter server's notify path share, mirroring
+    :func:`restart_signal` for the PS generation handshake.
+    """
+    gen = getattr(resp, "generation", None)
+    if gen is None:
+        return last_gen, False
+    if last_gen is not None and gen < last_gen:
+        return last_gen, True
+    return gen, False
 
 
 def restart_signal(meta: dict, last_gen: Any) -> tuple[Any, bool]:
@@ -774,3 +811,276 @@ class DurablePS:
 
     def close(self) -> None:
         self.journal.close()
+
+
+# --------------------------------------------------------------------------
+# Durable control plane: the scheduler's own journal
+# --------------------------------------------------------------------------
+
+_SCHED_JOURNAL_NAME = "sched-journal.cbor"
+
+# Compact the scheduler journal every this many round records: the window
+# between compactions is what a restart replays, and every compaction
+# rewrites gen + plan + the latest dispatch/member/round records — state
+# proportional to the fleet, not the job length.
+_SCHED_COMPACT_EVERY = 8
+
+
+@dataclass(slots=True)
+class _SchedResume:
+    """What a restarted scheduler adopts from its predecessor's journal."""
+
+    base_id: str
+    plan: dict
+    round: int = 0
+    member: dict | None = None
+    ctrl: dict | None = None
+    rejoins: int = 0
+    ps_restarts: int = 0
+    # job_id -> latest dispatch record ({job_id, peer, lease_id, kind,
+    # shard, batch_size}); re-dispatches (rejoin / ps restart) supersede.
+    dispatches: dict[str, dict] = field(default_factory=dict)
+
+
+class DurableScheduler:
+    """The scheduler/orchestrator's durable state root (``scheduler/``
+    under the job's checkpoint dir) — the same write-ahead discipline the
+    parameter server established (:class:`RoundJournal` reused verbatim):
+    length-prefixed CBOR records, fsync-batched appends, torn tail = clean
+    EOF, compaction keeping the journal proportional to the fleet.
+
+    Records:
+
+      * ``gen``      — one per scheduler process start; the **scheduler
+        generation id** the re-adoption handshake and every stamped
+        Continue/ScheduleUpdate trace back to (fsync'd);
+      * ``plan``     — the attempt's identity: base job id, stream tags,
+        per-shard job ids/tags, worker batch sizes (fsync'd);
+      * ``dispatch`` — one live execution: job id, peer, lease id, kind
+        (train/aggregate), shard. Re-dispatches (rejoin, per-shard PS
+        restart) append superseding records (fsync'd);
+      * ``round``    — the BatchScheduler frontier advanced (batched;
+        carries the straggler controller snapshot when adaptive);
+      * ``member``   — a membership epoch change (active/departed lists,
+        rejoin count).
+
+    On restart, :meth:`open` bumps the generation and parses the journal
+    into a :class:`_SchedResume`; the orchestrator re-dials the recorded
+    peers and runs the ``SchedulerHello``/``AdoptAck`` handshake against
+    the recorded executions. No journal (or an unreadable one — the torn
+    tail rule turns arbitrary corruption into a clean empty log) resumes
+    nothing: the caller falls back to the existing fresh-run path.
+    """
+
+    def __init__(self, root: Path | str, fsync_every: int | None = None) -> None:
+        self.root = Path(root)
+        self.generation = 1
+        self.resume: _SchedResume | None = None
+        self.journal: RoundJournal
+        self._fsync_every = fsync_every
+        # Appends arrive from to_thread workers; RoundJournal is a plain
+        # buffered file, so serialize them here.
+        self._lock = threading.Lock()
+        self._plan: dict = {}
+        self._dispatches: dict[str, dict] = {}
+        self._member: dict | None = None
+        self._last_round_rec: dict | None = None
+        self._ps_restarts = 0
+        self._rounds_since_compact = 0
+        self._closed = False
+
+    # ------------------------------------------------------------- opening
+
+    @staticmethod
+    def has_state(root: Path | str) -> bool:
+        """True when a previous scheduler left a journal worth adopting."""
+        path = Path(root) / _SCHED_JOURNAL_NAME
+        try:
+            return path.stat().st_size > 0
+        except OSError:
+            return False
+
+    @classmethod
+    def open(
+        cls,
+        root: Path | str,
+        *,
+        fresh: bool = False,
+        fsync_every: int | None = None,
+    ) -> "DurableScheduler":
+        """Open (blocking — run off-loop). ``fresh=True`` wipes any prior
+        state first: a NEW attempt must not leave a stale journal that the
+        next restart would adopt against the wrong executions."""
+        dur = cls(root, fsync_every)
+        dur.root.mkdir(parents=True, exist_ok=True)
+        path = dur.root / _SCHED_JOURNAL_NAME
+        if fresh:
+            path.unlink(missing_ok=True)
+        records = RoundJournal.read_all(path)
+        prev_gen = max(
+            (int(r.get("generation", 0)) for r in records if r.get("t") == "gen"),
+            default=0,
+        )
+        dur.generation = prev_gen + 1
+        dur.journal = RoundJournal(path, fsync_every)
+        if records:
+            dur.resume = dur._build_resume(records)
+        if dur.resume is not None:
+            # Seed the live tables from the adopted state so the first
+            # post-restart compaction keeps it.
+            dur._plan = dict(dur.resume.plan)
+            dur._dispatches = dict(dur.resume.dispatches)
+            dur._member = dur.resume.member
+            dur._ps_restarts = dur.resume.ps_restarts
+            dur._last_round_rec = {
+                "t": "round",
+                "round": dur.resume.round,
+                "ctrl": dur.resume.ctrl,
+            }
+            from ..telemetry.flight import FLIGHT
+
+            FLIGHT.record(
+                "scheduler.generation_bump",
+                node="scheduler",
+                generation=dur.generation,
+                round=dur.resume.round,
+                executions=len(dur.resume.dispatches),
+            )
+        dur.journal.append(
+            {"t": "gen", "generation": dur.generation}, sync=True
+        )
+        return dur
+
+    @staticmethod
+    def _build_resume(records: list[dict]) -> "_SchedResume | None":
+        plan: dict | None = None
+        resume: _SchedResume | None = None
+        for rec in records:
+            t = rec.get("t")
+            if t == "plan":
+                plan = {k: v for k, v in rec.items() if k != "t"}
+                resume = _SchedResume(
+                    base_id=str(plan.get("base_id", "")), plan=plan
+                )
+            elif resume is None:
+                continue  # pre-plan records (gen) carry no adoptable state
+            elif t == "dispatch":
+                resume.dispatches[str(rec.get("job_id", ""))] = {
+                    k: v for k, v in rec.items() if k != "t"
+                }
+            elif t == "round":
+                resume.round = max(resume.round, int(rec.get("round", 0)))
+                if rec.get("ctrl") is not None:
+                    resume.ctrl = rec.get("ctrl")
+            elif t == "member":
+                resume.member = {k: v for k, v in rec.items() if k != "t"}
+                resume.rejoins = int(rec.get("rejoins", 0))
+            elif t == "ps_restart":
+                resume.ps_restarts = int(rec.get("count", 0))
+        if resume is None or not resume.base_id:
+            return None
+        return resume
+
+    # ------------------------------------------------------------ recording
+
+    def note_plan(self, plan: dict) -> None:
+        with self._lock:
+            if self._closed:
+                return
+            self._plan = dict(plan)
+            self.journal.append({"t": "plan", **self._plan}, sync=True)
+
+    def note_dispatch(
+        self,
+        job_id: str,
+        peer: str,
+        lease_id: str,
+        kind: str,
+        shard: int | None = None,
+        batch_size: int | None = None,
+    ) -> None:
+        rec = {
+            "t": "dispatch",
+            "job_id": job_id,
+            "peer": peer,
+            "lease_id": lease_id,
+            "kind": kind,
+        }
+        if shard is not None:
+            rec["shard"] = int(shard)
+        if batch_size is not None:
+            rec["batch_size"] = int(batch_size)
+        with self._lock:
+            if self._closed:
+                return
+            self._dispatches[job_id] = {
+                k: v for k, v in rec.items() if k != "t"
+            }
+            self.journal.append(rec, sync=True)
+
+    def note_round(self, round_num: int, ctrl: dict | None = None) -> None:
+        """The BatchScheduler frontier advanced (fsync-batched — a torn
+        round record costs at most re-deriving one round from AdoptAcks)."""
+        rec: dict = {"t": "round", "round": int(round_num)}
+        if ctrl is not None:
+            rec["ctrl"] = ctrl
+        with self._lock:
+            if self._closed:
+                return
+            self._last_round_rec = rec
+            self.journal.append(rec)
+            self._rounds_since_compact += 1
+            if self._rounds_since_compact >= _SCHED_COMPACT_EVERY:
+                self._compact_locked()
+
+    def note_member(self, member: dict, rejoins: int = 0) -> None:
+        rec = {"t": "member", **member, "rejoins": int(rejoins)}
+        with self._lock:
+            if self._closed:
+                return
+            self._member = {k: v for k, v in rec.items() if k != "t"}
+            self.journal.append(rec)
+
+    def note_ps_restarts(self, count: int) -> None:
+        """Persist the per-shard PS-restart attempt count: a recovered
+        scheduler must resume the budget, not hand a persistently-failing
+        shard a fresh one after every scheduler crash."""
+        with self._lock:
+            if self._closed:
+                return
+            self._ps_restarts = int(count)
+            self.journal.append({"t": "ps_restart", "count": int(count)})
+
+    def _compact_locked(self) -> None:
+        window: list[dict] = [
+            {"t": "gen", "generation": self.generation},
+            {"t": "plan", **self._plan},
+        ]
+        window += [
+            {"t": "dispatch", **rec} for rec in self._dispatches.values()
+        ]
+        if self._member is not None:
+            window.append({"t": "member", **self._member})
+        if self._ps_restarts:
+            window.append({"t": "ps_restart", "count": self._ps_restarts})
+        if self._last_round_rec is not None:
+            window.append(self._last_round_rec)
+        self.journal.replace_with(window)
+        self._rounds_since_compact = 0
+
+    # ------------------------------------------------------------- lifecycle
+
+    def complete(self) -> None:
+        """The job finished: drop the journal so the next run with this
+        checkpoint dir starts fresh instead of adopting a finished job."""
+        with self._lock:
+            if not self._closed:
+                self.journal.close()
+                self._closed = True
+            (self.root / _SCHED_JOURNAL_NAME).unlink(missing_ok=True)
+
+    def close(self) -> None:
+        with self._lock:
+            if not self._closed:
+                self.journal.close()
+                self._closed = True

@@ -73,6 +73,17 @@ class FTConfig:
     # at dispatch, so recovery models a process restart, not a migration.
     ps_restart_attempts: int = 2
     ps_restart_backoff_s: float = 1.0
+    # Scheduler crash recovery (ft.durable DurableScheduler; active when
+    # DiLoCoJob.scheduler_recovery is on). ``scheduler_adopt_grace_s``:
+    # how long workers hold leases/executions past a dead scheduler
+    # (parked sends, deferred lease prune) waiting for re-adoption.
+    # ``scheduler_adopt_deadline_s``: how long the restarted scheduler
+    # waits for each execution's AdoptAck before falling back to the
+    # re-auction path. None (the default, and the only value a
+    # non-recoverable job ships — wire-omitted) means the ft.durable
+    # defaults (120 s / 20 s).
+    scheduler_adopt_grace_s: float | None = None
+    scheduler_adopt_deadline_s: float | None = None
 
     def __post_init__(self) -> None:
         if not 0.0 <= self.quorum_fraction <= 1.0:

@@ -75,6 +75,9 @@ __all__ = [
     "ShardMap",
     "SHARD_KEY",
     "PREFOLD_KEY",
+    # durable control plane (hypha_tpu.ft.durable DurableScheduler)
+    "SchedulerHello",
+    "AdoptAck",
     # WAN-adaptive outer rounds (hypha_tpu.ft.adaptive)
     "CODEC_KEY",
     # end-to-end round tracing (hypha_tpu.telemetry.trace)
@@ -559,6 +562,15 @@ class TrainExecutorConfig:
     # the reducer's own delta goes direct to the shard (a node cannot
     # push to itself), so shard ingress per group is the partial + one.
     reduce_members: list = field(default_factory=list)
+    # Durable control plane (hypha_tpu.ft.durable): the scheduler journals
+    # its state and can be restarted in place. A worker running such a job
+    # parks its Status/UpdateReceived sends in aio.retry for up to this
+    # many seconds across a scheduler outage, and its lease survives
+    # expiry by the same grace so the restarted scheduler (SchedulerHello)
+    # can re-adopt the live execution instead of re-auctioning it.
+    # Additive field: None (the only value a non-recoverable job ships) is
+    # omitted from the wire — scheduler recovery off keeps today's bytes.
+    adopt_grace_s: float | None = None
 
 
 @register
@@ -627,6 +639,12 @@ class AggregateExecutorConfig:
     # [lo, hi) degrades the link to int8, < lo to int4. None = defaults.
     codec_bw_hi_mbps: float | None = None
     codec_bw_lo_mbps: float | None = None
+    # Durable control plane (hypha_tpu.ft.durable): see
+    # TrainExecutorConfig.adopt_grace_s — the parameter server parks its
+    # Updated notify by the same grace (broadcasting FIRST on the first
+    # failed attempt, so an already-quorate round closes without the
+    # scheduler). Additive field: None is omitted from the wire.
+    adopt_grace_s: float | None = None
 
 
 @register
@@ -971,6 +989,14 @@ class Progress:
     # reported. Additive field: absent on the wire = shard 0, so a
     # single-PS job's control plane is byte-compatible.
     shard: int = 0
+    # Durable control plane (hypha_tpu.ft.durable): the scheduler
+    # generation the sender last adopted. Only stamped after a scheduler
+    # restart actually happened (generation >= 2) — a new scheduler drops
+    # traffic addressed to a NEWER generation than itself (the zombie /
+    # split-brain guard), while round idempotency absorbs old-generation
+    # re-sends. Additive field: None (the only value a job that never
+    # restarts its scheduler ships) is omitted from the wire entirely.
+    scheduler_generation: int | None = None
     # End-to-end round tracing (hypha_tpu.telemetry.trace): the sender's
     # trace context, so a worker's UPDATE/METRICS and the PS's UPDATED all
     # land in the round's trace. Additive field: None (the only value an
@@ -1002,6 +1028,16 @@ class ProgressResponse:
     # message. Additive field: None is omitted from the wire, tracing off
     # ships today's exact bytes.
     traceparent: str | None = None
+    # Durable control plane (hypha_tpu.ft.durable): a RESTARTED scheduler
+    # (generation >= 2) stamps its generation — and the round the response
+    # speaks for, the lint-enforced pairing — into every Continue /
+    # ScheduleUpdate / OK / DONE, so a worker that already adopted a newer
+    # generation can DROP a zombie predecessor's stale control decision
+    # instead of acting on it. Additive fields: None (the only value a
+    # never-restarted scheduler ships) is omitted from the wire entirely,
+    # keeping today's exact bytes (and the frozen singleton responses).
+    generation: int | None = None
+    round: int | None = None
 
 
 # --------------------------------------------------------------------------
@@ -1127,6 +1163,54 @@ class ShardMap:
 
 
 # --------------------------------------------------------------------------
+# Durable control plane (hypha_tpu.ft.durable DurableScheduler): the
+# execution re-adoption handshake a RESTARTED scheduler runs on the existing
+# /hypha-api executor channels. Neither message is ever sent by a job whose
+# scheduler did not restart, so the off path ships no new wire at all.
+# --------------------------------------------------------------------------
+
+
+@register
+@dataclass(slots=True)
+class SchedulerHello:
+    """Restarted scheduler → worker: "generation ``generation`` adopted
+    your execution of ``job_id``; my journal believes round ``round``".
+
+    Sent once per journaled execution during recovery. The worker re-arms
+    the backing lease (ending the adoption grace), records the generation
+    for stale-response dropping, and answers with its TRUE progress so the
+    scheduler fast-forwards instead of rewinding. ``round`` travels with
+    ``generation`` (hypha-lint ``msg-generation-needs-round``): an
+    un-rounded hello could re-adopt an execution against the wrong round.
+    """
+
+    generation: int = 0
+    job_id: str = ""
+    round: int = 0
+
+
+@register
+@dataclass(slots=True)
+class AdoptAck:
+    """Worker → restarted scheduler: the execution's actual state.
+
+    ``round``/``epoch`` are the execution's live progress (a parameter
+    server reports the next round it will close; a train worker its last
+    reported round) — the fast-forward source of truth. ``state`` is
+    ``running`` | ``gone`` (no such job — fall back to re-auction) |
+    ``stale`` (the hello came from an OLDER generation than one already
+    adopted: a zombie scheduler must not steal the execution back).
+    """
+
+    job_id: str = ""
+    round: int = 0
+    epoch: int = 0
+    state: str = "running"
+    generation: int = 0
+    ok: bool = True
+
+
+# --------------------------------------------------------------------------
 # Gossip: worker request ad (lib.rs:122-134)
 # --------------------------------------------------------------------------
 
@@ -1162,6 +1246,8 @@ declare_protocol(
     "ParameterPull",
     "ParameterPush",
     "Ack",
+    "SchedulerHello",
+    "AdoptAck",
 )
 declare_protocol(PROTOCOL_HEALTH, "HealthRequest", "HealthResponse")
 declare_protocol(PROTOCOL_PROGRESS, "Progress", "ProgressResponse")

@@ -21,6 +21,7 @@ messages and returns its ProgressResponse to the peer.
 
 from __future__ import annotations
 
+import dataclasses
 from typing import Callable
 
 from ..messages import (
@@ -29,6 +30,7 @@ from ..messages import (
     ProgressResponse,
     ProgressResponseKind,
 )
+from ..telemetry.ft_metrics import FT_METRICS
 from ..telemetry import trace
 from .simulation import project
 from .trackers import ProgressTracker, WorkerState
@@ -54,6 +56,7 @@ class BatchScheduler:
         updates_cap: int = UPDATES_CAP,
         shards_due: "Callable[[int], tuple[int, ...]] | None" = None,
         adaptive=None,
+        generation: int | None = None,
     ) -> None:
         self.tracker = tracker
         self._on_metrics = on_metrics
@@ -74,6 +77,13 @@ class BatchScheduler:
         # deadline instead of being quorum-dropped. None (the default)
         # keeps the reference projection path bit-exactly.
         self.adaptive = adaptive
+        # Durable control plane (ft.durable): a RESTARTED scheduler
+        # (generation >= 2) stamps its generation + the round into every
+        # response, so workers can drop a zombie predecessor's stale
+        # Continue/ScheduleUpdate. None — a never-restarted scheduler, the
+        # only value the off path ever sees — keeps the frozen singleton
+        # responses and today's exact wire bytes.
+        self.generation = generation
         # End-to-end round tracing (telemetry.trace): the scheduler owns
         # the per-round ROOT span — opened when a round starts, closed
         # when it advances — whose context rides SCHEDULE_UPDATE down to
@@ -92,6 +102,41 @@ class BatchScheduler:
 
     # ------------------------------------------------------------------
     def on_progress(self, peer: str, progress: Progress) -> ProgressResponse:
+        sender_gen = getattr(progress, "scheduler_generation", None)
+        if sender_gen is not None and (
+            self.generation is None or sender_gen > self.generation
+        ):
+            # Split-brain guard: this message was addressed to a NEWER
+            # scheduler generation — WE are the zombie (a partitioned
+            # predecessor still answering after its successor adopted the
+            # job). Refusing is the only safe move: an old generation's
+            # Continue/ScheduleUpdate acted on here would race the live
+            # scheduler's control decisions. `self.generation is None`
+            # counts too: senders only stamp after adopting generation
+            # >= 2, so an UNSTAMPED scheduler receiving stamped traffic is
+            # the generation-1 predecessor — the most common zombie (a
+            # never-restarted job's workers never stamp, so the off path
+            # cannot reach this branch).
+            FT_METRICS.stale_generation_dropped.add(1)
+            return ProgressResponse(
+                kind=ProgressResponseKind.ERROR,
+                message=(
+                    f"stale scheduler generation {self.generation or 1} "
+                    f"(sender adopted {sender_gen})"
+                ),
+            )
+        return self._stamp(self._on_progress(peer, progress))
+
+    def _stamp(self, resp: ProgressResponse) -> ProgressResponse:
+        """Generation-stamp one response (no-op pre-restart: the off path
+        keeps the shared frozen singletons byte-for-byte)."""
+        if self.generation is None:
+            return resp
+        return dataclasses.replace(
+            resp, generation=self.generation, round=self.tracker.round
+        )
+
+    def _on_progress(self, peer: str, progress: Progress) -> ProgressResponse:
         kind = progress.kind
         if kind == ProgressKind.STATUS:
             return self._on_status(peer, progress)
@@ -145,6 +190,50 @@ class BatchScheduler:
         if tracing is not None and self._round_span is not None:
             tracing.finish(self._round_span)
         self._round_span = None
+
+    # ------------------------------------------------------------------
+    def adopt_round(
+        self,
+        base_round: int,
+        shard_rounds: dict[int, int] | None = None,
+        ctrl: dict | None = None,
+    ) -> int:
+        """Fast-forward to the fleet's TRUE round after a scheduler restart.
+
+        ``base_round`` is the journal's last recorded frontier;
+        ``shard_rounds`` maps each adopted PS shard to the next round IT
+        will close (its AdoptAck) — every owned round below that is an
+        UPDATED the predecessor already processed (or that died with it),
+        so it is credited here and the frontier re-advances exactly as the
+        live notifies would have moved it. Fast-forward only: a shard
+        behind the journal (impossible for a committed round, but a torn
+        round record can over-read by one) never rewinds the frontier.
+        ``ctrl`` is the journaled StragglerController snapshot — the
+        rebuilt controller resumes its measured EWMA history, in WARMUP
+        (no assignments, no drop penalty, until one full measured round).
+        Returns the adopted round.
+        """
+        epochs = self.tracker.update_epochs
+        while self.tracker.round < min(base_round, epochs):
+            self.tracker.advance_round()
+        horizon = max(
+            [self.tracker.round] + [int(r) for r in (shard_rounds or {}).values()]
+        )
+        for shard, reported in (shard_rounds or {}).items():
+            for rnd in range(self.tracker.round, min(int(reported), epochs)):
+                if shard in self._due(rnd):
+                    self._updated.setdefault(rnd, set()).add(shard)
+        while (
+            self.tracker.round < min(horizon, epochs)
+            and self._updated.get(self.tracker.round, set())
+            >= self._due(self.tracker.round)
+        ):
+            self._updated.pop(self.tracker.round, None)
+            self.tracker.advance_round()
+        if self.adaptive is not None:
+            self.adaptive.resume_warmup(self.tracker.round, ctrl)
+        self._round_tp()  # rotate the root span onto the adopted round
+        return self.tracker.round
 
     # ------------------------------------------------------------------
     def _due(self, round_num: int) -> set:

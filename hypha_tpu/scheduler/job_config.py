@@ -144,6 +144,17 @@ class DiLoCoJob:
     # job codec, [lo, hi) degrades to int8, < lo to int4.
     codec_bw_hi_mbps: float = 100.0
     codec_bw_lo_mbps: float = 10.0
+    # Durable control plane (ft.durable DurableScheduler; needs
+    # checkpoint_dir + ft): the scheduler journals its plan, dispatches,
+    # round frontier and membership under <checkpoint_dir>/scheduler. A
+    # restarted scheduler (same peer id) replays the journal under a
+    # bumped generation and RE-ADOPTS the live executions in place — the
+    # SchedulerHello/AdoptAck handshake fast-forwards it to the fleet's
+    # true round instead of re-auctioning, so an outage shorter than a
+    # round costs nothing. Workers park their control sends and hold
+    # their leases for the adoption grace. Off (default) ships today's
+    # exact wire and behavior.
+    scheduler_recovery: bool = False
 
     def __post_init__(self) -> None:
         if self.delta_dtype not in ("float32", "bfloat16"):
@@ -212,6 +223,16 @@ class DiLoCoJob:
             )
         if self.codec_bw_lo_mbps > self.codec_bw_hi_mbps:
             raise ValueError("codec_bw_lo_mbps must be <= codec_bw_hi_mbps")
+        if self.scheduler_recovery and not self.checkpoint_dir:
+            raise ValueError(
+                "scheduler_recovery needs a checkpoint_dir (the scheduler "
+                "journal lives there)"
+            )
+        if self.scheduler_recovery and (self.ft is None or not self.ft.enabled):
+            raise ValueError(
+                "scheduler_recovery needs elastic membership (job.ft) — "
+                "re-adoption rides the same lease/quorum machinery"
+            )
         if self.rounds.update_rounds <= 0:
             raise ValueError("update_rounds must be positive")
         if self.rounds.avg_samples_between_updates <= 0:

@@ -42,11 +42,17 @@ from __future__ import annotations
 import asyncio
 import logging
 import uuid
+from pathlib import Path
 from typing import Any
 
 from .. import aio, messages
 from ..ft.adaptive import StragglerController
 from ..ft.detector import PhiAccrualDetector
+from ..ft.durable import (
+    DEFAULT_ADOPT_DEADLINE_S,
+    DEFAULT_ADOPT_GRACE_S,
+    DurableScheduler,
+)
 from ..ft.membership import (
     PROTOCOL_FT,
     FTConfig,
@@ -56,8 +62,10 @@ from ..ft.membership import (
 )
 from ..messages import (
     AGGREGATE_EXECUTOR_NAME,
+    PROTOCOL_API,
     PROTOCOL_PROGRESS,
     TRAIN_EXECUTOR_NAME,
+    AdoptAck,
     AggregateExecutorConfig,
     DataRecord,
     Executor,
@@ -67,6 +75,7 @@ from ..messages import (
     Progress,
     Receive,
     Reference,
+    SchedulerHello,
     Send,
     ShardMap,
     TrainExecutorConfig,
@@ -74,6 +83,8 @@ from ..messages import (
 )
 from ..network.node import Node, RequestError
 from ..stream import placement_parts, shards_due_at
+from ..telemetry import trace
+from ..telemetry.flight import FLIGHT
 from ..telemetry.ft_metrics import FT_METRICS
 from .allocator import GreedyWorkerAllocator
 from .batch_scheduler import BatchScheduler
@@ -85,7 +96,13 @@ from .task import DispatchError, StatusRouter, Task
 from .trackers import ProgressTracker, WorkerState
 from .worker_handle import WorkerHandle
 
-__all__ = ["Orchestrator", "JobResult", "JobFailed", "AllocationError"]
+__all__ = [
+    "Orchestrator",
+    "JobResult",
+    "JobFailed",
+    "AllocationError",
+    "AdoptionFailed",
+]
 
 log = logging.getLogger("hypha.scheduler.orchestrator")
 
@@ -104,6 +121,12 @@ class AllocationError(RuntimeError):
 
 class JobFailed(RuntimeError):
     pass
+
+
+class AdoptionFailed(RuntimeError):
+    """Scheduler crash recovery could not adopt the previous attempt's
+    executions (no/unreadable journal, or nothing alive to adopt). The
+    caller falls back to the existing fresh-run / re-auction path."""
 
 
 class JobResult:
@@ -140,6 +163,7 @@ class _RunContext:
         # being restarted.
         self.ps_handles: list[WorkerHandle | None] = []
         self.ps_job_ids: list[str] = []
+        self.ps_peers: list[str] = []  # planned shard peer ids (index = shard)
         self.shard_tags: list[str] = []
         self.shard_map: ShardMap | None = None
         self.reduce_groups: list[list[str]] = []
@@ -166,6 +190,15 @@ class _RunContext:
         self.ps_specs: list[JobSpec] = []
         self.ps_restarts = 0
         self.ps_restarting: set[int] = set()
+        # Scheduler crash recovery (ft.durable DurableScheduler): the
+        # control plane's own journal (None when job.scheduler_recovery is
+        # off), the adoption grace stamped into dispatched specs, the
+        # BatchScheduler (held for round journaling + adoption), and the
+        # last journaled round frontier.
+        self.dur: "DurableScheduler | None" = None
+        self.adopt_grace: float | None = None
+        self.batch_scheduler: "BatchScheduler | None" = None
+        self.round_journaled = -1
 
 
 class Orchestrator:
@@ -303,6 +336,7 @@ class Orchestrator:
         if max_attempts < 1:
             raise ValueError("max_attempts must be >= 1")
         last: JobFailed | AllocationError | None = None
+        sched_root = self._scheduler_root(job)
         for attempt in range(max_attempts):
             if attempt:
                 log.warning(
@@ -310,6 +344,32 @@ class Orchestrator:
                     attempt, max_attempts, last, retry_backoff,
                 )
                 await asyncio.sleep(retry_backoff)
+            # Scheduler crash recovery (ft.durable): a journal left by a
+            # dead predecessor means live executions may still be training
+            # — adopt them in place instead of re-auctioning. Any adoption
+            # failure (no/corrupt journal, nothing alive) falls back to
+            # the fresh-run path below, which wipes the stale journal.
+            if (
+                attempt == 0
+                and sched_root is not None
+                and DurableScheduler.has_state(sched_root)
+            ):
+                try:
+                    result = await self._resume_once(
+                        job,
+                        auction_timeout=auction_timeout,
+                        status_timeout=status_timeout,
+                    )
+                    result.attempt = attempt
+                    return result
+                except AdoptionFailed as e:
+                    log.warning(
+                        "scheduler recovery could not adopt the previous "
+                        "attempt (%s); falling back to a fresh run", e,
+                    )
+                except (JobFailed, AllocationError) as e:
+                    last = e
+                    continue
             try:
                 result = await self._run_once(
                     job,
@@ -323,6 +383,17 @@ class Orchestrator:
                 last = e
         assert last is not None
         raise last
+
+    @staticmethod
+    def _scheduler_root(job: DiLoCoJob) -> Path | None:
+        if (
+            getattr(job, "scheduler_recovery", False)
+            and job.checkpoint_dir
+            and job.ft is not None
+            and job.ft.enabled
+        ):
+            return Path(job.checkpoint_dir) / "scheduler"
+        return None
 
     # ------------------------------------------------------------- job specs
 
@@ -389,6 +460,11 @@ class Orchestrator:
                     sync_mode=job.sync_mode,
                     fragments=job.num_fragments,
                     rejoin=rejoin,
+                    # Durable control plane: workers park control sends and
+                    # hold leases this long across a scheduler outage
+                    # (None — recovery off — ships no new wire field).
+                    # getattr: tests drive this with bare namespace ctxs.
+                    adopt_grace_s=getattr(ctx, "adopt_grace", None),
                     checkpoint=(
                         {
                             "dir": f"{job.checkpoint_dir}/{handle.peer_id}",
@@ -400,6 +476,290 @@ class Orchestrator:
                 ),
             ),
         )
+
+    def _plan_streams(
+        self,
+        ctx: _RunContext,
+        job: DiLoCoJob,
+        worker_peers: list[str],
+        ps_peers: list[str],
+        num_shards: int,
+        parts: int,
+    ) -> None:
+        """Derive the attempt's stream identities from its peer lists:
+        job-unique tags, per-shard job ids/tags, the deterministic
+        tree-reduce grouping and the ShardMap placement, and the per-shard
+        aggregate specs. Pure function of (base_id, peers, job) — which is
+        exactly why a restarted scheduler can rebuild all of it from the
+        journaled plan record instead of persisting every spec."""
+        ctx.ps_peers = list(ps_peers)
+        ctx.updates_tag = f"updates:{ctx.base_id}"
+        ctx.results_tag = f"results:{ctx.base_id}"
+        if num_shards == 1:
+            ctx.shard_tags = [ctx.updates_tag]
+            ctx.ps_job_ids = [f"{ctx.base_id}-ps"]
+        else:
+            ctx.shard_tags = [
+                f"{ctx.updates_tag}.s{k}" for k in range(num_shards)
+            ]
+            ctx.ps_job_ids = [
+                f"{ctx.base_id}-ps{k}" for k in range(num_shards)
+            ]
+        # Tree-reduce plan: deterministic sorted-peer-id chunks; the
+        # first member of each group is its reducer. Singleton groups
+        # are dropped (nothing to pre-fold).
+        group_size = int(getattr(job, "reduce_group_size", 0) or 0)
+        ctx.reduce_groups = []
+        if group_size >= 2:
+            ordered = sorted(worker_peers)
+            ctx.reduce_groups = [
+                g
+                for g in (
+                    ordered[i : i + group_size]
+                    for i in range(0, len(ordered), group_size)
+                )
+                if len(g) >= 2
+            ]
+        # The placement announcement workers route by. Built for any
+        # sharded OR tree-reduced job; plain single-PS jobs ship None
+        # and keep the exact pre-shard wire.
+        ctx.shard_map = None
+        if num_shards > 1 or ctx.reduce_groups:
+            ctx.shard_map = ShardMap(
+                round=0,
+                shards=list(ps_peers),
+                tags=list(ctx.shard_tags),
+                fragments=parts,
+                groups=[list(g) for g in ctx.reduce_groups],
+            )
+        ft = ctx.ft
+        ctx.ps_specs = [
+            JobSpec(
+                job_id=ctx.ps_job_ids[k],
+                executor=Executor(
+                    kind="aggregate",
+                    name=AGGREGATE_EXECUTOR_NAME,
+                    aggregate=AggregateExecutorConfig(
+                        updates=Receive(
+                            Reference.from_peers(
+                                worker_peers, ctx.shard_tags[k]
+                            )
+                        ),
+                        results=Send(
+                            Reference.from_peers(
+                                worker_peers, ctx.results_tag
+                            )
+                        ),
+                        optimizer=job.outer_optimizer,
+                        num_workers=len(worker_peers),
+                        checkpoint_dir=(
+                            (
+                                f"{job.checkpoint_dir}/ps"
+                                if num_shards == 1
+                                else f"{job.checkpoint_dir}/ps{k}"
+                            )
+                            if job.checkpoint_dir
+                            else None
+                        ),
+                        ps_checkpoint_every_rounds=job.ps_checkpoint_every_rounds,
+                        quorum_fraction=ft.quorum_fraction if ft else 0.0,
+                        round_deadline_s=ft.round_deadline_s if ft else 0.0,
+                        # The broadcast mirrors the upload codec: the
+                        # receive side sniffs frames, so one field is
+                        # enough for both directions.
+                        delta_codec=job.delta_codec,
+                        # Workers and the PS must agree on the fragment
+                        # schedule, so both sides get the same pair.
+                        sync_mode=job.sync_mode,
+                        fragments=job.num_fragments,
+                        shard_index=k,
+                        num_ps_shards=num_shards,
+                        # WAN-adaptive knobs (ft.adaptive): None — not
+                        # False — when off, so a static job's dispatched
+                        # spec carries no new wire fields at all.
+                        adaptive_steps=(
+                            True if getattr(job, "adaptive_steps", False)
+                            else None
+                        ),
+                        adaptive_codec=(
+                            True if getattr(job, "adaptive_codec", False)
+                            else None
+                        ),
+                        codec_bw_hi_mbps=(
+                            job.codec_bw_hi_mbps
+                            if getattr(job, "adaptive_codec", False)
+                            else None
+                        ),
+                        codec_bw_lo_mbps=(
+                            job.codec_bw_lo_mbps
+                            if getattr(job, "adaptive_codec", False)
+                            else None
+                        ),
+                        # Durable control plane: the PS parks its Updated
+                        # notify (broadcast-first) across a scheduler
+                        # outage (None = recovery off, no new wire).
+                        adopt_grace_s=ctx.adopt_grace,
+                    ),
+                ),
+            )
+            for k in range(num_shards)
+        ]
+
+    def _plan_record(self, ctx: _RunContext, ps_peers: list[str]) -> dict:
+        """The journaled plan: what :meth:`_plan_streams` cannot re-derive
+        (base id, peer lists) plus the lease/batch bindings adoption needs."""
+        return {
+            "base_id": ctx.base_id,
+            "workers": {
+                peer: {
+                    "lease_id": handle.lease_id,
+                    "batch_size": handle.batch_size,
+                }
+                for peer, handle in ctx.handles.items()
+            },
+            "ps_peers": list(ps_peers),
+        }
+
+    async def _journal_dispatch(
+        self,
+        ctx: _RunContext,
+        job_id: str,
+        handle: WorkerHandle,
+        kind: str,
+        shard: int | None = None,
+    ) -> None:
+        if getattr(ctx, "dur", None) is None:
+            return
+        # Off-loop like every other journal write: note_dispatch fsyncs,
+        # and the journal lock may be held across a compaction rewrite —
+        # neither may stall progress responses or lease renewals.
+        await asyncio.to_thread(
+            ctx.dur.note_dispatch,
+            job_id,
+            handle.peer_id,
+            handle.lease_id,
+            kind,
+            shard,
+            handle.batch_size or None,
+        )
+
+    def _journal_round_soon(self, ctx: _RunContext) -> None:
+        """Journal a round-frontier advance off-loop (fire-and-forget like
+        the membership pushes: a torn/lost round record costs re-deriving
+        one round from AdoptAcks, never correctness)."""
+        if getattr(ctx, "dur", None) is None or ctx.tracker is None:
+            return
+        if ctx.tracker.round <= ctx.round_journaled:
+            return
+        ctx.round_journaled = ctx.tracker.round
+        ctrl = ctx.adaptive.snapshot() if ctx.adaptive is not None else None
+        aio.spawn(
+            asyncio.to_thread(ctx.dur.note_round, ctx.tracker.round, ctrl),
+            tasks=ctx.notify_tasks,
+            what="scheduler journal round",
+            logger=log,
+        )
+
+    async def _start_data(self, ctx: _RunContext, job: DiLoCoJob) -> None:
+        """Dataset discovery + slice scheduler
+        (hypha-scheduler.rs:269,435-457). Re-run as-is on scheduler
+        recovery: provider records live in the registry, not the journal."""
+        raw = await self.node.get_record(job.dataset)
+        if raw is None:
+            raise JobFailed(f"no data record for dataset {job.dataset!r}")
+        record = messages.decode(raw)
+        if not isinstance(record, DataRecord):
+            raise JobFailed(f"bad data record {record!r}")
+        providers = await self.node.find_providers(job.dataset)
+        if not providers:
+            raise JobFailed(f"no provider for dataset {job.dataset!r}")
+        ctx.data_scheduler = DataScheduler(
+            self.node, providers[0], job.dataset, record.num_slices
+        )
+        ctx.data_scheduler.start()
+
+    def _make_adaptive(self, ctx: _RunContext, job: DiLoCoJob) -> None:
+        if not getattr(job, "adaptive_steps", False):
+            return
+        # Base inner-step count: the round's sample budget spread
+        # over one aggregate sweep of the fleet's batch sizes —
+        # what a uniform pool would run per worker per round.
+        total_batch = sum(h.batch_size for h in ctx.handles.values())
+        ctx.adaptive = StragglerController(
+            base_steps=max(
+                1,
+                round(
+                    job.rounds.avg_samples_between_updates
+                    / max(total_batch, 1)
+                ),
+            )
+        )
+
+    def _start_control(
+        self,
+        ctx: _RunContext,
+        job: DiLoCoJob,
+        num_shards: int,
+        parts: int,
+        generation: int | None = None,
+    ):
+        """Stand up the DiLoCo control plane: BatchScheduler + the
+        /hypha-progress handler. ``generation`` is None for a fresh run
+        (unstamped responses, today's exact wire) and the bumped scheduler
+        generation on recovery. Returns (collected_metrics, registration)."""
+        ctx.complete = asyncio.Event()
+        collected: list = []
+        ctx.activity = [asyncio.get_running_loop().time()]  # watchdog feed
+
+        def on_metrics(peer: str, round_num: int, metrics: dict) -> None:
+            collected.append((peer, round_num, metrics))
+            self.metrics_bridge.on_metrics(peer, round_num, metrics)
+
+        batch_scheduler = BatchScheduler(
+            ctx.tracker, on_metrics=on_metrics, on_complete=ctx.complete.set,
+            shards_due=(
+                (
+                    lambda r: shards_due_at(
+                        job.sync_mode, r, parts, num_shards
+                    )
+                )
+                if num_shards > 1
+                else None
+            ),
+            adaptive=ctx.adaptive,
+            generation=generation,
+        )
+        ctx.batch_scheduler = batch_scheduler
+
+        async def on_progress(peer: str, progress: Progress):
+            ctx.activity[0] = asyncio.get_running_loop().time()
+            if ctx.detector is not None:
+                # Every progress message is a liveness signal — per-batch
+                # Status heartbeats mostly, but the PS's Updated and the
+                # round metrics count too.
+                ctx.detector.heartbeat(peer)
+            response = batch_scheduler.on_progress(peer, progress)
+            self._journal_round_soon(ctx)
+            if (
+                ctx.adaptive is not None
+                and ctx.membership is not None
+                and ctx.tracker is not None
+                and ctx.tracker.round > ctx.assign_published
+            ):
+                # A round advanced: publish the fresh per-worker
+                # inner-step assignment with the round membership so
+                # the PS can account expected contributions (and the
+                # HET telemetry gauges follow). Fire-and-forget like
+                # every other membership push — a lost snapshot is
+                # repaired by the next one.
+                ctx.assign_published = ctx.tracker.round
+                self._notify_membership_soon(ctx)
+            return response
+
+        progress_reg = self.node.on(PROTOCOL_PROGRESS, Progress).respond_with(
+            on_progress
+        )
+        return collected, progress_reg
 
     async def _run_once(
         self,
@@ -418,6 +778,13 @@ class Orchestrator:
         ctx.ft = ft
         ctx.status_timeout = status_timeout
         ctx.auction_timeout = auction_timeout
+        if self._scheduler_root(job) is not None:
+            assert ft is not None
+            ctx.adopt_grace = (
+                ft.scheduler_adopt_grace_s
+                if ft.scheduler_adopt_grace_s is not None
+                else DEFAULT_ADOPT_GRACE_S
+            )
         progress_reg = None
         tasks: list[Task] = []
         try:
@@ -447,22 +814,7 @@ class Orchestrator:
                     job.rounds.max_batch_size,
                 )
 
-            # Dataset discovery (hypha-scheduler.rs:269,435-457).
-            raw = await self.node.get_record(job.dataset)
-            if raw is None:
-                raise JobFailed(f"no data record for dataset {job.dataset!r}")
-            record = messages.decode(raw)
-            if not isinstance(record, DataRecord):
-                raise JobFailed(f"bad data record {record!r}")
-            providers = await self.node.find_providers(job.dataset)
-            if not providers:
-                raise JobFailed(f"no provider for dataset {job.dataset!r}")
-            provider = providers[0]
-
-            ctx.data_scheduler = DataScheduler(
-                self.node, provider, job.dataset, record.num_slices
-            )
-            ctx.data_scheduler.start()
+            await self._start_data(ctx, job)
 
             ctx.tracker = ProgressTracker(
                 parameter_server=[h.peer_id for h in ctx.ps_handles],
@@ -478,197 +830,51 @@ class Orchestrator:
                 for handle in ctx.handles.values():
                     handle.on_renew = ctx.detector.heartbeat
 
-            ctx.complete = asyncio.Event()
-            collected: list = []
-            ctx.activity = [asyncio.get_running_loop().time()]  # watchdog feed
-
-            def on_metrics(peer: str, round_num: int, metrics: dict) -> None:
-                collected.append((peer, round_num, metrics))
-                self.metrics_bridge.on_metrics(peer, round_num, metrics)
-
             parts = placement_parts(
                 job.sync_mode, job.num_fragments, num_shards
             )
-            if getattr(job, "adaptive_steps", False):
-                # Base inner-step count: the round's sample budget spread
-                # over one aggregate sweep of the fleet's batch sizes —
-                # what a uniform pool would run per worker per round.
-                total_batch = sum(h.batch_size for h in ctx.handles.values())
-                ctx.adaptive = StragglerController(
-                    base_steps=max(
-                        1,
-                        round(
-                            job.rounds.avg_samples_between_updates
-                            / max(total_batch, 1)
-                        ),
-                    )
-                )
-            batch_scheduler = BatchScheduler(
-                ctx.tracker, on_metrics=on_metrics, on_complete=ctx.complete.set,
-                shards_due=(
-                    (
-                        lambda r: shards_due_at(
-                            job.sync_mode, r, parts, num_shards
-                        )
-                    )
-                    if num_shards > 1
-                    else None
-                ),
-                adaptive=ctx.adaptive,
-            )
-
-            async def on_progress(peer: str, progress: Progress):
-                ctx.activity[0] = asyncio.get_running_loop().time()
-                if ctx.detector is not None:
-                    # Every progress message is a liveness signal — per-batch
-                    # Status heartbeats mostly, but the PS's Updated and the
-                    # round metrics count too.
-                    ctx.detector.heartbeat(peer)
-                response = batch_scheduler.on_progress(peer, progress)
-                if (
-                    ctx.adaptive is not None
-                    and ctx.membership is not None
-                    and ctx.tracker is not None
-                    and ctx.tracker.round > ctx.assign_published
-                ):
-                    # A round advanced: publish the fresh per-worker
-                    # inner-step assignment with the round membership so
-                    # the PS can account expected contributions (and the
-                    # HET telemetry gauges follow). Fire-and-forget like
-                    # every other membership push — a lost snapshot is
-                    # repaired by the next one.
-                    ctx.assign_published = ctx.tracker.round
-                    self._notify_membership_soon(ctx)
-                return response
-
-            progress_reg = self.node.on(PROTOCOL_PROGRESS, Progress).respond_with(
-                on_progress
+            self._make_adaptive(ctx, job)
+            collected, progress_reg = self._start_control(
+                ctx, job, num_shards, parts
             )
 
             ctx.router = StatusRouter(self.node)
             ctx.base_id = str(uuid.uuid4())
             worker_peers = list(ctx.handles)
+            ps_peers = [h.peer_id for h in ctx.ps_handles]
             # Job-unique stream tags: push routing keys on these, so several
             # jobs (or a PS colocated with a train job) can share worker
             # nodes without consuming each other's tensor streams. With N
             # shards, each shard gets its OWN updates tag so colocated
             # shard executors never consume each other's parts.
-            ctx.updates_tag = f"updates:{ctx.base_id}"
-            ctx.results_tag = f"results:{ctx.base_id}"
-            if num_shards == 1:
-                ctx.shard_tags = [ctx.updates_tag]
-                ctx.ps_job_ids = [f"{ctx.base_id}-ps"]
-            else:
-                ctx.shard_tags = [
-                    f"{ctx.updates_tag}.s{k}" for k in range(num_shards)
-                ]
-                ctx.ps_job_ids = [
-                    f"{ctx.base_id}-ps{k}" for k in range(num_shards)
-                ]
-
-            # Tree-reduce plan: deterministic sorted-peer-id chunks; the
-            # first member of each group is its reducer. Singleton groups
-            # are dropped (nothing to pre-fold).
-            group_size = int(getattr(job, "reduce_group_size", 0) or 0)
-            if group_size >= 2:
-                ordered = sorted(worker_peers)
-                ctx.reduce_groups = [
-                    g
-                    for g in (
-                        ordered[i : i + group_size]
-                        for i in range(0, len(ordered), group_size)
-                    )
-                    if len(g) >= 2
-                ]
-            # The placement announcement workers route by. Built for any
-            # sharded OR tree-reduced job; plain single-PS jobs ship None
-            # and keep the exact pre-shard wire.
-            if num_shards > 1 or ctx.reduce_groups:
-                ctx.shard_map = ShardMap(
-                    round=0,
-                    shards=[h.peer_id for h in ctx.ps_handles],
-                    tags=list(ctx.shard_tags),
-                    fragments=parts,
-                    groups=[list(g) for g in ctx.reduce_groups],
+            self._plan_streams(
+                ctx, job, worker_peers, ps_peers, num_shards, parts
+            )
+            sched_root = self._scheduler_root(job)
+            if sched_root is not None:
+                # Durable control plane: open FRESH (a previous attempt's
+                # journal must not be adopted against this attempt's
+                # executions) and persist the plan before anything runs.
+                ctx.dur = await asyncio.to_thread(
+                    lambda: DurableScheduler.open(sched_root, fresh=True)
                 )
-
-            ctx.ps_specs = [
-                JobSpec(
-                    job_id=ctx.ps_job_ids[k],
-                    executor=Executor(
-                        kind="aggregate",
-                        name=AGGREGATE_EXECUTOR_NAME,
-                        aggregate=AggregateExecutorConfig(
-                            updates=Receive(
-                                Reference.from_peers(
-                                    worker_peers, ctx.shard_tags[k]
-                                )
-                            ),
-                            results=Send(
-                                Reference.from_peers(
-                                    worker_peers, ctx.results_tag
-                                )
-                            ),
-                            optimizer=job.outer_optimizer,
-                            num_workers=len(worker_peers),
-                            checkpoint_dir=(
-                                (
-                                    f"{job.checkpoint_dir}/ps"
-                                    if num_shards == 1
-                                    else f"{job.checkpoint_dir}/ps{k}"
-                                )
-                                if job.checkpoint_dir
-                                else None
-                            ),
-                            ps_checkpoint_every_rounds=job.ps_checkpoint_every_rounds,
-                            quorum_fraction=ft.quorum_fraction if ft else 0.0,
-                            round_deadline_s=ft.round_deadline_s if ft else 0.0,
-                            # The broadcast mirrors the upload codec: the
-                            # receive side sniffs frames, so one field is
-                            # enough for both directions.
-                            delta_codec=job.delta_codec,
-                            # Workers and the PS must agree on the fragment
-                            # schedule, so both sides get the same pair.
-                            sync_mode=job.sync_mode,
-                            fragments=job.num_fragments,
-                            shard_index=k,
-                            num_ps_shards=num_shards,
-                            # WAN-adaptive knobs (ft.adaptive): None — not
-                            # False — when off, so a static job's dispatched
-                            # spec carries no new wire fields at all.
-                            adaptive_steps=(
-                                True if getattr(job, "adaptive_steps", False)
-                                else None
-                            ),
-                            adaptive_codec=(
-                                True if getattr(job, "adaptive_codec", False)
-                                else None
-                            ),
-                            codec_bw_hi_mbps=(
-                                job.codec_bw_hi_mbps
-                                if getattr(job, "adaptive_codec", False)
-                                else None
-                            ),
-                            codec_bw_lo_mbps=(
-                                job.codec_bw_lo_mbps
-                                if getattr(job, "adaptive_codec", False)
-                                else None
-                            ),
-                        ),
-                    ),
+                await asyncio.to_thread(
+                    ctx.dur.note_plan, self._plan_record(ctx, ps_peers)
                 )
-                for k in range(num_shards)
-            ]
             for k, spec in enumerate(ctx.ps_specs):
                 ps_task = await Task.dispatch(
                     self.node, ctx.router, spec, [ctx.ps_handles[k]]
                 )
                 tasks.append(ps_task)
+                await self._journal_dispatch(
+                    ctx, spec.job_id, ctx.ps_handles[k], "aggregate", shard=k
+                )
             for i, (peer, handle) in enumerate(ctx.handles.items()):
                 spec = self._train_spec(ctx, f"w{i}", handle)
                 tasks.append(
                     await Task.dispatch(self.node, ctx.router, spec, [handle])
                 )
+                await self._journal_dispatch(ctx, spec.job_id, handle, "train")
 
             await self._supervise(ctx, tasks)
             ft_summary = None
@@ -681,10 +887,397 @@ class Orchestrator:
                     "departed": snap.departed,
                     "rejoins": ctx.rejoin_count,
                 }
+            if ctx.dur is not None:
+                # A finished job's journal must not be adopted by the next
+                # run against executions that no longer exist.
+                await asyncio.to_thread(ctx.dur.complete)
             return JobResult(ctx.base_id, ctx.tracker.round, collected, ft=ft_summary)
         finally:
             for task in ctx.notify_tasks:
                 task.cancel()
+            if ctx.notify_tasks:
+                await asyncio.gather(
+                    *list(ctx.notify_tasks), return_exceptions=True
+                )
+            if ctx.dur is not None:
+                await asyncio.to_thread(ctx.dur.close)
+            if progress_reg is not None:
+                progress_reg.close()
+            if ctx.data_scheduler is not None:
+                ctx.data_scheduler.stop()
+            if ctx.router is not None:
+                ctx.router.close()
+            for handle in ctx.handles.values():
+                await handle.release()
+            for ps_handle in ctx.ps_handles:
+                if ps_handle is not None:
+                    await ps_handle.release()
+            await self.metrics_bridge.close()
+
+    # --------------------------------------------------- scheduler recovery
+
+    async def _adopt_executions(
+        self,
+        ctx: _RunContext,
+        records: dict[str, dict],
+        round_hint: int,
+        deadline_s: float,
+        clock=None,
+    ) -> dict[str, AdoptAck]:
+        """Run the SchedulerHello/AdoptAck handshake on the existing
+        executor channels.
+
+        ``records`` maps job id → its latest journaled dispatch record.
+        Peers are re-asked with backoff until they answer or ``deadline_s``
+        passes (injectable ``clock`` pins the deadline in tests without
+        real waiting); a definitive answer — ``running``, ``gone`` or
+        ``stale`` — stops the asking. Whatever is still unanswered at the
+        deadline is handed to the caller's fallback: the existing
+        depart/rejoin and per-shard ps-restart re-auction paths.
+        """
+        assert ctx.dur is not None
+        loop = asyncio.get_running_loop()
+        now = clock or loop.time
+        stop_at = now() + max(deadline_s, 0.0)
+        acks: dict[str, AdoptAck] = {}
+        pending = dict(records)
+
+        async def ask(
+            job_id: str, rec: dict, timeout: float
+        ) -> "tuple[str, AdoptAck | None]":
+            hello = SchedulerHello(
+                generation=ctx.dur.generation,
+                job_id=job_id,
+                round=round_hint,
+            )
+            span = trace.begin(
+                "adopt", attrs={"job": job_id, "round": round_hint},
+                node="scheduler",
+            )
+            try:
+                resp = await self.node.request(
+                    str(rec.get("peer", "")), PROTOCOL_API, hello,
+                    timeout=timeout,
+                )
+            except (RequestError, OSError, asyncio.TimeoutError) as e:
+                trace.finish(span, ok=False)
+                log.info("adoption hello for %s failed: %s", job_id, e)
+                return job_id, None
+            if not isinstance(resp, AdoptAck):
+                trace.finish(span, ok=False)
+                return job_id, None
+            trace.finish(span, ok=resp.state == "running")
+            return job_id, resp
+
+        first_pass = True
+        while pending and (first_pass or now() < stop_at):
+            first_pass = False
+            # Fan the sweep out (the hellos are independent) and bound
+            # each request by the REMAINING deadline: a serial sweep over
+            # N dead peers would overshoot the adoption deadline N-fold
+            # and delay the re-auction fallback by the same factor.
+            timeout = min(5.0, max(stop_at - now(), 0.5))
+            results = await asyncio.gather(
+                *(
+                    ask(job_id, rec, timeout)
+                    for job_id, rec in pending.items()
+                )
+            )
+            for job_id, resp in results:
+                if resp is None:
+                    continue
+                acks[job_id] = resp
+                rec = pending.pop(job_id, None) or {}
+                if resp.state == "running":
+                    FT_METRICS.adopted_executions.add(1)
+                FLIGHT.record(
+                    "scheduler.adopt_ack", node="scheduler", job=job_id,
+                    peer=str(rec.get("peer", "")), state=resp.state,
+                    round=resp.round, epoch=resp.epoch,
+                )
+            if pending and now() < stop_at:
+                await asyncio.sleep(0.3)
+        return acks
+
+    async def _resume_once(
+        self,
+        job: DiLoCoJob,
+        *,
+        auction_timeout: float = 2.0,
+        status_timeout: float | None = None,
+    ) -> JobResult:
+        """Adopt a dead predecessor's executions instead of re-auctioning.
+
+        The journal supplies the plan (base id → every stream identity is
+        re-derived), the live dispatch records and the last round
+        frontier; the fleet supplies the truth — each AdoptAck reports the
+        execution's actual round, so the scheduler FAST-FORWARDS to where
+        training already is (a quorate round that closed during the outage
+        is never re-run). Executions that fail the lease re-arm or never
+        ack within the adoption deadline fall back to the existing
+        depart/rejoin (train) and per-shard restart (PS) re-auction paths
+        once supervision starts.
+        """
+        ft = job.ft if (job.ft is not None and job.ft.enabled) else None
+        sched_root = self._scheduler_root(job)
+        assert ft is not None and sched_root is not None
+        try:
+            dur = await asyncio.to_thread(
+                lambda: DurableScheduler.open(sched_root)
+            )
+        except Exception as e:
+            raise AdoptionFailed(f"scheduler journal unreadable: {e}") from e
+        if dur.resume is None:
+            await asyncio.to_thread(dur.close)
+            raise AdoptionFailed("journal holds no adoptable plan")
+        res = dur.resume
+        ctx = _RunContext()
+        ctx.job = job
+        ctx.ft = ft
+        ctx.dur = dur
+        ctx.status_timeout = status_timeout
+        ctx.auction_timeout = auction_timeout
+        ctx.adopt_grace = (
+            ft.scheduler_adopt_grace_s
+            if ft.scheduler_adopt_grace_s is not None
+            else DEFAULT_ADOPT_GRACE_S
+        )
+        ctx.base_id = res.base_id
+        ctx.rejoin_count = res.rejoins
+        ctx.ps_restarts = res.ps_restarts
+        num_shards = max(int(getattr(job, "num_ps_shards", 1) or 1), 1)
+        parts = placement_parts(job.sync_mode, job.num_fragments, num_shards)
+        plan = res.plan
+        plan_workers: dict = dict(plan.get("workers") or {})
+        ps_peers = [str(p) for p in (plan.get("ps_peers") or [])]
+        if not plan_workers or len(ps_peers) != num_shards:
+            await asyncio.to_thread(dur.close)
+            raise AdoptionFailed("journaled plan is incomplete")
+        log.warning(
+            "scheduler recovery: generation %d adopting job %s at round %d "
+            "(%d journaled executions)",
+            dur.generation, ctx.base_id, res.round, len(res.dispatches),
+        )
+        recovery_span = trace.begin(
+            "scheduler_recovery",
+            attrs={"generation": dur.generation, "round": res.round},
+            node="scheduler",
+        )
+        progress_reg = None
+        tasks: list[Task] = []
+        try:
+            # Stream identities re-derive deterministically from the plan:
+            # the ORIGINAL worker set keeps tags/groups/specs matching what
+            # the live executions were dispatched with.
+            self._plan_streams(
+                ctx, job, sorted(plan_workers), ps_peers, num_shards, parts
+            )
+            # Latest per-execution dispatch records, classified. Train
+            # records for departed peers (a rejoin superseded them) are
+            # skipped via the journaled membership's active list.
+            member = res.member or {}
+            active = [
+                str(p)
+                for p in (member.get("active") or sorted(plan_workers))
+            ]
+            lease_ids: dict[str, str] = {
+                peer: str(rec.get("lease_id", ""))
+                for peer, rec in plan_workers.items()
+            }
+            batch_sizes: dict[str, int] = {
+                peer: int(rec.get("batch_size", 1) or 1)
+                for peer, rec in plan_workers.items()
+            }
+            train_jobs: dict[str, str] = {}  # peer -> job id
+            for job_id, rec in res.dispatches.items():
+                if rec.get("kind") != "train":
+                    continue
+                peer = str(rec.get("peer", ""))
+                train_jobs[peer] = job_id
+                lease_ids[peer] = str(rec.get("lease_id", ""))
+                if rec.get("batch_size"):
+                    batch_sizes[peer] = int(rec["batch_size"])
+            # Re-arm the journaled leases: the workers held them through
+            # the outage (adoption grace), so the first renewal resumes
+            # liveness tracking exactly where the dead loop stopped.
+            dead_workers: list[str] = []
+            for peer in active:
+                if peer not in lease_ids or peer not in train_jobs:
+                    dead_workers.append(peer)
+                    continue
+                try:
+                    handle = await WorkerHandle.adopt(
+                        self.node, peer, lease_ids[peer]
+                    )
+                except (RequestError, OSError, asyncio.TimeoutError) as e:
+                    log.warning(
+                        "adoption: lease re-arm for %s failed: %s", peer, e
+                    )
+                    dead_workers.append(peer)
+                    continue
+                handle.batch_size = batch_sizes.get(peer, 1)
+                ctx.handles[peer] = handle
+            ctx.ps_handles = [None] * num_shards
+            dead_shards: list[int] = []
+            for k, ps_job_id in enumerate(ctx.ps_job_ids):
+                rec = res.dispatches.get(ps_job_id)
+                if rec is None:
+                    dead_shards.append(k)
+                    continue
+                try:
+                    ctx.ps_handles[k] = await WorkerHandle.adopt(
+                        self.node, str(rec.get("peer", "")),
+                        str(rec.get("lease_id", "")),
+                    )
+                except (RequestError, OSError, asyncio.TimeoutError) as e:
+                    log.warning(
+                        "adoption: lease re-arm for ps shard %d failed: %s",
+                        k, e,
+                    )
+                    dead_shards.append(k)
+            if not ctx.handles and all(h is None for h in ctx.ps_handles):
+                raise AdoptionFailed("nothing alive to adopt")
+
+            # The re-adoption handshake proper, bounded by the deadline.
+            hello_records = {
+                train_jobs[peer]: {"peer": peer}
+                for peer in ctx.handles
+            }
+            for k, ps_job_id in enumerate(ctx.ps_job_ids):
+                if ctx.ps_handles[k] is not None:
+                    rec = res.dispatches.get(ps_job_id) or {}
+                    hello_records[ps_job_id] = {"peer": rec.get("peer", "")}
+            deadline_s = (
+                ft.scheduler_adopt_deadline_s
+                if ft.scheduler_adopt_deadline_s is not None
+                else DEFAULT_ADOPT_DEADLINE_S
+            )
+            acks = await self._adopt_executions(
+                ctx, hello_records, res.round, deadline_s
+            )
+            running = {
+                job_id: ack
+                for job_id, ack in acks.items()
+                if ack.ok and ack.state == "running"
+            }
+            # Fully-finished job adopted post-mortem: every execution is
+            # gone and the journal frontier covers the whole plan — report
+            # success instead of re-running a completed job from scratch.
+            if not running and res.round >= job.rounds.update_rounds:
+                await asyncio.to_thread(dur.complete)
+                return JobResult(ctx.base_id, res.round, [])
+            if not running:
+                raise AdoptionFailed("no execution answered the hello")
+
+            await self._start_data(ctx, job)
+            ctx.tracker = ProgressTracker(
+                parameter_server=ps_peers,
+                update_target=job.rounds.avg_samples_between_updates,
+                update_epochs=job.rounds.update_rounds,
+            )
+            ctx.detector = PhiAccrualDetector(threshold=ft.phi_threshold)
+            # Tracker + membership include the DEAD peers too: the prelude
+            # below routes them through the normal _depart machinery
+            # (quorum check, rejoin auction) once supervision starts.
+            members: list[str] = []
+            for peer in active:
+                if peer in ctx.handles or peer in dead_workers:
+                    ctx.tracker.add_worker(peer, batch_sizes.get(peer, 1))
+                    members.append(peer)
+            ctx.membership = MembershipView(members)
+            # Epoch continuity: resume PAST the journaled epoch so the
+            # first post-restart push supersedes anything the PS adopted
+            # from the dead scheduler (the PS epoch-gates updates).
+            ctx.membership.epoch = int(member.get("epoch", 0)) + 1
+            ctx.membership.departed = {
+                str(p) for p in (member.get("departed") or [])
+            }
+            for handle in ctx.handles.values():
+                handle.on_renew = ctx.detector.heartbeat
+            self._make_adaptive(ctx, job)
+            collected, progress_reg = self._start_control(
+                ctx, job, num_shards, parts, generation=dur.generation
+            )
+            ctx.router = StatusRouter(self.node)
+            # Fast-forward, never rewind: each adopted shard's AdoptAck
+            # round is an UPDATED the predecessor processed (or that died
+            # with it) — credit them and re-advance the frontier.
+            shard_rounds = {
+                k: running[ps_job_id].round
+                for k, ps_job_id in enumerate(ctx.ps_job_ids)
+                if ps_job_id in running
+            }
+            assert ctx.batch_scheduler is not None
+            # adopt_round also puts the rebuilt straggler controller in
+            # WARMUP, seeded from the journaled EWMA snapshot: base
+            # assignments, no drop penalty, until one full measured round
+            # (the arrivals the dead scheduler never saw are not evidence
+            # of slowness).
+            adopted_round = ctx.batch_scheduler.adopt_round(
+                res.round, shard_rounds, ctrl=res.ctrl
+            )
+            ctx.round_journaled = adopted_round
+            await asyncio.to_thread(
+                ctx.dur.note_round, adopted_round,
+                ctx.adaptive.snapshot() if ctx.adaptive is not None else None,
+            )
+            FT_METRICS.scheduler_recoveries.add(1)
+            FLIGHT.record(
+                "scheduler.recovered", node="scheduler",
+                generation=dur.generation, round=adopted_round,
+                adopted=len(running), journal_round=res.round,
+            )
+            log.warning(
+                "scheduler recovery: adopted %d/%d executions, "
+                "fast-forwarded round %d -> %d",
+                len(running), len(hello_records), res.round, adopted_round,
+            )
+            # Watch the adopted executions' job statuses on the existing
+            # channels (no re-dispatch: the jobs are already running).
+            for job_id in running:
+                tasks.append(Task.attach(ctx.router, job_id))
+            # Refresh the fleet's membership view under the new epoch (and
+            # hand the PS the new inner-step state: None, warmup).
+            self._notify_membership_soon(ctx)
+
+            async def prelude(add) -> None:
+                for peer in list(ctx.membership.active):
+                    job_id = train_jobs.get(peer)
+                    adopted = job_id is not None and job_id in running
+                    if not adopted:
+                        await self._depart(
+                            ctx, peer, "no adoption ack", add
+                        )
+                for k, ps_job_id in enumerate(ctx.ps_job_ids):
+                    if ps_job_id not in running:
+                        self._request_ps_restart(
+                            ctx, k, "no adoption ack", add
+                        )
+
+            await self._supervise(ctx, tasks, prelude=prelude)
+            ft_summary = None
+            if ctx.membership is not None:
+                snap = ctx.membership.snapshot()
+                ft_summary = {
+                    "epoch": snap.epoch,
+                    "active": snap.active,
+                    "suspected": snap.suspected,
+                    "departed": snap.departed,
+                    "rejoins": ctx.rejoin_count,
+                }
+            await asyncio.to_thread(ctx.dur.complete)
+            return JobResult(
+                ctx.base_id, ctx.tracker.round, collected, ft=ft_summary
+            )
+        finally:
+            trace.finish(recovery_span)
+            for task in ctx.notify_tasks:
+                task.cancel()
+            if ctx.notify_tasks:
+                await asyncio.gather(
+                    *list(ctx.notify_tasks), return_exceptions=True
+                )
+            await asyncio.to_thread(ctx.dur.close)
             if progress_reg is not None:
                 progress_reg.close()
             if ctx.data_scheduler is not None:
@@ -736,7 +1329,9 @@ class Orchestrator:
             if status.state == "cancelled":
                 return peer, status.job_id, "cancelled"
 
-    async def _supervise(self, ctx: _RunContext, tasks: list[Task]) -> None:
+    async def _supervise(
+        self, ctx: _RunContext, tasks: list[Task], prelude=None
+    ) -> None:
         """Wait for completion; tolerate train-worker loss when elastic.
 
         Failure signals: per-task failed/cancelled job statuses, per-handle
@@ -761,6 +1356,13 @@ class Orchestrator:
                 add("ps-worker", ps_handle, _await_failure(ps_handle))
         loop = asyncio.get_running_loop()
         try:
+            if prelude is not None:
+                # Adoption aftermath (scheduler crash recovery): executions
+                # whose AdoptAck never arrived enter the normal failure
+                # machinery here — depart/rejoin for train workers,
+                # per-shard restart for PS shards — with the same `add`
+                # the loop below uses, so their replacements are watched.
+                await prelude(add)
             while True:
                 timeout_s = self._effective_timeout(ctx)
                 last = ctx.activity[0] if ctx.activity else loop.time()
@@ -882,6 +1484,16 @@ class Orchestrator:
             )
         ctx.ps_restarts += 1
         ctx.ps_restarting.add(shard)
+        if getattr(ctx, "dur", None) is not None:
+            # Journal the spent attempt: a recovered scheduler resumes the
+            # restart budget instead of handing a persistently-failing
+            # shard a fresh one after every scheduler crash.
+            aio.spawn(
+                asyncio.to_thread(ctx.dur.note_ps_restarts, ctx.ps_restarts),
+                tasks=ctx.notify_tasks,
+                what="scheduler journal ps-restart",
+                logger=log,
+            )
         log.warning(
             "parameter server shard %d failed (%s); restart attempt %d/%d",
             shard, reason, ctx.ps_restarts, ctx.ft.ps_restart_attempts,
@@ -905,7 +1517,12 @@ class Orchestrator:
         assert ctx.ft is not None and ctx.job is not None
         assert len(ctx.ps_specs) > shard
         failed = ctx.ps_handles[shard]
-        old_peer = failed.peer_id if failed is not None else ""
+        # The planned placement names the peer even when no live handle
+        # exists (a shard that died alongside the scheduler has only its
+        # journal record — scheduler crash recovery's re-auction path).
+        old_peer = failed.peer_id if failed is not None else (
+            ctx.ps_peers[shard] if shard < len(ctx.ps_peers) else ""
+        )
         if failed is not None:
             await failed.release()
             ctx.ps_handles[shard] = None
@@ -959,6 +1576,10 @@ class Orchestrator:
                     await handle.release()
                 continue
             ctx.ps_handles[shard] = handle
+            await self._journal_dispatch(
+                ctx, ctx.ps_specs[shard].job_id, handle, "aggregate",
+                shard=shard,
+            )
             if ctx.membership is not None:
                 # Bring the recovered shard's (checkpoint-restored) view up
                 # to date, including any rejoiners it still owes catch-ups.
@@ -1031,6 +1652,19 @@ class Orchestrator:
         assert ctx.membership is not None and ctx.ps_handles
         ok = True
         snapshot = ctx.membership.snapshot()
+        if getattr(ctx, "dur", None) is not None:
+            # Journal the epoch change BEFORE pushing it: a restarted
+            # scheduler must never adopt an OLDER epoch than one the PS
+            # already saw (the PS epoch-gates membership updates).
+            await asyncio.to_thread(
+                ctx.dur.note_member,
+                {
+                    "epoch": snapshot.epoch,
+                    "active": list(snapshot.active),
+                    "departed": list(snapshot.departed),
+                },
+                ctx.rejoin_count,
+            )
         if getattr(ctx, "adaptive", None) is not None:
             # Publish the straggler controller's per-worker inner-step
             # assignment with the membership (RoundMembership.inner_steps,
@@ -1175,6 +1809,7 @@ class Orchestrator:
                 continue
             ctx.handles[peer] = handle
             ctx.rejoin_count += 1
+            await self._journal_dispatch(ctx, spec.job_id, handle, "train")
             latency_ms = (loop.time() - departed_at) * 1000.0
             FT_METRICS.rejoins.add(1)
             FT_METRICS.rejoin_latency_ms.record(latency_ms)

@@ -69,6 +69,22 @@ class Task:
         self._statuses = router.watch(spec.job_id)
 
     @classmethod
+    def attach(cls, router: StatusRouter, job_id: str) -> "Task":
+        """Watch an ALREADY-RUNNING job's statuses without dispatching.
+
+        Scheduler crash recovery (ft.durable): the executions survived the
+        dead scheduler and were re-adopted in place — re-sending
+        DispatchJob would be rejected (job already running), so the
+        restarted scheduler only re-subscribes to the status stream.
+        """
+        task = cls.__new__(cls)
+        task.spec = None
+        task.job_id = job_id
+        task._router = router
+        task._statuses = router.watch(job_id)
+        return task
+
+    @classmethod
     async def dispatch(
         cls,
         node: Node,

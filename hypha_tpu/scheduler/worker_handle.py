@@ -56,6 +56,32 @@ class WorkerHandle:
         handle._renewal = asyncio.create_task(handle._renewal_loop(timeout))
         return handle
 
+    @classmethod
+    async def adopt(
+        cls, node: Node, peer_id: str, lease_id: str
+    ) -> "WorkerHandle":
+        """Re-arm a JOURNALED lease after a scheduler restart
+        (ft.durable DurableScheduler).
+
+        The worker kept the lease alive through the outage (the adoption
+        grace holds it past expiry), so the restarted scheduler's first
+        renewal — owner-checked against the same scheduler peer id —
+        resumes exactly where the dead renewal loop stopped. A renewal
+        failure here is the adoption-time worker-death signal: the caller
+        falls back to the existing depart/rejoin or ps-restart path.
+        """
+        from ..resources import Resources
+
+        offer = WorkerOffer(
+            request_id="adopt",
+            lease_id=lease_id,
+            peer_id=peer_id,
+            resources=Resources(),
+            price=0.0,
+            expires_in=0.0,
+        )
+        return await cls.create(node, offer)
+
     async def _renew(self) -> float:
         resp = await self.node.request(
             self.peer_id,

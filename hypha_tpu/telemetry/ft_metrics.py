@@ -44,6 +44,15 @@ class FTMetrics:
         self.retry_attempts = Counter("hypha.ft.retry_attempts")
         self.ps_journal_bytes = Counter("hypha.ps.journal_bytes")
         self.ps_recoveries = Counter("hypha.ps.recoveries")
+        # Durable control plane (ft.durable DurableScheduler): completed
+        # scheduler crash recoveries, executions re-adopted in place by the
+        # SchedulerHello/AdoptAck handshake, and stale-generation control
+        # messages dropped (the zombie-scheduler guard firing).
+        self.scheduler_recoveries = Counter("hypha.scheduler.recoveries")
+        self.adopted_executions = Counter("hypha.scheduler.adopted_executions")
+        self.stale_generation_dropped = Counter(
+            "hypha.scheduler.stale_generation_dropped"
+        )
         self.rejoin_latency_ms = Histogram(
             "hypha.ft.rejoin_latency", unit="ms",
             bounds=(50, 100, 250, 500, 1000, 2500, 5000, 10000, 30000),
@@ -59,6 +68,9 @@ class FTMetrics:
             "retry_attempts": self.retry_attempts.value(),
             "ps_journal_bytes": self.ps_journal_bytes.value(),
             "ps_recoveries": self.ps_recoveries.value(),
+            "scheduler_recoveries": self.scheduler_recoveries.value(),
+            "adopted_executions": self.adopted_executions.value(),
+            "stale_generation_dropped": self.stale_generation_dropped.value(),
             "rejoin_latency_ms_sum": hist["sum"],
             "rejoin_latency_ms_count": hist["count"],
         }

@@ -25,6 +25,7 @@ from ..messages import (
     PROTOCOL_API,
     TOPIC_WORKER,
     Ack,
+    AdoptAck,
     CancelJob,
     DispatchJob,
     DispatchJobResponse,
@@ -32,6 +33,7 @@ from ..messages import (
     RenewLease,
     RenewLeaseResponse,
     RequestWorker,
+    SchedulerHello,
     WorkerOffer,
 )
 from ..resources import ResourceEvaluator, WeightedResourceEvaluator
@@ -90,6 +92,11 @@ class Arbiter:
         )
         self._registrations.append(
             self.node.on(PROTOCOL_API, CancelJob).respond_with(self._on_cancel)
+        )
+        self._registrations.append(
+            self.node.on(PROTOCOL_API, SchedulerHello).respond_with(
+                self._on_hello
+            )
         )
         self._subscription = await self.node.subscribe(TOPIC_WORKER)
         self._tasks.append(asyncio.create_task(self._auction_loop()))
@@ -188,9 +195,86 @@ class Arbiter:
     async def _prune_loop(self) -> None:
         while True:
             await asyncio.sleep(PRUNE_INTERVAL_S)
-            for lease in self.lease_manager.remove_expired():
+            now = time.time()
+            for lease in self.lease_manager.ledger.list_expired():
+                # Adoption grace (ft.durable): a lease backing a
+                # scheduler-recoverable job outlives its expiry — the dead
+                # scheduler stopped renewing, but the execution must stay
+                # adoptable until the restarted scheduler's hello (which
+                # renews it) or the grace runs out (then the normal
+                # expiry cancellation below fires).
+                grace = self.job_manager.adopt_grace_for_lease(lease.id)
+                if grace > 0 and now < lease.timeout + grace:
+                    continue
+                if not lease.is_expired():
+                    continue  # renewed between the scan and here
+                try:
+                    self.lease_manager.remove(lease.id)
+                except LeaseNotFound:
+                    # Removed concurrently (an undeliverable-offer rollback
+                    # while a previous iteration's cancel awaited): already
+                    # gone, and an unhandled KeyError here would kill the
+                    # prune loop for the worker's lifetime.
+                    continue
                 log.info("lease %s expired", lease.id)
                 await self.job_manager.cancel_for_lease(lease.id)
+
+    async def _on_hello(self, peer: str, msg: SchedulerHello) -> AdoptAck:
+        """Execution re-adoption (ft.durable DurableScheduler).
+
+        A restarted scheduler claims a journaled execution: reply with its
+        TRUE round/epoch so the scheduler fast-forwards, record the
+        generation (the training/PS loops drop any response stamped with
+        an older one), and re-arm the backing lease — renewals resume and
+        the adoption grace ends. A hello from an OLDER generation than one
+        already adopted is a zombie predecessor and is refused.
+        """
+        execution = self.job_manager.get(msg.job_id)
+        if execution is None:
+            return AdoptAck(
+                job_id=msg.job_id, state="gone",
+                generation=msg.generation, ok=False,
+            )
+        last = execution.scheduler_generation
+        if last is not None and msg.generation < last:
+            from ..telemetry.ft_metrics import FT_METRICS
+
+            FT_METRICS.stale_generation_dropped.add(1)
+            return AdoptAck(
+                job_id=msg.job_id, round=execution.round,
+                epoch=execution.epoch, state="stale",
+                generation=last, ok=False,
+            )
+        execution.scheduler_generation = msg.generation
+        # Re-arm the lease backing this job so renewals resume from here.
+        for active_job_id, lease_id in self.job_manager.lease_bindings():
+            if active_job_id != msg.job_id:
+                continue
+            try:
+                self.lease_manager.renew(lease_id, peer, LEASE_TIMEOUT_S)
+            except (LeaseNotFound, PermissionError) as e:
+                log.warning(
+                    "adoption hello for %s: lease %s re-arm failed: %s",
+                    msg.job_id, lease_id, e,
+                )
+            break
+        from ..telemetry.flight import FLIGHT
+
+        FLIGHT.record(
+            "scheduler.adopted",
+            node=getattr(self.node, "peer_id", None) or "worker",
+            job=msg.job_id,
+            generation=msg.generation, round=execution.round,
+        )
+        log.info(
+            "execution %s adopted by scheduler generation %d (round %d)",
+            msg.job_id, msg.generation, execution.round,
+        )
+        return AdoptAck(
+            job_id=msg.job_id, round=execution.round,
+            epoch=execution.epoch, state="running",
+            generation=msg.generation, ok=True,
+        )
 
     # ------------------------------------------------------------ dispatch
 

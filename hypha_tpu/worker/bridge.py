@@ -71,11 +71,21 @@ class Bridge:
         job_id: str,
         scheduler_peer: str,
         connector: Connector | None = None,
+        status_retry_s: float = 0.0,
+        progress_probe=None,
     ) -> None:
         self.node = node
         self.work_dir = Path(work_dir)
         self.job_id = job_id
         self.scheduler_peer = scheduler_peer
+        # Durable control plane (ft.durable): > 0 parks failed status
+        # sends in aio.retry for this many seconds — a scheduler outage
+        # costs backed-off re-attempts instead of a failed training loop.
+        # 0 (default) keeps today's single-attempt behavior.
+        self.status_retry_s = float(status_retry_s or 0.0)
+        # Snoops every Progress on its way to the scheduler (the executor
+        # keeps Execution.round current for the AdoptAck handshake).
+        self.progress_probe = progress_probe
         self.connector = connector or Connector(node, scheduler_peer)
         self.socket_path = self.work_dir / "bridge.sock"
         self._server: asyncio.base_events.Server | None = None
@@ -315,9 +325,29 @@ class Bridge:
             await self._respond(writer, 400, {"error": "body.progress must be Progress"})
             return
         progress.job_id = progress.job_id or self.job_id
-        response = await self.node.request(
-            self.scheduler_peer, PROTOCOL_PROGRESS, progress, timeout=30
-        )
+        if self.progress_probe is not None:
+            self.progress_probe(progress)
+        if self.status_retry_s > 0:
+            # Scheduler-recoverable job: park the send across an outage
+            # (PR 5's aio.retry path) — the restarted scheduler answers
+            # the re-attempt, the training thread never sees the gap.
+            from ..network.node import RequestError
+
+            response = await aio.retry(
+                lambda: self.node.request(
+                    self.scheduler_peer, PROTOCOL_PROGRESS, progress,
+                    timeout=30,
+                ),
+                base_delay=0.5, max_delay=5.0,
+                deadline=self.status_retry_s,
+                retry_on=(RequestError, OSError),
+                what=f"status {progress.kind.value} -> scheduler",
+                logger=log,
+            )
+        else:
+            response = await self.node.request(
+                self.scheduler_peer, PROTOCOL_PROGRESS, progress, timeout=30
+            )
         await self._respond(
             writer, 200, {"response": messages.to_json_dict(response)}
         )

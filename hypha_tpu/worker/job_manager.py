@@ -28,6 +28,15 @@ class Execution:
 
     def __init__(self, job_id: str) -> None:
         self.job_id = job_id
+        # Durable control plane (ft.durable): live progress the executor
+        # keeps current so a restarted scheduler's SchedulerHello can be
+        # answered with the execution's TRUE round/epoch (AdoptAck), plus
+        # the adoption grace (None = not adoptable, today's behavior) and
+        # the last adopted scheduler generation (stale-hello guard).
+        self.round = 0
+        self.epoch = 0
+        self.adopt_grace_s: float | None = None
+        self.scheduler_generation: int | None = None
         self._result: asyncio.Future[JobStatus] = (
             asyncio.get_event_loop().create_future()
         )
@@ -118,6 +127,34 @@ class JobManager:
 
     def jobs_for_lease(self, lease_id: str) -> list[str]:
         return [jid for jid, j in self._active.items() if j.lease_id == lease_id]
+
+    def lease_bindings(self) -> list[tuple[str, str]]:
+        """(job_id, lease_id) for every active job (adoption lease re-arm)."""
+        return [(jid, j.lease_id) for jid, j in self._active.items()]
+
+    def get(self, job_id: str) -> Execution | None:
+        """The live execution for ``job_id`` (None when not running) —
+        the re-adoption handshake's lookup (arbiter SchedulerHello)."""
+        job = self._active.get(job_id)
+        return job.execution if job is not None else None
+
+    def adopt_grace_for_lease(self, lease_id: str) -> float:
+        """The longest adoption grace any of the lease's jobs carries.
+
+        Scheduler crash recovery (ft.durable): a dead scheduler stops
+        renewing, but executions of a recoverable job must outlive the
+        lease expiry by this many seconds so the restarted scheduler can
+        re-adopt them in place. 0 = no adoptable job, prune immediately
+        (today's exact behavior).
+        """
+        grace = 0.0
+        for job in self._active.values():
+            if job.lease_id != lease_id:
+                continue
+            g = job.execution.adopt_grace_s
+            if g is not None and g > grace:
+                grace = float(g)
+        return grace
 
     async def cancel_job(self, job_id: str) -> None:
         job = self._active.get(job_id)

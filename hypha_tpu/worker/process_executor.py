@@ -50,12 +50,28 @@ class ProcessExecutor(JobExecutor):
     ) -> Execution:
         work_dir = Path(self.work_root) / f"hypha-{uuid.uuid4().hex[:12]}"
         work_dir.mkdir(parents=True, mode=0o700)
+        # Durable control plane (ft.durable): the adoption grace and the
+        # live-round probe ride the bridge exactly like the in-process
+        # executor's — the subprocess boundary changes nothing about the
+        # scheduler re-adoption handshake.
+        grace = float(
+            getattr(spec.executor.train, "adopt_grace_s", 0) or 0
+        ) if spec.executor.train is not None else 0.0
+        probe_target: list = []
+
+        def probe(progress) -> None:
+            for execution in probe_target:
+                if progress.round > execution.round:
+                    execution.round = progress.round
+
         bridge = Bridge(
             self.node,
             work_dir,
             job_id,
             scheduler_peer,
             Connector(self.node, scheduler_peer),
+            status_retry_s=grace,
+            progress_probe=probe,
         )
         socket_path = await bridge.start()
         job_json = json.dumps(messages.to_json_dict(spec))
@@ -83,6 +99,8 @@ class ProcessExecutor(JobExecutor):
             job_id, proc, bridge, work_dir, self.keep_work_dir,
             reducer=reducer,
         )
+        execution.adopt_grace_s = grace or None
+        probe_target.append(execution)
         execution.start_supervision()
         return execution
 

@@ -66,7 +66,13 @@ from .. import aio
 from .. import compress
 from .. import native
 from ..ft.adaptive import LinkTable
-from ..ft.durable import GENERATION_KEY, RESYNC_KEY, DurablePS, FoldRecord
+from ..ft.durable import (
+    GENERATION_KEY,
+    RESYNC_KEY,
+    DurablePS,
+    FoldRecord,
+    stale_scheduler_response,
+)
 from ..ft.membership import PROTOCOL_FT, MembershipUpdate, RoundMembership, quorum_size
 from ..ft.rejoin import CATCHUP_KEY, CatchupBuffer
 from ..messages import (
@@ -245,6 +251,27 @@ class _ElasticState:
             self.pending_joins.setdefault(peer, 3)
 
 
+def _fire_once(fn):
+    """Wrap an async thunk so only the FIRST call runs it.
+
+    The round's broadcast must fire exactly once — either from the
+    resilient notify's outage path (first failed attempt, so a quorate
+    round closes without the scheduler) or from the normal post-notify
+    call — never both, never zero. One helper instead of three hand-rolled
+    flag dicts, so the semantics cannot drift between the blocking,
+    adaptive and stream loops.
+    """
+    done = {"v": False}
+
+    async def run() -> None:
+        if done["v"]:
+            return
+        done["v"] = True
+        await fn()
+
+    return run
+
+
 class ParameterServerExecutor(JobExecutor):
     def __init__(self, node: Node, work_root: Path | str = "/tmp") -> None:
         self.node = node
@@ -263,6 +290,12 @@ class ParameterServerExecutor(JobExecutor):
         work_dir = self.work_root / f"hypha-ps-{uuid.uuid4().hex[:12]}"
         work_dir.mkdir(parents=True)
         execution = Execution(job_id)
+        # Durable control plane: a scheduler-recoverable job's aggregation
+        # outlives a dead scheduler by the adoption grace (arbiter prune
+        # defers the lease; _notify_updated_resilient parks the notify).
+        execution.adopt_grace_s = (
+            float(getattr(cfg, "adopt_grace_s", 0) or 0) or None
+        )
         task = asyncio.create_task(
             self._run(execution, job_id, cfg, scheduler_peer, work_dir)
         )
@@ -431,6 +464,10 @@ class ParameterServerExecutor(JobExecutor):
             elastic.shard = shard
             elastic.num_shards = num_shards
         stream_fragments = parts
+        # Durable control plane (ft.durable): the job's adoption grace —
+        # how long the Updated notify may park across a scheduler outage
+        # (0 = today's single-attempt behavior).
+        park_s = float(getattr(cfg, "adopt_grace_s", 0) or 0)
         try:
             # Crash recovery (ft.durable): restore the outer-state
             # checkpoint, replay committed rounds from the journal, re-send
@@ -448,6 +485,7 @@ class ParameterServerExecutor(JobExecutor):
                     stream=(sync_mode != "blocking") or sharded,
                     fragments=stream_fragments,
                     shard=shard, num_shards=num_shards,
+                    execution=execution,
                 )
                 if bcast_ef is not None and 0 in rec_efs:
                     bcast_ef = rec_efs[0]
@@ -476,6 +514,11 @@ class ParameterServerExecutor(JobExecutor):
                 )
                 return
             while True:
+                # Live progress for the AdoptAck handshake: the round this
+                # collect will close, and the last adopted membership epoch.
+                execution.round = round_num
+                if elastic is not None:
+                    execution.epoch = elastic.membership.epoch
                 # A recovered round resumes its replayed accumulator (its
                 # preloaded entries are already folded in, bit-exactly).
                 accum = recovered_accums.pop(round_num, None)
@@ -542,16 +585,22 @@ class ParameterServerExecutor(JobExecutor):
                         await asyncio.to_thread(
                             elastic.catchup.accumulate, update_path
                         )
-                    response = await self._notify_updated(
+                    bcast_adaptive = _fire_once(
+                        lambda _u=update_path, _r=round_num: (
+                            self._broadcast_adaptive(
+                                cfg, _u, _r, elastic, link, peer_efs,
+                                work_dir, traceparent=ptrace.ctx(_r),
+                            )
+                        )
+                    )
+                    response = await self._notify_updated_resilient(
                         scheduler_peer, job_id, round_num, arrivals=arrivals,
                         traceparent=ptrace.ctx(round_num),
+                        execution=execution, park_s=park_s,
+                        on_first_failure=bcast_adaptive,
                     )
                     ptrace.adopt(response, round_num + 1)
-                    await self._broadcast_adaptive(
-                        cfg, update_path, round_num, elastic, link,
-                        peer_efs, work_dir,
-                        traceparent=ptrace.ctx(round_num),
-                    )
+                    await bcast_adaptive()
                     for path, _ in received.values():
                         path.unlink(missing_ok=True)
                     round_num += 1
@@ -611,9 +660,27 @@ class ParameterServerExecutor(JobExecutor):
                 # otherwise the worker is told Continue instead of Done and
                 # starts a phantom extra round (the reference broadcasts
                 # first, parameter_server.rs:232-283, and carries this race).
-                response = await self._notify_updated(
+                # EXCEPTION — scheduler outage (park_s > 0, first attempt
+                # failed): the broadcast fires immediately so the quorate
+                # round closes without the scheduler; the workers' own
+                # UpdateReceived parks on their side, so the ordering race
+                # this comment guards cannot bite while it is down.
+                bcast_static = _fire_once(
+                    lambda _w=wire_path, _r=round_num: self._broadcast(
+                        cfg, _w, _r, elastic,
+                        extra_header=(
+                            {GENERATION_KEY: dur.generation}
+                            if dur is not None else None
+                        ),
+                        traceparent=ptrace.ctx(_r),
+                        span_round=_r,
+                    )
+                )
+                response = await self._notify_updated_resilient(
                     scheduler_peer, job_id, round_num, arrivals=arrivals,
                     traceparent=ptrace.ctx(round_num),
+                    execution=execution, park_s=park_s,
+                    on_first_failure=bcast_static,
                 )
                 ptrace.adopt(response, round_num + 1)
                 if dur is not None:
@@ -621,15 +688,7 @@ class ParameterServerExecutor(JobExecutor):
                         dur.note_notified, round_num,
                         response.kind == ProgressResponseKind.DONE,
                     )
-                await self._broadcast(
-                    cfg, wire_path, round_num, elastic,
-                    extra_header=(
-                        {GENERATION_KEY: dur.generation}
-                        if dur is not None else None
-                    ),
-                    traceparent=ptrace.ctx(round_num),
-                    span_round=round_num,
-                )
+                await bcast_static()
                 if dur is None:
                     # Durable runs keep the delta files — the journal
                     # references them until a checkpoint covers the round.
@@ -678,6 +737,7 @@ class ParameterServerExecutor(JobExecutor):
         fragments: int,
         shard: int = 0,
         num_shards: int = 1,
+        execution=None,
     ) -> tuple:
         """Resume this job from its durable state after a PS restart.
 
@@ -781,8 +841,13 @@ class ParameterServerExecutor(JobExecutor):
         if last_round >= 0:
             notified = resume.notified.get(last_round)
             if notified is None:
-                response = await self._notify_updated(
-                    scheduler_peer, job_id, last_round, shard=shard
+                # A PS and scheduler that died together recover in any
+                # order: the re-notify parks across the scheduler's own
+                # restart window (idempotent by round on its side).
+                response = await self._notify_updated_resilient(
+                    scheduler_peer, job_id, last_round, shard=shard,
+                    execution=execution,
+                    park_s=float(getattr(cfg, "adopt_grace_s", 0) or 0),
                 )
                 done = response.kind == ProgressResponseKind.DONE
                 await asyncio.to_thread(dur.note_notified, last_round, done)
@@ -1524,8 +1589,13 @@ class ParameterServerExecutor(JobExecutor):
 
         round_num = next_owned(round_start)
         ptrace = _PsTrace(self._trace_node())
+        park_s = float(getattr(cfg, "adopt_grace_s", 0) or 0)
         try:
             while True:
+                # Live progress for the AdoptAck handshake.
+                execution.round = round_num
+                if elastic is not None:
+                    execution.epoch = elastic.membership.epoch
                 if dur is not None:
                     await asyncio.to_thread(dur.note_open, round_num)
                 arrivals: dict[str, float] | None = (
@@ -1616,20 +1686,6 @@ class ParameterServerExecutor(JobExecutor):
                     )
                 if ckpt_dir is not None:
                     self._checkpoint_momentum(momentum_file, ckpt_dir)
-                # Notify BEFORE broadcasting (same race note as the
-                # blocking loop: the scheduler must have advanced the
-                # round before any worker's UpdateReceived).
-                response = await self._notify_updated(
-                    scheduler_peer, job_id, round_num, shard=shard,
-                    arrivals=arrivals,
-                    traceparent=ptrace.ctx(round_num),
-                )
-                ptrace.adopt(response, next_owned(round_num + 1))
-                if dur is not None:
-                    await asyncio.to_thread(
-                        dur.note_notified, round_num,
-                        response.kind == ProgressResponseKind.DONE,
-                    )
                 # Freeze the fan-out's peer set at CLOSE time: the
                 # backgrounded push must not pick up a rejoiner who joins
                 # while it is pending — that peer's catch-up (served
@@ -1647,25 +1703,53 @@ class ParameterServerExecutor(JobExecutor):
                     bcast_header[GENERATION_KEY] = dur.generation
                 if sharded:
                     bcast_header[SHARD_KEY] = shard
-                last_bcast[frag] = aio.spawn(
-                    self._broadcast_and_cleanup(
-                        cfg, update_path, wire_path, received, round_num,
-                        tag, elastic,
-                        # Per-fragment ordering barrier: round r+F's fan-out
-                        # for fragment p waits for round r's (see
-                        # _broadcast_and_cleanup).
-                        after=last_bcast.get(frag),
-                        peers=bcast_peers,
-                        header=bcast_header,
-                        # Durable runs keep the delta files — the journal
-                        # references them until a checkpoint covers them.
-                        keep_received=dur is not None,
-                        traceparent=ptrace.ctx(round_num),
-                    ),
-                    tasks=bcast_tasks,
-                    what=f"stream broadcast r{round_num}",
-                    logger=log,
+                async def _spawn_bcast(
+                    _u=update_path, _w=wire_path, _rcv=received,
+                    _r=round_num, _tag=tag, _frag=frag,
+                    _peers=bcast_peers, _hdr=bcast_header,
+                ) -> None:
+                    last_bcast[_frag] = aio.spawn(
+                        self._broadcast_and_cleanup(
+                            cfg, _u, _w, _rcv, _r, _tag, elastic,
+                            # Per-fragment ordering barrier: round r+F's
+                            # fan-out for fragment p waits for round r's
+                            # (see _broadcast_and_cleanup).
+                            after=last_bcast.get(_frag),
+                            peers=_peers,
+                            header=_hdr,
+                            # Durable runs keep the delta files — the
+                            # journal references them until a checkpoint
+                            # covers them.
+                            keep_received=dur is not None,
+                            traceparent=ptrace.ctx(_r),
+                        ),
+                        tasks=bcast_tasks,
+                        what=f"stream broadcast r{_r}",
+                        logger=log,
+                    )
+
+                launch_bcast = _fire_once(_spawn_bcast)
+
+                # Notify BEFORE broadcasting (same race note as the
+                # blocking loop: the scheduler must have advanced the
+                # round before any worker's UpdateReceived) — except
+                # across a scheduler outage, where the first failed
+                # attempt launches the fan-out so the quorate round
+                # closes without the scheduler.
+                response = await self._notify_updated_resilient(
+                    scheduler_peer, job_id, round_num, shard=shard,
+                    arrivals=arrivals,
+                    traceparent=ptrace.ctx(round_num),
+                    execution=execution, park_s=park_s,
+                    on_first_failure=launch_bcast,
                 )
+                ptrace.adopt(response, next_owned(round_num + 1))
+                if dur is not None:
+                    await asyncio.to_thread(
+                        dur.note_notified, round_num,
+                        response.kind == ProgressResponseKind.DONE,
+                    )
+                await launch_bcast()
                 STREAM_METRICS.fragment_closed(frag)
                 if sharded:
                     SHARD_METRICS.shard_rounds_closed.add(1)
@@ -2478,10 +2562,20 @@ class ParameterServerExecutor(JobExecutor):
         self, scheduler_peer: str, job_id: str, round_num: int, shard: int = 0,
         arrivals: "dict[str, float] | None" = None,
         traceparent: str | None = None,
+        execution=None,
     ) -> ProgressResponse:
+        gen = (
+            getattr(execution, "scheduler_generation", None)
+            if execution is not None
+            else None
+        )
         progress = Progress(
             kind=ProgressKind.UPDATED, job_id=job_id, round=round_num,
             shard=shard, traceparent=traceparent,
+            # Durable control plane: stamped only once a scheduler restart
+            # actually happened (generation >= 2) — a never-restarted job's
+            # Updated keeps today's exact bytes.
+            scheduler_generation=(gen if gen is not None and gen >= 2 else None),
         )
         if arrivals is not None:
             # Straggler-adaptive inner steps (ft.adaptive): per-peer
@@ -2496,4 +2590,76 @@ class ParameterServerExecutor(JobExecutor):
         )
         if not isinstance(resp, ProgressResponse):
             raise RequestError(f"unexpected progress response {resp!r}")
+        if execution is not None:
+            new_gen, stale = stale_scheduler_response(
+                resp, getattr(execution, "scheduler_generation", None)
+            )
+            if stale:
+                # A zombie predecessor answered: its OK/DONE decision must
+                # not drive this shard's round machinery — drop and
+                # re-notify (the live scheduler answers the retry).
+                FT_METRICS.stale_generation_dropped.add(1)
+                raise RequestError(
+                    "stale scheduler generation on Updated reply"
+                )
+            execution.scheduler_generation = new_gen
         return resp
+
+    async def _notify_updated_resilient(
+        self, scheduler_peer: str, job_id: str, round_num: int, *,
+        shard: int = 0,
+        arrivals: "dict[str, float] | None" = None,
+        traceparent: str | None = None,
+        execution=None,
+        park_s: float = 0.0,
+        on_first_failure=None,
+    ) -> ProgressResponse:
+        """Updated notify that survives a scheduler outage.
+
+        With ``park_s`` (the job's adoption grace) set, a SECOND
+        consecutive failed attempt triggers ``on_first_failure`` — the
+        round's broadcast, so an already-quorate round closes and workers
+        merge WITHOUT the scheduler — then the notify parks in aio.retry
+        until the restarted scheduler answers (idempotent by round on its
+        side) or the grace runs out (execution fails, the existing
+        re-auction path takes over). Two failures, not one: a single
+        transient RPC blip against a LIVE scheduler must not reorder
+        broadcast-before-notify — with the scheduler up, the workers'
+        UpdateReceived is NOT parked, so the early broadcast would
+        resurrect the exact Continue-vs-Done phantom-round race the
+        static ordering exists to prevent. The notify-before-broadcast
+        ordering is therefore preserved through any one-off failure, and
+        a real outage costs one extra backed-off attempt (~1 s) before
+        the round closes scheduler-free.
+        """
+        if park_s <= 0:
+            return await self._notify_updated(
+                scheduler_peer, job_id, round_num, shard=shard,
+                arrivals=arrivals, traceparent=traceparent,
+                execution=execution,
+            )
+        failures = {"n": 0}
+
+        async def once() -> ProgressResponse:
+            try:
+                return await self._notify_updated(
+                    scheduler_peer, job_id, round_num, shard=shard,
+                    arrivals=arrivals, traceparent=traceparent,
+                    execution=execution,
+                )
+            except (RequestError, OSError, asyncio.TimeoutError):
+                failures["n"] += 1
+                if failures["n"] == 2 and on_first_failure is not None:
+                    FLIGHT.record(
+                        "ps.notify_parked", node=self._trace_node(),
+                        job=job_id, round=round_num, shard=shard,
+                    )
+                    await on_first_failure()
+                raise
+
+        return await aio.retry(
+            once,
+            base_delay=0.5, max_delay=5.0, deadline=park_s,
+            retry_on=(RequestError, OSError),
+            what=f"updated r{round_num} -> scheduler", logger=log,
+        )

@@ -48,9 +48,23 @@ class InProcessTrainExecutor(JobExecutor):
     ) -> Execution:
         work_dir = Path(self.work_root) / f"hypha-{uuid.uuid4().hex[:12]}"
         work_dir.mkdir(parents=True, mode=0o700)
+        execution = Execution(job_id)
+        # Durable control plane (ft.durable): a scheduler-recoverable job
+        # parks its status sends across the outage and keeps the
+        # execution's live round current for the AdoptAck handshake.
+        train_cfg = spec.executor.train
+        grace = float(getattr(train_cfg, "adopt_grace_s", 0) or 0)
+        execution.adopt_grace_s = grace or None
+
+        def probe(progress) -> None:
+            if progress.round > execution.round:
+                execution.round = progress.round
+
         bridge = Bridge(
             self.node, work_dir, job_id, scheduler_peer,
             Connector(self.node, scheduler_peer),
+            status_retry_s=grace,
+            progress_probe=probe,
         )
         socket_path = await bridge.start()
         # Tree-reduce (hypha_tpu.stream.reduce): a job that names this
@@ -60,7 +74,6 @@ class InProcessTrainExecutor(JobExecutor):
         from ..stream.reduce import maybe_start_reducer
 
         reducer = maybe_start_reducer(self.node, spec)
-        execution = Execution(job_id)
         stop_flag = threading.Event()
         runner = asyncio.create_task(
             self._run(

@@ -1,0 +1,32 @@
+"""Seeded fixture pair for hypha-lint's ``msg-generation-needs-round`` rule.
+
+Deliberately NOT registered with hypha_tpu.messages (registration would
+leak into the live registry other tests lint); tests/test_lint.py passes
+these classes to ``proto_rules.check_generation_tags`` as an explicit
+registry. ``GenerationBad`` must trip the rule — a restart-handshake
+generation without its round could adopt an execution (or drop a
+Continue/ScheduleUpdate) against the wrong round. ``GenerationGood`` is
+the clean twin.
+"""
+
+# No `from __future__ import annotations`: stringified annotations make
+# dataclasses.fields() resolve against sys.modules[cls.__module__], which
+# an exec'd fixture module is deliberately absent from.
+from dataclasses import dataclass
+
+
+@dataclass(slots=True)
+class GenerationBad:
+    """A generation id with NO round tag: the rule must fire."""
+
+    scheduler_generation: int = 0
+    note: str = ""
+
+
+@dataclass(slots=True)
+class GenerationGood:
+    """A generation id paired with its round: the rule stays quiet."""
+
+    generation: int = 0
+    round: int = 0
+    note: str = ""

@@ -338,6 +338,37 @@ def test_proto_adaptive_rule_live_registry_clean():
     assert proto_rules.check_adaptive_tags() == []
 
 
+def test_proto_generation_rule_on_fixture_pair():
+    """The seeded fixture pair: GenerationBad (a restart-handshake
+    generation, no round tag) fires the rule, clean twin GenerationGood
+    stays quiet. Unregistered fixtures, explicit registry."""
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "proto_generation", FIXTURES / "proto_generation.py"
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    bad = proto_rules.check_generation_tags(
+        registry={
+            "GenerationBad": mod.GenerationBad,
+            "GenerationGood": mod.GenerationGood,
+        }
+    )
+    assert [v.rule for v in bad] == ["msg-generation-needs-round"]
+    assert "GenerationBad" in bad[0].message
+    assert proto_rules.check_generation_tags(
+        registry={"GenerationGood": mod.GenerationGood}
+    ) == []
+
+
+def test_proto_generation_rule_live_registry_clean():
+    """The shipping registry (SchedulerHello/AdoptAck carry round next to
+    generation; ProgressResponse pairs generation with round) satisfies
+    the rule at zero new suppressions."""
+    assert proto_rules.check_generation_tags() == []
+
+
 def test_proto_manifest_catches_stale_value_vocabulary():
     bad = proto_rules.check_protocol_map(
         registry={}, manifest={}, values={"GhostValue"}
