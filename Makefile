@@ -3,7 +3,7 @@
 
 PYTHON ?= python
 
-.PHONY: lint lint-fixtures test compressbench streambench ftbench-ps ftbench-scheduler shardbench servbench hetbench obsbench
+.PHONY: lint lint-fixtures test compressbench streambench ftbench-ps ftbench-scheduler shardbench servbench servbench-smoke hetbench obsbench
 
 lint:
 	$(PYTHON) -m hypha_tpu.analysis hypha_tpu/
@@ -49,15 +49,22 @@ shardbench:
 	JAX_PLATFORMS=cpu $(PYTHON) benchmarks/shardbench.py \
 		--chaos kill-ps --out SHARDBENCH_r08.json
 
-# Paged KV serving + multi-worker routing: block-granular admission vs the
-# fixed-slot pool at equal KV memory (asserts >=1.5x concurrency, bounded
-# p99), late-arrival p50 under a concurrent 4k-token prompt (asserts <=2x,
-# chunked prefill), and routed 2-worker throughput under 100 clients
-# (asserts >=1.8x vs one worker). Writes SERVBENCH_r05.json
-# (docs/serving.md / docs/performance.md "Paged KV serving").
+# Paged KV serving r06: the r05 sections (block-granular admission >=1.5x
+# concurrency at equal KV memory, late-arrival p50 <=2x under a 4k prompt,
+# routed 2-worker >=1.8x under 100 clients) plus automatic prefix caching
+# (shared-system-prompt TTFT and tok/s >=2x vs the no-cache pool,
+# token-identical) and n-gram speculative decoding (accept rate >0.2,
+# sequential-step speedup >=1.3x, token-identical). Writes
+# SERVBENCH_<round>.json — the --round tag keeps re-runs from overwriting
+# older artifacts (docs/serving.md / docs/performance.md).
 servbench:
-	JAX_PLATFORMS=cpu $(PYTHON) benchmarks/servbench.py \
-		--out SERVBENCH_r05.json
+	JAX_PLATFORMS=cpu $(PYTHON) benchmarks/servbench.py --round r06
+
+# Seconds-scale servbench for CI (tiny sections, same assertions with
+# smoke-adjusted floors).
+servbench-smoke:
+	JAX_PLATFORMS=cpu $(PYTHON) benchmarks/servbench.py --round smoke \
+		--smoke --out /tmp/SERVBENCH_smoke.json
 
 # WAN-adaptive outer rounds: a 4-worker pool with one bandwidth-capped +
 # one 4x slow-CPU peer, adaptive (straggler-adaptive inner steps +

@@ -283,6 +283,10 @@ async def _run_scheduler(conf: SchedulerConfig) -> None:
                 pool_block_size=conf.job.serve_block_size,
                 pool_blocks=conf.job.serve_blocks,
                 pool_prefill_chunk=conf.job.serve_prefill_chunk,
+                pool_prefix_cache=conf.job.serve_prefix_cache,
+                pool_spec_ngram=conf.job.serve_spec_ngram,
+                pool_spec_draft=conf.job.serve_spec_draft,
+                prefix_affinity=conf.job.serve_prefix_affinity,
                 eos_token_id=(
                     None
                     if conf.job.serve_eos_token_id < 0

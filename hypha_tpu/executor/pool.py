@@ -46,6 +46,33 @@ keys with the same positions). ``max_queue`` bounds the waiting line:
 beyond it ``submit`` fails fast with :class:`PoolBusy` carrying a
 retry-after hint instead of queueing unboundedly.
 
+**Automatic prefix caching** (``prefix_cache=True``, paged mode): paged
+lanes are laid out right-aligned at position 0 (RoPE positions and the
+causal mask are unchanged — token streams stay pinned against the
+one-shot path), so a full block's K/V content is a pure function of the
+token prefix. Admission chain-hashes the prompt's full blocks
+(executor.block_cache), maps the longest cached prefix into the new
+lane's table refcounted, and jumps ``r.pos`` past the hit — capped one
+token short of the prompt end, so the last token always recomputes (its
+logits yield the first generated token; when that write lands in a
+still-shared block it copy-on-writes into a fresh one first,
+ops.kvcache.copy_blocks). Completed/preempted lanes register their full
+blocks back into the cache; refcount-0 blocks park in an LRU that both
+allocation and eviction draw from, so a preempted group's resume is a
+cache hit (one prefill chunk) instead of a full recompute.
+
+**Speculative decoding** (``spec_ngram > 0``, paged mode): n-gram
+prompt-lookup drafting — the most recent earlier occurrence of the
+context's final n-gram proposes the tokens that followed it — verified
+by the SAME chunked-prefill program (it already scores every position of
+a K-token window per dispatch; per-column argmax makes each column's
+greedy next-token visible to the host). The accepted prefix plus one
+bonus token lands per verify dispatch, so progress is ≥ 1 token always
+and up to ``prefill_chunk`` on repetitive text; greedy output is
+token-identical by construction (only model-confirmed tokens are ever
+emitted). Both features default OFF; off, behavior and program shapes
+are exactly the pre-cache pool's.
+
 The reference has no inference path at all (its Executor union is
 Train|Aggregate, crates/messages/src/lib.rs:627-631) — this is net-new
 capability, benchmarked in SERVBENCH (late-arrival p50 + aggregate tok/s).
@@ -66,9 +93,11 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..ops.kvcache import copy_blocks
 from ..telemetry import SERVE_METRICS
 from ..telemetry import trace
 from ..telemetry.flight import FLIGHT
+from .block_cache import PrefixBlockCache, chain_hashes
 
 __all__ = ["DecodePool", "PoolBusy", "supports_pool", "supports_paging"]
 
@@ -163,10 +192,23 @@ class _PRow:
     done: bool = False
     # live-lane state, only meaningful while admitted
     slot: int = -1
-    window: int = 0  # L: logical prompt-region length (multiple of P)
+    window: int = 0  # prefill target: len(prompt + emitted) at admission
     pos: int = 0  # logical write index: prefill progress, then decode
     blocks: list = field(default_factory=list)
-    win_tokens: Any = None  # np[L] left-padded resume prompt
+    win_tokens: Any = None  # np[window + P] right-aligned resume prompt
+    # prefix-cache progress: how many leading blocks are registered in
+    # the cache, and the chain hash after them (block_cache.chain_hashes
+    # recurrence) — decode extends the chain incrementally.
+    hashed: int = 0
+    chain_h: int = 0
+    # speculation state: incrementally maintained context + n-gram
+    # position index (O(1) amortized per token instead of an O(len)
+    # rescan per iteration), and the accept-rate backoff.
+    spec_ctx: Any = None  # list, extended from emitted lazily
+    spec_index: Any = None  # tuple[n-gram] -> ascending positions
+    spec_indexed: int = 0
+    spec_ewma: float = 0.0  # accepted drafts per verify, smoothed
+    spec_cooldown: int = 0  # iterations to sit out after low accepts
 
 
 class DecodePool:
@@ -192,12 +234,21 @@ class DecodePool:
         prefill_chunk: int = 0,
         reserve_blocks: int = -1,
         max_queue: int = 0,
+        prefix_cache: bool = False,
+        spec_ngram: int = 0,
+        spec_draft: int = 0,
     ) -> None:
         if not supports_pool(model):
             raise ValueError(
                 f"{type(model).__name__} has no per-row decode path"
             )
         self._paged = block_size > 0
+        if prefix_cache and not self._paged:
+            raise ValueError("prefix_cache requires paged mode (block_size > 0)")
+        if spec_ngram > 0 and not self._paged:
+            raise ValueError(
+                "speculative decoding requires paged mode (block_size > 0)"
+            )
         if self._paged:
             if not supports_paging(model):
                 raise ValueError(
@@ -231,6 +282,15 @@ class DecodePool:
         self.block_size = block_size
         self.num_blocks = num_blocks if self._paged else 0
         self.prefill_chunk = prefill_chunk if self._paged else 0
+        self.prefix_cache = bool(prefix_cache)
+        self.spec_ngram = int(spec_ngram) if self._paged else 0
+        # Draft tokens per verify dispatch: the verify window holds the
+        # current token + drafts, so at most prefill_chunk - 1 fit.
+        if self._paged:
+            cap = max(self.prefill_chunk - 1, 0)
+            self.spec_draft = min(spec_draft, cap) if spec_draft > 0 else cap
+        else:
+            self.spec_draft = 0
         self._model = model
         dec_kw = dict(decode=True, decode_len=max_len, per_row_decode=True)
         if self._paged:
@@ -265,11 +325,15 @@ class DecodePool:
 
         self._rows: dict[int, _Row] = {}
         self._free = list(range(slots))
-        # Paged host bookkeeping: lanes, blocks, and the row-variable
-        # mirrors pushed to device before every dispatched program.
+        # Paged host bookkeeping: lanes, the block allocator (+ prefix
+        # cache), and the row-variable mirrors pushed to device before
+        # every dispatched program.
         self._lane_rows: dict[int, _PRow] = {}
         self._free_lanes = list(range(slots))
-        self._free_blocks = list(range(self.num_blocks))
+        self._alloc = PrefixBlockCache(
+            self.num_blocks, max(self.block_size, 1),
+            caching=self.prefix_cache,
+        )
         if self._paged:
             max_blocks = max_len // block_size
             self._h_idx = np.full((slots,), max_len, np.int32)
@@ -289,6 +353,7 @@ class DecodePool:
         self._admit_seq = 0
         self.chunks = 0  # decode programs dispatched (test/bench hook)
         self.prefill_chunks = 0  # paged: chunked-prefill programs dispatched
+        self.spec_chunks = 0  # speculation verify dispatches (same program)
         self.preemptions = 0
         self.requests = 0
         self._prefill_cache: dict = {}
@@ -296,6 +361,7 @@ class DecodePool:
         self._chunk_fn = None
         self._prefill_paged_fn = None
         self._sync_fn = None
+        self._copy_fn = None
         self._thread = threading.Thread(
             target=self._serve_loop, name="decode-pool", daemon=True
         )
@@ -304,9 +370,10 @@ class DecodePool:
     # ---------------------------------------------------------- load stats
 
     def free_blocks(self) -> int:
-        """Free KV blocks (paged) / free rows (fixed-slot) — the admission
-        headroom reported on ServeLoad heartbeats for router balancing."""
-        return len(self._free_blocks) if self._paged else len(self._free)
+        """Allocatable KV blocks (paged: free list + evictable ref-0
+        cached blocks) / free rows (fixed-slot) — the admission headroom
+        reported on ServeLoad heartbeats for router balancing."""
+        return self._alloc.free_count() if self._paged else len(self._free)
 
     def queue_depth(self) -> int:
         """Groups submitted but not yet admitted."""
@@ -555,7 +622,13 @@ class DecodePool:
         for every prompt length — it writes through the pool's block
         tables at each lane's current position, attending to the lane's
         already-prefilled keys. Idle lanes ride along parked at the
-        ``max_len`` sentinel (their writes land in the garbage block)."""
+        ``max_len`` sentinel (their writes land in the garbage block).
+
+        Returns the PER-COLUMN greedy next token ([slots, chunk]): the
+        host reads the column of each lane's last real token (right-
+        aligned prompts can end mid-chunk), and speculation reads every
+        column — this program scoring K positions per dispatch IS the
+        draft-verify step."""
         if self._prefill_paged_fn is not None:
             return self._prefill_paged_fn
         dec = self._dec
@@ -567,11 +640,24 @@ class DecodePool:
             logits, vars_ = out
             if isinstance(logits, tuple):  # MoE: (logits, aux)
                 logits = logits[0]
-            last = jnp.argmax(logits[:, -1], axis=-1).astype(jnp.int32)
-            return vars_["cache"], last
+            nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+            return vars_["cache"], nxt
 
         self._prefill_paged_fn = jax.jit(prefill, donate_argnums=(1,))
         return self._prefill_paged_fn
+
+    def _copy_block(self):
+        """Copy-on-write kernel: duplicate ONE physical block's K/V rows
+        (fixed [1] shape — copies are rare, one compile total)."""
+        if self._copy_fn is not None:
+            return self._copy_fn
+        bs = self.block_size
+
+        def copy(cache, src, dst):
+            return copy_blocks(cache, src, dst, bs)
+
+        self._copy_fn = jax.jit(copy, donate_argnums=(0,))
+        return self._copy_fn
 
     def _push_rowvars(self) -> None:
         self._cache = self._sync()(
@@ -731,32 +817,55 @@ class DecodePool:
     # ------------------------------------------------------- paged serving
 
     def _step_paged(self) -> None:
-        """One serve-loop iteration in paged mode: admit what fits, advance
-        chunked prefills, then run one decode chunk — prefill and decode
-        interleave, so a long prompt costs running requests at most one
-        ``prefill_chunk`` program per decode chunk, never a monolithic
-        prefill stall."""
+        """One serve-loop iteration in paged mode: admit what fits,
+        advance chunked prefills + speculation verifies (one shared
+        dispatch), then run one decode chunk for the remaining lanes —
+        prefill and decode interleave, so a long prompt costs running
+        requests at most one ``prefill_chunk`` program per decode chunk,
+        never a monolithic prefill stall."""
         self._admit_paged()
+        drafts: dict = {}
+        spec: list = []
+        if self.spec_ngram > 0:
+            for r in self._lane_rows.values():
+                if r.pos < r.window or r.done:
+                    continue
+                d = self._propose(r)
+                if d:
+                    spec.append(r)
+                    drafts[id(r)] = d
         pre = [r for r in self._lane_rows.values() if r.pos < r.window]
-        if pre:
-            self._run_prefill_chunk(pre)
+        if pre or spec:
+            self._run_prefill_chunk(pre, spec, drafts)
             self._finish_paged()
+        specced = {id(r) for r in spec}
         dec = [
             r
             for r in self._lane_rows.values()
-            if r.pos >= r.window and not r.done
+            if r.pos >= r.window and not r.done and id(r) not in specced
         ]
         if dec:
             self._run_decode_chunk(dec)
             self._finish_paged()
-        SERVE_METRICS.pool_state(len(self._free_blocks), self.queue_depth())
+        SERVE_METRICS.pool_state(self.free_blocks(), self.queue_depth())
+        if self.prefix_cache:
+            SERVE_METRICS.cache_state(
+                self._alloc.cached_count(), self._alloc.shared_count()
+            )
 
     def _admit_paged(self) -> None:
         """FIFO block-granular admission: the head group is admitted when
-        it has lanes AND its prompt-region blocks fit above the watermark
-        reserve (held back so live requests can grow). An empty pool
-        admits anything that fits the absolute bound — the reserve must
-        not park the only customer."""
+        it has lanes AND its uncached prompt-region blocks fit above the
+        watermark reserve (held back so live requests can grow). An empty
+        pool admits anything that fits the absolute bound — the reserve
+        must not park the only customer.
+
+        With the prefix cache on, each lane maps the longest cached
+        prefix of its (resume) prompt into its table refcounted and
+        prefill starts at the first uncached position — capped one token
+        short of the end, so the last prompt token always recomputes (its
+        logits are the first generated token)."""
+        bs = self.block_size
         while self._waiting:
             group = self._waiting[0]
             if not group.rows:
@@ -767,11 +876,21 @@ class DecodePool:
             live = [r for r in group.rows.values() if not r.done]
             if len(live) > len(self._free_lanes):
                 break
-            L = self._pwin(
-                max(len(r.prompt) + len(r.emitted) for r in live)
-            )
-            need = len(live) * (L // self.block_size)
-            free = len(self._free_blocks)
+            # Budget fresh blocks per lane net of cached-prefix hits;
+            # hits parked in the LRU leave the allocatable pool when
+            # mapped, so they count like fresh blocks.
+            need = 0
+            plans = []
+            for r in live:
+                full = r.prompt + r.emitted  # recompute-resume prompt
+                hashes = (
+                    chain_hashes(full, bs) if self.prefix_cache else []
+                )
+                hits, in_lru = self._alloc.peek(hashes)
+                lane_blocks = -(-len(full) // bs)
+                need += lane_blocks - hits + in_lru
+                plans.append((r, full, hashes, lane_blocks))
+            free = self._alloc.free_count()
             if free < need:
                 break
             if self._lane_rows and free - need < self.reserve_blocks:
@@ -787,67 +906,272 @@ class DecodePool:
                     "decode", parent=group.traceparent,
                     attrs={"rows": len(live)},
                 )
-            for r in live:
-                full = r.prompt + r.emitted  # recompute-resume prompt
+            for r, full, hashes, lane_blocks in plans:
                 r.slot = self._free_lanes.pop()
-                r.window = L
-                r.pos = 0
-                r.win_tokens = np.zeros((L,), np.int32)
-                r.win_tokens[L - len(full):] = full
-                r.blocks = [
-                    self._free_blocks.pop()
-                    for _ in range(L // self.block_size)
+                hit = self._alloc.lookup(hashes)
+                fresh = [
+                    self._alloc.alloc()
+                    for _ in range(lane_blocks - len(hit))
                 ]
+                if any(b is None for b in fresh):
+                    # peek() budgeted every mapped-LRU hit as consumed
+                    # headroom, so this cannot happen; fail loudly over
+                    # corrupting a table with a None id.
+                    raise RuntimeError("paged admission accounting broke")
+                r.blocks = hit + fresh
+                r.window = len(full)
+                r.pos = min(len(hit) * bs, len(full) - 1)
+                r.hashed = len(hit)
+                r.chain_h = hashes[len(hit) - 1] if hit else 0
+                r.win_tokens = np.zeros(
+                    (len(full) + self.prefill_chunk,), np.int32
+                )
+                r.win_tokens[: len(full)] = full
                 self._lane_rows[r.slot] = r
-                self._h_start[r.slot] = L - len(full)
+                self._h_start[r.slot] = 0
                 self._h_table[r.slot, :] = self.num_blocks
                 self._h_table[r.slot, : len(r.blocks)] = r.blocks
+                if self.prefix_cache:
+                    SERVE_METRICS.prefix_hit_blocks.add(len(hit))
+                    SERVE_METRICS.prefix_miss_blocks.add(
+                        len(hashes) - len(hit)
+                    )
             SERVE_METRICS.admissions.add(1)
 
-    def _run_prefill_chunk(self, pre: list) -> None:
+    def _propose(self, r: _PRow) -> list:
+        """Prompt-lookup drafting (n-gram speculation, no draft model):
+        find an earlier occurrence of the context's final ``spec_ngram``
+        tokens and propose the tokens that followed it — repetitive
+        output (templates, code, chat echoes) drafts itself.
+
+        Match policy: the NEAREST occurrence with a full draft window
+        after it, else the leftmost (longest continuation) — the
+        occurrence adjacent to the tail always matches trivially but has
+        almost nothing to copy. Lookup is O(log occurrences) over an
+        incrementally maintained position index; lanes whose drafts keep
+        missing back off to plain decode chunks (``spec_cooldown``), so
+        low-repetition traffic floors at the non-speculative pool."""
+        import bisect
+
+        n = self.spec_ngram
+        remaining = r.budget - len(r.emitted)
+        # A verify dispatch emits drafts + 1 bonus token, so cap drafts
+        # one short of the remaining budget; with <= 1 token remaining a
+        # plain decode chunk finishes the row.
+        cap = min(self.spec_draft, remaining - 1)
+        if cap <= 0:
+            return []
+        if r.spec_cooldown > 0:
+            r.spec_cooldown -= 1
+            return []
+        # Extend the cached context + n-gram index by the tokens emitted
+        # since the last call (amortized O(1) per token).
+        if r.spec_ctx is None:
+            r.spec_ctx = list(r.prompt)
+            r.spec_index = {}
+            r.spec_indexed = 0
+            r.spec_ewma = float(self.spec_draft)  # start optimistic
+        base = len(r.prompt)
+        if len(r.spec_ctx) - base < len(r.emitted):
+            r.spec_ctx.extend(r.emitted[len(r.spec_ctx) - base :])
+        ctx = r.spec_ctx
+        if len(ctx) <= n:
+            return []
+        # Index interior positions only (i <= len-n-1): the tail's own
+        # position must not match itself. Positions append in ascending
+        # order, so each bucket stays sorted for the bisect below.
+        for i in range(r.spec_indexed, len(ctx) - n):
+            r.spec_index.setdefault(tuple(ctx[i : i + n]), []).append(i)
+        r.spec_indexed = max(r.spec_indexed, len(ctx) - n)
+        positions = r.spec_index.get(tuple(ctx[-n:]))
+        if not positions:
+            return []
+        # Largest i with a full window (i + n + cap <= len), else the
+        # leftmost occurrence.
+        k = bisect.bisect_right(positions, len(ctx) - n - cap) - 1
+        best = positions[k] if k >= 0 else positions[0]
+        return ctx[best + n : best + n + cap]
+
+    def _register_lane(self, r: _PRow) -> None:
+        """Register ``r``'s newly FULL blocks in the prefix cache: a
+        block's content is final once every one of its positions is
+        written with tokens the request actually carries (``r.pos`` is
+        the written extent; positions past ``prompt+emitted`` hold
+        budget-overrun continuation tokens that nothing hashes)."""
+        if not self.prefix_cache:
+            return
+        bs = self.block_size
+        full_len = len(r.prompt) + len(r.emitted)
+        nfull = min(min(r.pos, full_len) // bs, len(r.blocks))
+        if nfull <= r.hashed:
+            return
+        full = r.prompt + r.emitted
+        h = r.chain_h
+        for j in range(r.hashed, nfull):
+            h = hash((h, tuple(full[j * bs : (j + 1) * bs])))
+            self._alloc.register(r.blocks[j], h)
+        r.chain_h = h
+        r.hashed = nfull
+
+    def _cow_for_write(self, r: _PRow, pos: int, span: int) -> bool:
+        """Make the blocks a write of ``[pos, pos + span)`` will touch
+        privately writable: copy-on-write any block still shared with
+        another lane (ops.kvcache.copy_blocks), and un-register a
+        privately held cached block about to be overwritten. False =
+        the pool could not supply a copy target (treated like decode
+        exhaustion by the caller)."""
+        if not self.prefix_cache:
+            return True
+        bs = self.block_size
+        hi = min(pos + span, len(r.blocks) * bs)
+        for bi in range(pos // bs, -(-hi // bs)):
+            b = r.blocks[bi]
+            if self._alloc.is_shared(b):
+                nb = self._alloc.alloc()
+                while nb is None:
+                    victim = self._pick_victim(exclude=r.group)
+                    if victim is None:
+                        return False
+                    self._preempt(victim)
+                    nb = self._alloc.alloc()
+                self._cache = self._copy_block()(
+                    self._cache,
+                    jnp.asarray([b], jnp.int32),
+                    jnp.asarray([nb], jnp.int32),
+                )
+                self._alloc.release(b)
+                r.blocks[bi] = nb
+                self._h_table[r.slot, bi] = nb
+                SERVE_METRICS.cow_copies.add(1)
+            elif self._alloc.is_registered(b):
+                # Sole owner (ref 1), overwriting in place. The expected
+                # such write is the capped-hit recompute of the final
+                # prompt token (pos == len(full)-1 inside the terminal
+                # hit block): it rewrites byte-identical K/V — the
+                # block's chain hash covers that very token — so the
+                # registration stays valid and exact-repeat traffic
+                # keeps hitting it. Any OTHER overwrite of a registered
+                # block would diverge from the hashed content: drop the
+                # registration rather than serve a corrupt cache entry.
+                full_len = len(r.prompt) + len(r.emitted)
+                identical = (
+                    pos == full_len - 1
+                    and bi == pos // bs
+                    and bi < r.hashed
+                )
+                if not identical:
+                    self._alloc.forget(b)
+        return True
+
+    def _run_prefill_chunk(
+        self, pre: list, spec: list = (), drafts: dict | None = None
+    ) -> None:
+        """One [slots, prefill_chunk] dispatch serving BOTH chunked
+        prefills and speculation verifies: prefilling lanes consume the
+        next window slice; speculating lanes consume [current token,
+        draft...] and accept the greedy-matched prefix plus one bonus
+        token from the per-column argmax."""
         P = self.prefill_chunk
+        # Allocation + CoW settle membership first: growing a spec lane
+        # (or copying a shared block) can preempt a group that is in
+        # these very lists.
+        for r in list(spec):
+            if r.slot < 0 or r.done:
+                continue
+            d = drafts[id(r)]
+            ok = self._grow(r, target=r.pos + 1 + len(d))
+            ok = ok and self._cow_for_write(r, r.pos, 1 + len(d))
+            if not ok:
+                self._fail_group(r.group, RuntimeError("paged pool exhausted"))
+        for r in list(pre):
+            if r.slot < 0 or r.done:
+                continue
+            if not self._cow_for_write(r, r.pos, P):
+                self._fail_group(r.group, RuntimeError("paged pool exhausted"))
+        pre = [r for r in pre if r.slot >= 0 and not r.done]
+        spec = [r for r in spec if r.slot >= 0 and not r.done]
+        if not pre and not spec:
+            return
         toks = np.zeros((self.slots, P), np.int32)
         self._h_idx[:] = self.max_len  # park every lane in the garbage block
         for r in pre:
             toks[r.slot] = r.win_tokens[r.pos : r.pos + P]
+            self._h_idx[r.slot] = r.pos
+        for r in spec:
+            x = [r.emitted[-1]] + drafts[id(r)]
+            toks[r.slot, : len(x)] = x
             self._h_idx[r.slot] = r.pos
         self._push_rowvars()
         # A paged prefill chunk can serve several groups; parent on the
         # first row's request (chunks are FIFO, so it is the oldest).
         with trace.span(
             "prefill",
-            parent=pre[0].group.traceparent if pre else None,
-            attrs={"rows": len(pre), "chunk": P},
+            parent=(pre + spec)[0].group.traceparent,
+            attrs={"rows": len(pre) + len(spec), "chunk": P,
+                   "spec_rows": len(spec)},
         ):
-            self._cache, last = self._prefill_paged()(
+            self._cache, nxt = self._prefill_paged()(
                 self._vars, self._cache, jnp.asarray(toks)
             )
-        self.prefill_chunks += 1
-        last_host = np.asarray(last)
+        if pre:
+            self.prefill_chunks += 1
+        if spec:
+            self.spec_chunks += 1
+        nxt_host = np.asarray(nxt)  # [slots, P] per-column greedy tokens
         for r in pre:
-            r.pos += P
+            base = r.pos
+            r.pos = min(r.pos + P, r.window)
             if r.pos >= r.window:
-                # The final chunk's last position is the last (resume)
-                # prompt token — its argmax is the next generated token,
-                # exactly the monolithic prefill's output.
-                r.emitted.append(int(last_host[r.slot]))
+                # The column of the last (resume-)prompt token: its
+                # argmax is the first generated token, exactly the
+                # monolithic prefill's output.
+                r.emitted.append(int(nxt_host[r.slot, r.window - 1 - base]))
+            self._register_lane(r)
+        for r in spec:
+            d = drafts[id(r)]
+            row = nxt_host[r.slot]
+            a = 0
+            while a < len(d) and int(row[a]) == d[a]:
+                a += 1
+            # d[:a] is greedy-confirmed; row[a] is the model's token
+            # after the accepted prefix — the bonus that guarantees >= 1
+            # token of progress per verify. Token-identical to plain
+            # decode by construction.
+            got = d[:a] + [int(row[a])]
+            r.emitted.extend(got[: r.budget - len(r.emitted)])
+            r.pos += a + 1
+            SERVE_METRICS.spec_proposed.add(len(d))
+            SERVE_METRICS.spec_accepted.add(a)
+            # Accept-rate backoff: a verify averaging < 1 accepted draft
+            # is worse than a decode chunk in every regime (1 token per
+            # wide dispatch vs K per chunk). Lanes whose drafts keep
+            # missing sit out 8 iterations of plain decode, then retry
+            # fresh — incidental n-gram repeats in low-repetition
+            # traffic cannot pin a lane to the verify path.
+            r.spec_ewma = 0.5 * r.spec_ewma + 0.5 * a
+            if r.spec_ewma < 1.0:
+                r.spec_cooldown = 8
+                r.spec_ewma = float(self.spec_draft)  # optimism on retry
+            self._register_lane(r)
 
-    def _grow(self, r: _PRow) -> bool:
-        """Allocate the blocks the next decode chunk will write for ``r``,
-        preempting the youngest other group when the pool is dry."""
-        remaining = max(r.budget - len(r.emitted), 0)
-        target = r.pos + min(self.steps_per_call, remaining)
+    def _grow(self, r: _PRow, target: int | None = None) -> bool:
+        """Allocate the blocks the next decode chunk (or speculation
+        verify, via ``target``) will write for ``r``, preempting the
+        youngest other group when the pool is dry."""
+        if target is None:
+            remaining = max(r.budget - len(r.emitted), 0)
+            target = r.pos + min(self.steps_per_call, remaining)
         need = -(-target // self.block_size)
         while len(r.blocks) < need:
-            if self._free_blocks:
-                b = self._free_blocks.pop()
-                self._h_table[r.slot, len(r.blocks)] = b
-                r.blocks.append(b)
+            b = self._alloc.alloc()
+            if b is None:
+                victim = self._pick_victim(exclude=r.group)
+                if victim is None:
+                    return False
+                self._preempt(victim)
                 continue
-            victim = self._pick_victim(exclude=r.group)
-            if victim is None:
-                return False
-            self._preempt(victim)
+            self._h_table[r.slot, len(r.blocks)] = b
+            r.blocks.append(b)
         return True
 
     def _pick_victim(self, exclude: _Group) -> _Group | None:
@@ -861,24 +1185,50 @@ class DecodePool:
             return None
         return max(victims.values(), key=lambda g: g.order)
 
+    def _release_lane(self, r: _PRow, *, register: bool) -> None:
+        """Return ``r``'s lane and blocks to the pool. ``register=True``
+        (preemption) hashes its full blocks into the prefix cache first,
+        so releasing refcounts parks them in the LRU and the resume
+        re-admission becomes a cache hit instead of a full recompute.
+        Finished rows pass ``register=False`` — their blocks were already
+        registered at the chunk boundaries that filled them (before
+        :meth:`_row_finished` EOS-padding rewrote ``emitted``)."""
+        if register:
+            self._register_lane(r)
+        # Tail-first: the LRU evicts oldest-first, and a chain is useless
+        # without its head — releasing deepest blocks first means eviction
+        # eats cached chains from the END, leaving the surviving prefix
+        # still hittable (evicting block 0 first would orphan the rest).
+        for b in reversed(r.blocks):
+            self._alloc.release(b)
+        self._h_table[r.slot, :] = self.num_blocks
+        self._h_idx[r.slot] = self.max_len
+        self._lane_rows.pop(r.slot, None)
+        self._free_lanes.append(r.slot)
+        r.slot = -1
+        r.blocks = []
+        r.pos = 0
+        r.window = 0
+        r.win_tokens = None
+        r.hashed = 0
+        r.chain_h = 0
+        r.spec_ctx = None
+        r.spec_index = None
+        r.spec_indexed = 0
+        r.spec_ewma = 0.0
+        r.spec_cooldown = 0
+
     def _preempt(self, group: _Group) -> None:
         """Preemption-to-queue with recompute resume: free the group's
         lanes and blocks, park it at the HEAD of the waiting line; its
         emitted tokens fold into the resume prompt at re-admission, so
-        greedy continuation is token-identical to an uncontended run."""
+        greedy continuation is token-identical to an uncontended run.
+        With the prefix cache on, the freed full blocks stay cached, so
+        the resume re-prefills only the uncached tail."""
         for r in list(group.rows.values()):
             if r.slot < 0 or r.done:
                 continue
-            self._free_blocks.extend(r.blocks)
-            self._h_table[r.slot, :] = self.num_blocks
-            self._h_idx[r.slot] = self.max_len
-            del self._lane_rows[r.slot]
-            self._free_lanes.append(r.slot)
-            r.slot = -1
-            r.blocks = []
-            r.pos = 0
-            r.window = 0
-            r.win_tokens = None
+            self._release_lane(r, register=True)
         self._waiting.insert(0, group)
         with self._submit_lock:
             self._backlog += 1
@@ -902,6 +1252,13 @@ class DecodePool:
                     r.group, RuntimeError("paged pool exhausted")
                 )
         live = [r for r in dec if r.slot >= 0 and not r.done]
+        for r in list(live):
+            # Defensive CoW sweep: decode writes land past the hit
+            # boundary by construction, but a shared block in the write
+            # range must never be scribbled on.
+            if not self._cow_for_write(r, r.pos, K):
+                self._fail_group(r.group, RuntimeError("paged pool exhausted"))
+        live = [r for r in live if r.slot >= 0 and not r.done]
         if not live:
             return
         tok = np.zeros((self.slots,), np.int32)
@@ -922,17 +1279,12 @@ class DecodePool:
                     break
                 r.emitted.append(int(t))
             r.pos += K
+            self._register_lane(r)
 
     def _fail_group(self, group: _Group, exc: Exception) -> None:
         for r in list(group.rows.values()):
             if r.slot >= 0:
-                self._free_blocks.extend(r.blocks)
-                self._h_table[r.slot, :] = self.num_blocks
-                self._h_idx[r.slot] = self.max_len
-                self._lane_rows.pop(r.slot, None)
-                self._free_lanes.append(r.slot)
-                r.slot = -1
-                r.blocks = []
+                self._release_lane(r, register=False)
         if not group.fut.done():
             group.fut.set_exception(exc)
 
@@ -942,13 +1294,7 @@ class DecodePool:
                 continue  # still prefilling
             if not self._row_finished(r):
                 continue
-            self._free_blocks.extend(r.blocks)
-            self._h_table[slot, :] = self.num_blocks
-            self._h_idx[slot] = self.max_len
-            r.blocks = []
-            r.slot = -1
-            del self._lane_rows[slot]
-            self._free_lanes.append(slot)
+            self._release_lane(r, register=False)
             group = r.group
             if all(pr.done for pr in group.rows.values()):
                 self._resolve_group(group)

@@ -699,6 +699,22 @@ class InferExecutorConfig:
     # Chunked prefill: prompt tokens prefilled per serve-loop iteration,
     # interleaved with decode chunks (0 = derive: 4*block_size).
     pool_prefill_chunk: int = 0
+    # Automatic prefix caching (paged mode only): admission maps the
+    # longest cached prompt-prefix into the new lane's block table
+    # (refcounted, copy-on-write on divergence) so shared system
+    # prompts / few-shot templates / multi-turn resumes skip their
+    # prefill. Additive field: absent on the wire = off, bit-identical
+    # to the pre-cache pool.
+    pool_prefix_cache: bool = False
+    # Speculative decoding via n-gram prompt-lookup drafting (paged mode
+    # only): propose the tokens that followed the most recent earlier
+    # occurrence of the context's final n-gram, verify them in one
+    # chunked-prefill-shaped dispatch, accept the greedy-matched prefix.
+    # 0 = off (additive field); 2-3 are typical.
+    pool_spec_ngram: int = 0
+    # Max draft tokens per verify dispatch (0 = derive: one less than
+    # the prefill chunk width).
+    pool_spec_draft: int = 0
     # Backpressure: reject-with-retry-after once this many requests are
     # queued unadmitted (0 = unbounded queueing, the pre-router behavior).
     queue_limit: int = 0

@@ -289,6 +289,36 @@ class JobSection:
             "(-1 = use the model config's eos_token_id)"
         },
     )
+    serve_prefix_cache: bool = field(
+        default=False,
+        metadata={
+            "doc": "serve jobs: automatic prefix caching — shared prompt "
+            "prefixes reuse cached KV blocks (paged mode only)"
+        },
+    )
+    serve_spec_ngram: int = field(
+        default=0,
+        metadata={
+            "doc": "serve jobs: speculative decoding via n-gram prompt "
+            "lookup, verified by the chunked-prefill program (0 = off; "
+            "paged mode only)"
+        },
+    )
+    serve_spec_draft: int = field(
+        default=0,
+        metadata={
+            "doc": "serve jobs: max draft tokens per speculation verify "
+            "(0 = derive: prefill chunk - 1)"
+        },
+    )
+    serve_prefix_affinity: bool = field(
+        default=False,
+        metadata={
+            "doc": "serve jobs: route requests by prompt-prefix hash so "
+            "shared-prefix traffic lands where the cache is warm "
+            "(routed deployments only)"
+        },
+    )
     dataset: str = field(
         default="mnist", metadata={"doc": "dataset name announced by a data node"}
     )
@@ -431,6 +461,20 @@ class JobSection:
                 raise ConfigError("job.serve_queue_limit must be >= 0")
             if self.serve_block_size < 0:
                 raise ConfigError("job.serve_block_size must be >= 0")
+            if self.serve_spec_ngram < 0:
+                raise ConfigError("job.serve_spec_ngram must be >= 0")
+            if self.serve_spec_draft < 0:
+                raise ConfigError("job.serve_spec_draft must be >= 0")
+            if self.serve_prefix_cache and self.serve_block_size <= 0:
+                raise ConfigError(
+                    "job.serve_prefix_cache requires serve_block_size > 0 "
+                    "(paged mode)"
+                )
+            if self.serve_spec_ngram > 0 and self.serve_block_size <= 0:
+                raise ConfigError(
+                    "job.serve_spec_ngram requires serve_block_size > 0 "
+                    "(paged mode)"
+                )
             return  # dataset/rounds are train-only concerns
         if not self.dataset:
             raise ConfigError("job.dataset is required")

@@ -42,7 +42,32 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
-__all__ = ["update_kv_cache"]
+__all__ = ["update_kv_cache", "copy_blocks"]
+
+
+def copy_blocks(cache, src: jnp.ndarray, dst: jnp.ndarray, block_size: int):
+    """Copy whole physical blocks ``src -> dst`` in every paged K/V pool
+    leaf of ``cache`` (copy-on-write: a lane about to append into a block
+    shared with other lanes gets a private copy first).
+
+    ``src``/``dst``: int32 [N] physical block ids. Only the flat
+    ``k``/``v`` pool leaves ([(blocks+1)*block_size, Hkv, D]) are touched
+    — idx/start/table are host-owned row variables. Pure function; the
+    caller jits it (donating the cache) and rewrites its table after.
+    """
+    rows_src = (
+        src[:, None] * block_size + jnp.arange(block_size)[None, :]
+    ).reshape(-1)
+    rows_dst = (
+        dst[:, None] * block_size + jnp.arange(block_size)[None, :]
+    ).reshape(-1)
+
+    def repl(path, leaf):
+        if getattr(path[-1], "key", None) in ("k", "v"):
+            return leaf.at[rows_dst].set(leaf[rows_src])
+        return leaf
+
+    return jax.tree_util.tree_map_with_path(repl, cache)
 
 
 def _physical(table, cols, block_size, max_blocks, blocks):

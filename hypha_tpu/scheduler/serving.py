@@ -28,7 +28,12 @@ same elastic-recovery shape the training orchestrator uses for replicas
   * ``queue_limit`` applies queue-depth backpressure at the router:
     when every live backend is over the line, clients get
     ``ok=False + retry_after_ms`` instead of an unbounded queue
-    (generate_remote retries on the hint).
+    (generate_remote retries on the hint);
+  * ``prefix_affinity`` routes requests sharing a prompt prefix to the
+    backend that owns it (rendezvous hash over backend names), so the
+    pool's automatic prefix cache (executor.pool ``prefix_cache``)
+    stays warm where the traffic lands — with a load-skew guard so a
+    hot prefix never becomes a hot spot.
 
 ``num_workers=1`` (the default) keeps the exact single-deployment
 behavior this class always had: no router registration, the one backend
@@ -112,6 +117,12 @@ class ServingSupervisor:
         pool_block_size: int = 0,
         pool_blocks: int = 0,
         pool_prefill_chunk: int = 0,
+        pool_prefix_cache: bool = False,
+        pool_spec_ngram: int = 0,
+        pool_spec_draft: int = 0,
+        prefix_affinity: bool = False,
+        affinity_tokens: int = 64,
+        affinity_skew: int = 4,
         eos_token_id: int | None = None,
         load_report_s: float = 1.0,
         phi_threshold: float = 8.0,
@@ -133,10 +144,19 @@ class ServingSupervisor:
             pool_block_size=pool_block_size,
             pool_blocks=pool_blocks,
             pool_prefill_chunk=pool_prefill_chunk,
+            pool_prefix_cache=pool_prefix_cache,
+            pool_spec_ngram=pool_spec_ngram,
+            pool_spec_draft=pool_spec_draft,
             queue_limit=queue_limit,
             eos_token_id=eos_token_id,
             load_report_s=load_report_s if self.route else 0.0,
         )
+        # Prefix-affinity routing: requests sharing a prompt prefix land
+        # on the same backend (where its KV blocks are already cached),
+        # unless that backend is materially busier than the best one.
+        self.prefix_affinity = bool(prefix_affinity)
+        self._affinity_tokens = max(int(affinity_tokens), 1)
+        self._affinity_skew = max(int(affinity_skew), 0)
         self.queue_limit = max(int(queue_limit), 0)
         self._resources = resources or Resources(tpu=1.0, memory=100.0)
         self._price = price or PriceRange(bid=1.0, max=10.0)
@@ -288,6 +308,28 @@ class ServingSupervisor:
         Only called on backends whose ``load`` is set (the routable set)."""
         return (dep.load.queue_depth + dep.inflight, -dep.load.free_blocks)
 
+    def _apply_affinity(self, backends: list, req: GenerateRequest) -> list:
+        """Prefix-affinity: move the backend that OWNS this prompt prefix
+        (rendezvous hash of the first ``affinity_tokens`` ids over the
+        backend names — stable under membership churn) to the front of
+        the least-loaded order, so shared-prefix traffic lands where the
+        prefix cache is warm. Load guard: if the owner is more than
+        ``affinity_skew`` queued+in-flight requests deeper than the best
+        backend, keep the least-loaded order — affinity must never turn
+        a hot prefix into a hot spot."""
+        if not self.prefix_affinity or len(backends) < 2 or not req.prompts:
+            return backends
+        key = tuple(req.prompts[0][: self._affinity_tokens])
+        owner = max(backends, key=lambda d: hash((key, d.backend_name)))
+        best = backends[0]  # already sorted by _score
+        depth = lambda d: d.load.queue_depth + d.inflight  # noqa: E731
+        if depth(owner) - depth(best) > self._affinity_skew:
+            return backends
+        if owner is not best:
+            backends = [owner] + [d for d in backends if d is not owner]
+        SERVE_METRICS.affinity_routed.add(1)
+        return backends
+
     async def _route_request(
         self, peer: str, req: GenerateRequest
     ) -> GenerateResponse:
@@ -307,6 +349,7 @@ class ServingSupervisor:
         backends = sorted(fresh or reported, key=self._score)
         if not backends:
             return GenerateResponse(tokens=[], ok=False, retry_after_ms=250.0)
+        backends = self._apply_affinity(backends, req)
         if self.queue_limit:
             depths = [d.load.queue_depth + d.inflight for d in backends]
             if min(depths) >= self.queue_limit:

@@ -253,6 +253,12 @@ class ServeMetrics:
       both as an OTLP histogram and as a bounded reservoir so
       :meth:`snapshot` can report p50/p95 directly (what SERVBENCH and
       the tests assert).
+    * ``prefix cache`` — blocks hit/missed at admission, copy-on-write
+      copies, LRU evictions, plus ``cached_blocks``/``shared_blocks``
+      gauges (snapshotted per serve-loop iteration); the snapshot
+      derives ``prefix_hit_rate`` from the hit/miss counters.
+    * ``speculation`` — drafted vs accepted tokens per verify dispatch
+      and the derived ``spec_accept_rate`` gauge.
     """
 
     _RESERVOIR = 2048
@@ -261,11 +267,20 @@ class ServeMetrics:
         self._lock = threading.Lock()
         self._free_blocks = 0.0
         self._queue_depth = 0.0
+        self._cached_blocks = 0.0
+        self._shared_blocks = 0.0
         self.admissions = Counter("hypha.serve.admissions")
         self.preemptions = Counter("hypha.serve.preemptions")
         self.rejections = Counter("hypha.serve.rejections")
         self.routed_requests = Counter("hypha.serve.routed_requests")
         self.ejections = Counter("hypha.serve.ejections")
+        self.prefix_hit_blocks = Counter("hypha.serve.prefix_hit_blocks")
+        self.prefix_miss_blocks = Counter("hypha.serve.prefix_miss_blocks")
+        self.cow_copies = Counter("hypha.serve.cow_copies")
+        self.cache_evictions = Counter("hypha.serve.cache_evictions")
+        self.spec_proposed = Counter("hypha.serve.spec_proposed")
+        self.spec_accepted = Counter("hypha.serve.spec_accepted")
+        self.affinity_routed = Counter("hypha.serve.affinity_routed")
         self.request_latency_ms = Histogram(
             "hypha.serve.request_latency", unit="ms",
             bounds=(5, 10, 25, 50, 100, 250, 500, 1000, 2500, 5000, 10000),
@@ -276,6 +291,28 @@ class ServeMetrics:
         with self._lock:
             self._free_blocks = float(free_blocks)
             self._queue_depth = float(queue_depth)
+
+    def cache_state(self, cached_blocks: float, shared_blocks: float) -> None:
+        with self._lock:
+            self._cached_blocks = float(cached_blocks)
+            self._shared_blocks = float(shared_blocks)
+
+    def cached_blocks(self) -> float:
+        with self._lock:
+            return self._cached_blocks
+
+    def shared_blocks(self) -> float:
+        with self._lock:
+            return self._shared_blocks
+
+    def prefix_hit_rate(self) -> float:
+        hit = self.prefix_hit_blocks.value()
+        total = hit + self.prefix_miss_blocks.value()
+        return hit / total if total else 0.0
+
+    def spec_accept_rate(self) -> float:
+        proposed = self.spec_proposed.value()
+        return self.spec_accepted.value() / proposed if proposed else 0.0
 
     def request_finished(self, latency_ms: float) -> None:
         self.request_latency_ms.record(latency_ms)
@@ -310,6 +347,17 @@ class ServeMetrics:
             "rejections": self.rejections.value(),
             "routed_requests": self.routed_requests.value(),
             "ejections": self.ejections.value(),
+            "prefix_hit_blocks": self.prefix_hit_blocks.value(),
+            "prefix_miss_blocks": self.prefix_miss_blocks.value(),
+            "prefix_hit_rate": self.prefix_hit_rate(),
+            "cached_blocks": self.cached_blocks(),
+            "shared_blocks": self.shared_blocks(),
+            "cow_copies": self.cow_copies.value(),
+            "cache_evictions": self.cache_evictions.value(),
+            "spec_proposed": self.spec_proposed.value(),
+            "spec_accepted": self.spec_accepted.value(),
+            "spec_accept_rate": self.spec_accept_rate(),
+            "affinity_routed": self.affinity_routed.value(),
             "request_latency_ms_count": hist["count"],
             "request_latency_ms_sum": hist["sum"],
             "request_latency_ms_p50": self._quantile(0.50),
@@ -514,6 +562,31 @@ def register_on(
         "hypha.serve.routed_requests", serve.routed_requests.value
     )
     meter.observable_gauge("hypha.serve.ejections", serve.ejections.value)
+    meter.observable_gauge(
+        "hypha.serve.prefix_hit_blocks", serve.prefix_hit_blocks.value
+    )
+    meter.observable_gauge(
+        "hypha.serve.prefix_miss_blocks", serve.prefix_miss_blocks.value
+    )
+    meter.observable_gauge(
+        "hypha.serve.prefix_hit_rate", serve.prefix_hit_rate
+    )
+    meter.observable_gauge(
+        "hypha.serve.cached_blocks", serve.cached_blocks
+    )
+    meter.observable_gauge(
+        "hypha.serve.shared_blocks", serve.shared_blocks
+    )
+    meter.observable_gauge("hypha.serve.cow_copies", serve.cow_copies.value)
+    meter.observable_gauge(
+        "hypha.serve.cache_evictions", serve.cache_evictions.value
+    )
+    meter.observable_gauge(
+        "hypha.serve.spec_accept_rate", serve.spec_accept_rate
+    )
+    meter.observable_gauge(
+        "hypha.serve.affinity_routed", serve.affinity_routed.value
+    )
     het = het if het is not None else HET_METRICS
     meter.observable_gauge("hypha.het.quorum_drops", het.quorum_drops.value)
     meter.observable_gauge(

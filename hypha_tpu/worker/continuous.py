@@ -47,6 +47,9 @@ class PoolServer:
         num_blocks: int = 0,
         prefill_chunk: int = 0,
         max_queue: int = 0,
+        prefix_cache: bool = False,
+        spec_ngram: int = 0,
+        spec_draft: int = 0,
     ) -> None:
         self.pool = DecodePool(
             model,
@@ -59,6 +62,9 @@ class PoolServer:
             num_blocks=num_blocks,
             prefill_chunk=prefill_chunk,
             max_queue=max_queue,
+            prefix_cache=prefix_cache,
+            spec_ngram=spec_ngram,
+            spec_draft=spec_draft,
         )
         self._run_fallback = run_fallback
         # Bounded one-shot decode concurrency: each distinct fallback shape

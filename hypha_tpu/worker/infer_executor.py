@@ -201,6 +201,9 @@ class InProcessInferExecutor(JobExecutor):
                     num_blocks=cfg.pool_blocks,
                     prefill_chunk=cfg.pool_prefill_chunk,
                     max_queue=cfg.queue_limit,
+                    prefix_cache=cfg.pool_prefix_cache,
+                    spec_ngram=cfg.pool_spec_ngram,
+                    spec_draft=cfg.pool_spec_draft,
                 )
             elif cfg.batch_window_ms >= 0:
                 loaded["batcher"] = self.batchers[job_id] = RequestBatcher(

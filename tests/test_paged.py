@@ -347,7 +347,23 @@ def test_serve_metrics_snapshot_and_gauges(tiny_llama):
         "hypha.serve.rejections",
         "hypha.serve.routed_requests",
         "hypha.serve.ejections",
+        "hypha.serve.prefix_hit_blocks",
+        "hypha.serve.prefix_miss_blocks",
+        "hypha.serve.prefix_hit_rate",
+        "hypha.serve.cached_blocks",
+        "hypha.serve.shared_blocks",
+        "hypha.serve.cow_copies",
+        "hypha.serve.cache_evictions",
+        "hypha.serve.spec_accept_rate",
+        "hypha.serve.affinity_routed",
     ):
         assert expected in names
+    snap = SERVE_METRICS.snapshot()
+    for key in (
+        "prefix_hit_blocks", "prefix_miss_blocks", "prefix_hit_rate",
+        "cow_copies", "cache_evictions", "spec_proposed", "spec_accepted",
+        "spec_accept_rate", "affinity_routed",
+    ):
+        assert key in snap
     _, instruments, gauges, _ = telemetry._drain()
     assert gauges[("test", "hypha.serve.admissions")][0] >= 2

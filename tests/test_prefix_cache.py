@@ -1,0 +1,366 @@
+"""Automatic prefix caching (ISSUE-12 tentpole): chain-hashed block
+sharing with refcounts, copy-on-write on divergent appends, LRU eviction,
+preemption-resume as a cache hit — plus the block-conservation property
+test guarding the allocator rewrite."""
+
+from __future__ import annotations
+
+import dataclasses
+import random
+import time
+from concurrent.futures import Future
+
+import jax
+import numpy as np
+import pytest
+
+from hypha_tpu.executor.block_cache import PrefixBlockCache, chain_hashes
+from hypha_tpu.executor.generate import generate
+from hypha_tpu.executor.pool import DecodePool, _Group
+from hypha_tpu.models import Llama, LlamaConfig
+from hypha_tpu.telemetry import SERVE_METRICS
+
+
+@pytest.fixture(scope="module")
+def tiny_llama():
+    cfg = dataclasses.replace(LlamaConfig.tiny(), dtype="float32")
+    model = Llama(cfg)
+    ids = np.zeros((1, 8), np.int32)
+    params = model.init(jax.random.key(0), ids)
+    return model, params, cfg
+
+
+def _ref(model, params, prompt, n_new):
+    return np.asarray(
+        generate(model, params, np.asarray([prompt], np.int32), n_new)
+    )[0].tolist()
+
+
+# ---------------------------------------------------------------- allocator
+
+
+def test_chain_hashes_prefix_property():
+    toks = [5, 9, 2, 7, 1, 1, 3, 8, 4, 4, 6]
+    h4 = chain_hashes(toks, 4)
+    assert len(h4) == 2  # full blocks only; the 3-token tail has no hash
+    # a longer sequence sharing the prefix shares the leading hashes
+    assert chain_hashes(toks + [9, 9, 9, 9, 9], 4)[:2] == h4
+    # ...and any divergence INSIDE an earlier block changes every hash
+    # from there on (the chain bakes the whole prefix in)
+    other = chain_hashes([5, 9, 2, 6] + toks[4:], 4)
+    assert other[0] != h4[0] and other[1] != h4[1]
+    assert chain_hashes([], 4) == []
+
+
+def test_allocator_lookup_refcount_lru_evict():
+    alloc = PrefixBlockCache(4, 2, caching=True)
+    assert alloc.free_count() == 4
+    a, b = alloc.alloc(), alloc.alloc()
+    hashes = chain_hashes([1, 2, 3, 4], 2)
+    alloc.register(a, hashes[0])
+    alloc.register(b, hashes[1])
+    # a second lane maps the cached prefix: refcounts climb, blocks shared
+    hit = alloc.lookup(hashes)
+    assert hit == [a, b]
+    assert alloc.refcount(a) == 2 and alloc.is_shared(a)
+    # releases: ref 2 -> 1 -> 0 parks REGISTERED blocks in the LRU
+    for blk in (a, b, a, b):
+        alloc.release(blk)
+    assert alloc.refcount(a) == 0
+    assert alloc.free_count() == 4  # 2 free + 2 evictable
+    # the cached content is still addressable...
+    assert alloc.peek(hashes) == (2, 2)
+    # ...until allocation pressure evicts it, oldest first
+    got = [alloc.alloc() for _ in range(4)]
+    assert set(got) == set(range(4)) and alloc.evictions == 2
+    assert alloc.peek(hashes) == (0, 0)
+    # unregistered blocks free directly (never park in the LRU)
+    for blk in got:
+        alloc.release(blk)
+    assert alloc.free_count() == 4 and alloc.cached_count() == 0
+
+
+def test_allocator_forget_and_duplicate_register():
+    alloc = PrefixBlockCache(3, 2, caching=True)
+    a = alloc.alloc()
+    alloc.register(a, 123)
+    # duplicate content on another block: the original wins
+    b = alloc.alloc()
+    alloc.register(b, 123)
+    assert not alloc.is_registered(b)
+    alloc.forget(a)
+    assert not alloc.is_registered(a)
+    assert alloc.lookup([123]) == []
+    alloc.release(a)
+    alloc.release(b)
+    assert alloc.free_count() == 3  # forgotten block freed, not parked
+
+
+def test_block_conservation_property():
+    """Random admit/grow/preempt/finish/evict sequences: every physical
+    block stays in exactly one of {free list, a live lane table, ref-0
+    cache}, and refcounts equal table references — checked after every
+    single operation."""
+    rng = random.Random(0xB10C)
+    for round_ in range(20):
+        nblocks = rng.randint(4, 24)
+        bs = rng.choice([2, 4])
+        alloc = PrefixBlockCache(nblocks, bs, caching=rng.random() < 0.8)
+        lanes: list[list[int]] = []  # live lane tables
+        corpus = [
+            [rng.randint(1, 9) for _ in range(rng.randint(1, 6 * bs))]
+            for _ in range(5)
+        ]
+        for _ in range(300):
+            op = rng.random()
+            if op < 0.45:  # admit: cached-prefix lookup + fresh alloc
+                toks = rng.choice(corpus)
+                hashes = chain_hashes(toks, bs)
+                want = -(-len(toks) // bs)
+                table = alloc.lookup(hashes)
+                while len(table) < want:
+                    b = alloc.alloc()
+                    if b is None:
+                        break
+                    table.append(b)
+                if len(table) == want:
+                    # register the full blocks (prefill completed)
+                    for j, h in enumerate(hashes):
+                        alloc.register(table[j], h)
+                    lanes.append(table)
+                else:  # could not fit: roll back like a failed admission
+                    for b in table:
+                        alloc.release(b)
+            elif op < 0.65 and lanes:  # grow a lane by one block
+                b = alloc.alloc()
+                if b is not None:
+                    rng.choice(lanes).append(b)
+            elif op < 0.9 and lanes:  # finish/preempt: release the table
+                for b in lanes.pop(rng.randrange(len(lanes))):
+                    alloc.release(b)
+            else:  # CoW: a shared block in some lane diverges
+                shared = [
+                    (li, bi)
+                    for li, t in enumerate(lanes)
+                    for bi, b in enumerate(t)
+                    if alloc.is_shared(b)
+                ]
+                if shared:
+                    li, bi = rng.choice(shared)
+                    nb = alloc.alloc()
+                    if nb is not None:
+                        alloc.release(lanes[li][bi])
+                        lanes[li][bi] = nb
+            alloc.check_conservation(lanes)
+        for table in lanes:
+            for b in table:
+                alloc.release(b)
+        alloc.check_conservation([])
+        assert alloc.free_count() == nblocks, f"round {round_} leaked"
+
+
+# ------------------------------------------------------------ pool serving
+
+
+def test_shared_prefix_skips_prefill_token_identical(tiny_llama):
+    """The headline behavior: a request sharing a cached prompt prefix
+    re-prefills ONE chunk (the uncached tail) instead of the whole
+    prompt, with exactly the uncached output."""
+    model, params, _ = tiny_llama
+    SERVE_METRICS.reset()
+    shared = [(i * 7 + 3) % 50 + 1 for i in range(32)]
+    pool = DecodePool(
+        model, params, slots=4, max_len=128, steps_per_call=4,
+        block_size=8, num_blocks=48, prefill_chunk=8, prefix_cache=True,
+    )
+    try:
+        p1 = shared + [9, 9]
+        assert pool.submit([list(p1)], 6).result(timeout=300) == [
+            _ref(model, params, p1, 6)
+        ]
+        cold = pool.prefill_chunks
+        assert cold >= 5  # 34 tokens / 8-token chunks
+        p2 = shared + [3, 1, 4]
+        assert pool.submit([list(p2)], 6).result(timeout=300) == [
+            _ref(model, params, p2, 6)
+        ]
+        assert pool.prefill_chunks - cold == 1, (
+            "warm request re-prefilled more than the uncached tail"
+        )
+        snap = SERVE_METRICS.snapshot()
+        assert snap["prefix_hit_blocks"] >= 4
+        assert snap["prefix_hit_rate"] > 0
+    finally:
+        pool.close()
+
+
+def test_cow_on_divergent_append_to_shared_block(tiny_llama):
+    """A fully block-aligned cached prompt forces the capped-hit write
+    (the last token recomputes INSIDE a shared block): while the original
+    owner is still live, the append must copy-on-write into a fresh block
+    and stay token-identical."""
+    model, params, _ = tiny_llama
+    SERVE_METRICS.reset()
+    prompt = [(i * 5 + 1) % 40 + 1 for i in range(16)]  # 4 full blocks
+    pool = DecodePool(
+        model, params, slots=4, max_len=128, steps_per_call=4,
+        block_size=4, num_blocks=64, prefill_chunk=8, prefix_cache=True,
+    )
+    try:
+        long = pool.submit([list(prompt)], 48)  # stays live for a while
+        deadline = time.time() + 300
+        while pool.chunks < 1:
+            assert time.time() < deadline
+            time.sleep(0.005)
+        got = pool.submit([list(prompt)], 6).result(timeout=300)
+        assert got == [_ref(model, params, prompt, 6)]
+        snap = SERVE_METRICS.snapshot()
+        assert snap["cow_copies"] >= 1, "shared-block append never CoW'd"
+        assert snap["prefix_hit_blocks"] >= 4
+        long.result(timeout=300)
+    finally:
+        pool.close()
+
+
+def test_exact_repeat_aligned_prompt_stays_cached(tiny_llama):
+    """Sequential identical block-aligned prompts (the capped-hit,
+    ref-1 in-place recompute path): the terminal block's registration
+    must SURVIVE the rewrite — it re-derives byte-identical K/V — so
+    every repeat after the first pays exactly one prefill chunk, with
+    no registration oscillation."""
+    model, params, _ = tiny_llama
+    SERVE_METRICS.reset()
+    prompt = [(i * 5 + 1) % 40 + 1 for i in range(16)]  # 4 full blocks
+    pool = DecodePool(
+        model, params, slots=2, max_len=64, steps_per_call=4,
+        block_size=4, num_blocks=32, prefill_chunk=4, prefix_cache=True,
+    )
+    try:
+        ref = _ref(model, params, prompt, 4)
+        assert pool.submit([list(prompt)], 4).result(timeout=300) == [ref]
+        for _ in range(3):  # every repeat: full hit, 1 recompute chunk
+            before = pool.prefill_chunks
+            assert pool.submit([list(prompt)], 4).result(timeout=300) == [
+                ref
+            ]
+            assert pool.prefill_chunks - before == 1, (
+                "terminal-block registration oscillated on exact repeat"
+            )
+    finally:
+        pool.close()
+
+
+def test_lru_eviction_under_pressure(tiny_llama):
+    """More distinct prompts than the pool can cache: old entries evict
+    (counter ticks), serving stays correct, and the idle pool conserves
+    every block."""
+    model, params, _ = tiny_llama
+    SERVE_METRICS.reset()
+    pool = DecodePool(
+        model, params, slots=2, max_len=64, steps_per_call=4,
+        block_size=4, num_blocks=8, prefill_chunk=4, prefix_cache=True,
+    )
+    try:
+        for i in range(6):
+            p = [(i * 13 + j) % 50 + 1 for j in range(8)]
+            assert pool.submit([list(p)], 4).result(timeout=300) == [
+                _ref(model, params, p, 4)
+            ]
+        assert SERVE_METRICS.snapshot()["cache_evictions"] >= 1
+        deadline = time.time() + 30
+        while pool.free_blocks() != pool.num_blocks:
+            assert time.time() < deadline, "idle pool leaked blocks"
+            time.sleep(0.01)
+    finally:
+        pool.close()
+
+
+def _park_group(pool, prompt, n_new):
+    """Stage a group on the waiting line WITHOUT waking the serve thread
+    (it blocks on the submit queue, which we never touch) — the test
+    drives ``_step_paged`` synchronously for fully deterministic
+    admission/preemption interleaving."""
+    g = _Group([list(prompt)], int(n_new), Future())
+    with pool._submit_lock:
+        pool._backlog += 1
+    pool._waiting.append(g)
+    return g
+
+
+def test_preempt_resume_is_cache_hit(tiny_llama):
+    """Acceptance pin: preemption-resume of a cached group re-prefills
+    ONLY the uncached tail. The same deterministic contended scenario
+    (two groups stepped synchronously through a too-small pool) runs with
+    the cache off and on: both preempt, both stream token-identically,
+    and the cached run's prefill_chunks counter stays strictly below the
+    uncached run's (whose every resume re-prefills prompt + emitted from
+    scratch). Block conservation is checked after every step."""
+    model, params, _ = tiny_llama
+    # 9-token prompts: decode positions stay off block boundaries, so a
+    # preempted lane donates its unregistered tail block(s) to the free
+    # list, covering the survivor's remaining growth (15 blocks = one
+    # short of both groups' combined peak) — resumes find their full
+    # blocks still cached.
+    p1 = [(i * 7 + 5) % 50 + 1 for i in range(9)]
+    p2 = [(i * 11 + 2) % 50 + 1 for i in range(9)]
+    n_new = 24
+    ref1 = _ref(model, params, p1, n_new)
+    ref2 = _ref(model, params, p2, n_new)
+
+    def run(cache: bool):
+        SERVE_METRICS.reset()
+        pool = DecodePool(
+            model, params, slots=4, max_len=64, steps_per_call=2,
+            block_size=4, num_blocks=15, prefill_chunk=4,
+            reserve_blocks=0, prefix_cache=cache,
+        )
+        try:
+            g1 = _park_group(pool, p1, n_new)
+            g2 = _park_group(pool, p2, n_new)
+            for _ in range(200):
+                if g1.fut.done() and g2.fut.done():
+                    break
+                pool._step_paged()
+                pool._alloc.check_conservation(
+                    [r.blocks for r in pool._lane_rows.values()]
+                )
+            assert g1.fut.result(timeout=1) == [ref1]
+            assert g2.fut.result(timeout=1) == [ref2]
+            assert pool.preemptions >= 1, "pool never contended"
+            pool._alloc.check_conservation([])
+            assert pool._alloc.free_count() == pool.num_blocks
+            return pool.prefill_chunks, SERVE_METRICS.snapshot()
+        finally:
+            pool.close()
+
+    chunks_off, _ = run(cache=False)
+    chunks_on, snap = run(cache=True)
+    # every full block of a preempted group's prompt+emitted was
+    # registered at preempt time, so each resume re-prefills at most the
+    # partial tail (1 chunk) instead of ceil(len/P) chunks
+    assert chunks_on < chunks_off, (
+        f"cached run prefilled {chunks_on} chunks vs {chunks_off} "
+        f"uncached — resumes re-prefilled cached blocks"
+    )
+    assert snap["prefix_hit_blocks"] >= 6, "resume never hit the cache"
+
+
+def test_prefix_cache_requires_paged_and_defaults_off(tiny_llama):
+    model, params, _ = tiny_llama
+    with pytest.raises(ValueError, match="prefix_cache requires paged"):
+        DecodePool(model, params, slots=2, max_len=64, prefix_cache=True)
+    pool = DecodePool(
+        model, params, slots=2, max_len=64, steps_per_call=2,
+        block_size=8, num_blocks=16, prefill_chunk=8,
+    )
+    try:
+        assert pool.prefix_cache is False
+        assert pool._alloc.caching is False
+        # off: a repeated prompt re-prefills from scratch (no sharing)
+        out1 = pool.submit([[5, 9, 2, 7, 1, 1, 3, 8, 4]], 4).result(timeout=300)
+        before = pool.prefill_chunks
+        out2 = pool.submit([[5, 9, 2, 7, 1, 1, 3, 8, 4]], 4).result(timeout=300)
+        assert out1 == out2
+        assert pool.prefill_chunks - before == 2  # 9 tokens / 8 per chunk
+    finally:
+        pool.close()

@@ -14,6 +14,7 @@ from hypha_tpu.ft.detector import PhiAccrualDetector
 from hypha_tpu.messages import (
     INFER_EXECUTOR_NAME,
     GenerateRequest,
+    GenerateResponse,
     ServeLoad,
 )
 from hypha_tpu.network import MemoryTransport, Node
@@ -103,6 +104,71 @@ def test_router_backpressure_unit():
             "c", GenerateRequest(serve_name="bp", prompts=[[1]])
         )
         assert resp.ok is False and resp.retry_after_ms > 0
+        sup._router.close()
+        await node.stop()
+
+    run(main())
+
+
+def test_router_prefix_affinity_unit():
+    """Prefix-affinity routing: requests sharing a prompt prefix land on
+    the same backend every time (rendezvous hash, stable under identical
+    load); a backend that gets materially busier than the best one loses
+    its affinity traffic to the load guard; affinity also pins the
+    config plumbing (supervisor kwargs -> InferExecutorConfig)."""
+
+    async def main():
+        import time as _time
+
+        hub = MemoryTransport()
+        node = Node(hub.shared(), peer_id="sched")
+        await node.start()
+        SERVE_METRICS.reset()
+        sup = ServingSupervisor(
+            node, _MODEL, "aff", num_workers=3,
+            prefix_affinity=True, affinity_skew=2,
+            pool_prefix_cache=True, pool_block_size=8, pool_spec_ngram=3,
+        )
+        # config plumbing: the knobs reach the dispatched executor config
+        assert sup._config.pool_prefix_cache is True
+        assert sup._config.pool_spec_ngram == 3
+        now = _time.monotonic()
+        fake = lambda slot, depth: _Deployment(  # noqa: E731
+            slot=slot,
+            handle=types.SimpleNamespace(peer_id=f"w{slot}", failed=None),
+            task=None, job_id=f"j{slot}", backend_name=f"aff@{slot}",
+            load=ServeLoad(job_id=f"j{slot}", queue_depth=depth),
+            load_at=now,
+        )
+        sup._deployments = [fake(0, 0), fake(1, 0), fake(2, 0)]
+        calls = []
+
+        async def fake_request(peer, proto, msg, timeout=None):
+            calls.append(msg.serve_name)
+            return GenerateResponse(tokens=[[0]])
+
+        sup.node.request = fake_request  # type: ignore[method-assign]
+        req = GenerateRequest(serve_name="aff", prompts=[[7, 7, 7, 1, 2]])
+        for _ in range(5):
+            resp = await sup._route_request("c", req)
+            assert resp.ok
+        assert len(set(calls)) == 1, f"affinity flapped: {calls}"
+        assert SERVE_METRICS.snapshot()["affinity_routed"] >= 5
+        # a DIFFERENT prefix keeps its own stable owner (may coincide)
+        other = GenerateRequest(serve_name="aff", prompts=[[9, 1, 4, 4]])
+        first = (await sup._route_request("c", other), calls[-1])[1]
+        for _ in range(3):
+            await sup._route_request("c", other)
+        assert calls[-3:] == [first] * 3
+        # load guard: the owner goes deep past the skew -> traffic falls
+        # back to least-loaded instead of piling onto the hot spot
+        owner_slot = int(calls[0].split("@")[1])
+        sup._deployments[owner_slot].load = ServeLoad(
+            job_id=f"j{owner_slot}", queue_depth=50
+        )
+        calls.clear()
+        await sup._route_request("c", req)
+        assert calls and calls[0] != f"aff@{owner_slot}"
         sup._router.close()
         await node.stop()
 

@@ -1,0 +1,176 @@
+"""Speculative decoding via n-gram prompt lookup (ISSUE-12 tentpole):
+the chunked-prefill program doubles as the verify step; greedy output is
+pinned token-identical with speculation on, off, and combined with the
+prefix cache."""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import numpy as np
+import pytest
+
+from hypha_tpu.executor.generate import generate
+from hypha_tpu.executor.pool import DecodePool
+from hypha_tpu.models import Llama, LlamaConfig
+from hypha_tpu.telemetry import SERVE_METRICS
+
+
+@pytest.fixture(scope="module")
+def tiny_llama():
+    cfg = dataclasses.replace(LlamaConfig.tiny(), dtype="float32")
+    model = Llama(cfg)
+    ids = np.zeros((1, 8), np.int32)
+    params = model.init(jax.random.key(0), ids)
+    return model, params, cfg
+
+
+def _ref(model, params, prompt, n_new):
+    return np.asarray(
+        generate(model, params, np.asarray([prompt], np.int32), n_new)
+    )[0].tolist()
+
+
+def test_spec_decode_token_identical(tiny_llama):
+    """Greedy speculation can only ever emit model-confirmed tokens: the
+    stream must equal the one-shot path EXACTLY for repetitive prompts
+    (high accept rate), periodic ones, and short arbitrary ones."""
+    model, params, _ = tiny_llama
+    pool = DecodePool(
+        model, params, slots=4, max_len=256, steps_per_call=4,
+        block_size=8, num_blocks=64, prefill_chunk=16, spec_ngram=2,
+    )
+    prompts = [
+        [5, 9, 2],
+        [1, 2, 3, 1, 2, 3, 1, 2, 3, 1, 2],
+        [7] * 20,
+        [4, 4, 8, 4, 4, 8, 4, 4],
+    ]
+    try:
+        for p in prompts:
+            got = pool.submit([list(p)], 40).result(timeout=300)
+            assert got == [_ref(model, params, p, 40)], p
+    finally:
+        pool.close()
+
+
+def test_spec_accept_rate_and_dispatch_savings(tiny_llama):
+    """On self-repetitive output the n-gram proposer drafts the loop and
+    the verify accepts multi-token prefixes: the accept-rate metrics tick
+    and speculation displaces decode chunks (fewer than budget/K decode
+    programs for the tokens emitted)."""
+    model, params, _ = tiny_llama
+    SERVE_METRICS.reset()
+    n_new = 48
+    pool = DecodePool(
+        model, params, slots=2, max_len=256, steps_per_call=4,
+        block_size=8, num_blocks=64, prefill_chunk=16, spec_ngram=2,
+    )
+    try:
+        p = [1, 2, 3, 1, 2, 3, 1, 2]
+        got = pool.submit([list(p)], n_new).result(timeout=300)
+        assert got == [_ref(model, params, p, n_new)]
+        assert pool.spec_chunks >= 1, "speculation never dispatched"
+        snap = SERVE_METRICS.snapshot()
+        assert snap["spec_proposed"] > 0
+        assert snap["spec_accepted"] > 0
+        assert 0.0 < snap["spec_accept_rate"] <= 1.0
+        # a tiny greedy model loops, so drafting covers most of the
+        # budget: plain decode would need ~n_new/K chunk programs
+        assert pool.chunks < n_new / pool.steps_per_call, (
+            f"{pool.chunks} decode chunks — speculation displaced nothing"
+        )
+    finally:
+        pool.close()
+
+
+def test_spec_with_prefix_cache_and_eos(tiny_llama):
+    """Composition: speculation + prefix cache together stay
+    token-identical, and an EOS inside an accepted draft window finishes
+    the row with the same padded stream as the plain pool."""
+    model, params, _ = tiny_llama
+    probe = DecodePool(
+        model, params, slots=2, max_len=128, steps_per_call=2,
+        block_size=8, num_blocks=32, prefill_chunk=8,
+    )
+    try:
+        first = probe.submit([[3, 3, 3]], 2).result(timeout=300)[0][0]
+    finally:
+        probe.close()
+
+    def run(**kw):
+        pool = DecodePool(
+            model, params, slots=2, max_len=128, steps_per_call=2,
+            block_size=8, num_blocks=32, prefill_chunk=8,
+            eos_token_id=int(first), **kw,
+        )
+        try:
+            return pool.submit([[3, 3, 3]], 12).result(timeout=300)
+        finally:
+            pool.close()
+
+    plain = run()
+    assert plain == run(spec_ngram=2, prefix_cache=True)
+    assert plain == run(spec_ngram=3)
+
+
+def test_spec_backoff_floors_at_plain_decode(tiny_llama):
+    """Low-repetition traffic: incidental n-gram repeats draft with a
+    near-zero accept rate — the per-lane EWMA backoff must park the lane
+    on plain decode chunks (cooldown) instead of pinning it to
+    1-token-per-wide-dispatch verifies, so the floor is the
+    non-speculative pool. Token-identity holds throughout."""
+    model, params, _ = tiny_llama
+    SERVE_METRICS.reset()
+    # this prompt's greedy continuation is NOT self-repetitive for the
+    # seeded tiny model (~0.1 simulated accept), but its trigrams repeat
+    # — the pathological case for naive always-speculate
+    p = [1, 2, 3, 4, 5, 6, 7, 8] * 2
+    n_new = 64
+    pool = DecodePool(
+        model, params, slots=2, max_len=256, steps_per_call=4,
+        block_size=8, num_blocks=64, prefill_chunk=16, spec_ngram=3,
+    )
+    try:
+        got = pool.submit([list(p)], n_new).result(timeout=300)
+        assert got == [_ref(model, params, p, n_new)]
+        # cooldown keeps verify dispatches a minority: most tokens come
+        # from decode chunks once drafts keep missing
+        assert pool.chunks > pool.spec_chunks, (
+            f"{pool.spec_chunks} verifies vs {pool.chunks} decode chunks "
+            f"— backoff never parked the mispredicting lane"
+        )
+    finally:
+        pool.close()
+
+
+def test_spec_requires_paged_and_defaults_off(tiny_llama):
+    model, params, _ = tiny_llama
+    with pytest.raises(ValueError, match="speculative decoding requires"):
+        DecodePool(model, params, slots=2, max_len=64, spec_ngram=2)
+    pool = DecodePool(
+        model, params, slots=2, max_len=64, steps_per_call=2,
+        block_size=8, num_blocks=16, prefill_chunk=8,
+    )
+    try:
+        assert pool.spec_ngram == 0 and pool.spec_chunks == 0
+    finally:
+        pool.close()
+
+
+def test_spec_draft_cap_respects_chunk_width(tiny_llama):
+    model, params, _ = tiny_llama
+    pool = DecodePool(
+        model, params, slots=2, max_len=64, steps_per_call=2,
+        block_size=8, num_blocks=16, prefill_chunk=8,
+        spec_ngram=2, spec_draft=100,
+    )
+    try:
+        # current token + drafts must fit one prefill-chunk dispatch
+        assert pool.spec_draft == pool.prefill_chunk - 1
+        got = pool.submit([[6, 6, 6, 6]], 10).result(timeout=300)
+        ref = _ref(model, params, [6, 6, 6, 6], 10)
+        assert got == [ref]
+    finally:
+        pool.close()
