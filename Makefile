@@ -3,7 +3,7 @@
 
 PYTHON ?= python
 
-.PHONY: lint lint-fixtures test compressbench streambench ftbench-ps ftbench-scheduler shardbench servbench servbench-smoke hetbench obsbench
+.PHONY: lint lint-fixtures test compressbench streambench ftbench-ps ftbench-scheduler shardbench servbench servbench-smoke hetbench obsbench obsbench-smoke
 
 lint:
 	$(PYTHON) -m hypha_tpu.analysis hypha_tpu/
@@ -91,9 +91,17 @@ ftbench-ps:
 ftbench-scheduler:
 	$(PYTHON) bench.py --chaos kill-scheduler:2
 
-# Observability plane: end-to-end round tracing overhead (traced round
-# wall within 3% of untraced) and critical-path attribution (a bw-capped
-# peer's upload span named as the stall by the merged timeline). Writes
-# OBSBENCH_r10.json + OBSBENCH_r10.telemetry.json (docs/observability.md).
+# Observability planes: end-to-end round tracing (traced round wall
+# within 3% of untraced; a bw-capped peer's upload span named as the
+# stall by the merged timeline) AND the live metrics plane (metrics-on
+# round wall within 3% of off; the fleet bandwidth rollup names the
+# bw-capped peer's gauge as the outlier; gap-free loss curves across a
+# kill-worker rejoin; reporting-off wire golden-pinned). Writes
+# OBSBENCH_r11.json + OBSBENCH_r11.telemetry.json (docs/observability.md).
 obsbench:
 	JAX_PLATFORMS=cpu $(PYTHON) benchmarks/obsbench.py
+
+# CI-sized obsbench (the obs.yml workflow's smoke path).
+obsbench-smoke:
+	JAX_PLATFORMS=cpu $(PYTHON) benchmarks/obsbench.py --smoke --skip-trace \
+		--out /tmp/OBSBENCH_smoke.json

@@ -48,6 +48,11 @@ def run_chaos_scenario(
     round_deadline_s: float = 6.0,
     trace_dir: "str | None" = None,
     model_scale: int = 1,
+    metrics_plane: bool = False,
+    metrics_dir: "str | None" = None,
+    slo_rules: "list | None" = None,
+    metrics_interval_s: float = 0.25,
+    samples_per_round: int = 24,
 ) -> dict:
     # Scheduler scenarios run the dedicated two-pass harness (no-kill
     # baseline + chaos run, final weights compared bit-for-bit).
@@ -61,7 +66,8 @@ def run_chaos_scenario(
         )
     return _run_worker_ps_scenario(
         spec, num_workers, rounds, quorum_fraction, round_deadline_s,
-        trace_dir, model_scale,
+        trace_dir, model_scale, metrics_plane, metrics_dir, slo_rules,
+        metrics_interval_s, samples_per_round,
     )
 
 
@@ -73,6 +79,11 @@ def _run_worker_ps_scenario(
     round_deadline_s: float,
     trace_dir: "str | None",
     model_scale: int,
+    metrics_plane: bool = False,
+    metrics_dir: "str | None" = None,
+    slo_rules: "list | None" = None,
+    metrics_interval_s: float = 0.25,
+    samples_per_round: int = 24,
 ) -> dict:
     """Run one chaos scenario; returns the FTBENCH result dict.
 
@@ -82,7 +93,11 @@ def _run_worker_ps_scenario(
     (telemetry.trace) and flight-recorder spill into that directory for
     the run's duration. ``model_scale`` multiplies the toy model's width
     so the delta grows (obsbench's bw-cap run needs uploads that dwarf
-    compute).
+    compute). ``metrics_plane`` turns on the live metrics plane
+    (telemetry.metrics_plane): every node reports registry deltas to the
+    scheduler's collector, training-quality series ride the round
+    metrics, and the result grows a ``metrics_plane`` section (fleet
+    rollups, loss curves, SLO state, journal path).
     """
     from safetensors.numpy import save_file
 
@@ -195,7 +210,8 @@ def _run_worker_ps_scenario(
             },
             dataset="toy",
             rounds=DiLoCoRounds(
-                update_rounds=rounds, avg_samples_between_updates=24,
+                update_rounds=rounds,
+                avg_samples_between_updates=max(int(samples_per_round), 1),
                 max_batch_size=4,
             ),
             inner_optimizer=Adam(lr=1e-3),
@@ -218,6 +234,10 @@ def _run_worker_ps_scenario(
             # Durable PS state lives under the checkpoint dir — required
             # for the kill-ps recovery path (journal + outer checkpoint).
             checkpoint_dir=str(tmp / "ckpt") if ps_scenario else None,
+            metrics_plane=metrics_plane,
+            metrics_interval_s=metrics_interval_s,
+            metrics_dir=metrics_dir,
+            slo_rules=list(slo_rules or []),
         )
 
         replacement = mk_worker(f"{victim}b") if kill_actions else None
@@ -309,6 +329,38 @@ def _run_worker_ps_scenario(
             round(first_metric[b] - first_metric[a], 4)
             for a, b in zip(ordered, ordered[1:])
         ]
+        metrics_summary = None
+        if metrics_plane and orch.metrics is not None:
+            store = orch.metrics.store
+            # PEAK upload rate per peer: a blocking round drags every
+            # peer's average down to the straggler's pace, but only the
+            # capped link's burst rate never exceeds its cap — the rollup
+            # the bw-cap outlier probe reads.
+            peak_mbps = store.fleet_peak("node.bandwidth_out_mbps")
+            outlier = store.outlier(
+                "node.bandwidth_out_mbps", values=peak_mbps
+            )
+            metrics_summary = {
+                "reports": orch.metrics.reports,
+                "journal": (
+                    str(orch.metrics.journal_path)
+                    if orch.metrics.journal_path is not None
+                    else None
+                ),
+                "bandwidth_out_mbps": {
+                    p: round(v, 4) for p, v in peak_mbps.items()
+                },
+                "bandwidth_outlier": (
+                    {"peer": outlier[0], "mbps": round(outlier[1], 4)}
+                    if outlier is not None
+                    else None
+                ),
+                "loss_rounds": {
+                    str(r): {p: round(v, 6) for p, v in peers.items()}
+                    for r, peers in store.quality_rounds("loss").items()
+                },
+                "slo": orch.metrics.watchdog.state(),
+            }
         return {
             "metric": "ft_chaos_rounds_completed",
             "value": result.rounds,
@@ -337,6 +389,7 @@ def _run_worker_ps_scenario(
             "wall_s": round(wall_s, 1),
             "round_walls_s": round_walls,
             "trace_dir": trace_dir,
+            "metrics_plane": metrics_summary,
             "vs_baseline": None,  # the seed aborts the whole job here
         }
 

@@ -79,6 +79,7 @@ def _modules():
     from hypha_tpu import messages
     from hypha_tpu.ft import membership  # extends the manifest at import
     from hypha_tpu.scheduler import job_config  # noqa: F401  (ditto)
+    from hypha_tpu.telemetry import metrics_plane  # noqa: F401  (ditto)
 
     return messages, membership
 

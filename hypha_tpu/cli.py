@@ -270,6 +270,23 @@ async def _run_scheduler(conf: SchedulerConfig) -> None:
             # SIGINT/SIGTERM.
             from .scheduler.serving import ServingSupervisor
 
+            # Live metrics plane for serve deployments: a collector on
+            # this scheduler node ingests the serving workers' registry
+            # reports + ServeLoad relays, journals metrics-<name>.jsonl,
+            # and answers `telemetry.top <addr>` queries. Off by default.
+            collector = None
+            if conf.job.metrics_plane:
+                from .telemetry.metrics_plane import MetricsCollector
+
+                collector = MetricsCollector(
+                    node,
+                    # Prefix-matches the supervisor's dispatched job ids
+                    # ("serve-<name>-<slot>-<uuid>"), so the serving
+                    # workers' reports are accepted.
+                    f"serve-{conf.job.serve_name}",
+                    slo_rules=list(conf.job.slo_rules),
+                    journal_dir=conf.job.metrics_dir or None,
+                ).start()
             sup = ServingSupervisor(
                 node,
                 conf.job.to_model_spec(),
@@ -292,6 +309,12 @@ async def _run_scheduler(conf: SchedulerConfig) -> None:
                     if conf.job.serve_eos_token_id < 0
                     else conf.job.serve_eos_token_id
                 ),
+                report_metrics_s=(
+                    conf.job.metrics_interval_s
+                    if conf.job.metrics_plane
+                    else None
+                ),
+                metrics=collector,
             )
             print(
                 f"serving {conf.job.serve_name!r} "
@@ -309,6 +332,8 @@ async def _run_scheduler(conf: SchedulerConfig) -> None:
                 signal_task.cancel()
             await sup.stop()
             await runner
+            if collector is not None:
+                await collector.close()
             return
         connector = (
             AimConnector(conf.status_bridge) if conf.status_bridge else NoOpConnector()

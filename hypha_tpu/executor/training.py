@@ -925,6 +925,53 @@ def run_training(
     rtrace = _RoundTrace(trace_node)
     round_samples = 0
     round_losses: list[float] = []
+    # Live metrics plane (telemetry.metrics_plane): reporting jobs attach
+    # round-tagged training-quality keys (loss EWMA, delta norm, tokens/s,
+    # inner steps) to the METRICS progress they already send per round.
+    # Off (the default) leaves the metrics dict — and the wire — exactly
+    # as it is today.
+    report_quality = bool(getattr(cfg, "report_metrics_s", None))
+    _EWMA_BETA = 0.7
+    qstate: dict[str, Any] = {
+        "ewma": None, "t0": time.monotonic(), "tokens": 0.0, "batches": 0,
+    }
+
+    def quality_metrics(mean_loss: float) -> dict:
+        """One round's quality keys; resets the per-round accumulators."""
+        now = time.monotonic()
+        dur = max(now - qstate["t0"], 1e-9)
+        ewma = qstate["ewma"]
+        if not math.isnan(mean_loss):
+            ewma = (
+                mean_loss
+                if ewma is None
+                else _EWMA_BETA * ewma + (1.0 - _EWMA_BETA) * mean_loss
+            )
+            qstate["ewma"] = ewma
+        out = {
+            "loss_ewma": float(ewma) if ewma is not None else mean_loss,
+            "tokens_per_s": float(qstate["tokens"]) / dur,
+            "inner_steps": float(qstate["batches"]),
+        }
+        qstate.update(t0=now, tokens=0.0, batches=0)
+        return out
+
+    def note_quality_batch(batch: Any) -> None:
+        qstate["batches"] += 1
+        ids = batch.get("input_ids") if isinstance(batch, dict) else None
+        qstate["tokens"] += (
+            float(np.asarray(ids).size)
+            if ids is not None
+            else float(cfg.batch_size)
+        )
+
+    def delta_norm_of(flat: dict) -> float:
+        """L2 norm of the shipped (post-EF) delta — one definition for
+        every sync path's quality report."""
+        return float(
+            np.sqrt(sum(float(np.vdot(v, v)) for v in flat.values()))
+        )
+
     # Last PS generation seen on the results stream (ft.durable): a change
     # mid-wait means the parameter server restarted — the shipped delta may
     # have died with it unjournaled, so the worker re-pushes it.
@@ -1209,12 +1256,16 @@ def run_training(
         )
         trace.finish(up_span)
         mean_loss = float(np.mean(round_losses)) if round_losses else math.nan
+        round_metrics = {"loss": mean_loss, "samples": float(round_samples)}
+        if report_quality:
+            round_metrics.update(quality_metrics(mean_loss))
+            round_metrics["delta_norm"] = delta_norm_of(wire_flat)
         send_status_gated(
             Progress(
                 kind=ProgressKind.METRICS,
                 job_id=spec.job_id,
                 round=round_num,
-                metrics={"loss": mean_loss, "samples": float(round_samples)},
+                metrics=round_metrics,
                 traceparent=round_tp,
             )
         )
@@ -1416,12 +1467,16 @@ def run_training(
             _push_part(p, path, samples)
         trace.finish(up_span)
         mean_loss = float(np.mean(round_losses)) if round_losses else math.nan
+        round_metrics = {"loss": mean_loss, "samples": samples}
+        if report_quality:
+            round_metrics.update(quality_metrics(mean_loss))
+            round_metrics["delta_norm"] = delta_norm_of(wire_flat)
         send_status_gated(
             Progress(
                 kind=ProgressKind.METRICS,
                 job_id=spec.job_id,
                 round=round_num,
-                metrics={"loss": mean_loss, "samples": samples},
+                metrics=round_metrics,
                 traceparent=round_tp,
             )
         )
@@ -1542,12 +1597,17 @@ def run_training(
         )
         stream_state.begin(round_num, state.params, anchor, round_samples)
         mean_loss = float(np.mean(round_losses)) if round_losses else math.nan
+        round_metrics = {"loss": mean_loss, "samples": float(round_samples)}
+        if report_quality:
+            # No delta norm here: the due fragment's delta belongs to the
+            # background flight thread (stream mode).
+            round_metrics.update(quality_metrics(mean_loss))
         send_status_gated(
             Progress(
                 kind=ProgressKind.METRICS,
                 job_id=spec.job_id,
                 round=round_num,
-                metrics={"loss": mean_loss, "samples": float(round_samples)},
+                metrics=round_metrics,
                 traceparent=round_tp,
             )
         )
@@ -1628,6 +1688,8 @@ def run_training(
             result.losses.append(loss)
             result.batches += 1
             round_samples += cfg.batch_size
+            if report_quality:
+                note_quality_batch(batch)
 
             resp = send_status_gated(
                 Progress(

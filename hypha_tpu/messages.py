@@ -571,6 +571,16 @@ class TrainExecutorConfig:
     # Additive field: None (the only value a non-recoverable job ships) is
     # omitted from the wire — scheduler recovery off keeps today's bytes.
     adopt_grace_s: float | None = None
+    # Live metrics plane (hypha_tpu.telemetry.metrics_plane): the worker
+    # runtime samples its metric registry every report_metrics_s seconds
+    # into MetricsReport deltas pushed to metrics_peer (the scheduler's
+    # collector) on /hypha-metrics/0.0.1, and the training executor adds
+    # round-tagged quality keys (loss EWMA, delta norm, tokens/s) to its
+    # METRICS progress. Additive fields: None — the only value a
+    # non-reporting job ships — is omitted from the wire entirely, so
+    # metrics off keeps today's exact bytes.
+    report_metrics_s: float | None = None
+    metrics_peer: str | None = None
 
 
 @register
@@ -645,6 +655,13 @@ class AggregateExecutorConfig:
     # failed attempt, so an already-quorate round closes without the
     # scheduler). Additive field: None is omitted from the wire.
     adopt_grace_s: float | None = None
+    # Live metrics plane (hypha_tpu.telemetry.metrics_plane), mirroring
+    # the train side: the PS runtime reports registry deltas to
+    # metrics_peer, and the aggregation loop attaches round-tagged
+    # quality (pseudo-gradient/update norms, accepted deltas) to its
+    # Updated notifies. Additive fields: None is omitted from the wire.
+    report_metrics_s: float | None = None
+    metrics_peer: str | None = None
 
 
 @register
@@ -725,6 +742,13 @@ class InferExecutorConfig:
     # Load-report heartbeat cadence toward the scheduler-side router
     # (ServeLoad on /hypha-serve/0.0.1; 0 disables reporting).
     load_report_s: float = 1.0
+    # Live metrics plane (hypha_tpu.telemetry.metrics_plane): serving
+    # workers report registry deltas (pool gauges, latency summaries,
+    # fabric bytes) to metrics_peer every report_metrics_s seconds.
+    # Additive fields: None is omitted from the wire — metrics off keeps
+    # today's exact bytes.
+    report_metrics_s: float | None = None
+    metrics_peer: str | None = None
 
 
 @register

@@ -1687,8 +1687,19 @@ class Node:
         stream = await self._stream_to(peer_id, PROTOCOL_PUSH)
         try:
             await stream.write_frame(messages.encode(resource))
-            n = await self._write_source(stream, source)
-            self.bytes_out += n
+            if isinstance(
+                source, (bytes, bytearray, memoryview, str)
+            ) or hasattr(source, "__fspath__"):
+                # Lump-sum accounting keeps the sendfile fast path.
+                n = await self._write_source(stream, source)
+                self.bytes_out += n
+            else:
+                # Streamed (iterator) sources credit the outbound counter
+                # chunk by chunk: a slow / throttled transfer must read as
+                # its true rate on the bandwidth gauges, not as one burst
+                # at completion (the metrics plane's link rollups compare
+                # rates across peers).
+                n = await self._write_source(_CountingStream(stream, self), source)
             return n
         finally:
             await stream.close()

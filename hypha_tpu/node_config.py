@@ -438,6 +438,35 @@ class JobSection:
         default=10.0,
         metadata={"doc": "adaptive_codec: links below this ship int4"},
     )
+    metrics_plane: bool = field(
+        default=False,
+        metadata={
+            "doc": "live metrics plane: nodes push periodic MetricsReport "
+            "deltas to the scheduler on /hypha-metrics/0.0.1; the scheduler "
+            "aggregates, journals metrics-<job>.jsonl and evaluates "
+            "slo_rules (off = byte-identical wire)"
+        },
+    )
+    metrics_interval_s: float = field(
+        default=1.0,
+        metadata={"doc": "metrics plane: seconds between node reports"},
+    )
+    metrics_dir: str = field(
+        default="",
+        metadata={
+            "doc": "metrics plane: journal directory (empty = the trace "
+            "dir when tracing is on, else no journal)"
+        },
+    )
+    slo_rules: list = field(
+        default_factory=list,
+        metadata={
+            "doc": "metrics plane: declarative SLO rules, e.g. "
+            "'hypha.serve.request_latency_ms.p99 <= 250', "
+            "'round_wall_s <= 30', 'silent_s <= 15' — breaches log "
+            "advisories and fire flight events"
+        },
+    )
 
     def validate(self) -> None:
         if self.kind not in ("train", "serve"):
@@ -512,6 +541,16 @@ class JobSection:
             raise ConfigError(
                 "job.codec_bw_lo_mbps must be <= job.codec_bw_hi_mbps"
             )
+        if self.metrics_interval_s <= 0:
+            raise ConfigError("job.metrics_interval_s must be positive")
+        if self.slo_rules:
+            from .telemetry.slo import parse_slo_rule
+
+            for rule in self.slo_rules:
+                try:
+                    parse_slo_rule(str(rule))
+                except ValueError as e:
+                    raise ConfigError(f"job.slo_rules: {e}") from None
         if self.round_deadline_s < 0:
             raise ConfigError("job.round_deadline_s must be >= 0")
         if self.phi_threshold <= 0:
@@ -584,6 +623,10 @@ class JobSection:
             adaptive_codec=self.adaptive_codec,
             codec_bw_hi_mbps=self.codec_bw_hi_mbps,
             codec_bw_lo_mbps=self.codec_bw_lo_mbps,
+            metrics_plane=self.metrics_plane,
+            metrics_interval_s=self.metrics_interval_s,
+            metrics_dir=self.metrics_dir or None,
+            slo_rules=list(self.slo_rules),
             ft=(
                 FTConfig(
                     quorum_fraction=self.quorum_fraction,

@@ -155,6 +155,27 @@ class DiLoCoJob:
     # their leases for the adoption grace. Off (default) ships today's
     # exact wire and behavior.
     scheduler_recovery: bool = False
+    # Live metrics plane (hypha_tpu.telemetry.metrics_plane): every node
+    # samples its metric registry into periodic MetricsReport deltas
+    # pushed to the scheduler on /hypha-metrics/0.0.1; the scheduler
+    # aggregates them into a bounded time-series store with fleet
+    # rollups, journals a round-stamped metrics-<job>.jsonl next to the
+    # trace spans, and evaluates the declarative SLO rules below
+    # (breaches fire flight events and logged advisories — enforcement
+    # stays future work). Workers and the PS additionally attach
+    # round-tagged training-quality series (loss EWMA, delta norm,
+    # tokens/s) to their existing progress messages, so loss curves
+    # become a first-class artifact. Off (default) ships byte-identical
+    # wire: no config field, header key or protocol is spoken.
+    metrics_plane: bool = False
+    metrics_interval_s: float = 1.0
+    # Where metrics-<job>.jsonl lands; None = the active trace directory
+    # (when tracing is on), else no journal.
+    metrics_dir: str | None = None
+    # Declarative SLO rules, e.g. "hypha.serve.request_latency_ms.p99 <=
+    # 250", "round_wall_s <= 30", "hypha.het.quorum_drops == 0",
+    # "silent_s <= 15" (grammar: hypha_tpu.telemetry.slo).
+    slo_rules: list = field(default_factory=list)
 
     def __post_init__(self) -> None:
         if self.delta_dtype not in ("float32", "bfloat16"):
@@ -233,6 +254,12 @@ class DiLoCoJob:
                 "scheduler_recovery needs elastic membership (job.ft) — "
                 "re-adoption rides the same lease/quorum machinery"
             )
+        if self.metrics_interval_s <= 0:
+            raise ValueError("metrics_interval_s must be positive")
+        if self.slo_rules:
+            from ..telemetry.slo import parse_slo_rules
+
+            parse_slo_rules(self.slo_rules)  # raises on a bad rule
         if self.rounds.update_rounds <= 0:
             raise ValueError("update_rounds must be positive")
         if self.rounds.avg_samples_between_updates <= 0:

@@ -73,6 +73,7 @@ from ..messages import (
     Fetch,
     JobSpec,
     Progress,
+    ProgressKind,
     Receive,
     Reference,
     SchedulerHello,
@@ -199,6 +200,10 @@ class _RunContext:
         self.adopt_grace: float | None = None
         self.batch_scheduler: "BatchScheduler | None" = None
         self.round_journaled = -1
+        # Live metrics plane (telemetry.metrics_plane): the scheduler-side
+        # collector (None when job.metrics_plane is off — the default, no
+        # new wire at all).
+        self.metrics = None
 
 
 class Orchestrator:
@@ -210,6 +215,10 @@ class Orchestrator:
         self.node = node
         self.allocator = GreedyWorkerAllocator(node)
         self.metrics_bridge = MetricsBridge(metrics_connector)
+        # The last run's live-metrics collector (telemetry.metrics_plane):
+        # kept on the orchestrator so benches/embedders can read the
+        # store's rollups and loss curves after run() returns.
+        self.metrics = None
 
     # ------------------------------------------------------------ allocation
 
@@ -465,6 +474,19 @@ class Orchestrator:
                     # (None — recovery off — ships no new wire field).
                     # getattr: tests drive this with bare namespace ctxs.
                     adopt_grace_s=getattr(ctx, "adopt_grace", None),
+                    # Live metrics plane: report cadence + the collector
+                    # peer (this scheduler). None — metrics off — ships
+                    # no new wire fields.
+                    report_metrics_s=(
+                        float(getattr(job, "metrics_interval_s", 1.0))
+                        if getattr(job, "metrics_plane", False)
+                        else None
+                    ),
+                    metrics_peer=(
+                        self.node.peer_id
+                        if getattr(job, "metrics_plane", False)
+                        else None
+                    ),
                     checkpoint=(
                         {
                             "dir": f"{job.checkpoint_dir}/{handle.peer_id}",
@@ -599,6 +621,17 @@ class Orchestrator:
                         # notify (broadcast-first) across a scheduler
                         # outage (None = recovery off, no new wire).
                         adopt_grace_s=ctx.adopt_grace,
+                        # Live metrics plane (None = off, no new wire).
+                        report_metrics_s=(
+                            float(getattr(job, "metrics_interval_s", 1.0))
+                            if getattr(job, "metrics_plane", False)
+                            else None
+                        ),
+                        metrics_peer=(
+                            self.node.peer_id
+                            if getattr(job, "metrics_plane", False)
+                            else None
+                        ),
                     ),
                 ),
             )
@@ -695,6 +728,40 @@ class Orchestrator:
             )
         )
 
+    def _start_metrics(self, ctx: _RunContext, job: DiLoCoJob) -> None:
+        """Stand up the live metrics plane's scheduler half (the
+        MetricsCollector): store + SLO watchdog + journal + the
+        /hypha-metrics handler. No-op (today's exact behavior and wire)
+        unless ``job.metrics_plane`` is on."""
+        if not getattr(job, "metrics_plane", False):
+            return
+        from ..telemetry.metrics_plane import MetricsCollector
+
+        journal_dir = getattr(job, "metrics_dir", None)
+        if journal_dir is None:
+            tracing = trace.active()
+            journal_dir = tracing.trace_dir if tracing is not None else None
+
+        def on_advisory(adv) -> None:
+            # Advisory, not actuator: the orchestrator LOGS the breach
+            # (the RoundMembership posture); enforcement is future work.
+            log.warning(
+                "SLO advisory for job %s: %s (peer=%s value=%.6g) — "
+                "logged only",
+                adv.job_id or ctx.base_id, adv.rule, adv.peer or "fleet",
+                adv.value,
+            )
+
+        ctx.metrics = MetricsCollector(
+            self.node,
+            ctx.base_id,
+            slo_rules=list(getattr(job, "slo_rules", []) or []),
+            journal_dir=journal_dir,
+            on_advisory=on_advisory,
+            round_fn=lambda: ctx.tracker.round if ctx.tracker else 0,
+        ).start()
+        self.metrics = ctx.metrics
+
     def _start_control(
         self,
         ctx: _RunContext,
@@ -714,6 +781,11 @@ class Orchestrator:
         def on_metrics(peer: str, round_num: int, metrics: dict) -> None:
             collected.append((peer, round_num, metrics))
             self.metrics_bridge.on_metrics(peer, round_num, metrics)
+            if ctx.metrics is not None:
+                # Round-tagged training-quality points (loss, loss EWMA,
+                # delta norm, tokens/s) join the live store — the
+                # loss-curve feed benchmarks/convergence.py consumes.
+                ctx.metrics.ingest_quality(peer, round_num, metrics)
 
         batch_scheduler = BatchScheduler(
             ctx.tracker, on_metrics=on_metrics, on_complete=ctx.complete.set,
@@ -738,6 +810,17 @@ class Orchestrator:
                 # Status heartbeats mostly, but the PS's Updated and the
                 # round metrics count too.
                 ctx.detector.heartbeat(peer)
+            if (
+                ctx.metrics is not None
+                and progress.kind == ProgressKind.UPDATED
+            ):
+                # The PS's round-tagged quality (pseudo-gradient/update
+                # norms, accepted deltas) rides its Updated notify — only
+                # reporting jobs attach the key, so the static wire is
+                # untouched.
+                quality = dict(progress.metrics).get("quality")
+                if isinstance(quality, dict):
+                    ctx.metrics.ingest_quality(peer, progress.round, quality)
             response = batch_scheduler.on_progress(peer, progress)
             self._journal_round_soon(ctx)
             if (
@@ -850,6 +933,9 @@ class Orchestrator:
             self._plan_streams(
                 ctx, job, worker_peers, ps_peers, num_shards, parts
             )
+            # Live metrics plane: collector after the base id exists (the
+            # journal is named for the job), before anything dispatches.
+            self._start_metrics(ctx, job)
             sched_root = self._scheduler_root(job)
             if sched_root is not None:
                 # Durable control plane: open FRESH (a previous attempt's
@@ -901,6 +987,8 @@ class Orchestrator:
                 )
             if ctx.dur is not None:
                 await asyncio.to_thread(ctx.dur.close)
+            if ctx.metrics is not None:
+                await ctx.metrics.close()
             if progress_reg is not None:
                 progress_reg.close()
             if ctx.data_scheduler is not None:
@@ -1072,6 +1160,7 @@ class Orchestrator:
             self._plan_streams(
                 ctx, job, sorted(plan_workers), ps_peers, num_shards, parts
             )
+            self._start_metrics(ctx, job)
             # Latest per-execution dispatch records, classified. Train
             # records for departed peers (a rejoin superseded them) are
             # skipped via the journaled membership's active list.
@@ -1278,6 +1367,8 @@ class Orchestrator:
                     *list(ctx.notify_tasks), return_exceptions=True
                 )
             await asyncio.to_thread(ctx.dur.close)
+            if ctx.metrics is not None:
+                await ctx.metrics.close()
             if progress_reg is not None:
                 progress_reg.close()
             if ctx.data_scheduler is not None:

@@ -128,8 +128,16 @@ class ServingSupervisor:
         phi_threshold: float = 8.0,
         eject_check_s: float = 0.25,
         request_timeout: float = 120.0,
+        report_metrics_s: float | None = None,
+        metrics=None,
     ) -> None:
         self.node = node
+        # Live metrics plane (telemetry.metrics_plane): an optional
+        # MetricsCollector sharing this scheduler node — ServeLoad
+        # heartbeats are relayed into its store, and dispatched serving
+        # jobs carry report_metrics_s/metrics_peer so serving workers run
+        # registry reporters. None (default) = no new wire or behavior.
+        self.metrics = metrics
         self.serve_name = serve_name
         self.num_workers = max(int(num_workers), 1)
         # Routing defaults on exactly when there is something to balance;
@@ -150,6 +158,10 @@ class ServingSupervisor:
             queue_limit=queue_limit,
             eos_token_id=eos_token_id,
             load_report_s=load_report_s if self.route else 0.0,
+            report_metrics_s=(
+                float(report_metrics_s) if report_metrics_s else None
+            ),
+            metrics_peer=(node.peer_id if report_metrics_s else None),
         )
         # Prefix-affinity routing: requests sharing a prompt prefix land
         # on the same backend (where its KV blocks are already cached),
@@ -415,6 +427,15 @@ class ServingSupervisor:
                 dep.load = load
                 dep.load_at = time.monotonic()
                 self._detector.heartbeat(peer)
+                if self.metrics is not None:
+                    # Live metrics plane: serve queue depths / KV headroom
+                    # join the fleet store per backend, so telemetry.top
+                    # and serve-SLO rules see the routed deployments too.
+                    self.metrics.ingest_serve_load(
+                        load.serve_name or f"{peer}:{load.job_id}",
+                        float(load.queue_depth),
+                        float(load.free_blocks),
+                    )
                 return ServeLoadAck(ok=True)
         return ServeLoadAck(ok=False)  # stale job (already torn down)
 

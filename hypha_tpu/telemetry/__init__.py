@@ -213,7 +213,11 @@ class Counter:
         if amount < 0:
             raise ValueError("counter increments must be non-negative")
         with self._lock:
-            self._value += amount
+            # float() here, not at read time: a numpy/jax scalar increment
+            # would otherwise promote the accumulator to np.float32 and
+            # leak a non-JSON-serializable scalar into every snapshot
+            # (pinned by the metrics_snapshot JSON-safety property test).
+            self._value += float(amount)
 
     def value(self) -> float:
         with self._lock:
@@ -235,6 +239,7 @@ class Histogram:
         self._lock = threading.Lock()
 
     def record(self, value: float, **_attrs) -> None:
+        value = float(value)  # numpy/jax scalars must not taint the sum
         with self._lock:
             self._sum += value
             self._count += 1

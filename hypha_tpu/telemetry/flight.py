@@ -23,7 +23,9 @@ from __future__ import annotations
 
 import atexit
 import json
+import os
 import re
+import signal as _signal
 import threading
 import time
 from collections import deque
@@ -133,6 +135,85 @@ class FlightRecorder:
                     f.write(json.dumps(rec, default=str) + "\n")
             written.append(path)
         return written
+
+    def _snapshot_lockfree(self) -> list[dict]:
+        """Ring copy that NEVER blocks on the recorder lock.
+
+        The SIGUSR2 handler runs in the main thread between bytecodes —
+        if the interrupted frame holds ``self._lock`` (``record`` on a
+        hot path, ``spill``), acquiring it from the handler would
+        deadlock the process the tool exists to diagnose. ``deque``
+        appends are themselves thread-safe; a concurrent mutation during
+        the copy raises RuntimeError, which the retry absorbs.
+        """
+        for _ in range(8):
+            try:
+                return list(self._ring)
+            except RuntimeError:
+                continue
+        return []
+
+    def dump(self, path: str | Path | None = None) -> Path | None:
+        """Live capture WITHOUT draining: write the ring's current
+        contents to one file and leave the ring intact.
+
+        The wedged-node tool: unlike :meth:`spill` (which drains, so the
+        exit hook stays idempotent), ``dump`` is a read-only snapshot —
+        an operator can take several while the node stays stuck and each
+        shows the full recent history. Default target:
+        ``events-<node>-dump.jsonl`` under the spill dir (or the CWD when
+        no spill dir is armed). Deliberately LOCK-FREE end to end: it is
+        the signal handler's body, and the interrupted frame may hold the
+        recorder lock (attribute reads are atomic under the GIL).
+        """
+        events = self._snapshot_lockfree()
+        base = self.spill_dir
+        node = self.node
+        if path is None:
+            safe = _SAFE_NODE.sub("-", node) or "node"
+            path = (base or Path(".")) / f"events-{safe}-dump.jsonl"
+        path = Path(path)
+        path.parent.mkdir(parents=True, exist_ok=True)
+        with open(path, "w", encoding="utf-8") as f:
+            for rec in events:
+                f.write(json.dumps(rec, default=str) + "\n")
+        return path
+
+    def arm_signal(self, signum: int | None = None) -> bool:
+        """Install a ``SIGUSR2`` handler that :meth:`dump`\\ s the ring.
+
+        ``kill -USR2 <pid>`` then captures a wedged node's recent events
+        live — no RPC, no cooperation from the (possibly stuck) event
+        loop: the handler only snapshots a deque and writes one file.
+        Returns False when signals cannot be installed here (non-main
+        thread, platforms without SIGUSR2) — callers treat that as a
+        soft no.
+        """
+        if signum is None:
+            signum = getattr(_signal, "SIGUSR2", None)
+            if signum is None:  # platform without SIGUSR2
+                return False
+
+        def _on_signal(_signum, _frame) -> None:
+            try:
+                path = self.dump()
+                # A signal handler can't log safely through arbitrary
+                # handlers; a direct low-level write is async-signal-ish
+                # enough for a diagnostics path.
+                os.write(
+                    2,
+                    f"[flight] dumped ring to {path}\n".encode(
+                        "utf-8", "replace"
+                    ),
+                )
+            except Exception:
+                pass
+
+        try:
+            _signal.signal(signum, _on_signal)
+            return True
+        except (ValueError, OSError):  # not the main thread
+            return False
 
     def _spill_quiet(self) -> None:
         try:

@@ -115,7 +115,9 @@ class StreamMetrics:
 
     def flight_started(self, nbytes: float) -> None:
         with self._lock:
-            self._in_flight += nbytes
+            # float(): a numpy byte count must not promote the gauge to a
+            # non-JSON-serializable scalar (metrics_snapshot JSON-safety).
+            self._in_flight += float(nbytes)
             self.peak_bytes_in_flight = max(
                 self.peak_bytes_in_flight, self._in_flight
             )
@@ -126,10 +128,11 @@ class StreamMetrics:
         :meth:`flight_started` from the thread's exit path, so a failed
         job can never read as mid-upload for the process lifetime."""
         with self._lock:
-            self._in_flight = max(0.0, self._in_flight - nbytes)
+            self._in_flight = max(0.0, self._in_flight - float(nbytes))
 
     def flight_finished(self, flight_s: float, overlapped_s: float) -> None:
         """One sync completed end to end (merge applied)."""
+        flight_s, overlapped_s = float(flight_s), float(overlapped_s)
         with self._lock:
             self.flight_seconds += flight_s
             # Compute can't overlap more than the flight lasted (timer skew).
@@ -148,6 +151,7 @@ class StreamMetrics:
 
     def fragment_closed(self, fragment_id: int) -> None:
         """One (round, fragment) closed on the parameter server."""
+        fragment_id = int(fragment_id)  # np.int64 keys break json.dumps
         with self._lock:
             counter = self.fragment_closes.get(fragment_id)
             created = counter is None

@@ -89,15 +89,52 @@ def load_jsonl(path: str | Path) -> list[dict]:
     return out
 
 
+def _is_span(rec: dict) -> bool:
+    """Span-shaped: a name plus a numeric start. Anything else in a
+    spans file (a metrics journal dropped under the wrong name, a foreign
+    build's records) is skipped with a warning instead of crashing the
+    merge downstream."""
+    return isinstance(rec.get("name"), str) and isinstance(
+        rec.get("start_ns"), (int, float)
+    )
+
+
 def load_dir(trace_dir: str | Path) -> tuple[list[dict], list[dict]]:
-    """(spans, events) merged from every per-node file under the dir."""
+    """(spans, events) merged from every per-node file under the dir.
+
+    Resilient by design: the trace directory is shared with the flight
+    recorder's ``events-*.jsonl`` AND the metrics plane's
+    ``metrics-*.jsonl`` journal — only span/event files are read, and a
+    non-span record inside a spans file is skipped with a warning. A peer
+    that has events but no spans file (it crashed before its first span
+    flushed, or ran untraced) merges fine: its events still appear in the
+    tail, it just contributes no phases.
+    """
     trace_dir = Path(trace_dir)
     spans: list[dict] = []
     events: list[dict] = []
     for path in sorted(trace_dir.glob("spans-*.jsonl")):
-        spans.extend(load_jsonl(path))
+        recs = load_jsonl(path)
+        good = [r for r in recs if _is_span(r)]
+        if len(good) != len(recs):
+            print(
+                f"[timeline] {path.name}: skipped {len(recs) - len(good)} "
+                "non-span records",
+                file=sys.stderr,
+            )
+        spans.extend(good)
     for path in sorted(trace_dir.glob("events-*.jsonl")):
         events.extend(load_jsonl(path))
+    span_nodes = {s.get("node") or "node" for s in spans}
+    event_nodes = {e.get("node") or "node" for e in events}
+    missing = sorted(event_nodes - span_nodes)
+    if spans and missing:
+        print(
+            f"[timeline] no spans for peer(s) {', '.join(missing)} "
+            "(crashed before flushing, or untraced) — events merged, "
+            "phases skipped",
+            file=sys.stderr,
+        )
     return spans, events
 
 
@@ -232,7 +269,10 @@ def build_timeline(trace_dir: str | Path) -> dict:
             for s in recs:
                 off = offsets.get(s.get("node") or "node", 0.0)
                 s0 = int(s.get("start_ns", 0)) / 1e9 + off
-                s1 = int(s.get("end_ns", s.get("start_ns", 0))) / 1e9 + off
+                end = s.get("end_ns")
+                if not isinstance(end, (int, float)):  # foreign/torn record
+                    end = s.get("start_ns", 0)
+                s1 = int(end) / 1e9 + off
                 lo = s0 if lo is None else min(lo, s0)
                 hi = s1 if hi is None else max(hi, s1)
             wall = (hi - lo) if lo is not None and hi is not None else 0.0

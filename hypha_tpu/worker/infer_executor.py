@@ -228,6 +228,21 @@ class InProcessInferExecutor(JobExecutor):
                     what="serve load reporter",
                     logger=log,
                 )
+            report_s = getattr(cfg, "report_metrics_s", None)
+            if report_s:
+                # Live metrics plane (telemetry.metrics_plane): registry
+                # deltas — pool gauges, request-latency summaries, fabric
+                # bytes — to the scheduler's collector. Off = no reporter,
+                # no new wire.
+                from ..telemetry.metrics_plane import MetricsReporter
+
+                registration["metrics"] = MetricsReporter(
+                    self.node,
+                    getattr(cfg, "metrics_peer", None) or scheduler_peer,
+                    job_id,
+                    peer=f"{self.node.peer_id}:{cfg.serve_name}",
+                    interval_s=float(report_s),
+                ).start()
 
         loader = asyncio.create_task(bring_up())
 
@@ -237,6 +252,8 @@ class InProcessInferExecutor(JobExecutor):
             if registration.get("reg") is not None:
                 registration["reg"].close()
             await aio.reap(registration.get("load"))
+            if registration.get("metrics") is not None:
+                await registration["metrics"].stop()
             batcher = self.batchers.pop(job_id, None)
             if batcher is not None:
                 # Drop the batcher's closure over model/params too — a

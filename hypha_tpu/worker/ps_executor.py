@@ -468,6 +468,27 @@ class ParameterServerExecutor(JobExecutor):
         # how long the Updated notify may park across a scheduler outage
         # (0 = today's single-attempt behavior).
         park_s = float(getattr(cfg, "adopt_grace_s", 0) or 0)
+        # Live metrics plane (telemetry.metrics_plane): registry deltas to
+        # the scheduler's collector, plus round-tagged quality (the
+        # pseudo-gradient/update norms computed in _outer_step) attached
+        # to Updated notifies. None (default) = no reporter, no new wire.
+        reporter = None
+        report_s = getattr(cfg, "report_metrics_s", None)
+        if report_s and self.node is not None:
+            from ..telemetry.metrics_plane import MetricsReporter
+
+            def _gen() -> "int | None":
+                g = getattr(execution, "scheduler_generation", None)
+                return int(g) if g is not None else None
+
+            reporter = MetricsReporter(
+                self.node,
+                getattr(cfg, "metrics_peer", None) or scheduler_peer,
+                job_id,
+                interval_s=float(report_s),
+                round_fn=lambda: execution.round,
+                generation_fn=_gen,
+            ).start()
         try:
             # Crash recovery (ft.durable): restore the outer-state
             # checkpoint, replay committed rounds from the journal, re-send
@@ -564,10 +585,11 @@ class ParameterServerExecutor(JobExecutor):
                     "outer_step", parent=ptrace.ctx(round_num),
                     attrs={"round": round_num}, node=ptrace.node,
                 )
+                quality = {} if report_s else None
                 update_path = await asyncio.to_thread(
                     self._outer_step,
                     received, momentum_file, lr, mu, work_dir, round_num,
-                    accum,
+                    accum, quality,
                 )
                 trace.finish(outer_span)
                 if link is not None:
@@ -598,6 +620,7 @@ class ParameterServerExecutor(JobExecutor):
                         traceparent=ptrace.ctx(round_num),
                         execution=execution, park_s=park_s,
                         on_first_failure=bcast_adaptive,
+                        quality=quality,
                     )
                     ptrace.adopt(response, round_num + 1)
                     await bcast_adaptive()
@@ -681,6 +704,7 @@ class ParameterServerExecutor(JobExecutor):
                     traceparent=ptrace.ctx(round_num),
                     execution=execution, park_s=park_s,
                     on_first_failure=bcast_static,
+                    quality=quality,
                 )
                 ptrace.adopt(response, round_num + 1)
                 if dur is not None:
@@ -711,6 +735,8 @@ class ParameterServerExecutor(JobExecutor):
             log.exception("parameter server job %s failed", job_id)
             execution.finish("failed", str(e))
         finally:
+            if reporter is not None:
+                await reporter.stop()
             if membership_reg is not None:
                 membership_reg.close()
             consumer.close()
@@ -1636,10 +1662,15 @@ class ParameterServerExecutor(JobExecutor):
                     attrs={"round": round_num, "fragment": frag},
                     node=ptrace.node,
                 )
+                quality = (
+                    {"fragment": float(frag)}
+                    if getattr(cfg, "report_metrics_s", None)
+                    else None
+                )
                 update_path = await asyncio.to_thread(
                     self._outer_step,
                     received, momentum_file, lr, mu, work_dir, round_num,
-                    accum,
+                    accum, quality,
                 )
                 trace.finish(outer_span)
                 if frag not in bcast_efs:
@@ -1742,6 +1773,7 @@ class ParameterServerExecutor(JobExecutor):
                     traceparent=ptrace.ctx(round_num),
                     execution=execution, park_s=park_s,
                     on_first_failure=launch_bcast,
+                    quality=quality,
                 )
                 ptrace.adopt(response, next_owned(round_num + 1))
                 if dur is not None:
@@ -2278,6 +2310,7 @@ class ParameterServerExecutor(JobExecutor):
         work_dir: Path,
         round_num: int,
         accum: "_RoundAccum | None" = None,
+        stats: dict | None = None,
     ) -> Path:
         """Nesterov over the round's sample-weighted mean pseudo-gradient.
 
@@ -2286,6 +2319,10 @@ class ParameterServerExecutor(JobExecutor):
         run here (C++ flat kernel via native.nesterov_update, numpy
         fallback). Callers without an accumulator (tests, the degenerate
         path) fold the received files now, with the same validation.
+        ``stats`` (metrics plane, None = skip the extra flops) is filled
+        with the round's training-quality numbers: the L2 norms of the
+        mean pseudo-gradient and of the applied outer update, plus the
+        accepted-delta count.
         """
         if accum is None or accum.folds == 0:
             accum = _RoundAccum() if accum is None else accum
@@ -2312,6 +2349,12 @@ class ParameterServerExecutor(JobExecutor):
             new_m, upd = native.nesterov_update(m, g.ravel(), lr, mu)
             momentum[key] = new_m.reshape(g.shape)
             update[key] = upd.reshape(g.shape)
+        if stats is not None:
+            g_sq = sum(float(np.vdot(g, g)) for g in mean.values())
+            u_sq = sum(float(np.vdot(u, u)) for u in update.values())
+            stats["delta_norm"] = float(np.sqrt(g_sq))
+            stats["update_norm"] = float(np.sqrt(u_sq))
+            stats["accepted"] = float(len(received))
         save_file(update, str(out))
         save_file(momentum, str(momentum_tmp))
         os.replace(momentum_tmp, momentum_file)
@@ -2563,6 +2606,7 @@ class ParameterServerExecutor(JobExecutor):
         arrivals: "dict[str, float] | None" = None,
         traceparent: str | None = None,
         execution=None,
+        quality: "dict | None" = None,
     ) -> ProgressResponse:
         gen = (
             getattr(execution, "scheduler_generation", None)
@@ -2585,6 +2629,12 @@ class ParameterServerExecutor(JobExecutor):
             progress.metrics = {
                 "arrival_s": {p: round(t, 6) for p, t in arrivals.items()}
             }
+        if quality:
+            # Metrics plane (telemetry.metrics_plane): the round's
+            # training-quality numbers (pseudo-gradient/update norms,
+            # accepted deltas) ride the round-tagged Updated — only
+            # reporting jobs attach the key; the static wire is untouched.
+            progress.metrics = {**progress.metrics, "quality": dict(quality)}
         resp = await self.node.request(
             scheduler_peer, PROTOCOL_PROGRESS, progress, timeout=30
         )
@@ -2613,6 +2663,7 @@ class ParameterServerExecutor(JobExecutor):
         execution=None,
         park_s: float = 0.0,
         on_first_failure=None,
+        quality: "dict | None" = None,
     ) -> ProgressResponse:
         """Updated notify that survives a scheduler outage.
 
@@ -2636,7 +2687,7 @@ class ParameterServerExecutor(JobExecutor):
             return await self._notify_updated(
                 scheduler_peer, job_id, round_num, shard=shard,
                 arrivals=arrivals, traceparent=traceparent,
-                execution=execution,
+                execution=execution, quality=quality,
             )
         failures = {"n": 0}
 
@@ -2645,7 +2696,7 @@ class ParameterServerExecutor(JobExecutor):
                 return await self._notify_updated(
                     scheduler_peer, job_id, round_num, shard=shard,
                     arrivals=arrivals, traceparent=traceparent,
-                    execution=execution,
+                    execution=execution, quality=quality,
                 )
             except (RequestError, OSError, asyncio.TimeoutError):
                 failures["n"] += 1

@@ -74,11 +74,26 @@ class InProcessTrainExecutor(JobExecutor):
         from ..stream.reduce import maybe_start_reducer
 
         reducer = maybe_start_reducer(self.node, spec)
+        # Live metrics plane (telemetry.metrics_plane): periodic registry
+        # deltas to the scheduler's collector. None (the default) starts
+        # nothing — off ships no /hypha-metrics traffic at all.
+        reporter = None
+        report_s = getattr(train_cfg, "report_metrics_s", None)
+        if report_s:
+            from ..telemetry.metrics_plane import MetricsReporter
+
+            reporter = MetricsReporter(
+                self.node,
+                getattr(train_cfg, "metrics_peer", None) or scheduler_peer,
+                job_id,
+                interval_s=float(report_s),
+                round_fn=lambda: execution.round,
+            ).start()
         stop_flag = threading.Event()
         runner = asyncio.create_task(
             self._run(
                 execution, spec, socket_path, work_dir, bridge, stop_flag,
-                reducer,
+                reducer, reporter,
             )
         )
 
@@ -124,6 +139,7 @@ class InProcessTrainExecutor(JobExecutor):
         bridge: Bridge,
         stop_flag: threading.Event,
         reducer=None,
+        reporter=None,
     ) -> None:
         from ..executor.bridge_client import Session
         from ..executor.training import run_training
@@ -157,6 +173,10 @@ class InProcessTrainExecutor(JobExecutor):
                 log.exception("in-process training job %s failed", spec.job_id)
                 execution.finish("failed", str(e))
         finally:
+            if reporter is not None:
+                # Final flush: the last round's counters reach the
+                # collector before the node tears the job down.
+                await reporter.stop()
             if reducer is not None:
                 await reducer.stop()
             await bridge.stop()

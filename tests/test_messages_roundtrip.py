@@ -17,6 +17,7 @@ import pytest
 from hypha_tpu import messages
 from hypha_tpu.ft import membership  # noqa: F401  registers the FT types
 from hypha_tpu.scheduler import job_config  # noqa: F401  registers job types
+from hypha_tpu.telemetry import metrics_plane  # noqa: F401  metrics types
 from hypha_tpu.analysis.proto_rules import (
     REQUIRES_ROUND_TAG,
     sample_instance,

@@ -193,3 +193,59 @@ def test_timeline_cli_writes_json(tmp_path, capsys):
     assert len(out["rounds"]) == 3
     text = capsys.readouterr().out
     assert "stall:" in text and "round" in text
+
+
+# ---------------------------------------------------------------------------
+# Resilience (ISSUE 13 satellite): a trace dir shared with the metrics
+# plane must merge without crashing — metrics journals are not spans, a
+# peer may have events but no spans file, and a spans file may hold
+# foreign records.
+# ---------------------------------------------------------------------------
+
+
+def test_timeline_tolerates_metrics_journal_in_trace_dir(tmp_path):
+    _skewed_trace(tmp_path, {"w0": 0.0, "w1": 0.0, "psw": 0.0})
+    # The metrics plane's journal lives next to the spans (same dir).
+    (tmp_path / "metrics-abc123.jsonl").write_text(
+        json.dumps({"type": "report", "t": 1.0, "peer": "w0",
+                    "counters": {"node.bytes_out": 10}}) + "\n"
+        + json.dumps({"type": "quality", "t": 2.0, "peer": "w0",
+                      "round": 0, "loss": 3.5}) + "\n"
+    )
+    out = timeline.build_timeline(tmp_path)
+    assert len(out["rounds"]) == 3  # journal ignored, merge unchanged
+
+
+def test_timeline_skips_non_span_records_with_warning(tmp_path, capsys):
+    _skewed_trace(tmp_path, {"w0": 0.0, "w1": 0.0, "psw": 0.0})
+    # A metrics journal dropped under a spans-* name (operator mistake):
+    # its records have no span shape and must be skipped, not crash the
+    # int(start_ns) math downstream.
+    (tmp_path / "spans-oops.jsonl").write_text(
+        json.dumps({"type": "report", "t": 1.0, "peer": "w9",
+                    "gauges": {"q": 1}}) + "\n"
+        + json.dumps({"name": 42, "start_ns": "soon"}) + "\n"
+    )
+    out = timeline.build_timeline(tmp_path)
+    assert len(out["rounds"]) == 3
+    assert "non-span records" in capsys.readouterr().err
+
+
+def test_timeline_peer_with_events_but_no_spans(tmp_path, capsys):
+    """A node that crashed before flushing any span (or ran untraced)
+    still contributes its flight events to the tail — with a warning,
+    never a crash."""
+    _skewed_trace(tmp_path, {"w0": 0.0, "w1": 0.0, "psw": 0.0})
+    (tmp_path / "events-ghost.jsonl").write_text(
+        json.dumps({"t_mono_ns": 1, "t_wall_ns": int(1001e9),
+                    "event": "chaos.kill", "node": "ghost"}) + "\n"
+    )
+    out = timeline.build_timeline(tmp_path)
+    assert len(out["rounds"]) == 3
+    assert any(e["event"] == "chaos.kill" for e in out["events"])
+    assert "ghost" in capsys.readouterr().err
+
+
+def test_timeline_empty_dir_is_clean(tmp_path):
+    out = timeline.build_timeline(tmp_path)
+    assert out["rounds"] == [] and out["num_spans"] == 0
