@@ -105,3 +105,17 @@ obsbench:
 obsbench-smoke:
 	JAX_PLATFORMS=cpu $(PYTHON) benchmarks/obsbench.py --smoke --skip-trace \
 		--out /tmp/OBSBENCH_smoke.json
+
+# Control-plane scale harness (ISSUE 14): 128 in-process workers on the
+# memory fabric, star vs multi-level reduce/broadcast trees, plus a
+# kill-a-mid-tree-reducer chaos run. Asserts tree PS egress <= 0.25x
+# star at N=128, sublinear round wall + scheduler CPU, zero
+# double-counted deltas under the kill. Writes SCALEBENCH_r12.json.
+scalebench:
+	JAX_PLATFORMS=cpu $(PYTHON) benchmarks/scalebench.py \
+		--out SCALEBENCH_r12.json
+
+# CI-sized scalebench (the scale.yml workflow's smoke path: N in {4,16}).
+scalebench-smoke:
+	JAX_PLATFORMS=cpu $(PYTHON) benchmarks/scalebench.py --smoke \
+		--out /tmp/SCALEBENCH_smoke.json

@@ -1,0 +1,659 @@
+"""Control-plane scale harness: 100+ lightweight workers, real wire.
+
+Spawns N in-process workers on the memory fabric speaking the REAL wire
+protocol — per-batch Status/ScheduleUpdate over ``/hypha-progress``
+against a real :class:`BatchScheduler`, one-hot delta pushes through the
+real multi-level :class:`GroupReducer` tree into a real (elastic)
+:class:`ParameterServerExecutor`, update broadcasts back down the real
+:class:`BroadcastRelay` tree — with STUBBED compute (``hetbench.py``'s
+memory-fabric pattern, minus jax): a worker's "inner step" is a 1 ms
+sleep and its pseudo-gradient is the one-hot vector ``e_i``.
+
+The one-hot deltas make double-counting *observable at the workers*:
+every broadcast update's nonzero components must be exactly equal (a
+double-counted worker would weigh 2× its siblings) and their count is the
+round's accepted cover — the cover-set assertion the chaos run leans on.
+
+Scenarios per N ∈ {4, 32, 128} (``--smoke``: {4, 16}):
+
+  * **star** — today's topology: W direct pushes in, W broadcast pushes
+    out, every control sweep linear;
+  * **tree** — ``reduce_group_size``/``reduce_tree_depth`` reduce tree +
+    mirrored broadcast tree;
+  * **chaos** (largest N, tree) — a MID-tree reducer is killed after
+    round 1: its leaves fail over direct-to-shard, the broadcast hop
+    expands around it, and every remaining round must close with zero
+    double-counted deltas.
+
+Measured per scenario: round wall-clock, PS egress bytes/round
+(``node.bytes_out``), scheduler control-loop ms/round
+(``SCALE_METRICS.sched_progress_ms``), per-protocol control-plane bytes.
+
+Asserted (ISSUE 14 acceptance):
+
+  * tree PS egress/round at N_max <= 0.25x star's at the same N;
+  * star->tree egress ratio grows with N (the tree is the scaling fix);
+  * round wall-clock grows SUBLINEARLY from N_min to N_max;
+  * scheduler CPU per round per PEER stays within 1.75x across the
+    fleet growth. Every worker necessarily sends a handful of control
+    messages per round, so the per-round total is Omega(N) for any
+    scheduler; what this PR fixes is every per-message cost that scaled
+    with N (round gating O(changed), one projection per round via the
+    plan cache + capped-capacity memo instead of one-per-worker
+    O(N^2 log N), O(1) tracker census and detector checks). Measured
+    per-message cost is flat N=4 -> N=32 and rises ~1.5x at N=128 from
+    cache pressure (128 concurrent worker tasks sharing one
+    interpreter) — an environmental level shift, not algorithmic
+    growth; the pre-fix quadratic paths measured 2.7x per-peer growth
+    already at a 4x fleet, so the 1.75x bound cleanly separates the
+    two;
+  * the chaos run completes every round, zero double-counts.
+
+Run: ``make scalebench`` (outside tier-1) or
+``python benchmarks/scalebench.py --out SCALEBENCH_r12.json``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import os
+import sys
+import tempfile
+import time
+import types
+from pathlib import Path
+
+import numpy as np
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+
+def _log(msg: str) -> None:
+    print(f"[scalebench] {msg}", file=sys.stderr, flush=True)
+
+
+STATUS_PER_ROUND = 3  # round sample target = N * this (batch size 1)
+QUORUM_FRACTION = 0.75
+
+
+async def _bench_scenario(
+    n: int,
+    rounds: int,
+    topology: str,
+    group_size: int,
+    depth: int,
+    kill_peer: str | None,
+    round_deadline_s: float,
+    tmp: Path,
+) -> dict:
+    from safetensors.numpy import load_file, save_file
+
+    from hypha_tpu import messages
+    from hypha_tpu.ft.detector import PhiAccrualDetector
+    from hypha_tpu.ft.membership import MembershipView
+    from hypha_tpu.messages import (
+        PROTOCOL_PROGRESS,
+        AggregateExecutorConfig,
+        Executor,
+        JobSpec,
+        Nesterov,
+        Progress,
+        ProgressKind,
+        ProgressResponse,
+        ProgressResponseKind,
+        Receive,
+        Reference,
+        Send,
+        ShardMap,
+    )
+    from hypha_tpu.network import MemoryTransport, Node
+    from hypha_tpu.network.node import RequestError
+    from hypha_tpu.scheduler.batch_scheduler import BatchScheduler
+    from hypha_tpu.scheduler.orchestrator import Orchestrator, _RunContext
+    from hypha_tpu.scheduler.trackers import ProgressTracker
+    from hypha_tpu.stream import ancestors_of, build_reduce_groups, children_of
+    from hypha_tpu.stream.reduce import BroadcastRelay, GroupReducer
+    from hypha_tpu.telemetry.ft_metrics import SCALE_METRICS
+    from hypha_tpu.worker.ps_executor import ParameterServerExecutor
+
+    SCALE_METRICS.reset()
+    workers = [f"w{i:03d}" for i in range(n)]
+    tree = topology == "tree"
+    groups = build_reduce_groups(workers, group_size, depth) if tree else []
+    kids = children_of(groups)
+    smap = (
+        ShardMap(
+            round=0, shards=["ps0"], tags=["updates"], fragments=1,
+            groups=[list(g) for g in groups],
+            tree_depth=(depth if depth >= 2 else None),
+        )
+        if tree
+        else None
+    )
+
+    hub = MemoryTransport()
+    nodes = {p: Node(hub.shared(), peer_id=p) for p in ["sched", "ps0", *workers]}
+    for node in nodes.values():
+        await node.start()
+    addrs = {p: node.listen_addrs[0] for p, node in nodes.items()}
+    for a in nodes.values():
+        for p, addr in addrs.items():
+            if p != a.peer_id:
+                a.add_peer_addr(p, addr)
+
+    # ------------------------------------------------------ scheduler side
+    tracker = ProgressTracker(
+        parameter_server=["ps0"],
+        update_target=n * STATUS_PER_ROUND,
+        update_epochs=rounds,
+    )
+    for w in workers:
+        tracker.add_worker(w, 1)
+    detector = PhiAccrualDetector()
+    membership = MembershipView(list(workers))
+    round_closes: list[float] = []
+    bs = BatchScheduler(tracker)
+    job_id = "scale-agg"
+
+    orch = Orchestrator.__new__(Orchestrator)
+    orch.node = nodes["sched"]
+    ctx = _RunContext()
+    ctx.membership = membership
+    ctx.ps_job_ids = [job_id]
+    ctx.ps_handles = [types.SimpleNamespace(peer_id="ps0")]
+
+    async def on_progress(peer: str, progress: Progress):
+        detector.heartbeat(peer)
+        resp = bs.on_progress(peer, progress)
+        if progress.kind == ProgressKind.UPDATED:
+            round_closes.append(time.monotonic())
+            # The real elastic membership sweep (encode-once + bounded
+            # fan-out) runs once per round — its /hypha-ft bytes land in
+            # the control-plane accounting.
+            await orch._notify_membership(ctx)
+        return resp
+
+    progress_reg = nodes["sched"].on(PROTOCOL_PROGRESS, Progress).respond_with(
+        on_progress
+    )
+
+    # ------------------------------------------------------------- PS side
+    spec = JobSpec(
+        job_id=job_id,
+        executor=Executor(
+            kind="aggregate",
+            name="parameter-server",
+            aggregate=AggregateExecutorConfig(
+                updates=Receive(Reference.from_peers(list(workers), "updates")),
+                results=Send(Reference.from_peers(list(workers), "results")),
+                optimizer=Nesterov(lr=1.0, momentum=0.0),
+                num_workers=n,
+                quorum_fraction=QUORUM_FRACTION,
+                round_deadline_s=round_deadline_s,
+                broadcast_tree=smap,
+            ),
+        ),
+    )
+    pse = ParameterServerExecutor(nodes["ps0"], tmp / f"ps-{topology}-{n}")
+    ps_bytes_before = nodes["ps0"].bytes_out
+    execution = await pse.execute(job_id, spec, "sched")
+
+    # ---------------------------------------------------------- tree roles
+    reducers: dict[str, GroupReducer] = {}
+    relays: dict[str, BroadcastRelay] = {}
+    for head, members in kids.items():
+        parent = None
+        for g in groups:
+            if head in g[1:]:
+                parent = g[0]
+        cfg = types.SimpleNamespace(
+            ps_shards=smap,
+            reduce_members=list(members),
+            reduce_via=parent,
+            delta_codec="none",
+            delta_dtype="float32",
+            sync_mode="blocking",
+        )
+        reducer = GroupReducer(nodes[head], cfg, work_dir=tmp / f"red-{head}")
+        reducer.start()
+        reducers[head] = reducer
+        relay = BroadcastRelay(
+            nodes[head],
+            types.SimpleNamespace(
+                ps_shards=smap,
+                results=Receive(Reference.from_peers(["ps0"], "results")),
+            ),
+            work_dir=tmp / f"relay-{head}",
+        )
+        relay.start()
+        relays[head] = relay
+
+    # --------------------------------------------------------- worker loop
+    dead = asyncio.Event()
+    cover_violations: list[str] = []
+    covers_seen: dict[int, int] = {}
+    # Per-peer round watermarks: the kill gates on its SUBTREE having
+    # merged round 0 — a leaf enters round 1 only after the relay hop
+    # delivered the wire, so the node can't die holding an already-acked
+    # broadcast it never re-pushed (the relay hop is at-most-once per
+    # wire; a real deployment re-syncs such a loss via the durable PS
+    # generation bump, which this harness doesn't model).
+    round_at: dict[str, int] = {}
+    from hypha_tpu.stream import subtree_of
+
+    kill_subtree = (
+        set(subtree_of(groups, kill_peer)) - {kill_peer}
+        if (tree and kill_peer is not None)
+        else set()
+    )
+
+    async def run_worker(idx: int, peer: str) -> int:
+        node = nodes[peer]
+        delta = {"g": np.zeros(n, np.float32)}
+        delta["g"][idx] = 1.0
+        f = tmp / f"delta-{peer}.st"
+        save_file(delta, str(f))
+        # Route: leaves push [reducer, shard] ANY; reducers push to their
+        # parent (or direct at the top) — exactly connectors.shard_route.
+        route = ["ps0"]
+        if tree:
+            parent = None
+            for g in groups:
+                if peer in g[1:]:
+                    parent = g[0]
+            if parent is not None:
+                route = [parent, "ps0"]
+        allowed = {"ps0", *(ancestors_of(groups, peer) if tree else ())}
+
+        def wants(push) -> bool:
+            r = push.resource
+            return isinstance(r, dict) and r.get("resource") == "results"
+
+        consumer = node.consume_pushes(wants)
+        completed = 0
+        try:
+            rnd = 0
+            while True:
+                round_at[peer] = rnd
+                if kill_peer == peer and rnd >= 1:
+                    # Wait for the subtree's round-0 merges (see round_at
+                    # above) — then die mid-round-1: members' reduce
+                    # pushes fail over, the broadcast expands around.
+                    while any(round_at.get(m, 0) < 1 for m in kill_subtree):
+                        await asyncio.sleep(0.002)
+                    dead.set()
+                    return completed
+                # Inner steps: Status per batch until a sync point.
+                counter = None
+                while counter is None:
+                    await asyncio.sleep(0.001)
+                    resp = await node.request(
+                        "sched", PROTOCOL_PROGRESS,
+                        Progress(
+                            kind=ProgressKind.STATUS, job_id=f"{job_id}-{peer}",
+                            batch_size=1, round=rnd,
+                        ),
+                        timeout=30,
+                    )
+                    if resp.kind == ProgressResponseKind.SCHEDULE_UPDATE:
+                        counter = int(resp.counter or 0)
+                    elif resp.kind == ProgressResponseKind.DONE:
+                        return completed
+                for _ in range(counter):
+                    await asyncio.sleep(0.001)
+                    await node.request(
+                        "sched", PROTOCOL_PROGRESS,
+                        Progress(
+                            kind=ProgressKind.STATUS, job_id=f"{job_id}-{peer}",
+                            batch_size=1, round=rnd,
+                        ),
+                        timeout=30,
+                    )
+                # Ship the pseudo-gradient (ANY failover up the tree).
+                header = {
+                    "resource": "updates", "name": f.name, "round": rnd,
+                    "num_samples": 1.0,
+                }
+                last: Exception | None = None
+                for target in route:
+                    try:
+                        await node.push(target, header, f)
+                        last = None
+                        break
+                    except (RequestError, OSError) as e:
+                        last = e
+                if last is not None:
+                    raise last
+                await node.request(
+                    "sched", PROTOCOL_PROGRESS,
+                    Progress(
+                        kind=ProgressKind.UPDATE, job_id=f"{job_id}-{peer}",
+                        round=rnd,
+                    ),
+                    timeout=30,
+                )
+                # Await the round's broadcast (from the PS or any ancestor
+                # relay), verify the one-hot cover algebra.
+                while True:
+                    push = await consumer.next(timeout=120)
+                    meta = push.resource if isinstance(push.resource, dict) else {}
+                    if push.peer not in allowed:
+                        cover_violations.append(
+                            f"{peer}: broadcast from non-ancestor {push.peer}"
+                        )
+                    got_round = int(meta.get("round", -1))
+                    dest = tmp / f"bcast-{peer}.st"
+                    await push.save_to(dest)
+                    if got_round >= rnd:
+                        break
+                update = load_file(str(dest))["g"]
+                nz = update[np.abs(update) > 1e-12]
+                if idx == 0 and len(nz):
+                    lo, hi = float(np.min(np.abs(nz))), float(np.max(np.abs(nz)))
+                    if hi / max(lo, 1e-30) > 1.0 + 1e-6:
+                        cover_violations.append(
+                            f"round {got_round}: unequal components "
+                            f"(double count): min {lo} max {hi}"
+                        )
+                    covers_seen[got_round] = int(len(nz))
+                resp = await node.request(
+                    "sched", PROTOCOL_PROGRESS,
+                    Progress(
+                        kind=ProgressKind.UPDATE_RECEIVED,
+                        job_id=f"{job_id}-{peer}", round=rnd,
+                    ),
+                    timeout=30,
+                )
+                completed += 1
+                rnd += 1
+                if resp.kind == ProgressResponseKind.DONE:
+                    return completed
+        finally:
+            consumer.close()
+
+    async def reap_killed() -> None:
+        """The kill proper, then (later) the orchestrator's depart path.
+
+        The NODE dies the moment the kill fires — mid-round, exactly like
+        a real crash: its leaves' [reducer, shard] pushes fail over
+        direct, and every broadcast hop expands around it. The scheduler
+        side reacts on a delay (modeling φ detection latency): the round
+        in flight closes DEGRADED at quorum + deadline with the dead
+        reducer still in the membership, and only then does the epoch
+        bump shrink the active set so later rounds close on full cover.
+        """
+        await dead.wait()
+        assert kill_peer is not None
+        red = reducers.pop(kill_peer, None)
+        if red is not None:
+            await red.stop()
+        rel = relays.pop(kill_peer, None)
+        if rel is not None:
+            await rel.stop()
+        await nodes[kill_peer].stop()
+        _log(f"chaos: killed mid-tree reducer {kill_peer}")
+        await asyncio.sleep(min(round_deadline_s / 2, 1.5))
+        if kill_peer in tracker.peers:
+            tracker.remove_worker(kill_peer)
+        membership.depart(kill_peer)
+        await orch._notify_membership(ctx)
+        _log(f"chaos: {kill_peer} departed (epoch {membership.epoch})")
+
+    # Small-N scenarios are over in single-digit milliseconds of
+    # scheduler CPU; a stray GC pause inside one 10 µs timed window
+    # swings the sublinearity ratios by tens of percent run to run
+    # (cyclic-GC cost scales with the whole harness's live object graph —
+    # 128 worker tasks — not with the scheduler's work, and it lands in
+    # whichever frame is executing). Measure with the cyclic collector
+    # off, collected before and re-enabled after, standard timing-bench
+    # practice; refcounting still reclaims the per-message garbage.
+    import gc
+
+    gc.collect()
+    gc.disable()
+    t0 = time.monotonic()
+    sched_ms0 = SCALE_METRICS.sched_progress_ms.snapshot()["sum"]
+    tasks = [
+        asyncio.create_task(run_worker(i, w), name=f"scale-{w}")
+        for i, w in enumerate(workers)
+    ]
+    reaper = (
+        asyncio.create_task(reap_killed()) if kill_peer is not None else None
+    )
+    try:
+        worker_rounds = await asyncio.gather(*tasks)
+        status = await asyncio.wait_for(execution.wait(), 120)
+        wall_s = time.monotonic() - t0
+        if reaper is not None:
+            await asyncio.wait_for(reaper, 30)
+    finally:
+        gc.enable()
+
+    ps_egress = nodes["ps0"].bytes_out - ps_bytes_before
+    sched_ms = (
+        SCALE_METRICS.sched_progress_ms.snapshot()["sum"] - sched_ms0
+    )
+    control = SCALE_METRICS.control_bytes()
+    scale_snap = SCALE_METRICS.snapshot()
+
+    progress_reg.close()
+    for red in reducers.values():
+        await red.stop()
+    for rel in relays.values():
+        await rel.stop()
+    for node in nodes.values():
+        await node.stop()
+
+    live = [w for w in workers if w != kill_peer]
+    expected_live_rounds = rounds * len(live)
+    completed_total = sum(worker_rounds)
+    per_round_wall = (
+        float(np.mean(np.diff(round_closes)))
+        if len(round_closes) > 1
+        else wall_s / max(rounds, 1)
+    )
+    out = {
+        "n": n,
+        "topology": topology,
+        "rounds": rounds,
+        "group_size": group_size if tree else 0,
+        "tree_depth": depth if tree else 0,
+        "kill_peer": kill_peer,
+        "ps_status": status.state,
+        "wall_s": round(wall_s, 3),
+        "round_wall_s": round(per_round_wall, 4),
+        "ps_egress_bytes": int(ps_egress),
+        "ps_egress_bytes_per_round": int(ps_egress / max(rounds, 1)),
+        "sched_ms_per_round": round(sched_ms / max(rounds, 1), 3),
+        "control_bytes": control,
+        "tree_folds": scale_snap["tree_folds"],
+        "tree_forwards": scale_snap["tree_forwards"],
+        "relay_pushes": scale_snap["relay_pushes"],
+        "relay_failovers": scale_snap["relay_failovers"],
+        "covers_by_round": dict(sorted(covers_seen.items())),
+        "cover_violations": cover_violations,
+        "completed_worker_rounds": completed_total,
+        "expected_live_rounds": expected_live_rounds,
+    }
+    assert status.state == "completed", f"PS ended {status.state}"
+    assert not cover_violations, cover_violations
+    # Every surviving worker closed every round (the kill costs at most
+    # the dead worker's own contributions, never a round).
+    assert completed_total >= expected_live_rounds, (
+        completed_total, expected_live_rounds,
+    )
+    for rnd, cover in covers_seen.items():
+        assert cover <= n, f"round {rnd} covered {cover} > {n} workers"
+        floor = int(np.ceil(QUORUM_FRACTION * len(live)))
+        assert cover >= floor, f"round {rnd} covered {cover} < quorum {floor}"
+    return out
+
+
+def run_scenario(**kw) -> dict:
+    async def main() -> dict:
+        tmp = Path(tempfile.mkdtemp(prefix="hypha-scalebench-"))
+        try:
+            return await _bench_scenario(tmp=tmp, **kw)
+        finally:
+            import shutil
+
+            await asyncio.to_thread(shutil.rmtree, tmp, ignore_errors=True)
+
+    return asyncio.run(asyncio.wait_for(main(), 900))
+
+
+def main() -> int:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--out", default="SCALEBENCH_r12.json")
+    parser.add_argument("--rounds", type=int, default=4)
+    parser.add_argument("--group-size", type=int, default=8)
+    parser.add_argument("--depth", type=int, default=2)
+    parser.add_argument(
+        "--smoke", action="store_true",
+        help="CI shape: N in {4,16}, 3 rounds, no star run at N_max",
+    )
+    args = parser.parse_args()
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    # A straggler (or deadline-closing chaos round) must not stall the
+    # harness: reducers flush fast, rounds close fast.
+    os.environ.setdefault("HYPHA_REDUCE_FLUSH_S", "1.0")
+
+    ns = [4, 16] if args.smoke else [4, 32, 128]
+    rounds = 3 if args.smoke else args.rounds
+    n_max = ns[-1]
+    deadline = 3.0
+
+    results: dict[str, dict] = {}
+    for n in ns:
+        # Smaller fleets finish a round in single-digit milliseconds of
+        # scheduler CPU; run them for proportionally more rounds so the
+        # per-round ratios the sublinearity asserts divide are averaged
+        # over enough work to be stable (everything reported is
+        # per-round, so scenario round counts may differ).
+        rounds_n = rounds * (4 if n * 4 <= n_max else 2 if n * 2 <= n_max else 1)
+        for topology in ("star", "tree"):
+            gs = min(args.group_size, max(n // 2, 2))
+            _log(f"scenario: N={n} {topology} rounds={rounds_n}")
+            results[f"{topology}-{n}"] = run_scenario(
+                n=n, rounds=rounds_n, topology=topology,
+                group_size=gs, depth=args.depth, kill_peer=None,
+                round_deadline_s=deadline,
+            )
+            _log(json.dumps(results[f"{topology}-{n}"], default=str))
+
+    # Chaos: kill a MID-tree reducer (a level-1 head that is not a top
+    # target) at the largest N.
+    from hypha_tpu.stream import build_reduce_groups, children_of, parent_of
+
+    workers = [f"w{i:03d}" for i in range(n_max)]
+    # Quorum-reachability bound for the chaos leg: a dead mid-tree
+    # reducer can cost up to its whole group's round contributions
+    # (members whose pushes it accepted but never flushed), so the group
+    # must be small enough that N - 1 - (G - 1) still reaches quorum —
+    # otherwise the worst-case kill parks a round below quorum forever
+    # (only binds at small N; at N=128 the default G=8 passes untouched).
+    import math
+
+    gs = min(args.group_size, max(n_max // 2, 2))
+    gs = max(2, min(gs, n_max - math.ceil(QUORUM_FRACTION * n_max)))
+    groups = build_reduce_groups(workers, gs, args.depth)
+    parents = parent_of(groups)
+    mid = sorted(
+        h for h in children_of(groups) if h in parents
+    )
+    kill = mid[0] if mid else sorted(children_of(groups))[-1]
+    _log(f"scenario: N={n_max} tree CHAOS kill-mid-reducer={kill}")
+    results[f"chaos-{n_max}"] = run_scenario(
+        n=n_max, rounds=rounds, topology="tree",
+        group_size=gs, depth=args.depth, kill_peer=kill,
+        round_deadline_s=deadline,
+    )
+    _log(json.dumps(results[f"chaos-{n_max}"], default=str))
+
+    n_min = ns[0]
+    star_hi = results[f"star-{n_max}"]
+    tree_hi = results[f"tree-{n_max}"]
+    tree_lo = results[f"tree-{n_min}"]
+    egress_ratio_vs_star = (
+        tree_hi["ps_egress_bytes_per_round"]
+        / max(star_hi["ps_egress_bytes_per_round"], 1)
+    )
+    scale = n_max / n_min
+    egress_growth = (
+        tree_hi["ps_egress_bytes_per_round"]
+        / max(tree_lo["ps_egress_bytes_per_round"], 1)
+    )
+    wall_growth = tree_hi["round_wall_s"] / max(tree_lo["round_wall_s"], 1e-9)
+    sched_growth = (
+        tree_hi["sched_ms_per_round"]
+        / max(tree_lo["sched_ms_per_round"], 1e-9)
+    )
+    sched_per_peer_growth = sched_growth / scale
+    chaos = results[f"chaos-{n_max}"]
+
+    line = {
+        "metric": "scale_tree_ps_egress_vs_star",
+        "value": round(egress_ratio_vs_star, 4),
+        "unit": f"x (tree/star PS egress per round at N={n_max})",
+        "vs_baseline": None,  # the seed tops out at 3-4 workers
+        "n_sweep": ns,
+        "rounds": rounds,
+        "group_size": args.group_size,
+        "tree_depth": args.depth,
+        "sublinear": {
+            "scale_factor": scale,
+            "tree_egress_growth": round(egress_growth, 3),
+            "tree_round_wall_growth": round(wall_growth, 3),
+            "sched_ms_growth": round(sched_growth, 3),
+            "sched_ms_per_peer_growth": round(sched_per_peer_growth, 3),
+        },
+        "scenarios": results,
+        "asserts": {
+            f"tree_egress_le_0.25x_star_at_{n_max}": egress_ratio_vs_star <= 0.25,
+            "tree_egress_growth_sublinear": egress_growth < scale,
+            "round_wall_growth_sublinear": wall_growth < scale,
+            "sched_cpu_per_peer_flat": sched_per_peer_growth <= 1.75,
+            "chaos_all_rounds_closed": (
+                chaos["ps_status"] == "completed"
+                and chaos["completed_worker_rounds"]
+                >= chaos["expected_live_rounds"]
+            ),
+            "chaos_zero_double_counts": chaos["cover_violations"] == [],
+        },
+    }
+    # Hard acceptance gates (ISSUE 14): fail loudly, never a fake green.
+    assert egress_ratio_vs_star <= 0.25, (
+        f"tree PS egress {tree_hi['ps_egress_bytes_per_round']} not <= 0.25x "
+        f"star {star_hi['ps_egress_bytes_per_round']} at N={n_max}"
+    )
+    assert egress_growth < scale, (
+        f"tree egress grew {egress_growth:.1f}x over a {scale:.0f}x fleet"
+    )
+    assert wall_growth < scale, (
+        f"round wall grew {wall_growth:.1f}x over a {scale:.0f}x fleet"
+    )
+    assert sched_per_peer_growth <= 1.75, (
+        f"scheduler ms/round/peer grew {sched_per_peer_growth:.2f}x over a "
+        f"{scale:.0f}x fleet (per-message cost still scales with N)"
+    )
+    assert line["asserts"]["chaos_all_rounds_closed"], chaos
+    assert chaos["cover_violations"] == [], chaos["cover_violations"]
+
+    out = Path(args.out)
+    with open(out, "w") as f:
+        json.dump(line, f, indent=2)
+        f.write("\n")
+    from hypha_tpu import telemetry
+
+    with open(out.with_suffix(".telemetry.json"), "w") as f:
+        json.dump(telemetry.metrics_snapshot(), f, indent=2)
+        f.write("\n")
+    _log(f"wrote {out}")
+    print(json.dumps(line))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -37,7 +37,14 @@ from typing import Any, Awaitable, Callable, Coroutine, MutableSet, TypeVar
 
 from .telemetry import Counter
 
-__all__ = ["TASK_FAILURES", "spawn", "reap", "wait_quiet", "retry"]
+__all__ = [
+    "TASK_FAILURES",
+    "spawn",
+    "reap",
+    "wait_quiet",
+    "retry",
+    "gather_bounded",
+]
 
 log = logging.getLogger("hypha.aio")
 
@@ -90,8 +97,17 @@ async def reap(*tasks: asyncio.Task | None) -> None:
     live = [t for t in tasks if t is not None]
     for t in live:
         t.cancel()
-    if live:
-        await asyncio.gather(*live, return_exceptions=True)
+    live = [t for t in live if not t.done()]
+    while live:
+        # Re-cancel periodically: py3.10's wait_for can swallow a
+        # cancellation that races the inner future completing (the task
+        # keeps looping, un-cancelled, and a single .cancel() above would
+        # leave this await parked forever — seen with a consumer.next()
+        # racing a push at teardown).
+        done, pending = await asyncio.wait(live, timeout=1.0)
+        for t in pending:
+            t.cancel()
+        live = list(pending)
 
 
 async def wait_quiet(
@@ -117,6 +133,34 @@ async def wait_quiet(
 
 
 _T = TypeVar("_T")
+
+
+async def gather_bounded(
+    fns: "list[Callable[[], Awaitable[_T]]]", *, limit: int = 8
+) -> "list[_T]":
+    """Run awaitable FACTORIES concurrently, at most ``limit`` in flight,
+    returning results in input order.
+
+    The fleet-scale fan-out primitive (ISSUE 14): a serial
+    ``for peer: await`` walk makes every control-plane sweep O(N) round
+    trips, while an unbounded gather at N=128 floods the fabric. The
+    factories (not coroutines) keep lints and retries simple — nothing is
+    created until a slot frees. First failure propagates after every
+    sibling is cancelled and awaited (no orphaned in-flight requests).
+    """
+    if not fns:
+        return []
+    sem = asyncio.Semaphore(max(int(limit), 1))
+
+    async def run(fn: "Callable[[], Awaitable[_T]]") -> "_T":
+        async with sem:
+            return await fn()
+
+    tasks = [asyncio.create_task(run(fn)) for fn in fns]
+    try:
+        return await asyncio.gather(*tasks)
+    finally:
+        await reap(*(t for t in tasks if not t.done()))
 
 
 async def retry(

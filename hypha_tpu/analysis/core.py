@@ -93,6 +93,11 @@ RULES: dict[str, str] = {
         "round/epoch tag — an un-rounded generation can adopt or drop "
         "control decisions against the wrong round"
     ),
+    "msg-tree-needs-round": (
+        "message carries a tree level/parent placement field but no "
+        "round/epoch tag — a stale placement can re-parent in-flight "
+        "partials or re-route a broadcast hop"
+    ),
     "msg-unmapped-protocol": (
         "registered wire message not claimed by any stream protocol"
     ),

@@ -66,6 +66,14 @@ _FRAGMENT_FIELDS = {"fragment_id", "fragment"}
 # re-encode its link) against a round that already closed.
 _ADAPTIVE_FIELDS = {"inner_steps", "codecs", "peer_codecs"}
 
+# Field names carrying reduce/broadcast TREE placement — a node's level
+# in the tree or its parent edge (hypha_tpu.stream.tree). Their presence
+# obliges the message to carry a round/epoch tag too
+# (``msg-tree-needs-round``): a tree placement applied from a stale
+# redelivery would re-parent in-flight partials (or re-route a broadcast
+# hop) against a placement that no longer exists.
+_TREE_FIELDS = {"tree_depth", "tree_level", "parent", "reduce_parent"}
+
 # Field names carrying a process GENERATION id (the PS and scheduler
 # restart handshakes, hypha_tpu.ft.durable). Their presence obliges the
 # message to carry a round/epoch tag too (``msg-generation-needs-round``):
@@ -411,6 +419,38 @@ def check_adaptive_tags(registry=None) -> list[Violation]:
     return out
 
 
+def check_tree_tags(registry=None) -> list[Violation]:
+    """Any message with tree level/parent placement must carry a round tag.
+
+    Structural, like :func:`check_fragment_tags`: EVERY registered
+    dataclass that grows a ``tree_depth``/``tree_level``/``parent``/
+    ``reduce_parent`` field must pair it with ``round``/``epoch``/
+    ``round_num`` — the multi-level reduce/broadcast tree
+    (hypha_tpu.stream.tree) is per-round state: an un-rounded placement
+    could re-parent an in-flight partial onto a reducer that no longer
+    heads its group, silently double- or under-counting the round.
+    """
+    messages, _ = _modules()
+    registry = registry if registry is not None else _package_registry(messages)
+    out: list[Violation] = []
+    for name, cls in sorted(registry.items()):
+        if not dataclasses.is_dataclass(cls):
+            continue
+        fields = {f.name for f in dataclasses.fields(cls)}
+        if fields & _TREE_FIELDS and not fields & _TAG_FIELDS:
+            out.append(
+                _violation(
+                    cls,
+                    "msg-tree-needs-round",
+                    f"{name}: carries {sorted(fields & _TREE_FIELDS)} "
+                    f"but no round tag ({'/'.join(sorted(_TAG_FIELDS))}) — "
+                    f"a stale tree placement can re-parent in-flight "
+                    f"partials or re-route a broadcast hop",
+                )
+            )
+    return out
+
+
 def check_generation_tags(registry=None) -> list[Violation]:
     """Any message with a generation id must carry a round/epoch tag.
 
@@ -505,6 +545,7 @@ def check() -> list[Violation]:
         + check_fragment_tags()
         + check_shard_tags()
         + check_adaptive_tags()
+        + check_tree_tags()
         + check_generation_tags()
         + check_protocol_map()
     )

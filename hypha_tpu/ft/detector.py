@@ -42,14 +42,47 @@ _MIN_STD_S = 0.05
 # the lease-renewal failure path instead, which needs no distribution.
 
 
+def _phi_of_z(z: float) -> float:
+    """φ as a function of the standardized silence z (the exact formula
+    :meth:`PhiAccrualDetector.phi` evaluates, including its underflow
+    fallback) — strictly monotone increasing."""
+    p_later = 0.5 * math.erfc(z)
+    if p_later <= 0.0:
+        return z * z / math.log(10.0)
+    return -math.log10(p_later)
+
+
+def _solve_z(threshold: float) -> float:
+    """The z where φ crosses ``threshold``, by bisection (φ is monotone;
+    one solve per detector, reused for every peer's suspect_at)."""
+    lo, hi = -10.0, 10.0
+    while _phi_of_z(hi) < threshold:
+        hi *= 2.0
+        if hi > 1e6:  # pathological threshold; fall back to "always check"
+            return float("-inf")
+    for _ in range(200):
+        mid = (lo + hi) / 2.0
+        if _phi_of_z(mid) < threshold:
+            lo = mid
+        else:
+            hi = mid
+    return hi
+
+
 class _PeerHistory:
-    __slots__ = ("intervals", "last", "_sum", "_sum_sq")
+    __slots__ = ("intervals", "last", "_sum", "_sum_sq", "suspect_at")
 
     def __init__(self, now: float, window: int) -> None:
         self.intervals: deque[float] = deque(maxlen=window)
         self.last = now
         self._sum = 0.0
         self._sum_sq = 0.0
+        # Earliest clock() at which φ can reach the detector's threshold
+        # (solved in closed form from the fitted distribution at each
+        # heartbeat). Until then suspicion checks are ONE float compare —
+        # the poll loop's per-tick cost stops scaling with erfc calls at
+        # fleet size (ISSUE 14).
+        self.suspect_at = float("inf")
 
     def record(self, now: float) -> None:
         interval = max(now - self.last, 0.0)
@@ -91,6 +124,10 @@ class PhiAccrualDetector:
         self.min_samples = min_samples
         self._clock = clock
         self._peers: dict[str, _PeerHistory] = {}
+        # z* with φ(z*) == threshold (φ is strictly monotone in z): the
+        # crossing elapsed is mean + z*·√2·std, giving every peer a
+        # closed-form suspect_at timestamp per heartbeat.
+        self._z_threshold = _solve_z(threshold)
 
     # -- feeding -------------------------------------------------------------
     def heartbeat(self, peer: str) -> None:
@@ -101,6 +138,11 @@ class PhiAccrualDetector:
             self._peers[peer] = _PeerHistory(now, self.window)
         else:
             hist.record(now)
+            if len(hist.intervals) >= self.min_samples:
+                mean, std = hist.mean_std()
+                hist.suspect_at = (
+                    now + mean + self._z_threshold * std * math.sqrt(2.0)
+                )
 
     def remove(self, peer: str) -> None:
         self._peers.pop(peer, None)
@@ -130,6 +172,14 @@ class PhiAccrualDetector:
         return -math.log10(p_later)
 
     def suspected(self, peer: str) -> bool:
+        # Fast negative (the overwhelming case): before suspect_at the
+        # fitted φ cannot have crossed the threshold — one float compare
+        # instead of an erfc per peer per poll tick. The exact φ check
+        # stays the verdict past the horizon (and for short histories,
+        # whose suspect_at is still +inf).
+        hist = self._peers.get(peer)
+        if hist is None or self._clock() < hist.suspect_at:
+            return False
         return self.phi(peer) >= self.threshold
 
     def suspicion_levels(self) -> dict[str, float]:

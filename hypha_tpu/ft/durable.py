@@ -574,10 +574,26 @@ class DurablePS:
             if prev is not None:
                 unfold(prev)
             if fold.prefold and fold.covers:
-                # Mirror of the collector's _retire_covered: a partial
-                # supersedes its members' earlier failed-over direct
-                # entries (same sorted order, so the replayed fold
-                # sequence is bit-identical to the live one's).
+                # Mirror of the collector's _retire_covered, both loops in
+                # the same order. First (multi-level trees): another
+                # sender's PARTIAL whose covers intersect this one's is
+                # un-folded whole, sorted-key order — the live gate
+                # (_prefold_superseded, bigger cover wins) only ever
+                # folded this record with every intersecting accepted
+                # entry strictly smaller, so intersection here re-derives
+                # exactly the live un-folds.
+                covset = frozenset(fold.covers)
+                for okey in sorted(last):
+                    oprev = last[okey]
+                    if okey == fold.peer or not oprev.prefold:
+                        continue
+                    if frozenset(oprev.covers or ()) & covset:
+                        unfold(oprev)
+                        del last[okey]
+                # Then: a partial supersedes its members' earlier
+                # failed-over direct entries (same sorted order, so the
+                # replayed fold sequence is bit-identical to the live
+                # one's).
                 for member in sorted(fold.covers):
                     mprev = last.get(member)
                     if mprev is not None and not mprev.prefold:

@@ -233,6 +233,26 @@ def encode(msg: Any) -> bytes:
     return codec.dumps(_to_plain(msg))
 
 
+class PreEncoded:
+    """An already-``encode``d wire payload.
+
+    ``Node.request`` ships ``__pre_encoded__`` verbatim instead of
+    re-serializing — the scheduler's membership fan-out encodes one
+    snapshot and sends the same bytes to every parameter-service shard
+    (and every retry). Purely a send-side optimization: the wire is
+    byte-identical to encoding the original message at each call site.
+    """
+
+    __slots__ = ("__pre_encoded__",)
+
+    def __init__(self, data: bytes) -> None:
+        self.__pre_encoded__ = data
+
+    @classmethod
+    def of(cls, msg: Any) -> "PreEncoded":
+        return cls(encode(msg))
+
+
 def decode(data: bytes) -> Any:
     return _from_plain(codec.loads(data))
 
@@ -562,6 +582,14 @@ class TrainExecutorConfig:
     # the reducer's own delta goes direct to the shard (a node cannot
     # push to itself), so shard ingress per group is the partial + one.
     reduce_members: list = field(default_factory=list)
+    # Broadcast tree (hypha_tpu.stream.reduce.BroadcastRelay): when True,
+    # THIS worker re-pushes each results-stream wire it receives under the
+    # relay tag to its ``reduce_members`` subtree (the reduce tree run in
+    # reverse), so the parameter service's egress per round is ~G pushes
+    # instead of W. None — the only value a non-tree job ships — is
+    # omitted from the wire entirely; broadcast trees off keep today's
+    # exact bytes.
+    relay_results: bool | None = None
     # Durable control plane (hypha_tpu.ft.durable): the scheduler journals
     # its state and can be restarted in place. A worker running such a job
     # parks its Status/UpdateReceived sends in aio.retry for up to this
@@ -645,6 +673,13 @@ class AggregateExecutorConfig:
     # entirely, so `adaptive_steps: off` keeps today's exact bytes.
     adaptive_steps: bool | None = None
     adaptive_codec: bool | None = None
+    # Broadcast tree (hypha_tpu.stream.tree): the placement whose reduce
+    # groups this parameter server mirrors DOWNWARD for its update
+    # broadcasts — each round's wire goes to the top-level reducers (and
+    # ungrouped workers) only, which re-push to their subtrees. None — the
+    # only value a non-tree job ships — is omitted from the wire, so
+    # broadcast trees off keep today's exact bytes.
+    broadcast_tree: ShardMap | None = None
     # adaptive_codec thresholds (megabits/s): >= hi keeps the job codec,
     # [lo, hi) degrades the link to int8, < lo to int4. None = defaults.
     codec_bw_hi_mbps: float | None = None
@@ -1189,6 +1224,15 @@ class ShardMap:
     tags: list = field(default_factory=list)  # per-shard updates tags
     fragments: int = 1  # total placed fragment count (sanity cross-check)
     groups: list = field(default_factory=list)  # tree-reduce: list[list[str]]
+    # Multi-level reduce/broadcast tree (hypha_tpu.stream.tree): the depth
+    # the collapsed ``groups`` plan was built with. Purely informational —
+    # every mechanic derives from ``groups`` alone — but it lets receivers
+    # validate the plan and telemetry label per-level counters. None (the
+    # only value a single-level job ships) is omitted from the wire, so
+    # ``reduce_tree_depth`` unset keeps PR 6's exact bytes. Travels next to
+    # ``round`` (hypha-lint ``msg-tree-needs-round``): a tree placement
+    # without its round could re-parent an in-flight partial.
+    tree_depth: int | None = None
 
     def __post_init__(self) -> None:
         if self.tags and len(self.tags) != len(self.shards):

@@ -88,18 +88,22 @@ class Stream:
         await self.close()
 
     # -- framing ------------------------------------------------------------
-    async def write_frame(self, obj: Any) -> None:
-        await write_frame(self, obj)
+    async def write_frame(self, obj: Any) -> int:
+        return await write_frame(self, obj)
 
     async def read_frame(self, max_size: int = MAX_FRAME) -> Any:
         return await read_frame(self, max_size)
 
 
-async def write_frame(stream: Stream, obj: Any) -> None:
+async def write_frame(stream: Stream, obj: Any) -> int:
+    """Write one length-prefixed frame; returns the frame's wire size
+    (prefix + body) so callers can account per-protocol control bytes
+    without re-serializing."""
     body = codec.dumps(obj)
     if len(body) > MAX_FRAME:
         raise FrameError(f"frame too large: {len(body)}")
     await stream.write(_LEN.pack(len(body)) + body)
+    return 8 + len(body)
 
 
 async def read_frame(stream: Stream, max_size: int = MAX_FRAME) -> Any:

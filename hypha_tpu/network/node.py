@@ -35,6 +35,7 @@ from dataclasses import dataclass, field
 from typing import Any, AsyncIterator, Awaitable, Callable
 
 from .. import aio, messages
+from ..telemetry.ft_metrics import SCALE_METRICS
 from .fabric import MAX_FRAME, FrameError, Stream, Transport, copy_stream
 
 __all__ = [
@@ -530,6 +531,40 @@ class PushStream:
         self._done()
 
 
+class _LocalFileStream(Stream):
+    """A read-only Stream over a local file — the payload carrier for
+    :meth:`Node.inject_push` (a broadcast relay handing its own node the
+    wire it just saved, without a loopback dial)."""
+
+    def __init__(self, path) -> None:
+        self._path = path
+        self._f = None
+        self._eof = False
+
+    async def read(self, n: int = 65536) -> bytes:
+        if self._eof:
+            return b""
+        if self._f is None:
+            self._f = await asyncio.to_thread(open, self._path, "rb")
+        data = await asyncio.get_running_loop().run_in_executor(
+            None, self._f.read, n
+        )
+        if not data:
+            self._eof = True
+        return data
+
+    async def write(self, data: bytes) -> None:
+        raise OSError("injected push streams are read-only")
+
+    async def close(self) -> None:
+        if self._f is not None:
+            f, self._f = self._f, None
+            await asyncio.to_thread(f.close)
+
+    async def abort(self) -> None:
+        await self.close()
+
+
 class _CountingStream(Stream):
     """Wraps a stream, crediting reads to the node's inbound byte counter
     (the reference's bandwidth-instrumented muxer role,
@@ -821,7 +856,10 @@ class Node:
                 log.debug("handler error on %s: %s", proto, e)
                 await stream.write_frame({"ok": False, "error": str(e)})
                 return
-        await stream.write_frame({"ok": True, "body": messages.encode(response)})
+        sent = await stream.write_frame(
+            {"ok": True, "body": messages.encode(response)}
+        )
+        SCALE_METRICS.note_control(proto, sent)
 
     async def request(
         self, peer_id: str, protocol: str, msg: Any, timeout: float = 30.0
@@ -839,7 +877,15 @@ class Node:
     async def _request_inner(self, peer_id: str, protocol: str, msg: Any) -> Any:
         stream = await self._stream_to(peer_id, protocol)
         try:
-            await stream.write_frame(messages.encode(msg))
+            # PreEncoded payloads skip re-serialization: a scheduler
+            # fanning one membership snapshot out to N parameter-service
+            # shards encodes it once (hypha_tpu.messages.PreEncoded) and
+            # every send ships the same bytes.
+            pre = getattr(msg, "__pre_encoded__", None)
+            sent = await stream.write_frame(
+                pre if pre is not None else messages.encode(msg)
+            )
+            SCALE_METRICS.note_control(protocol, sent)
             reply = await stream.read_frame()
         except (FrameError, ConnectionError, OSError) as e:
             raise RequestError(f"rpc to {peer_id} failed: {e}") from e
@@ -1773,6 +1819,54 @@ class Node:
         # Keep the transport connection alive until the consumer drains it
         # (TCP closes the socket when the accept callback returns).
         await finished.wait()
+
+    async def inject_push(
+        self,
+        peer: str,
+        resource: Any,
+        path,
+        on_done: Callable[[], None] | None = None,
+    ) -> None:
+        """Deliver a LOCAL push into this node's own consumer routing.
+
+        A broadcast-tree relay (hypha_tpu.stream.reduce.BroadcastRelay)
+        receives a wire addressed to its subtree and must also hand it to
+        the training loop on the SAME node — dialing oneself would burn a
+        socket and an accept slot for a file already on local disk.
+        ``peer`` attributes the push to its true origin (the sending hop),
+        so receiver-side allowlists behave exactly as for a wire push.
+        ``on_done`` fires when the consumer finishes with the stream
+        (save_to/read_all EOF), after which the caller may unlink ``path``.
+        Bypasses the inbound accept semaphore deliberately: local delivery
+        must not contend with (or deadlock behind) 8 slow remote senders.
+        """
+        stream = _LocalFileStream(path)
+        fired = False
+
+        def done() -> None:
+            nonlocal fired
+            if fired:
+                return
+            fired = True
+            # Best-effort file-handle cleanup; the event loop is running,
+            # so schedule rather than await.
+            aio.spawn(stream.close(), what="inject_push close", logger=log)
+            if on_done is not None:
+                on_done()
+
+        push = PushStream(
+            peer=peer, resource=resource, stream=stream, _done=done
+        )
+        target = self._push_queue
+        for consumer in self._push_consumers:
+            try:
+                matches = consumer.predicate(push)
+            except Exception:
+                matches = False
+            if matches:
+                target = consumer._queue
+                break
+        await target.put(push)
 
     def consume_pushes(
         self, predicate: Callable[[PushStream], bool], buffer: int = 64

@@ -22,6 +22,7 @@ messages and returns its ProgressResponse to the peer.
 from __future__ import annotations
 
 import dataclasses
+import time
 from typing import Callable
 
 from ..messages import (
@@ -30,7 +31,7 @@ from ..messages import (
     ProgressResponse,
     ProgressResponseKind,
 )
-from ..telemetry.ft_metrics import FT_METRICS
+from ..telemetry.ft_metrics import FT_METRICS, SCALE_METRICS
 from ..telemetry import trace
 from .simulation import project
 from .trackers import ProgressTracker, WorkerState
@@ -70,6 +71,29 @@ class BatchScheduler:
         self.shards_due = shards_due
         # round -> shards that have reported UPDATED for it.
         self._updated: dict[int, set[int]] = {}
+        # shard -> last round it owns (memo for _shard_done).
+        self._last_owned: dict[int, int] = {}
+        # Round schedule plan (ISSUE 14): the first successful projection
+        # of a round fixes the sync point for EVERY worker it simulated —
+        # (round, membership_version, peer -> planned batch count). Later
+        # TRAINING Statuses claim their assignment with one dict lookup
+        # instead of re-running the O(N log N) event simulation per worker
+        # (O(N^2 log N) per round at fleet scale). Invalidated by the
+        # round advancing and by any membership change — a mid-round
+        # depart must re-spread the dead worker's planned share, not
+        # leave the round undershooting by it.
+        self._round_plan: "tuple[int, int, dict[str, int]] | None" = None
+        # Capped-projection memo: a projection that capped `left` samples
+        # short measured the fleet's assignable capacity = counter - left.
+        # No projection can succeed until the counter falls below it, so
+        # early-round Statuses — where the target is far out of reach —
+        # skip the O(N log N) simulation with one compare. Keyed on
+        # (round, sim_batch_total, membership_version, stats_version) so a
+        # round advance, membership change, or a worker speeding up/down
+        # >10% re-measures (time-capped capacity is a function of the
+        # speeds it simulated); the no-stats cap is never memoized
+        # (capacity is unknown there, not zero).
+        self._sim_skip: "tuple[int, int, int, int, int] | None" = None
         # Straggler-adaptive inner steps (hypha_tpu.ft.adaptive): when set,
         # per-worker sync points come from the controller's EWMA-derived
         # assignment instead of the synchronization simulation — a 4x
@@ -102,6 +126,20 @@ class BatchScheduler:
 
     # ------------------------------------------------------------------
     def on_progress(self, peer: str, progress: Progress) -> ProgressResponse:
+        # Control-loop timing reservoir (SCALE_METRICS): the number
+        # benchmarks/scalebench.py asserts flat per peer across fleet
+        # growth — every message pays one perf_counter pair, nothing else.
+        t0 = time.perf_counter()
+        try:
+            return self._on_progress_gated(peer, progress)
+        finally:
+            SCALE_METRICS.note_sched_progress(
+                (time.perf_counter() - t0) * 1000.0
+            )
+
+    def _on_progress_gated(
+        self, peer: str, progress: Progress
+    ) -> ProgressResponse:
         sender_gen = getattr(progress, "scheduler_generation", None)
         if sender_gen is not None and (
             self.generation is None or sender_gen > self.generation
@@ -146,7 +184,7 @@ class BatchScheduler:
             return _OK
         if kind == ProgressKind.UPDATE:
             # Worker finished its countdown and shipped its pseudo-gradient.
-            if peer in self.tracker.peers:
+            if self.tracker.tracked(peer):
                 self.tracker.set_state(peer, WorkerState.UPDATING)
             return _OK
         if kind == ProgressKind.UPDATED:
@@ -245,11 +283,21 @@ class BatchScheduler:
         """No owned round left for ``shard`` after ``after_round``: its
         aggregation loop should terminate. In stream mode a shard's LAST
         owned round can come before the job's final round — the scheduler
-        owns ``update_epochs``, so it makes this call, not the shard."""
-        return all(
-            shard not in self._due(r)
-            for r in range(after_round + 1, self.tracker.update_epochs)
-        )
+        owns ``update_epochs``, so it makes this call, not the shard.
+
+        The shard→last-owned-round table is computed ONCE per shard (the
+        due schedule is a pure function of the round): the pre-memo form
+        re-scanned every remaining round × shard per UPDATED, which at
+        many rounds × many shards was the scheduler's second O(N) walk.
+        """
+        last = self._last_owned.get(shard)
+        if last is None:
+            last = -1
+            for r in range(self.tracker.update_epochs):
+                if shard in self._due(r):
+                    last = r
+            self._last_owned[shard] = last
+        return after_round >= last
 
     def _on_updated(self, progress: Progress) -> ProgressResponse:
         shard = int(getattr(progress, "shard", 0) or 0)
@@ -303,7 +351,7 @@ class BatchScheduler:
 
     # ------------------------------------------------------------------
     def _on_status(self, peer: str, progress: Progress) -> ProgressResponse:
-        if peer not in self.tracker.peers:
+        if not self.tracker.tracked(peer):
             return ProgressResponse(
                 kind=ProgressResponseKind.ERROR, message="unknown worker"
             )
@@ -315,6 +363,21 @@ class BatchScheduler:
             self.adaptive.note_batch(peer)
         if state != WorkerState.TRAINING:
             # Already counting down / mid-update: keep going.
+            return _CONTINUE
+        # O(1) reachability lower bound (ISSUE 14): the projection can
+        # assign at most ``updates_cap`` batches per producing worker
+        # before a cap fires, so while the round's remaining counter
+        # exceeds Σ batch_size × updates_cap the full simulation is
+        # GUARANTEED capped and its verdict is CONTINUE. Early-round
+        # Statuses — the overwhelming majority at N=128 — skip the O(N)
+        # sims build + O(N·cap·log N) event simulation entirely, with a
+        # bit-identical reply. (``sim_batch_total`` is maintained by the
+        # tracker over exactly the states sim_peers selects below.)
+        if (
+            self.adaptive is None
+            and self.tracker.counter
+            > self.tracker.sim_batch_total * self.updates_cap
+        ):
             return _CONTINUE
         if self.adaptive is not None:
             # Adaptive assignment: the worker's sync point is fixed for the
@@ -328,6 +391,44 @@ class BatchScheduler:
                 traceparent=self._round_tp(),
             )
 
+        # Claim this round's cached plan if one exists. The claimant's
+        # very Status completed one of its planned batches (a TRAINING
+        # worker claims on its FIRST Status after the plan lands), so the
+        # handed-out counter is the planned share minus one.
+        plan = self._round_plan
+        if (
+            plan is not None
+            and plan[0] == self.tracker.round
+            and plan[1] == self.tracker.membership_version
+            # A worker already in the next round (its UPDATE_RECEIVED beat
+            # the PS's UPDATED) must not claim the old round's share.
+            and progress.round in (None, plan[0])
+        ):
+            planned = plan[2].get(peer)
+            if planned is not None:
+                self.tracker.set_state(peer, WorkerState.UPDATE_SCHEDULED)
+                return ProgressResponse(
+                    kind=ProgressResponseKind.SCHEDULE_UPDATE,
+                    counter=max(planned - 1, 0),
+                    traceparent=self._round_tp(),
+                )
+            # Joined after the plan was fixed: fall through to a fresh sim.
+
+        # Capped-memo fast negative: the last projection measured the
+        # fleet's assignable capacity; until the counter drops below it
+        # the simulation is guaranteed to cap again with the same
+        # CONTINUE verdict.
+        skip = self._sim_skip
+        if (
+            skip is not None
+            and skip[0] == self.tracker.round
+            and skip[1] == self.tracker.sim_batch_total
+            and skip[2] == self.tracker.membership_version
+            and skip[3] == self.tracker.stats_version
+            and self.tracker.counter > skip[4]
+        ):
+            return _CONTINUE
+
         # Simulate all workers still producing batches this round.
         sim_peers = [
             p
@@ -339,9 +440,23 @@ class BatchScheduler:
             self.tracker.counter, workers, self.time_cap_ms, self.updates_cap
         )
         if projection.capped or projection.left > 0:
+            if projection.left > 0 and not projection.no_stats:
+                self._sim_skip = (
+                    self.tracker.round,
+                    self.tracker.sim_batch_total,
+                    self.tracker.membership_version,
+                    self.tracker.stats_version,
+                    self.tracker.counter - projection.left,
+                )
             return _CONTINUE
-        # Round target reachable: schedule this worker's sync point.
+        # Round target reachable: schedule this worker's sync point and
+        # fix the round's plan for everyone else it simulated.
         counter = projection.updates[sim_peers.index(peer)]
+        self._round_plan = (
+            self.tracker.round,
+            self.tracker.membership_version,
+            dict(zip(sim_peers, projection.updates)),
+        )
         self.tracker.set_state(peer, WorkerState.UPDATE_SCHEDULED)
         return ProgressResponse(
             kind=ProgressResponseKind.SCHEDULE_UPDATE, counter=counter,
@@ -350,7 +465,7 @@ class BatchScheduler:
 
     # ------------------------------------------------------------------
     def _on_update_received(self, peer: str) -> ProgressResponse:
-        if peer not in self.tracker.peers:
+        if not self.tracker.tracked(peer):
             return ProgressResponse(
                 kind=ProgressResponseKind.ERROR, message="unknown worker"
             )

@@ -126,6 +126,23 @@ class DiLoCoJob:
     # ingress from W pushes to ~W/G. A dead reducer degrades its group to
     # direct shard pushes (ANY failover). 0/1 = disabled.
     reduce_group_size: int = 0
+    # Multi-level reduce tree (hypha_tpu.stream.tree; needs
+    # reduce_group_size >= 2): chunk the level-1 reducers into groups of
+    # reduce_group_size again, and so on, ``reduce_tree_depth`` times —
+    # shard ingress drops from W pushes to ~W/G^d partials. Mid-tree
+    # reducers forward cumulative partials to their parent with the same
+    # ANY failover leaves use, covers extending transitively, so a dead
+    # mid-tree reducer degrades its subtree one hop without
+    # double-counting (the shard's cover-set reconciliation). 0/1 =
+    # today's single level, byte-identical wire.
+    reduce_tree_depth: int = 0
+    # Broadcast tree (hypha_tpu.stream.reduce.BroadcastRelay; needs
+    # reduce_group_size >= 2): mirror the reduce tree DOWNWARD for update
+    # broadcasts — the parameter service pushes each round's wire to the
+    # top-level reducers (and ungrouped workers) only, ~G pushes instead
+    # of W; relays re-push to their subtrees with dead-relay expansion.
+    # Off (default) keeps today's star fan-out and exact wire.
+    broadcast_tree: bool = False
     # WAN-adaptive outer rounds (hypha_tpu.ft.adaptive). adaptive_steps
     # replaces the synchronization simulation with an EWMA round-trip
     # controller: per-worker inner-step counts are published with the
@@ -200,6 +217,27 @@ class DiLoCoJob:
             raise ValueError("num_ps_shards must be >= 1")
         if self.reduce_group_size < 0:
             raise ValueError("reduce_group_size must be >= 0 (0 = disabled)")
+        if self.reduce_tree_depth < 0:
+            raise ValueError(
+                "reduce_tree_depth must be >= 0 (0/1 = single level)"
+            )
+        if self.reduce_tree_depth >= 2 and self.reduce_group_size < 2:
+            raise ValueError(
+                "reduce_tree_depth >= 2 needs reduce_group_size >= 2 "
+                "(the tree is built from the reduce groups)"
+            )
+        if self.broadcast_tree and self.reduce_group_size < 2:
+            raise ValueError(
+                "broadcast_tree needs reduce_group_size >= 2 (the relays "
+                "ARE the reduce tree's reducers)"
+            )
+        if self.broadcast_tree and self.adaptive_codec:
+            # Per-link codecs produce per-peer wires (with per-peer EF
+            # residuals); a relay forwards ONE byte-identical wire.
+            raise ValueError(
+                "broadcast_tree is not supported with adaptive_codec "
+                "(per-peer broadcast wires cannot be relayed verbatim)"
+            )
         if self.num_ps_shards > 1 and self.sync_mode == "overlap":
             # Overlap's one whole-tree flight has no per-part schedule to
             # route by; pipelining + sharding compose via sync_mode=stream.

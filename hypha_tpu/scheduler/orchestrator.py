@@ -428,6 +428,9 @@ class Orchestrator:
         # Tree-reduce role for THIS worker: the first member of its group
         # pre-folds the others' deltas (reduce_members); the rest route
         # their pushes [reducer, shard] with ANY failover (reduce_via).
+        # Multi-level plans compose here unmodified: a mid-tree reducer
+        # heads one group AND is a member of its parent's, so it gets
+        # BOTH fields — members to fold, a parent to forward to.
         reduce_via = None
         reduce_members: list[str] = []
         for group in ctx.reduce_groups:
@@ -435,6 +438,23 @@ class Orchestrator:
                 reduce_members = [p for p in group[1:]]
             elif handle.peer_id in group:
                 reduce_via = group[0]
+        # Broadcast tree: reducers also relay result wires down their
+        # subtree, and every worker's results allowlist must admit its
+        # ancestor chain (any ancestor can be the hop that delivers —
+        # including around a dead relay). Off (the default) ships
+        # exactly today's Receive reference.
+        tree_on = bool(getattr(job, "broadcast_tree", False)) and bool(
+            ctx.reduce_groups
+        )
+        results_peers = list(ps_peers)
+        if tree_on:
+            from ..stream import ancestors_of
+
+            results_peers += [
+                a
+                for a in ancestors_of(ctx.reduce_groups, handle.peer_id)
+                if a not in results_peers
+            ]
         return JobSpec(
             job_id=f"{ctx.base_id}-{suffix}",
             executor=Executor(
@@ -451,12 +471,16 @@ class Orchestrator:
                     results=Receive(
                         # Every shard broadcasts on the shared results tag;
                         # tree-reduce jobs also accept the reducer-relayed
-                        # streams (same tag, shard peers only).
-                        Reference.from_peers(ps_peers, ctx.results_tag)
+                        # streams (same tag, shard peers only; broadcast
+                        # trees add the worker's ancestor relays).
+                        Reference.from_peers(results_peers, ctx.results_tag)
                     ),
                     ps_shards=ctx.shard_map,
                     reduce_via=reduce_via,
                     reduce_members=reduce_members,
+                    relay_results=(
+                        True if tree_on and reduce_members else None
+                    ),
                     optimizer=job.inner_optimizer,
                     batch_size=handle.batch_size,
                     preprocessor=job.preprocessor,
@@ -527,21 +551,24 @@ class Orchestrator:
             ctx.ps_job_ids = [
                 f"{ctx.base_id}-ps{k}" for k in range(num_shards)
             ]
-        # Tree-reduce plan: deterministic sorted-peer-id chunks; the
-        # first member of each group is its reducer. Singleton groups
-        # are dropped (nothing to pre-fold).
+        # Tree-reduce plan: deterministic sorted-peer-id groups-of-groups
+        # (stream.tree). ``reduce_tree_depth`` unset builds exactly the
+        # single-level chunks PR 6 shipped — the first member of each
+        # group is its reducer, singleton groups dropped — so the
+        # ShardMap's ``groups`` stay byte-identical. Depth >= 2 collapses
+        # the tree into per-reducer groups whose children span levels
+        # (mid-tree reducers appear both as a head and as another head's
+        # member), which is what _train_spec's reduce_via/reduce_members
+        # derivation already composes.
         group_size = int(getattr(job, "reduce_group_size", 0) or 0)
+        depth = int(getattr(job, "reduce_tree_depth", 0) or 1)
         ctx.reduce_groups = []
         if group_size >= 2:
-            ordered = sorted(worker_peers)
-            ctx.reduce_groups = [
-                g
-                for g in (
-                    ordered[i : i + group_size]
-                    for i in range(0, len(ordered), group_size)
-                )
-                if len(g) >= 2
-            ]
+            from ..stream import build_reduce_groups
+
+            ctx.reduce_groups = build_reduce_groups(
+                worker_peers, group_size, depth
+            )
         # The placement announcement workers route by. Built for any
         # sharded OR tree-reduced job; plain single-PS jobs ship None
         # and keep the exact pre-shard wire.
@@ -553,6 +580,8 @@ class Orchestrator:
                 tags=list(ctx.shard_tags),
                 fragments=parts,
                 groups=[list(g) for g in ctx.reduce_groups],
+                # None for single-level plans: PR 6's exact wire bytes.
+                tree_depth=(depth if depth >= 2 else None),
             )
         ft = ctx.ft
         ctx.ps_specs = [
@@ -615,6 +644,15 @@ class Orchestrator:
                         codec_bw_lo_mbps=(
                             job.codec_bw_lo_mbps
                             if getattr(job, "adaptive_codec", False)
+                            else None
+                        ),
+                        # Broadcast tree: the PS mirrors the reduce
+                        # placement downward (None = today's star fan-out,
+                        # no new wire).
+                        broadcast_tree=(
+                            ctx.shard_map
+                            if getattr(job, "broadcast_tree", False)
+                            and ctx.reduce_groups
                             else None
                         ),
                         # Durable control plane: the PS parks its Updated
@@ -873,10 +911,32 @@ class Orchestrator:
         try:
             # Acceptance: first renewal converts each temp lease — must happen
             # within the 500 ms offer window, so BEFORE the PS auction runs
-            # (worker.rs:75; rfc/2025-08-04 "Lease Renewal").
-            for offer in worker_offers:
-                handle = await WorkerHandle.create(self.node, offer)
-                ctx.handles[handle.peer_id] = handle
+            # (worker.rs:75; rfc/2025-08-04 "Lease Renewal"). Bounded
+            # fan-out, not a serial walk: at N=128 a serial sweep of
+            # round trips would blow the offer window by itself; insertion
+            # stays in offer order so worker indices are deterministic.
+            # Handles are recorded as they are created (index slot, then
+            # merged in offer order), not from gather's return value: if
+            # one offer fails mid-fan-out, the siblings already created
+            # must still reach ctx.handles so the outer cleanup releases
+            # their leases instead of leaking them until expiry.
+            created: "list[WorkerHandle | None]" = [None] * len(worker_offers)
+
+            async def _create(i: int, offer) -> None:
+                created[i] = await WorkerHandle.create(self.node, offer)
+
+            try:
+                await aio.gather_bounded(
+                    [
+                        (lambda i=i, o=offer: _create(i, o))
+                        for i, offer in enumerate(worker_offers)
+                    ],
+                    limit=16,
+                )
+            finally:
+                for handle in created:
+                    if handle is not None:
+                        ctx.handles[handle.peer_id] = handle
             num_shards = max(int(getattr(job, "num_ps_shards", 1) or 1), 1)
             ps_offers = await self._allocate_ps(
                 job,
@@ -955,11 +1015,26 @@ class Orchestrator:
                 await self._journal_dispatch(
                     ctx, spec.job_id, ctx.ps_handles[k], "aggregate", shard=k
                 )
-            for i, (peer, handle) in enumerate(ctx.handles.items()):
-                spec = self._train_spec(ctx, f"w{i}", handle)
-                tasks.append(
-                    await Task.dispatch(self.node, ctx.router, spec, [handle])
-                )
+            # Train dispatches fan out with bounded concurrency (each is
+            # an independent request to a distinct peer); journaling stays
+            # in worker order afterwards so the journal is deterministic.
+            pairs = [
+                (self._train_spec(ctx, f"w{i}", handle), handle)
+                for i, (peer, handle) in enumerate(ctx.handles.items())
+            ]
+            dispatched = await aio.gather_bounded(
+                [
+                    (
+                        lambda s=spec, h=handle: Task.dispatch(
+                            self.node, ctx.router, s, [h]
+                        )
+                    )
+                    for spec, handle in pairs
+                ],
+                limit=8,
+            )
+            for (spec, handle), task in zip(pairs, dispatched):
+                tasks.append(task)
                 await self._journal_dispatch(ctx, spec.job_id, handle, "train")
 
             await self._supervise(ctx, tasks)
@@ -1763,6 +1838,14 @@ class Orchestrator:
             # until the first adaptive assignment exists.
             assignments = ctx.adaptive.assignments()
             snapshot.inner_steps = assignments or None
+        # Encode once per shard payload, OFF-loop (the snapshot's active
+        # list is O(fleet); at N=128 serial per-shard re-encodes on the
+        # event loop were the membership path's CPU), then fan the
+        # requests out with bounded concurrency instead of awaiting each
+        # shard in turn — the sweep's wall-clock stops scaling with the
+        # shard count. The wire bytes are identical to encoding at each
+        # call site (messages.PreEncoded).
+        live: list[tuple[int, WorkerHandle]] = []
         for k, handle in enumerate(ctx.ps_handles):
             if handle is None:
                 # Shard mid-restart: a plain snapshot loss is repaired by
@@ -1774,21 +1857,47 @@ class Orchestrator:
                 if joined:
                     ok = False
                 continue
-            update = MembershipUpdate(
+            live.append((k, handle))
+        joined_list = list(joined or [])
+        updates = [
+            MembershipUpdate(
                 job_id=ctx.ps_job_ids[k],
                 membership=snapshot,
-                joined=list(joined or []),
+                joined=joined_list,
             )
+            for k, _ in live
+        ]
+
+        def encode_all():
+            try:
+                return [messages.PreEncoded.of(u) for u in updates]
+            except Exception:
+                # Snapshot not wire-encodable (test doubles drive this
+                # path with fakes): fall back to in-request encoding.
+                return updates
+
+        payloads = await asyncio.to_thread(encode_all)
+
+        async def push_one(k: int, handle: WorkerHandle, payload) -> bool:
             try:
                 await self.node.request(
-                    handle.peer_id, PROTOCOL_FT, update, timeout=10
+                    handle.peer_id, PROTOCOL_FT, payload, timeout=10
                 )
+                return True
             except RequestError as e:
                 log.warning(
                     "membership update to PS shard %d failed: %s", k, e
                 )
-                ok = False
-        return ok
+                return False
+
+        results = await aio.gather_bounded(
+            [
+                (lambda k=k, h=handle, p=payload: push_one(k, h, p))
+                for (k, handle), payload in zip(live, payloads)
+            ],
+            limit=8,
+        )
+        return ok and all(results)
 
     async def _depart(self, ctx: _RunContext, peer: str, reason: str, add) -> None:
         """A train worker is gone: degrade the round set, maybe rejoin."""

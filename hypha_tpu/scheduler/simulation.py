@@ -38,6 +38,10 @@ class Projection:
     left: int  # samples still unassigned when simulation stopped
     updates: tuple  # per-worker batch counts to run before the sync point
     capped: bool  # True when time_cap/updates_cap stopped the simulation
+    # True when the cap is "a worker has no statistics yet" — its capacity
+    # is unknown, not zero, so callers must not memoize the shortfall
+    # (the batch scheduler's O(1) capped-sim fast path keys on this).
+    no_stats: bool = False
 
 
 def project(
@@ -60,7 +64,7 @@ def project(
     if remaining <= 0:
         return Projection(0.0, max(remaining, 0), tuple(updates), False)
     if n == 0 or any(w.mean_batch_ms is None for w in workers):
-        return Projection(0.0, remaining, tuple(updates), True)
+        return Projection(0.0, remaining, tuple(updates), True, no_stats=True)
 
     # Priority queue of (next_completion_time_ms, index).
     heap: list[tuple[float, int]] = []

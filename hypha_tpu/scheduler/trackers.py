@@ -69,24 +69,67 @@ class ProgressTracker:
         self.last_update: list[float] = []  # clock() of last completed batch
         self.stats: list[RuntimeStatistic] = []
         self.states: list[WorkerState] = []
+        # O(1) lookups at fleet scale (ISSUE 14): peer → array index, a
+        # per-state census, and the Σ batch_size over workers still
+        # producing this round (TRAINING / UPDATE_SCHEDULED — the batch
+        # scheduler's reachability lower bound). All maintained
+        # incrementally: every mutation funnels through add/remove/
+        # set_state, so per-Status work stays independent of N.
+        self._index: dict[str, int] = {}
+        self._state_counts: dict[WorkerState, int] = {s: 0 for s in WorkerState}
+        self.sim_batch_total = 0
+        # Invalidation feeds for the batch scheduler's cached round plan
+        # and capped-capacity memo. A mid-round depart must re-spread the
+        # dead worker's planned share, and a materially faster fleet must
+        # re-measure its assignable capacity — both caches key on these
+        # versions so staleness is bounded to one Status.
+        self.membership_version = 0
+        # Bumped when any worker's mean drifts >10% (either direction)
+        # from the value at its last bump: a projection's time-capped
+        # capacity is only as fresh as the speeds it simulated. The 10%
+        # hysteresis keeps converged EWMAs from bumping every Status.
+        self.stats_version = 0
+        self._stat_base: list[float | None] = []
+
+    _SIM_STATES = (WorkerState.TRAINING, WorkerState.UPDATE_SCHEDULED)
 
     # -- membership ---------------------------------------------------------
     def add_worker(self, peer: str, batch_size: int) -> None:
-        if peer in self.peers:
+        if peer in self._index:
             raise ValueError(f"worker {peer!r} already tracked")
+        self._index[peer] = len(self.peers)
         self.peers.append(peer)
         self.batch_sizes.append(batch_size)
         self.last_update.append(self._clock())
         self.stats.append(self._stat_factory())
         self.states.append(WorkerState.TRAINING)
+        self._state_counts[WorkerState.TRAINING] += 1
+        self.sim_batch_total += batch_size
+        self._stat_base.append(None)
+        self.membership_version += 1
 
     def index_of(self, peer: str) -> int:
-        return self.peers.index(peer)
+        try:
+            return self._index[peer]
+        except KeyError:
+            raise ValueError(f"{peer!r} is not tracked") from None
+
+    def tracked(self, peer: str) -> bool:
+        """O(1) membership — ``peer in tracker.peers`` scans the list."""
+        return peer in self._index
 
     def remove_worker(self, peer: str) -> None:
-        i = self.peers.index(peer)
-        for arr in (self.peers, self.batch_sizes, self.last_update, self.stats, self.states):
+        i = self._index.pop(peer)
+        self._state_counts[self.states[i]] -= 1
+        if self.states[i] in self._SIM_STATES:
+            self.sim_batch_total -= self.batch_sizes[i]
+        for arr in (self.peers, self.batch_sizes, self.last_update, self.stats, self.states, self._stat_base):
             del arr[i]
+        # Membership changes are rare (join/depart); re-basing the index
+        # once per change keeps every hot-path lookup O(1).
+        for j in range(i, len(self.peers)):
+            self._index[self.peers[j]] = j
+        self.membership_version += 1
 
     # -- round progress -----------------------------------------------------
     def update(self, peer: str, batch_size: int) -> None:
@@ -97,20 +140,39 @@ class ProgressTracker:
         self.stats[i].record(elapsed_ms)
         self.last_update[i] = now
         self.counter -= batch_size
+        mean = self.stats[i].mean()
+        if mean is not None:
+            base = self._stat_base[i]
+            if base is None or not (0.9 * base <= mean <= base / 0.9):
+                self.stats_version += 1
+                self._stat_base[i] = mean
 
     def elapsed_ms(self, peer: str) -> float:
         i = self.index_of(peer)
         return (self._clock() - self.last_update[i]) * 1000.0
 
     def set_state(self, peer: str, state: WorkerState) -> None:
-        self.states[self.index_of(peer)] = state
+        i = self.index_of(peer)
+        old = self.states[i]
+        if old is state:
+            return
+        self._state_counts[old] -= 1
+        self._state_counts[state] += 1
+        if (old in self._SIM_STATES) != (state in self._SIM_STATES):
+            delta = self.batch_sizes[i]
+            self.sim_batch_total += (
+                delta if state in self._SIM_STATES else -delta
+            )
+        self.states[i] = state
 
     def state(self, peer: str) -> WorkerState:
         return self.states[self.index_of(peer)]
 
     def all_in(self, *states: WorkerState) -> bool:
-        allowed = set(states)
-        return bool(self.states) and all(s in allowed for s in self.states)
+        # O(states), not O(N): the census is maintained by set_state.
+        return bool(self.states) and sum(
+            self._state_counts[s] for s in set(states)
+        ) == len(self.states)
 
     def advance_round(self) -> None:
         """Parameter server reported Updated: reset the sample counter."""

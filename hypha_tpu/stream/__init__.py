@@ -34,6 +34,15 @@ bit-identical to the pre-streaming behavior).
 from __future__ import annotations
 
 from .partition import fragment_of, partition_names, shard_names, shard_of
+from .tree import (
+    ancestors_of,
+    build_reduce_groups,
+    children_of,
+    parent_of,
+    subtree_of,
+    top_targets,
+    tree_levels,
+)
 from .sync import (
     SYNC_MODES,
     effective_fragments,
@@ -58,4 +67,11 @@ __all__ = [
     "shard_owns_round",
     "shards_due_at",
     "next_owned_round",
+    "build_reduce_groups",
+    "children_of",
+    "parent_of",
+    "ancestors_of",
+    "subtree_of",
+    "top_targets",
+    "tree_levels",
 ]

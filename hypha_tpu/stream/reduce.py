@@ -35,6 +35,26 @@ Quantized jobs re-encode the partial with the job's ``delta_codec`` and a
 per-part error-feedback residual — the partial stream per part is as much
 a time series as a worker's delta stream, so EF is unbiased for exactly
 the reason it is on the PS broadcast path.
+
+Multi-level trees (``reduce_tree_depth >= 2``, hypha_tpu.stream.tree): a
+mid-tree reducer's children are themselves reducers, so its bucket holds a
+MIX of raw leaf deltas and ``prefold``-tagged partials — partials fold
+verbatim (``RoundAccum`` prefolded semantics) and their ``covers`` headers
+union transitively, so the partial a top-level reducer ships still lists
+exactly the WORKER peers it represents. A mid-tree reducer forwards its
+cumulative partial to its own parent (``cfg.reduce_via``) with the same
+``[parent, shard]`` ANY failover leaves use, so a dead parent degrades one
+hop instead of severing the subtree; the shard's cover-set reconciliation
+(hypha_tpu.worker.ps_executor) resolves any at-least-once overlap between
+a failed-over partial and its ancestor's.
+
+:class:`BroadcastRelay` runs the same tree DOWNWARD for update broadcasts:
+the parameter service pushes each round's wire to the top-level reducers
+(and ungrouped workers) only under the ``<results>.relay`` tag; each relay
+injects the wire into its OWN training loop locally and re-pushes it to
+its direct children — relay tag for child reducers, the plain results tag
+for leaves — expanding a dead child relay to that child's children so a
+mid-tree death costs fan-out, not the subtree's round.
 """
 
 from __future__ import annotations
@@ -49,11 +69,21 @@ from pathlib import Path
 
 from .. import aio, compress
 from ..messages import PREFOLD_KEY, SHARD_KEY, FragmentTag
-from ..telemetry.ft_metrics import SHARD_METRICS
+from ..telemetry.ft_metrics import SCALE_METRICS, SHARD_METRICS
 from .accum import RoundAccum
 from .partition import shard_of
+from .tree import children_of, subtree_of, tree_levels
 
-__all__ = ["GroupReducer", "maybe_start_reducer", "REDUCE_FLUSH_ENV"]
+__all__ = [
+    "GroupReducer",
+    "BroadcastRelay",
+    "TreeRuntime",
+    "maybe_start_reducer",
+    "maybe_start_relay",
+    "relay_tag",
+    "tree_broadcast",
+    "REDUCE_FLUSH_ENV",
+]
 
 log = logging.getLogger("hypha.stream.reduce")
 
@@ -72,15 +102,35 @@ def _flush_after() -> float:
         return _FLUSH_DEFAULT
 
 
-def maybe_start_reducer(node, spec) -> "GroupReducer | None":
-    """Start a :class:`GroupReducer` next to a dispatched train job when
-    its spec names THIS worker as its group's reducer (non-empty
-    ``reduce_members`` + a placement map). Returns the started reducer, or
-    None for every other job — the worker runtimes call this on dispatch
-    and ``await reducer.stop()`` on job teardown.
+class TreeRuntime:
+    """The runtime-side tree roles one dispatched train job gave this
+    worker: its :class:`GroupReducer` (upward partial folding) and, when
+    the job's broadcast tree is on, its :class:`BroadcastRelay` (downward
+    wire fan-out). The worker runtimes hold one handle and ``await
+    stop()`` on job teardown."""
 
-    Lives runtime-side (not in the training executor process): the
-    reducer consumes fabric pushes, and the node lives in the runtime.
+    def __init__(self, reducer=None, relay=None) -> None:
+        self.reducer = reducer
+        self.relay = relay
+
+    async def stop(self) -> None:
+        if self.relay is not None:
+            await self.relay.stop()
+        if self.reducer is not None:
+            await self.reducer.stop()
+
+
+def maybe_start_reducer(node, spec) -> "TreeRuntime | None":
+    """Start this worker's tree roles for a dispatched train job: a
+    :class:`GroupReducer` when the spec names it as its group's reducer
+    (non-empty ``reduce_members`` + a placement map), plus a
+    :class:`BroadcastRelay` when the job's broadcast tree is on
+    (``relay_results``). Returns the started runtime, or None for every
+    other job — the worker runtimes call this on dispatch and ``await
+    runtime.stop()`` on job teardown.
+
+    Lives runtime-side (not in the training executor process): both roles
+    consume fabric pushes, and the node lives in the runtime.
     """
     cfg = getattr(getattr(spec, "executor", None), "train", None)
     if cfg is None:
@@ -95,18 +145,47 @@ def maybe_start_reducer(node, spec) -> "GroupReducer | None":
         "group reducer started: %d members, %d shard(s)",
         len(members), len(shard_map.shards),
     )
-    return reducer
+    return TreeRuntime(reducer=reducer, relay=maybe_start_relay(node, spec))
+
+
+class _Entry:
+    """One accepted child contribution: the saved file, its weight, and
+    whether it is a prefolded partial (and then, the workers it covers)."""
+
+    __slots__ = ("path", "samples", "prefolded", "covers")
+
+    def __init__(
+        self, path: Path, samples: float, prefolded: bool, covers: frozenset
+    ) -> None:
+        self.path = path
+        self.samples = samples
+        self.prefolded = prefolded
+        self.covers = covers
 
 
 class _Bucket:
-    """One (round, part)'s group state on the reducer."""
+    """One (round, part)'s group state on the reducer.
+
+    Entries are keyed like the shard's received table
+    (``prefold:``-prefixed for partials): a mid-tree child sends BOTH its
+    own raw delta and its group's partial, and the two must never collide
+    as duplicates of each other.
+    """
 
     def __init__(self) -> None:
         self.accum = RoundAccum()
-        self.entries: dict[str, tuple[Path, float]] = {}  # peer -> file
+        self.entries: dict[str, _Entry] = {}
         self.first_at: float | None = None
         self.flushed = 0  # partials shipped so far (re-flushes included)
         self.dirty = False  # folds since the last flush
+
+    def covered(self) -> set[str]:
+        """The worker peers this bucket's cumulative sum represents:
+        direct senders plus every partial's transitive cover set."""
+        out: set[str] = set()
+        for entry in self.entries.values():
+            out |= entry.covers
+        return out
 
 
 class GroupReducer:
@@ -130,6 +209,17 @@ class GroupReducer:
         self.tags: list[str] = list(shard_map.tags)
         self.num_shards = len(self.shards)
         self.parts = int(shard_map.fragments) or 1
+        # Multi-level placement (stream.tree): the parent this reducer
+        # forwards partials to (None = top level, ship to the shard), the
+        # full worker set its subtree can cover (bucket completeness), and
+        # its level for the per-level telemetry counters.
+        groups = list(getattr(shard_map, "groups", None) or [])
+        self.parent = getattr(cfg, "reduce_via", None) or None
+        peer_id = getattr(node, "peer_id", "")
+        self.expected_cover = (
+            set(subtree_of(groups, peer_id)) if groups else set(self.members)
+        ) or set(self.members)
+        self.level = tree_levels(groups).get(peer_id, 1) if groups else 1
         self._own_dir = work_dir is None
         self.work_dir = Path(
             work_dir
@@ -222,27 +312,42 @@ class GroupReducer:
             samples = float(meta.get("num_samples", 1.0))
         except (TypeError, ValueError):
             samples = 1.0
+        # A child reducer's forwarded partial folds VERBATIM (already
+        # Σ samples·Δθ) and covers the workers its header lists; a raw
+        # delta covers its sender. The entry key keeps a mid-tree child's
+        # partial and its OWN direct delta apart.
+        prefolded = bool(meta.get(PREFOLD_KEY))
+        covers = (
+            frozenset(str(p) for p in (meta.get("covers") or ()))
+            if prefolded
+            else frozenset((peer,))
+        )
+        key = f"prefold:{peer}" if prefolded else peer
         bucket = self._buckets.setdefault((round_num, part), _Bucket())
         if bucket.first_at is None:
             bucket.first_at = asyncio.get_running_loop().time()
-        old = bucket.entries.pop(peer, None)
+        old = bucket.entries.pop(key, None)
         if old is not None:
             # Duplicate re-send: un-fold the superseded delta while its
             # file still holds the original bytes, exactly like the shard
             # does — the next flush ships the corrected cumulative sum.
             log.warning(
-                "reducer: duplicate delta from %s (round %d part %d); "
-                "replacing", peer, round_num, part,
+                "reducer: duplicate %s from %s (round %d part %d); "
+                "replacing", "partial" if prefolded else "delta",
+                peer, round_num, part,
             )
             await asyncio.to_thread(
-                bucket.accum.fold, old[0], old[1], -1.0
+                bucket.accum.fold, old.path, old.samples, -1.0, old.prefolded
             )
             self.unfolds += 1
-            old[0].unlink(missing_ok=True)
-        await asyncio.to_thread(bucket.accum.fold, dest, samples)
+            old.path.unlink(missing_ok=True)
+        await asyncio.to_thread(
+            bucket.accum.fold, dest, samples, 1.0, prefolded
+        )
         self.folds += 1
         SHARD_METRICS.reduced_deltas.add(1)
-        bucket.entries[peer] = (dest, samples)
+        SCALE_METRICS.note_tree_fold(self.level)
+        bucket.entries[key] = _Entry(dest, samples, prefolded, covers)
         bucket.dirty = True
 
     def _part_of(self, meta: dict) -> int | None:
@@ -267,7 +372,10 @@ class GroupReducer:
         for (round_num, part), bucket in list(self._buckets.items()):
             if not bucket.dirty:
                 continue
-            complete = set(bucket.entries) >= self.members
+            # Complete when every worker in this reducer's SUBTREE is
+            # represented — direct leaf deltas plus child partials' covers
+            # (at depth 1 this is exactly "every member reported").
+            complete = bucket.covered() >= self.expected_cover
             overdue = (
                 bucket.first_at is not None
                 and now - bucket.first_at >= self._flush_after
@@ -315,24 +423,47 @@ class GroupReducer:
             "num_samples": float(bucket.accum.total_samples),
             PREFOLD_KEY: True,
             # The worker peers this partial represents: the shard's close
-            # condition counts covered WORKERS, not accepted files.
-            "covers": sorted(bucket.entries),
+            # condition counts covered WORKERS, not accepted files —
+            # covers union TRANSITIVELY through child partials, so a
+            # top-level flush still lists leaf workers, never reducers'
+            # intermediate identities.
+            "covers": sorted(bucket.covered()),
         }
         if tag_header:
             header.update(tag_header)
         if self.num_shards > 1:
             header[SHARD_KEY] = owner
-        peer = self.shards[owner]
+        shard_peer = self.shards[owner]
+        # Mid-tree reducers forward UP the tree: the parent first, the
+        # owning shard as ANY failover (a dead parent degrades this
+        # subtree to a direct-to-shard partial; the shard's cover-set
+        # reconciliation absorbs any at-least-once overlap with the
+        # parent's own partial).
+        peers = (
+            [self.parent, shard_peer]
+            if self.parent and self.parent != shard_peer
+            else [shard_peer]
+        )
         from ..network.node import RequestError
         from ..worker.connectors import push_timeout
 
+        async def any_once() -> None:
+            last: Exception | None = None
+            for peer in peers:
+                try:
+                    await self.node.push(peer, header, wire)
+                    return
+                except (RequestError, OSError) as e:
+                    last = e
+            raise RequestError(f"no peer accepted the partial: {last}")
+
         try:
             await aio.retry(
-                lambda: self.node.push(peer, header, wire),
+                any_once,
                 attempts=3, base_delay=0.25,
-                attempt_timeout=push_timeout(wire),
+                attempt_timeout=push_timeout(wire) * len(peers),
                 retry_on=(RequestError, OSError),
-                what=f"reduce partial to {peer}", logger=log,
+                what=f"reduce partial to {peers}", logger=log,
             )
         except (RequestError, OSError, asyncio.TimeoutError) as e:
             # Tolerated: the members' ANY failover (and the shard's
@@ -340,18 +471,21 @@ class GroupReducer:
             # next dirty flush.
             log.warning(
                 "reducer: partial push r%d part %d to %s failed: %s",
-                round_num, part, peer, e,
+                round_num, part, peers, e,
             )
             wire.unlink(missing_ok=True)
             return
         bucket.flushed += 1
         bucket.dirty = False
         self.partials += 1
+        SCALE_METRICS.note_tree_forward(self.level)
         wire.unlink(missing_ok=True)
         log.info(
-            "reducer: shipped partial r%d part %d -> shard %d "
-            "(%d members, weight %.1f)",
-            round_num, part, owner, len(bucket.entries),
+            "reducer: shipped partial r%d part %d -> %s "
+            "(%d entries, covers %d workers, weight %.1f)",
+            round_num, part,
+            self.parent or f"shard {owner}",
+            len(bucket.entries), len(bucket.covered()),
             bucket.accum.total_samples,
         )
         self._gc(round_num, part)
@@ -363,6 +497,251 @@ class GroupReducer:
         for key in [
             k for k in self._buckets if k[1] == part and k[0] < round_num
         ]:
-            for path, _ in self._buckets[key].entries.values():
-                path.unlink(missing_ok=True)
+            for entry in self._buckets[key].entries.values():
+                entry.path.unlink(missing_ok=True)
             del self._buckets[key]
+
+
+# ---------------------------------------------------------------- broadcast
+
+
+def relay_tag(results_tag: str) -> str:
+    """The resource tag broadcast-tree wires travel under BETWEEN tree
+    nodes. Distinct from the plain results tag so a relay's consumer and
+    its own training loop's receive never race for the same push — the
+    relay re-injects the plain-tagged copy locally."""
+    return f"{results_tag}.relay"
+
+
+async def tree_broadcast(
+    node,
+    header: dict,
+    results_tag: str,
+    groups,
+    targets,
+    wire_path: Path,
+    *,
+    allowed=None,
+    concurrency: int = 8,
+    attempts: int = 2,
+    what: str = "tree broadcast",
+    logger=log,
+) -> tuple[int, int]:
+    """Push one wire down a broadcast tree hop with failover expansion.
+
+    ``targets`` get the push concurrently (bounded at ``concurrency``
+    streams, ``_broadcast``'s discipline): a target that has children in
+    ``groups`` receives it under the RELAY tag (its BroadcastRelay
+    re-pushes to its subtree), a leaf under the plain results tag. A
+    target whose push fails after ``attempts`` tries is expanded to its
+    direct children — filtered by ``allowed`` (the live broadcast set)
+    when given — so a dead mid-tree relay degrades this hop's fan-out
+    instead of severing its subtree. Returns ``(delivered, lost)`` where
+    ``lost`` counts leaf peers no path could reach (they catch up from the
+    next round's broadcast, exactly like a failed direct push today).
+    """
+    from ..network.node import RequestError
+    from ..worker.connectors import push_timeout
+
+    kids = children_of(groups)
+
+    async def push_one(peer: str) -> bool:
+        hdr = dict(header)
+        hdr["resource"] = (
+            relay_tag(results_tag) if kids.get(peer) else results_tag
+        )
+        try:
+            await aio.retry(
+                lambda: node.push(peer, hdr, wire_path),
+                attempts=attempts, base_delay=0.25,
+                attempt_timeout=push_timeout(wire_path),
+                retry_on=(RequestError, OSError),
+                what=f"{what} to {peer}", logger=logger,
+            )
+            return True
+        except (RequestError, OSError, asyncio.TimeoutError) as e:
+            logger.warning("%s to %s failed: %s", what, peer, e)
+            return False
+
+    delivered = lost = 0
+    frontier = [str(p) for p in targets]
+    while frontier:
+        # push_one never raises, so each wave is one bounded fan-out.
+        outcomes = await aio.gather_bounded(
+            [(lambda p=p: push_one(p)) for p in frontier],
+            limit=concurrency,
+        )
+        next_frontier: list[str] = []
+        for peer, ok in zip(frontier, outcomes):
+            if ok:
+                delivered += 1
+                SCALE_METRICS.relay_pushes.add(1)
+                continue
+            children = [
+                c
+                for c in kids.get(peer, ())
+                if allowed is None or c in allowed
+            ]
+            if children:
+                # Route AROUND the dead relay: its children take the push
+                # directly from this hop (grandparent failover).
+                SCALE_METRICS.relay_failovers.add(1)
+                logger.warning(
+                    "%s: relay %s unreachable; expanding to %d children",
+                    what, peer, len(children),
+                )
+                next_frontier.extend(children)
+            else:
+                lost += 1
+        frontier = next_frontier
+    return delivered, lost
+
+
+class BroadcastRelay:
+    """Re-push results-stream wires down this worker's subtree.
+
+    The reduce tree run in reverse: the parameter service pushes each
+    round's update wire to the TOP-level reducers only (and ungrouped
+    workers) under the relay tag; each relay saves the wire once,
+    re-injects a plain-tagged copy into its own node's push routing (the
+    local training loop consumes it exactly as if the PS had pushed
+    directly — same header, same sender attribution), and forwards it to
+    its direct children with :func:`tree_broadcast`'s failover expansion.
+    Headers ride VERBATIM (round, epoch, generation, shard, fragment tag,
+    traceparent), so every stale-round / generation / epoch gate on the
+    worker side behaves identically to the star topology.
+    """
+
+    def __init__(self, node, cfg, work_dir: Path | str | None = None) -> None:
+        shard_map = cfg.ps_shards
+        if shard_map is None:
+            raise ValueError("BroadcastRelay needs cfg.ps_shards placement")
+        self.node = node
+        self.groups = list(getattr(shard_map, "groups", None) or [])
+        ref = cfg.results.ref
+        self.results_tag = ref.resource or "results"
+        self.children = children_of(self.groups).get(node.peer_id, [])
+        self._own_dir = work_dir is None
+        self.work_dir = Path(
+            work_dir
+            if work_dir is not None
+            else tempfile.mkdtemp(prefix="hypha-relay-")
+        )
+        self._task: asyncio.Task | None = None
+        self._consumer = None
+        # test/bench hooks
+        self.relayed = 0
+
+    def start(self) -> None:
+        tag = relay_tag(self.results_tag)
+
+        def wants(push) -> bool:
+            r = push.resource
+            return isinstance(r, dict) and r.get("resource") == tag
+
+        self.work_dir.mkdir(parents=True, exist_ok=True)
+        self._consumer = self.node.consume_pushes(wants)
+        self._task = aio.spawn(
+            self._run(), what="broadcast relay", logger=log
+        )
+
+    async def stop(self) -> None:
+        task, self._task = self._task, None
+        if task is not None:
+            # Cancel until it sticks: a cancel landing in the same loop
+            # iteration as local_done completing is SWALLOWED by 3.10's
+            # wait_for (bpo-37658) — the task would resume its consumer
+            # wait and a single reap would hang forever.
+            while not task.done():
+                task.cancel()
+                await asyncio.wait({task}, timeout=0.5)
+        if self._consumer is not None:
+            self._consumer.close()
+            self._consumer = None
+        if self._own_dir:
+            await asyncio.to_thread(
+                shutil.rmtree, self.work_dir, ignore_errors=True
+            )
+
+    async def _run(self) -> None:
+        assert self._consumer is not None
+        # Sequential per push: the PS chains same-fragment fan-outs so
+        # broadcast ORDER is part of the protocol — relaying two rounds of
+        # one fragment concurrently could invert their arrival below.
+        while True:
+            push = await self._consumer.next()
+            try:
+                await self._relay(push)
+            except asyncio.CancelledError:
+                raise
+            except Exception:
+                log.exception("broadcast relay failed for one wire")
+
+    async def _relay(self, push) -> None:
+        meta = push.resource if isinstance(push.resource, dict) else {}
+        dest = self.work_dir / f"relay-{uuid.uuid4().hex[:12]}"
+        await push.save_to(dest)
+        self.relayed += 1
+        # Subtree FIRST, own copy second: the invariant downstream
+        # failover reasons from is "once the subtree's root merged round
+        # r, the subtree was served round r" — injecting locally first
+        # would let this node finish the round (and, in a crash, die)
+        # with the fan-out still pending, silently starving its children.
+        # The injected copy keeps the ORIGINAL sender attribution (the
+        # parent hop), which the training loop's results allowlist admits
+        # (the orchestrator lists each worker's ancestor chain next to
+        # the shard peers).
+        local_done = asyncio.Event()
+        local_header = {**meta, "resource": self.results_tag}
+        injected = False
+        try:
+            await tree_broadcast(
+                self.node, meta, self.results_tag, self.groups,
+                self.children, dest, what="relay", logger=log,
+            )
+            await self.node.inject_push(
+                push.peer, local_header, dest, on_done=local_done.set
+            )
+            injected = True
+        finally:
+            # The local consumer owns its copy of the bytes once finish()
+            # fires; a consumer that never drains (job mid-teardown) must
+            # not pin the file — stop()'s rmtree sweeps the stragglers.
+            # asyncio.wait, not wait_for: 3.10's wait_for can swallow a
+            # cancellation racing the event (bpo-37658), which would eat
+            # stop()'s cancel and wedge teardown.
+            if injected:
+                waiter = asyncio.create_task(local_done.wait())
+                try:
+                    done, _ = await asyncio.wait({waiter}, timeout=120)
+                    if not done:
+                        log.warning(
+                            "relay: local consumer never drained the wire"
+                        )
+                finally:
+                    waiter.cancel()
+                dest.unlink(missing_ok=True)
+            else:
+                dest.unlink(missing_ok=True)
+
+
+def maybe_start_relay(node, spec) -> "BroadcastRelay | None":
+    """Start a :class:`BroadcastRelay` next to a dispatched train job when
+    its spec turns the broadcast tree on (``relay_results``) and names
+    this worker as a reducer (non-empty ``reduce_members``). Returns the
+    started relay or None; the worker runtimes call this on dispatch and
+    ``await relay.stop()`` on teardown, exactly like
+    :func:`maybe_start_reducer`."""
+    cfg = getattr(getattr(spec, "executor", None), "train", None)
+    if cfg is None:
+        return None
+    if not getattr(cfg, "relay_results", None):
+        return None
+    members = getattr(cfg, "reduce_members", None)
+    shard_map = getattr(cfg, "ps_shards", None)
+    if not members or shard_map is None:
+        return None
+    relay = BroadcastRelay(node, cfg)
+    relay.start()
+    log.info("broadcast relay started: %d direct children", len(members))
+    return relay

@@ -505,6 +505,7 @@ def metrics_snapshot() -> dict:
         "shard": SHARD_METRICS.snapshot(),
         "serve": SERVE_METRICS.snapshot(),
         "het": HET_METRICS.snapshot(),
+        "scale": SCALE_METRICS.snapshot(),
         "gauges": gauges,
         "aio_task_failures": _aio_task_failures(),
     }
@@ -521,6 +522,7 @@ def _aio_task_failures() -> float:
 from .ft_metrics import (  # noqa: E402
     FT_METRICS,
     HET_METRICS,
+    SCALE_METRICS,
     SERVE_METRICS,
     SHARD_METRICS,
     STREAM_METRICS,
@@ -528,4 +530,10 @@ from .ft_metrics import (  # noqa: E402
     ServeMetrics,
 )
 
-__all__ += ["FT_METRICS", "FTMetrics", "SERVE_METRICS", "ServeMetrics"]
+__all__ += [
+    "FT_METRICS",
+    "FTMetrics",
+    "SCALE_METRICS",
+    "SERVE_METRICS",
+    "ServeMetrics",
+]

@@ -28,6 +28,8 @@ __all__ = [
     "SERVE_METRICS",
     "HetMetrics",
     "HET_METRICS",
+    "ScaleMetrics",
+    "SCALE_METRICS",
     "register_on",
 ]
 
@@ -505,6 +507,131 @@ class HetMetrics:
 HET_METRICS = HetMetrics()
 
 
+class ScaleMetrics:
+    """Control-plane scale instruments (ROADMAP item 4 / ISSUE 14).
+
+    * ``control_bytes``   — per-protocol control-plane wire bytes (request
+      + response frames through ``Node``): membership updates
+      (``/hypha-ft``), Status/ScheduleUpdate heartbeats
+      (``/hypha-progress``), lease traffic (``/hypha-api``) — the numbers
+      ``benchmarks/scalebench.py`` asserts sublinear. Tensor payloads
+      (push/pull) deliberately do NOT record here; they are data plane.
+    * ``tree folds/forwards`` — per-level reduce-tree activity: how many
+      child contributions each level folded and how many cumulative
+      partials it shipped up (``hypha_tpu.stream.reduce.GroupReducer``).
+    * ``relay counters``  — broadcast-tree pushes delivered per hop and
+      dead-relay failover expansions (``tree_broadcast``).
+    * ``sched_progress_ms`` — the scheduler's per-message control-loop
+      time (``BatchScheduler.on_progress``), the reservoir scalebench
+      reads its scheduler-CPU-per-round numbers from.
+    """
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._control: dict[str, Counter] = {}
+        self.tree_folds: dict[int, Counter] = {}
+        self.tree_forwards: dict[int, Counter] = {}
+        self.relay_pushes = Counter("hypha.scale.relay_pushes")
+        self.relay_failovers = Counter("hypha.scale.relay_failovers")
+        self.sched_progress_ms = Histogram(
+            "hypha.scale.sched_progress", unit="ms",
+            bounds=(0.05, 0.1, 0.25, 0.5, 1, 2.5, 5, 10, 25, 50, 100),
+        )
+        # Meters registered via register_on: protocols and tree levels
+        # only become known as traffic flows, so their gauges attach
+        # lazily (the het bundle's discipline).
+        self._meters: list[Meter] = []
+
+    # ------------------------------------------------------------ recording
+    @staticmethod
+    def _proto_key(protocol: str) -> str:
+        # "/hypha-progress/0.0.1" -> "hypha-progress"
+        return protocol.strip("/").split("/", 1)[0] or "unknown"
+
+    def note_control(self, protocol: str, nbytes: int) -> None:
+        key = self._proto_key(protocol)
+        with self._lock:
+            counter = self._control.get(key)
+            created = counter is None
+            if created:
+                counter = Counter(f"hypha.scale.control_bytes.{key}")
+                self._control[key] = counter
+            meters = list(self._meters) if created else []
+        for meter in meters:
+            meter.observable_gauge(counter.name, counter.value)
+        counter.add(int(nbytes))
+
+    def _level_counter(
+        self, table: dict[int, Counter], level: int, stem: str
+    ) -> Counter:
+        level = int(level)
+        with self._lock:
+            counter = table.get(level)
+            created = counter is None
+            if created:
+                counter = Counter(f"hypha.scale.{stem}.l{level}")
+                table[level] = counter
+            meters = list(self._meters) if created else []
+        for meter in meters:
+            meter.observable_gauge(counter.name, counter.value)
+        return counter
+
+    def note_tree_fold(self, level: int) -> None:
+        self._level_counter(self.tree_folds, level, "tree_folds").add(1)
+
+    def note_tree_forward(self, level: int) -> None:
+        self._level_counter(self.tree_forwards, level, "tree_forwards").add(1)
+
+    def note_sched_progress(self, ms: float) -> None:
+        self.sched_progress_ms.record(float(ms))
+
+    # ------------------------------------------------------------- querying
+    def control_bytes(self) -> dict[str, int]:
+        with self._lock:
+            return {k: int(c.value()) for k, c in sorted(self._control.items())}
+
+    def attach_meter(self, meter: Meter) -> None:
+        """Export the lazy per-protocol/per-level instruments, including
+        ones first seen after this call."""
+        with self._lock:
+            self._meters.append(meter)
+            counters = (
+                list(self._control.values())
+                + list(self.tree_folds.values())
+                + list(self.tree_forwards.values())
+            )
+        for counter in counters:
+            meter.observable_gauge(counter.name, counter.value)
+
+    def snapshot(self) -> dict:
+        hist = self.sched_progress_ms.snapshot()
+        with self._lock:
+            folds = {
+                f"l{lv}": int(c.value())
+                for lv, c in sorted(self.tree_folds.items())
+            }
+            forwards = {
+                f"l{lv}": int(c.value())
+                for lv, c in sorted(self.tree_forwards.items())
+            }
+        return {
+            "control_bytes": self.control_bytes(),
+            "tree_folds": folds,
+            "tree_forwards": forwards,
+            "relay_pushes": self.relay_pushes.value(),
+            "relay_failovers": self.relay_failovers.value(),
+            "sched_progress_ms_sum": hist["sum"],
+            "sched_progress_ms_count": hist["count"],
+        }
+
+    def reset(self) -> None:
+        """Fresh instruments (tests and scalebench isolate runs this way)."""
+        self.__init__()
+
+
+SCALE_METRICS = ScaleMetrics()
+
+
 def register_on(
     meter: Meter,
     metrics: FTMetrics = FT_METRICS,
@@ -596,8 +723,17 @@ def register_on(
     meter.observable_gauge(
         "hypha.het.codec_switches", het.codec_switches.value
     )
+    meter.observable_gauge(
+        "hypha.scale.relay_pushes", SCALE_METRICS.relay_pushes.value
+    )
+    meter.observable_gauge(
+        "hypha.scale.relay_failovers", SCALE_METRICS.relay_failovers.value
+    )
     # Per-fragment close counters (and the heterogeneity bundle's per-peer
-    # bandwidth / assigned-step gauges + per-codec counters) attach lazily
-    # — fragment ids and peers only exist once rounds run.
+    # bandwidth / assigned-step gauges + per-codec counters, and the scale
+    # bundle's per-protocol control bytes + per-level tree counters)
+    # attach lazily — fragment ids, peers and protocols only exist once
+    # traffic flows.
     stream.attach_meter(meter)
     het.attach_meter(meter)
+    SCALE_METRICS.attach_meter(meter)

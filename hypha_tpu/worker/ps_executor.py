@@ -98,8 +98,10 @@ from ..stream import (
     next_owned_round,
     placement_parts,
     shard_owns_round,
+    top_targets,
 )
 from ..stream.accum import RoundAccum
+from ..stream.reduce import tree_broadcast
 from ..telemetry import trace
 from ..telemetry.flight import FLIGHT
 from ..telemetry.ft_metrics import (
@@ -1004,6 +1006,37 @@ class ParameterServerExecutor(JobExecutor):
         return f"{_PREFOLD_PREFIX}{peer}" if prefolded else peer
 
     @staticmethod
+    def _prefold_superseded(covers, cov, key: str) -> bool:
+        """Must a NEW partial be dropped against the accepted ones?
+
+        Multi-level trees make partial-vs-partial overlap possible: a
+        mid-tree reducer's flush can fail over to the shard (ANY) while
+        the copy its parent "missed" was in fact delivered — the parent's
+        later partial then covers a SUPERSET of the failed-over one's
+        workers, and a PROPER overlap (neither contains the other) can
+        arise when the parent's bucket holds only the descendant's FIRST
+        flush while its cumulative re-flush failed over here. Overlaps
+        cannot be decomposed (a cumulative sum is one file), so the rule
+        is SIZE-ORDERED, bigger cover wins: a new partial folds only when
+        every accepted partial it intersects is STRICTLY SMALLER — those
+        are un-folded and retired in :meth:`_retire_covered` (losing at
+        worst the few members only they covered — a quorum-absorbed
+        undercount, the price of liveness). Otherwise the new partial is
+        dropped outright, never journaled. Ties keep the accepted entry,
+        so reconciliation is deterministic and the round's cover only
+        ever grows toward quorum — arrival-ordered retirement would let
+        a small failed-over partial evict a reducer's full-subtree flush
+        and park the round below quorum forever. Same-sender re-flushes
+        (``key`` match) stay on the duplicate-replacement path — a
+        cumulative re-flush always covers at least its predecessor, and
+        dropping it would freeze the group at its first flush."""
+        return any(
+            p and (cov & c) and len(c) >= len(cov)
+            for k, (p, c) in covers.items()
+            if k != key
+        )
+
+    @staticmethod
     def _direct_covered(covers, peer: str) -> bool:
         """Is a direct delta from ``peer`` already represented by an
         accepted tree-reduce partial?
@@ -1028,6 +1061,27 @@ class ParameterServerExecutor(JobExecutor):
         ``replay_ops`` re-derives exactly these un-folds from the
         journaled partial's ``covers``, keeping the replay bit-exact).
         Durable files stay on disk for that replay (checkpoint GC)."""
+        # Multi-level trees first: an accepted partial from ANOTHER sender
+        # whose covers intersect this one's is un-folded whole, sorted-key
+        # order (replay_ops mirrors both loops). Every entry reaching here
+        # is STRICTLY SMALLER than the new partial (_prefold_superseded
+        # dropped the new one otherwise): usually a descendant's
+        # failed-over flush this cumulative sum already contains; under a
+        # proper overlap the bigger cover wins and the smaller entry's
+        # exclusive members are a quorum-absorbed undercount.
+        for okey in sorted(k for k in list(bucket) if k in covers):
+            info = covers.get(okey)
+            if info is None or not info[0] or not (info[1] & cov):
+                continue
+            log.warning(
+                "ps %s: partial %s overlapped by a newer ancestor partial; "
+                "un-folding", job_id, okey,
+            )
+            old = bucket.pop(okey)
+            covers.pop(okey, None)
+            await self._fold(accum, old, sign=-1.0, prefolded=True)
+            if not durable:
+                old[0].unlink(missing_ok=True)
         for member in sorted(cov):
             info = covers.get(member)
             if member not in bucket or (info is not None and info[0]):
@@ -1182,6 +1236,13 @@ class ParameterServerExecutor(JobExecutor):
             key = self._entry_key(prefolded, peer)
             if prefolded:
                 SHARD_METRICS.prefold_partials.add(1)
+                if self._prefold_superseded(covers, cov, key):
+                    log.info(
+                        "ps %s: partial from %s contained in an accepted "
+                        "ancestor partial; dropped", job_id, peer,
+                    )
+                    await push.read_all()
+                    continue
             elif self._direct_covered(covers, peer):
                 log.info(
                     "ps %s: delta from %s already covered by a tree-reduce "
@@ -1382,14 +1443,21 @@ class ParameterServerExecutor(JobExecutor):
             meta = push.resource if isinstance(push.resource, dict) else {}
             prefolded, cov = self._push_cover(meta, peer)
             key = self._entry_key(prefolded, peer)
-            if prefolded:
-                SHARD_METRICS.prefold_partials.add(1)
-            elif self._direct_covered(
+            cov_table = (
                 covers
                 if delta_round == round_num
-                else st.early_covers.get(delta_round, {}),
-                peer,
-            ):
+                else st.early_covers.get(delta_round, {})
+            )
+            if prefolded:
+                SHARD_METRICS.prefold_partials.add(1)
+                if self._prefold_superseded(cov_table, cov, key):
+                    log.info(
+                        "ps %s: partial from %s contained in an accepted "
+                        "ancestor partial; dropped", job_id, peer,
+                    )
+                    await push.read_all()
+                    continue
+            elif self._direct_covered(cov_table, peer):
                 log.info(
                     "ps %s: delta from %s already covered by a tree-reduce "
                     "partial; dropped", job_id, peer,
@@ -1957,6 +2025,13 @@ class ParameterServerExecutor(JobExecutor):
                 if delta_round == round_num
                 else pending_covers.setdefault(delta_round, {})
             )
+            if prefolded and self._prefold_superseded(cov_table, cov, key):
+                log.info(
+                    "ps %s: partial from %s contained in an accepted "
+                    "ancestor partial; dropped", job_id, peer,
+                )
+                await push.read_all()
+                continue
             if not prefolded and self._direct_covered(cov_table, peer):
                 log.info(
                     "ps %s: delta from %s already covered by a tree-reduce "
@@ -2542,6 +2617,52 @@ class ParameterServerExecutor(JobExecutor):
             # round's update (its catch-up already contains it).
             peers = peers_override
         if not peers:
+            return
+        # Broadcast tree (hypha_tpu.stream.tree): push each wire to the
+        # top-level relays (and ungrouped workers) only; the relays
+        # re-push down their subtrees, cutting this node's egress per
+        # round from W pushes to ~G. ANY-strategy fan-outs (first success
+        # wins) keep the direct path — racing a tree against itself makes
+        # no sense — as do single-peer sets.
+        tree_map = getattr(cfg, "broadcast_tree", None)
+        tree_groups = (
+            list(getattr(tree_map, "groups", None) or [])
+            if tree_map is not None
+            else []
+        )
+        if (
+            tree_groups
+            and strategy != TransferStrategy.ANY
+            and len(peers) > 1
+        ):
+            bcast_span = (
+                trace.begin(
+                    "broadcast", parent=traceparent,
+                    attrs={
+                        "round": span_round, "peers": len(peers),
+                        "tree": True,
+                    },
+                    node=self._trace_node(),
+                )
+                if span_round is not None
+                else None
+            )
+            try:
+                targets = top_targets(tree_groups, peers)
+                delivered, lost = await tree_broadcast(
+                    self.node, header, str(header.get("resource", "results")),
+                    tree_groups, targets, update_path,
+                    allowed=set(peers),
+                    concurrency=_BROADCAST_CONCURRENCY,
+                    what="ps tree broadcast", logger=log,
+                )
+                if lost:
+                    log.warning(
+                        "ps: tree broadcast left %d peer(s) unreached; "
+                        "they catch up next round", lost,
+                    )
+            finally:
+                trace.finish(bcast_span)
             return
         bcast_span = (
             trace.begin(

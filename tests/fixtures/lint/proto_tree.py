@@ -1,0 +1,33 @@
+"""Seeded fixture pair for hypha-lint's ``msg-tree-needs-round`` rule.
+
+Deliberately NOT registered with hypha_tpu.messages (registration would
+leak into the live registry other tests lint); tests/test_lint.py passes
+these classes to ``proto_rules.check_tree_tags`` as an explicit registry.
+``TreeBad`` must trip the rule — a tree placement whose header has no
+round could re-parent an in-flight partial onto a reducer that no longer
+heads its group. ``TreeGood`` is the clean twin.
+"""
+
+# No `from __future__ import annotations`: stringified annotations make
+# dataclasses.fields() resolve against sys.modules[cls.__module__], which
+# an exec'd fixture module is deliberately absent from.
+from dataclasses import dataclass
+
+
+@dataclass(slots=True)
+class TreeBad:
+    """Tree placement with NO round tag: the rule must fire."""
+
+    tree_depth: int = 2
+    parent: str = ""
+    payload_len: int = 0
+
+
+@dataclass(slots=True)
+class TreeGood:
+    """Tree placement paired with its round: the rule must stay quiet."""
+
+    round: int = 0
+    tree_depth: int = 2
+    parent: str = ""
+    payload_len: int = 0

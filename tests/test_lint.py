@@ -369,6 +369,33 @@ def test_proto_generation_rule_live_registry_clean():
     assert proto_rules.check_generation_tags() == []
 
 
+def test_proto_tree_rule_on_fixture_pair():
+    """The seeded fixture pair: TreeBad (tree_depth/parent placement, no
+    round tag) fires the rule, clean twin TreeGood stays quiet.
+    Unregistered fixtures, explicit registry."""
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "proto_tree", FIXTURES / "proto_tree.py"
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    bad = proto_rules.check_tree_tags(
+        registry={"TreeBad": mod.TreeBad, "TreeGood": mod.TreeGood}
+    )
+    assert [v.rule for v in bad] == ["msg-tree-needs-round"]
+    assert "TreeBad" in bad[0].message
+    assert proto_rules.check_tree_tags(
+        registry={"TreeGood": mod.TreeGood}
+    ) == []
+
+
+def test_proto_tree_rule_live_registry_clean():
+    """The shipping registry (ShardMap carries round next to tree_depth)
+    satisfies the rule at zero new suppressions."""
+    assert proto_rules.check_tree_tags() == []
+
+
 def test_proto_manifest_catches_stale_value_vocabulary():
     bad = proto_rules.check_protocol_map(
         registry={}, manifest={}, values={"GhostValue"}

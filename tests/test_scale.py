@@ -1,0 +1,840 @@
+"""Control-plane scale-out (ISSUE 14): multi-level trees + sublinear
+scheduler work.
+
+Covers:
+
+  * tree plan determinism — collapsed groups-of-groups from sorted peer
+    ids alone; depth 1 byte-identical to the single-level plan;
+  * broadcast relay — a top-level relay re-pushes a wire to its children
+    AND injects a plain-tagged copy into its own node's routing; a dead
+    mid-tree relay is expanded to its children (failover);
+  * the parameter server's tree broadcast egress (top targets only);
+  * BatchScheduler's O(1) reachability gate — bit-identical verdicts to
+    the full projection on both sides of the threshold;
+  * ProgressTracker O(1) census (state counts / sim batch totals /
+    index) staying consistent under random mutation;
+  * the φ detector's suspect_at fast path agreeing with exact φ;
+  * the orchestrator's membership fan-out: one encode per payload
+    (PreEncoded), bounded-concurrency sends, identical wire bytes;
+  * default-off wire goldens: no tree config ⇒ no new field on any
+    encoded message.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import dataclasses
+import types
+from pathlib import Path
+
+import numpy as np
+import pytest
+from safetensors.numpy import load_file, save_file
+
+from hypha_tpu import messages
+from hypha_tpu.messages import (
+    AggregateExecutorConfig,
+    Adam,
+    Executor,
+    Fetch,
+    JobSpec,
+    Nesterov,
+    Receive,
+    Reference,
+    Send,
+    ShardMap,
+    TrainExecutorConfig,
+)
+from hypha_tpu.network import MemoryTransport, Node
+from hypha_tpu.stream import (
+    ancestors_of,
+    build_reduce_groups,
+    children_of,
+    parent_of,
+    subtree_of,
+    top_targets,
+    tree_levels,
+)
+from hypha_tpu.stream.reduce import (
+    BroadcastRelay,
+    relay_tag,
+    tree_broadcast,
+)
+
+
+def _run(coro, timeout=90):
+    return asyncio.run(asyncio.wait_for(coro, timeout))
+
+
+async def _mesh(peer_ids):
+    hub = MemoryTransport()
+    nodes = {p: Node(hub.shared(), peer_id=p) for p in peer_ids}
+    for n in nodes.values():
+        await n.start()
+    for a in nodes.values():
+        for b in nodes.values():
+            if a is not b:
+                a.add_peer_addr(b.peer_id, b.listen_addrs[0])
+    return nodes
+
+
+# ------------------------------------------------------------- tree plans
+
+
+def test_depth1_plan_matches_single_level_chunks():
+    """reduce_tree_depth unset must reproduce PR 6's exact groups — the
+    ShardMap wire (and every consumer of it) depends on this."""
+    peers = [f"w{i:02d}" for i in range(11)]
+    ordered = sorted(peers)
+    legacy = [
+        g
+        for g in (ordered[i : i + 3] for i in range(0, len(ordered), 3))
+        if len(g) >= 2
+    ]
+    assert build_reduce_groups(peers, 3, 1) == legacy
+    # depth 0 (the unset default) behaves as depth 1 — the orchestrator
+    # maps `reduce_tree_depth or 1`.
+    assert build_reduce_groups(peers, 3, 0) == legacy
+    assert build_reduce_groups(peers, 0, 2) == []
+    assert build_reduce_groups(peers, 1, 2) == []
+
+
+def test_multi_level_plan_structure():
+    peers = [f"w{i:03d}" for i in range(16)]
+    groups = build_reduce_groups(peers, 4, 2)
+    kids = children_of(groups)
+    parents = parent_of(groups)
+    # 16 workers / G=4: level-1 heads w000,w004,w008,w012; level 2 chunks
+    # those 4 heads into one group headed by w000.
+    assert kids["w000"] == ["w001", "w002", "w003", "w004", "w008", "w012"]
+    assert parents["w004"] == "w000"
+    assert ancestors_of(groups, "w005") == ["w004", "w000"]
+    assert set(subtree_of(groups, "w000")) == set(peers) - {"w000"}
+    assert top_targets(groups, peers) == ["w000"]
+    assert tree_levels(groups)["w000"] == 2
+    assert tree_levels(groups)["w004"] == 1
+    # Every worker is either a top target or has an ancestor chain that
+    # terminates at one — nothing is orphaned.
+    tops = set(top_targets(groups, peers))
+    for p in peers:
+        anc = ancestors_of(groups, p)
+        assert p in tops or (anc and anc[-1] in tops)
+
+
+def test_plan_is_deterministic_and_cover_disjoint():
+    rng = np.random.default_rng(0)
+    for n, g, d in ((5, 2, 3), (37, 4, 2), (128, 8, 2), (128, 4, 3)):
+        peers = [f"p{int(x):04d}" for x in rng.permutation(n * 7)[:n]]
+        a = build_reduce_groups(peers, g, d)
+        b = build_reduce_groups(list(reversed(peers)), g, d)
+        assert a == b  # order-independent (sorted ids)
+        # Subtrees of distinct top targets are disjoint and cover all.
+        tops = top_targets(a, peers)
+        seen: set = set()
+        for t in tops:
+            sub = set(subtree_of(a, t)) | {t}
+            assert not (sub - {t}) & seen
+            seen |= sub
+        assert seen == set(peers)
+
+
+def test_top_targets_skips_dead_ancestors():
+    groups = [["r2", "c", "r1"], ["r1", "a", "b"]]
+    peers = ["a", "b", "c", "r1", "r2"]
+    assert top_targets(groups, peers) == ["r2"]
+    # r2 dead: r1 (now ancestor-less among the live) and c become targets.
+    live = ["a", "b", "c", "r1"]
+    assert top_targets(groups, live) == ["c", "r1"]
+    # r1 AND r2 dead: the leaves take direct pushes.
+    assert top_targets(groups, ["a", "b", "c"]) == ["a", "b", "c"]
+
+
+# -------------------------------------------------------- broadcast relay
+
+
+def _relay_cfg(groups, shards=("ps0",), results_peers=("ps0",)):
+    return types.SimpleNamespace(
+        ps_shards=ShardMap(
+            round=0, shards=list(shards),
+            tags=[f"u.s{i}" for i in range(len(shards))],
+            fragments=1, groups=[list(g) for g in groups],
+        ),
+        results=Receive(Reference.from_peers(list(results_peers), "results")),
+        reduce_members=[],
+        reduce_via=None,
+    )
+
+
+def test_relay_fans_out_and_injects_locally(tmp_path):
+    """A relay re-pushes the wire to its children under the plain results
+    tag (leaves) and hands its OWN node a locally injected copy with the
+    original sender attribution — no loopback dial."""
+    groups = [["r", "a", "b"]]
+
+    async def main():
+        nodes = await _mesh(["ps0", "r", "a", "b"])
+        relay = BroadcastRelay(
+            nodes["r"], _relay_cfg(groups), work_dir=tmp_path / "r"
+        )
+        relay.start()
+        wire = tmp_path / "wire.st"
+        save_file({"w": np.arange(4, dtype=np.float32)}, str(wire))
+        await nodes["ps0"].push(
+            "r",
+            {"resource": relay_tag("results"), "name": wire.name,
+             "round": 3, "epoch": 7},
+            wire,
+        )
+        got = {}
+        for peer in ("a", "b", "r"):
+            push = await nodes[peer].next_push(timeout=20)
+            meta = dict(push.resource)
+            dest = tmp_path / f"got-{peer}.st"
+            await push.save_to(dest)
+            got[peer] = (push.peer, meta, dict(load_file(str(dest))))
+        await relay.stop()
+        for n in nodes.values():
+            await n.stop()
+        return got, relay.relayed
+
+    got, relayed = _run(main())
+    assert relayed == 1
+    for peer in ("a", "b"):
+        sender, meta, tree = got[peer]
+        assert sender == "r"
+        assert meta["resource"] == "results"
+        assert (meta["round"], meta["epoch"]) == (3, 7)  # header verbatim
+        np.testing.assert_array_equal(
+            tree["w"], np.arange(4, dtype=np.float32)
+        )
+    # The relay's own copy keeps the ORIGIN attribution (allowlists see
+    # the parent hop, exactly as a direct wire push would).
+    sender, meta, tree = got["r"]
+    assert sender == "ps0"
+    assert meta["resource"] == "results"
+    np.testing.assert_array_equal(tree["w"], np.arange(4, dtype=np.float32))
+
+
+def test_tree_broadcast_expands_around_dead_relay(tmp_path):
+    """tree_broadcast: a target relay that cannot be reached is expanded
+    to its children — the subtree still gets the round's wire."""
+    groups = [["r2", "c", "r1"], ["r1", "a", "b"]]
+
+    async def main():
+        # r1 is never started: every dial to it fails.
+        nodes = await _mesh(["ps0", "r2", "a", "b", "c"])
+        wire = tmp_path / "wire.st"
+        save_file({"w": np.ones(2, np.float32)}, str(wire))
+        delivered, lost = await tree_broadcast(
+            nodes["ps0"],
+            {"resource": "results", "name": wire.name, "round": 1},
+            "results",
+            groups,
+            ["r1"],  # push to the (dead) mid-tree relay only
+            wire,
+            attempts=1,
+        )
+        got = []
+        for peer in ("a", "b"):
+            push = await nodes[peer].next_push(timeout=20)
+            meta = dict(push.resource)
+            await push.read_all()
+            got.append((peer, meta["resource"], meta["round"]))
+        for n in nodes.values():
+            await n.stop()
+        return delivered, lost, got
+
+    delivered, lost, got = _run(main())
+    assert delivered == 2 and lost == 0
+    assert got == [("a", "results", 1), ("b", "results", 1)]
+
+
+def test_ps_broadcast_uses_tree_targets(tmp_path):
+    """ParameterServerExecutor._broadcast with a broadcast_tree cfg pushes
+    to the TOP targets only (relay tag for relays); leaves get their copy
+    from the relay hop, and PS egress is ~G instead of W."""
+    from hypha_tpu.worker.ps_executor import ParameterServerExecutor
+
+    groups = [["r", "a", "b"]]
+    smap = ShardMap(
+        round=0, shards=["ps0"], tags=["u.s0"], fragments=1,
+        groups=groups,
+    )
+
+    async def main():
+        nodes = await _mesh(["ps0", "r", "a", "b"])
+        relay = BroadcastRelay(
+            nodes["r"],
+            types.SimpleNamespace(
+                ps_shards=smap,
+                results=Receive(
+                    Reference.from_peers(["ps0", "r"], "results")
+                ),
+            ),
+            work_dir=tmp_path / "relay",
+        )
+        relay.start()
+        pse = ParameterServerExecutor(nodes["ps0"], tmp_path / "ps")
+        cfg = types.SimpleNamespace(
+            results=Send(Reference.from_peers(["r", "a", "b"], "results")),
+            broadcast_tree=smap,
+        )
+        wire = tmp_path / "update.st"
+        save_file({"w": np.full(4, 2.0, np.float32)}, str(wire))
+        before = nodes["ps0"].bytes_out
+        await pse._broadcast(cfg, wire, 5)
+        ps_pushes = nodes["ps0"].bytes_out - before
+        got = {}
+        for peer in ("a", "b", "r"):
+            push = await nodes[peer].next_push(timeout=20)
+            got[peer] = (push.peer, dict(push.resource))
+            await push.read_all()
+        await relay.stop()
+        for n in nodes.values():
+            await n.stop()
+        return ps_pushes, got
+
+    ps_bytes, got = _run(main())
+    # ONE wire left the PS (the top relay's copy); both leaves got theirs
+    # from the relay, with the round stamp intact.
+    wire_size = 4 * 4 + 200  # tensor + header slack
+    assert ps_bytes < 2 * wire_size, "PS pushed more than the top target"
+    assert got["a"][0] == "r" and got["b"][0] == "r"
+    assert got["a"][1]["round"] == 5
+    assert got["r"][1]["resource"] == "results"  # injected local copy
+
+
+# ----------------------------------------------- scheduler sublinear work
+
+
+def _tracker(n, batch=4, target=1000, epochs=2):
+    from hypha_tpu.scheduler.trackers import ProgressTracker
+
+    t = ProgressTracker(
+        parameter_server="ps", update_target=target, update_epochs=epochs,
+        clock=lambda: 0.0,
+    )
+    for i in range(n):
+        t.add_worker(f"w{i}", batch)
+    return t
+
+
+def test_tracker_census_consistent_under_mutation():
+    from hypha_tpu.scheduler.trackers import ProgressTracker, WorkerState
+
+    rng = np.random.default_rng(7)
+    t = _tracker(0)
+    alive: list[str] = []
+    states = list(WorkerState)
+    for step in range(500):
+        op = rng.integers(0, 4)
+        if op == 0 or not alive:
+            peer = f"p{step}"
+            t.add_worker(peer, int(rng.integers(1, 9)))
+            alive.append(peer)
+        elif op == 1 and len(alive) > 1:
+            peer = alive.pop(int(rng.integers(0, len(alive))))
+            t.remove_worker(peer)
+        else:
+            peer = alive[int(rng.integers(0, len(alive)))]
+            t.set_state(peer, states[int(rng.integers(0, len(states)))])
+        # census vs brute force
+        for s in states:
+            assert t._state_counts[s] == sum(1 for x in t.states if x is s)
+        expect_total = sum(
+            b
+            for b, s in zip(t.batch_sizes, t.states)
+            if s in ProgressTracker._SIM_STATES
+        )
+        assert t.sim_batch_total == expect_total
+        for i, p in enumerate(t.peers):
+            assert t.index_of(p) == i
+    assert t.all_in(*states)
+    with pytest.raises(ValueError):
+        t.index_of("ghost")
+
+
+def test_batch_scheduler_gate_matches_full_projection():
+    """The O(1) reachability gate must return CONTINUE exactly when the
+    full simulation would — probe both sides of the threshold."""
+    from hypha_tpu.messages import Progress, ProgressKind, ProgressResponseKind
+    from hypha_tpu.scheduler.batch_scheduler import BatchScheduler
+    from hypha_tpu.scheduler.simulation import project
+    from hypha_tpu.scheduler.trackers import WorkerState
+
+    for n, batch, target in ((4, 8, 10_000), (4, 8, 50), (32, 4, 200)):
+        t = _tracker(n, batch=batch, target=target)
+        bs = BatchScheduler(t)
+        # Feed one timed batch per worker so stats exist, then reset the
+        # counter to the probed value.
+        for i in range(n):
+            bs.on_progress(
+                f"w{i}",
+                Progress(
+                    kind=ProgressKind.STATUS, job_id="j", batch_size=batch
+                ),
+            )
+            t.set_state(f"w{i}", WorkerState.TRAINING)
+        bs._round_plan = None  # warmup may have fixed a plan; probe the sim
+        for counter in (
+            target,
+            t.sim_batch_total * bs.updates_cap + 1,
+            t.sim_batch_total * bs.updates_cap,
+            batch,
+            1,
+        ):
+            t.counter = counter
+            resp = bs.on_progress(
+                "w0",
+                Progress(
+                    kind=ProgressKind.STATUS, job_id="j", batch_size=batch
+                ),
+            )
+            t.counter = counter  # undo the Status decrement for the oracle
+            sim_peers = [
+                p
+                for p, s in zip(t.peers, t.states)
+                if s in (WorkerState.TRAINING, WorkerState.UPDATE_SCHEDULED)
+            ]
+            # The handler decremented the counter before projecting; the
+            # oracle must see the same value.
+            oracle = project(
+                counter - batch, t.sims(sim_peers),
+                bs.time_cap_ms, bs.updates_cap,
+            )
+            want = (
+                ProgressResponseKind.CONTINUE
+                if (oracle.capped or oracle.left > 0)
+                else ProgressResponseKind.SCHEDULE_UPDATE
+            )
+            assert resp.kind == want, (n, counter, resp.kind, want)
+            t.counter = counter
+            t.set_state("w0", WorkerState.TRAINING)  # re-arm for next probe
+            bs._round_plan = None  # each probe exercises the gate + sim
+
+
+def test_round_plan_one_projection_schedules_every_worker(monkeypatch):
+    """The first successful projection fixes the round's plan: later
+    TRAINING Statuses claim planned-minus-one with a dict lookup (the
+    claiming Status completed one planned batch), no re-simulation; a
+    worker already in the NEXT round never claims the stale plan."""
+    from hypha_tpu.messages import Progress, ProgressKind, ProgressResponseKind
+    from hypha_tpu.scheduler import batch_scheduler as bsm
+    from hypha_tpu.scheduler.trackers import WorkerState
+
+    from hypha_tpu.scheduler.trackers import ProgressTracker
+
+    n, batch = 8, 1
+    now = [0.0]
+    t = ProgressTracker(
+        parameter_server="ps", update_target=n * 3, update_epochs=2,
+        clock=lambda: now[0],
+    )
+    for i in range(n):
+        t.add_worker(f"w{i}", batch)
+    bs = bsm.BatchScheduler(t)
+    sims = []
+    real_project = bsm.project
+    monkeypatch.setattr(
+        bsm, "project", lambda *a, **k: sims.append(1) or real_project(*a, **k)
+    )
+
+    def status(peer, at, round=0):
+        now[0] = at
+        return bs.on_progress(
+            peer,
+            Progress(
+                kind=ProgressKind.STATUS, job_id="j", batch_size=batch,
+                round=round,
+            ),
+        )
+
+    # Warm stats: one Status each at t=0.1s (mean 100 ms across the
+    # board). The LAST one completes the stats set, and its projection —
+    # the round's ONE simulation — fixes the plan for every worker.
+    responses = [status(f"w{i}", 0.1) for i in range(n)]
+    assert all(
+        r.kind is ProgressResponseKind.CONTINUE for r in responses[:-1]
+    )
+    assert responses[-1].kind is ProgressResponseKind.SCHEDULE_UPDATE
+    plan = bs._round_plan
+    assert plan is not None and plan[0] == 0
+    assert set(plan[2]) == {f"w{i}" for i in range(n)}
+    sims_at_plan = len(sims)
+
+    # Every remaining TRAINING worker claims from the plan — zero sims.
+    # The claiming Status completed one of the planned batches, so the
+    # handed-out counter is the planned share minus one.
+    for i in range(n - 1):
+        r = status(f"w{i}", 0.2)
+        assert r.kind is ProgressResponseKind.SCHEDULE_UPDATE
+        assert r.counter == max(plan[2][f"w{i}"] - 1, 0)
+    assert len(sims) == sims_at_plan, "a plan claim re-ran the projection"
+
+    # A worker racing ahead into round 1 (its UPDATE_RECEIVED beat the
+    # PS's UPDATED) must not claim the round-0 plan: the round-tagged
+    # Status falls through to a fresh projection.
+    t.set_state("w0", WorkerState.TRAINING)
+    status("w0", 0.3, round=1)
+    assert len(sims) > sims_at_plan, "stale round-0 plan was claimed"
+
+
+def test_round_plan_invalidated_by_mid_round_depart(monkeypatch):
+    """A mid-round depart invalidates the cached plan: the departed
+    worker's planned share must be re-spread over the survivors by a
+    fresh projection, not silently lost to stale dict lookups."""
+    from hypha_tpu.messages import Progress, ProgressKind, ProgressResponseKind
+    from hypha_tpu.scheduler import batch_scheduler as bsm
+    from hypha_tpu.scheduler.trackers import ProgressTracker
+
+    n, batch = 4, 1
+    now = [0.0]
+    t = ProgressTracker(
+        parameter_server="ps", update_target=n * 3, update_epochs=2,
+        clock=lambda: now[0],
+    )
+    for i in range(n):
+        t.add_worker(f"w{i}", batch)
+    bs = bsm.BatchScheduler(t)
+    sims = []
+    real_project = bsm.project
+    monkeypatch.setattr(
+        bsm, "project", lambda *a, **k: sims.append(1) or real_project(*a, **k)
+    )
+
+    def status(peer, at):
+        now[0] = at
+        return bs.on_progress(
+            peer,
+            Progress(
+                kind=ProgressKind.STATUS, job_id="j", batch_size=batch,
+                round=0,
+            ),
+        )
+
+    responses = [status(f"w{i}", 0.1) for i in range(n)]
+    assert responses[-1].kind is ProgressResponseKind.SCHEDULE_UPDATE
+    assert bs._round_plan is not None
+    sims_at_plan = len(sims)
+
+    # w3 departs before completing its share; the survivors' Statuses
+    # must NOT keep claiming the stale plan.
+    t.remove_worker("w3")
+    r = status("w0", 0.2)
+    assert len(sims) > sims_at_plan, "stale plan survived a depart"
+    assert r.kind in (
+        ProgressResponseKind.CONTINUE, ProgressResponseKind.SCHEDULE_UPDATE
+    )
+    plan = bs._round_plan
+    if plan is not None:
+        assert "w3" not in plan[2]
+
+
+def test_capacity_memo_invalidated_by_faster_stats(monkeypatch):
+    """The capped-capacity memo is only as fresh as the speeds it
+    simulated: a worker speeding up >10% bumps the tracker's
+    stats_version and forces a re-measure instead of serving the stale
+    CONTINUE until the counter drains below the old capacity."""
+    from hypha_tpu.messages import Progress, ProgressKind
+    from hypha_tpu.scheduler import batch_scheduler as bsm
+    from hypha_tpu.scheduler.trackers import ProgressTracker
+
+    now = [0.0]
+    # Geometry that makes the TIME cap (the stats-dependent one) bind:
+    # 2 workers at ~1000 ms/batch inside a 1500 ms time cap assign one
+    # batch each (capacity 2), while the counter stays above that — the
+    # O(1) reachability bound (counter > sim_total * updates_cap = 6)
+    # stops gating at counter 6, so the capped projection runs and
+    # memoizes capacity 2.
+    t = ProgressTracker(
+        parameter_server="ps", update_target=10, update_epochs=2,
+        clock=lambda: now[0],
+    )
+    t.add_worker("w0", 1)
+    t.add_worker("w1", 1)
+    bs = bsm.BatchScheduler(t, time_cap_ms=1500.0)
+    sims = []
+    real_project = bsm.project
+    monkeypatch.setattr(
+        bsm, "project", lambda *a, **k: sims.append(1) or real_project(*a, **k)
+    )
+
+    def status(peer, at):
+        now[0] = at
+        return bs.on_progress(
+            peer,
+            Progress(
+                kind=ProgressKind.STATUS, job_id="j", batch_size=1, round=0
+            ),
+        )
+
+    status("w0", 1.0)
+    status("w1", 1.0)
+    status("w0", 2.0)
+    status("w1", 2.0)  # counter 6: projection runs, memoizes capacity 2
+    n_measured = len(sims)
+    assert bs._sim_skip is not None and bs._sim_skip[4] == 2
+    status("w0", 3.0)  # same 1000 ms mean: memo short-circuits, no re-sim
+    assert len(sims) == n_measured
+    status("w1", 3.05)  # mean ~1016 ms: inside the 10% hysteresis band
+    assert len(sims) == n_measured
+    # A 50 ms batch pulls w1's mean down >10%: the time-capped capacity
+    # the memo measured is stale, so the next Status re-simulates.
+    status("w1", 3.10)
+    assert len(sims) > n_measured, "stale capacity memo survived a speedup"
+
+
+def test_shard_done_memo_matches_schedule():
+    from hypha_tpu.scheduler.batch_scheduler import BatchScheduler
+    from hypha_tpu.stream import shards_due_at
+
+    t = _tracker(2, epochs=9)
+    bs = BatchScheduler(
+        t, shards_due=lambda r: shards_due_at("stream", r, 6, 3)
+    )
+    for shard in range(3):
+        for after in range(-1, 10):
+            brute = all(
+                shard not in set(shards_due_at("stream", r, 6, 3))
+                for r in range(after + 1, 9)
+            )
+            assert bs._shard_done(shard, after) == brute, (shard, after)
+
+
+def test_detector_fast_path_matches_exact_phi():
+    from hypha_tpu.ft.detector import PhiAccrualDetector
+
+    clock = [0.0]
+    det = PhiAccrualDetector(threshold=8.0, clock=lambda: clock[0])
+    for i in range(10):
+        clock[0] = i * 1.0
+        det.heartbeat("w")
+    hist = det._peers["w"]
+    assert np.isfinite(hist.suspect_at)
+    # Sweep the clock across the horizon: suspected() must flip exactly
+    # where phi crosses the threshold (the fast path may only shortcut
+    # NEGATIVE verdicts).
+    flips = []
+    for dt in np.linspace(0.0, 30.0, 2000):
+        clock[0] = 9.0 + float(dt)
+        exact = det.phi("w") >= det.threshold
+        assert det.suspected("w") == exact
+        flips.append(exact)
+    assert not flips[0] and flips[-1]
+    # A fresh heartbeat pushes the horizon out again.
+    clock[0] = 40.0
+    det.heartbeat("w")
+    assert not det.suspected("w")
+
+
+def test_preencoded_request_ships_identical_bytes():
+    """messages.PreEncoded must produce a wire indistinguishable from
+    encoding at the call site — the receiving handler sees the same
+    decoded message."""
+    from hypha_tpu.ft.membership import (
+        PROTOCOL_FT,
+        MembershipUpdate,
+        RoundMembership,
+    )
+
+    update = MembershipUpdate(
+        job_id="job-ps0",
+        membership=RoundMembership(
+            epoch=4, active=[f"w{i}" for i in range(12)]
+        ),
+        joined=["w3"],
+    )
+    pre = messages.PreEncoded.of(update)
+    assert pre.__pre_encoded__ == messages.encode(update)
+    assert messages.decode(pre.__pre_encoded__) == update
+
+    async def main():
+        nodes = await _mesh(["sched", "ps"])
+        got = []
+
+        async def on_update(peer, msg):
+            got.append((peer, msg))
+            from hypha_tpu.messages import Ack
+
+            return Ack(ok=True)
+
+        reg = nodes["ps"].on(PROTOCOL_FT, MembershipUpdate).respond_with(
+            on_update
+        )
+        await nodes["sched"].request("ps", PROTOCOL_FT, pre, timeout=10)
+        reg.close()
+        for n in nodes.values():
+            await n.stop()
+        return got
+
+    got = _run(main())
+    assert got == [("sched", update)]
+
+
+def test_notify_membership_encodes_once_and_fans_out():
+    """The orchestrator's membership sweep: every live shard gets a
+    PreEncoded payload (no per-request re-encode), concurrently."""
+    from hypha_tpu.ft.membership import MembershipView
+    from hypha_tpu.scheduler.orchestrator import Orchestrator, _RunContext
+
+    class _Node:
+        peer_id = "sched"
+
+        def __init__(self):
+            self.sent = []
+            self.inflight = 0
+            self.peak = 0
+
+        async def request(self, peer, proto, msg, timeout=10):
+            self.inflight += 1
+            self.peak = max(self.peak, self.inflight)
+            await asyncio.sleep(0.05)
+            self.inflight -= 1
+            self.sent.append((peer, proto, msg))
+            from hypha_tpu.messages import Ack
+
+            return Ack(ok=True)
+
+    async def main():
+        node = _Node()
+        orch = Orchestrator.__new__(Orchestrator)
+        orch.node = node
+        ctx = _RunContext()
+        ctx.membership = MembershipView([f"w{i}" for i in range(8)])
+        ctx.ps_job_ids = [f"job-ps{k}" for k in range(4)]
+        ctx.ps_handles = [
+            types.SimpleNamespace(peer_id=f"ps{k}") for k in range(4)
+        ]
+        ok = await orch._notify_membership(ctx)
+        return ok, node
+
+    ok, node = _run(main())
+    assert ok and len(node.sent) == 4
+    assert node.peak >= 2, "membership sweep did not overlap requests"
+    for k, (peer, proto, msg) in enumerate(sorted(node.sent)):
+        assert peer == f"ps{k}"
+        assert isinstance(msg, messages.PreEncoded)
+        decoded = messages.decode(msg.__pre_encoded__)
+        assert decoded.job_id == f"job-ps{k}"
+        assert decoded.membership.active == [f"w{i}" for i in range(8)]
+
+
+# ------------------------------------------------------- wire compat pins
+
+
+def test_tree_fields_absent_by_default_on_wire():
+    """Unset tree config ships today's byte-identical wire: none of the
+    new field NAMES may appear in the encoded bytes."""
+    smap = ShardMap(
+        round=0, shards=["ps0"], tags=["u"], fragments=2,
+        groups=[["r", "a"]],
+    )
+    assert b"tree_depth" not in messages.encode(smap)
+    train = TrainExecutorConfig(
+        model={"family": "gpt2"},
+        data=Fetch(Reference.from_uri("file:///d")),
+        updates=Send(Reference.from_peers(["ps"], "updates")),
+        results=Receive(Reference.from_peers(["ps"], "results")),
+        optimizer=Adam(),
+        batch_size=4,
+        ps_shards=smap,
+        reduce_members=["a"],
+    )
+    assert b"relay_results" not in messages.encode(train)
+    agg = AggregateExecutorConfig(
+        updates=Receive(Reference.from_peers(["w"], "updates")),
+        results=Send(Reference.from_peers(["w"], "results")),
+        optimizer=Nesterov(),
+    )
+    assert b"broadcast_tree" not in messages.encode(agg)
+    # ...and the fields round-trip when SET.
+    smap2 = dataclasses.replace(smap, tree_depth=2)
+    back = messages.decode(messages.encode(smap2))
+    assert back.tree_depth == 2
+    train2 = dataclasses.replace(train, relay_results=True)
+    assert messages.decode(messages.encode(train2)).relay_results is True
+
+
+def test_job_config_tree_validation():
+    from hypha_tpu.scheduler.job_config import DiLoCoJob
+
+    def make(**kw):
+        return DiLoCoJob(model={"family": "gpt2"}, dataset="d", **kw)
+
+    make(reduce_group_size=4, reduce_tree_depth=2)
+    make(reduce_group_size=4, broadcast_tree=True)
+    with pytest.raises(ValueError, match="reduce_group_size >= 2"):
+        make(reduce_tree_depth=2)
+    with pytest.raises(ValueError, match="reduce_group_size >= 2"):
+        make(broadcast_tree=True)
+    with pytest.raises(ValueError, match="reduce_tree_depth"):
+        make(reduce_group_size=4, reduce_tree_depth=-1)
+    with pytest.raises(ValueError, match="adaptive_codec"):
+        make(
+            reduce_group_size=4, broadcast_tree=True, adaptive_codec=True
+        )
+
+
+def test_plan_streams_builds_tree_and_relay_roles():
+    """_plan_streams + _train_spec: depth-2 groups in the ShardMap, relay
+    flags on reducers only, ancestor chain in each worker's results
+    allowlist, broadcast_tree stamped into the aggregate spec."""
+    from hypha_tpu.scheduler.job_config import (
+        DiLoCoJob,
+        DiLoCoRounds,
+        JobResources,
+    )
+    from hypha_tpu.scheduler.orchestrator import Orchestrator, _RunContext
+    from hypha_tpu.resources import Resources
+    from hypha_tpu.messages import PriceRange
+
+    job = DiLoCoJob(
+        model={"family": "gpt2"},
+        dataset="d",
+        rounds=DiLoCoRounds(update_rounds=2, avg_samples_between_updates=8),
+        inner_optimizer=Adam(),
+        outer_optimizer=Nesterov(),
+        resources=JobResources(
+            num_workers=9,
+            worker=Resources(cpu=1),
+            parameter_server=Resources(cpu=1),
+            worker_price=PriceRange(bid=1.0, max=2.0),
+            parameter_server_price=PriceRange(bid=1.0, max=2.0),
+        ),
+        reduce_group_size=3,
+        reduce_tree_depth=2,
+        broadcast_tree=True,
+    )
+    orch = Orchestrator.__new__(Orchestrator)
+    orch.node = types.SimpleNamespace(peer_id="sched")
+    ctx = _RunContext()
+    ctx.job = job
+    ctx.base_id = "base"
+    workers = [f"w{i}" for i in range(9)]
+    ctx.ps_handles = [types.SimpleNamespace(peer_id="ps0")]
+    orch._plan_streams(ctx, job, workers, ["ps0"], 1, 1)
+    assert ctx.shard_map is not None
+    assert ctx.shard_map.tree_depth == 2
+    assert ctx.reduce_groups == build_reduce_groups(workers, 3, 2)
+    assert ctx.ps_specs[0].executor.aggregate.broadcast_tree == ctx.shard_map
+
+    def spec_for(peer):
+        handle = types.SimpleNamespace(
+            peer_id=peer, batch_size=2, lease_id="l",
+        )
+        return orch._train_spec(ctx, "wX", handle).executor.train
+
+    top = spec_for("w0")  # head of heads
+    assert top.relay_results is True
+    assert top.reduce_via is None
+    assert set(top.reduce_members) == {"w1", "w2", "w3", "w6"}
+    mid = spec_for("w3")  # level-1 head under w0
+    assert mid.relay_results is True
+    assert mid.reduce_via == "w0"
+    assert mid.reduce_members == ["w4", "w5"]
+    leaf = spec_for("w5")
+    assert leaf.relay_results is None
+    assert leaf.reduce_via == "w3"
+    # Results allowlist: shard peers + the worker's ancestor chain.
+    assert leaf.results.ref.peers == ["ps0", "w3", "w0"]
+    assert top.results.ref.peers == ["ps0"]

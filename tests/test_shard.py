@@ -309,6 +309,141 @@ def test_group_reducer_partial_and_duplicate_unfold(tmp_path):
     )
 
 
+def test_multi_level_reflush_value_exact_out_of_order(tmp_path, monkeypatch):
+    """ISSUE-14 satellite: cumulative-sum re-flushes through TWO tree
+    levels stay value-exact under duplicate/un-fold and out-of-order
+    partial arrival.
+
+    Topology: leaves a, b → mid-tree reducer r1 → top reducer r2 (which
+    also folds leaf c and r1's own direct delta) → shard ps0. The
+    sequence forces an INCOMPLETE deadline flush at r1 (covers {a} only),
+    its replacement by the cumulative {a, b} re-flush at r2 (prefold
+    duplicate un-fold), and a duplicate re-send from a leaf — the shipped
+    top-level partial must be BIT-equal to a RoundAccum replaying the
+    same op sequence, its weight and transitive covers exact.
+    """
+    from hypha_tpu.stream.reduce import GroupReducer
+
+    monkeypatch.setenv("HYPHA_REDUCE_FLUSH_S", "0.6")
+    sizes = 8
+    rng = np.random.default_rng(42)
+    d = {
+        p: {"w": rng.standard_normal(sizes).astype(np.float32)}
+        for p in ("a", "b", "c", "r1", "a2")
+    }
+    groups = [["r2", "c", "r1"], ["r1", "a", "b"]]
+    smap = ShardMap(
+        round=0, shards=["ps0"], tags=["u.s0"], fragments=1,
+        groups=groups, tree_depth=2,
+    )
+
+    def cfg_for(members, via):
+        return types.SimpleNamespace(
+            ps_shards=smap,
+            reduce_members=list(members),
+            reduce_via=via,
+            delta_codec="none",
+            delta_dtype="float32",
+            sync_mode="blocking",
+        )
+
+    async def main():
+        nodes = await _mesh(["ps0", "r1", "r2", "a", "b", "c"])
+        red1 = GroupReducer(
+            nodes["r1"], cfg_for(["a", "b"], "r2"), work_dir=tmp_path / "r1"
+        )
+        red2 = GroupReducer(
+            nodes["r2"], cfg_for(["c", "r1"], None), work_dir=tmp_path / "r2"
+        )
+        assert red1.parent == "r2" and red2.parent is None
+        assert red2.expected_cover == {"c", "r1", "a", "b"}
+        assert red2.level == 2 and red1.level == 1
+        red1.start()
+        red2.start()
+
+        async def push(src, dst, tree, label):
+            f = tmp_path / f"{label}.st"
+            save_file(tree, str(f))
+            await nodes[src].push(
+                dst,
+                {"resource": "u.s0", "name": f.name, "round": 0,
+                 "num_samples": 4.0},
+                f,
+            )
+
+        async def until(pred, what, timeout=20.0):
+            t0 = asyncio.get_running_loop().time()
+            while not pred():
+                if asyncio.get_running_loop().time() - t0 > timeout:
+                    raise AssertionError(f"timed out waiting for {what}")
+                await asyncio.sleep(0.05)
+
+        # 1. a → r1; the flush deadline passes with b missing, so r1 ships
+        #    an INCOMPLETE partial covering {a} up to r2.
+        await push("a", "r1", d["a"], "da")
+        await until(lambda: red1.partials >= 1, "r1 deadline flush")
+        await until(lambda: red2.folds >= 1, "r2 folds P1a")
+        # 2. c's direct delta lands at r2.
+        await push("c", "r2", d["c"], "dc")
+        await until(lambda: red2.folds >= 2, "r2 folds c")
+        # 3. b arrives late at r1 → cumulative re-flush {a, b}; r2 must
+        #    un-fold the superseded {a} partial (prefold duplicate).
+        await push("b", "r1", d["b"], "db")
+        await until(lambda: red1.partials >= 2, "r1 re-flush")
+        await until(lambda: red2.unfolds >= 1, "r2 prefold un-fold")
+        # 4. a DUPLICATE re-send: r1 un-folds the original, re-flushes the
+        #    corrected cumulative sum, r2 replaces again.
+        await push("a", "r1", d["a2"], "da2")
+        await until(lambda: red1.unfolds >= 1, "r1 duplicate un-fold")
+        await until(lambda: red2.unfolds >= 2, "r2 second un-fold")
+        # 5. r1's own worker delta goes direct to its parent (in the real
+        #    system via its training loop's [r2, ps0] ANY route) —
+        #    completing r2's subtree cover, so r2 flushes to the shard.
+        await push("r1", "r2", d["r1"], "dr1")
+        partial_push = await nodes["ps0"].next_push(timeout=30)
+        meta = dict(partial_push.resource)
+        dest = tmp_path / "top-partial.st"
+        await partial_push.save_to(dest)
+        await red1.stop()
+        await red2.stop()
+        for n in nodes.values():
+            await n.stop()
+        return meta, dict(load_file(str(dest)))
+
+    meta, shipped = _run(main())
+    assert meta[PREFOLD_KEY] is True
+    assert meta["round"] == 0
+    assert sorted(meta["covers"]) == ["a", "b", "c", "r1"]
+    assert meta["num_samples"] == 16.0
+    # Replay the EXACT op sequence the reducers executed; f32 addition is
+    # order-sensitive, so matching bits proves the un-fold/re-flush
+    # algebra cancelled exactly (the same property the shard's duplicate
+    # replacement relies on).
+    from hypha_tpu.stream.accum import RoundAccum
+
+    r1_sim = RoundAccum()
+    r1_sim.fold_tree(d["a"], 4.0)
+    p1a = {k: v.copy() for k, v in r1_sim.partial().items()}
+    w1a = r1_sim.total_samples
+    r1_sim.fold_tree(d["b"], 4.0)
+    p1ab = {k: v.copy() for k, v in r1_sim.partial().items()}
+    w1ab = r1_sim.total_samples
+    r1_sim.fold_tree(d["a"], 4.0, -1.0)
+    r1_sim.fold_tree(d["a2"], 4.0)
+    p1final = {k: v.copy() for k, v in r1_sim.partial().items()}
+    w1final = r1_sim.total_samples
+    r2_sim = RoundAccum()
+    r2_sim.fold_tree(p1a, w1a, prefolded=True)
+    r2_sim.fold_tree(d["c"], 4.0)
+    r2_sim.fold_tree(p1a, w1a, -1.0, prefolded=True)
+    r2_sim.fold_tree(p1ab, w1ab, prefolded=True)
+    r2_sim.fold_tree(p1ab, w1ab, -1.0, prefolded=True)
+    r2_sim.fold_tree(p1final, w1final, prefolded=True)
+    r2_sim.fold_tree(d["r1"], 4.0)
+    assert r2_sim.total_samples == 16.0
+    np.testing.assert_array_equal(shipped["w"], r2_sim.partial()["w"])
+
+
 # ------------------------------------------- sharded blocking aggregation
 
 
@@ -1129,6 +1264,90 @@ def test_cover_reconciliation_replays_bit_exact(tmp_path):
         )
     assert replayed.total_samples == accum.total_samples
     np.testing.assert_array_equal(replayed.mean()["w"], accum.mean()["w"])
+
+
+def test_properly_overlapping_partial_dropped_then_superset_retires(tmp_path):
+    """Partial-vs-partial PROPER overlap (neither contains the other),
+    equal sizes: the tie keeps the accepted entry, so the new partial is
+    dropped unfolded — folding it would double-count the shared member.
+    Convergence comes from cumulative re-flushes: a later BIGGER flush
+    wins, retiring the accepted entry, and the replay journal never sees
+    the dropped one."""
+    from hypha_tpu.ft.durable import DurablePS
+    from hypha_tpu.worker.ps_executor import ParameterServerExecutor
+
+    ps = ParameterServerExecutor(node=None, work_root=tmp_path / "w")
+    dur = DurablePS.open(tmp_path / "dur", "job")
+    dur.note_open(0)
+    accum = RoundAccum()
+    # r1's cumulative {w1,w2} failed over direct and was accepted; r2's
+    # deadline flush {w1,w3} holds only r1's FIRST flush (w1) plus w3.
+    part_12 = {"w": np.float32(4.0) * (_D1["w"] + _D2["w"])}
+    part_13 = {"w": np.float32(4.0) * (_D1["w"] + _D3["w"])}
+    part_123 = {"w": np.float32(4.0) * (_D1["w"] + _D2["w"] + _D3["w"])}
+    overlapping = _partial("r2", 0, part_13, 8.0, ["w1", "w3"])
+    consumer = _FakeConsumer([
+        _partial("r1", 0, part_12, 8.0, ["w1", "w2"]),
+        overlapping,
+        # r2's cumulative re-flush grew to contain r1's entry: retire it.
+        _partial("r2", 0, part_123, 12.0, ["w1", "w2", "w3"]),
+    ])
+    received = _run(ps._collect_round(
+        consumer, "job", set(), 3, tmp_path / "w", 0, accum=accum, dur=dur
+    ))
+    assert overlapping.drained, "proper overlap must be drained, not folded"
+    assert set(received) == {"prefold:r2"}
+    assert accum.total_samples == 12.0
+    np.testing.assert_array_equal(
+        accum.mean()["w"], part_123["w"] / np.float32(12.0)
+    )
+
+    # Replay: +r1, -r1 (retired by the containing re-flush), +r2 — the
+    # dropped overlap was never journaled, and the replayed accumulator
+    # is bit-equal to the live one's.
+    reopened = DurablePS.open(tmp_path / "dur", "job")
+    ops = reopened.replay_ops(0)
+    assert [(f.peer, s) for f, s in ops] == [
+        ("prefold:r1", 1.0), ("prefold:r1", -1.0), ("prefold:r2", 1.0)
+    ]
+    replayed = RoundAccum()
+    for fold, sign in ops:
+        replayed.fold(
+            reopened.deltas_dir / fold.file, fold.samples, sign, fold.prefold
+        )
+    assert replayed.total_samples == accum.total_samples
+    np.testing.assert_array_equal(replayed.mean()["w"], accum.mean()["w"])
+
+
+def test_bigger_properly_overlapping_partial_folds_and_retires(tmp_path):
+    """Partial-vs-partial PROPER overlap where the NEW partial covers
+    MORE workers: bigger cover wins — it folds and the smaller accepted
+    entry is un-folded and retired (its exclusive member becomes a
+    quorum-absorbed undercount). Arrival-ordered retirement would let
+    the small entry park the round below quorum forever: a top-level
+    reducer's full-subtree flush must never lose to a failed-over
+    fragment it happens to intersect."""
+    from hypha_tpu.worker.ps_executor import ParameterServerExecutor
+
+    ps = ParameterServerExecutor(node=None, work_root=tmp_path)
+    accum = RoundAccum()
+    d4 = {"w": np.full(4, 5.0, np.float32)}
+    part_12 = {"w": np.float32(4.0) * (_D1["w"] + _D2["w"])}
+    part_134 = {
+        "w": np.float32(4.0) * (_D1["w"] + _D3["w"] + d4["w"])
+    }
+    consumer = _FakeConsumer([
+        _partial("r1", 0, part_12, 8.0, ["w1", "w2"]),
+        _partial("r2", 0, part_134, 12.0, ["w1", "w3", "w4"]),
+    ])
+    received = _run(ps._collect_round(
+        consumer, "job", set(), 3, tmp_path, 0, accum=accum
+    ))
+    assert set(received) == {"prefold:r2"}
+    assert accum.total_samples == 12.0
+    np.testing.assert_array_equal(
+        accum.mean()["w"], part_134["w"] / np.float32(12.0)
+    )
 
 
 def test_reducer_leaves_non_member_pushes_for_colocated_shard(tmp_path):
