@@ -3,7 +3,7 @@
 
 PYTHON ?= python
 
-.PHONY: lint lint-fixtures test compressbench streambench ftbench-ps ftbench-scheduler shardbench servbench servbench-smoke hetbench obsbench obsbench-smoke
+.PHONY: lint lint-fixtures test compressbench streambench ftbench-ps ftbench-scheduler shardbench servbench servbench-smoke hetbench obsbench obsbench-smoke databench databench-smoke
 
 lint:
 	$(PYTHON) -m hypha_tpu.analysis hypha_tpu/
@@ -105,6 +105,22 @@ obsbench:
 obsbench-smoke:
 	JAX_PLATFORMS=cpu $(PYTHON) benchmarks/obsbench.py --smoke --skip-trace \
 		--out /tmp/OBSBENCH_smoke.json
+
+# Async input pipeline (ISSUE 15): the same DiLoCo job with the
+# synchronous loader vs slice prefetch + zero-copy batching + deferred
+# device sync, under a bw-capped data link (ft.chaos bw-cap:data).
+# Asserts input-wait fraction and slice-boundary stall >=3x lower with
+# prefetch, tokens/s uplift on a slice-boundary workload, bit-exact loss
+# parity, and a kill-the-data-node-mid-prefetch recovery. Writes
+# DATABENCH_r13.json (docs/performance.md "Async input pipeline").
+databench:
+	JAX_PLATFORMS=cpu $(PYTHON) benchmarks/databench.py \
+		--out DATABENCH_r13.json
+
+# CI-sized databench (the data.yml workflow's smoke path).
+databench-smoke:
+	JAX_PLATFORMS=cpu $(PYTHON) benchmarks/databench.py --smoke \
+		--out /tmp/DATABENCH_smoke.json
 
 # Control-plane scale harness (ISSUE 14): 128 in-process workers on the
 # memory fabric, star vs multi-level reduce/broadcast trees, plus a

@@ -62,7 +62,12 @@ from ..stream import SYNC_MODES, effective_fragments, fragment_due, merge_correc
 from ..stream.partition import partition_names, shard_of
 from ..worker.connectors import shard_route
 from ..telemetry import trace
-from ..telemetry.ft_metrics import FT_METRICS, HET_METRICS, STREAM_METRICS
+from ..telemetry.ft_metrics import (
+    DATA_METRICS,
+    FT_METRICS,
+    HET_METRICS,
+    STREAM_METRICS,
+)
 from .diloco import (
     apply_updates,
     extract_delta,
@@ -742,8 +747,20 @@ def run_training(
     from .dataset import stream_batches
 
     def fetch_slice() -> str:
+        t0 = time.monotonic()
         rels = session.fetch(cfg.data)
-        return str(work_dir / rels[0])
+        path = work_dir / rels[0]
+        DATA_METRICS.note_fetch(time.monotonic() - t0)
+        return str(path)
+
+    # End-to-end round tracing (telemetry.trace): all no-ops when off.
+    # Created before the stream so input_wait spans can join round traces.
+    rtrace = _RoundTrace(trace_node)
+
+    def input_span_ctx():
+        # The most recent round context handed down by the scheduler —
+        # good enough to attribute a mid-round input stall to its round.
+        return rtrace.tp, rtrace.node
 
     model_spec = dict(cfg.model)
     input_names = model_spec.get("input_names")
@@ -752,7 +769,17 @@ def run_training(
         from .preprocess import build_preprocessor
 
         preprocessor = build_preprocessor(cfg.preprocessor, session, work_dir)
-    stream = stream_batches(fetch_slice, cfg.batch_size, input_names, preprocessor)
+    # Async input pipeline (executor.dataset, ISSUE 15): slice prefetch +
+    # zero-copy assembly + the deferred device sync below. None/False (the
+    # default) takes the original synchronous path, bit-identically.
+    pipeline_on = bool(getattr(cfg, "input_pipeline", None))
+    stream = stream_batches(
+        fetch_slice, cfg.batch_size, input_names, preprocessor,
+        pipeline=pipeline_on,
+        prefetch=getattr(cfg, "prefetch_slices", None),
+        span_ctx=input_span_ctx,
+        unlink_consumed=pipeline_on,
+    )
 
     first_batch = next(stream)
     model, params, causal_lm, has_aux = _init_model(cfg, session, work_dir, first_batch)
@@ -921,8 +948,6 @@ def run_training(
     result = TrainResult()
     countdown: int | None = None
     round_num = 0
-    # End-to-end round tracing (telemetry.trace): all no-ops when off.
-    rtrace = _RoundTrace(trace_node)
     round_samples = 0
     round_losses: list[float] = []
     # Live metrics plane (telemetry.metrics_plane): reporting jobs attach
@@ -1069,6 +1094,14 @@ def run_training(
             f"job {spec.job_id}: sharded parameter service is not supported "
             "for multihost replicas"
         )
+    if pipeline_on and mh is not None:
+        # The deferred loss read assumes this process can observe the step
+        # asynchronously; multihost lockstep broadcasts cannot.
+        _mh_done_bounded(mh)
+        raise ValueError(
+            f"job {spec.job_id}: input_pipeline is not supported for "
+            "multihost replicas"
+        )
     stream_state: _WorkerStream | None = None
     if sync_mode != "blocking":
         if mh is not None:
@@ -1172,7 +1205,16 @@ def run_training(
 
     def batches() -> Iterator[Any]:
         yield first_batch
-        yield from stream
+        while True:
+            t0 = time.monotonic()
+            batch = next(stream, None)
+            # Total input wait: host assembly + any slice acquisition that
+            # ran inline — the fraction databench asserts the pipeline
+            # shrinks (recording only; values and order are untouched).
+            DATA_METRICS.note_input_wait(time.monotonic() - t0)
+            if batch is None:
+                return
+            yield batch
 
     def do_update() -> bool:
         """Ship Δθ, wait for the PS broadcast, merge. True = next round."""
@@ -1660,6 +1702,28 @@ def run_training(
         new_state, metrics = step(state, place(batch))
         return new_state, metrics, float(metrics["loss"])
 
+    def run_one_deferred(batch):
+        """Device double-buffering (input_pipeline): dispatch the step and
+        return WITHOUT forcing the loss — the host thread goes straight on
+        to assemble and place batch n+1 while step n computes on device.
+        The metrics land in ``pending_metrics``; ``flush_pending_loss``
+        reads them one step later (same values, same order)."""
+        new_state, metrics = step(state, place(batch))
+        return new_state, metrics
+
+    # One-step-deferred loss reads (input_pipeline only; empty otherwise).
+    # Flushed before every round-boundary action that reports or resets
+    # ``round_losses``, and after the loop — the loss SEQUENCE is
+    # bit-identical to the synchronous read, just observed later.
+    pending_metrics: list[Any] = []
+
+    def flush_pending_loss() -> None:
+        while pending_metrics:
+            metrics = pending_metrics.pop(0)
+            loss = float(metrics["loss"])
+            round_losses.append(loss)
+            result.losses.append(loss)
+
     t0 = time.monotonic()
     try:
         for batch in batches():
@@ -1678,14 +1742,26 @@ def run_training(
                     lambda b=batch: run_one(b), mh_bound("step"), "train step"
                 )
                 compiled_once["step"] = True
+                round_losses.append(loss)
+                result.losses.append(loss)
             else:
                 overlapping = stream_state is not None and stream_state.in_flight
-                bt0 = time.monotonic() if overlapping else 0.0
-                state, metrics, loss = run_one(batch)
-                if overlapping:
-                    stream_state.note_compute(time.monotonic() - bt0)
-            round_losses.append(loss)
-            result.losses.append(loss)
+                if pipeline_on and not overlapping:
+                    # Deferred sync: dispatch step n, then read step n-1's
+                    # loss (already done on device) — never this step's.
+                    # Skipped while a stream flight is up: note_compute's
+                    # overlap accounting needs the synchronous read.
+                    state, metrics = run_one_deferred(batch)
+                    flush_pending_loss()
+                    pending_metrics.append(metrics)
+                else:
+                    bt0 = time.monotonic() if overlapping else 0.0
+                    state, metrics, loss = run_one(batch)
+                    if overlapping:
+                        stream_state.note_compute(time.monotonic() - bt0)
+                    flush_pending_loss()  # older deferred losses first
+                    round_losses.append(loss)
+                    result.losses.append(loss)
             result.batches += 1
             round_samples += cfg.batch_size
             if report_quality:
@@ -1708,6 +1784,10 @@ def run_training(
             if countdown is not None:
                 if countdown <= 0:
                     countdown = None
+                    # Round boundary: the round's LAST loss may still be
+                    # deferred — it must land in round_losses before the
+                    # sync reports/reset them.
+                    flush_pending_loss()
                     if stream_state is not None:
                         begin_stream_sync()
                     elif shard_map is not None:
@@ -1720,8 +1800,16 @@ def run_training(
             if max_batches is not None and result.batches >= max_batches:
                 log.warning("max_batches=%d reached; stopping", max_batches)
                 break
+        flush_pending_loss()
     finally:
         rtrace.close_inner()
+        # Stop the input pipeline's prefetch thread NOW (the generator's
+        # finally owns it) instead of at GC — its next fetch would race
+        # the bridge teardown. No-op for the synchronous stream.
+        try:
+            stream.close()
+        except Exception:  # never let input teardown mask the real error
+            pass
         if stream_state is not None:
             stream_state.abort()
         if mh is not None:

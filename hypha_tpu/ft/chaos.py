@@ -65,6 +65,7 @@ import logging
 import random
 import time
 from dataclasses import dataclass, field
+from pathlib import Path
 from typing import Any, Callable
 
 from .. import aio
@@ -374,14 +375,45 @@ class ChaosController:
 
         return gen()
 
+    def _maybe_throttled(self, source, rate_bps: float):
+        """Throttle byte/file sources; pass anything already streaming
+        (an async iterator — e.g. a previously wrapped source) through."""
+        if isinstance(source, (bytes, bytearray, memoryview, str, Path)):
+            return self._throttled_source(source, rate_bps)
+        return source
+
     def _wrap_bw_cap(self, target: str, rate_bps: float) -> None:
-        """Cap every push on the target's LINK (both directions): its own
-        uploads (delta pushes) and pushes toward it from every other node
-        the controller holds (update broadcasts, catch-ups)."""
+        """Cap every push AND pull payload on the target's LINK (both
+        directions): its own uploads (delta pushes) and served pulls
+        (a capped DATA NODE's slice streams), plus pushes/pull payloads
+        toward it from every other node the controller holds (update
+        broadcasts, catch-ups, slices it pulls)."""
         for name, worker in self.workers.items():
             node = getattr(worker, "node", None)
             if node is None:
                 continue
+            handler = getattr(node, "_pull_handler", None)
+            if handler is not None:
+                if name == target:
+
+                    async def capped_pull(
+                        peer: str, resource: Any, _h=handler
+                    ):
+                        return self._maybe_throttled(
+                            await _h(peer, resource), rate_bps
+                        )
+
+                else:
+
+                    async def capped_pull(
+                        peer: str, resource: Any, _h=handler
+                    ):
+                        source = await _h(peer, resource)
+                        if peer != target:
+                            return source
+                        return self._maybe_throttled(source, rate_bps)
+
+                node.on_pull(capped_pull)
             orig_push = node.push
 
             if name == target:

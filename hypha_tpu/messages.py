@@ -427,6 +427,11 @@ class Reference:
     resource: str | None = None
     scheduler_peer: str | None = None
     dataset: str | None = None
+    # Scheduler variant only — async input pipeline: the slice-prefetch
+    # window the fetching connector forwards as ``DataRequest.prefetch``
+    # (and the signal that enables its on-disk slice cache). Additive:
+    # None — every non-pipelined job — is omitted from the wire.
+    prefetch: int | None = None
 
     def variant(self) -> str:
         if self.uri is not None:
@@ -459,8 +464,10 @@ class Reference:
         return cls(peers=list(peers), strategy=strategy, resource=resource)
 
     @classmethod
-    def from_scheduler(cls, peer: str, dataset: str) -> "Reference":
-        return cls(scheduler_peer=peer, dataset=dataset)
+    def from_scheduler(
+        cls, peer: str, dataset: str, prefetch: int | None = None
+    ) -> "Reference":
+        return cls(scheduler_peer=peer, dataset=dataset, prefetch=prefetch)
 
 
 def _newtype_ref(name: str, allowed: frozenset):
@@ -609,6 +616,19 @@ class TrainExecutorConfig:
     # metrics off keeps today's exact bytes.
     report_metrics_s: float | None = None
     metrics_peer: str | None = None
+    # Async input pipeline (executor.dataset): True turns on zero-copy
+    # batch assembly (contiguous slice views + a carry-over buffer across
+    # slice boundaries), background slice prefetch, and device
+    # double-buffering with a one-step-deferred loss read — the hot path
+    # never waits on input. Batch order and the loss SEQUENCE stay
+    # bit-exact vs the synchronous loader. Additive fields: None — the
+    # only value a non-pipelined job ships — is omitted from the wire, so
+    # the default is today's byte-identical spec and bit-identical loop.
+    input_pipeline: bool | None = None
+    # Slice-prefetch window (needs input_pipeline): how many assigned
+    # slices the worker may hold/fetch ahead. None with input_pipeline on
+    # = DEFAULT_PREFETCH_SLICES.
+    prefetch_slices: int | None = None
 
 
 @register
@@ -985,6 +1005,13 @@ class DataRequest:
 
     dataset: str
     peer_id: str = ""
+    # Async input pipeline (executor.dataset): the worker intends to HOLD
+    # up to this many assigned slices at once (background slice prefetch),
+    # so the scheduler retires its oldest held slice only once the window
+    # is full — and a dead worker's reclaim returns every held slice.
+    # Additive field: None — the only value a non-prefetching worker
+    # ships — is omitted from the wire, today's exact bytes.
+    prefetch: int | None = None
 
 
 @register
@@ -992,6 +1019,12 @@ class DataRequest:
 class DataResponse:
     data_provider: str
     index: int
+    # Stamped (prefetching requests only) so the worker's on-disk slice
+    # cache can key entries ``(dataset, epoch, index)`` — the same slice
+    # index is DIFFERENT work after an epoch wrap only if the dataset
+    # changed underneath, which the cache's content hash catches; the
+    # epoch key keeps accounting exact either way. Additive: None omitted.
+    epoch: int | None = None
 
 
 @register

@@ -415,6 +415,21 @@ class JobSection:
             "(0 = default 4); each fragment syncs every num_fragments rounds"
         },
     )
+    input_pipeline: bool = field(
+        default=False,
+        metadata={
+            "doc": "async input pipeline: background slice prefetch + "
+            "zero-copy batch assembly + deferred device sync (batch order "
+            "and losses stay bit-exact; off = the synchronous loader)"
+        },
+    )
+    prefetch_slices: int = field(
+        default=0,
+        metadata={
+            "doc": "input pipeline: dataset slices fetched ahead / held "
+            "per worker (0 = executor default; needs input_pipeline)"
+        },
+    )
     adaptive_steps: bool = field(
         default=False,
         metadata={
@@ -529,6 +544,10 @@ class JobSection:
             )
         if self.num_fragments < 0:
             raise ConfigError("job.num_fragments must be >= 0 (0 = default)")
+        if self.prefetch_slices < 0:
+            raise ConfigError("job.prefetch_slices must be >= 0 (0 = default)")
+        if self.prefetch_slices > 0 and not self.input_pipeline:
+            raise ConfigError("job.prefetch_slices needs job.input_pipeline")
         if self.adaptive_codec and self.sync_mode != "blocking":
             raise ConfigError(
                 "job.adaptive_codec requires sync_mode = blocking"
@@ -619,6 +638,8 @@ class JobSection:
             delta_codec=self.delta_codec,
             sync_mode=self.sync_mode,
             num_fragments=self.num_fragments,
+            input_pipeline=self.input_pipeline,
+            prefetch_slices=self.prefetch_slices,
             adaptive_steps=self.adaptive_steps,
             adaptive_codec=self.adaptive_codec,
             codec_bw_hi_mbps=self.codec_bw_hi_mbps,

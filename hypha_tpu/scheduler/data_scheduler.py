@@ -10,11 +10,20 @@ ours separates assignment from completion, so the handler retires a peer's
 previous slice when that peer asks for the next one — same observable
 behavior (every request returns a fresh slice; a dead worker's in-flight
 slice can be reclaimed via ``remove_worker``).
+
+Async input pipeline (executor.dataset slice prefetch): a request carrying
+``prefetch=k`` declares the worker HOLDS up to ``k`` assigned slices at
+once (it fetches ahead while training on the oldest), so retirement is
+deferred until the window is full — the scheduler retires the OLDEST held
+slice, in consumption order. ``remove_worker`` reclaims every held slice,
+not just the last one. Requests without the field (every pre-pipeline
+worker) keep the exact hold-one behavior above.
 """
 
 from __future__ import annotations
 
 import logging
+from collections import deque
 
 from ..messages import PROTOCOL_API, DataRequest, DataResponse
 from ..network.node import Node
@@ -33,17 +42,25 @@ class DataScheduler:
         self.data_provider = data_provider
         self.dataset = dataset
         self.tracker = SliceTracker(num_slices)
-        # peer -> (epoch, slice currently held): the epoch guards retirement —
-        # a slice handed out before an epoch wrap must not be marked processed
-        # in the new epoch (it would silently never be served that epoch).
-        self._last: dict[str, tuple[int, int]] = {}
+        # peer -> deque of (epoch, slice) currently held, oldest first: the
+        # epoch guards retirement — a slice handed out before an epoch wrap
+        # must not be marked processed in the new epoch (it would silently
+        # never be served that epoch). Non-prefetching peers hold one.
+        self._last: dict[str, deque[tuple[int, int]]] = {}
         self._registration = None
 
     def start(self) -> None:
         async def on_data(peer: str, msg: DataRequest) -> DataResponse:
-            index = self.assign(peer)
+            prefetch = getattr(msg, "prefetch", None)
+            index = self.assign(peer, prefetch=prefetch)
             log.debug("slice %d of %s -> %s", index, self.dataset, peer)
-            return DataResponse(data_provider=self.data_provider, index=index)
+            resp = DataResponse(data_provider=self.data_provider, index=index)
+            if prefetch is not None:
+                # Prefetching workers run the on-disk slice cache, keyed
+                # (dataset, epoch, index); legacy requests keep today's
+                # exact response bytes (epoch None is omitted).
+                resp.epoch = self.tracker.epoch
+            return resp
 
         # Predicate-routed: several DataSchedulers (one per dataset) can
         # share the API protocol on one scheduler node.
@@ -53,17 +70,32 @@ class DataScheduler:
             .respond_with(on_data)
         )
 
-    def assign(self, peer: str) -> int:
-        """Retire the peer's previous slice and pick the next one."""
-        prev = self._last.pop(peer, None)
-        if prev is not None and prev[0] == self.tracker.epoch:
-            self.tracker.mark_processed(prev[1])
-        index = self.tracker.next(peer)
-        self._last[peer] = (self.tracker.epoch, index)
+    def assign(self, peer: str, prefetch: int | None = None) -> int:
+        """Retire the peer's oldest held slice once its window is full,
+        then pick the next one. ``prefetch=None`` holds one slice — the
+        exact pre-pipeline behavior (retire previous on every request)."""
+        window = max(int(prefetch), 1) if prefetch is not None else 1
+        held = self._last.get(peer)
+        if held is None:
+            held = self._last[peer] = deque()
+        while len(held) >= window:
+            epoch, prev = held.popleft()
+            if epoch == self.tracker.epoch:
+                self.tracker.mark_processed(prev)
+        index = self.tracker.next(
+            peer,
+            exclude={i for e, i in held if e == self.tracker.epoch},
+        )
+        held.append((self.tracker.epoch, index))
         return index
 
+    def held_of(self, peer: str) -> list[int]:
+        """Slices the peer currently holds (oldest first; tests/metrics)."""
+        return [i for _, i in self._last.get(peer, ())]
+
     def remove_worker(self, peer: str) -> None:
-        """Reclaim a dead worker's slices (tracker/slice.rs:105-114)."""
+        """Reclaim ALL of a dead worker's held slices (tracker/slice.rs:
+        105-114) — a prefetching worker may die holding several."""
         self._last.pop(peer, None)
         self.tracker.remove_worker(peer)
 

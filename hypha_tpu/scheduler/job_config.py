@@ -186,6 +186,18 @@ class DiLoCoJob:
     # wire: no config field, header key or protocol is spoken.
     metrics_plane: bool = False
     metrics_interval_s: float = 1.0
+    # Async input pipeline (hypha_tpu.executor.dataset, ISSUE 15): workers
+    # prefetch dataset slices in the background (the scheduler lets each
+    # worker hold up to prefetch_slices assignments, reclaiming ALL of a
+    # dead worker's held slices), assemble batches as zero-copy contiguous
+    # views with a carry-over buffer across slice boundaries, and defer
+    # each step's loss read one step so batch n+1 is placed on device
+    # while step n computes. Batch order and the loss sequence stay
+    # bit-exact vs the synchronous loader; off (default) ships today's
+    # byte-identical wire and code path. prefetch_slices 0 = the
+    # executor's default window (needs input_pipeline).
+    input_pipeline: bool = False
+    prefetch_slices: int = 0
     # Where metrics-<job>.jsonl lands; None = the active trace directory
     # (when tracing is on), else no journal.
     metrics_dir: str | None = None
@@ -294,6 +306,13 @@ class DiLoCoJob:
             )
         if self.metrics_interval_s <= 0:
             raise ValueError("metrics_interval_s must be positive")
+        if self.prefetch_slices < 0:
+            raise ValueError("prefetch_slices must be >= 0 (0 = default)")
+        if self.prefetch_slices > 0 and not self.input_pipeline:
+            raise ValueError(
+                "prefetch_slices needs input_pipeline (the prefetcher IS "
+                "the pipeline's fetch stage)"
+            )
         if self.slo_rules:
             from ..telemetry.slo import parse_slo_rules
 

@@ -446,6 +446,18 @@ class Orchestrator:
         tree_on = bool(getattr(job, "broadcast_tree", False)) and bool(
             ctx.reduce_groups
         )
+        # Async input pipeline: resolve the prefetch window HERE so the
+        # executor's prefetcher, the fetch reference and the scheduler's
+        # slice-retirement accounting all see one number. None (pipeline
+        # off, the default) stamps no new field anywhere — today's bytes.
+        prefetch_depth = None
+        if getattr(job, "input_pipeline", False):
+            from ..executor.dataset import DEFAULT_PREFETCH_SLICES
+
+            prefetch_depth = (
+                int(getattr(job, "prefetch_slices", 0) or 0)
+                or DEFAULT_PREFETCH_SLICES
+            )
         results_peers = list(ps_peers)
         if tree_on:
             from ..stream import ancestors_of
@@ -463,7 +475,10 @@ class Orchestrator:
                 train=TrainExecutorConfig(
                     model=job.model,
                     data=Fetch(
-                        Reference.from_scheduler(self.node.peer_id, job.dataset)
+                        Reference.from_scheduler(
+                            self.node.peer_id, job.dataset,
+                            prefetch=prefetch_depth,
+                        )
                     ),
                     updates=Send(
                         Reference.from_peers([ps_peers[0]], ctx.updates_tag)
@@ -511,6 +526,10 @@ class Orchestrator:
                         if getattr(job, "metrics_plane", False)
                         else None
                     ),
+                    input_pipeline=(
+                        True if prefetch_depth is not None else None
+                    ),
+                    prefetch_slices=prefetch_depth,
                     checkpoint=(
                         {
                             "dir": f"{job.checkpoint_dir}/{handle.peer_id}",

@@ -241,10 +241,14 @@ class SliceTracker:
         return [i for i, p in self._assigned.items() if p == peer]
 
     # -- assignment ---------------------------------------------------------
-    def next(self, peer: str) -> int:
-        """Pick the next slice for ``peer`` (slice.rs:65-100)."""
+    def next(self, peer: str, exclude: "frozenset[int] | set[int]" = frozenset()) -> int:
+        """Pick the next slice for ``peer`` (slice.rs:65-100).
+
+        ``exclude`` names slices the peer ALREADY HOLDS (prefetch-window
+        assignment, scheduler.data_scheduler): the affinity shortcut must
+        not hand one of them straight back."""
         # 1. peer-affine: a slice this peer was already assigned (cache reuse)
-        mine = self.remaining_of(peer)
+        mine = [i for i in self.remaining_of(peer) if i not in exclude]
         if mine:
             return mine[0]
         # 2. fresh available slice

@@ -506,6 +506,7 @@ def metrics_snapshot() -> dict:
         "serve": SERVE_METRICS.snapshot(),
         "het": HET_METRICS.snapshot(),
         "scale": SCALE_METRICS.snapshot(),
+        "data": DATA_METRICS.snapshot(),
         "gauges": gauges,
         "aio_task_failures": _aio_task_failures(),
     }
@@ -520,6 +521,7 @@ def _aio_task_failures() -> float:
 # Fault-tolerance instruments (import at the bottom: ft_metrics uses the
 # Counter/Histogram classes defined above).
 from .ft_metrics import (  # noqa: E402
+    DATA_METRICS,
     FT_METRICS,
     HET_METRICS,
     SCALE_METRICS,

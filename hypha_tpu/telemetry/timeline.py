@@ -50,6 +50,7 @@ __all__ = [
 # quorum_wait by construction).
 PHASES = (
     "compute",
+    "input_wait",
     "encode",
     "upload",
     "quorum_wait",
@@ -59,6 +60,10 @@ PHASES = (
 )
 _SPAN_PHASE = {
     "inner_steps": "compute",
+    # Input-pipeline stall (executor.dataset): the training thread blocked
+    # on a slice acquisition mid-round. Peer-attributed, so a data-starved
+    # worker is named on the round's critical path like a slow uploader.
+    "input_wait": "input_wait",
     "encode": "encode",
     "upload": "upload",
     "quorum_wait": "quorum_wait",

@@ -41,6 +41,7 @@ from ..messages import (
     TransferStrategy,
 )
 from ..network.node import Node, PushStream, RequestError
+from ..telemetry.ft_metrics import DATA_METRICS
 
 __all__ = ["Connector", "ReceivedFile", "fetch_uri", "shard_route"]
 
@@ -168,11 +169,21 @@ def fetch_uri(uri: str, dest_dir: Path) -> Path:
 
 
 class Connector:
-    """Routes Reference variants to transports (connector/mod.rs router)."""
+    """Routes Reference variants to transports (connector/mod.rs router).
 
-    def __init__(self, node: Node, scheduler_peer: str = "") -> None:
+    ``slice_cache`` (worker.slice_cache.SliceCache, optional) backs
+    scheduler-mediated slice fetches for PIPELINED jobs (the Fetch
+    reference carries ``prefetch``): assignments whose ``(dataset, epoch,
+    index)`` the cache already holds are served from disk — a rejoined or
+    restarted worker re-pulls nothing it already had.
+    """
+
+    def __init__(
+        self, node: Node, scheduler_peer: str = "", slice_cache=None
+    ) -> None:
         self.node = node
         self.scheduler_peer = scheduler_peer
+        self.slice_cache = slice_cache
 
     # -------------------------------------------------------------- fetch
 
@@ -210,23 +221,50 @@ class Connector:
 
     async def _fetch_slice(self, ref: Reference, dest_dir: Path) -> Path:
         """Scheduler-mediated slice fetch: ask for an assignment, pull it
-        (connector/mod.rs:436-507 PeerStreamPullConnector)."""
+        (connector/mod.rs:436-507 PeerStreamPullConnector).
+
+        Pipelined jobs (``ref.prefetch`` set) forward the prefetch window
+        to the scheduler so it defers slice retirement, key the dest name
+        by the response's epoch (a prefetching consumer may still be
+        reading this index's previous-epoch file), and check/fill the
+        on-disk slice cache around the network pull."""
         scheduler = ref.scheduler_peer or self.scheduler_peer
         if not scheduler:
             raise ValueError("no scheduler peer for slice fetch")
+        prefetch = getattr(ref, "prefetch", None)
         resp = await self.node.request(
             scheduler,
             PROTOCOL_API,
-            DataRequest(dataset=ref.dataset or "", peer_id=self.node.peer_id),
+            DataRequest(
+                dataset=ref.dataset or "",
+                peer_id=self.node.peer_id,
+                prefetch=prefetch,
+            ),
         )
         if not isinstance(resp, DataResponse):
             raise RequestError(f"unexpected data response {resp!r}")
+        epoch = getattr(resp, "epoch", None)
+        dest_dir.mkdir(parents=True, exist_ok=True)
+        stem = _safe_name(ref.dataset or "slice")
+        dest = (
+            dest_dir / f"{stem}-e{epoch}-{resp.index:06d}"
+            if epoch is not None
+            else dest_dir / f"{stem}-{resp.index:06d}"
+        )
+        cache = (
+            self.slice_cache
+            if prefetch is not None and epoch is not None
+            else None
+        )
+        if cache is not None and await asyncio.to_thread(
+            cache.get, ref.dataset or "", epoch, resp.index, dest
+        ):
+            return dest
         stream = await self.node.pull(
             resp.data_provider, DataSlice(dataset=ref.dataset or "", index=resp.index)
         )
-        dest_dir.mkdir(parents=True, exist_ok=True)
-        dest = dest_dir / f"{_safe_name(ref.dataset or 'slice')}-{resp.index:06d}"
         loop = asyncio.get_running_loop()
+        pulled = 0
         try:
             f = await asyncio.to_thread(open, dest, "wb")
             try:
@@ -234,11 +272,17 @@ class Connector:
                     chunk = await stream.read(1 << 20)
                     if not chunk:
                         break
+                    pulled += len(chunk)
                     await loop.run_in_executor(None, f.write, chunk)
             finally:
                 await asyncio.to_thread(f.close)
         finally:
             await stream.close()
+        DATA_METRICS.bytes_pulled.add(pulled)
+        if cache is not None:
+            await asyncio.to_thread(
+                cache.put, ref.dataset or "", epoch, resp.index, dest
+            )
         return dest
 
     # --------------------------------------------------------------- send

@@ -64,12 +64,17 @@ class ProcessExecutor(JobExecutor):
                 if progress.round > execution.round:
                     execution.round = progress.round
 
+        from .slice_cache import SliceCache
+
         bridge = Bridge(
             self.node,
             work_dir,
             job_id,
             scheduler_peer,
-            Connector(self.node, scheduler_peer),
+            Connector(
+                self.node, scheduler_peer,
+                slice_cache=SliceCache(Path(self.work_root) / "slice-cache"),
+            ),
             status_retry_s=grace,
             progress_probe=probe,
         )

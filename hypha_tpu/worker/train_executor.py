@@ -60,9 +60,17 @@ class InProcessTrainExecutor(JobExecutor):
             if progress.round > execution.round:
                 execution.round = progress.round
 
+        # Slice cache lives under work_root — it survives per-job work
+        # dirs, so a re-dispatched execution's pipelined slice fetches hit
+        # disk (the cache only activates for prefetch-tagged fetches).
+        from .slice_cache import SliceCache
+
         bridge = Bridge(
             self.node, work_dir, job_id, scheduler_peer,
-            Connector(self.node, scheduler_peer),
+            Connector(
+                self.node, scheduler_peer,
+                slice_cache=SliceCache(Path(self.work_root) / "slice-cache"),
+            ),
             status_retry_s=grace,
             progress_probe=probe,
         )

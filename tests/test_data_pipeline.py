@@ -1,0 +1,622 @@
+"""Async input pipeline (ISSUE 15): zero-copy batch assembly, slice
+prefetch, prefetch-window slice accounting, the on-disk slice LRU, and
+bit-exact loss parity of the pipelined loop vs the synchronous loader."""
+
+from __future__ import annotations
+
+import itertools
+import queue
+import threading
+import time
+from contextlib import contextmanager
+from pathlib import Path
+
+import numpy as np
+import pytest
+from safetensors.numpy import save_file
+
+from hypha_tpu.executor.dataset import (
+    SlicePrefetcher,
+    batches,
+    load_slice,
+    slice_batches,
+    slice_samples,
+    stream_batches,
+)
+from hypha_tpu.scheduler.data_scheduler import DataScheduler
+from hypha_tpu.scheduler.trackers import SliceTracker
+from hypha_tpu.telemetry.ft_metrics import DATA_METRICS
+from hypha_tpu.worker.slice_cache import SliceCache
+
+
+def _make_slices(tmp_path: Path, sizes, seed=0, keys=("input_ids", "labels")):
+    rng = np.random.default_rng(seed)
+    paths = []
+    for i, n in enumerate(sizes):
+        p = tmp_path / f"s{i}.safetensors"
+        tensors = {}
+        if "input_ids" in keys:
+            tensors["input_ids"] = rng.integers(0, 100, (n, 4)).astype(np.int32)
+        if "labels" in keys:
+            tensors["labels"] = rng.integers(0, 9, (n,)).astype(np.int32)
+        save_file(tensors, str(p))
+        paths.append(str(p))
+    return paths
+
+
+# ------------------------------------------------------- zero-copy assembly
+
+
+@pytest.mark.parametrize("batch_size", [1, 3, 4, 7])
+def test_slice_batches_bit_equal_to_per_sample_stacking(tmp_path, batch_size):
+    """Contiguous views + carry-over must reproduce the per-sample path's
+    batches EXACTLY — values, dtypes, order — including batches spanning
+    uneven slice boundaries."""
+    paths = _make_slices(tmp_path, [5, 3, 7, 2, 6, 1, 4])
+
+    def samples():
+        for p in paths:
+            yield from slice_samples(p)
+
+    legacy = list(batches(samples(), batch_size))
+    zero_copy = list(slice_batches((load_slice(p) for p in paths), batch_size))
+    assert len(legacy) == len(zero_copy) and legacy
+    for a, b in zip(legacy, zero_copy):
+        assert set(a) == set(b)
+        for k in a:
+            assert a[k].dtype == b[k].dtype
+            np.testing.assert_array_equal(a[k], b[k])
+
+
+def test_slice_batches_carry_spans_multiple_small_slices(tmp_path):
+    """Slices SMALLER than one batch accumulate in the carry buffer until
+    a batch fills — the n < need path."""
+    paths = _make_slices(tmp_path, [2, 1, 2, 3, 1])
+    got = list(slice_batches((load_slice(p) for p in paths), 4))
+    assert len(got) == 2  # 9 samples -> 2 full batches, ragged tail carried
+
+    def samples():
+        for p in paths:
+            yield from slice_samples(p)
+
+    for a, b in zip(list(batches(samples(), 4)), got):
+        for k in a:
+            np.testing.assert_array_equal(a[k], b[k])
+
+
+def test_full_batches_inside_a_slice_are_views(tmp_path):
+    (path,) = _make_slices(tmp_path, [8])
+    arrays = load_slice(path)
+    got = list(slice_batches(iter([arrays]), 4))
+    assert len(got) == 2
+    for b in got:
+        assert b["input_ids"].base is not None  # a view, not a copy
+
+
+def test_slice_batches_rejects_mid_stream_key_change(tmp_path):
+    a = _make_slices(tmp_path, [4], keys=("input_ids", "labels"))[0]
+    bdir = tmp_path / "b"
+    bdir.mkdir()
+    b = _make_slices(bdir, [4], keys=("input_ids",))[0]
+    with pytest.raises(ValueError, match="key mismatch"):
+        list(slice_batches((load_slice(p) for p in [a, b]), 2))
+
+
+# --------------------------------------------------- empty / ragged slices
+
+
+def test_empty_slice_raises_with_path_in_both_assemblies(tmp_path):
+    p = tmp_path / "empty.safetensors"
+    save_file({"input_ids": np.zeros((0, 4), np.int32)}, str(p))
+    with pytest.raises(ValueError, match="empty.safetensors"):
+        list(slice_samples(p))
+    with pytest.raises(ValueError, match="empty.safetensors"):
+        load_slice(p)
+
+
+def test_no_tensor_slice_raises_instead_of_spinning(tmp_path):
+    """A tensor-less slice used to yield NOTHING silently — the infinite
+    stream then re-fetched forever. Now it names the slice."""
+    p = tmp_path / "junk.safetensors"
+    save_file({}, str(p))
+    with pytest.raises(ValueError, match="junk.safetensors"):
+        list(slice_samples(p))
+    with pytest.raises(ValueError, match="junk.safetensors"):
+        load_slice(p)
+
+
+def test_ragged_counts_clamp_identically(tmp_path):
+    p = tmp_path / "ragged.safetensors"
+    save_file(
+        {
+            "input_ids": np.arange(20, dtype=np.int32).reshape(5, 4),
+            "labels": np.arange(3, dtype=np.int32),  # ragged: 3 < 5
+        },
+        str(p),
+    )
+    assert len(list(slice_samples(p))) == 3
+    arrays = load_slice(p)
+    assert all(int(v.shape[0]) == 3 for v in arrays.values())
+
+
+def test_load_slice_reads_only_input_names(tmp_path):
+    (path,) = _make_slices(tmp_path, [4])
+    arrays = load_slice(path, input_names=["input_ids"])
+    assert set(arrays) == {"input_ids"}
+    with pytest.raises(KeyError, match="missing"):
+        load_slice(path, input_names=["nope"])
+
+
+# ------------------------------------------------------------- prefetcher
+
+
+def test_prefetcher_preserves_order_and_bounds_depth(tmp_path):
+    paths = _make_slices(tmp_path, [2, 2, 2, 2])
+    fetched: list[str] = []
+    it = itertools.cycle(paths)
+
+    def fetch():
+        p = next(it)
+        fetched.append(p)
+        return p
+
+    pf = SlicePrefetcher(fetch, depth=2)
+    try:
+        got = [pf.take() for _ in range(6)]
+        assert got == (paths * 2)[:6]  # consumption order == fetch order
+        time.sleep(0.3)
+        # queue bound throttles the producer: at most depth ready + one
+        # in-flight beyond what was consumed.
+        assert len(fetched) <= 6 + 2 + 1
+    finally:
+        pf.close()
+
+
+def test_prefetcher_retries_transient_failures():
+    calls = {"n": 0}
+
+    def flaky():
+        calls["n"] += 1
+        if calls["n"] < 3:
+            raise OSError("data node mid-restart")
+        return "ok-path"
+
+    before = DATA_METRICS.prefetch_errors.value()
+    pf = SlicePrefetcher(flaky, depth=1, retry_base_s=0.01)
+    try:
+        assert pf.take() == "ok-path"
+        assert DATA_METRICS.prefetch_errors.value() - before == 2
+    finally:
+        pf.close()
+
+
+def test_prefetcher_surfaces_persistent_failure():
+    def dead():
+        raise OSError("gone")
+
+    pf = SlicePrefetcher(dead, depth=1, retry_deadline_s=0.05, retry_base_s=0.01)
+    try:
+        with pytest.raises(RuntimeError, match="slice prefetch failed"):
+            pf.take()
+    finally:
+        pf.close()
+
+
+def test_stream_batches_pipeline_parity(tmp_path):
+    paths = _make_slices(tmp_path, [5, 3, 7, 2])
+    it_sync, it_pipe = itertools.cycle(paths), itertools.cycle(paths)
+    sync = stream_batches(lambda: next(it_sync), 4)
+    pipe = stream_batches(lambda: next(it_pipe), 4, pipeline=True, prefetch=2)
+    try:
+        for _ in range(25):
+            a, b = next(sync), next(pipe)
+            for k in a:
+                np.testing.assert_array_equal(a[k], b[k])
+    finally:
+        pipe.close()
+
+
+# ------------------------------------------- scheduler prefetch accounting
+
+
+def _ds(num_slices: int) -> DataScheduler:
+    ds = DataScheduler.__new__(DataScheduler)
+    ds.tracker = SliceTracker(num_slices)
+    ds._last = {}
+    return ds
+
+
+def test_prefetch_window_defers_retirement():
+    ds = _ds(4)
+    a = ds.assign("w0", prefetch=2)
+    b = ds.assign("w0", prefetch=2)
+    assert sorted([a, b]) == [0, 1]  # two DISTINCT held slices, none retired
+    assert ds.tracker._processed == set()
+    assert ds.held_of("w0") == [a, b]
+    c = ds.assign("w0", prefetch=2)
+    # window full: the OLDEST held slice retired, newest two held
+    assert ds.tracker._processed == {a}
+    assert ds.held_of("w0") == [b, c]
+
+
+def test_prefetch_window_legacy_requests_unchanged():
+    ds = _ds(3)
+    assert ds.assign("w0") == 0
+    assert ds.assign("w0") == 1  # previous retired immediately
+    assert ds.tracker._processed == {0}
+
+
+def test_remove_worker_reclaims_all_held_slices():
+    ds = _ds(4)
+    ds.assign("w0", prefetch=3)
+    ds.assign("w0", prefetch=3)
+    ds.assign("w0", prefetch=3)
+    assert len(ds.held_of("w0")) == 3
+    ds.remove_worker("w0")
+    assert "w0" not in ds._last
+    # ALL three return to the pool: a new worker can draw them fresh
+    drawn = {ds.assign("w1", prefetch=1) for _ in range(4)}
+    assert drawn == {0, 1, 2, 3}
+
+
+def test_prefetch_epoch_wrap_does_not_retire_stale_holds():
+    """A slice held across an epoch wrap must NOT be marked processed in
+    the new epoch when its window finally retires it — it would silently
+    starve that slice for the whole epoch (the hold-many twin of the
+    existing hold-one epoch guard)."""
+    ds = _ds(2)
+    assert ds.assign("a", prefetch=2) == 0
+    assert ds.assign("a", prefetch=2) == 1  # all assigned, a holds both
+    # b steals both (retiring each in epoch 0), then wraps the epoch
+    assert ds.assign("b", prefetch=1) == 0
+    assert ds.assign("b", prefetch=1) == 1
+    assert ds.assign("b", prefetch=1) == 0  # everything processed -> wrap
+    assert ds.tracker.epoch == 1
+    assert ds.tracker._processed == set()
+    # a's window is full of EPOCH-0 holds; its next request pops the
+    # oldest — which must not poison epoch 1's accounting
+    got = ds.assign("a", prefetch=2)
+    assert got == 1  # the only epoch-1 slice not assigned to b
+    assert ds.tracker._processed == set()
+
+
+def test_data_scheduler_wire_stamps_epoch_only_for_prefetch(tmp_path):
+    """Over the real wire: a prefetch-tagged DataRequest gets the epoch
+    back; a legacy request's response omits it — byte-identical to
+    today's."""
+    import asyncio
+
+    from hypha_tpu import messages
+    from hypha_tpu.network import MemoryTransport, Node
+
+    async def main():
+        hub = MemoryTransport()
+        sched = Node(hub.shared(), peer_id="sched")
+        await sched.start()
+        client = Node(hub.shared(), peer_id="w0")
+        await client.start()
+        client.add_peer_addr("sched", sched.listen_addrs[0])
+        ds = DataScheduler(sched, "prov", "mnist", num_slices=4)
+        ds.start()
+        legacy = await client.request(
+            "sched", messages.PROTOCOL_API,
+            messages.DataRequest(dataset="mnist", peer_id="w0"),
+        )
+        assert legacy.epoch is None
+        assert "epoch" not in messages._to_plain(legacy)
+        pipelined = await client.request(
+            "sched", messages.PROTOCOL_API,
+            messages.DataRequest(dataset="mnist", peer_id="w0", prefetch=2),
+        )
+        assert pipelined.epoch == 0
+        ds.stop()
+        await client.stop()
+        await sched.stop()
+
+    asyncio.run(main())
+
+
+# ------------------------------------------------------------- slice cache
+
+
+def test_slice_cache_roundtrip_and_hit_counters(tmp_path):
+    src = tmp_path / "src.bin"
+    src.write_bytes(b"slice-bytes" * 100)
+    cache = SliceCache(tmp_path / "cache", max_bytes=1 << 20)
+    hits0 = DATA_METRICS.cache_hits.value()
+    miss0 = DATA_METRICS.cache_misses.value()
+    dest = tmp_path / "out.bin"
+    assert not cache.get("toy", 0, 1, dest)
+    cache.put("toy", 0, 1, src)
+    assert cache.get("toy", 0, 1, dest)
+    assert dest.read_bytes() == src.read_bytes()
+    assert DATA_METRICS.cache_hits.value() - hits0 == 1
+    assert DATA_METRICS.cache_misses.value() - miss0 == 1
+
+
+def test_slice_cache_promotes_across_epoch_wraps(tmp_path):
+    """Slice content is a pure function of (dataset, index), so an epoch
+    wrap must PROMOTE the cached entry to the new epoch's key — a hit,
+    not a re-pull — and leave no dead prior-epoch generation behind."""
+    src = tmp_path / "src.bin"
+    src.write_bytes(b"slice-bytes" * 100)
+    cache = SliceCache(tmp_path / "cache", max_bytes=1 << 20)
+    cache.put("toy", 0, 1, src)
+    dest = tmp_path / "out.bin"
+    assert cache.get("toy", 1, 1, dest)  # epoch wrapped: promoted hit
+    assert dest.read_bytes() == src.read_bytes()
+    assert cache.entries() == 1  # moved, not duplicated
+    # a different INDEX is genuinely new work
+    assert not cache.get("toy", 1, 2, dest)
+
+
+def test_slice_cache_lru_eviction(tmp_path):
+    cache = SliceCache(tmp_path / "cache", max_bytes=2500)
+    for i in range(4):
+        src = tmp_path / f"s{i}.bin"
+        src.write_bytes(bytes([i]) * 1000)
+        cache.put("toy", 0, i, src)
+        time.sleep(0.01)  # distinct mtimes -> deterministic LRU order
+    assert cache.entries() == 2  # 4000 bytes shrunk under the 2500 cap
+    dest = tmp_path / "out.bin"
+    assert not cache.get("toy", 0, 0, dest)  # oldest evicted
+    assert cache.get("toy", 0, 3, dest)  # newest kept
+
+
+def test_slice_cache_corruption_falls_back_to_refetch(tmp_path):
+    src = tmp_path / "src.bin"
+    src.write_bytes(b"good-bytes" * 50)
+    cache = SliceCache(tmp_path / "cache", max_bytes=1 << 20)
+    cache.put("toy", 0, 7, src)
+    # flip bytes in the cached entry behind the cache's back
+    entry = next((tmp_path / "cache").glob("*.slice"))
+    data = bytearray(entry.read_bytes())
+    data[3] ^= 0xFF
+    entry.write_bytes(bytes(data))
+    corrupt0 = DATA_METRICS.cache_corrupt.value()
+    dest = tmp_path / "out.bin"
+    assert not cache.get("toy", 0, 7, dest)  # miss, not garbage
+    assert DATA_METRICS.cache_corrupt.value() - corrupt0 == 1
+    assert not dest.exists()  # the poisoned copy-out was withdrawn
+    assert cache.entries() == 0  # evicted; the next fetch re-pulls
+
+
+# -------------------------------------------- loss parity harness (no net)
+
+
+class _FakeSession:
+    """Deterministic single-worker scheduler + parameter server behind the
+    bridge-client API (the test_stream harness, with a MULTI-slice fetch
+    so batches cross slice boundaries)."""
+
+    def __init__(self, work_dir: Path, rounds: int, batches_per_round: int = 3,
+                 slice_sizes=(5, 3, 7, 2), fetch_delay_s: float = 0.0):
+        self.work_dir = Path(work_dir)
+        self.target_rounds = rounds
+        self.batches_per_round = batches_per_round
+        self.fetch_delay_s = fetch_delay_s
+        self.rounds_done = 0
+        self.batches_this_round = 0
+        self.scheduled = False
+        self.events: "queue.Queue[dict]" = queue.Queue()
+        self.fetches = 0
+        self.lock = threading.Lock()
+        d = self.work_dir / "artifacts"
+        d.mkdir(parents=True, exist_ok=True)
+        rng = np.random.default_rng(42)
+        # Content kept in memory; each fetch RE-MATERIALIZES the file like
+        # the real connector does (the pipeline unlinks consumed slices).
+        self._data = [
+            rng.integers(0, 16, (n, 8)).astype(np.int32) for n in slice_sizes
+        ]
+
+    def fetch(self, fetch):
+        if self.fetch_delay_s:
+            time.sleep(self.fetch_delay_s)
+        with self.lock:
+            i = self.fetches % len(self._data)
+            self.fetches += 1
+        p = self.work_dir / "artifacts" / f"slice{i}-f{self.fetches}.safetensors"
+        save_file({"input_ids": self._data[i]}, str(p))
+        return [f"artifacts/{p.name}"]
+
+    def send_status(self, progress):
+        from hypha_tpu.messages import (
+            ProgressKind,
+            ProgressResponse,
+            ProgressResponseKind,
+        )
+
+        kind = progress.kind
+        with self.lock:
+            if kind == ProgressKind.STATUS:
+                if self.rounds_done >= self.target_rounds:
+                    return ProgressResponse(kind=ProgressResponseKind.DONE)
+                self.batches_this_round += 1
+                if (
+                    not self.scheduled
+                    and self.batches_this_round >= self.batches_per_round
+                ):
+                    self.scheduled = True
+                    return ProgressResponse(
+                        kind=ProgressResponseKind.SCHEDULE_UPDATE, counter=0
+                    )
+                return ProgressResponse(kind=ProgressResponseKind.CONTINUE)
+            if kind == ProgressKind.UPDATE_RECEIVED:
+                self.rounds_done += 1
+                self.batches_this_round = 0
+                self.scheduled = False
+                done = self.rounds_done >= self.target_rounds
+                return ProgressResponse(
+                    kind=(
+                        ProgressResponseKind.DONE
+                        if done
+                        else ProgressResponseKind.CONTINUE
+                    )
+                )
+            return ProgressResponse(kind=ProgressResponseKind.OK)
+
+    def send_resource(self, send, path, resource="updates", meta=None):
+        from hypha_tpu import compress
+
+        meta = meta or {}
+        delta = compress.read_delta(self.work_dir / path)
+        update = {k: (0.7 * np.asarray(v, np.float32)) for k, v in delta.items()}
+        incoming = self.work_dir / "incoming"
+        incoming.mkdir(exist_ok=True)
+        round_num = int(meta.get("round", self.rounds_done))
+        out = incoming / f"update-{round_num}.safetensors"
+        save_file(update, str(out))
+        event_meta = {"round": round_num}
+        for key in ("fragment_id", "fragments"):
+            if key in meta:
+                event_meta[key] = meta[key]
+        self.events.put(
+            {"path": f"incoming/{out.name}", "meta": event_meta, "size": 0}
+        )
+
+    @contextmanager
+    def receive(self, receive):
+        def gen():
+            while True:
+                try:
+                    yield self.events.get(timeout=30)
+                except queue.Empty:
+                    return
+
+        yield gen()
+
+
+def _spec(work_dir, **overrides):
+    from hypha_tpu.messages import (
+        Adam,
+        Executor,
+        Fetch,
+        JobSpec,
+        Receive,
+        Reference,
+        Send,
+        TrainExecutorConfig,
+    )
+
+    cfg = TrainExecutorConfig(
+        model={
+            "model_type": "causal-lm",
+            "family": "gpt2",
+            "config": {
+                "vocab_size": 16,
+                "n_positions": 8,
+                "n_embd": 8,
+                "n_layer": 1,
+                "n_head": 2,
+            },
+            "seed": 3,
+        },
+        data=Fetch(Reference.from_uri("file:///unused")),
+        updates=Send(Reference.from_peers(["ps"], "updates")),
+        results=Receive(Reference.from_peers(["ps"], "results")),
+        optimizer=Adam(lr=1e-3),
+        batch_size=4,
+        **overrides,
+    )
+    return JobSpec(
+        job_id="data-pipeline-test",
+        executor=Executor(kind="train", name="diloco-transformer", train=cfg),
+    )
+
+
+def _run(tmp_path, name, rounds=3, **overrides):
+    from hypha_tpu.executor.training import run_training
+
+    work = tmp_path / name
+    work.mkdir()
+    session = _FakeSession(work, rounds=rounds)
+    return run_training(session, work, _spec(work, **overrides), max_batches=64)
+
+
+@pytest.mark.slow
+def test_loss_parity_sync_vs_pipeline_blocking(tmp_path):
+    """The acceptance pin: pipeline on — prefetch + zero-copy + deferred
+    sync — produces the bit-identical loss SEQUENCE and round count of the
+    synchronous loader, in blocking mode."""
+    base = _run(tmp_path, "sync")
+    piped = _run(
+        tmp_path, "pipe", input_pipeline=True, prefetch_slices=2
+    )
+    assert base.rounds == piped.rounds
+    assert base.batches == piped.batches
+    assert base.losses == piped.losses  # bit-exact, same order
+
+
+@pytest.mark.slow
+def test_loss_parity_sync_vs_pipeline_stream(tmp_path, monkeypatch):
+    """Same pin through the streaming outer sync (zero-flight-drift mode
+    pins overlap ≡ blocking, so losses stay comparable run to run)."""
+    monkeypatch.setenv("HYPHA_STREAM_POLL_WAIT", "60")
+    base = _run(tmp_path, "sync", sync_mode="overlap")
+    piped = _run(
+        tmp_path, "pipe", sync_mode="overlap",
+        input_pipeline=True, prefetch_slices=2,
+    )
+    assert base.rounds == piped.rounds
+    assert base.losses == piped.losses
+
+
+@pytest.mark.slow
+def test_pipeline_records_input_wait_metrics(tmp_path):
+    DATA_METRICS.reset()
+    _run(tmp_path, "metrics", input_pipeline=True, prefetch_slices=2)
+    snap = DATA_METRICS.snapshot()
+    assert snap["slices_fetched"] >= 2
+    assert snap["input_waits"] > 0
+    assert snap["boundary_waits"] > 0
+
+
+# ---------------------------------------------------------- wire goldens
+
+
+def test_defaults_off_ship_byte_identical_wire():
+    """No pipeline config ⇒ none of the new fields appear on any wire
+    form — DataRequest / DataResponse / Reference / TrainExecutorConfig
+    encode to today's exact key sets."""
+    from hypha_tpu import messages
+    from hypha_tpu.messages import (
+        DataRequest,
+        DataResponse,
+        Reference,
+    )
+
+    assert set(messages._to_plain(DataRequest(dataset="d", peer_id="w"))) == {
+        "_t", "dataset", "peer_id",
+    }
+    assert set(messages._to_plain(DataResponse(data_provider="p", index=3))) == {
+        "_t", "data_provider", "index",
+    }
+    assert set(messages._to_plain(Reference.from_scheduler("s", "d"))) == {
+        "_t", "scheduler_peer", "dataset",
+    }
+    spec = _spec(Path("/tmp"))
+    plain = messages._to_plain(spec.executor.train)
+    assert "input_pipeline" not in plain
+    assert "prefetch_slices" not in plain
+    # and the round trip preserves the absent-field defaults
+    back = messages.decode(messages.encode(spec.executor.train))
+    assert back.input_pipeline is None
+    assert back.prefetch_slices is None
+
+
+def test_train_spec_stamps_pipeline_fields_only_when_on():
+    from hypha_tpu import messages as m
+    from hypha_tpu.scheduler.job_config import DiLoCoJob
+
+    job_off = DiLoCoJob(model={"family": "gpt2"}, dataset="toy")
+    assert job_off.input_pipeline is False
+    job_on = DiLoCoJob(
+        model={"family": "gpt2"}, dataset="toy",
+        input_pipeline=True, prefetch_slices=3,
+    )
+    assert job_on.prefetch_slices == 3
+    with pytest.raises(ValueError, match="prefetch_slices"):
+        DiLoCoJob(model={"family": "gpt2"}, dataset="toy", prefetch_slices=2)
+    ref_on = m.Reference.from_scheduler("sched", "toy", prefetch=3)
+    assert m._to_plain(ref_on)["prefetch"] == 3

@@ -37,6 +37,7 @@ from hypha_tpu.network import MemoryTransport, Node
 from hypha_tpu.telemetry import metrics_snapshot
 from hypha_tpu.telemetry.flight import FlightRecorder
 from hypha_tpu.telemetry.ft_metrics import (
+    DATA_METRICS,
     FT_METRICS,
     HET_METRICS,
     SERVE_METRICS,
@@ -672,7 +673,7 @@ def test_metrics_snapshot_is_json_safe_under_numpy_scalars():
                         v.add(np.float32(1))
 
     for bundle in (FT_METRICS, STREAM_METRICS, SHARD_METRICS,
-                   SERVE_METRICS, HET_METRICS):
+                   SERVE_METRICS, HET_METRICS, DATA_METRICS):
         feed(bundle)
     # The special recorders that historically bypassed Counter/Histogram.
     STREAM_METRICS.flight_started(np.float32(1024.0))
@@ -687,6 +688,10 @@ def test_metrics_snapshot_is_json_safe_under_numpy_scalars():
     SERVE_METRICS.cache_state(np.float32(5), np.int32(1))
     SERVE_METRICS.request_finished(np.float32(25.0))
     FT_METRICS.rejoin_latency_ms.record(np.float32(100.0))
+    DATA_METRICS.note_input_wait(np.float32(0.5))
+    DATA_METRICS.note_boundary_wait(np.float64(0.25))
+    DATA_METRICS.note_fetch(np.float32(0.1))
+    DATA_METRICS.note_queue_depth(np.int64(2))
 
     snap = metrics_snapshot()
     json.dumps(snap)  # must not raise
