@@ -3,7 +3,7 @@
 
 PYTHON ?= python
 
-.PHONY: lint lint-fixtures test compressbench streambench ftbench-ps ftbench-scheduler shardbench servbench servbench-smoke hetbench obsbench obsbench-smoke databench databench-smoke
+.PHONY: lint lint-fixtures test compressbench streambench ftbench-ps ftbench-scheduler shardbench servbench servbench-smoke swapbench swapbench-smoke hetbench obsbench obsbench-smoke databench databench-smoke
 
 lint:
 	$(PYTHON) -m hypha_tpu.analysis hypha_tpu/
@@ -65,6 +65,21 @@ servbench:
 servbench-smoke:
 	JAX_PLATFORMS=cpu $(PYTHON) benchmarks/servbench.py --round smoke \
 		--smoke --out /tmp/SERVBENCH_smoke.json
+
+# Live weight streaming: closed-loop clients while >=5 outer rounds
+# hot-swap through the pool (0 failed/blocked requests, tok/s >=0.9x the
+# static-weights run, SLO watchdog green, completion stamps on-schedule),
+# per-round token provenance vs a host-side θ0+Σu reference fold, and
+# prefix-cache hit-rate recovery >=80% within 2 swap intervals. Writes
+# SWAPBENCH_<round>.json (docs/serving.md "Live weight streaming").
+swapbench:
+	JAX_PLATFORMS=cpu $(PYTHON) benchmarks/swapbench.py --round r14
+
+# Seconds-scale swapbench for CI (tiny sections, same assertions with
+# smoke-adjusted floors).
+swapbench-smoke:
+	JAX_PLATFORMS=cpu $(PYTHON) benchmarks/swapbench.py --round smoke \
+		--smoke --out /tmp/SWAPBENCH_smoke.json
 
 # WAN-adaptive outer rounds: a 4-worker pool with one bandwidth-capped +
 # one 4x slow-CPU peer, adaptive (straggler-adaptive inner steps +

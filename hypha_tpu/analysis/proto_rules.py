@@ -82,6 +82,18 @@ _TREE_FIELDS = {"tree_depth", "tree_level", "parent", "reduce_parent"}
 # (or drop) an execution against the wrong round.
 _GENERATION_FIELDS = {"generation", "scheduler_generation", "ps_generation"}
 
+# Field names carrying live-weight-swap state (hypha_tpu.serving
+# .weight_stream). Their presence obliges the message to carry BOTH a
+# round tag AND a generation tag (``msg-swap-needs-generation``): the
+# served model is defined by (round, PS generation) together — round
+# numbering restarts its meaning per generation, so a swap stamp missing
+# either half could pin evals to (or roll back onto) a model from a
+# different PS incarnation. ``weight_round`` itself counts as the round
+# half and ``weight_generation`` as the generation half, so the stamp
+# pair on responses/heartbeats satisfies the rule without colliding with
+# the restart-handshake field names.
+_SWAP_FIELDS = {"weight_round", "swap_round", "swap"}
+
 
 def _modules():
     from hypha_tpu import messages
@@ -483,6 +495,49 @@ def check_generation_tags(registry=None) -> list[Violation]:
     return out
 
 
+def check_swap_tags(registry=None) -> list[Violation]:
+    """Any message with live-weight-swap state must carry round AND
+    generation tags.
+
+    Structural, like :func:`check_fragment_tags`, but two-sided: EVERY
+    registered dataclass that grows a ``weight_round``/``swap_round``/
+    ``swap`` field must pair it with both a round tag (``weight_round``
+    itself, or ``round``/``epoch``/``round_num``) and a generation tag
+    (``weight_generation``, or the restart-handshake generation fields) —
+    the served model's identity is the (round, PS generation) PAIR, and a
+    swap stamp missing either half silently aliases models across PS
+    restarts (round 7 of generation 2 is not round 7 of generation 1).
+    """
+    messages, _ = _modules()
+    registry = registry if registry is not None else _package_registry(messages)
+    round_ok = _TAG_FIELDS | {"weight_round"}
+    gen_ok = _GENERATION_FIELDS | {"weight_generation"}
+    out: list[Violation] = []
+    for name, cls in sorted(registry.items()):
+        if not dataclasses.is_dataclass(cls):
+            continue
+        fields = {f.name for f in dataclasses.fields(cls)}
+        if not fields & _SWAP_FIELDS:
+            continue
+        missing = [
+            half
+            for half, ok in (("round", round_ok), ("generation", gen_ok))
+            if not fields & ok
+        ]
+        if missing:
+            out.append(
+                _violation(
+                    cls,
+                    "msg-swap-needs-generation",
+                    f"{name}: carries {sorted(fields & _SWAP_FIELDS)} "
+                    f"but no {' or '.join(missing)} tag — a swap stamp "
+                    f"missing either half of (round, generation) aliases "
+                    f"served models across PS restarts",
+                )
+            )
+    return out
+
+
 def check_protocol_map(registry=None, manifest=None, values=None) -> list[Violation]:
     messages, _ = _modules()
     registry = registry if registry is not None else _package_registry(messages)
@@ -547,5 +602,6 @@ def check() -> list[Violation]:
         + check_adaptive_tags()
         + check_tree_tags()
         + check_generation_tags()
+        + check_swap_tags()
         + check_protocol_map()
     )

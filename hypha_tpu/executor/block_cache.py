@@ -25,11 +25,24 @@ Design (vLLM's automatic prefix caching, adapted to this allocator):
 * **Allocation order.** ``alloc`` draws from the free list first, then
   evicts the LRU's oldest block (dropping its hash entry). Only when
   both are empty does the pool fall back to preemption.
+* **Weight generations (live weight streaming, PR 16).** Chain hashes
+  address token CONTENT, but the cached K/V were computed under specific
+  weights — after a hot swap the same prompt bytes hash identically
+  while the blocks hold stale activations. Every registration is
+  stamped with the allocator's current ``generation``;
+  ``bump_generation`` (called by the pool at the swap boundary)
+  invalidates LAZILY: live lanes keep their mapped blocks until release
+  (refcounts never move at a swap), but a stale-generation block is a
+  cache MISS — ``peek``/``lookup`` drop its registration on contact,
+  ``release`` sends a stale ref-0 block to the free list instead of the
+  LRU, and ``register`` evicts a stale holder so the new-generation
+  content can claim the hash.
 
 Every block is therefore in exactly one of three places — the free
 list, at least one live lane table (ref > 0), or the ref-0 LRU — and
 ``check_conservation`` asserts that partition (the block-conservation
-property test drives random op sequences against it).
+property test drives random op sequences against it, swap bumps
+included).
 """
 
 from __future__ import annotations
@@ -76,6 +89,12 @@ class PrefixBlockCache:
         self._by_hash: dict[int, int] = {}  # content hash -> block
         self._lru: "OrderedDict[int, None]" = OrderedDict()  # ref-0 cached
         self.evictions = 0  # cached blocks recycled under pressure
+        # Weight generation the allocator currently admits against; every
+        # registered block remembers the generation its K/V were written
+        # under, and a mismatch makes it a miss (lazily dropped).
+        self.generation = 0
+        self._gen_of: dict[int, int] = {}  # block -> generation registered
+        self.stale_drops = 0  # stale-generation registrations dropped
 
     # ----------------------------------------------------------- querying
 
@@ -100,17 +119,40 @@ class PrefixBlockCache:
     def is_registered(self, block: int) -> bool:
         return block in self._hash_of
 
+    def _stale(self, block: int) -> bool:
+        """Registered under an older weight generation than current."""
+        return (
+            block in self._hash_of
+            and self._gen_of.get(block, self.generation) != self.generation
+        )
+
+    def _drop_stale(self, block: int) -> None:
+        """Lazy invalidation on contact: drop a stale block's
+        registration; if it was parked ref-0 in the LRU it becomes plain
+        free space (nothing can ever hit it again). Live references are
+        untouched — the owning lanes finish on the blocks they mapped."""
+        self.forget(block)
+        self.stale_drops += 1
+        if block in self._lru:
+            del self._lru[block]
+            self._free.append(block)
+
     def peek(self, hashes: list) -> tuple:
         """Longest cached prefix of ``hashes`` WITHOUT taking references:
         ``(hit_blocks, hits_in_lru)``. ``hits_in_lru`` counts hits that
         currently sit in the LRU — mapping them consumes allocatable
-        headroom, so admission must budget for them like fresh blocks."""
+        headroom, so admission must budget for them like fresh blocks.
+        Stale-generation entries are misses (and are dropped on
+        contact, so peek/lookup agree on the same admission)."""
         hits = in_lru = 0
         if not self.caching:
             return 0, 0
         for h in hashes:
             b = self._by_hash.get(h)
             if b is None:
+                break
+            if self._stale(b):
+                self._drop_stale(b)
                 break
             hits += 1
             if b in self._lru:
@@ -119,17 +161,28 @@ class PrefixBlockCache:
 
     # ---------------------------------------------------------- mutation
 
+    def bump_generation(self) -> None:
+        """A weight swap happened: everything registered so far holds K/V
+        from the OLD weights. No refcount or table moves here — the
+        stale entries fall out lazily as peek/lookup/release touch them,
+        so live lanes are never disturbed mid-decode."""
+        self.generation += 1
+
     def lookup(self, hashes: list) -> list:
         """Map the longest cached prefix of ``hashes``: bumps each hit
         block's refcount (un-parking it from the LRU) and returns the
         physical ids in prefix order. The caller writes them into its
-        lane table."""
+        lane table. Stale-generation entries never map — a post-swap
+        admission must recompute the prefix under the new weights."""
         out: list = []
         if not self.caching:
             return out
         for h in hashes:
             b = self._by_hash.get(h)
             if b is None:
+                break
+            if self._stale(b):
+                self._drop_stale(b)
                 break
             if self._ref[b] == 0:
                 del self._lru[b]
@@ -147,6 +200,7 @@ class PrefixBlockCache:
         elif self._lru:
             b, _ = self._lru.popitem(last=False)
             del self._by_hash[self._hash_of.pop(b)]
+            self._gen_of.pop(b, None)
             self.evictions += 1
             SERVE_METRICS.cache_evictions.add(1)
         else:
@@ -156,13 +210,21 @@ class PrefixBlockCache:
 
     def register(self, block: int, h: int) -> None:
         """Attach content hash ``h`` to ``block`` (its K/V are fully
-        written and final). Duplicate content — another block already
-        registered under ``h`` — keeps the original; this block stays
-        unregistered and will free normally."""
-        if not self.caching or block in self._hash_of or h in self._by_hash:
+        written and final) under the CURRENT weight generation.
+        Duplicate content — another block already registered under ``h``
+        — keeps the original; this block stays unregistered and will
+        free normally. Exception: a stale-generation holder is evicted
+        first, so post-swap recomputation can re-claim the hash."""
+        if not self.caching or block in self._hash_of:
             return
+        holder = self._by_hash.get(h)
+        if holder is not None:
+            if not self._stale(holder):
+                return
+            self._drop_stale(holder)
         self._hash_of[block] = h
         self._by_hash[h] = block
+        self._gen_of[block] = self.generation
 
     def forget(self, block: int) -> None:
         """Drop ``block``'s registration (an in-place overwrite is about
@@ -171,15 +233,23 @@ class PrefixBlockCache:
         h = self._hash_of.pop(block, None)
         if h is not None:
             del self._by_hash[h]
+            self._gen_of.pop(block, None)
 
     def release(self, block: int) -> None:
         """Drop one table reference. At ref 0, registered blocks park in
         the LRU (their content stays addressable for future hits);
-        unregistered blocks go straight back to the free list."""
+        unregistered blocks go straight back to the free list — as do
+        stale-generation registrations, whose content can never be hit
+        again (the lane that held them across a swap just finished)."""
         self._ref[block] -= 1
         if self._ref[block] < 0:
             raise AssertionError(f"block {block} released below ref 0")
         if self._ref[block] == 0:
+            if self._stale(block):
+                # Not yet parked anywhere: forget and fall through to the
+                # free list (the LRU would just defer the same drop).
+                self.forget(block)
+                self.stale_drops += 1
             if block in self._hash_of:
                 self._lru[block] = None
             else:
@@ -222,3 +292,8 @@ class PrefixBlockCache:
                 raise AssertionError(f"hash index desync on block {b}")
         if len(self._by_hash) != len(self._hash_of):
             raise AssertionError("hash maps disagree on cached count")
+        if set(self._gen_of) != set(self._hash_of):
+            raise AssertionError(
+                "generation stamps desync from registrations: "
+                f"{sorted(set(self._gen_of) ^ set(self._hash_of))}"
+            )

@@ -211,6 +211,12 @@ class _PRow:
     spec_cooldown: int = 0  # iterations to sit out after low accepts
 
 
+# Serve-loop wake sentinel (request_swap/pin_round): drained and dropped —
+# it exists only to unblock an idle queue.get so a staged swap applies
+# without waiting for the next request to arrive.
+_WAKE: Any = object()
+
+
 class DecodePool:
     """One serving pool: owns the chip from a dedicated thread.
 
@@ -300,6 +306,23 @@ class DecodePool:
             self._vars = dict(params)
         else:
             self._vars = {"params": params}
+        # Live weight streaming (hypha_tpu.serving.weight_stream): a
+        # pending hot swap staged by request_swap() from any thread,
+        # applied by the SERVE thread at the next chunk boundary —
+        # ``self._vars`` is read exactly once per dispatched program on
+        # that thread, so one assignment is atomic and no in-flight
+        # decode step ever sees mixed-round weights.
+        self._swap_lock = threading.Lock()
+        self._pending_swap: dict | None = None
+        self._param_names: set | None = None  # lazy flat_leaf_map cache
+        self._pending_rollback: int | None = None
+        self._prev_leaves: tuple | None = None  # (round, leaves) snapshot
+        self.weight_round: int | None = None
+        self.weight_generation: int | None = None
+        self.pinned_round: int | None = None
+        self.swaps_applied = 0
+        self.swaps_deferred = 0
+        self.swaps_rolled_back = 0
         self.slots = slots
         self.max_len = max_len
         self.steps_per_call = steps_per_call
@@ -383,6 +406,214 @@ class DecodePool:
     def live_rows(self) -> int:
         """Rows currently decoding/prefilling (either mode)."""
         return len(self._rows) + len(self._lane_rows)
+
+    # ----------------------------------------------------- weight swapping
+
+    def weight_state(self) -> tuple:
+        """The (round, generation) currently serving — None/None until the
+        first swap (requests decode on the dispatched params)."""
+        with self._swap_lock:
+            return self.weight_round, self.weight_generation
+
+    def _norm_swap_key(self, name: str) -> str:
+        """Map a wire delta name onto the local param tree. Trainer-side
+        names come from the FULL init tree and so carry the ``params/``
+        head; the pool holds the inner subtree with unprefixed names.
+        Normalizing ONCE at staging time keeps fold, rollback-undo, and
+        apply keys in one spelling — a mismatch here would fold the same
+        leaf under two dict keys and silently drop one delta at apply.
+        Unknown names pass through so the apply-side lookup fails loud.
+        """
+        if self._param_names is None:
+            from .serialization import flat_leaf_map
+
+            self._param_names = set(flat_leaf_map(self._vars["params"]))
+        if name in self._param_names:
+            return name
+        if name.startswith("params/") and name[7:] in self._param_names:
+            return name[7:]
+        return name
+
+    def request_swap(
+        self,
+        updates: dict,
+        *,
+        round_num: int,
+        generation: int = 0,
+        keep_previous: bool = False,
+    ) -> None:
+        """Stage round ``round_num``'s outer UPDATE (flat name -> delta
+        array) for an atomic flip at the next chunk boundary. Thread-safe;
+        callers feed rounds contiguously (WeightStager enforces it). A
+        swap staged before the previous one applied FOLDS into it —
+        updates are deltas, so replacing would silently skip a round.
+        While ``pin_round`` holds serving back, staged rounds keep
+        folding (counted as deferred) and apply the moment the pin lifts.
+        """
+        with self._swap_lock:
+            if self._closed:
+                return
+            pend = self._pending_swap
+            if pend is None:
+                self._pending_swap = {
+                    "updates": {
+                        self._norm_swap_key(k): np.asarray(v, np.float32)
+                        for k, v in updates.items()
+                    },
+                    "round": int(round_num),
+                    "generation": int(generation),
+                    "keep_previous": bool(keep_previous),
+                    "staged_at": time.monotonic(),
+                }
+            else:
+                acc = pend["updates"]
+                for k, v in updates.items():
+                    k = self._norm_swap_key(k)
+                    arr = np.asarray(v, np.float32)
+                    acc[k] = acc[k] + arr if k in acc else arr
+                pend["round"] = int(round_num)
+                pend["generation"] = int(generation)
+                pend["keep_previous"] = bool(keep_previous)
+            if (
+                self.pinned_round is not None
+                and int(round_num) > self.pinned_round
+            ):
+                self.swaps_deferred += 1
+                SERVE_METRICS.swap_deferred.add(1)
+        # Wake an idle serve loop so the flip doesn't wait for traffic.
+        self._queue.put(_WAKE)
+
+    def pin_round(self, round_num: int | None) -> None:
+        """Rollback knob: pin serving to ``round_num`` — newer staged
+        rounds defer (and keep folding) until unpinned (None). Pinning
+        the PREVIOUS applied round restores it from the retained
+        ``keep_previous`` snapshot at the next chunk boundary."""
+        with self._swap_lock:
+            self.pinned_round = (
+                int(round_num) if round_num is not None else None
+            )
+            if (
+                round_num is not None
+                and self._prev_leaves is not None
+                and self._prev_leaves[0] == int(round_num)
+                and self.weight_round is not None
+                and self.weight_round > int(round_num)
+            ):
+                self._pending_rollback = int(round_num)
+        self._queue.put(_WAKE)
+
+    def _reset_spec_state(self) -> None:
+        """Per-lane speculation accept statistics were learned under the
+        OLD weights: re-arm every lane optimistically instead of letting
+        a stale low EWMA park it on plain decode after the model improved
+        (tokens are greedy-verified either way — throughput only). The
+        context/index caches stay: emitted tokens are facts."""
+        for r in self._lane_rows.values():
+            if r.spec_ctx is not None:
+                r.spec_ewma = float(self.spec_draft)
+            r.spec_cooldown = 0
+
+    def _apply_swap(self) -> None:
+        """Serve-thread only: flip ``self._vars`` to the staged round (or
+        roll back to the pinned snapshot) at a chunk-boundary admission
+        point. Device-preserving: only the fragment's named leaves move
+        (replace_leaves), everything else aliases the live tree."""
+        with self._swap_lock:
+            pend = self._pending_swap
+            rollback, self._pending_rollback = self._pending_rollback, None
+            pinned = self.pinned_round
+            if pend is not None and (
+                pinned is not None and pend["round"] > pinned
+            ):
+                pend = None  # stays staged; folds until unpinned
+            elif pend is not None:
+                self._pending_swap = None
+        if rollback is not None and self._prev_leaves is not None:
+            prev_round, leaves = self._prev_leaves
+            if prev_round == rollback:
+                from .serialization import flat_leaf_map, replace_leaves
+
+                # Fold the UNDONE delta (current - snapshot) back into the
+                # pending accumulator before restoring: updates are
+                # deltas, so once the pin lifts the flip must roll FORWARD
+                # through the rolled-back round, not skip it (θ_r + u_{r+2}
+                # is a model no trainer ever held).
+                rolled_from = self.weight_round
+                cur = flat_leaf_map(self._vars["params"])
+                undo = {
+                    name: np.asarray(cur[name], np.float32)
+                    - np.asarray(old, np.float32)
+                    for name, old in leaves.items()
+                }
+                with self._swap_lock:
+                    pend2 = self._pending_swap
+                    if pend2 is None:
+                        self._pending_swap = {
+                            "updates": undo,
+                            "round": rolled_from,
+                            "generation": self.weight_generation or 0,
+                            "keep_previous": False,
+                            "staged_at": time.monotonic(),
+                        }
+                    else:
+                        acc = pend2["updates"]
+                        for k, v in undo.items():
+                            acc[k] = acc[k] + v if k in acc else v
+                self._vars = {
+                    **self._vars,
+                    "params": replace_leaves(self._vars["params"], leaves),
+                }
+                self._prev_leaves = None
+                with self._swap_lock:
+                    self.weight_round = prev_round
+                    self.swaps_rolled_back += 1
+                self._alloc.bump_generation()
+                self._reset_spec_state()
+                SERVE_METRICS.swap_rolled_back.add(1)
+                SERVE_METRICS.weight_state(
+                    prev_round, self.weight_generation or 0
+                )
+        if pend is None:
+            return
+        from .serialization import flat_leaf_map, replace_leaves
+
+        flat = flat_leaf_map(self._vars["params"])
+        new = {}
+        prev = {} if pend["keep_previous"] else None
+        for name, u in pend["updates"].items():
+            leaf = flat[name]  # KeyError = wire/tree mismatch: fail loud
+            if prev is not None:
+                prev[name] = leaf
+            upd = jnp.asarray(u)
+            new[name] = (
+                leaf.astype(jnp.float32) + upd.astype(jnp.float32)
+            ).astype(leaf.dtype)
+        self._vars = {
+            **self._vars,
+            "params": replace_leaves(self._vars["params"], new),
+        }
+        if prev is not None:
+            self._prev_leaves = (self.weight_round, prev)
+        with self._swap_lock:
+            self.weight_round = pend["round"]
+            self.weight_generation = pend["generation"]
+            self.swaps_applied += 1
+        # Cached prefix blocks hold K/V computed under the old weights:
+        # same token bytes, stale activations. Invalidate lazily — live
+        # lanes keep their blocks until release, new admissions never
+        # match a stale-generation chain.
+        self._alloc.bump_generation()
+        self._reset_spec_state()
+        SERVE_METRICS.swap_applied.add(1)
+        SERVE_METRICS.swap_finished(
+            (time.monotonic() - pend["staged_at"]) * 1000.0
+        )
+        SERVE_METRICS.weight_state(pend["round"], pend["generation"])
+        FLIGHT.record(
+            "serve.weight_swap",
+            round=pend["round"], generation=pend["generation"],
+            live_rows=self.live_rows(),
+        )
 
     # ------------------------------------------------------------ public
 
@@ -505,7 +736,7 @@ class DecodePool:
                     item = self._queue.get_nowait()
                 except queue.Empty:
                     break
-                if item is not None:
+                if item is not None and item is not _WAKE:
                     self._waiting.append(item)
             self._backlog = 0
         for g in self._waiting:
@@ -685,7 +916,7 @@ class DecodePool:
                     item = self._queue.get(block=not live)
                     if item is None:
                         stop = True
-                    else:
+                    elif item is not _WAKE:
                         self._waiting.append(item)
                     # drain anything else that queued meanwhile
                     while not stop:
@@ -695,13 +926,18 @@ class DecodePool:
                             break
                         if more is None:
                             stop = True
-                        else:
+                        elif more is not _WAKE:
                             self._waiting.append(more)
                 except queue.Empty:
                     pass
                 if stop:
                     self._fail_all(RuntimeError("pool is closed"))
                     return
+                # Chunk boundary: between dispatched programs is the one
+                # place no decode step is in flight, so a staged weight
+                # swap (or rollback) flips here — atomically w.r.t. every
+                # program dispatched below.
+                self._apply_swap()
                 if self._paged:
                     self._step_paged()
                 else:

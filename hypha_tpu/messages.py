@@ -717,6 +717,15 @@ class AggregateExecutorConfig:
     # Updated notifies. Additive fields: None is omitted from the wire.
     report_metrics_s: float | None = None
     metrics_peer: str | None = None
+    # Live weight streaming: serving peers this parameter server fans its
+    # update broadcasts out to IN ADDITION to the training workers. Kept
+    # separate from the results peers because elastic membership rewrites
+    # the broadcast set to the active TRAIN workers each round — serve
+    # subscribers are not round members and must survive that override.
+    # Under a broadcast tree they attach as relay children instead
+    # (``broadcast_tree.serve_leaves``). None = no serve fan-out, today's
+    # exact bytes.
+    serve_peers: list | None = None
 
 
 @register
@@ -804,6 +813,12 @@ class InferExecutorConfig:
     # today's exact bytes.
     report_metrics_s: float | None = None
     metrics_peer: str | None = None
+    # Live weight streaming (hypha_tpu.serving.weight_stream): follow a
+    # training job's PS broadcast and hot-swap the decode pool onto each
+    # completed outer round at a chunk boundary. None — the only value a
+    # static-weights job ships — is omitted from the wire, so the whole
+    # subsystem off keeps today's exact bytes (golden-pinned).
+    serve_follow_rounds: WeightFollow | None = None
 
 
 @register
@@ -833,6 +848,17 @@ class GenerateResponse:
     # queueing unboundedly (generate_remote honors this automatically).
     ok: bool = True
     retry_after_ms: float = 0.0
+    # Live weight streaming (hypha_tpu.serving.weight_stream): the DiLoCo
+    # outer round and PS generation the responding worker was SERVING when
+    # it emitted these tokens — the provenance stamp swapbench audits
+    # against the swap schedule. A swap stamp without both halves is
+    # ambiguous across a PS restart (round counters reset per generation),
+    # so the pair always travels together (hypha-lint
+    # ``msg-swap-needs-generation``). Additive fields: None — the only
+    # value a non-following server ships — is omitted from the wire, so
+    # ``serve_follow_rounds`` unset keeps today's exact bytes.
+    weight_round: int | None = None
+    weight_generation: int | None = None
 
 
 @register
@@ -856,12 +882,56 @@ class ServeLoad:
     live_requests: int = 0
     requests: int = 0  # served since job start (monotonic)
     rejections: int = 0  # backpressure rejections since job start
+    # Live weight streaming: the (round, generation) this worker currently
+    # serves — the router's view of how fresh each backend's weights are.
+    # The pair travels together (``msg-swap-needs-generation``); None —
+    # the only value a non-following server ships — is omitted from the
+    # wire, so heartbeats stay byte-identical with the subsystem off.
+    weight_round: int | None = None
+    weight_generation: int | None = None
 
 
 @register
 @dataclass(slots=True)
 class ServeLoadAck:
     ok: bool = True
+
+
+@register
+@dataclass(slots=True)
+class WeightFollow:
+    """Live weight streaming config: attach a serving worker to a training
+    job's PS broadcast (hypha_tpu.serving.weight_stream.WeightSubscriber).
+
+    ``results`` is the broadcast Receive reference — the PS shard peers
+    plus, under a broadcast tree, this worker's assigned relay chain (the
+    same allowlist discipline train workers use). The subscriber decodes
+    each round's fragment wires into a staging tree and hot-swaps the
+    decode pool's params only when round ``r`` is COMPLETE and contiguous
+    with what is already applied: the broadcast carries per-round outer
+    UPDATES, not absolute weights, so a skipped round would serve a model
+    that never existed. ``round`` is the outer round the dispatched
+    weights correspond to (folding starts at ``round + 1``) and travels
+    next to ``ps_generation`` (hypha-lint ``msg-generation-needs-round``)
+    — a PS restart resets round accounting per generation.
+    """
+
+    results: Receive | None = None
+    round: int = 0  # the round the dispatched checkpoint/params embody
+    ps_generation: int = 0
+    # Wires to expect per round before the round can swap in. 0 = derive
+    # from each wire's FragmentTag (``fragments`` for tagged wires, 1 for
+    # an untagged single-file broadcast). Stream-staggered jobs broadcast
+    # ONE due fragment per round, so the scheduler pins this to 1 there.
+    fragments: int = 0
+    # Rollback knob: pin serving to this round — later swaps stage but
+    # defer (counted, never applied), and if the pinned round is the
+    # previously applied one it is restored from the retained snapshot.
+    # None (the only value a follow-the-leader config ships) = live.
+    pin_round: int | None = None
+    # Retain the pre-swap fragment leaves so ``pin_round`` can roll back
+    # one round without a re-broadcast. Costs one extra param copy.
+    keep_previous: bool = False
 
 
 @register
@@ -1266,6 +1336,13 @@ class ShardMap:
     # ``round`` (hypha-lint ``msg-tree-needs-round``): a tree placement
     # without its round could re-parent an in-flight partial.
     tree_depth: int | None = None
+    # Live weight streaming: serving peers attached to the broadcast as
+    # LEAVES only — they receive update wires (direct, or via a relay
+    # chosen by ``stream.tree.with_serve_leaves``) but never appear in
+    # ``groups``, so reduce membership / quorum / catch-up accounting
+    # ignore them entirely. None (the only value a train-only job ships)
+    # is omitted from the wire — PR 14's exact bytes.
+    serve_leaves: list | None = None
 
     def __post_init__(self) -> None:
         if self.tags and len(self.tags) != len(self.shards):
@@ -1370,6 +1447,7 @@ declare_protocol(PROTOCOL_HEALTH, "HealthRequest", "HealthResponse")
 declare_protocol(PROTOCOL_PROGRESS, "Progress", "ProgressResponse")
 declare_protocol(PROTOCOL_GENERATE, "GenerateRequest", "GenerateResponse")
 declare_protocol(PROTOCOL_SERVE, "ServeLoad", "ServeLoadAck")
+declare_values("WeightFollow")
 declare_protocol(PROTOCOL_STREAM, "FragmentTag")
 declare_protocol(PROTOCOL_SHARD, "ShardMap")
 declare_protocol(f"gossip:{TOPIC_WORKER}", "RequestWorker")

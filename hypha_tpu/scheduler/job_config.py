@@ -205,6 +205,15 @@ class DiLoCoJob:
     # 250", "round_wall_s <= 30", "hypha.het.quorum_drops == 0",
     # "silent_s <= 15" (grammar: hypha_tpu.telemetry.slo).
     slo_rules: list = field(default_factory=list)
+    # Live weight streaming (hypha_tpu.serving.weight_stream): serving
+    # worker peer ids attached to the update broadcast as extra LEAVES —
+    # they receive every round's wire (directly, or as relay children
+    # under broadcast_tree) but are never round members: reducers don't
+    # wait on them, quorum doesn't count them, elastic membership never
+    # drops or adopts them. Each listed peer runs a WeightSubscriber
+    # (serving.weight_stream.follow_for builds its Receive allowlist).
+    # Empty (default) ships today's exact wire.
+    serve_peers: list = field(default_factory=list)
 
     def __post_init__(self) -> None:
         if self.delta_dtype not in ("float32", "bfloat16"):

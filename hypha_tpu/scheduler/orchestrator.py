@@ -602,6 +602,21 @@ class Orchestrator:
                 # None for single-level plans: PR 6's exact wire bytes.
                 tree_depth=(depth if depth >= 2 else None),
             )
+        # Live weight streaming: serving followers ride the broadcast as
+        # extra leaves. Under a broadcast tree they hang off relay heads
+        # (stream.tree.with_serve_leaves reads serve_leaves from the
+        # announced placement); flat jobs just append them to the PS's
+        # push set via AggregateExecutorConfig.serve_peers below. Never
+        # added to ``groups`` — reducers must not wait on them.
+        serve_peers = [
+            str(p) for p in (getattr(job, "serve_peers", None) or [])
+        ]
+        if (
+            ctx.shard_map is not None
+            and serve_peers
+            and getattr(job, "broadcast_tree", False)
+        ):
+            ctx.shard_map.serve_leaves = list(serve_peers)
         ft = ctx.ft
         ctx.ps_specs = [
             JobSpec(
@@ -674,6 +689,10 @@ class Orchestrator:
                             and ctx.reduce_groups
                             else None
                         ),
+                        # Live weight streaming followers (None = today's
+                        # exact wire; appended AFTER elastic overrides in
+                        # the PS's _broadcast, never round members).
+                        serve_peers=(serve_peers or None),
                         # Durable control plane: the PS parks its Updated
                         # notify (broadcast-first) across a scheduler
                         # outage (None = recovery off, no new wire).

@@ -130,6 +130,7 @@ class ServingSupervisor:
         request_timeout: float = 120.0,
         report_metrics_s: float | None = None,
         metrics=None,
+        serve_follow_rounds=None,
     ) -> None:
         self.node = node
         # Live metrics plane (telemetry.metrics_plane): an optional
@@ -162,7 +163,16 @@ class ServingSupervisor:
                 float(report_metrics_s) if report_metrics_s else None
             ),
             metrics_peer=(node.peer_id if report_metrics_s else None),
+            # Live weight streaming (serving.weight_stream): a WeightFollow
+            # attaching every deployed backend to a training job's PS
+            # broadcast. None (the default) dispatches today's exact config
+            # bytes — the field is omitted from the wire.
+            serve_follow_rounds=serve_follow_rounds,
         )
+        # Last-reported serving (round, generation) per backend name —
+        # observability for the rollout: `weight_rounds()` shows which
+        # backends have converged on the newest broadcast round.
+        self._weight_rounds: dict[str, tuple] = {}
         # Prefix-affinity routing: requests sharing a prompt prefix land
         # on the same backend (where its KV blocks are already cached),
         # unless that backend is materially busier than the best one.
@@ -309,6 +319,11 @@ class ServingSupervisor:
     async def stop(self) -> None:
         self._stop.set()
 
+    def weight_rounds(self) -> dict:
+        """Per-backend serving (round, generation) as last heartbeated —
+        empty until a follow-configured backend applies its first swap."""
+        return dict(self._weight_rounds)
+
     # ------------------------------------------------------------- routing
 
     def _live_backends(self) -> list[_Deployment]:
@@ -427,6 +442,14 @@ class ServingSupervisor:
                 dep.load = load
                 dep.load_at = time.monotonic()
                 self._detector.heartbeat(peer)
+                if load.weight_round is not None:
+                    # Live weight streaming: remember which broadcast round
+                    # each backend is serving (rollout observability; the
+                    # stamps ride the heartbeat only after a first swap).
+                    self._weight_rounds[load.serve_name or peer] = (
+                        load.weight_round,
+                        load.weight_generation,
+                    )
                 if self.metrics is not None:
                     # Live metrics plane: serve queue depths / KV headroom
                     # join the fleet store per backend, so telemetry.top

@@ -1,0 +1,10 @@
+"""Serve-side subsystems that ride a TRAINING job's data plane.
+
+Today: live weight streaming (:mod:`~hypha_tpu.serving.weight_stream`) —
+a serving worker subscribes to the parameter server's per-round update
+broadcast and hot-swaps the decode pool's weights with zero downtime.
+"""
+
+from .weight_stream import WeightStager, WeightSubscriber, follow_for
+
+__all__ = ["WeightStager", "WeightSubscriber", "follow_for"]

@@ -42,6 +42,7 @@ from .tree import (
     subtree_of,
     top_targets,
     tree_levels,
+    with_serve_leaves,
 )
 from .sync import (
     SYNC_MODES,
@@ -74,4 +75,5 @@ __all__ = [
     "subtree_of",
     "top_targets",
     "tree_levels",
+    "with_serve_leaves",
 ]

@@ -618,9 +618,19 @@ class BroadcastRelay:
             raise ValueError("BroadcastRelay needs cfg.ps_shards placement")
         self.node = node
         self.groups = list(getattr(shard_map, "groups", None) or [])
+        # Live weight streaming: serve subscribers ride the SAME downward
+        # fan-out as relay children (with_serve_leaves is pure over the
+        # placement, so this relay and the PS derive one assignment), but
+        # never join self.groups — reduce membership stays train-only.
+        serve = list(getattr(shard_map, "serve_leaves", None) or [])
+        from .tree import with_serve_leaves
+
+        self.bcast_groups = (
+            with_serve_leaves(self.groups, serve) if serve else self.groups
+        )
         ref = cfg.results.ref
         self.results_tag = ref.resource or "results"
-        self.children = children_of(self.groups).get(node.peer_id, [])
+        self.children = children_of(self.bcast_groups).get(node.peer_id, [])
         self._own_dir = work_dir is None
         self.work_dir = Path(
             work_dir
@@ -696,7 +706,7 @@ class BroadcastRelay:
         injected = False
         try:
             await tree_broadcast(
-                self.node, meta, self.results_tag, self.groups,
+                self.node, meta, self.results_tag, self.bcast_groups,
                 self.children, dest, what="relay", logger=log,
             )
             await self.node.inject_push(

@@ -48,6 +48,7 @@ __all__ = [
     "subtree_of",
     "top_targets",
     "tree_levels",
+    "with_serve_leaves",
 ]
 
 
@@ -149,6 +150,39 @@ def top_targets(groups, peers) -> list[str]:
         if p not in parents or not any(a in live_set for a in anc):
             out.append(p)
     return out
+
+
+def with_serve_leaves(groups, serve_leaves) -> list[list[str]]:
+    """The BROADCAST-ONLY plan: ``groups`` with serving subscribers
+    attached as relay children (live weight streaming, PR 16).
+
+    Serve peers consume update wires but never push deltas, so they must
+    stay out of the REDUCE plan (a reducer folding a group that contains
+    one would wait forever); this derives the downward fan-out plan the
+    parameter service and the relays share instead. Each serve leaf is
+    assigned round-robin to a relay head in sorted-head order — a pure
+    function of ``(groups, sorted serve peer ids)``, so the PS's
+    ``top_targets``/``tree_broadcast`` walk and every relay's
+    ``children_of`` slice agree on the assignment with no extra wire.
+    Leaves already present anywhere in ``groups`` are skipped (a peer
+    that trains AND serves already receives every wire); with no relay
+    heads the plan is returned unchanged — callers fall back to direct
+    pushes, exactly the no-tree topology.
+    """
+    base = [list(g) for g in (groups or [])]
+    heads = sorted({str(g[0]) for g in base if len(g) >= 2})
+    members = {str(p) for g in base for p in g}
+    leaves = [
+        p
+        for p in sorted({str(s) for s in (serve_leaves or [])})
+        if p not in members
+    ]
+    if not heads or not leaves:
+        return base
+    by_head = {str(g[0]): g for g in base if len(g) >= 2}
+    for i, leaf in enumerate(leaves):
+        by_head[heads[i % len(heads)]].append(leaf)
+    return base
 
 
 def tree_levels(groups) -> dict[str, int]:

@@ -267,6 +267,10 @@ class ServeMetrics:
       derives ``prefix_hit_rate`` from the hit/miss counters.
     * ``speculation`` — drafted vs accepted tokens per verify dispatch
       and the derived ``spec_accept_rate`` gauge.
+    * ``weight streaming`` — the serving (round, generation) gauges
+      stamped at each hot swap, applied/deferred/rolled-back swap
+      counters, and a stage→flip swap-latency reservoir (same
+      quantile treatment as request latency; what SWAPBENCH asserts).
     """
 
     _RESERVOIR = 2048
@@ -277,6 +281,8 @@ class ServeMetrics:
         self._queue_depth = 0.0
         self._cached_blocks = 0.0
         self._shared_blocks = 0.0
+        self._weight_round = -1.0  # -1 = never swapped (dispatched params)
+        self._weight_generation = -1.0
         self.admissions = Counter("hypha.serve.admissions")
         self.preemptions = Counter("hypha.serve.preemptions")
         self.rejections = Counter("hypha.serve.rejections")
@@ -289,11 +295,45 @@ class ServeMetrics:
         self.spec_proposed = Counter("hypha.serve.spec_proposed")
         self.spec_accepted = Counter("hypha.serve.spec_accepted")
         self.affinity_routed = Counter("hypha.serve.affinity_routed")
+        self.swap_applied = Counter("hypha.serve.swap_applied")
+        self.swap_deferred = Counter("hypha.serve.swap_deferred")
+        self.swap_rolled_back = Counter("hypha.serve.swap_rolled_back")
         self.request_latency_ms = Histogram(
             "hypha.serve.request_latency", unit="ms",
             bounds=(5, 10, 25, 50, 100, 250, 500, 1000, 2500, 5000, 10000),
         )
         self._latencies: list[float] = []
+        self.swap_latency_ms = Histogram(
+            "hypha.serve.swap_latency", unit="ms",
+            bounds=(1, 5, 10, 25, 50, 100, 250, 500, 1000, 2500),
+        )
+        self._swap_latencies: list[float] = []
+
+    def weight_state(self, round_num: float, generation: float) -> None:
+        """The (round, generation) the pool is serving after a swap —
+        last-writer gauges, like pool_state."""
+        with self._lock:
+            self._weight_round = float(round_num)
+            self._weight_generation = float(generation)
+
+    def weight_round(self) -> float:
+        with self._lock:
+            return self._weight_round
+
+    def weight_generation(self) -> float:
+        with self._lock:
+            return self._weight_generation
+
+    def swap_finished(self, latency_ms: float) -> None:
+        """Stage→flip wall time of one applied swap (request_swap to the
+        chunk-boundary application on the serve thread)."""
+        self.swap_latency_ms.record(latency_ms)
+        with self._lock:
+            self._swap_latencies.append(float(latency_ms))
+            if len(self._swap_latencies) > self._RESERVOIR:
+                del self._swap_latencies[
+                    : len(self._swap_latencies) - self._RESERVOIR
+                ]
 
     def pool_state(self, free_blocks: float, queue_depth: float) -> None:
         with self._lock:
@@ -337,9 +377,9 @@ class ServeMetrics:
         with self._lock:
             return self._queue_depth
 
-    def _quantile(self, q: float) -> float:
+    def _quantile(self, q: float, which: str = "_latencies") -> float:
         with self._lock:
-            lat = sorted(self._latencies)
+            lat = sorted(getattr(self, which))
         if not lat:
             return 0.0
         i = min(int(q * len(lat)), len(lat) - 1)
@@ -370,6 +410,14 @@ class ServeMetrics:
             "request_latency_ms_sum": hist["sum"],
             "request_latency_ms_p50": self._quantile(0.50),
             "request_latency_ms_p95": self._quantile(0.95),
+            "weight_round": self.weight_round(),
+            "weight_generation": self.weight_generation(),
+            "swap_applied": self.swap_applied.value(),
+            "swap_deferred": self.swap_deferred.value(),
+            "swap_rolled_back": self.swap_rolled_back.value(),
+            "swap_latency_ms_count": self.swap_latency_ms.snapshot()["count"],
+            "swap_latency_ms_p50": self._quantile(0.50, "_swap_latencies"),
+            "swap_latency_ms_p95": self._quantile(0.95, "_swap_latencies"),
         }
 
     def reset(self) -> None:
@@ -834,6 +882,19 @@ def register_on(
     )
     meter.observable_gauge(
         "hypha.serve.affinity_routed", serve.affinity_routed.value
+    )
+    meter.observable_gauge("hypha.serve.weight_round", serve.weight_round)
+    meter.observable_gauge(
+        "hypha.serve.weight_generation", serve.weight_generation
+    )
+    meter.observable_gauge(
+        "hypha.serve.swap_applied", serve.swap_applied.value
+    )
+    meter.observable_gauge(
+        "hypha.serve.swap_deferred", serve.swap_deferred.value
+    )
+    meter.observable_gauge(
+        "hypha.serve.swap_rolled_back", serve.swap_rolled_back.value
     )
     data = DATA_METRICS
     meter.observable_gauge("hypha.data.input_wait_seconds", data.input_wait_s)

@@ -166,6 +166,15 @@ def _peer_round(snap: dict, peer: str) -> int | None:
     return max(rounds) if rounds else None
 
 
+def _weight_round(gauges: dict, peer: str):
+    """Live weight streaming: the round this peer is SERVING (the
+    ``hypha.serve.weight_round`` gauge). Negative = never swapped —
+    dispatched params, or a peer that isn't serving at all — rendered
+    blank rather than as a misleading -1."""
+    v = (gauges.get(peer) or {}).get("hypha.serve.weight_round")
+    return None if v is None or v < 0 else v
+
+
 def render(snap: dict, now: float | None = None) -> str:
     """One frame: the per-peer table + fleet line + SLO state."""
     now = time.time() if now is None else now
@@ -182,6 +191,7 @@ def render(snap: dict, now: float | None = None) -> str:
         ("down Mb/s", lambda p: (gauges.get(p) or {}).get("node.bandwidth_in_mbps")),
         ("queue", lambda p: (gauges.get(p) or {}).get("hypha.serve.queue_depth")),
         ("blocks", lambda p: (gauges.get(p) or {}).get("hypha.serve.free_blocks")),
+        ("w.round", lambda p: _weight_round(gauges, p)),
         ("silent s", lambda p: (now - last_seen[p]) if p in last_seen else None),
     )
     lines: list[str] = []

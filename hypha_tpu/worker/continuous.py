@@ -86,14 +86,34 @@ class PoolServer:
 
     def load(self) -> dict:
         """The admission-headroom snapshot piggybacked on ServeLoad
-        heartbeats (scheduler.serving router balancing)."""
+        heartbeats (scheduler.serving router balancing). Includes the
+        serving (round, generation) when live weight streaming has ever
+        swapped — None otherwise, so a non-following server's heartbeat
+        wire stays byte-identical (None fields are omitted)."""
+        weight_round, weight_generation = self.pool.weight_state()
         return {
             "queue_depth": self.pool.queue_depth(),
             "free_blocks": self.pool.free_blocks(),
             "live_requests": self.pool.live_rows(),
             "requests": self.requests,
             "rejections": self.rejections,
+            "weight_round": weight_round,
+            "weight_generation": weight_generation,
         }
+
+    def weight_state(self) -> tuple:
+        """(round, generation) currently being SERVED — None pair until
+        the first live-weight swap applies."""
+        return self.pool.weight_state()
+
+    def request_swap(self, updates: dict, **kw: Any) -> None:
+        """Stage a weight delta for the next chunk boundary (live weight
+        streaming passthrough — see DecodePool.request_swap)."""
+        self.pool.request_swap(updates, **kw)
+
+    def pin_round(self, round_num: int | None) -> None:
+        """Pin/unpin serving to a round (rollback knob passthrough)."""
+        self.pool.pin_round(round_num)
 
     async def submit(
         self,

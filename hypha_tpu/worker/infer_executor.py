@@ -112,7 +112,18 @@ class InProcessInferExecutor(JobExecutor):
                             ok=False,
                             retry_after_ms=busy.retry_after_s * 1e3,
                         )
-            return GenerateResponse(tokens=tokens)
+            # Live weight streaming: stamp the serving (round, generation)
+            # the tokens were decoded under — provenance for clients that
+            # pin evals to a round. Follow off (the default) leaves both
+            # None, which the wire omits: today's exact response bytes.
+            wr = wg = None
+            if cfg.serve_follow_rounds is not None and hasattr(
+                batcher, "weight_state"
+            ):
+                wr, wg = batcher.weight_state()
+            return GenerateResponse(
+                tokens=tokens, weight_round=wr, weight_generation=wg
+            )
 
         registration: dict = {}
 
@@ -211,6 +222,26 @@ class InProcessInferExecutor(JobExecutor):
                     max_batch=cfg.max_batch,
                     window_s=cfg.batch_window_ms / 1e3,
                 )
+            if cfg.serve_follow_rounds is not None:
+                # Live weight streaming: subscribe this server to the
+                # training job's PS broadcast and hot-swap the pool at
+                # chunk boundaries. Only the continuous pool has a swap
+                # surface — following on a window/one-shot server is a
+                # config error, reported like any bad geometry.
+                if mode != "continuous":
+                    raise ValueError(
+                        "serve_follow_rounds requires continuous scheduling "
+                        f"(resolved mode is {mode!r})"
+                    )
+                from ..serving.weight_stream import WeightSubscriber
+
+                registration["weights"] = sub = WeightSubscriber(
+                    self.node,
+                    cfg.serve_follow_rounds,
+                    loaded["batcher"].pool,
+                    work_dir=self.work_root / job_id / "weight-stream",
+                )
+                sub.start()
             registration["reg"] = (
                 self.node.on(PROTOCOL_GENERATE, GenerateRequest)
                 .match(lambda m: m.serve_name == cfg.serve_name)
@@ -252,6 +283,8 @@ class InProcessInferExecutor(JobExecutor):
             if registration.get("reg") is not None:
                 registration["reg"].close()
             await aio.reap(registration.get("load"))
+            if registration.get("weights") is not None:
+                await registration["weights"].stop()
             if registration.get("metrics") is not None:
                 await registration["metrics"].stop()
             batcher = self.batchers.pop(job_id, None)
@@ -306,6 +339,10 @@ class InProcessInferExecutor(JobExecutor):
                         live_requests=int(stats["live_requests"]),
                         requests=int(stats["requests"]),
                         rejections=int(stats["rejections"]),
+                        # None until the first live-weight swap (and always
+                        # for non-following servers) — omitted on the wire.
+                        weight_round=stats.get("weight_round"),
+                        weight_generation=stats.get("weight_generation"),
                     ),
                     timeout=max(cfg.load_report_s, 2.0),
                 )

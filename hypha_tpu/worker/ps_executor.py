@@ -99,6 +99,7 @@ from ..stream import (
     placement_parts,
     shard_owns_round,
     top_targets,
+    with_serve_leaves,
 )
 from ..stream.accum import RoundAccum
 from ..stream.reduce import tree_broadcast
@@ -2616,6 +2617,18 @@ class ParameterServerExecutor(JobExecutor):
             # rejoiner joining mid-fan-out gets its catch-up, not this
             # round's update (its catch-up already contains it).
             peers = peers_override
+        # Live weight streaming: serve subscribers join the fan-out HERE —
+        # after the elastic-membership and pipelined-round overrides, both
+        # of which rewrite ``peers`` to the round's train members. Serve
+        # followers are not round members (no quorum, no catch-up
+        # accounting) and must survive every override; under a broadcast
+        # tree they hang off relays via with_serve_leaves below instead of
+        # inflating the PS's own egress.
+        serve_peers = [
+            str(p) for p in (getattr(cfg, "serve_peers", None) or [])
+        ]
+        if serve_peers:
+            peers = list(peers) + [p for p in serve_peers if p not in peers]
         if not peers:
             return
         # Broadcast tree (hypha_tpu.stream.tree): push each wire to the
@@ -2648,10 +2661,20 @@ class ParameterServerExecutor(JobExecutor):
                 else None
             )
             try:
-                targets = top_targets(tree_groups, peers)
+                # Serve leaves attach to relay heads (broadcast-only plan:
+                # the relays derive the identical assignment from their
+                # ShardMap's serve_leaves) — a leaf whose relay is live
+                # drops out of top_targets and rides the relay hop; a
+                # leaf with no live relay stays a direct target.
+                bcast_groups = with_serve_leaves(
+                    tree_groups,
+                    serve_peers
+                    + list(getattr(tree_map, "serve_leaves", None) or []),
+                )
+                targets = top_targets(bcast_groups, peers)
                 delivered, lost = await tree_broadcast(
                     self.node, header, str(header.get("resource", "results")),
-                    tree_groups, targets, update_path,
+                    bcast_groups, targets, update_path,
                     allowed=set(peers),
                     concurrency=_BROADCAST_CONCURRENCY,
                     what="ps tree broadcast", logger=log,

@@ -1,0 +1,32 @@
+"""Seeded fixture pair for hypha-lint's ``msg-swap-needs-generation`` rule.
+
+Deliberately NOT registered with hypha_tpu.messages (registration would
+leak into the live registry other tests lint); tests/test_lint.py passes
+these classes to ``proto_rules.check_swap_tags`` as an explicit registry.
+``SwapBad`` must trip the rule — a weight-swap stamp carrying only the
+round aliases served models across PS restarts (round 7 of generation 2
+is not round 7 of generation 1). ``SwapGood`` is the clean twin: the
+(round, generation) pair travels together.
+"""
+
+# No `from __future__ import annotations`: stringified annotations make
+# dataclasses.fields() resolve against sys.modules[cls.__module__], which
+# an exec'd fixture module is deliberately absent from.
+from dataclasses import dataclass
+
+
+@dataclass(slots=True)
+class SwapBad:
+    """A swap round with NO generation tag: the rule must fire."""
+
+    weight_round: int = 0
+    note: str = ""
+
+
+@dataclass(slots=True)
+class SwapGood:
+    """The full (round, generation) swap stamp: the rule stays quiet."""
+
+    weight_round: int = 0
+    weight_generation: int = 0
+    note: str = ""

@@ -369,6 +369,36 @@ def test_proto_generation_rule_live_registry_clean():
     assert proto_rules.check_generation_tags() == []
 
 
+def test_proto_swap_rule_on_fixture_pair():
+    """The seeded fixture pair: SwapBad (a weight_round stamp with no
+    generation half) fires the rule, clean twin SwapGood (the full
+    (round, generation) pair) stays quiet. Unregistered fixtures,
+    explicit registry."""
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "proto_swap", FIXTURES / "proto_swap.py"
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    bad = proto_rules.check_swap_tags(
+        registry={"SwapBad": mod.SwapBad, "SwapGood": mod.SwapGood}
+    )
+    assert [v.rule for v in bad] == ["msg-swap-needs-generation"]
+    assert "SwapBad" in bad[0].message
+    assert "generation" in bad[0].message
+    assert proto_rules.check_swap_tags(
+        registry={"SwapGood": mod.SwapGood}
+    ) == []
+
+
+def test_proto_swap_rule_live_registry_clean():
+    """The shipping registry satisfies the rule at zero new suppressions:
+    GenerateResponse and ServeLoad carry weight_round NEXT TO
+    weight_generation (the live-weight-streaming stamp pair)."""
+    assert proto_rules.check_swap_tags() == []
+
+
 def test_proto_tree_rule_on_fixture_pair():
     """The seeded fixture pair: TreeBad (tree_depth/parent placement, no
     round tag) fires the rule, clean twin TreeGood stays quiet.

@@ -1,0 +1,498 @@
+"""Live weight streaming (ISSUE 16 tentpole): zero-downtime train→serve
+hot swaps. Covers the stager's contiguous-round assembly, the pool's
+chunk-boundary flip (token-identical to the target model), fold-pending
+semantics, the pin/rollback/roll-forward knob, speculation-state reset,
+generation-stamped prefix-cache invalidation (property test + the
+post-swap-admission pin), the swap metrics surface, and the golden wire
+pins that hold ``serve_follow_rounds`` unset to today's exact bytes."""
+
+from __future__ import annotations
+
+import dataclasses
+import random
+import time
+from types import SimpleNamespace
+
+import jax
+import numpy as np
+import pytest
+
+from hypha_tpu import codec, messages
+from hypha_tpu.executor.block_cache import PrefixBlockCache, chain_hashes
+from hypha_tpu.executor.generate import generate
+from hypha_tpu.executor.pool import DecodePool
+from hypha_tpu.executor.serialization import flat_leaf_map, replace_leaves
+from hypha_tpu.messages import (
+    GenerateResponse,
+    InferExecutorConfig,
+    ServeLoad,
+    WeightFollow,
+)
+from hypha_tpu.models import Llama, LlamaConfig
+from hypha_tpu.serving import WeightStager, follow_for
+from hypha_tpu.stream import with_serve_leaves
+from hypha_tpu.telemetry import SERVE_METRICS
+
+
+@pytest.fixture(scope="module")
+def tiny_llama():
+    cfg = dataclasses.replace(LlamaConfig.tiny(), dtype="float32")
+    model = Llama(cfg)
+    ids = np.zeros((1, 8), np.int32)
+    params = model.init(jax.random.key(0), ids)
+    return model, params, cfg
+
+
+def _ref(model, params, prompt, n_new):
+    return np.asarray(
+        generate(model, params, np.asarray([prompt], np.int32), n_new)
+    )[0].tolist()
+
+
+def _delta(params, seed, scale=0.01):
+    """A full-tree outer update: one small deterministic delta per leaf."""
+    rng = np.random.default_rng(seed)
+    return {
+        name: rng.standard_normal(np.shape(leaf)).astype(np.float32) * scale
+        for name, leaf in flat_leaf_map(params).items()
+    }
+
+
+def _shifted(params, *deltas):
+    """θ0 + Σ deltas as a host-side reference tree."""
+    flat = flat_leaf_map(params)
+    new = {}
+    for name, leaf in flat.items():
+        acc = np.asarray(leaf, np.float32)
+        for d in deltas:
+            if name in d:
+                acc = acc + d[name]
+        new[name] = acc.astype(np.asarray(leaf).dtype)
+    return replace_leaves(params, new)
+
+
+def _wait_round(pool, round_num, timeout=30.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pool.weight_state()[0] == round_num:
+            return
+        time.sleep(0.01)
+    raise AssertionError(
+        f"pool never reached round {round_num} (at {pool.weight_state()})"
+    )
+
+
+# ---------------------------------------------------------------- stager
+
+
+def test_stager_out_of_order_fragments_assemble_contiguously():
+    s = WeightStager(start_round=2)
+    # round 4 lands first; rounds only release once 3 completes.
+    assert s.offer(4, {"a": np.ones(2)}, fragment_id=0, fragments=1) == []
+    assert s.offer(3, {"a": np.ones(2)}, fragment_id=1, fragments=2) == []
+    ready = s.offer(3, {"b": 2 * np.ones(2)}, fragment_id=0, fragments=2)
+    assert [r for r, _ in ready] == [3, 4]
+    assert sorted(ready[0][1]) == ["a", "b"]
+    assert s.applied_round == 4 and s.held_rounds() == []
+
+
+def test_stager_drops_stale_and_resends_overwrite():
+    s = WeightStager(start_round=0)
+    assert [r for r, _ in s.offer(1, {"a": np.ones(2)})] == [1]
+    # A recovered PS re-broadcasting its last committed round is stale.
+    assert s.offer(1, {"a": np.ones(2)}) == []
+    assert s.dropped_stale == 1
+    # A re-send of a STAGED fragment overwrites (idempotent), not folds.
+    assert s.offer(3, {"a": np.ones(2)}, fragment_id=0, fragments=1) == []
+    assert s.offer(3, {"a": 5 * np.ones(2)}, fragment_id=0, fragments=1) == []
+    ready = s.offer(2, {"a": np.ones(2)})
+    assert [r for r, _ in ready] == [2, 3]
+    np.testing.assert_allclose(ready[1][1]["a"], 5 * np.ones(2))
+
+
+def test_stager_generation_change_counts_and_keeps_round_numbering():
+    s = WeightStager(start_round=0, ps_generation=1)
+    s.offer(1, {"a": np.ones(2)}, ps_generation=1)
+    assert s.generation_changes == 0
+    ready = s.offer(2, {"a": np.ones(2)}, ps_generation=2)
+    assert [r for r, _ in ready] == [2]
+    assert s.generation == 2 and s.generation_changes == 1
+
+
+def test_stager_fragments_pin_for_stream_staggered_broadcasts():
+    # Stream mode: ONE due fragment per round, each tagged fragments=4.
+    # Without the pin the stager would wait for 4 wires forever.
+    s = WeightStager(start_round=0, fragments=1)
+    ready = s.offer(1, {"f0": np.ones(2)}, fragment_id=0, fragments=4)
+    assert [r for r, _ in ready] == [1]
+
+
+def test_follow_for_allowlist_is_shards_plus_relay_heads():
+    f = follow_for(
+        "results:job", ["ps1", "ps0"],
+        groups=[["w0", "w1", "w2"], ["w3"]],  # singleton: no relay
+        start_round=7, fragments=1,
+    )
+    assert f.results.ref.peers == ["ps0", "ps1", "w0"]
+    assert f.results.ref.resource == "results:job"
+    assert f.round == 7 and f.fragments == 1
+    # Round-trips like any registered message.
+    assert messages.decode(messages.encode(f)) == f
+
+
+def test_with_serve_leaves_attaches_round_robin_without_touching_groups():
+    groups = [["w0", "w1"], ["w2", "w3"], ["w4"]]
+    out = with_serve_leaves(groups, ["s1", "s0", "w0"])
+    # base groups unchanged (reducers never wait on serve leaves)
+    assert groups == [["w0", "w1"], ["w2", "w3"], ["w4"]]
+    # already-present ids skipped; leaves round-robin over the heads
+    assert out[0] == ["w0", "w1", "s0"]
+    assert out[1] == ["w2", "w3", "s1"]
+    assert out[2] == ["w4"]
+
+
+# ------------------------------------------------------------- pool swap
+
+
+def test_pool_swap_tokens_identical_to_target_model(tiny_llama):
+    """The headline invariant: after the flip, served tokens are exactly
+    what a pool dispatched with θ0+u1 would produce — and before any
+    swap, responses come from the dispatched params unstamped."""
+    model, params, _ = tiny_llama
+    SERVE_METRICS.reset()
+    u1 = _delta(params, seed=1)
+    target = _shifted(params, u1)
+    prompt = [5, 9, 2, 7]
+    n_new = 10
+    before = _ref(model, params, prompt, n_new)
+    after = _ref(model, target, prompt, n_new)
+    pool = DecodePool(
+        model, params, slots=4, max_len=64, steps_per_call=4,
+        block_size=8, num_blocks=24, prefill_chunk=8,
+    )
+    try:
+        assert pool.weight_state() == (None, None)
+        assert pool.submit([list(prompt)], n_new).result(timeout=300) == [
+            before
+        ]
+        pool.request_swap(u1, round_num=1, generation=3)
+        _wait_round(pool, 1)
+        assert pool.weight_state() == (1, 3)
+        assert pool.submit([list(prompt)], n_new).result(timeout=300) == [
+            after
+        ]
+    finally:
+        pool.close()
+    snap = SERVE_METRICS.snapshot()
+    assert snap["swap_applied"] == 1
+    assert snap["weight_round"] == 1.0
+    assert snap["weight_generation"] == 3.0
+    assert snap["swap_latency_ms_count"] == 1
+    assert pool.swaps_applied == 1
+
+
+def test_pool_swap_folds_pending_rounds_never_skips(tiny_llama):
+    """Updates are deltas: rounds staged while the serve thread is busy
+    FOLD (θ0+u1+u2), they don't replace (θ0+u2 is a model no trainer
+    ever held)."""
+    model, params, _ = tiny_llama
+    u1, u2 = _delta(params, seed=11), _delta(params, seed=12)
+    target = _shifted(params, u1, u2)
+    prompt = [3, 1, 4, 1, 5]
+    n_new = 8
+    want = _ref(model, target, prompt, n_new)
+    pool = DecodePool(
+        model, params, slots=2, max_len=64, steps_per_call=4,
+        block_size=8, num_blocks=16, prefill_chunk=8,
+    )
+    try:
+        pool.request_swap(u1, round_num=1)
+        pool.request_swap(u2, round_num=2)
+        _wait_round(pool, 2)
+        assert pool.submit([list(prompt)], n_new).result(timeout=300) == [
+            want
+        ]
+    finally:
+        pool.close()
+
+
+def test_pool_swap_mid_traffic_zero_failures(tiny_llama):
+    """Zero-downtime: requests keep completing while swaps roll — no
+    failed futures, no blocked submissions, every response the full
+    requested length (the closed-loop swapbench asserts the same at
+    scale)."""
+    model, params, _ = tiny_llama
+    pool = DecodePool(
+        model, params, slots=4, max_len=64, steps_per_call=2,
+        block_size=8, num_blocks=32, prefill_chunk=8,
+    )
+    futures = []
+    try:
+        for i in range(12):
+            futures.append(pool.submit([[1 + (i % 7), 2, 3]], 6))
+            if i % 3 == 2:
+                pool.request_swap(
+                    _delta(params, seed=100 + i), round_num=i // 3 + 1
+                )
+        results = [f.result(timeout=300) for f in futures]
+        _wait_round(pool, 4)
+    finally:
+        pool.close()
+    assert all(len(r[0]) == 6 for r in results)
+
+
+def test_pin_round_defers_rolls_back_then_rolls_forward(tiny_llama):
+    """The rollback knob: pin to the previously applied round restores
+    its retained snapshot; staged rounds defer while pinned, and
+    unpinning rolls FORWARD through the rolled-back round (final model is
+    θ0+u1+u2+u3, not θ1+u3)."""
+    model, params, _ = tiny_llama
+    SERVE_METRICS.reset()
+    u1 = _delta(params, seed=21)
+    u2 = _delta(params, seed=22)
+    u3 = _delta(params, seed=23)
+    prompt = [2, 7, 1, 8]
+    n_new = 8
+    at_r1 = _ref(model, _shifted(params, u1), prompt, n_new)
+    at_r3 = _ref(model, _shifted(params, u1, u2, u3), prompt, n_new)
+    pool = DecodePool(
+        model, params, slots=2, max_len=64, steps_per_call=4,
+        block_size=8, num_blocks=16, prefill_chunk=8,
+    )
+    try:
+        pool.request_swap(u1, round_num=1, keep_previous=True)
+        _wait_round(pool, 1)
+        pool.request_swap(u2, round_num=2, keep_previous=True)
+        _wait_round(pool, 2)
+        pool.pin_round(1)  # roll back to the retained round-1 snapshot
+        _wait_round(pool, 1)
+        assert pool.swaps_rolled_back == 1
+        assert pool.submit([list(prompt)], n_new).result(timeout=300) == [
+            at_r1
+        ]
+        pool.request_swap(u3, round_num=3)  # defers while pinned
+        time.sleep(0.2)
+        assert pool.weight_state()[0] == 1
+        assert pool.swaps_deferred >= 1
+        pool.pin_round(None)
+        _wait_round(pool, 3)
+        assert pool.submit([list(prompt)], n_new).result(timeout=300) == [
+            at_r3
+        ]
+    finally:
+        pool.close()
+    assert SERVE_METRICS.snapshot()["swap_rolled_back"] == 1
+    assert SERVE_METRICS.snapshot()["swap_deferred"] >= 1
+
+
+def test_swap_resets_speculation_accept_state(tiny_llama):
+    """Per-lane accept EWMAs were learned under the old weights: a swap
+    re-arms them optimistically and clears the n-gram backoff cooldown
+    (context/index caches stay — emitted tokens are facts)."""
+    model, params, _ = tiny_llama
+    pool = DecodePool(
+        model, params, slots=2, max_len=64, steps_per_call=2,
+        block_size=8, num_blocks=16, prefill_chunk=8,
+        spec_ngram=2, spec_draft=3,
+    )
+    try:
+        row = SimpleNamespace(
+            spec_ctx=[1, 2, 3], spec_ewma=0.1, spec_cooldown=7
+        )
+        cold = SimpleNamespace(spec_ctx=None, spec_ewma=0.0, spec_cooldown=4)
+        pool._lane_rows[98] = row
+        pool._lane_rows[99] = cold
+        pool._reset_spec_state()
+        assert row.spec_ewma == float(pool.spec_draft)
+        assert row.spec_cooldown == 0
+        assert cold.spec_ewma == 0.0  # never speculated: nothing to re-arm
+        assert cold.spec_cooldown == 0
+    finally:
+        pool._lane_rows.clear()
+        pool.close()
+
+
+# --------------------------------------------- prefix-cache generations
+
+
+def test_post_swap_admission_never_maps_pre_swap_chain():
+    """The pin: identical prompt bytes hash identically, but K/V written
+    under the old weights must be a MISS after the swap — lookup and
+    peek both refuse, and the stale block becomes plain free space."""
+    alloc = PrefixBlockCache(8, 2, caching=True)
+    toks = [1, 2, 3, 4]
+    hashes = chain_hashes(toks, 2)
+    table = [alloc.alloc() for _ in hashes]
+    for b, h in zip(table, hashes):
+        alloc.register(b, h)
+    for b in table:
+        alloc.release(b)  # parks in LRU, still addressable
+    assert alloc.peek(hashes)[0] == len(hashes)
+    alloc.bump_generation()
+    assert alloc.peek(hashes) == (0, 0)
+    assert alloc.lookup(hashes) == []
+    assert alloc.stale_drops >= 1
+    alloc.check_conservation([])
+    # Recompute under the new weights: fresh blocks claim the hashes.
+    table2 = [alloc.alloc() for _ in hashes]
+    for b, h in zip(table2, hashes):
+        alloc.register(b, h)
+    assert alloc.lookup(hashes) == table2
+    for b in table2:
+        alloc.release(b)
+        alloc.release(b)
+    alloc.check_conservation([])
+
+
+def test_stale_block_released_by_live_lane_goes_free_not_lru():
+    """A lane that held its blocks ACROSS a swap finishes normally; at
+    ref-0 its stale registration drops and the block frees (parking it
+    in the LRU would just defer the same drop)."""
+    alloc = PrefixBlockCache(4, 2, caching=True)
+    hashes = chain_hashes([5, 6], 2)
+    b = alloc.alloc()
+    alloc.register(b, hashes[0])
+    alloc.bump_generation()  # swap while the lane is mid-decode
+    alloc.release(b)
+    assert not alloc.is_registered(b)
+    assert alloc.stale_drops == 1
+    alloc.check_conservation([])
+    assert alloc.free_count() == 4
+
+
+def test_block_conservation_holds_across_generation_bumps():
+    """The PR 7 property test, swap bumps included: random admit / grow /
+    release / CoW / bump_generation sequences keep every block in
+    exactly one of {free, live table, ref-0 LRU} with exact refcounts
+    and generation stamps in sync with registrations."""
+    rng = random.Random(0x5A9B)
+    for round_ in range(15):
+        nblocks = rng.randint(4, 24)
+        bs = rng.choice([2, 4])
+        alloc = PrefixBlockCache(nblocks, bs, caching=True)
+        lanes: list[list[int]] = []
+        corpus = [
+            [rng.randint(1, 9) for _ in range(rng.randint(1, 6 * bs))]
+            for _ in range(5)
+        ]
+        for _ in range(300):
+            op = rng.random()
+            if op < 0.08:  # live weight swap
+                alloc.bump_generation()
+            elif op < 0.5:  # admit: cached-prefix lookup + fresh alloc
+                toks = rng.choice(corpus)
+                hashes = chain_hashes(toks, bs)
+                want = -(-len(toks) // bs)
+                table = alloc.lookup(hashes)
+                while len(table) < want:
+                    b = alloc.alloc()
+                    if b is None:
+                        break
+                    table.append(b)
+                if len(table) == want:
+                    for j, h in enumerate(hashes):
+                        alloc.register(table[j], h)
+                    lanes.append(table)
+                else:
+                    for b in table:
+                        alloc.release(b)
+            elif op < 0.68 and lanes:  # grow a lane
+                b = alloc.alloc()
+                if b is not None:
+                    rng.choice(lanes).append(b)
+            elif op < 0.9 and lanes:  # finish/preempt
+                for b in lanes.pop(rng.randrange(len(lanes))):
+                    alloc.release(b)
+            else:  # CoW divergence
+                shared = [
+                    (li, bi)
+                    for li, t in enumerate(lanes)
+                    for bi, b in enumerate(t)
+                    if alloc.is_shared(b)
+                ]
+                if shared:
+                    li, bi = rng.choice(shared)
+                    nb = alloc.alloc()
+                    if nb is not None:
+                        alloc.release(lanes[li][bi])
+                        lanes[li][bi] = nb
+            alloc.check_conservation(lanes)
+        for table in lanes:
+            for b in table:
+                alloc.release(b)
+        alloc.check_conservation([])
+        assert alloc.free_count() == nblocks, f"round {round_} leaked"
+
+
+# ----------------------------------------------------- metrics & wire
+
+
+def test_weight_gauges_register_on_meter():
+    from hypha_tpu.telemetry import Telemetry
+    from hypha_tpu.telemetry.ft_metrics import register_on
+
+    telemetry = Telemetry()
+    register_on(telemetry.meter("test"))
+    names = {key[1] for key in telemetry._gauges}
+    for expected in (
+        "hypha.serve.weight_round",
+        "hypha.serve.weight_generation",
+        "hypha.serve.swap_applied",
+        "hypha.serve.swap_deferred",
+        "hypha.serve.swap_rolled_back",
+    ):
+        assert expected in names
+
+
+def test_generate_response_wire_bytes_exact_when_not_following():
+    """serve_follow_rounds unset ships today's exact response bytes: the
+    stamp pair is omitted entirely, not encoded as null."""
+    golden = codec.dumps(
+        {
+            "_t": "GenerateResponse",
+            "tokens": [[1, 2, 3]],
+            "ok": True,
+            "retry_after_ms": 0.0,
+        }
+    )
+    assert messages.encode(GenerateResponse(tokens=[[1, 2, 3]])) == golden
+
+
+def test_serve_load_wire_bytes_exact_when_not_following():
+    golden = codec.dumps(
+        {
+            "_t": "ServeLoad",
+            "job_id": "j1",
+            "serve_name": "svc",
+            "queue_depth": 2,
+            "free_blocks": 9,
+            "live_requests": 1,
+            "requests": 5,
+            "rejections": 0,
+        }
+    )
+    load = ServeLoad(
+        job_id="j1", serve_name="svc", queue_depth=2, free_blocks=9,
+        live_requests=1, requests=5,
+    )
+    assert messages.encode(load) == golden
+
+
+def test_infer_config_wire_omits_follow_when_unset():
+    cfg = InferExecutorConfig(model={"m": 1}, serve_name="svc")
+    plain = messages.to_json_dict(cfg)
+    assert "serve_follow_rounds" not in plain
+    assert b"serve_follow_rounds" not in messages.encode(cfg)
+    on = dataclasses.replace(
+        cfg, serve_follow_rounds=follow_for("results:x", ["ps0"])
+    )
+    assert messages.decode(messages.encode(on)) == on
+
+
+def test_stamped_messages_roundtrip_with_both_halves():
+    resp = GenerateResponse(
+        tokens=[[1]], weight_round=4, weight_generation=2
+    )
+    assert messages.decode(messages.encode(resp)) == resp
+    load = ServeLoad(job_id="j", weight_round=4, weight_generation=2)
+    assert messages.decode(messages.encode(load)) == load
