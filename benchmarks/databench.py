@@ -70,6 +70,7 @@ def run_topology(
     """One orchestrated DiLoCo run; returns walls + DATA_METRICS deltas."""
     from safetensors.numpy import save_file
 
+    from hypha_tpu.aio import wait_quiet
     from hypha_tpu.data_node import DataNode
     from hypha_tpu.ft import ChaosController
     from hypha_tpu.ft.chaos import ChaosAction
@@ -225,17 +226,11 @@ def run_topology(
         finally:
             restart_task.cancel()
             for w in list(workers.values()) + [psw]:
-                try:
-                    await w.stop()
-                except (Exception, asyncio.CancelledError):
-                    pass
+                await wait_quiet(w.stop())
             for d in (data, replacement_data.get("node")):
                 if d is None:
                     continue
-                try:
-                    await d.stop()
-                except (Exception, asyncio.CancelledError):
-                    pass
+                await wait_quiet(d.stop())
             await sched.stop()
             await gw.stop()
         wall_s = time.monotonic() - t0

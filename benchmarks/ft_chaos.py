@@ -101,6 +101,7 @@ def _run_worker_ps_scenario(
     """
     from safetensors.numpy import save_file
 
+    from hypha_tpu.aio import wait_quiet
     from hypha_tpu.data_node import DataNode
     from hypha_tpu.ft import ChaosController, FTConfig, parse_chaos_spec
     from hypha_tpu.gateway import Gateway
@@ -292,10 +293,7 @@ def _run_worker_ps_scenario(
             if replacement_ps.get("node") is not None:
                 stops.append(replacement_ps["node"])
             for w in stops:
-                try:
-                    await w.stop()
-                except (Exception, asyncio.CancelledError):
-                    pass
+                await wait_quiet(w.stop())
             await data.stop()
             await sched.stop()
             await gw.stop()
@@ -450,6 +448,7 @@ def run_scheduler_scenario(
     rounds complete, zero full job restarts, weights bit-equal, added
     wall-clock at most one baseline round + a fixed restart budget.
     """
+    from hypha_tpu.aio import wait_quiet
     from hypha_tpu.data_node import DataNode
     from hypha_tpu.ft import ChaosController, FTConfig, parse_chaos_specs
     from hypha_tpu.gateway import Gateway
@@ -633,20 +632,11 @@ def run_scheduler_scenario(
                 result = await run_task
         finally:
             for w in list(workers.values()) + [psw]:
-                try:
-                    await w.stop()
-                except (Exception, asyncio.CancelledError):
-                    pass
+                await wait_quiet(w.stop())
             for n in stops:
-                try:
-                    await n.stop()
-                except (Exception, asyncio.CancelledError):
-                    pass
+                await wait_quiet(n.stop())
             await data.stop()
-            try:
-                await sched.stop()
-            except (Exception, asyncio.CancelledError):
-                pass
+            await wait_quiet(sched.stop())
             await gw.stop()
         wall_s = time.monotonic() - t0
         fired_at = chaos.fired_at("sched") if chaos is not None else None

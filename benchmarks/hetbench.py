@@ -75,6 +75,7 @@ def run_het_scenario(
     """One orchestrated run; returns the per-run metrics dict."""
     from safetensors.numpy import save_file
 
+    from hypha_tpu.aio import wait_quiet
     from hypha_tpu.data_node import DataNode
     from hypha_tpu.ft import ChaosController, FTConfig, parse_chaos_specs
     from hypha_tpu.gateway import Gateway
@@ -214,10 +215,7 @@ def run_het_scenario(
             )
         finally:
             for w in list(workers.values()) + [psw]:
-                try:
-                    await w.stop()
-                except (Exception, asyncio.CancelledError):
-                    pass
+                await wait_quiet(w.stop())
             await data.stop()
             await sched.stop()
             await gw.stop()

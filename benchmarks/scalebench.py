@@ -316,16 +316,22 @@ async def _bench_scenario(
                     "resource": "updates", "name": f.name, "round": rnd,
                     "num_samples": 1.0,
                 }
-                last: Exception | None = None
-                for target in route:
-                    try:
-                        await node.push(target, header, f)
-                        last = None
-                        break
-                    except (RequestError, OSError) as e:
-                        last = e
-                if last is not None:
-                    raise last
+
+                async def ship_any_once() -> None:
+                    # ANY failover IS the re-attempt policy here: a dead
+                    # hop fails over to the next ancestor immediately,
+                    # with no backoff to skew the scale measurement.
+                    last: Exception | None = None
+                    for target in route:
+                        try:
+                            await node.push(target, header, f)
+                            return
+                        except (RequestError, OSError) as e:
+                            last = e
+                    if last is not None:
+                        raise last
+
+                await ship_any_once()
                 await node.request(
                     "sched", PROTOCOL_PROGRESS,
                     Progress(

@@ -9,7 +9,13 @@ and the suppression syntax):
   * JAX discipline  — host syncs and Python side effects inside jitted
     functions, donated-buffer reuse;
   * protocol schema — every wire message round-trips, carries its FT
-    round/epoch tags, and is claimed by a stream protocol.
+    round/epoch tags, and is claimed by exactly one stream protocol;
+  * whole-program  — a project graph (modules, calls, spawned tasks,
+    handler registrations) built once per run drives the cross-file
+    passes: protocol sender/handler coverage, generation-guard ordering,
+    round-tag provenance, interprocedural blocking/lock reach and
+    spawned-task resource leaks (:mod:`.graph`, :mod:`.flow`,
+    :mod:`.handler_rules`).
 
 Run it as ``python -m hypha_tpu.analysis hypha_tpu/`` (CI and ``make
 lint`` do), or from tests via :func:`lint_paths` / :func:`lint_source`.
@@ -21,17 +27,21 @@ are counted against a repo-wide budget (default
 from .core import (
     DEFAULT_SUPPRESSION_BUDGET,
     RULES,
+    WHOLE_PROGRAM_RULES,
     LintReport,
     Violation,
     lint_paths,
     lint_source,
+    parse_sources,
 )
 
 __all__ = [
     "DEFAULT_SUPPRESSION_BUDGET",
     "RULES",
+    "WHOLE_PROGRAM_RULES",
     "LintReport",
     "Violation",
     "lint_paths",
     "lint_source",
+    "parse_sources",
 ]

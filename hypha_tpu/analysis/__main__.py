@@ -3,14 +3,100 @@
 Exit status 0 only when there are zero unsuppressed violations, zero parse
 errors, AND the inline-suppression count is within budget — CI treats a
 creeping waiver pile the same as a regression.
+
+``--format json`` emits a machine-readable report (rule, path, line,
+message, suppressed flag, plus the suppression/budget accounting and the
+per-protocol coverage table) for the CI artifact.  ``--changed <git-ref>``
+scopes the file-local rules to the files the diff touches while still
+running the whole-program passes over everything — a diff that only edits
+a sender can break an invariant in a handler it never touches.
+``--dump-graph`` prints the call/handler graph (the ``make lint-graph``
+target) instead of linting.
 """
 
 from __future__ import annotations
 
 import argparse
+import json
+import subprocess
 import sys
+from pathlib import Path
 
-from .core import DEFAULT_SUPPRESSION_BUDGET, RULES, lint_paths
+from .core import (
+    DEFAULT_SUPPRESSION_BUDGET,
+    RULES,
+    LintReport,
+    lint_paths,
+    parse_sources,
+)
+
+
+def _changed_files(ref: str) -> set[str] | None:
+    """Resolved paths of ``*.py`` files changed vs ``ref`` (None on git
+    failure — the caller falls back to a full run rather than linting
+    nothing and reporting a false green)."""
+    try:
+        out = subprocess.run(
+            ["git", "diff", "--name-only", "-z", ref, "--", "*.py"],
+            capture_output=True,
+            text=True,
+            timeout=30,
+            check=True,
+        ).stdout
+    except (OSError, subprocess.SubprocessError):
+        return None
+    return {
+        str(Path(name).resolve())
+        for name in out.split("\0")
+        if name.strip()
+    }
+
+
+def _dump_graph(paths: list[str]) -> int:
+    from . import graph
+
+    errors: list[str] = []
+    sources = parse_sources(paths, errors)
+    for err in errors:
+        print(f"PARSE ERROR: {err}", file=sys.stderr)
+    project = graph.build_project(sources, paths)
+    print(graph.dump(project))
+    return 1 if errors else 0
+
+
+def _coverage_table(report: LintReport) -> dict | None:
+    if report.project is None:
+        return None
+    from . import handler_rules
+
+    return handler_rules.coverage(report.project)
+
+
+def _json_report(
+    report: LintReport, budget: int, coverage: dict | None
+) -> str:
+    payload = {
+        "violations": [
+            {
+                "rule": v.rule,
+                "path": v.path,
+                "line": v.line,
+                "message": v.message,
+                "suppressed": v.suppressed,
+            }
+            for v in report.violations
+        ],
+        "parse_errors": list(report.parse_errors),
+        "suppressions": {
+            "sites": list(report.suppression_sites),
+            "used": len(report.suppression_sites),
+            "budget": budget,
+        },
+        "ok": report.ok(budget=budget),
+    }
+    if coverage is not None:
+        payload["protocol_coverage"] = coverage
+    return json.dumps(payload, indent=2, sort_keys=True)
 
 
 def main(argv: list[str] | None = None) -> int:
@@ -37,6 +123,30 @@ def main(argv: list[str] | None = None) -> int:
         help="skip the runtime protocol-schema checks",
     )
     parser.add_argument(
+        "--no-whole-program",
+        action="store_true",
+        help="skip the cross-file passes (graph build + flow/handler rules)",
+    )
+    parser.add_argument(
+        "--format",
+        choices=("text", "json"),
+        default="text",
+        help="output format (json includes the protocol coverage table)",
+    )
+    parser.add_argument(
+        "--changed",
+        metavar="GIT_REF",
+        help=(
+            "scope file-local rules to files changed vs this git ref; "
+            "whole-program passes still run over every path"
+        ),
+    )
+    parser.add_argument(
+        "--dump-graph",
+        action="store_true",
+        help="print the call/handler graph and exit (no linting)",
+    )
+    parser.add_argument(
         "--budget",
         type=int,
         default=DEFAULT_SUPPRESSION_BUDGET,
@@ -50,6 +160,9 @@ def main(argv: list[str] | None = None) -> int:
             print(f"{rule:<{width}}  {desc}")
         return 0
 
+    if args.dump_graph:
+        return _dump_graph(args.paths)
+
     rules = set(args.rules) if args.rules else None
     if rules:
         unknown = rules - set(RULES)
@@ -57,9 +170,27 @@ def main(argv: list[str] | None = None) -> int:
             print(f"unknown rule(s): {', '.join(sorted(unknown))}", file=sys.stderr)
             return 2
 
+    changed_only: set[str] | None = None
+    if args.changed:
+        changed_only = _changed_files(args.changed)
+        if changed_only is None:
+            print(
+                f"hypha-lint: git diff against {args.changed!r} failed; "
+                f"falling back to a full run",
+                file=sys.stderr,
+            )
+
     report = lint_paths(
-        args.paths, rules=rules, protocol_checks=not args.no_proto
+        args.paths,
+        rules=rules,
+        protocol_checks=not args.no_proto,
+        whole_program=not args.no_whole_program,
+        changed_only=changed_only,
     )
+
+    if args.format == "json":
+        print(_json_report(report, args.budget, _coverage_table(report)))
+        return 0 if report.ok(budget=args.budget) else 1
 
     for err in report.parse_errors:
         print(f"PARSE ERROR: {err}")

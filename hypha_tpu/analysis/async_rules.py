@@ -121,6 +121,7 @@ class _AsyncVisitor(ast.NodeVisitor):
         self._func_stack: list[bool] = []  # True = async frame
         self._name_stack: list[str] = []  # enclosing function names
         self._lock_depth = 0
+        self._raises_depth = 0  # inside `with pytest.raises(...)`
 
     # ------------------------------------------------------------- scoping
 
@@ -132,12 +133,14 @@ class _AsyncVisitor(ast.NodeVisitor):
         # A nested function body runs later, not under any lock the
         # enclosing frame currently holds.
         held, self._lock_depth = self._lock_depth, 0
+        raises, self._raises_depth = self._raises_depth, 0
         self._func_stack.append(is_async)
         self._name_stack.append(name)
         self.generic_visit(node)
         self._name_stack.pop()
         self._func_stack.pop()
         self._lock_depth = held
+        self._raises_depth = raises
 
     def visit_FunctionDef(self, node: ast.FunctionDef) -> None:
         self._enter_func(node, False, node.name)
@@ -205,6 +208,19 @@ class _AsyncVisitor(ast.NodeVisitor):
             )
         self.generic_visit(node)
 
+    def visit_With(self, node: ast.With) -> None:
+        expects_failure = any(
+            isinstance(item.context_expr, ast.Call)
+            and (_dotted(item.context_expr.func) or "").rsplit(".", 1)[-1]
+            == "raises"
+            for item in node.items
+        )
+        if expects_failure:
+            self._raises_depth += 1
+        self.generic_visit(node)
+        if expects_failure:
+            self._raises_depth -= 1
+
     # ------------------------------------------------------ lock-held-await
 
     def visit_AsyncWith(self, node: ast.AsyncWith) -> None:
@@ -252,7 +268,9 @@ class _AsyncVisitor(ast.NodeVisitor):
           * ``await aio.retry(lambda: node.push(...), ...)`` — the push in
             a lambda is not awaited, so it never trips this rule;
           * a retry body: a (nested) function whose name ends in ``_once``
-            passed to ``aio.retry`` may await the push directly.
+            passed to ``aio.retry`` may await the push directly;
+          * a push inside ``with pytest.raises(...)`` — the test asserts
+            this exact attempt FAILS, so retrying would defeat it.
         """
         if not isinstance(node.value, ast.Call):
             return
@@ -263,6 +281,8 @@ class _AsyncVisitor(ast.NodeVisitor):
             return
         if any(n.endswith("_once") for n in self._name_stack):
             return  # retry body by convention (passed to aio.retry)
+        if self._raises_depth > 0:
+            return  # the test asserts this push fails; never retry it
         self.violations.append(
             self.src.violation(
                 "naked-stream-push",

@@ -26,11 +26,13 @@ from pathlib import Path
 
 __all__ = [
     "RULES",
+    "WHOLE_PROGRAM_RULES",
     "Violation",
     "LintReport",
     "FileSource",
     "lint_paths",
     "lint_source",
+    "parse_sources",
     "dotted_name",
     "DEFAULT_SUPPRESSION_BUDGET",
 ]
@@ -101,6 +103,43 @@ RULES: dict[str, str] = {
     "msg-unmapped-protocol": (
         "registered wire message not claimed by any stream protocol"
     ),
+    "msg-double-claimed": (
+        "wire message claimed by two+ stream protocols — one frame, two "
+        "dispatch paths; shared payloads belong in declare_values"
+    ),
+    # -- whole-program: protocol conformance --------------------------------
+    "proto-no-sender": (
+        "PROTOCOL_MESSAGES entry never constructed outside its defining "
+        "module — dead wire surface"
+    ),
+    "proto-no-handler": (
+        "PROTOCOL_MESSAGES entry has no handler registration, isinstance/"
+        "match, annotation or requested-reply consumer anywhere"
+    ),
+    "proto-unused-waiver": (
+        "handler_rules.WAIVERS entry matches no declared protocol message"
+    ),
+    "handler-mutates-before-guard": (
+        "handler for a generation-stamped message mutates state before "
+        "comparing generations (zombie traffic lands unfenced)"
+    ),
+    "round-tag-not-live": (
+        "round/epoch kwarg of a wire-message constructor stamped from a "
+        "literal constant, not a live round variable"
+    ),
+    # -- whole-program: interprocedural async hygiene -----------------------
+    "async-blocking-reach": (
+        "async def reaches a blocking call through a chain of sync "
+        "project helpers"
+    ),
+    "lock-held-await-reach": (
+        "await of an async helper that (transitively) performs a network "
+        "round-trip, while holding an asyncio.Lock"
+    ),
+    "task-resource-leak": (
+        "lock/semaphore/file acquired in a spawned task without a `with` "
+        "block or releasing try/finally — leaks on cancellation"
+    ),
     # -- meta ---------------------------------------------------------------
     "unused-suppression": (
         "inline disable comment that waives nothing — delete it, or it "
@@ -109,6 +148,22 @@ RULES: dict[str, str] = {
 }
 
 DEFAULT_SUPPRESSION_BUDGET = 10
+
+# Rules produced by the whole-program CHECK passes (graph/flow/handler
+# families).  The COLLECT phase is skipped entirely when a --rule filter
+# selects none of these.
+WHOLE_PROGRAM_RULES: frozenset[str] = frozenset(
+    {
+        "proto-no-sender",
+        "proto-no-handler",
+        "proto-unused-waiver",
+        "handler-mutates-before-guard",
+        "round-tag-not-live",
+        "async-blocking-reach",
+        "lock-held-await-reach",
+        "task-resource-leak",
+    }
+)
 
 
 def dotted_name(node: ast.AST) -> str | None:
@@ -146,6 +201,9 @@ class LintReport:
     # "path:line" of every inline disable comment seen — the unit the
     # budget is charged in (one comment may waive several findings).
     suppression_sites: list[str] = field(default_factory=list)
+    # The Project graph built by the whole-program passes (None when they
+    # didn't run) — kept so the CLI's coverage table reuses the one parse.
+    project: object | None = None
 
     @property
     def active(self) -> list[Violation]:
@@ -225,36 +283,37 @@ def _iter_py_files(paths: list[str | Path], errors: list[str]) -> list[Path]:
     return files
 
 
-def lint_source(
-    path: str, text: str, rules: set[str] | None = None
-) -> LintReport:
-    """Run the AST rule families over one in-memory source (test entry)."""
+def _file_checks(src: FileSource) -> list[Violation]:
+    """All file-local rule families over one parsed source (unfiltered)."""
     from . import async_rules, jax_rules, trace_rules
 
-    report = LintReport()
-    try:
-        src = FileSource(path, text)
-    except (SyntaxError, ValueError) as e:  # ValueError: e.g. null bytes
-        report.parse_errors.append(f"{path}: {e}")
-        return report
-    found = (
+    return (
         async_rules.check(src) + jax_rules.check(src) + trace_rules.check(src)
     )
-    for v in found:
-        if rules is None or v.rule in rules:
-            report.violations.append(v)
-    # Suppression bookkeeping: every disable comment is a budget site, and
-    # one that waived nothing is itself a violation (a stale marker would
-    # otherwise silently swallow the next finding on its line).  Waived
-    # lines come from the UNFILTERED findings, so a --rule subset can't
-    # misread a legitimately-used marker as stale.
+
+
+def _account_suppressions(
+    src: FileSource,
+    found: list[Violation],
+    rules: set[str] | None,
+    report: LintReport,
+    *,
+    check_unused: bool = True,
+) -> None:
+    """Suppression bookkeeping for one file: every disable comment is a
+    budget site, and one that waived nothing is itself a violation (a stale
+    marker would otherwise silently swallow the next finding on its line).
+    Waived lines come from the UNFILTERED findings, so a --rule subset
+    can't misread a legitimately-used marker as stale."""
     waived_lines = {v.line for v in found if v.suppressed}
     for lineno in sorted(src.suppressions):
-        report.suppression_sites.append(f"{path}:{lineno}")
+        report.suppression_sites.append(f"{src.path}:{lineno}")
+        if not check_unused:
+            continue
         named = src.suppressions[lineno]
         if named and all(r.startswith("msg-") for r in named):
             # Protocol-family waivers are consumed by the runtime checks,
-            # which this per-file pass can't see; only the budget counts.
+            # which the AST passes can't see; only the budget counts.
             continue
         if lineno not in waived_lines and (
             rules is None or "unused-suppression" in rules
@@ -262,7 +321,7 @@ def lint_source(
             report.violations.append(
                 Violation(
                     rule="unused-suppression",
-                    path=path,
+                    path=src.path,
                     line=lineno,
                     message=(
                         "disable comment waives no violation on this line; "
@@ -270,23 +329,37 @@ def lint_source(
                     ),
                 )
             )
+
+
+def lint_source(
+    path: str, text: str, rules: set[str] | None = None
+) -> LintReport:
+    """Run the file-local AST rule families over one in-memory source
+    (test entry; whole-program passes need :func:`lint_paths`)."""
+    report = LintReport()
+    try:
+        src = FileSource(path, text)
+    except (SyntaxError, ValueError) as e:  # ValueError: e.g. null bytes
+        report.parse_errors.append(f"{path}: {e}")
+        return report
+    found = _file_checks(src)
+    for v in found:
+        if rules is None or v.rule in rules:
+            report.violations.append(v)
+    _account_suppressions(src, found, rules, report)
     return report
 
 
-def lint_paths(
-    paths: list[str | Path],
-    *,
-    rules: set[str] | None = None,
-    protocol_checks: bool = True,
-) -> LintReport:
-    """Lint files/directories; optionally run the runtime protocol checks.
+def parse_sources(
+    paths: list[str | Path], errors: list[str]
+) -> list[FileSource]:
+    """Parse every file under ``paths`` exactly once (the COLLECT input).
 
-    ``rules`` filters to a subset of rule ids (None = all).  The protocol
-    family needs the package importable (it inspects the live message
-    registry), so callers linting arbitrary snippets can switch it off.
-    """
-    report = LintReport()
-    for f in _iter_py_files(paths, report.parse_errors):
+    The returned list is the single AST cache for a whole lint run: the
+    file-local families, the project graph, and the whole-program passes
+    all walk these trees — nothing re-parses per rule."""
+    sources: list[FileSource] = []
+    for f in _iter_py_files(paths, errors):
         try:
             # tokenize.open honors PEP 263 coding cookies; a file the
             # decoder rejects must surface as a parse error, not a crash
@@ -294,9 +367,67 @@ def lint_paths(
             with tokenize.open(f) as fh:
                 text = fh.read()
         except (OSError, UnicodeDecodeError, SyntaxError) as e:
-            report.parse_errors.append(f"{f}: {e}")
+            errors.append(f"{f}: {e}")
             continue
-        report.extend(lint_source(str(f), text, rules))
+        try:
+            sources.append(FileSource(str(f), text))
+        except (SyntaxError, ValueError) as e:
+            errors.append(f"{f}: {e}")
+    return sources
+
+
+def lint_paths(
+    paths: list[str | Path],
+    *,
+    rules: set[str] | None = None,
+    protocol_checks: bool = True,
+    whole_program: bool = True,
+    changed_only: set[str] | None = None,
+) -> LintReport:
+    """Two-phase driver: COLLECT (parse once, build the project graph) then
+    CHECK (file-local families + whole-program passes + runtime protocol
+    checks).
+
+    ``rules`` filters to a subset of rule ids (None = all).  The runtime
+    protocol family needs the package importable (it inspects the live
+    message registry), so callers linting arbitrary snippets can switch it
+    off.  ``whole_program`` gates the cross-file passes (graph build +
+    flow/handler rules).  ``changed_only`` (resolved path strings) scopes
+    the FILE-LOCAL rules and the unused-suppression check to those files —
+    the whole-program passes still see every parsed file, because a diff
+    that only touches a sender can break an invariant in a handler it
+    never edits."""
+    report = LintReport()
+    sources = parse_sources(paths, report.parse_errors)
+
+    def in_scope(src: FileSource) -> bool:
+        if changed_only is None:
+            return True
+        return str(Path(src.path).resolve()) in changed_only
+
+    per_file: dict[str, list[Violation]] = {
+        src.path: (_file_checks(src) if in_scope(src) else [])
+        for src in sources
+    }
+    if (
+        whole_program
+        and sources
+        and (rules is None or rules & WHOLE_PROGRAM_RULES)
+    ):
+        from . import flow, graph, handler_rules
+
+        project = graph.build_project(sources, list(paths))
+        report.project = project
+        for v in flow.check(project) + handler_rules.check(project):
+            per_file.setdefault(v.path, []).append(v)
+    for src in sources:
+        found = per_file.get(src.path, [])
+        for v in found:
+            if rules is None or v.rule in rules:
+                report.violations.append(v)
+        _account_suppressions(
+            src, found, rules, report, check_unused=in_scope(src)
+        )
     # The runtime protocol family imports the live message registry; skip
     # it entirely when a --rule filter selects no msg-* rule, so AST-only
     # runs work in minimal environments and don't pay the import.

@@ -1,0 +1,352 @@
+"""Interprocedural async dataflow rules (the whole-program CHECK passes).
+
+The per-file async rules (:mod:`.async_rules`) stop at function
+boundaries: an ``async def`` that calls a sync helper which calls
+``shutil.rmtree`` two hops down starves the event loop exactly like a
+direct call, but no single AST shows it.  These passes walk the
+:class:`~.graph.Project` call graph instead:
+
+  * ``async-blocking-reach``   — a blocking call (the same
+    :data:`~.async_rules.BLOCKING_CALLS` set) reachable from an ``async
+    def`` through one or more *sync* project callees.  Reported at the
+    first-hop call site in the async function, with the full chain in the
+    message.  Direct calls inside the async body stay the per-file rule's
+    territory (``async-blocking-call``) so one defect never double-reports.
+  * ``lock-held-await-reach``  — an ``await helper(...)`` under an
+    ``asyncio.Lock`` where ``helper`` (an async project function, any
+    depth) performs a network round-trip (:data:`~.async_rules
+    .ROUND_TRIP_ATTRS`).  The per-file rule only sees a literal
+    ``await node.request(...)`` under the lock.
+  * ``task-resource-leak``     — a lock/semaphore ``.acquire()`` or bare
+    ``open()`` inside a function that runs as a spawned task
+    (``aio.spawn`` / ``create_task`` edges) with no ``with`` block and no
+    enclosing ``try/finally`` releasing it: when the task is cancelled
+    mid-flight (every chaos kill does this) the resource leaks for the
+    process lifetime — the mid-fan-out lease leaks PRs 11/14 fixed by
+    hand were exactly this shape.
+
+All three respect the standard inline suppression on the reported line.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from .async_rules import BLOCKING_CALLS, ROUND_TRIP_ATTRS
+from .core import Violation, dotted_name
+from .graph import FunctionInfo, Project
+
+__all__ = ["check", "MAX_CHAIN_DEPTH"]
+
+# Call-chain search depth for reachability walks.  Deep enough for the
+# helper-of-a-helper shapes the repo actually grows, bounded so a cycle in
+# the (memoized) walk can never run away.
+MAX_CHAIN_DEPTH = 6
+
+
+# --------------------------------------------------------------------------
+# Reachability memos
+# --------------------------------------------------------------------------
+
+
+def _direct_blocking(fn: FunctionInfo) -> list[tuple[str, int]]:
+    """(blocking call name, line) sites inside this function body only."""
+    out: list[tuple[str, int]] = []
+    for node in ast.walk(fn.node):
+        if isinstance(node, ast.Call):
+            name = dotted_name(node.func)
+            if name in BLOCKING_CALLS:
+                out.append((name, node.lineno))
+    return out
+
+
+def _blocking_closure(
+    project: Project, memo: dict[str, tuple[str, ...] | None]
+) -> None:
+    """memo[qualname] = shortest chain of callee names ending in a blocking
+    call (("helper", "open"),) or None when nothing blocking is reachable.
+
+    Only SYNC functions participate: an async callee awaits, so the event
+    loop keeps breathing — reaching a blocking call *through an async
+    function* is that function's own finding, not its caller's.
+    """
+
+    def visit(q: str, depth: int, seen: frozenset[str]) -> tuple[str, ...] | None:
+        if q in memo:
+            return memo[q]
+        if depth > MAX_CHAIN_DEPTH or q in seen:
+            return None
+        fn = project.functions.get(q)
+        if fn is None or fn.is_async:
+            memo[q] = None
+            return None
+        direct = _direct_blocking(fn)
+        if direct:
+            memo[q] = (direct[0][0],)
+            return memo[q]
+        best: tuple[str, ...] | None = None
+        for callee in fn.calls:
+            sub = visit(callee, depth + 1, seen | {q})
+            if sub is not None:
+                chain = (callee.rsplit(":", 1)[-1],) + sub
+                if best is None or len(chain) < len(best):
+                    best = chain
+        memo[q] = best
+        return best
+
+    for q in project.functions:
+        visit(q, 0, frozenset())
+
+
+def _round_trips(fn: FunctionInfo) -> bool:
+    """Does this (async) function await a network round-trip directly?"""
+    for node in ast.walk(fn.node):
+        if isinstance(node, ast.Await) and isinstance(node.value, ast.Call):
+            name = dotted_name(node.value.func)
+            short = name.rsplit(".", 1)[-1] if name else None
+            if short in ROUND_TRIP_ATTRS:
+                return True
+    return False
+
+
+def _round_trip_closure(
+    project: Project, memo: dict[str, bool]
+) -> None:
+    """memo[qualname] = this async function performs a round-trip await,
+    directly or through async callees."""
+
+    def visit(q: str, depth: int, seen: frozenset[str]) -> bool:
+        if q in memo:
+            return memo[q]
+        if depth > MAX_CHAIN_DEPTH or q in seen:
+            return False
+        fn = project.functions.get(q)
+        if fn is None or not fn.is_async:
+            memo[q] = False
+            return False
+        if _round_trips(fn):
+            memo[q] = True
+            return True
+        result = any(
+            visit(c, depth + 1, seen | {q})
+            for c in fn.calls
+            if project.functions.get(c) is not None
+            and project.functions[c].is_async
+        )
+        memo[q] = result
+        return result
+
+    for q in project.functions:
+        visit(q, 0, frozenset())
+
+
+# --------------------------------------------------------------------------
+# async-blocking-reach
+# --------------------------------------------------------------------------
+
+
+class _AsyncCallSiteVisitor(ast.NodeVisitor):
+    """Call sites inside ONE async function body, skipping nested defs
+    (they have their own FunctionInfo) and tracking lock depth for the
+    interprocedural lock rule."""
+
+    def __init__(self) -> None:
+        self.call_sites: list[tuple[ast.Call, int]] = []  # (node, lock_depth)
+        self._lock_depth = 0
+
+    def visit_FunctionDef(self, node: ast.FunctionDef) -> None:
+        return  # nested def: its own function
+
+    def visit_AsyncFunctionDef(self, node: ast.AsyncFunctionDef) -> None:
+        return
+
+    def visit_Lambda(self, node: ast.Lambda) -> None:
+        return
+
+    def visit_AsyncWith(self, node: ast.AsyncWith) -> None:
+        lockish = any(
+            "lock" in (dotted_name(item.context_expr) or "").lower()
+            or (
+                isinstance(item.context_expr, ast.Call)
+                and "lock" in (dotted_name(item.context_expr.func) or "").lower()
+            )
+            for item in node.items
+        )
+        if lockish:
+            self._lock_depth += 1
+        self.generic_visit(node)
+        if lockish:
+            self._lock_depth -= 1
+
+    def visit_Call(self, node: ast.Call) -> None:
+        self.call_sites.append((node, self._lock_depth))
+        self.generic_visit(node)
+
+
+def _check_async_reach(project: Project) -> list[Violation]:
+    blocking_memo: dict[str, tuple[str, ...] | None] = {}
+    _blocking_closure(project, blocking_memo)
+    rt_memo: dict[str, bool] = {}
+    _round_trip_closure(project, rt_memo)
+
+    out: list[Violation] = []
+    for q, fn in sorted(project.functions.items()):
+        if not fn.is_async:
+            continue
+        mod = project.modules.get(fn.module)
+        if mod is None:
+            continue
+        v = _AsyncCallSiteVisitor()
+        for stmt in getattr(fn.node, "body", []):
+            v.visit(stmt)
+        for call, lock_depth in v.call_sites:
+            raw = dotted_name(call.func)
+            target = project.resolve_callable(mod, raw or "", fn.class_name)
+            if target is None:
+                continue
+            callee = project.functions.get(target)
+            if callee is None:
+                continue
+            if not callee.is_async:
+                chain = blocking_memo.get(target)
+                if chain is not None:
+                    hops = " -> ".join(
+                        (target.rsplit(":", 1)[-1],) + chain
+                    )
+                    out.append(
+                        mod.src.violation(
+                            "async-blocking-reach",
+                            call,
+                            f"async `{q.rsplit(':', 1)[-1]}` reaches "
+                            f"blocking `{chain[-1]}()` through sync "
+                            f"call chain {hops}; offload the helper with "
+                            f"asyncio.to_thread or make the chain async",
+                        )
+                    )
+            elif lock_depth > 0 and rt_memo.get(target, False):
+                out.append(
+                    mod.src.violation(
+                        "lock-held-await-reach",
+                        call,
+                        f"await {raw}(...) while holding an asyncio.Lock: "
+                        f"`{target.rsplit(':', 1)[-1]}` performs a network "
+                        f"round-trip (transitively), so every waiter "
+                        f"stalls on the slowest peer",
+                    )
+                )
+    return out
+
+
+# --------------------------------------------------------------------------
+# task-resource-leak
+# --------------------------------------------------------------------------
+
+_RELEASE_ATTRS = {"release", "close", "unlink", "shutdown"}
+_ACQUIRE_ATTRS = {"acquire"}
+
+
+def _finally_releases(try_node: ast.Try) -> bool:
+    for stmt in try_node.finalbody:
+        for node in ast.walk(stmt):
+            if isinstance(node, ast.Call):
+                name = dotted_name(node.func) or ""
+                if name.rsplit(".", 1)[-1] in _RELEASE_ATTRS:
+                    return True
+    return False
+
+
+class _LeakVisitor(ast.NodeVisitor):
+    """Unprotected acquire()/open() sites inside one spawned-task body."""
+
+    def __init__(self) -> None:
+        self.leaks: list[tuple[ast.Call, str]] = []
+        self._protected = 0  # inside with-items or a releasing try/finally
+
+    def visit_FunctionDef(self, node: ast.FunctionDef) -> None:
+        return  # nested defs run on their own stack
+
+    def visit_AsyncFunctionDef(self, node: ast.AsyncFunctionDef) -> None:
+        return
+
+    def _visit_with(self, node: ast.With | ast.AsyncWith) -> None:
+        # The context expressions THEMSELVES are protected: `with
+        # lock:` / `with open(p) as f:` releases on every exit path.
+        self._protected += 1
+        for item in node.items:
+            self.visit(item.context_expr)
+        self._protected -= 1
+        for stmt in node.body:
+            self.visit(stmt)
+
+    visit_With = _visit_with
+    visit_AsyncWith = _visit_with
+
+    def visit_Try(self, node: ast.Try) -> None:
+        if _finally_releases(node):
+            self._protected += 1
+            for stmt in node.body:
+                self.visit(stmt)
+            self._protected -= 1
+        else:
+            for stmt in node.body:
+                self.visit(stmt)
+        for h in node.handlers:
+            self.visit(h)
+        for stmt in node.orelse + node.finalbody:
+            self.visit(stmt)
+
+    def visit_Call(self, node: ast.Call) -> None:
+        if self._protected == 0:
+            name = dotted_name(node.func) or ""
+            short = name.rsplit(".", 1)[-1]
+            if short in _ACQUIRE_ATTRS and isinstance(node.func, ast.Attribute):
+                self.leaks.append((node, f"{name}()"))
+            elif name == "open":
+                self.leaks.append((node, "open()"))
+        self.generic_visit(node)
+
+
+def _check_task_leaks(project: Project) -> list[Violation]:
+    # Every function reachable as a spawned task (spawn edges, then the
+    # ordinary call closure under them).
+    task_roots = {
+        s for fn in project.functions.values() for s in fn.spawns
+    }
+    entries: set[str] = set()
+    todo = list(task_roots)
+    while todo:
+        q = todo.pop()
+        if q in entries:
+            continue
+        entries.add(q)
+        fn = project.functions.get(q)
+        if fn is None or len(entries) > 4096:
+            continue
+        todo.extend(fn.calls)
+    out: list[Violation] = []
+    for q in sorted(entries):
+        fn = project.functions.get(q)
+        if fn is None:
+            continue
+        mod = project.modules.get(fn.module)
+        if mod is None:
+            continue
+        v = _LeakVisitor()
+        for stmt in getattr(fn.node, "body", []):
+            v.visit(stmt)
+        for call, what in v.leaks:
+            out.append(
+                mod.src.violation(
+                    "task-resource-leak",
+                    call,
+                    f"{what} in task `{q.rsplit(':', 1)[-1]}` (spawned via "
+                    f"aio.spawn/create_task) has no `with` block or "
+                    f"releasing try/finally — a cancellation mid-flight "
+                    f"leaks it for the process lifetime",
+                )
+            )
+    return out
+
+
+def check(project: Project) -> list[Violation]:
+    return _check_async_reach(project) + _check_task_leaks(project)

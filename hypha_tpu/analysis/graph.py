@@ -1,0 +1,466 @@
+"""Whole-program project graph: modules, functions, call edges, task edges.
+
+The per-file rule families (:mod:`.async_rules`, :mod:`.jax_rules`,
+:mod:`.trace_rules`) see one AST at a time, which makes every *cross-file*
+invariant invisible — an ``async def`` reaching ``open()`` through a sync
+helper two hops down, a protocol message constructed in one module and
+handled (or not) in another.  This module is the COLLECT phase of the
+two-phase driver (see :mod:`.core`): every source file is parsed exactly
+once into a :class:`~.core.FileSource`, then indexed into a
+:class:`Project` that the whole-program CHECK passes (:mod:`.flow`,
+:mod:`.handler_rules`) query.
+
+What the project graph knows:
+
+  * **functions** — every ``def``/``async def`` (module-level, methods,
+    nested), keyed by a qualified name ``pkg.mod:Class.fn``;
+  * **call edges** — best-effort static resolution of ``Call`` targets to
+    project functions: bare names (local or ``from mod import name``),
+    dotted module attributes (``mod.fn`` through ``import``/alias), and
+    ``self.method`` within a class;
+  * **task edges** — ``aio.spawn(coro(...))`` / ``asyncio.create_task``
+    arguments and ``aio.retry(fn)`` bodies (including ``lambda:`` bodies)
+    resolve to the function that will run as a background task / retry
+    body, so the async-hygiene passes can reason about code that runs off
+    the registering stack;
+  * **string constants** — module-level ``NAME = "literal"`` assignments
+    (and f-strings over them), so protocol ids like ``PROTOCOL_API`` and
+    ``f"gossip:{TOPIC_WORKER}"`` resolve without importing anything;
+  * **wire dataclasses + manifest** — ``@register``-decorated classes with
+    their field names, and the ``declare_protocol(...)`` /
+    ``declare_values(...)`` manifest, harvested statically so multi-file
+    fixture packages exercise the same code path as the live package.
+
+Resolution is deliberately conservative: an edge is recorded only when the
+target is unambiguous.  The passes built on top treat "no edge" as "no
+information", never as "safe".
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+from pathlib import Path
+
+from .core import FileSource, dotted_name
+
+__all__ = [
+    "FunctionInfo",
+    "ModuleInfo",
+    "Project",
+    "build_project",
+    "SPAWN_CALLS",
+    "RETRY_CALLS",
+]
+
+# Callables that schedule their (coroutine / factory) argument as a
+# background task.  The final dotted segment is matched so both ``spawn``
+# and ``aio.spawn`` / ``hypha_tpu.aio.spawn`` resolve.
+SPAWN_CALLS: frozenset[str] = frozenset(
+    {"spawn", "create_task", "ensure_future"}
+)
+
+# Callables whose first argument is an awaitable FACTORY re-invoked with
+# backoff; a ``lambda: node.push(...)`` body or a ``*_once`` function
+# reference passed here runs as the retry body.
+RETRY_CALLS: frozenset[str] = frozenset({"retry"})
+
+
+@dataclass(slots=True)
+class FunctionInfo:
+    """One ``def``/``async def`` in the project."""
+
+    qualname: str  # "pkg.mod:Class.fn" / "pkg.mod:fn" / "pkg.mod:outer.<locals>.fn"
+    module: str  # module key ("pkg.mod")
+    node: ast.AST  # the FunctionDef / AsyncFunctionDef
+    is_async: bool
+    class_name: str | None = None
+    # Resolved project-internal call edges: qualnames this function calls
+    # directly on its own stack.
+    calls: list[str] = field(default_factory=list)
+    # Qualnames this function schedules as background tasks (aio.spawn /
+    # create_task) — they run later, on their own stack.
+    spawns: list[str] = field(default_factory=list)
+    # Qualnames this function passes to aio.retry as the retry body.
+    retry_bodies: list[str] = field(default_factory=list)
+    # Unresolved call targets (dotted best-effort names), kept for the
+    # graph dump so "why is there no edge" is debuggable.
+    external_calls: list[str] = field(default_factory=list)
+
+
+@dataclass(slots=True)
+class ModuleInfo:
+    key: str  # dotted module key derived from the file path
+    src: FileSource
+    # local alias -> module key or external dotted module name
+    import_modules: dict[str, str] = field(default_factory=dict)
+    # local name -> "module.name" for `from mod import name`
+    import_names: dict[str, str] = field(default_factory=dict)
+    # module-level NAME = "literal" string constants
+    constants: dict[str, str] = field(default_factory=dict)
+
+
+@dataclass(slots=True)
+class Project:
+    modules: dict[str, ModuleInfo] = field(default_factory=dict)
+    functions: dict[str, FunctionInfo] = field(default_factory=dict)
+    # registered wire dataclass name -> set of field names (static harvest
+    # of @register classes; AnnAssign field names only, defaults ignored)
+    wire_classes: dict[str, set[str]] = field(default_factory=dict)
+    # wire class name -> (module key, lineno) of its definition
+    wire_sites: dict[str, tuple[str, int]] = field(default_factory=dict)
+    # protocol id -> tuple of declared message names (static manifest)
+    manifest: dict[str, tuple[str, ...]] = field(default_factory=dict)
+    # names declared nested value vocabulary
+    value_vocab: set[str] = field(default_factory=set)
+
+    # ------------------------------------------------------------ lookups
+
+    def module_for_path(self, path: str) -> ModuleInfo | None:
+        for m in self.modules.values():
+            if m.src.path == path:
+                return m
+        return None
+
+    def resolve_callable(
+        self, mod: ModuleInfo, name: str, class_name: str | None
+    ) -> str | None:
+        """Resolve a dotted call target to a project function qualname."""
+        if not name:
+            return None
+        parts = name.split(".")
+        # self.method / cls.method -> same class, same module
+        if parts[0] in ("self", "cls") and len(parts) == 2 and class_name:
+            q = f"{mod.key}:{class_name}.{parts[1]}"
+            if q in self.functions:
+                return q
+            return None
+        if len(parts) == 1:
+            # local function ...
+            q = f"{mod.key}:{parts[0]}"
+            if q in self.functions:
+                return q
+            # ... or `from mod import name`
+            target = mod.import_names.get(parts[0])
+            if target:
+                tmod, _, tname = target.rpartition(".")
+                key = self._project_module(tmod)
+                if key:
+                    q = f"{key}:{tname}"
+                    if q in self.functions:
+                        return q
+            return None
+        # mod.fn / alias.fn through imports
+        head, fn = ".".join(parts[:-1]), parts[-1]
+        target_mod = mod.import_modules.get(head)
+        if target_mod is None and head in mod.import_names:
+            # `from pkg import mod` lands in import_names
+            target_mod = mod.import_names[head]
+        if target_mod:
+            key = self._project_module(target_mod)
+            if key:
+                q = f"{key}:{fn}"
+                if q in self.functions:
+                    return q
+        return None
+
+    def _project_module(self, dotted: str) -> str | None:
+        """Map an imported dotted module name onto a project module key.
+
+        Matching is by suffix so both absolute (``hypha_tpu.aio``) and the
+        short keys multi-file fixture packages get (``aio``) resolve.
+        """
+        if dotted in self.modules:
+            return dotted
+        want = dotted.split(".")
+        for key in self.modules:
+            have = key.split(".")
+            if have[-len(want):] == want or want[-len(have):] == have:
+                return key
+        return None
+
+    def resolve_constant(self, mod: ModuleInfo, node: ast.AST) -> str | None:
+        """Best-effort compile-time string for a protocol-id expression."""
+        if isinstance(node, ast.Constant) and isinstance(node.value, str):
+            return node.value
+        if isinstance(node, ast.Name):
+            if node.id in mod.constants:
+                return mod.constants[node.id]
+            target = mod.import_names.get(node.id)
+            if target:
+                tmod, _, tname = target.rpartition(".")
+                key = self._project_module(tmod)
+                if key:
+                    return self.modules[key].constants.get(tname)
+            return None
+        if isinstance(node, ast.Attribute):
+            name = dotted_name(node)
+            if name:
+                head, _, tail = name.rpartition(".")
+                target_mod = mod.import_modules.get(head)
+                if target_mod:
+                    key = self._project_module(target_mod)
+                    if key:
+                        return self.modules[key].constants.get(tail)
+            return None
+        if isinstance(node, ast.JoinedStr):
+            out: list[str] = []
+            for part in node.values:
+                if isinstance(part, ast.Constant):
+                    out.append(str(part.value))
+                elif isinstance(part, ast.FormattedValue):
+                    inner = self.resolve_constant(mod, part.value)
+                    if inner is None:
+                        return None
+                    out.append(inner)
+                else:
+                    return None
+            return "".join(out)
+        return None
+
+
+# --------------------------------------------------------------------------
+# Collection
+# --------------------------------------------------------------------------
+
+
+def _module_key(path: str, roots: list[Path]) -> str:
+    """Dotted module key for a file path, relative to the nearest root."""
+    p = Path(path)
+    for root in roots:
+        try:
+            rel = p.resolve().relative_to(root.resolve())
+        except ValueError:
+            continue
+        parts = list(rel.parts)
+        # Name the package after its directory so `from pkg.mod import x`
+        # suffix-matches (`root.name` is the package dir itself when the
+        # caller points at one, e.g. `hypha_tpu/`).
+        prefix = [root.name] if root.is_dir() else []
+        if parts and parts[-1].endswith(".py"):
+            parts[-1] = parts[-1][: -len(".py")]
+        if parts and parts[-1] == "__init__":
+            parts = parts[:-1]
+        key = ".".join(prefix + parts)
+        if key:
+            return key
+    return Path(path).stem
+
+
+def _collect_imports(mod: ModuleInfo) -> None:
+    for node in ast.walk(mod.src.tree):
+        if isinstance(node, ast.Import):
+            for alias in node.names:
+                mod.import_modules[alias.asname or alias.name] = alias.name
+        elif isinstance(node, ast.ImportFrom):
+            base = node.module or ""
+            if node.level:
+                # Relative import: resolve against this module's own
+                # dotted key (level 1 = same package).
+                parts = mod.key.split(".")
+                anchor = parts[: max(len(parts) - node.level, 0)]
+                base = ".".join(anchor + ([node.module] if node.module else []))
+            for alias in node.names:
+                if alias.name == "*":
+                    continue
+                local = alias.asname or alias.name
+                mod.import_names[local] = f"{base}.{alias.name}" if base else alias.name
+
+
+def _collect_constants(mod: ModuleInfo) -> None:
+    for node in mod.src.tree.body:
+        if isinstance(node, ast.Assign) and len(node.targets) == 1:
+            tgt = node.targets[0]
+            if isinstance(tgt, ast.Name):
+                if isinstance(node.value, ast.Constant) and isinstance(
+                    node.value.value, str
+                ):
+                    mod.constants[tgt.id] = node.value.value
+
+
+_REGISTER_DECORATORS = {"register", "messages.register"}
+
+
+def _collect_wire_classes(project: Project, mod: ModuleInfo) -> None:
+    for node in ast.walk(mod.src.tree):
+        if not isinstance(node, ast.ClassDef):
+            continue
+        decorated = any(
+            (dotted_name(d) or dotted_name(getattr(d, "func", None) or d))
+            in _REGISTER_DECORATORS
+            for d in node.decorator_list
+        )
+        if not decorated:
+            continue
+        fields = {
+            stmt.target.id
+            for stmt in node.body
+            if isinstance(stmt, ast.AnnAssign)
+            and isinstance(stmt.target, ast.Name)
+        }
+        project.wire_classes[node.name] = fields
+        project.wire_sites[node.name] = (mod.key, node.lineno)
+
+
+def _collect_manifest(project: Project, mod: ModuleInfo) -> None:
+    for node in ast.walk(mod.src.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        callee = dotted_name(node.func)
+        short = callee.rsplit(".", 1)[-1] if callee else None
+        if short == "declare_protocol" and node.args:
+            proto = project.resolve_constant(mod, node.args[0])
+            if proto is None:
+                continue
+            names = tuple(
+                a.value
+                for a in node.args[1:]
+                if isinstance(a, ast.Constant) and isinstance(a.value, str)
+            )
+            existing = project.manifest.get(proto, ())
+            project.manifest[proto] = tuple(
+                dict.fromkeys(existing + names)
+            )
+        elif short == "declare_values":
+            for a in node.args:
+                if isinstance(a, ast.Constant) and isinstance(a.value, str):
+                    project.value_vocab.add(a.value)
+
+
+class _FunctionCollector(ast.NodeVisitor):
+    """Walk one module, creating FunctionInfos with raw call targets."""
+
+    def __init__(self, project: Project, mod: ModuleInfo) -> None:
+        self.project = project
+        self.mod = mod
+        self._class_stack: list[str] = []
+        self._fn_stack: list[FunctionInfo] = []
+        # (caller FunctionInfo, raw dotted target, kind) resolved in pass 2
+        self.raw_edges: list[tuple[FunctionInfo, str, str]] = []
+
+    def visit_ClassDef(self, node: ast.ClassDef) -> None:
+        self._class_stack.append(node.name)
+        self.generic_visit(node)
+        self._class_stack.pop()
+
+    def _qual(self, name: str) -> str:
+        if self._fn_stack:
+            return f"{self._fn_stack[-1].qualname}.<locals>.{name}"
+        if self._class_stack:
+            return f"{self.mod.key}:{'.'.join(self._class_stack)}.{name}"
+        return f"{self.mod.key}:{name}"
+
+    def _visit_fn(self, node, is_async: bool) -> None:
+        info = FunctionInfo(
+            qualname=self._qual(node.name),
+            module=self.mod.key,
+            node=node,
+            is_async=is_async,
+            class_name=self._class_stack[-1] if self._class_stack else None,
+        )
+        # First definition wins on a name collision (e.g. @overload).
+        self.project.functions.setdefault(info.qualname, info)
+        self._fn_stack.append(info)
+        self.generic_visit(node)
+        self._fn_stack.pop()
+
+    def visit_FunctionDef(self, node: ast.FunctionDef) -> None:
+        self._visit_fn(node, False)
+
+    def visit_AsyncFunctionDef(self, node: ast.AsyncFunctionDef) -> None:
+        self._visit_fn(node, True)
+
+    def visit_Call(self, node: ast.Call) -> None:
+        if self._fn_stack:
+            caller = self._fn_stack[-1]
+            name = dotted_name(node.func)
+            if name:
+                short = name.rsplit(".", 1)[-1]
+                if short in SPAWN_CALLS and node.args:
+                    target = self._task_target(node.args[0])
+                    if target:
+                        self.raw_edges.append((caller, target, "spawn"))
+                elif short in RETRY_CALLS and node.args:
+                    target = self._task_target(node.args[0])
+                    if target:
+                        self.raw_edges.append((caller, target, "retry"))
+                self.raw_edges.append((caller, name, "call"))
+        self.generic_visit(node)
+
+    @staticmethod
+    def _task_target(arg: ast.expr) -> str | None:
+        """The function behind a spawn/retry argument.
+
+        ``spawn(self._loop())`` -> ``self._loop``; ``retry(lambda:
+        node.push(...))`` -> ``node.push``; ``retry(push_once)`` ->
+        ``push_once``.
+        """
+        if isinstance(arg, ast.Call):
+            return dotted_name(arg.func)
+        if isinstance(arg, ast.Lambda):
+            body = arg.body
+            if isinstance(body, ast.Await):
+                body = body.value
+            if isinstance(body, ast.Call):
+                return dotted_name(body.func)
+            return None
+        return dotted_name(arg)
+
+
+def build_project(sources: list[FileSource], roots: list[str | Path]) -> Project:
+    """Index parsed sources into a :class:`Project` (the COLLECT phase)."""
+    project = Project()
+    root_paths = [Path(r) for r in roots]
+    for src in sources:
+        key = _module_key(src.path, root_paths)
+        # Duplicate keys (two roots with an identically-named module) keep
+        # the first; suffix matching tolerates the collision.
+        if key in project.modules:
+            key = f"{key}@{len(project.modules)}"
+        mod = ModuleInfo(key=key, src=src)
+        project.modules[key] = mod
+        _collect_imports(mod)
+        _collect_constants(mod)
+    collectors: list[_FunctionCollector] = []
+    for mod in project.modules.values():
+        _collect_wire_classes(project, mod)
+        _collect_manifest(project, mod)
+        c = _FunctionCollector(project, mod)
+        c.visit(mod.src.tree)
+        collectors.append(c)
+    # Second pass: resolve raw call targets now every function is known.
+    for c in collectors:
+        for caller, raw, kind in c.raw_edges:
+            q = project.resolve_callable(c.mod, raw, caller.class_name)
+            if kind == "call":
+                if q is not None:
+                    caller.calls.append(q)
+                else:
+                    caller.external_calls.append(raw)
+            elif kind == "spawn" and q is not None:
+                caller.spawns.append(q)
+            elif kind == "retry" and q is not None:
+                caller.retry_bodies.append(q)
+    return project
+
+
+def dump(project: Project) -> str:
+    """Human-readable call/handler graph (the ``make lint-graph`` target)."""
+    lines: list[str] = []
+    lines.append(f"# modules: {len(project.modules)}")
+    lines.append(f"# functions: {len(project.functions)}")
+    for q in sorted(project.functions):
+        fn = project.functions[q]
+        mark = "async " if fn.is_async else ""
+        lines.append(f"{mark}{q}")
+        for callee in sorted(set(fn.calls)):
+            lines.append(f"  -> {callee}")
+        for s in sorted(set(fn.spawns)):
+            lines.append(f"  ~> spawn {s}")
+        for r in sorted(set(fn.retry_bodies)):
+            lines.append(f"  ~> retry-body {r}")
+    if project.manifest:
+        lines.append("# protocol manifest (static)")
+        for proto in sorted(project.manifest):
+            lines.append(f"{proto}: {', '.join(project.manifest[proto])}")
+    return "\n".join(lines)

@@ -560,9 +560,11 @@ def check_protocol_map(registry=None, manifest=None, values=None) -> list[Violat
                     ),
                 )
             )
+    proto_claims: dict[str, list[str]] = {}
     for proto, names in manifest.items():
         for n in names:
             claimed.add(n)
+            proto_claims.setdefault(n, []).append(proto)
             if n not in registry:
                 # A stale manifest entry is reported against the manifest's
                 # home module rather than a class (there is no class).
@@ -576,6 +578,32 @@ def check_protocol_map(registry=None, manifest=None, values=None) -> list[Violat
                         ),
                     )
                 )
+    # A message claimed by two+ stream protocols dispatches the same frame
+    # through two handler paths; every message belongs to exactly ONE
+    # protocol (shared payloads go through declare_values).  Before this
+    # check, "claimed" membership alone made a double registration look
+    # covered.
+    for n, protos in sorted(proto_claims.items()):
+        if len(protos) < 2:
+            continue
+        cls = registry.get(n)
+        msg = (
+            f"{n}: claimed by {len(protos)} protocols "
+            f"({', '.join(sorted(protos))}) — a message belongs to exactly "
+            f"one stream protocol; move the shared payload to "
+            f"declare_values"
+        )
+        if cls is not None:
+            out.append(_violation(cls, "msg-double-claimed", msg))
+        else:
+            out.append(
+                Violation(
+                    rule="msg-double-claimed",
+                    path=messages.__file__,
+                    line=1,
+                    message=msg,
+                )
+            )
     for name, cls in sorted(registry.items()):
         if name in claimed:
             continue

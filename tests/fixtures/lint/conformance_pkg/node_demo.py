@@ -1,0 +1,51 @@
+"""Senders and handlers for the covered half of the fixture manifest."""
+
+from wire_demo import (
+    PROTOCOL_DEMO,
+    GhostMsg,
+    PingMsg,
+    ReplyMsg,
+    SilentMsg,
+    StampMsg,
+)
+
+
+async def on_ping(peer, msg):
+    return ReplyMsg(seq=msg.seq)
+
+
+def wire_is_fine(node):
+    node.on(PROTOCOL_DEMO, PingMsg).respond_with(on_ping)
+
+
+async def roundtrip_is_fine(node, seq):
+    return await node.request(PingMsg(seq=seq), PROTOCOL_DEMO)
+
+
+async def ship_silent(node):
+    # Sender evidence only: nothing anywhere consumes SilentMsg.
+    await node.send(SilentMsg(x=1))
+
+
+def peek_ghost(frame):
+    # Consumer evidence only: nothing constructs GhostMsg.
+    return isinstance(frame, GhostMsg)
+
+
+def stamp_literal(payload):
+    # Seeded: the round stamp is a bare literal, not live round state.
+    return StampMsg(round=0, payload=payload)
+
+
+def stamp_const_local(payload):
+    # Seeded: taint-lite — the local is only ever assigned a constant.
+    r = 0
+    return StampMsg(round=r, payload=payload)
+
+
+def stamp_is_fine(current_round, payload):
+    return StampMsg(round=current_round, payload=payload)
+
+
+async def on_stamp_is_fine(peer, msg: StampMsg):
+    return None

@@ -1,0 +1,66 @@
+"""Fixture wire surface for the protocol-conformance pass.
+
+Declares six messages on one protocol; its sibling module
+``node_demo.py`` covers some of them and deliberately leaves the rest
+half-wired, so the whole-program pass has exact seeded findings:
+
+  PingMsg   — sender + registered handler (covered)
+  ReplyMsg  — reply position only, protocol is requested (covered)
+  StampMsg  — sender + annotation consumer, with one bad round stamp
+  OrphanMsg — never used anywhere (no sender AND no handler)
+  SilentMsg — constructed but never consumed (no handler)
+  GhostMsg  — isinstance-consumed but never constructed (no sender)
+"""
+
+
+PROTOCOL_DEMO = "/demo/0.0.1"
+
+
+def register(cls):
+    return cls
+
+
+def declare_protocol(proto, *names):
+    return (proto, names)
+
+
+declare_protocol(
+    PROTOCOL_DEMO,
+    "PingMsg",
+    "ReplyMsg",
+    "StampMsg",
+    "OrphanMsg",
+    "SilentMsg",
+    "GhostMsg",
+)
+
+
+@register
+class PingMsg:
+    seq: int = 0
+
+
+@register
+class ReplyMsg:
+    seq: int = 0
+
+
+@register
+class StampMsg:
+    round: int = 0
+    payload: str = ""
+
+
+@register
+class OrphanMsg:
+    x: int = 0
+
+
+@register
+class SilentMsg:
+    x: int = 0
+
+
+@register
+class GhostMsg:
+    x: int = 0

@@ -1,0 +1,17 @@
+"""Sync helpers for the interprocedural-reach fixtures: the blocking call
+sits two hops below the async caller in ``service.py``, and the
+round-trip helper is async so awaiting it under a lock stalls waiters."""
+
+import shutil
+
+
+def scrub(path):
+    shutil.rmtree(path)
+
+
+def cleanup(path):
+    scrub(path)
+
+
+async def fetch_state(node):
+    return await node.request("/state", "/flow/0.0.1")

@@ -1,0 +1,28 @@
+"""Async entry points over the flow_pkg helpers."""
+
+import asyncio
+
+from helpers import cleanup, fetch_state
+
+
+async def rotate(path):
+    # Seeded: two sync hops to shutil.rmtree starve the event loop.
+    cleanup(path)
+
+
+async def refresh(lock, node):
+    async with lock:
+        # Seeded: the helper round-trips while the lock is held.
+        return await fetch_state(node)
+
+
+async def rotate_is_fine(path):
+    await asyncio.to_thread(cleanup, path)
+
+
+async def refresh_is_fine(lock, node):
+    async with lock:
+        pending = True
+    if pending:
+        return await fetch_state(node)
+    return None

@@ -1,0 +1,43 @@
+"""Handlers for the generation-stamped fixture message."""
+
+from wire_guard import PROTOCOL_GUARD, EpochUpdate
+
+
+class BadState:
+    def __init__(self):
+        self.generation = -1
+        self.latest = ""
+
+    async def on_update(self, peer, msg):
+        # Seeded: the mutation lands before the staleness fence, so a
+        # zombie predecessor's update overwrites live state.
+        self.latest = msg.payload
+        if msg.generation < self.generation:
+            return
+        self.generation = msg.generation
+
+    def wire(self, node):
+        node.on(PROTOCOL_GUARD, EpochUpdate).respond_with(self.on_update)
+
+
+class GoodState:
+    def __init__(self):
+        self.generation = -1
+        self.latest = ""
+
+    async def on_update_is_fine(self, peer, msg):
+        if msg.generation < self.generation:
+            return
+        self.generation = msg.generation
+        self.latest = msg.payload
+
+    def wire_is_fine(self, node):
+        node.on(PROTOCOL_GUARD, EpochUpdate).respond_with(
+            self.on_update_is_fine
+        )
+
+
+async def announce_is_fine(node, gen):
+    await node.request(
+        EpochUpdate(generation=gen, payload="adopt"), PROTOCOL_GUARD
+    )

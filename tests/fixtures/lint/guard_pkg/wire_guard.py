@@ -1,0 +1,23 @@
+"""Fixture wire surface for the generation-guard pass: one
+generation-stamped message, handled twice in ``handlers.py`` — once
+mutating before the staleness fence (seeded), once fencing first."""
+
+
+PROTOCOL_GUARD = "/guard/0.0.1"
+
+
+def register(cls):
+    return cls
+
+
+def declare_protocol(proto, *names):
+    return (proto, names)
+
+
+declare_protocol(PROTOCOL_GUARD, "EpochUpdate")
+
+
+@register
+class EpochUpdate:
+    generation: int = 0
+    payload: str = ""

@@ -1,0 +1,15 @@
+"""Sync helper reached from a spawned task: the bare acquire leaks when
+the task is cancelled between acquire and release (no with block, no
+releasing try/finally on ANY exit path)."""
+
+
+def snapshot(sem, sink):
+    # Seeded: cancellation (or any raise from append) leaks the permit.
+    sem.acquire()
+    sink.append(1)
+    sem.release()
+
+
+def snapshot_is_fine(sem, sink):
+    with sem:
+        sink.append(1)

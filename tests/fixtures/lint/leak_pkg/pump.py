@@ -1,0 +1,35 @@
+"""Spawned-task bodies for the task-resource-leak fixture pair."""
+
+from pipes import snapshot, snapshot_is_fine
+
+
+class Pump:
+    def __init__(self, sem, sink):
+        self._sem = sem
+        self._sink = sink
+
+    def start(self, aio):
+        aio.spawn(self._drain())
+
+    async def _drain(self):
+        # Seeded: unreleased acquire directly in the task body, plus a
+        # second leak one call-hop down in pipes.snapshot.
+        await self._sem.acquire()
+        snapshot(self._sem, self._sink)
+
+
+class SafePump:
+    def __init__(self, sem, sink):
+        self._sem = sem
+        self._sink = sink
+
+    def start_is_fine(self, aio):
+        aio.spawn(self._drain_is_fine())
+
+    async def _drain_is_fine(self):
+        async with self._sem:
+            snapshot_is_fine(self._sem, self._sink)
+        try:
+            await self._sem.acquire()
+        finally:
+            self._sem.release()

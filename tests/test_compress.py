@@ -13,6 +13,7 @@ import numpy as np
 import pytest
 
 from hypha_tpu import native
+from hypha_tpu.aio import retry
 from hypha_tpu.compress import (
     DEFAULT_CHUNK,
     ErrorFeedback,
@@ -415,7 +416,10 @@ def test_ps_round_int8_end_to_end(tmp_path):
 
         async def worker_round(node, f, samples):
             header = {"resource": "updates", "name": "delta", "num_samples": samples}
-            await node.push("ps", header, f)
+            await retry(
+                lambda: node.push("ps", header, f),
+                attempts=3, base_delay=0.05,
+            )
             push = await node.next_push(timeout=10)
             dest = tmp_path / f"update-{node.peer_id}.st"
             await push.save_to(dest)

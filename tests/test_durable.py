@@ -398,11 +398,14 @@ def test_ps_crash_recovery_blocking_bit_equal(tmp_path):
         async def push_delta(node, rnd):
             f = tmp_path / f"d-{label}-{node.peer_id}-{rnd}.st"
             save_file(_round_delta(node.peer_id, rnd), str(f))
-            await node.push(
-                "ps",
-                {"resource": "updates", "name": f.name, "round": rnd,
-                 "num_samples": 8.0 if node.peer_id == "w1" else 4.0},
-                f,
+            await aio.retry(
+                lambda: node.push(
+                    "ps",
+                    {"resource": "updates", "name": f.name, "round": rnd,
+                     "num_samples": 8.0 if node.peer_id == "w1" else 4.0},
+                    f,
+                ),
+                attempts=3, base_delay=0.05,
             )
             return f
 
@@ -653,11 +656,14 @@ def test_recovered_ps_drops_stale_plain_resend(tmp_path):
                 f = tmp_path / f"sd-{node.peer_id}-{rnd}.st"
                 save_file(_round_delta(node.peer_id, rnd), str(f))
                 files[(node.peer_id, rnd)] = f
-            await node.push(
-                "ps",
-                {"resource": "updates", "name": f.name, "round": rnd,
-                 "num_samples": 8.0 if node.peer_id == "w1" else 4.0},
-                f,
+            await aio.retry(
+                lambda: node.push(
+                    "ps",
+                    {"resource": "updates", "name": f.name, "round": rnd,
+                     "num_samples": 8.0 if node.peer_id == "w1" else 4.0},
+                    f,
+                ),
+                attempts=3, base_delay=0.05,
             )
 
         # round 0 completes end to end (committed + broadcast received).

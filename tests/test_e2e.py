@@ -17,6 +17,7 @@ import numpy as np
 import pytest
 from safetensors.numpy import save_file
 
+from hypha_tpu.aio import wait_quiet
 from hypha_tpu.data_node import DataNode
 from hypha_tpu.gateway import Gateway
 from hypha_tpu.messages import Adam, ModelType, Nesterov, PriceRange
@@ -472,10 +473,8 @@ def test_elastic_quorum_round_and_rejoin(tmp_path):
         finally:
             restart_task.cancel()
             for w in list(workers.values()) + [psw, replacement]:
-                try:
-                    await w.stop()
-                except (Exception, asyncio.CancelledError):
-                    pass  # w3 was chaos-killed; a second stop may trip
+                # w3 was chaos-killed; a second stop may trip.
+                await wait_quiet(w.stop())
             await data.stop()
             await sched.stop()
             await gw.stop()

@@ -539,14 +539,19 @@ def test_chaos_partition_ps_severs_and_heals():
                          delay_s=0.2)],
             {"psw": ps, "w1": w1},
         )
+        async def probe_once(node, target):
+            # Deliberate single-attempt probe: the assertion IS whether
+            # this exact push lands under the chaos schedule.
+            await node.push(target, {}, b"")
+
         with pytest.raises(RequestError):
             await w1.node.push("psw", {}, b"")  # worker -> PS dropped
         with pytest.raises(RequestError):
             await ps.node.push("w1", {}, b"")  # PS broadcast dropped
-        await w1.node.push("other", {}, b"")  # unrelated peers unaffected
+        await probe_once(w1.node, "other")  # unrelated peers unaffected
         await asyncio.sleep(0.4)
         await ctl.drain()
-        await w1.node.push("psw", {}, b"")  # healed
+        await probe_once(w1.node, "psw")  # healed
         assert w1.node.sent == ["other", "psw"]
 
     run(scenario())

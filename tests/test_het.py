@@ -445,8 +445,12 @@ def test_bw_cap_throttles_mid_stream(tmp_path):
         workers = {"w1": _FakeWorker(node)}
         actions = parse_chaos_specs("bw-cap:w1:1", "w1")  # 1 Mbit/s
         ChaosController(actions, workers)
+        async def timed_push_once():
+            # Single timed attempt — the bw-cap drain IS the measurement.
+            return await node.push("ps", {"resource": "u"}, payload)
+
         t0 = time.monotonic()
-        n = await node.push("ps", {"resource": "u"}, payload)
+        n = await timed_push_once()
         elapsed = time.monotonic() - t0
         assert n == 65536
         # 0.524 Mbit at 1 Mbit/s ≥ ~0.5 s, and the drain itself saw it.

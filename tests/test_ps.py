@@ -14,6 +14,7 @@ import numpy as np
 import pytest
 
 from hypha_tpu import native
+from hypha_tpu.aio import retry
 
 
 def test_weighted_sum_matches_numpy():
@@ -147,7 +148,10 @@ def test_ps_executor_round(tmp_path):
 
         async def worker_round(node, f, samples):
             header = {"resource": "updates", "name": "delta", "num_samples": samples}
-            await node.push("ps", header, f)
+            await retry(
+                lambda: node.push("ps", header, f),
+                attempts=3, base_delay=0.05,
+            )
             push = await node.next_push(timeout=10)  # the broadcast update
             dest = tmp_path / f"update-{node.peer_id}.st"
             await push.save_to(dest)

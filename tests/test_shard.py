@@ -62,6 +62,7 @@ from hypha_tpu.stream import (
     shard_owns_round,
     shards_due_at,
 )
+from hypha_tpu.aio import retry
 from hypha_tpu.stream.accum import RoundAccum
 
 REPO = Path(__file__).resolve().parent.parent
@@ -262,11 +263,14 @@ def test_group_reducer_partial_and_duplicate_unfold(tmp_path):
         async def push(node, tree, label):
             f = tmp_path / f"{label}.st"
             save_file(tree, str(f))
-            await node.push(
-                "red",
-                {"resource": "u.s0", "name": f.name, "round": 0,
-                 "num_samples": 4.0},
-                f,
+            await retry(
+                lambda: node.push(
+                    "red",
+                    {"resource": "u.s0", "name": f.name, "round": 0,
+                     "num_samples": 4.0},
+                    f,
+                ),
+                attempts=3, base_delay=0.05,
             )
 
         await push(nodes["w1"], d1, "d1")

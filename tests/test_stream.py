@@ -29,6 +29,7 @@ import numpy as np
 import pytest
 from safetensors.numpy import save_file
 
+from hypha_tpu.aio import retry
 from hypha_tpu.stream import (
     effective_fragments,
     fragment_due,
@@ -737,17 +738,20 @@ def test_ps_stream_chaos_kill_worker_mid_fragment(tmp_path):
             delta = {n: full[n] for n in frags[f]}
             fpath = tmp_path / f"{node.peer_id}-d{r}.st"
             save_file(delta, str(fpath))
-            await node.push(
-                "ps",
-                {
-                    "resource": "updates",
-                    "name": f"delta-{r}",
-                    "num_samples": 5.0,
-                    "round": r,
-                    "fragment_id": f,
-                    "fragments": 2,
-                },
-                fpath,
+            await retry(
+                lambda: node.push(
+                    "ps",
+                    {
+                        "resource": "updates",
+                        "name": f"delta-{r}",
+                        "num_samples": 5.0,
+                        "round": r,
+                        "fragment_id": f,
+                        "fragments": 2,
+                    },
+                    fpath,
+                ),
+                attempts=3, base_delay=0.05,
             )
 
         # Round 0: both workers report; then w2 is killed mid-stream.
