@@ -62,16 +62,18 @@ shardbench:
 	JAX_PLATFORMS=cpu $(PYTHON) benchmarks/shardbench.py \
 		--chaos kill-ps --out SHARDBENCH_r08.json
 
-# Paged KV serving r06: the r05 sections (block-granular admission >=1.5x
+# Paged KV serving r07: the r06 sections (block-granular admission >=1.5x
 # concurrency at equal KV memory, late-arrival p50 <=2x under a 4k prompt,
-# routed 2-worker >=1.8x under 100 clients) plus automatic prefix caching
-# (shared-system-prompt TTFT and tok/s >=2x vs the no-cache pool,
-# token-identical) and n-gram speculative decoding (accept rate >0.2,
-# sequential-step speedup >=1.3x, token-identical). Writes
-# SERVBENCH_<round>.json — the --round tag keeps re-runs from overwriting
-# older artifacts (docs/serving.md / docs/performance.md).
+# routed 2-worker >=1.8x under 100 clients, prefix-cache TTFT and tok/s
+# >=2x, n-gram speculation step-speedup >=1.3x) plus ragged paged
+# attention (speedup monotone in falling occupancy, >=1.5x at 25%), int8
+# KV blocks (>=2x concurrent lanes at equal cache bytes, bounded logits
+# delta) and model-draft speculation (beats n-gram on accept rate and
+# step speedup on low-repetition traffic). Writes SERVBENCH_<round>.json
+# — the --round tag keeps re-runs from overwriting older artifacts
+# (docs/serving.md / docs/performance.md).
 servbench:
-	JAX_PLATFORMS=cpu $(PYTHON) benchmarks/servbench.py --round r06
+	JAX_PLATFORMS=cpu $(PYTHON) benchmarks/servbench.py --round r07
 
 # Seconds-scale servbench for CI (tiny sections, same assertions with
 # smoke-adjusted floors).

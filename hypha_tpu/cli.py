@@ -303,6 +303,9 @@ async def _run_scheduler(conf: SchedulerConfig) -> None:
                 pool_prefix_cache=conf.job.serve_prefix_cache,
                 pool_spec_ngram=conf.job.serve_spec_ngram,
                 pool_spec_draft=conf.job.serve_spec_draft,
+                pool_ragged=conf.job.serve_ragged,
+                pool_kv_quant=conf.job.serve_kv_quant,
+                pool_spec_layers=conf.job.serve_spec_layers,
                 prefix_affinity=conf.job.serve_prefix_affinity,
                 eos_token_id=(
                     None

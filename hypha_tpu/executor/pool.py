@@ -177,6 +177,29 @@ class _Group:
 
 
 @dataclass
+class SpeculationState:
+    """ONE per-lane speculation state shared by every proposer (n-gram
+    prompt-lookup and the model draft): whichever path proposed, the
+    verify's accept count feeds the same EWMA and the same cooldown, so
+    a lane backs off the *verify dispatch* — not one proposer — when
+    drafts keep missing, and a weight swap re-arms both paths at once
+    (:meth:`DecodePool._reset_spec_state`). Splitting this per-proposer
+    was the bug: the model-draft path inherited a stale n-gram EWMA
+    learned under old weights (or vice versa) and sat out verifies the
+    new model would have won."""
+
+    # n-gram context + position index: incrementally maintained
+    # (O(1) amortized per token instead of an O(len) rescan per
+    # iteration). The model draft reads ``ctx`` too when present.
+    ctx: Any = None  # list, extended from emitted lazily
+    index: Any = None  # tuple[n-gram] -> ascending positions
+    indexed: int = 0
+    ewma: float = 0.0  # accepted drafts per verify, smoothed
+    cooldown: int = 0  # iterations to sit out after low accepts
+    primed: bool = False  # ewma initialized (first proposal happened)
+
+
+@dataclass
 class _PRow:
     """One prompt's state in the PAGED pool. Survives preemption: ``prompt``
     and ``emitted`` persist, the lane/window/block state is rebuilt at
@@ -201,14 +224,8 @@ class _PRow:
     # recurrence) — decode extends the chain incrementally.
     hashed: int = 0
     chain_h: int = 0
-    # speculation state: incrementally maintained context + n-gram
-    # position index (O(1) amortized per token instead of an O(len)
-    # rescan per iteration), and the accept-rate backoff.
-    spec_ctx: Any = None  # list, extended from emitted lazily
-    spec_index: Any = None  # tuple[n-gram] -> ascending positions
-    spec_indexed: int = 0
-    spec_ewma: float = 0.0  # accepted drafts per verify, smoothed
-    spec_cooldown: int = 0  # iterations to sit out after low accepts
+    # shared speculation state (n-gram AND model draft — see dataclass)
+    spec: SpeculationState = field(default_factory=SpeculationState)
 
 
 # Serve-loop wake sentinel (request_swap/pin_round): drained and dropped —
@@ -243,6 +260,11 @@ class DecodePool:
         prefix_cache: bool = False,
         spec_ngram: int = 0,
         spec_draft: int = 0,
+        ragged: bool = False,
+        kv_quant: str = "",
+        spec_layers: int = 0,
+        draft_model: Any = None,
+        draft_params: Any = None,
     ) -> None:
         if not supports_pool(model):
             raise ValueError(
@@ -255,6 +277,27 @@ class DecodePool:
             raise ValueError(
                 "speculative decoding requires paged mode (block_size > 0)"
             )
+        if (ragged or kv_quant) and not self._paged:
+            raise ValueError(
+                "ragged / kv_quant require paged mode (block_size > 0)"
+            )
+        if kv_quant not in ("", "int8"):
+            raise ValueError(f"unknown kv_quant {kv_quant!r}")
+        if (spec_layers > 0 or draft_model is not None) and not self._paged:
+            raise ValueError(
+                "model-draft speculation requires paged mode (block_size > 0)"
+            )
+        if spec_layers > 0 and draft_model is not None:
+            raise ValueError("spec_layers and draft_model are exclusive")
+        if draft_model is not None and draft_params is None:
+            raise ValueError("draft_model requires draft_params")
+        if spec_layers > 0:
+            n_layers = getattr(getattr(model, "config", None), "num_layers", 0)
+            if not 0 < spec_layers < n_layers:
+                raise ValueError(
+                    f"spec_layers {spec_layers} must be in (0, "
+                    f"{n_layers}) for this model"
+                )
         if self._paged:
             if not supports_paging(model):
                 raise ValueError(
@@ -290,6 +333,32 @@ class DecodePool:
         self.prefill_chunk = prefill_chunk if self._paged else 0
         self.prefix_cache = bool(prefix_cache)
         self.spec_ngram = int(spec_ngram) if self._paged else 0
+        self.ragged = bool(ragged) and self._paged
+        self.kv_quant = kv_quant if self._paged else ""
+        # Model-draft speculation: either an explicit small family member
+        # (draft_model/draft_params) or LayerSkip-style self-draft — the
+        # first ``spec_layers`` layers of the SERVED params plus the
+        # shared embed/norm/head, filtered lazily from the live var tree
+        # so weight swaps propagate to the draft for free.
+        self.spec_layers = int(spec_layers) if self._paged else 0
+        self._draft_params = (
+            draft_params if isinstance(draft_params, dict)
+            and "params" in draft_params
+            else ({"params": draft_params} if draft_params is not None
+                  else None)
+        )
+        if draft_model is not None:
+            self._draft_model = draft_model
+        elif self.spec_layers > 0:
+            self._draft_model = dataclasses.replace(
+                model,
+                config=dataclasses.replace(
+                    model.config, num_layers=self.spec_layers
+                ),
+            )
+        else:
+            self._draft_model = None
+        self.spec_model = self._draft_model is not None
         # Draft tokens per verify dispatch: the verify window holds the
         # current token + drafts, so at most prefill_chunk - 1 fit.
         if self._paged:
@@ -297,10 +366,19 @@ class DecodePool:
             self.spec_draft = min(spec_draft, cap) if spec_draft > 0 else cap
         else:
             self.spec_draft = 0
+        # Model-draft forward window: the draft runs cache-less causal
+        # forwards over a static [1, W] buffer (context tail + grown
+        # draft) — small by design; correctness is the verify's job.
+        self._draft_window = min(max_len, 64) if self._paged else 0
+        self._draft_fn = None
         self._model = model
         dec_kw = dict(decode=True, decode_len=max_len, per_row_decode=True)
         if self._paged:
             dec_kw.update(kv_blocks=num_blocks, kv_block_size=block_size)
+            if self.ragged:
+                dec_kw.update(ragged_attention=True)
+            if self.kv_quant:
+                dec_kw.update(kv_quant=self.kv_quant)
         self._dec = dataclasses.replace(model, **dec_kw)
         if isinstance(params, dict) and "params" in params:
             self._vars = dict(params)
@@ -507,11 +585,14 @@ class DecodePool:
         OLD weights: re-arm every lane optimistically instead of letting
         a stale low EWMA park it on plain decode after the model improved
         (tokens are greedy-verified either way — throughput only). The
+        state is the SHARED n-gram + model-draft record, so a swap
+        re-arms both proposers — a self-draft built from the new weights
+        must not inherit an accept rate the old weights earned. The
         context/index caches stay: emitted tokens are facts."""
         for r in self._lane_rows.values():
-            if r.spec_ctx is not None:
-                r.spec_ewma = float(self.spec_draft)
-            r.spec_cooldown = 0
+            if r.spec.primed:
+                r.spec.ewma = float(self.spec_draft)
+            r.spec.cooldown = 0
 
     def _apply_swap(self) -> None:
         """Serve-thread only: flip ``self._vars`` to the staged round (or
@@ -1062,12 +1143,15 @@ class DecodePool:
         self._admit_paged()
         drafts: dict = {}
         spec: list = []
-        if self.spec_ngram > 0:
+        speculating = self.spec_ngram > 0 or self.spec_model
+        if speculating:
             for r in self._lane_rows.values():
                 if r.pos < r.window or r.done:
                     continue
                 d = self._propose(r)
-                if d:
+                # None = no proposal (decode chunk); [] = zero-draft
+                # verify (the budget-edge final token, see _propose).
+                if d is not None:
                     spec.append(r)
                     drafts[id(r)] = d
         pre = [r for r in self._lane_rows.values() if r.pos < r.window]
@@ -1075,6 +1159,13 @@ class DecodePool:
             self._run_prefill_chunk(pre, spec, drafts)
             self._finish_paged()
         specced = {id(r) for r in spec}
+        if speculating:
+            # A lane that completed prefill THIS step hasn't been seen by
+            # the proposal loop yet — hold it out of this step's decode
+            # chunk so its first generation step can be a verify (matters
+            # at the budget edge: a 2-token request ships entirely as
+            # prefill + zero-draft verify, never paying a decode chunk).
+            specced |= {id(r) for r in pre}
         dec = [
             r
             for r in self._lane_rows.values()
@@ -1174,7 +1265,42 @@ class DecodePool:
                     )
             SERVE_METRICS.admissions.add(1)
 
-    def _propose(self, r: _PRow) -> list:
+    def _propose(self, r: _PRow) -> "list | None":
+        """Draft tokens for one verify dispatch, or ``None`` for a plain
+        decode chunk. The n-gram proposer runs first (free — host-side
+        lookup), the model draft backs it up on traffic the prompt can't
+        predict; both sit behind ONE cooldown/EWMA gate (``r.spec``), so
+        accept-rate backoff is a property of the lane, not the proposer.
+
+        Budget edge: a verify dispatch emits drafts + 1 bonus token, so
+        drafts cap one short of the remaining budget. At exactly ONE
+        remaining token that cap is zero — but the verify program still
+        emits the bonus token, so the final token of every speculating
+        row ships as a zero-draft verify (``[]``, one prefill-shaped
+        dispatch) instead of dragging the whole pool through a K-step
+        decode chunk for one kept token. ``[]`` bypasses the cooldown
+        gate (nothing is being speculated) and skips the EWMA update in
+        the verifier — it must neither cost a proposal nor count as one.
+        Both proposer paths share this boundary by construction: it is
+        decided before either runs."""
+        remaining = r.budget - len(r.emitted)
+        cap = min(self.spec_draft, remaining - 1)
+        if remaining == 1 and self.spec_draft > 0:
+            return []
+        if cap <= 0:
+            return None
+        if r.spec.cooldown > 0:
+            r.spec.cooldown -= 1
+            return None
+        if not r.spec.primed:
+            r.spec.primed = True
+            r.spec.ewma = float(self.spec_draft)  # start optimistic
+        d = self._propose_ngram(r, cap) if self.spec_ngram > 0 else None
+        if d is None and self.spec_model:
+            d = self._propose_model(r, cap)
+        return d
+
+    def _propose_ngram(self, r: _PRow, cap: int) -> "list | None":
         """Prompt-lookup drafting (n-gram speculation, no draft model):
         find an earlier occurrence of the context's final ``spec_ngram``
         tokens and propose the tokens that followed it — repetitive
@@ -1185,48 +1311,104 @@ class DecodePool:
         occurrence adjacent to the tail always matches trivially but has
         almost nothing to copy. Lookup is O(log occurrences) over an
         incrementally maintained position index; lanes whose drafts keep
-        missing back off to plain decode chunks (``spec_cooldown``), so
+        missing back off to plain decode chunks (``spec.cooldown``), so
         low-repetition traffic floors at the non-speculative pool."""
         import bisect
 
         n = self.spec_ngram
-        remaining = r.budget - len(r.emitted)
-        # A verify dispatch emits drafts + 1 bonus token, so cap drafts
-        # one short of the remaining budget; with <= 1 token remaining a
-        # plain decode chunk finishes the row.
-        cap = min(self.spec_draft, remaining - 1)
-        if cap <= 0:
-            return []
-        if r.spec_cooldown > 0:
-            r.spec_cooldown -= 1
-            return []
-        # Extend the cached context + n-gram index by the tokens emitted
-        # since the last call (amortized O(1) per token).
-        if r.spec_ctx is None:
-            r.spec_ctx = list(r.prompt)
-            r.spec_index = {}
-            r.spec_indexed = 0
-            r.spec_ewma = float(self.spec_draft)  # start optimistic
-        base = len(r.prompt)
-        if len(r.spec_ctx) - base < len(r.emitted):
-            r.spec_ctx.extend(r.emitted[len(r.spec_ctx) - base :])
-        ctx = r.spec_ctx
+        ctx = self._spec_ctx(r)
         if len(ctx) <= n:
-            return []
+            return None
         # Index interior positions only (i <= len-n-1): the tail's own
         # position must not match itself. Positions append in ascending
         # order, so each bucket stays sorted for the bisect below.
-        for i in range(r.spec_indexed, len(ctx) - n):
-            r.spec_index.setdefault(tuple(ctx[i : i + n]), []).append(i)
-        r.spec_indexed = max(r.spec_indexed, len(ctx) - n)
-        positions = r.spec_index.get(tuple(ctx[-n:]))
+        for i in range(r.spec.indexed, len(ctx) - n):
+            r.spec.index.setdefault(tuple(ctx[i : i + n]), []).append(i)
+        r.spec.indexed = max(r.spec.indexed, len(ctx) - n)
+        positions = r.spec.index.get(tuple(ctx[-n:]))
         if not positions:
-            return []
+            return None
         # Largest i with a full window (i + n + cap <= len), else the
         # leftmost occurrence.
         k = bisect.bisect_right(positions, len(ctx) - n - cap) - 1
         best = positions[k] if k >= 0 else positions[0]
-        return ctx[best + n : best + n + cap]
+        return ctx[best + n : best + n + cap] or None
+
+    def _spec_ctx(self, r: _PRow) -> list:
+        """The lane's token context (prompt + emitted), cached and
+        extended incrementally — shared by both proposers."""
+        if r.spec.ctx is None:
+            r.spec.ctx = list(r.prompt)
+            r.spec.index = {}
+            r.spec.indexed = 0
+        base = len(r.prompt)
+        if len(r.spec.ctx) - base < len(r.emitted):
+            r.spec.ctx.extend(r.emitted[len(r.spec.ctx) - base :])
+        return r.spec.ctx
+
+    def _draft_vars(self) -> dict:
+        """Variables for the draft forward. Explicit draft params are
+        static; the self-draft (``spec_layers``) filters the LIVE served
+        tree on every call — host-side dict surgery over aliased device
+        arrays, so an applied weight swap reaches the draft at the very
+        next proposal with no copy and no staleness window."""
+        if self._draft_params is not None:
+            return self._draft_params
+        keep = {}
+        for k, v in self._vars["params"].items():
+            if k.startswith("layers_"):
+                try:
+                    if int(k[7:]) >= self.spec_layers:
+                        continue
+                except ValueError:
+                    pass
+            keep[k] = v
+        return {"params": keep}
+
+    def _draft_forward(self):
+        """Jitted cache-less draft forward: [1, W] tokens -> per-column
+        greedy argmax. ONE static shape for the pool's lifetime."""
+        if self._draft_fn is not None:
+            return self._draft_fn
+        dmodel = self._draft_model
+
+        def fwd(variables, toks):
+            out = dmodel.apply(variables, toks)
+            logits = out[0] if isinstance(out, tuple) else out  # MoE aux
+            return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+
+        self._draft_fn = jax.jit(fwd)
+        return self._draft_fn
+
+    def _propose_model(self, r: _PRow, cap: int) -> "list | None":
+        """Model-draft proposal: grow ``cap`` draft tokens by running the
+        draft model's cache-less causal forward over a static [1, W]
+        window holding the context tail, appending its greedy next token
+        each step. The window truncates long contexts and restarts RoPE
+        positions at 0 — that only costs accept rate; every emitted
+        token still comes from the verify program, so correctness is
+        position-exact regardless of what the draft saw."""
+        W = self._draft_window
+        cap = min(cap, W - 1)
+        if cap <= 0:
+            return None
+        ctx = self._spec_ctx(r)
+        L = max(min(len(ctx), W - cap), 1)
+        buf = np.zeros((1, W), np.int32)
+        buf[0, :L] = ctx[-L:]
+        fwd = self._draft_forward()
+        variables = self._draft_vars()
+        draft = []
+        pos = L
+        for _ in range(cap):
+            step = fwd(variables, jnp.asarray(buf))
+            nxt = int(np.asarray(step)[0, pos - 1])
+            draft.append(nxt)
+            if pos >= W:
+                break
+            buf[0, pos] = nxt
+            pos += 1
+        return draft or None
 
     def _register_lane(self, r: _PRow) -> None:
         """Register ``r``'s newly FULL blocks in the prefix cache: a
@@ -1376,18 +1558,24 @@ class DecodePool:
             got = d[:a] + [int(row[a])]
             r.emitted.extend(got[: r.budget - len(r.emitted)])
             r.pos += a + 1
-            SERVE_METRICS.spec_proposed.add(len(d))
-            SERVE_METRICS.spec_accepted.add(a)
-            # Accept-rate backoff: a verify averaging < 1 accepted draft
-            # is worse than a decode chunk in every regime (1 token per
-            # wide dispatch vs K per chunk). Lanes whose drafts keep
-            # missing sit out 8 iterations of plain decode, then retry
-            # fresh — incidental n-gram repeats in low-repetition
-            # traffic cannot pin a lane to the verify path.
-            r.spec_ewma = 0.5 * r.spec_ewma + 0.5 * a
-            if r.spec_ewma < 1.0:
-                r.spec_cooldown = 8
-                r.spec_ewma = float(self.spec_draft)  # optimism on retry
+            if d:
+                SERVE_METRICS.spec_proposed.add(len(d))
+                SERVE_METRICS.spec_accepted.add(a)
+                # Accept-rate backoff: a verify averaging < 1 accepted
+                # draft is worse than a decode chunk in every regime (1
+                # token per wide dispatch vs K per chunk). Lanes whose
+                # drafts keep missing sit out 8 iterations of plain
+                # decode, then retry fresh — incidental repeats in
+                # low-repetition traffic cannot pin a lane to the verify
+                # path. One EWMA per LANE: n-gram and model drafts feed
+                # it alike (SpeculationState). A zero-draft budget-edge
+                # verify (d == []) skips this block entirely — it
+                # proposed nothing, so it must not count as a hit or a
+                # miss.
+                r.spec.ewma = 0.5 * r.spec.ewma + 0.5 * a
+                if r.spec.ewma < 1.0:
+                    r.spec.cooldown = 8
+                    r.spec.ewma = float(self.spec_draft)  # optimism on retry
             self._register_lane(r)
 
     def _grow(self, r: _PRow, target: int | None = None) -> bool:
@@ -1448,11 +1636,7 @@ class DecodePool:
         r.win_tokens = None
         r.hashed = 0
         r.chain_h = 0
-        r.spec_ctx = None
-        r.spec_index = None
-        r.spec_indexed = 0
-        r.spec_ewma = 0.0
-        r.spec_cooldown = 0
+        r.spec = SpeculationState()
 
     def _preempt(self, group: _Group) -> None:
         """Preemption-to-queue with recompute resume: free the group's
@@ -1508,6 +1692,16 @@ class DecodePool:
             self._vars, self._cache, jnp.asarray(tok)
         )
         self.chunks += 1
+        # Occupancy telemetry for THIS dispatch: blocks the kernel
+        # actually attended vs blocks the lanes hold vs the dense-gather
+        # worst case (every live lane × max_blocks). With ragged off the
+        # gather always pays the worst case — the attended/capacity gap
+        # is exactly the work ragged attention skips.
+        max_blocks = self.max_len // self.block_size
+        allocated = sum(len(r.blocks) for r in live)
+        capacity = len(live) * max_blocks
+        attended = allocated if self.ragged else capacity
+        SERVE_METRICS.attention_state(attended, allocated, capacity)
         toks_host = np.asarray(toks)  # [K, slots]
         for r in live:
             for t in toks_host[:, r.slot]:

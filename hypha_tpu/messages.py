@@ -796,6 +796,19 @@ class InferExecutorConfig:
     # Max draft tokens per verify dispatch (0 = derive: one less than
     # the prefill chunk width).
     pool_spec_draft: int = 0
+    # Ragged paged attention (paged mode only): decode visits occupied
+    # KV blocks only — occupancy-proportional attention cost. Additive
+    # field: absent on the wire = dense gather, bit-identical.
+    pool_ragged: bool = False
+    # KV block quantization (paged mode only): "int8" stores K/V blocks
+    # as int8 payloads with per-position max-abs scales (~4x the lanes
+    # per byte of KV). Additive field: absent = full precision.
+    pool_kv_quant: str = ""
+    # Model-draft speculation (paged mode only): self-draft with the
+    # first N layers of the served model, verified by the same
+    # chunked-prefill program as n-gram drafts. Additive field:
+    # absent = off.
+    pool_spec_layers: int = 0
     # Backpressure: reject-with-retry-after once this many requests are
     # queued unadmitted (0 = unbounded queueing, the pre-router behavior).
     queue_limit: int = 0

@@ -181,6 +181,12 @@ class _Attention(nn.Module):
     # cache update hands back the same dense per-lane views.
     kv_blocks: int = 0
     kv_block_size: int = 0
+    # Ragged paged attention (ops.paged_attention): skip the dense window
+    # gather and attend over occupied blocks only. Default off = the
+    # historical dense-gather path, bit-identical.
+    ragged_attention: bool = False
+    # int8 KV blocks (ops.kvcache kv_quant): "" = full-precision pools.
+    kv_quant: str = ""
 
     def _proj(self, x, features, use_bias, dtype, name):
         """Dense projection, plus the low-rank LoRA path when enabled.
@@ -257,15 +263,29 @@ class _Attention(nn.Module):
                         v.astype(dtype),
                     )
 
+                ragged = self.ragged_attention and self.kv_blocks > 0
                 full_k, full_v, offset, start = update_kv_cache(
                     self, k, v, self.decode_len, prepare=_rope_rows,
                     per_row=True, blocks=self.kv_blocks,
                     block_size=self.kv_block_size,
+                    kv_quant=self.kv_quant, ragged=ragged,
                 )
-                attn = dot_product_attention(
-                    roped["q"], full_k, full_v, causal=True, q_offset=offset,
-                    window=cfg.sliding_window, k_start=start,
-                )
+                if ragged:
+                    # full_k is the raw PagedKV pool view; attention walks
+                    # the block table directly (occupancy-proportional).
+                    from ..ops.paged_attention import paged_attention
+
+                    attn = paged_attention(
+                        roped["q"], full_k, blocks=self.kv_blocks,
+                        block_size=self.kv_block_size, q_offset=offset,
+                        k_start=start, window=cfg.sliding_window,
+                    )
+                else:
+                    attn = dot_product_attention(
+                        roped["q"], full_k, full_v, causal=True,
+                        q_offset=offset, window=cfg.sliding_window,
+                        k_start=start,
+                    )
                 attn = attn.reshape(B, S, cfg.num_heads * hd)
                 return self._proj(attn, E, False, dtype, "o_proj")
 
@@ -346,6 +366,8 @@ class _Block(nn.Module):
     per_row_decode: bool = False
     kv_blocks: int = 0
     kv_block_size: int = 0
+    ragged_attention: bool = False
+    kv_quant: str = ""
 
     @nn.compact
     def __call__(self, x, cos, sin):
@@ -353,6 +375,7 @@ class _Block(nn.Module):
         x = x + _Attention(
             cfg, self.attn_impl, self.decode, self.decode_len,
             self.per_row_decode, self.kv_blocks, self.kv_block_size,
+            self.ragged_attention, self.kv_quant,
             name="self_attn"
         )(_RMSNorm(cfg.rms_eps, cfg.rms_offset, name="input_layernorm")(x), cos, sin)
         x = x + _MLP(cfg, name="mlp")(
@@ -370,6 +393,10 @@ class Llama(nn.Module):
     # Paged KV serving (executor.pool paged mode): block-pool cache layout.
     kv_blocks: int = 0
     kv_block_size: int = 0
+    # Ragged paged attention + int8 KV blocks (both default-off: the
+    # dense-gather full-precision path, bit-identical to before).
+    ragged_attention: bool = False
+    kv_quant: str = ""
     # with_head=False returns final hidden states [B, S, E] — the
     # chunked-CE training path (executor.train.chunked_causal_ce) projects
     # to vocab inside the loss so [B, S, 32000] f32 logits never
@@ -399,6 +426,7 @@ class Llama(nn.Module):
             x = block_cls(
                 cfg, self.attn_impl, self.decode, self.decode_len,
                 self.per_row_decode, self.kv_blocks, self.kv_block_size,
+                self.ragged_attention, self.kv_quant,
                 name=f"layers_{i}",
             )(x, cos, sin)
         x = _RMSNorm(cfg.rms_eps, cfg.rms_offset, name="norm")(x)

@@ -193,6 +193,8 @@ class _MoEBlock(nn.Module):
     per_row_decode: bool = False  # continuous-batching pool (executor.pool)
     kv_blocks: int = 0  # paged KV serving (executor.pool paged mode)
     kv_block_size: int = 0
+    ragged_attention: bool = False  # occupancy-proportional paged attention
+    kv_quant: str = ""  # int8 KV blocks ("" = full precision)
 
     @nn.compact
     def __call__(self, x, cos, sin):
@@ -201,6 +203,7 @@ class _MoEBlock(nn.Module):
         x = x + _Attention(
             lcfg, self.attn_impl, self.decode, self.decode_len,
             self.per_row_decode, self.kv_blocks, self.kv_block_size,
+            self.ragged_attention, self.kv_quant,
             name="self_attn"
         )(_RMSNorm(cfg.rms_eps, name="input_layernorm")(x), cos, sin)
         moe_out, aux = MoELayer(
@@ -222,6 +225,8 @@ class Mixtral(nn.Module):
     per_row_decode: bool = False  # continuous-batching pool (executor.pool)
     kv_blocks: int = 0  # paged KV serving (executor.pool paged mode)
     kv_block_size: int = 0
+    ragged_attention: bool = False  # occupancy-proportional paged attention
+    kv_quant: str = ""  # int8 KV blocks ("" = full precision)
     # with_head=False returns (hidden [B, S, E], aux) for the chunked-CE
     # training path (see llama.py / gpt2.py).
     with_head: bool = True
@@ -251,7 +256,8 @@ class Mixtral(nn.Module):
             x, aux = block_cls(
                 cfg, self.attn_impl, self.decode, self.decode_len,
                 self.dropless, self.per_row_decode, self.kv_blocks,
-                self.kv_block_size, name=f"layers_{i}",
+                self.kv_block_size, self.ragged_attention, self.kv_quant,
+                name=f"layers_{i}",
             )(x, cos, sin)
             aux_total = aux_total + aux
         x = _RMSNorm(cfg.rms_eps, name="norm")(x)

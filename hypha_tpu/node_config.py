@@ -311,6 +311,31 @@ class JobSection:
             "(0 = derive: prefill chunk - 1)"
         },
     )
+    serve_ragged: bool = field(
+        default=False,
+        metadata={
+            "doc": "serve jobs: ragged paged attention — decode visits "
+            "occupied KV blocks only, occupancy-proportional cost "
+            "(paged mode only; off = dense gather, bit-identical)"
+        },
+    )
+    serve_kv_quant: str = field(
+        default="",
+        metadata={
+            "doc": "serve jobs: KV block quantization — 'int8' stores "
+            "K/V blocks as int8 with per-position max-abs scales "
+            "(~4x more lanes per byte of KV); '' = full precision "
+            "(paged mode only)"
+        },
+    )
+    serve_spec_layers: int = field(
+        default=0,
+        metadata={
+            "doc": "serve jobs: model-draft speculation — self-draft "
+            "with the first N layers of the served model, verified by "
+            "the chunked-prefill program (0 = off; paged mode only)"
+        },
+    )
     serve_prefix_affinity: bool = field(
         default=False,
         metadata={
@@ -517,6 +542,27 @@ class JobSection:
             if self.serve_spec_ngram > 0 and self.serve_block_size <= 0:
                 raise ConfigError(
                     "job.serve_spec_ngram requires serve_block_size > 0 "
+                    "(paged mode)"
+                )
+            if self.serve_ragged and self.serve_block_size <= 0:
+                raise ConfigError(
+                    "job.serve_ragged requires serve_block_size > 0 "
+                    "(paged mode)"
+                )
+            if self.serve_kv_quant not in ("", "int8"):
+                raise ConfigError(
+                    "job.serve_kv_quant must be '' or 'int8'"
+                )
+            if self.serve_kv_quant and self.serve_block_size <= 0:
+                raise ConfigError(
+                    "job.serve_kv_quant requires serve_block_size > 0 "
+                    "(paged mode)"
+                )
+            if self.serve_spec_layers < 0:
+                raise ConfigError("job.serve_spec_layers must be >= 0")
+            if self.serve_spec_layers > 0 and self.serve_block_size <= 0:
+                raise ConfigError(
+                    "job.serve_spec_layers requires serve_block_size > 0 "
                     "(paged mode)"
                 )
             return  # dataset/rounds are train-only concerns

@@ -6,12 +6,16 @@ shapes)."""
 
 from .attention import dot_product_attention
 from .flash_attention import flash_attention
+from .paged_attention import PagedKV, paged_attention, ragged_block_attention
 from .rope import apply_rope, rope_frequencies
 from .rmsnorm import rms_norm
 
 __all__ = [
     "dot_product_attention",
     "flash_attention",
+    "PagedKV",
+    "paged_attention",
+    "ragged_block_attention",
     "apply_rope",
     "rope_frequencies",
     "rms_norm",

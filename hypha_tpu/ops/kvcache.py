@@ -42,7 +42,18 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
-__all__ = ["update_kv_cache", "copy_blocks"]
+__all__ = ["update_kv_cache", "copy_blocks", "KV_QMAX"]
+
+# int8 KV blocks reuse the compress/quant max-abs convention: payload in
+# [-127, 127], scale = maxabs / 127, zero/non-finite chunks ship all-zero
+# payload with a zero scale (dequant yields exact zeros).
+KV_QMAX = 127.0
+
+# Cache leaves copy_blocks moves on copy-on-write: the K/V payload pools
+# plus their per-row dequant scales (int8 mode) — scales are cache DATA
+# laid out row-parallel to the pools, so a block copy moves payload and
+# scale together and the prefix cache stays quantization-agnostic.
+_POOL_LEAVES = ("k", "v", "k_scale", "v_scale")
 
 
 def copy_blocks(cache, src: jnp.ndarray, dst: jnp.ndarray, block_size: int):
@@ -50,10 +61,11 @@ def copy_blocks(cache, src: jnp.ndarray, dst: jnp.ndarray, block_size: int):
     leaf of ``cache`` (copy-on-write: a lane about to append into a block
     shared with other lanes gets a private copy first).
 
-    ``src``/``dst``: int32 [N] physical block ids. Only the flat
-    ``k``/``v`` pool leaves ([(blocks+1)*block_size, Hkv, D]) are touched
-    — idx/start/table are host-owned row variables. Pure function; the
-    caller jits it (donating the cache) and rewrites its table after.
+    ``src``/``dst``: int32 [N] physical block ids. Only the flat pool
+    leaves ([(blocks+1)*block_size, ...] — K/V payload and, in int8 mode,
+    their per-row scales) are touched — idx/start/table are host-owned
+    row variables. Pure function; the caller jits it (donating the cache)
+    and rewrites its table after.
     """
     rows_src = (
         src[:, None] * block_size + jnp.arange(block_size)[None, :]
@@ -63,11 +75,27 @@ def copy_blocks(cache, src: jnp.ndarray, dst: jnp.ndarray, block_size: int):
     ).reshape(-1)
 
     def repl(path, leaf):
-        if getattr(path[-1], "key", None) in ("k", "v"):
+        if getattr(path[-1], "key", None) in _POOL_LEAVES:
             return leaf.at[rows_dst].set(leaf[rows_src])
         return leaf
 
     return jax.tree_util.tree_map_with_path(repl, cache)
+
+
+def _quantize_rows(x: jnp.ndarray):
+    """Max-abs int8 quantization over the head_dim axis: one scale per
+    (position, kv-head) chunk — the compress/quant per-chunk idiom at KV
+    granularity. ``x`` [N, Hkv, D] -> (payload int8 [N, Hkv, D],
+    scale f32 [N, Hkv])."""
+    xf = x.astype(jnp.float32)
+    maxabs = jnp.max(jnp.abs(xf), axis=-1)
+    ok = jnp.isfinite(maxabs) & (maxabs > 0)
+    scale = jnp.where(ok, maxabs / KV_QMAX, 0.0)
+    inv = jnp.where(ok, KV_QMAX / jnp.where(ok, maxabs, 1.0), 0.0)
+    payload = jnp.clip(
+        jnp.rint(xf * inv[..., None]), -KV_QMAX, KV_QMAX
+    ).astype(jnp.int8)
+    return payload, scale
 
 
 def _physical(table, cols, block_size, max_blocks, blocks):
@@ -95,6 +123,8 @@ def update_kv_cache(
     per_row: bool = False,
     blocks: int = 0,
     block_size: int = 0,
+    kv_quant: str = "",
+    ragged: bool = False,
 ):
     """Append this step's K/V into ``module``'s cache collection.
 
@@ -125,8 +155,26 @@ def update_kv_cache(
     into the pool. Writes scatter through the table; the returned
     ``full_k``/``full_v`` are the dense per-lane views gathered back out,
     so downstream attention is unchanged.
+
+    ``kv_quant="int8"`` (paged only) stores the pools as int8 payload with
+    per-(position, kv-head) max-abs scales in sibling ``k_scale`` /
+    ``v_scale`` cache variables ([(blocks+1)*block_size, Hkv] f32) —
+    scales are cache data beside the pool, so copy-on-write block copies
+    and the content-addressed prefix cache (both keyed on physical rows)
+    compose without changes. The dense return path dequantizes the
+    gathered window; the ragged path hands the raw pools + scales to
+    ops.paged_attention, which fuses dequant into its block loop.
+
+    ``ragged=True`` (paged only) skips the dense window gather and
+    returns ``(PagedKV, None, offset, start)`` — the attention caller
+    consumes the pool + table directly (ops.paged_attention), making step
+    cost proportional to occupied blocks instead of ``decode_len``.
     """
     B, S, Hkv, D = k.shape
+    if (kv_quant or ragged) and blocks <= 0:
+        raise ValueError("kv_quant / ragged require paged mode (blocks > 0)")
+    if kv_quant not in ("", "int8"):
+        raise ValueError(f"unsupported kv_quant {kv_quant!r} ('' | 'int8')")
     if blocks > 0:
         if not per_row:
             raise ValueError("paged KV cache requires per_row=True")
@@ -154,16 +202,44 @@ def update_kv_cache(
             k, v = prepare(offset, start.value)
         dtype = k.dtype
         pool_rows = (blocks + 1) * block_size
+        pool_dtype = jnp.int8 if kv_quant == "int8" else dtype
         ck = module.variable(
-            "cache", "k", jnp.zeros, (pool_rows, Hkv, D), dtype
+            "cache", "k", jnp.zeros, (pool_rows, Hkv, D), pool_dtype
         )
         cv = module.variable(
-            "cache", "v", jnp.zeros, (pool_rows, Hkv, D), dtype
+            "cache", "v", jnp.zeros, (pool_rows, Hkv, D), pool_dtype
         )
+        ks = vs = None
+        if kv_quant == "int8":
+            ks = module.variable(
+                "cache", "k_scale", jnp.zeros, (pool_rows, Hkv), jnp.float32
+            )
+            vs = module.variable(
+                "cache", "v_scale", jnp.zeros, (pool_rows, Hkv), jnp.float32
+            )
         cols = offset[:, None] + jnp.arange(S)[None, :]  # [B, S]
         phys = _physical(table.value, cols, block_size, max_blocks, blocks)
-        ck.value = ck.value.at[phys.reshape(-1)].set(k.reshape(B * S, Hkv, D))
-        cv.value = cv.value.at[phys.reshape(-1)].set(v.reshape(B * S, Hkv, D))
+        kw, vw = k.reshape(B * S, Hkv, D), v.reshape(B * S, Hkv, D)
+        if kv_quant == "int8":
+            kw, k_sc = _quantize_rows(kw)
+            vw, v_sc = _quantize_rows(vw)
+            ks.value = ks.value.at[phys.reshape(-1)].set(k_sc)
+            vs.value = vs.value.at[phys.reshape(-1)].set(v_sc)
+        ck.value = ck.value.at[phys.reshape(-1)].set(kw)
+        cv.value = cv.value.at[phys.reshape(-1)].set(vw)
+        idx.value = offset + S
+        if ragged:
+            # No dense gather: the caller attends straight over the pool
+            # through the table (occupancy-proportional kernel).
+            from .paged_attention import PagedKV
+
+            view = PagedKV(
+                k=ck.value, v=cv.value,
+                k_scale=None if ks is None else ks.value,
+                v_scale=None if vs is None else vs.value,
+                table=table.value,
+            )
+            return view, None, offset, start.value
         # Dense per-lane views for the (unchanged) attention math. Window
         # positions are always in-range, so only table sentinels route to
         # the garbage block — and those positions are masked.
@@ -171,7 +247,11 @@ def update_kv_cache(
         phys_win = _physical(table.value, win, block_size, max_blocks, blocks)
         full_k = ck.value[phys_win]  # [B, decode_len, Hkv, D]
         full_v = cv.value[phys_win]
-        idx.value = offset + S
+        if kv_quant == "int8":
+            full_k = (full_k.astype(jnp.float32)
+                      * ks.value[phys_win][..., None]).astype(dtype)
+            full_v = (full_v.astype(jnp.float32)
+                      * vs.value[phys_win][..., None]).astype(dtype)
         return full_k, full_v, offset, start.value
     if per_row:
         idx = module.variable(

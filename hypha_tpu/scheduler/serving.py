@@ -120,6 +120,9 @@ class ServingSupervisor:
         pool_prefix_cache: bool = False,
         pool_spec_ngram: int = 0,
         pool_spec_draft: int = 0,
+        pool_ragged: bool = False,
+        pool_kv_quant: str = "",
+        pool_spec_layers: int = 0,
         prefix_affinity: bool = False,
         affinity_tokens: int = 64,
         affinity_skew: int = 4,
@@ -156,6 +159,9 @@ class ServingSupervisor:
             pool_prefix_cache=pool_prefix_cache,
             pool_spec_ngram=pool_spec_ngram,
             pool_spec_draft=pool_spec_draft,
+            pool_ragged=pool_ragged,
+            pool_kv_quant=pool_kv_quant,
+            pool_spec_layers=pool_spec_layers,
             queue_limit=queue_limit,
             eos_token_id=eos_token_id,
             load_report_s=load_report_s if self.route else 0.0,

@@ -267,6 +267,11 @@ class ServeMetrics:
       derives ``prefix_hit_rate`` from the hit/miss counters.
     * ``speculation`` — drafted vs accepted tokens per verify dispatch
       and the derived ``spec_accept_rate`` gauge.
+    * ``attention occupancy`` — per-decode-dispatch gauges from the pool:
+      ``attended_blocks`` (KV blocks the attention visited),
+      ``occupied_fraction`` (allocated / dense-gather capacity) and the
+      derived ``attended_ratio`` (attended / allocated — 1.0 under
+      ragged paged attention, the dense overhead multiplier otherwise).
     * ``weight streaming`` — the serving (round, generation) gauges
       stamped at each hot swap, applied/deferred/rolled-back swap
       counters, and a stage→flip swap-latency reservoir (same
@@ -281,6 +286,9 @@ class ServeMetrics:
         self._queue_depth = 0.0
         self._cached_blocks = 0.0
         self._shared_blocks = 0.0
+        self._attended_blocks = 0.0
+        self._allocated_blocks = 0.0
+        self._occupied_fraction = 0.0
         self._weight_round = -1.0  # -1 = never swapped (dispatched params)
         self._weight_generation = -1.0
         self.admissions = Counter("hypha.serve.admissions")
@@ -339,6 +347,45 @@ class ServeMetrics:
         with self._lock:
             self._free_blocks = float(free_blocks)
             self._queue_depth = float(queue_depth)
+
+    def attention_state(
+        self,
+        attended_blocks: float,
+        allocated_blocks: float,
+        capacity_blocks: float,
+    ) -> None:
+        """Occupancy of the LAST decode dispatch (last-writer gauges,
+        like pool_state): KV blocks the attention actually visited,
+        blocks the live lanes hold, and the dense-gather worst case
+        (live lanes × max_blocks). Ragged attention makes attended ==
+        allocated; dense gather pays attended == capacity regardless of
+        occupancy — ``attended_ratio`` (attended / allocated) is the
+        per-step multiplier the kernel spends over the useful work."""
+        with self._lock:
+            self._attended_blocks = float(attended_blocks)
+            self._allocated_blocks = float(allocated_blocks)
+            self._occupied_fraction = (
+                float(allocated_blocks) / float(capacity_blocks)
+                if capacity_blocks
+                else 0.0
+            )
+
+    def attended_blocks(self) -> float:
+        with self._lock:
+            return self._attended_blocks
+
+    def occupied_fraction(self) -> float:
+        with self._lock:
+            return self._occupied_fraction
+
+    def attended_ratio(self) -> float:
+        """Attended vs allocated blocks in the last decode dispatch:
+        1.0 = the kernel visited exactly the occupied blocks (ragged);
+        > 1.0 = dense gather overhead at partial occupancy."""
+        with self._lock:
+            if not self._allocated_blocks:
+                return 0.0
+            return self._attended_blocks / self._allocated_blocks
 
     def cache_state(self, cached_blocks: float, shared_blocks: float) -> None:
         with self._lock:
@@ -400,6 +447,9 @@ class ServeMetrics:
             "prefix_hit_rate": self.prefix_hit_rate(),
             "cached_blocks": self.cached_blocks(),
             "shared_blocks": self.shared_blocks(),
+            "attended_blocks": self.attended_blocks(),
+            "occupied_fraction": self.occupied_fraction(),
+            "attended_ratio": self.attended_ratio(),
             "cow_copies": self.cow_copies.value(),
             "cache_evictions": self.cache_evictions.value(),
             "spec_proposed": self.spec_proposed.value(),
@@ -872,6 +922,12 @@ def register_on(
     )
     meter.observable_gauge(
         "hypha.serve.shared_blocks", serve.shared_blocks
+    )
+    meter.observable_gauge(
+        "hypha.serve.attended_blocks", serve.attended_blocks
+    )
+    meter.observable_gauge(
+        "hypha.serve.occupied_fraction", serve.occupied_fraction
     )
     meter.observable_gauge("hypha.serve.cow_copies", serve.cow_copies.value)
     meter.observable_gauge(

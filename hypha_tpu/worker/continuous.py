@@ -50,6 +50,9 @@ class PoolServer:
         prefix_cache: bool = False,
         spec_ngram: int = 0,
         spec_draft: int = 0,
+        ragged: bool = False,
+        kv_quant: str = "",
+        spec_layers: int = 0,
     ) -> None:
         self.pool = DecodePool(
             model,
@@ -65,6 +68,9 @@ class PoolServer:
             prefix_cache=prefix_cache,
             spec_ngram=spec_ngram,
             spec_draft=spec_draft,
+            ragged=ragged,
+            kv_quant=kv_quant,
+            spec_layers=spec_layers,
         )
         self._run_fallback = run_fallback
         # Bounded one-shot decode concurrency: each distinct fallback shape

@@ -215,6 +215,9 @@ class InProcessInferExecutor(JobExecutor):
                     prefix_cache=cfg.pool_prefix_cache,
                     spec_ngram=cfg.pool_spec_ngram,
                     spec_draft=cfg.pool_spec_draft,
+                    ragged=cfg.pool_ragged,
+                    kv_quant=cfg.pool_kv_quant,
+                    spec_layers=cfg.pool_spec_layers,
                 )
             elif cfg.batch_window_ms >= 0:
                 loaded["batcher"] = self.batchers[job_id] = RequestBatcher(

@@ -1,0 +1,173 @@
+"""int8 KV blocks (ops.kvcache ``kv_quant``): quantizer invariants,
+scale leaves riding the pool through copy_blocks, a quality-delta bound
+vs f32 KV on a real model-family forward, and the pool-level composition
+with ragged attention and the prefix cache."""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from hypha_tpu.executor.pool import DecodePool, _set_rowvar
+from hypha_tpu.models import Llama, LlamaConfig
+from hypha_tpu.ops.kvcache import KV_QMAX, _quantize_rows, copy_blocks
+
+
+@pytest.fixture(scope="module")
+def tiny_llama():
+    cfg = dataclasses.replace(LlamaConfig.tiny(), dtype="float32")
+    model = Llama(cfg)
+    ids = np.zeros((1, 8), np.int32)
+    params = model.init(jax.random.key(0), ids)
+    return model, params, cfg
+
+
+def test_quantize_rows_bounds_and_zero_convention():
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.standard_normal((32, 2, 8)).astype(np.float32))
+    payload, scale = _quantize_rows(x)
+    assert payload.dtype == jnp.int8
+    assert scale.shape == (32, 2)
+    deq = payload.astype(jnp.float32) * scale[..., None]
+    # per-(position, head) max-abs scaling: error <= half a quantization
+    # step of that row's own range
+    step = np.asarray(scale)[..., None]
+    assert (np.abs(np.asarray(deq - x)) <= 0.5 * step + 1e-7).all()
+    # all-zero and non-finite rows quantize to zero payload + zero scale
+    bad = jnp.zeros((3, 2, 8)).at[1, 0, 0].set(jnp.inf).at[2, 1, 3].set(
+        jnp.nan
+    )
+    p2, s2 = _quantize_rows(bad)
+    assert int(jnp.abs(p2[0]).sum()) == 0 and float(s2[0].sum()) == 0.0
+    assert int(jnp.abs(p2[1, 0]).sum()) == 0 and float(s2[1, 0]) == 0.0
+    assert int(jnp.abs(p2[2, 1]).sum()) == 0 and float(s2[2, 1]) == 0.0
+    # scale reconstructs the row max to within one step
+    maxabs = np.abs(np.asarray(x)).max(-1)
+    np.testing.assert_allclose(
+        np.asarray(scale) * KV_QMAX, maxabs, rtol=1e-6
+    )
+
+
+def test_copy_blocks_moves_scale_leaves():
+    bs = 4
+    cache = {
+        "k": jnp.arange(32, dtype=jnp.float32).reshape(8, 2, 2),
+        "v": -jnp.arange(32, dtype=jnp.float32).reshape(8, 2, 2),
+        "k_scale": jnp.arange(16, dtype=jnp.float32).reshape(8, 2),
+        "v_scale": -jnp.arange(16, dtype=jnp.float32).reshape(8, 2),
+        "idx": jnp.zeros((2,), jnp.int32),  # must NOT be copied
+    }
+    out = copy_blocks(
+        cache, jnp.asarray([0], jnp.int32), jnp.asarray([1], jnp.int32), bs
+    )
+    for leaf in ("k", "v", "k_scale", "v_scale"):
+        np.testing.assert_array_equal(
+            np.asarray(out[leaf][bs : 2 * bs]), np.asarray(cache[leaf][:bs])
+        )
+    np.testing.assert_array_equal(
+        np.asarray(out["idx"]), np.asarray(cache["idx"])
+    )
+
+
+def _paged_logits(model, params, toks, *, kv_quant, blocks=16, bs=8):
+    """One chunked-prefill-shaped forward through the paged per-row
+    decode path (the pool's program), returning logits + final cache."""
+    B, S = toks.shape
+    max_blocks = 64 // bs
+    dec = dataclasses.replace(
+        model, decode=True, decode_len=64, per_row_decode=True,
+        kv_blocks=blocks, kv_block_size=bs, kv_quant=kv_quant,
+    )
+    skel = jax.eval_shape(
+        lambda: dec.init(jax.random.key(0), jnp.zeros((B, 1), jnp.int32))
+    )["cache"]
+    cache = jax.tree.map(lambda s: jnp.zeros(s.shape, s.dtype), skel)
+    cache = _set_rowvar(cache, "idx", jnp.zeros((B,), jnp.int32))
+    cache = _set_rowvar(cache, "start", jnp.zeros((B,), jnp.int32))
+    table = np.full((B, max_blocks), blocks, np.int32)
+    need = -(-S // bs)
+    for b in range(B):
+        table[b, :need] = np.arange(b * need, (b + 1) * need)
+    cache = _set_rowvar(cache, "table", jnp.asarray(table))
+    logits, vars_ = dec.apply(
+        {**params, "cache": cache}, jnp.asarray(toks), mutable=["cache"]
+    )
+    return np.asarray(logits, np.float32), vars_["cache"]
+
+
+def test_int8_kv_quality_delta_bounded(tiny_llama):
+    """int8 KV on the real Llama family forward: the pool payload is
+    genuinely int8 (4x smaller than f32), scales ride beside it, and the
+    logits stay within a small bounded delta of full-precision KV."""
+    model, params, _ = tiny_llama
+    rng = np.random.default_rng(2)
+    toks = rng.integers(1, 255, size=(2, 16)).astype(np.int32)
+    ref, cache_f32 = _paged_logits(model, params, toks, kv_quant="")
+    got, cache_i8 = _paged_logits(model, params, toks, kv_quant="int8")
+
+    leaves_f32 = {
+        p[-1].key: l
+        for p, l in jax.tree_util.tree_flatten_with_path(cache_f32)[0]
+        if getattr(p[-1], "key", "") in ("k", "v")
+    }
+    leaves_i8 = {
+        p[-1].key: l
+        for p, l in jax.tree_util.tree_flatten_with_path(cache_i8)[0]
+        if getattr(p[-1], "key", "") in ("k", "v", "k_scale", "v_scale")
+    }
+    assert leaves_f32["k"].dtype == jnp.float32
+    assert leaves_i8["k"].dtype == jnp.int8
+    assert leaves_i8["v"].dtype == jnp.int8
+    assert leaves_i8["k_scale"].dtype == jnp.float32
+    assert (
+        leaves_i8["k"].dtype.itemsize * 4 == leaves_f32["k"].dtype.itemsize
+    )
+
+    spread = np.abs(ref).max()
+    delta = np.abs(got - ref).max()
+    assert delta < 0.05 * spread + 0.05, (
+        f"int8 KV moved logits by {delta} (spread {spread})"
+    )
+
+
+def test_int8_pool_end_to_end_and_composition(tiny_llama):
+    """The pool serves int8 KV lanes (dense and ragged, with the prefix
+    cache) and greedy streams stay self-consistent across the
+    compositions that share the quantized pool bytes."""
+    model, params, _ = tiny_llama
+    prompts = [[5, 9, 2, 14], [1, 2, 3, 1, 2, 3, 1, 2]]
+
+    def run(**kw):
+        pool = DecodePool(
+            model, params, slots=4, max_len=64, steps_per_call=4,
+            block_size=8, num_blocks=32, prefill_chunk=16, **kw,
+        )
+        try:
+            return pool.submit(
+                [list(p) for p in prompts], 12
+            ).result(timeout=300)
+        finally:
+            pool.close()
+
+    base = run(kv_quant="int8")
+    assert all(len(o) == 12 for o in base)
+    assert base == run(kv_quant="int8", prefix_cache=True)
+    ragged = run(kv_quant="int8", ragged=True)
+    assert all(len(o) == 12 for o in ragged)
+
+
+def test_kv_quant_validation(tiny_llama):
+    model, params, _ = tiny_llama
+    with pytest.raises(ValueError, match="require paged mode"):
+        DecodePool(model, params, slots=2, max_len=64, kv_quant="int8")
+    with pytest.raises(ValueError, match="require paged mode"):
+        DecodePool(model, params, slots=2, max_len=64, ragged=True)
+    with pytest.raises(ValueError, match="unknown kv_quant"):
+        DecodePool(
+            model, params, slots=2, max_len=64, block_size=8,
+            num_blocks=16, prefill_chunk=8, kv_quant="fp8",
+        )

@@ -352,6 +352,8 @@ def test_serve_metrics_snapshot_and_gauges(tiny_llama):
         "hypha.serve.prefix_hit_rate",
         "hypha.serve.cached_blocks",
         "hypha.serve.shared_blocks",
+        "hypha.serve.attended_blocks",
+        "hypha.serve.occupied_fraction",
         "hypha.serve.cow_copies",
         "hypha.serve.cache_evictions",
         "hypha.serve.spec_accept_rate",
@@ -363,7 +365,41 @@ def test_serve_metrics_snapshot_and_gauges(tiny_llama):
         "prefix_hit_blocks", "prefix_miss_blocks", "prefix_hit_rate",
         "cow_copies", "cache_evictions", "spec_proposed", "spec_accepted",
         "spec_accept_rate", "affinity_routed",
+        "attended_blocks", "occupied_fraction", "attended_ratio",
     ):
         assert key in snap
     _, instruments, gauges, _ = telemetry._drain()
     assert gauges[("test", "hypha.serve.admissions")][0] >= 2
+
+
+def test_attention_occupancy_telemetry(tiny_llama):
+    """Ragged decode attends exactly the allocated blocks
+    (attended_ratio == 1.0); dense decode attends every table column of
+    every live lane, so at partial occupancy its attended/allocated
+    ratio is strictly > 1 — the per-step gauge that motivates the ragged
+    kernel."""
+    model, params, _ = tiny_llama
+    short = [1, 2, 3]  # 1 block of 8 vs max_blocks=8: low occupancy
+
+    def occupancy(**kw):
+        SERVE_METRICS.reset()
+        pool = DecodePool(
+            model, params, slots=4, max_len=64, steps_per_call=2,
+            block_size=8, num_blocks=32, prefill_chunk=8, **kw,
+        )
+        try:
+            out = pool.submit([list(short)], 4).result(timeout=300)
+        finally:
+            pool.close()
+        return out, SERVE_METRICS.snapshot()
+
+    out_d, dense = occupancy()
+    out_r, ragged = occupancy(ragged=True)
+    assert out_r == out_d  # telemetry never changes tokens
+    for snap in (dense, ragged):
+        assert 0.0 < snap["occupied_fraction"] <= 1.0
+        assert snap["attended_blocks"] >= 1
+    assert ragged["attended_ratio"] == 1.0
+    assert dense["attended_ratio"] > 1.0
+    # attended == allocated when ragged; dense attends full capacity
+    assert ragged["attended_blocks"] < dense["attended_blocks"]

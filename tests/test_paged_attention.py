@@ -1,0 +1,212 @@
+"""Ragged paged attention (ops.paged_attention): garbage-block
+invariance at every occupancy, bit-parity with the dense gather at full
+occupancy, closeness elsewhere, GQA head routing, and the Pallas kernel
+in interpret mode — all on pool-valid states (live lanes write only
+inside their allocated blocks; idle lanes park with all-sentinel
+tables, exactly like executor.pool)."""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from hypha_tpu.ops.attention import dot_product_attention
+from hypha_tpu.ops.kvcache import _physical
+from hypha_tpu.ops.paged_attention import (
+    PagedKV,
+    paged_attention,
+    ragged_block_attention,
+)
+
+
+def _state(rng, *, B, hkv, D, blocks, bs, max_blocks, occ, poison=1e4):
+    """A pool-valid paged state: per-lane prefix-packed tables over
+    disjoint physical blocks, garbage block poisoned so any leak is
+    numerically loud (and distinguishable run to run)."""
+    rows = (blocks + 1) * bs
+    k = rng.standard_normal((rows, hkv, D)).astype(np.float32)
+    v = rng.standard_normal((rows, hkv, D)).astype(np.float32)
+    k[blocks * bs :] = poison
+    v[blocks * bs :] = poison
+    free = list(rng.permutation(blocks))
+    table = np.full((B, max_blocks), blocks, np.int32)
+    for b in range(B):
+        for j in range(occ[b]):
+            table[b, j] = free.pop()
+    return PagedKV(
+        jnp.asarray(k), jnp.asarray(v), None, None, jnp.asarray(table)
+    )
+
+
+def _dense_ref(q, kv, *, blocks, bs, q_offset, k_start=None, window=None):
+    """The historical dense-gather expression, written out independently
+    of the op's own dense branch."""
+    B, max_blocks = kv.table.shape
+    decode_len = max_blocks * bs
+    win = jnp.broadcast_to(jnp.arange(decode_len)[None, :], (B, decode_len))
+    phys = _physical(kv.table, win, bs, max_blocks, blocks)
+    return dot_product_attention(
+        q, kv.k[phys].astype(q.dtype), kv.v[phys].astype(q.dtype),
+        causal=True, q_offset=q_offset,
+        k_start=k_start, window=window,
+    )
+
+
+def _rand_case(rng, *, B, hq, hkv, D, blocks, bs, max_blocks, sq=1):
+    """Random pool-valid lanes: occupancy >= the blocks the causal
+    window needs, query positions inside the allocated region."""
+    occ = rng.integers(1, max_blocks + 1, size=B)
+    qoff = np.zeros(B, np.int32)
+    for b in range(B):
+        # queries [qoff, qoff+sq) must land inside occ*bs positions
+        hi = occ[b] * bs - sq
+        lo = max((occ[b] - 1) * bs - sq + 1, 0)
+        qoff[b] = int(rng.integers(lo, hi + 1)) if hi >= lo else 0
+    kv = _state(
+        rng, B=B, hkv=hkv, D=D, blocks=blocks, bs=bs,
+        max_blocks=max_blocks, occ=occ,
+    )
+    q = jnp.asarray(rng.standard_normal((B, sq, hq, D)).astype(np.float32))
+    return q, kv, jnp.asarray(qoff), occ
+
+
+@pytest.mark.parametrize("hq,hkv", [(4, 4), (4, 2), (8, 2)])
+@pytest.mark.parametrize("bs", [4, 8])
+def test_garbage_never_contributes(hq, hkv, bs):
+    """Property: re-poisoning the garbage block (and every unallocated
+    block) must not move a single output bit, at any occupancy, for any
+    GQA ratio — the ragged op's masking is what guarantees it, since
+    sentinel table entries physically alias the garbage block."""
+    rng = np.random.default_rng(hash((hq, hkv, bs)) % 2**32)
+    B, D, max_blocks, blocks = 4, 8, 6, 40
+    for _ in range(3):
+        q, kv, qoff, occ = _rand_case(
+            rng, B=B, hq=hq, hkv=hkv, D=D, blocks=blocks, bs=bs,
+            max_blocks=max_blocks,
+        )
+        out = ragged_block_attention(
+            q, kv, blocks=blocks, block_size=bs, q_offset=qoff
+        )
+        # rewrite every row not reachable through a live table entry
+        live = set()
+        for b in range(B):
+            for j in range(occ[b]):
+                live.add(int(kv.table[b, j]))
+        k2, v2 = np.asarray(kv.k).copy(), np.asarray(kv.v).copy()
+        for blk in range(blocks + 1):
+            if blk not in live:
+                k2[blk * bs : (blk + 1) * bs] = rng.standard_normal(
+                    (bs, hkv, D)
+                ) * 1e6
+                v2[blk * bs : (blk + 1) * bs] = rng.standard_normal(
+                    (bs, hkv, D)
+                ) * 1e6
+        out2 = ragged_block_attention(
+            q, kv._replace(k=jnp.asarray(k2), v=jnp.asarray(v2)),
+            blocks=blocks, block_size=bs, q_offset=qoff,
+        )
+        assert np.array_equal(np.asarray(out), np.asarray(out2))
+        assert np.isfinite(np.asarray(out)).all()
+
+
+@pytest.mark.parametrize("hq,hkv", [(4, 4), (4, 2)])
+@pytest.mark.parametrize("bs", [4, 8])
+@pytest.mark.parametrize("sq", [1, 4])
+def test_ragged_matches_dense_gather(hq, hkv, bs, sq):
+    """Partial occupancy: the streaming softmax agrees with the dense
+    gather to float tolerance on every pool-valid lane (the causal
+    window only ever touches allocated blocks)."""
+    rng = np.random.default_rng(hash((hq, hkv, bs, sq, 1)) % 2**32)
+    B, D, max_blocks, blocks = 3, 8, 6, 40
+    for _ in range(3):
+        q, kv, qoff, _ = _rand_case(
+            rng, B=B, hq=hq, hkv=hkv, D=D, blocks=blocks, bs=bs,
+            max_blocks=max_blocks, sq=sq,
+        )
+        got = ragged_block_attention(
+            q, kv, blocks=blocks, block_size=bs, q_offset=qoff
+        )
+        ref = _dense_ref(q, kv, blocks=blocks, bs=bs, q_offset=qoff)
+        np.testing.assert_allclose(
+            np.asarray(got), np.asarray(ref), atol=2e-5, rtol=2e-5
+        )
+
+
+def test_full_occupancy_bit_parity_and_idle_lane_zeros():
+    """Full occupancy takes the lax.cond dense branch: outputs are
+    ARRAY-EQUAL to the dense gather (the CPU fallback's bit-parity
+    contract). An idle lane (all-sentinel table) outputs exact zeros."""
+    rng = np.random.default_rng(11)
+    B, hq, hkv, D, bs, max_blocks, blocks = 3, 4, 2, 8, 4, 6, 40
+    occ = np.full(B, max_blocks)
+    kv = _state(
+        rng, B=B, hkv=hkv, D=D, blocks=blocks, bs=bs,
+        max_blocks=max_blocks, occ=occ,
+    )
+    q = jnp.asarray(rng.standard_normal((B, 1, hq, D)).astype(np.float32))
+    qoff = jnp.asarray(
+        rng.integers((max_blocks - 1) * bs, max_blocks * bs, B)
+        .astype(np.int32)
+    )
+    got = ragged_block_attention(
+        q, kv, blocks=blocks, block_size=bs, q_offset=qoff
+    )
+    ref = _dense_ref(q, kv, blocks=blocks, bs=bs, q_offset=qoff)
+    assert np.array_equal(np.asarray(got), np.asarray(ref))
+
+    # idle lane: sentinel table, parked offset — output must be zeros
+    idle = kv._replace(
+        table=jnp.full((B, max_blocks), blocks, jnp.int32)
+    )
+    out = ragged_block_attention(
+        q, idle, blocks=blocks, block_size=bs,
+        q_offset=jnp.full((B,), max_blocks * bs, jnp.int32),
+    )
+    assert np.array_equal(np.asarray(out), np.zeros_like(np.asarray(out)))
+
+
+def test_window_and_k_start_masks_match_dense():
+    """Sliding window + k_start thread through the streaming branch the
+    same way the dense path applies them."""
+    rng = np.random.default_rng(5)
+    B, hq, hkv, D, bs, max_blocks, blocks = 3, 4, 2, 8, 4, 8, 40
+    q, kv, qoff, _ = _rand_case(
+        rng, B=B, hq=hq, hkv=hkv, D=D, blocks=blocks, bs=bs,
+        max_blocks=max_blocks,
+    )
+    kstart = jnp.asarray(np.minimum(2, np.asarray(qoff)).astype(np.int32))
+    got = ragged_block_attention(
+        q, kv, blocks=blocks, block_size=bs, q_offset=qoff,
+        k_start=kstart, window=2 * bs,
+    )
+    ref = _dense_ref(
+        q, kv, blocks=blocks, bs=bs, q_offset=qoff,
+        k_start=kstart, window=2 * bs,
+    )
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(ref), atol=2e-5, rtol=2e-5
+    )
+
+
+def test_pallas_kernel_interpret_parity():
+    """The TPU kernel (interpret mode off-TPU) agrees with the XLA
+    fallback — including the scalar-prefetched table indexing, GQA head
+    routing, and the garbage predicate."""
+    rng = np.random.default_rng(3)
+    B, hq, hkv, D, bs, max_blocks, blocks = 2, 4, 2, 8, 4, 4, 16
+    q, kv, qoff, _ = _rand_case(
+        rng, B=B, hq=hq, hkv=hkv, D=D, blocks=blocks, bs=bs,
+        max_blocks=max_blocks,
+    )
+    ref = ragged_block_attention(
+        q, kv, blocks=blocks, block_size=bs, q_offset=qoff
+    )
+    got = paged_attention(
+        q, kv, blocks=blocks, block_size=bs, q_offset=qoff,
+        use_kernel=True, interpret=True,
+    )
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(ref), atol=2e-5, rtol=2e-5
+    )

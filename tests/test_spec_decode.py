@@ -174,3 +174,137 @@ def test_spec_draft_cap_respects_chunk_width(tiny_llama):
         assert got == [ref]
     finally:
         pool.close()
+
+
+# ------------------------------------------------- model-draft speculation
+
+
+def test_model_draft_token_identical(tiny_llama):
+    """The self-draft (first ``spec_layers`` layers of the served model)
+    proposes through the SAME chunked-prefill verify as n-gram drafts:
+    greedy output is token-identical to the plain pool on arbitrary
+    low-repetition prompts, where n-gram lookup has nothing to copy."""
+    model, params, _ = tiny_llama
+    pool = DecodePool(
+        model, params, slots=4, max_len=256, steps_per_call=4,
+        block_size=8, num_blocks=64, prefill_chunk=16,
+        spec_layers=1, spec_draft=4,
+    )
+    prompts = [
+        [5, 9, 2],
+        [17, 3, 200, 45, 91, 8, 120, 7],
+        [1, 2, 3, 1, 2, 3, 1, 2],
+    ]
+    try:
+        assert pool.spec_model
+        for p in prompts:
+            got = pool.submit([list(p)], 24).result(timeout=300)
+            assert got == [_ref(model, params, p, 24)], p
+        assert pool.spec_chunks >= 1, "model draft never dispatched"
+    finally:
+        pool.close()
+
+
+def test_model_draft_validation(tiny_llama):
+    model, params, _ = tiny_llama
+    with pytest.raises(ValueError, match="requires paged mode"):
+        DecodePool(model, params, slots=2, max_len=64, spec_layers=1)
+    with pytest.raises(ValueError, match="spec_layers 2 must be in"):
+        DecodePool(
+            model, params, slots=2, max_len=64, block_size=8,
+            num_blocks=16, prefill_chunk=8, spec_layers=2,
+        )
+    with pytest.raises(ValueError, match="draft_model requires"):
+        DecodePool(
+            model, params, slots=2, max_len=64, block_size=8,
+            num_blocks=16, prefill_chunk=8, draft_model=model,
+        )
+
+
+def test_explicit_draft_model_token_identical(tiny_llama):
+    """An explicit small family member as the draft: same verify
+    contract, token-identical output (the draft only sets WHICH columns
+    get verified, never what is emitted)."""
+    model, params, cfg = tiny_llama
+    dcfg = dataclasses.replace(cfg, num_layers=1)
+    dmodel = Llama(dcfg)
+    dparams = dmodel.init(jax.random.key(1), np.zeros((1, 8), np.int32))
+    pool = DecodePool(
+        model, params, slots=2, max_len=128, steps_per_call=4,
+        block_size=8, num_blocks=32, prefill_chunk=16,
+        draft_model=dmodel, draft_params=dparams, spec_draft=3,
+    )
+    try:
+        p = [9, 1, 44, 7, 130]
+        got = pool.submit([list(p)], 16).result(timeout=300)
+        assert got == [_ref(model, params, p, 16)]
+    finally:
+        pool.close()
+
+
+def test_shared_backoff_state_between_proposers(tiny_llama):
+    """Satellite pin: ONE SpeculationState per lane. Whichever proposer
+    drafted, a missing verify decays the same EWMA, and the cooldown
+    parks BOTH paths — the model draft must not keep dispatching
+    verifies a lane's n-gram record already proved unprofitable."""
+    from hypha_tpu.executor.pool import SpeculationState, _PRow
+
+    model, params, _ = tiny_llama
+    pool = DecodePool(
+        model, params, slots=2, max_len=256, steps_per_call=4,
+        block_size=8, num_blocks=64, prefill_chunk=16,
+        spec_ngram=3, spec_layers=1, spec_draft=4,
+    )
+    try:
+        r = _PRow(group=None, lane=0, prompt=[1, 2, 3], budget=64)
+        r.emitted = [4]
+        r.spec = SpeculationState(ewma=0.2, cooldown=3, primed=True)
+        # cooldown gates BOTH proposers: no draft of either kind
+        assert pool._propose(r) is None
+        assert r.spec.cooldown == 2
+        r.spec.cooldown = 0
+        d = pool._propose(r)  # n-gram has no match -> model draft runs
+        assert d is not None and len(d) >= 1
+    finally:
+        pool.close()
+
+
+def test_budget_edge_final_token_ships_as_zero_draft_verify(tiny_llama):
+    """Satellite pin (remaining == 1): the verify program always emits
+    one bonus token, so the final token of a speculating row ships as a
+    zero-draft verify instead of a K-step decode chunk — with spec on,
+    a 2-token generation never dispatches a decode chunk. n-gram and
+    model-draft pools agree on the boundary (it is decided in _propose
+    before either proposer runs), and the stream stays token-identical
+    to the plain pool."""
+    model, params, _ = tiny_llama
+    p = [11, 3, 7, 150]
+    ref = _ref(model, params, p, 2)
+
+    def run(**kw):
+        pool = DecodePool(
+            model, params, slots=2, max_len=128, steps_per_call=4,
+            block_size=8, num_blocks=32, prefill_chunk=16, **kw,
+        )
+        try:
+            got = pool.submit([list(p)], 2).result(timeout=300)
+            return got, pool.chunks, pool.spec_chunks
+        finally:
+            pool.close()
+
+    got_n, chunks_n, spec_n = run(spec_ngram=2)
+    got_m, chunks_m, spec_m = run(spec_layers=1, spec_draft=4)
+    assert got_n == [ref] and got_m == [ref]
+    # the final token came from a verify dispatch on BOTH paths
+    assert chunks_n == 0 and spec_n >= 1, (
+        f"n-gram path: {chunks_n} decode chunks, {spec_n} verifies"
+    )
+    assert chunks_m == 0 and spec_m >= 1, (
+        f"model-draft path: {chunks_m} decode chunks, {spec_m} verifies"
+    )
+    # zero-draft verifies must not tick the proposal metrics
+    SERVE_METRICS.reset()
+    got_z, _, _ = run(spec_ngram=2)
+    assert got_z == [ref]
+    snap = SERVE_METRICS.snapshot()
+    assert snap["spec_proposed"] == 0 and snap["spec_accepted"] == 0

@@ -20,7 +20,7 @@ import pytest
 from hypha_tpu import codec, messages
 from hypha_tpu.executor.block_cache import PrefixBlockCache, chain_hashes
 from hypha_tpu.executor.generate import generate
-from hypha_tpu.executor.pool import DecodePool
+from hypha_tpu.executor.pool import DecodePool, SpeculationState
 from hypha_tpu.executor.serialization import flat_leaf_map, replace_leaves
 from hypha_tpu.messages import (
     GenerateResponse,
@@ -287,8 +287,10 @@ def test_pin_round_defers_rolls_back_then_rolls_forward(tiny_llama):
 
 def test_swap_resets_speculation_accept_state(tiny_llama):
     """Per-lane accept EWMAs were learned under the old weights: a swap
-    re-arms them optimistically and clears the n-gram backoff cooldown
-    (context/index caches stay — emitted tokens are facts)."""
+    re-arms them optimistically and clears the backoff cooldown
+    (context/index caches stay — emitted tokens are facts). The state is
+    the ONE SpeculationState shared by the n-gram and model-draft
+    proposers, so the reset reaches both."""
     model, params, _ = tiny_llama
     pool = DecodePool(
         model, params, slots=2, max_len=64, steps_per_call=2,
@@ -297,16 +299,61 @@ def test_swap_resets_speculation_accept_state(tiny_llama):
     )
     try:
         row = SimpleNamespace(
-            spec_ctx=[1, 2, 3], spec_ewma=0.1, spec_cooldown=7
+            spec=SpeculationState(
+                ctx=[1, 2, 3], ewma=0.1, cooldown=7, primed=True
+            )
         )
-        cold = SimpleNamespace(spec_ctx=None, spec_ewma=0.0, spec_cooldown=4)
+        cold = SimpleNamespace(spec=SpeculationState(cooldown=4))
         pool._lane_rows[98] = row
         pool._lane_rows[99] = cold
         pool._reset_spec_state()
-        assert row.spec_ewma == float(pool.spec_draft)
-        assert row.spec_cooldown == 0
-        assert cold.spec_ewma == 0.0  # never speculated: nothing to re-arm
-        assert cold.spec_cooldown == 0
+        assert row.spec.ewma == float(pool.spec_draft)
+        assert row.spec.cooldown == 0
+        assert cold.spec.ewma == 0.0  # never speculated: nothing to re-arm
+        assert cold.spec.cooldown == 0
+    finally:
+        pool._lane_rows.clear()
+        pool.close()
+
+
+def test_swap_rearms_model_draft_accept_state(tiny_llama):
+    """Regression (shared-EWMA rider): a draft model swapped mid-round
+    must NOT inherit the stale accept EWMA the old weights earned. The
+    self-draft reads the LIVE served tree, so after _apply_swap both the
+    draft's parameters and its accept statistics must be fresh."""
+    model, params, _ = tiny_llama
+    pool = DecodePool(
+        model, params, slots=2, max_len=64, steps_per_call=2,
+        block_size=8, num_blocks=16, prefill_chunk=8,
+        spec_layers=1, spec_draft=3,
+    )
+    try:
+        # a lane parked by model-draft misses under the OLD weights
+        row = SimpleNamespace(
+            spec=SpeculationState(ewma=0.05, cooldown=8, primed=True)
+        )
+        pool._lane_rows[98] = row
+        embed = np.asarray(pool._vars["params"]["embed_tokens"])
+        # stage directly (no _WAKE) so THIS thread deterministically
+        # performs the apply + reset instead of racing the serve loop
+        with pool._swap_lock:
+            pool._pending_swap = {
+                "updates": {
+                    "embed_tokens": (np.ones_like(embed) * 1e-3).astype(
+                        np.float32
+                    )
+                },
+                "round": 1, "generation": 0, "keep_previous": False,
+                "staged_at": time.monotonic(),
+            }
+        pool._apply_swap()
+        assert row.spec.ewma == float(pool.spec_draft)
+        assert row.spec.cooldown == 0
+        # and the draft's own parameters ARE the swapped ones (live view)
+        after = np.asarray(
+            pool._draft_vars()["params"]["embed_tokens"]
+        )
+        np.testing.assert_allclose(after, embed + 1e-3, rtol=0, atol=1e-6)
     finally:
         pool._lane_rows.clear()
         pool.close()
