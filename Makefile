@@ -62,18 +62,19 @@ shardbench:
 	JAX_PLATFORMS=cpu $(PYTHON) benchmarks/shardbench.py \
 		--chaos kill-ps --out SHARDBENCH_r08.json
 
-# Paged KV serving r07: the r06 sections (block-granular admission >=1.5x
+# Paged KV serving r08: the r07 sections (block-granular admission >=1.5x
 # concurrency at equal KV memory, late-arrival p50 <=2x under a 4k prompt,
 # routed 2-worker >=1.8x under 100 clients, prefix-cache TTFT and tok/s
-# >=2x, n-gram speculation step-speedup >=1.3x) plus ragged paged
-# attention (speedup monotone in falling occupancy, >=1.5x at 25%), int8
-# KV blocks (>=2x concurrent lanes at equal cache bytes, bounded logits
-# delta) and model-draft speculation (beats n-gram on accept rate and
-# step speedup on low-repetition traffic). Writes SERVBENCH_<round>.json
-# — the --round tag keeps re-runs from overwriting older artifacts
-# (docs/serving.md / docs/performance.md).
+# >=2x, n-gram speculation step-speedup >=1.3x, ragged paged attention,
+# int8 KV blocks, model-draft speculation) plus the fleet prefix cache
+# (cold-start TTFT via cross-worker block pull within 2x of a local hit
+# and >=2x better than re-prefill, fleet hit rate above the local-only
+# baseline) and KV migration vs recompute (prompt-length crossover,
+# LinkTable policy recomputing under a bw-cap link). Writes
+# SERVBENCH_<round>.json — the --round tag keeps re-runs from overwriting
+# older artifacts (docs/serving.md / docs/performance.md).
 servbench:
-	JAX_PLATFORMS=cpu $(PYTHON) benchmarks/servbench.py --round r07
+	JAX_PLATFORMS=cpu $(PYTHON) benchmarks/servbench.py --round r08
 
 # Seconds-scale servbench for CI (tiny sections, same assertions with
 # smoke-adjusted floors).

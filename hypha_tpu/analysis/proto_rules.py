@@ -94,6 +94,16 @@ _GENERATION_FIELDS = {"generation", "scheduler_generation", "ps_generation"}
 # the restart-handshake field names.
 _SWAP_FIELDS = {"weight_round", "swap_round", "swap"}
 
+# Field names carrying content-addressed KV-block identity (the fleet
+# prefix cache / KV migration wire, hypha_tpu.executor.block_cache chain
+# hashes). Their presence obliges the message to carry BOTH a round tag
+# AND a generation tag (``msg-block-needs-generation``): chain hashes
+# address token CONTENT, but the cached K/V were computed under specific
+# weights — a block message missing its (weight_round, weight_generation)
+# stamp would let a hot swap's stale activations be shipped into a
+# fresh-weights pool (silently wrong tokens, not a decode error).
+_BLOCK_FIELDS = {"block_hash", "chain_hash", "block_hashes", "chain_hashes"}
+
 
 def _modules():
     from hypha_tpu import messages
@@ -538,6 +548,50 @@ def check_swap_tags(registry=None) -> list[Violation]:
     return out
 
 
+def check_block_tags(registry=None) -> list[Violation]:
+    """Any message with content-addressed KV-block identity must carry
+    round AND generation tags.
+
+    Structural and two-sided, like :func:`check_swap_tags`: EVERY
+    registered dataclass that grows a ``block_hash``/``chain_hash``/
+    ``block_hashes``/``chain_hashes`` field must pair it with both a
+    round tag (``weight_round``, or ``round``/``epoch``/``round_num``)
+    and a generation tag (``weight_generation``, or the restart-handshake
+    generation fields) — a chain hash addresses token content, but the
+    K/V it names were computed under specific weights, and an unstamped
+    block transfer would ship pre-swap activations into a post-swap pool
+    as silently wrong tokens.
+    """
+    messages, _ = _modules()
+    registry = registry if registry is not None else _package_registry(messages)
+    round_ok = _TAG_FIELDS | {"weight_round"}
+    gen_ok = _GENERATION_FIELDS | {"weight_generation"}
+    out: list[Violation] = []
+    for name, cls in sorted(registry.items()):
+        if not dataclasses.is_dataclass(cls):
+            continue
+        fields = {f.name for f in dataclasses.fields(cls)}
+        if not fields & _BLOCK_FIELDS:
+            continue
+        missing = [
+            half
+            for half, ok in (("round", round_ok), ("generation", gen_ok))
+            if not fields & ok
+        ]
+        if missing:
+            out.append(
+                _violation(
+                    cls,
+                    "msg-block-needs-generation",
+                    f"{name}: carries {sorted(fields & _BLOCK_FIELDS)} "
+                    f"but no {' or '.join(missing)} tag — an unstamped "
+                    f"KV-block transfer ships stale-weight activations "
+                    f"across a hot swap as silently wrong tokens",
+                )
+            )
+    return out
+
+
 def check_protocol_map(registry=None, manifest=None, values=None) -> list[Violation]:
     messages, _ = _modules()
     registry = registry if registry is not None else _package_registry(messages)
@@ -631,5 +685,6 @@ def check() -> list[Violation]:
         + check_tree_tags()
         + check_generation_tags()
         + check_swap_tags()
+        + check_block_tags()
         + check_protocol_map()
     )

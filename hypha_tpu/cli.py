@@ -306,6 +306,9 @@ async def _run_scheduler(conf: SchedulerConfig) -> None:
                 pool_ragged=conf.job.serve_ragged,
                 pool_kv_quant=conf.job.serve_kv_quant,
                 pool_spec_layers=conf.job.serve_spec_layers,
+                fleet_cache=conf.job.serve_fleet_cache,
+                kv_migration=conf.job.serve_kv_migration,
+                fleet_digest_k=conf.job.serve_digest_k,
                 prefix_affinity=conf.job.serve_prefix_affinity,
                 eos_token_id=(
                     None

@@ -95,6 +95,11 @@ class PrefixBlockCache:
         self.generation = 0
         self._gen_of: dict[int, int] = {}  # block -> generation registered
         self.stale_drops = 0  # stale-generation registrations dropped
+        # Fleet cache: per-hash hit tally feeding the bounded ServeLoad
+        # digest — registered-but-never-hit chains count 0 so a fresh
+        # worker still advertises what it holds (the fleet can't bootstrap
+        # off hits that haven't happened yet).
+        self._hits: dict[int, int] = {}  # content hash -> lookup hits
 
     # ----------------------------------------------------------- querying
 
@@ -187,8 +192,49 @@ class PrefixBlockCache:
             if self._ref[b] == 0:
                 del self._lru[b]
             self._ref[b] += 1
+            self._hits[h] = self._hits.get(h, 0) + 1
             out.append(b)
         return out
+
+    # -------------------------------------------------------- fleet cache
+
+    def block_for(self, h: int) -> int | None:
+        """Physical block registered under ``h`` at the CURRENT weight
+        generation, else None (stale holders are dropped on contact, the
+        same lazy invalidation peek/lookup apply)."""
+        b = self._by_hash.get(h)
+        if b is None:
+            return None
+        if self._stale(b):
+            self._drop_stale(b)
+            return None
+        return b
+
+    def resolve_chain(self, hashes: list) -> list:
+        """Physical ids of the longest cached prefix of ``hashes``
+        WITHOUT taking references — the BlockPull serving path. The
+        serve thread extracts the rows in the same loop iteration, so
+        the blocks cannot move under the read."""
+        out: list = []
+        for h in hashes:
+            b = self.block_for(h)
+            if b is None:
+                break
+            out.append(b)
+        return out
+
+    def hot_chains(self, k: int) -> list:
+        """Bounded digest for ServeLoad piggybacking: the top-``k``
+        currently-registered chain hashes by hit count, as
+        ``[hash, hits]`` pairs (hottest first). Hashes whose block was
+        evicted are pruned from the tally here, so the digest only ever
+        advertises chains a puller can actually fetch."""
+        if not self.caching or k <= 0:
+            return []
+        live = {h: self._hits.get(h, 0) for h in self._by_hash}
+        self._hits = dict(live)  # prune tallies for evicted content
+        top = sorted(live.items(), key=lambda kv: (-kv[1], kv[0]))[:k]
+        return [[h, c] for h, c in top]
 
     def alloc(self) -> int | None:
         """One fresh block with ref=1: free list first, then evict the

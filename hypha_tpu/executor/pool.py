@@ -93,7 +93,12 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ..ops.kvcache import copy_blocks
+from ..ops.kvcache import (
+    _POOL_LEAVES,
+    copy_blocks,
+    extract_blocks,
+    insert_blocks,
+)
 from ..telemetry import SERVE_METRICS
 from ..telemetry import trace
 from ..telemetry.flight import FLIGHT
@@ -102,6 +107,14 @@ from .block_cache import PrefixBlockCache, chain_hashes
 __all__ = ["DecodePool", "PoolBusy", "supports_pool", "supports_paging"]
 
 log = logging.getLogger("hypha.executor.pool")
+
+
+class StaleBlockGeneration(RuntimeError):
+    """A shipped KV chain was computed under different weights than this
+    pool currently serves: chain hashes address token content, not
+    weights, so admission rejects the stamp mismatch rather than silently
+    serving old-weight KV (the receiving side of hypha-lint's
+    ``msg-block-needs-generation`` contract)."""
 
 
 class PoolBusy(RuntimeError):
@@ -265,6 +278,9 @@ class DecodePool:
         spec_layers: int = 0,
         draft_model: Any = None,
         draft_params: Any = None,
+        fleet_cache: bool = False,
+        kv_migration: bool = False,
+        digest_k: int = 32,
     ) -> None:
         if not supports_pool(model):
             raise ValueError(
@@ -289,6 +305,15 @@ class DecodePool:
             )
         if spec_layers > 0 and draft_model is not None:
             raise ValueError("spec_layers and draft_model are exclusive")
+        if (fleet_cache or kv_migration) and not (
+            self._paged and prefix_cache
+        ):
+            # Both features trade in content-addressed blocks: without the
+            # chain-hash registry there is nothing to ship or land on.
+            raise ValueError(
+                "fleet_cache / kv_migration require paged mode with "
+                "prefix_cache=True"
+            )
         if draft_model is not None and draft_params is None:
             raise ValueError("draft_model requires draft_params")
         if spec_layers > 0:
@@ -442,6 +467,22 @@ class DecodePool:
             self._h_table = np.full(
                 (slots, max_blocks), self.num_blocks, np.int32
             )
+        # Fleet prefix cache + KV migration (content-addressed block
+        # shipping): the digest is refreshed by the serve thread each
+        # iteration and read whole (one attribute load) by the heartbeat
+        # thread; serve_chain/inject_chain run as serve-thread ops so the
+        # allocator's no-locking contract holds.
+        self.fleet_cache = bool(fleet_cache)
+        self.kv_migration = bool(kv_migration)
+        self.digest_k = max(int(digest_k), 1)
+        self.fleet_digest: list = []
+        self._ops: list = []  # (fn, Future) run on the serve thread
+        self._ops_lock = threading.Lock()
+        self._migrate_policy = None  # (est_bytes, tokens) -> target | None
+        self._migrate_send = None  # (ticket) -> None, any-thread handoff
+        self._prefill_rate = 0.0  # tokens/s EWMA (transfer-vs-recompute)
+        self._block_bytes = 0  # lazy: wire bytes per shipped block
+        self.migrated_out = 0
         self._queue: "queue.Queue[_Group | None]" = queue.Queue()
         self._waiting: list[_Group] = []
         # Guards the closed-check + enqueue in submit() against the serve
@@ -484,6 +525,151 @@ class DecodePool:
     def live_rows(self) -> int:
         """Rows currently decoding/prefilling (either mode)."""
         return len(self._rows) + len(self._lane_rows)
+
+    # ------------------------------------- fleet cache / migration plumbing
+
+    def run_op(self, fn) -> Future:
+        """Run ``fn()`` on the serve thread at the next chunk boundary
+        (thread-safe). The allocator and the device cache are serve-thread
+        property — every cross-thread touch (chain serving, block
+        injection) funnels through here instead of growing locks."""
+        fut: Future = Future()
+        with self._ops_lock:
+            if self._closed:
+                fut.set_exception(RuntimeError("pool is closed"))
+                return fut
+            self._ops.append((fn, fut))
+        self._queue.put(_WAKE)
+        return fut
+
+    def _drain_ops(self) -> None:
+        while True:
+            with self._ops_lock:
+                if not self._ops:
+                    return
+                fn, fut = self._ops.pop(0)
+            if not fut.set_running_or_notify_cancel():
+                continue
+            try:
+                fut.set_result(fn())
+            except Exception as exc:  # noqa: BLE001 — delivered to caller
+                fut.set_exception(exc)
+
+    def serve_chain(self, hashes: list) -> Future:
+        """BlockPull serving: resolve the longest cached prefix of
+        ``hashes`` and extract its pool rows (every leaf — payload and
+        int8 scales — verbatim). Resolves to ``{"hashes", "leaves"}`` or
+        None when nothing is cached."""
+        return self.run_op(lambda: self._op_serve_chain(list(hashes)))
+
+    def inject_chain(
+        self,
+        hashes: list,
+        leaves: dict,
+        weight_round,
+        weight_generation,
+    ) -> Future:
+        """Land shipped blocks (``extract_blocks`` layout, one row-run
+        per hash) as registered ref-0 cache entries, so the next
+        admission of the same prefix is an ordinary cache hit. Resolves
+        to the number of blocks injected; raises
+        :class:`StaleBlockGeneration` when the stamp doesn't match the
+        weights this pool currently serves."""
+        return self.run_op(
+            lambda: self._op_inject_chain(
+                list(hashes), leaves, weight_round, weight_generation
+            )
+        )
+
+    def set_migrate_hooks(self, policy, send) -> None:
+        """Install the preemption-migration hooks (worker side):
+        ``policy(est_bytes, resume_tokens) -> target | None`` picks
+        transfer vs recompute; ``send(ticket)`` hands the extracted state
+        to the async sender. Both run ON the serve thread and must not
+        block."""
+        self._migrate_policy = policy
+        self._migrate_send = send
+
+    def _block_nbytes(self) -> int:
+        """Wire payload bytes one shipped block carries, summed over
+        every pool leaf (k/v payload + int8 scale rows)."""
+        if self._block_bytes:
+            return self._block_bytes
+        n = 0
+
+        def visit(path, leaf):
+            nonlocal n
+            if getattr(path[-1], "key", None) in _POOL_LEAVES:
+                n += (
+                    self.block_size
+                    * int(np.prod(leaf.shape[1:]))
+                    * leaf.dtype.itemsize
+                )
+            return leaf
+
+        jax.tree_util.tree_map_with_path(visit, self._cache)
+        self._block_bytes = n
+        return n
+
+    def prefill_cost_s(self, tokens: int) -> float | None:
+        """Estimated seconds to prefill ``tokens`` locally (measured
+        chunked-prefill throughput EWMA); None until the first prefill
+        has been timed."""
+        rate = self._prefill_rate
+        return tokens / rate if rate > 0 else None
+
+    def _op_serve_chain(self, hashes: list) -> dict | None:
+        if not (self._paged and self.prefix_cache):
+            raise RuntimeError("chain serving requires the prefix cache")
+        ids = self._alloc.resolve_chain(hashes)
+        if not ids:
+            return None
+        return {
+            "hashes": list(hashes[: len(ids)]),
+            "leaves": extract_blocks(self._cache, ids, self.block_size),
+        }
+
+    def _op_inject_chain(
+        self, hashes: list, leaves: dict, wr, wg
+    ) -> int:
+        if not (self._paged and self.prefix_cache):
+            raise RuntimeError("chain injection requires the prefix cache")
+        if (wr, wg) != self.weight_state():
+            raise StaleBlockGeneration(
+                f"shipped blocks stamped {(wr, wg)}, pool serves "
+                f"{self.weight_state()}"
+            )
+        bs = self.block_size
+        n = len(hashes)
+        taken: list = []  # (block, hash)
+        rows: list = []  # index into the shipped row-runs
+        for i, h in enumerate(hashes):
+            if self._alloc.block_for(h) is not None:
+                continue  # already cached under the serving weights
+            if self._lane_rows and self._alloc.free_count() <= max(
+                self.reserve_blocks, 0
+            ):
+                break  # don't starve live lanes to warm the cache
+            b = self._alloc.alloc()
+            if b is None:
+                break
+            taken.append((b, h))
+            rows.append(i)
+        if not taken:
+            return 0
+        sub = {
+            key: a.reshape(n, bs, *a.shape[1:])[rows].reshape(
+                len(rows) * bs, *a.shape[1:]
+            )
+            for key, a in leaves.items()
+        }
+        self._cache = insert_blocks(
+            self._cache, [b for b, _ in taken], sub, bs
+        )
+        for b, h in taken:
+            self._alloc.register(b, h)
+            self._alloc.release(b)  # ref 0 + registered -> parks in LRU
+        return len(taken)
 
     # ----------------------------------------------------- weight swapping
 
@@ -820,6 +1006,11 @@ class DecodePool:
                 if item is not None and item is not _WAKE:
                     self._waiting.append(item)
             self._backlog = 0
+        with self._ops_lock:
+            ops, self._ops = self._ops, []
+        for _fn, fut in ops:
+            if not fut.done():
+                fut.set_exception(exc)
         for g in self._waiting:
             if not g.fut.done():
                 g.fut.set_exception(exc)
@@ -1019,6 +1210,11 @@ class DecodePool:
                 # swap (or rollback) flips here — atomically w.r.t. every
                 # program dispatched below.
                 self._apply_swap()
+                # Cross-thread ops (chain serving / injection) run at the
+                # same boundary — after a staged swap flips, so a stamp
+                # check inside an op sees the weights the NEXT program
+                # will dispatch with.
+                self._drain_ops()
                 if self._paged:
                     self._step_paged()
                 else:
@@ -1179,6 +1375,10 @@ class DecodePool:
             SERVE_METRICS.cache_state(
                 self._alloc.cached_count(), self._alloc.shared_count()
             )
+        if self.fleet_cache:
+            # Refreshed here (serve thread), read whole by the heartbeat
+            # thread — a single attribute load, no locking needed.
+            self.fleet_digest = self._alloc.hot_chains(self.digest_k)
 
     def _admit_paged(self) -> None:
         """FIFO block-granular admission: the head group is admitted when
@@ -1522,6 +1722,7 @@ class DecodePool:
         self._push_rowvars()
         # A paged prefill chunk can serve several groups; parent on the
         # first row's request (chunks are FIFO, so it is the oldest).
+        t0 = time.monotonic()
         with trace.span(
             "prefill",
             parent=(pre + spec)[0].group.traceparent,
@@ -1536,6 +1737,19 @@ class DecodePool:
         if spec:
             self.spec_chunks += 1
         nxt_host = np.asarray(nxt)  # [slots, P] per-column greedy tokens
+        if pre:
+            # Measured prefill throughput (host sync above closes the
+            # dispatch): the recompute side of the transfer-vs-recompute
+            # policy. Spec verifies share the program but not the shape
+            # of a resume prefill, so only prefill lanes count.
+            dt = time.monotonic() - t0
+            if dt > 0:
+                rate = P * len(pre) / dt
+                self._prefill_rate = (
+                    rate
+                    if self._prefill_rate == 0
+                    else 0.7 * self._prefill_rate + 0.3 * rate
+                )
         for r in pre:
             base = r.pos
             r.pos = min(r.pos + P, r.window)
@@ -1644,7 +1858,16 @@ class DecodePool:
         emitted tokens fold into the resume prompt at re-admission, so
         greedy continuation is token-identical to an uncontended run.
         With the prefix cache on, the freed full blocks stay cached, so
-        the resume re-prefills only the uncached tail."""
+        the resume re-prefills only the uncached tail.
+
+        With KV migration on, a single-prompt victim whose link beats
+        local recompute ships instead: its computed blocks + cursor +
+        emitted tokens leave for the router-named target and the group
+        exits this pool's books entirely (the async sender resolves the
+        future from the target's MigrateAck, or requeues the group here
+        on any failure — exactly this method's recompute path)."""
+        if self._try_migrate(group):
+            return
         for r in list(group.rows.values()):
             if r.slot < 0 or r.done:
                 continue
@@ -1658,6 +1881,95 @@ class DecodePool:
             "serve.preempt", rows=len(group.rows), order=group.order,
             emitted=sum(len(r.emitted) for r in group.rows.values()),
         )
+
+    def _try_migrate(self, group: _Group) -> bool:
+        """Attempt to ship a preemption victim instead of requeueing it.
+        Single-prompt groups only (one lane's state travels as one
+        MigrateRequest); multi-prompt groups keep recompute-resume. True
+        = the group left this pool's books (sender owns its future)."""
+        if not (
+            self.kv_migration
+            and self._migrate_policy is not None
+            and self._migrate_send is not None
+            and len(group.prompts) == 1
+        ):
+            return False
+        r = group.rows.get(0)
+        if r is None or r.slot < 0 or r.done:
+            return False
+        bs = self.block_size
+        full = r.prompt + r.emitted
+        nfull = min(min(r.pos, len(full)) // bs, len(r.blocks))
+        if nfull <= 0:
+            return False  # nothing computed worth shipping
+        try:
+            target = self._migrate_policy(
+                nfull * self._block_nbytes(), len(full)
+            )
+        except Exception:  # noqa: BLE001 — policy is a worker hook
+            log.exception("migrate policy failed; recompute-resume")
+            return False
+        if target is None:
+            return False  # recompute wins (or no router hint yet)
+        hashes = chain_hashes(full, bs)[:nfull]
+        leaves = extract_blocks(self._cache, r.blocks[:nfull], bs)
+        wr, wg = self.weight_state()
+        ticket = {
+            "group": group,
+            "prompt": list(r.prompt),
+            "emitted": list(r.emitted),
+            "budget": max(r.budget - len(r.emitted), 0),
+            "hashes": hashes,
+            "block_size": bs,
+            "leaves": leaves,
+            "weight_round": wr,
+            "weight_generation": wg,
+            "target": target,
+        }
+        self._release_lane(r, register=True)
+        self.preemptions += 1
+        self.migrated_out += 1
+        SERVE_METRICS.preemptions.add(1)
+        FLIGHT.record(
+            "serve.migrate_out", order=group.order, blocks=nfull,
+            emitted=len(ticket["emitted"]),
+        )
+        try:
+            self._migrate_send(ticket)
+        except Exception:  # noqa: BLE001 — sender is a worker hook
+            log.exception("migrate send failed; recompute-resume")
+            self.requeue_migrated(group)
+        return True
+
+    def requeue_migrated(self, group: _Group) -> None:
+        """Any-thread fallback: a migration attempt failed (target busy,
+        stale generation, link died) — hand the group back to the serve
+        loop for plain recompute-resume, today's preemption behavior."""
+        with self._submit_lock:
+            if self._closed:
+                if not group.fut.done():
+                    group.fut.set_exception(RuntimeError("pool is closed"))
+                return
+            self._backlog += 1
+            self._queue.put(group)
+
+    def complete_migrated(self, group: _Group, tokens: list) -> None:
+        """Any-thread completion: the migration target decoded the rest
+        of the budget — resolve the original client future with
+        ``emitted-before-preempt + remote continuation`` (same latency
+        accounting as a locally finished group)."""
+        r = group.rows[0]
+        r.emitted = list(r.emitted) + [int(t) for t in tokens]
+        r.done = True
+        trace.finish(group.trace_span)
+        group.trace_span = None
+        if group.fut.done():
+            return
+        if group.t_submit:
+            SERVE_METRICS.request_finished(
+                (time.monotonic() - group.t_submit) * 1e3
+            )
+        group.fut.set_result([r.emitted])
 
     def _run_decode_chunk(self, dec: list) -> None:
         K = self.steps_per_call

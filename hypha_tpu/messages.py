@@ -36,6 +36,7 @@ __all__ = [
     "PROTOCOL_SERVE",
     "PROTOCOL_STREAM",
     "PROTOCOL_SHARD",
+    "PROTOCOL_BLOCKS",
     "TOPIC_WORKER",
     "TRAIN_EXECUTOR_NAME",
     "AGGREGATE_EXECUTOR_NAME",
@@ -123,6 +124,13 @@ PROTOCOL_STREAM = "/hypha-stream/0.0.1"
 # ShardMap is the placement announcement riding inside job specs; the
 # per-push shard id travels as the ``shard`` header key next to ``round``.
 PROTOCOL_SHARD = "/hypha-shard/0.0.1"
+# Fleet KV-block plane (serving fleet cache + request migration): paged KV
+# blocks are content-addressed by chain hash (pure functions of the token
+# prefix), so a worker that never prefilled a hot prefix can PULL the
+# finished blocks from a holder (BlockPull/BlockChain) and a preempted
+# request can MIGRATE its computed KV to a less-loaded worker
+# (MigrateRequest/MigrateAck) instead of recompute-resuming.
+PROTOCOL_BLOCKS = "/hypha-blocks/0.0.1"
 TOPIC_WORKER = "hypha/worker"
 
 # Executor implementation names: what the scheduler asks for at auction and
@@ -832,6 +840,21 @@ class InferExecutorConfig:
     # static-weights job ships — is omitted from the wire, so the whole
     # subsystem off keeps today's exact bytes (golden-pinned).
     serve_follow_rounds: WeightFollow | None = None
+    # Fleet prefix cache (scheduler.serving directory + /hypha-blocks/0.0.1
+    # pulls): workers piggyback a bounded digest of their hottest cached
+    # chain hashes on ServeLoad and pull remotely-held chains instead of
+    # re-prefilling. Additive fields: None — the only value a
+    # fleet-cache-off job ships — is omitted from the wire, so both
+    # subsystems unset keep today's exact bytes (golden-pinned).
+    pool_fleet_cache: bool | None = None
+    # KV migration on preemption: ship a preempted request's computed
+    # blocks + cursor + emitted tokens to a router-named less-loaded
+    # worker instead of recompute-resuming (LinkTable bandwidth EWMA
+    # decides ship-vs-recompute per preemption).
+    pool_kv_migration: bool | None = None
+    # Digest bound: top-K hot chains advertised per heartbeat (None =
+    # derive, 32).
+    fleet_digest_k: int | None = None
 
 
 @register
@@ -849,6 +872,13 @@ class GenerateRequest:
     # rides to the serving worker so its prefill/decode spans join the
     # request's trace. Additive field: None is omitted from the wire.
     traceparent: str | None = None
+    # Fleet prefix cache: when the router's directory knows this prompt's
+    # longest cached prefix lives on ANOTHER backend, it names that holder
+    # here and the admitting worker pulls the chain (BlockPull on
+    # /hypha-blocks/0.0.1) instead of re-prefilling. Additive fields: None
+    # is omitted from the wire, so fleet cache off keeps today's bytes.
+    pull_peer: str | None = None
+    pull_serve: str | None = None
 
 
 @register
@@ -902,12 +932,119 @@ class ServeLoad:
     # wire, so heartbeats stay byte-identical with the subsystem off.
     weight_round: int | None = None
     weight_generation: int | None = None
+    # Fleet prefix cache: bounded digest of this worker's hottest cached
+    # chain hashes — list of ``[chain_hash, hit_count]`` pairs, top-K by
+    # hit count (K = fleet_digest_k). The router folds these into its
+    # block-hash -> holders directory. Additive field: None — the only
+    # value a fleet-cache-off worker ships — is omitted from the wire, so
+    # heartbeats stay byte-identical with the subsystem off.
+    cache_digest: list | None = None
 
 
 @register
 @dataclass(slots=True)
 class ServeLoadAck:
     ok: bool = True
+    # KV migration: the router piggybacks its current pick for "a
+    # less-loaded worker" on the heartbeat ack, so a worker that preempts
+    # moments later already knows where to ship the request. Additive
+    # fields: None is omitted from the wire — migration off keeps the
+    # one-byte ack exactly as it is today.
+    migrate_peer: str | None = None
+    migrate_serve: str | None = None
+
+
+@register
+@dataclass(slots=True)
+class BlockPull:
+    """Fleet prefix cache: puller -> holder chain request
+    (``/hypha-blocks/0.0.1``).
+
+    ``chain_hashes`` is the ROOT-FIRST hash list of the prompt's full
+    blocks (executor.block_cache.chain_hashes) — the full list travels
+    because chain hashes are one-way: a holder cannot derive the prefix
+    hashes from a tail hash alone. The holder serves the longest cached
+    prefix of the chain. The ``(weight_round, weight_generation)`` stamp
+    is the PULLER's serving weights: KV computed under different weights
+    is wrong to reuse, so a mismatched holder refuses rather than ships
+    (hypha-lint ``msg-block-needs-generation``).
+    """
+
+    serve_name: str = ""
+    chain_hashes: list | None = None  # list[int], root first
+    weight_round: int | None = None
+    weight_generation: int | None = None
+
+
+@register
+@dataclass(slots=True)
+class BlockChain:
+    """Fleet prefix cache: holder -> puller chain payload.
+
+    ``leaves`` maps each pool-leaf path (k / v and, under int8 KV quant,
+    k_scale / v_scale — shipped verbatim so quantized blocks land
+    bit-identical) to ``[raw_bytes, dtype_str, shape]``. ``hashes`` is
+    the served root-first prefix of the requested chain; rows are
+    concatenated in the same order, ``block_size`` positions per block.
+    The weight stamp echoes the weights the blocks were computed under —
+    the puller rejects a stale stamp at admission instead of silently
+    serving old-weight KV.
+    """
+
+    ok: bool = True
+    chain_hash: int | None = None  # deepest served hash (= hashes[-1])
+    hashes: list | None = None  # list[int], root first
+    block_size: int | None = None
+    leaves: dict | None = None  # leaf path -> [bytes, dtype, shape]
+    weight_round: int | None = None
+    weight_generation: int | None = None
+    error: str | None = None  # ok=False: "stale-generation" | "not-cached"
+
+
+@register
+@dataclass(slots=True)
+class MigrateRequest:
+    """KV migration: preempting worker -> router-named target
+    (``/hypha-blocks/0.0.1``).
+
+    Ships the preempted request's computed state — full KV blocks (same
+    ``leaves`` encoding as BlockChain), the chain hashes naming them, the
+    original prompt, the tokens emitted so far, and the remaining token
+    budget. The target injects the blocks into its cache and admits
+    ``prompt + emitted`` as a normal request: admission's prefix-hit path
+    skips straight past the transferred positions, so only the partial
+    tail block re-prefills. A stale weight stamp is rejected at admission
+    (``msg-block-needs-generation``).
+    """
+
+    serve_name: str = ""
+    prompt: list | None = None  # list[int], the original prompt
+    emitted: list | None = None  # list[int], tokens decoded before preempt
+    budget: int | None = None  # remaining new tokens to decode
+    chain_hashes: list | None = None  # list[int], root first
+    block_size: int | None = None
+    leaves: dict | None = None  # leaf path -> [bytes, dtype, shape]
+    weight_round: int | None = None
+    weight_generation: int | None = None
+
+
+@register
+@dataclass(slots=True)
+class MigrateAck:
+    """KV migration: target -> source completion.
+
+    ``tokens`` is the target's continuation (the remaining budget decoded
+    after the transferred positions); the source resolves the original
+    client future with ``emitted + tokens``, so the client-facing
+    GenerateRequest protocol is unchanged. ok=False (busy / stale
+    generation / injection failure) sends the source down today's
+    recompute-resume path.
+    """
+
+    ok: bool = True
+    tokens: list | None = None  # list[int], the continuation
+    error: str | None = None
+    retry_after_ms: float | None = None
 
 
 @register
@@ -1460,6 +1597,9 @@ declare_protocol(PROTOCOL_HEALTH, "HealthRequest", "HealthResponse")
 declare_protocol(PROTOCOL_PROGRESS, "Progress", "ProgressResponse")
 declare_protocol(PROTOCOL_GENERATE, "GenerateRequest", "GenerateResponse")
 declare_protocol(PROTOCOL_SERVE, "ServeLoad", "ServeLoadAck")
+declare_protocol(
+    PROTOCOL_BLOCKS, "BlockPull", "BlockChain", "MigrateRequest", "MigrateAck"
+)
 declare_values("WeightFollow")
 declare_protocol(PROTOCOL_STREAM, "FragmentTag")
 declare_protocol(PROTOCOL_SHARD, "ShardMap")

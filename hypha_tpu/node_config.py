@@ -344,6 +344,31 @@ class JobSection:
             "(routed deployments only)"
         },
     )
+    serve_fleet_cache: bool = field(
+        default=False,
+        metadata={
+            "doc": "serve jobs: fleet-wide prefix cache — backends "
+            "advertise cached chain hashes on heartbeats, the router "
+            "routes to actual holders and names a pull source so cold "
+            "workers fetch KV blocks instead of re-prefilling "
+            "(requires serve_prefix_cache)"
+        },
+    )
+    serve_kv_migration: bool = field(
+        default=False,
+        metadata={
+            "doc": "serve jobs: migrate a preempted request's KV blocks "
+            "+ cursor to a less-loaded worker instead of recomputing "
+            "from scratch (requires serve_prefix_cache)"
+        },
+    )
+    serve_digest_k: int = field(
+        default=32,
+        metadata={
+            "doc": "serve jobs: fleet-cache digest bound — top-K hot "
+            "chain hashes piggybacked per ServeLoad heartbeat"
+        },
+    )
     dataset: str = field(
         default="mnist", metadata={"doc": "dataset name announced by a data node"}
     )
@@ -565,6 +590,15 @@ class JobSection:
                     "job.serve_spec_layers requires serve_block_size > 0 "
                     "(paged mode)"
                 )
+            if (
+                self.serve_fleet_cache or self.serve_kv_migration
+            ) and not self.serve_prefix_cache:
+                raise ConfigError(
+                    "job.serve_fleet_cache / serve_kv_migration require "
+                    "serve_prefix_cache (content-addressed blocks)"
+                )
+            if self.serve_digest_k < 1:
+                raise ConfigError("job.serve_digest_k must be >= 1")
             return  # dataset/rounds are train-only concerns
         if not self.dataset:
             raise ConfigError("job.dataset is required")

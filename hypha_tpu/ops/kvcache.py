@@ -41,8 +41,18 @@ from __future__ import annotations
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
-__all__ = ["update_kv_cache", "copy_blocks", "KV_QMAX"]
+__all__ = [
+    "update_kv_cache",
+    "copy_blocks",
+    "extract_blocks",
+    "insert_blocks",
+    "leaves_to_wire",
+    "leaves_from_wire",
+    "leaves_nbytes",
+    "KV_QMAX",
+]
 
 # int8 KV blocks reuse the compress/quant max-abs convention: payload in
 # [-127, 127], scale = maxabs / 127, zero/non-finite chunks ship all-zero
@@ -80,6 +90,77 @@ def copy_blocks(cache, src: jnp.ndarray, dst: jnp.ndarray, block_size: int):
         return leaf
 
     return jax.tree_util.tree_map_with_path(repl, cache)
+
+
+def extract_blocks(cache, ids, block_size: int) -> dict:
+    """Gather whole physical blocks out of every paged pool leaf of
+    ``cache`` as HOST arrays — the fleet-cache/migration export path.
+
+    ``ids``: physical block ids, root first. Returns a dict keyed by the
+    leaf's tree path (``jax.tree_util.keystr``) — k / v payload pools
+    and, in int8 mode, their k_scale / v_scale rows, shipped verbatim so
+    quantized blocks land bit-identical on the receiver. Each value is a
+    numpy array of ``len(ids) * block_size`` pool rows in chain order.
+    """
+    ids = jnp.asarray(list(ids), jnp.int32)
+    rows = (
+        ids[:, None] * block_size + jnp.arange(block_size)[None, :]
+    ).reshape(-1)
+    out: dict = {}
+
+    def visit(path, leaf):
+        if getattr(path[-1], "key", None) in _POOL_LEAVES:
+            out[jax.tree_util.keystr(path)] = np.asarray(leaf[rows])
+        return leaf
+
+    jax.tree_util.tree_map_with_path(visit, cache)
+    return out
+
+
+def insert_blocks(cache, ids, leaves: dict, block_size: int):
+    """Scatter shipped block rows (``extract_blocks`` layout, possibly a
+    row-subset) into the matching pool leaves of ``cache`` at physical
+    blocks ``ids``. Leaves are matched by tree path, so a pull between
+    pools with different leaf sets (e.g. f32 puller, int8 holder) only
+    lands the leaves both sides share — callers gate on matching pool
+    config before shipping. Returns the updated cache tree.
+    """
+    ids = jnp.asarray(list(ids), jnp.int32)
+    rows = (
+        ids[:, None] * block_size + jnp.arange(block_size)[None, :]
+    ).reshape(-1)
+
+    def repl(path, leaf):
+        data = leaves.get(jax.tree_util.keystr(path))
+        if data is None:
+            return leaf
+        return leaf.at[rows].set(jnp.asarray(data, leaf.dtype))
+
+    return jax.tree_util.tree_map_with_path(repl, cache)
+
+
+def leaves_to_wire(leaves: dict) -> dict:
+    """Encode extracted pool leaves for BlockChain/MigrateRequest:
+    leaf path -> ``[raw_bytes, dtype_str, shape]`` (codec ships bytes
+    natively, so payloads travel verbatim — no base64, no copies)."""
+    return {
+        key: [np.ascontiguousarray(a).tobytes(), str(a.dtype), list(a.shape)]
+        for key, a in leaves.items()
+    }
+
+
+def leaves_from_wire(wire: dict) -> dict:
+    """Inverse of :func:`leaves_to_wire`."""
+    return {
+        key: np.frombuffer(raw, dtype=dtype).reshape(shape)
+        for key, (raw, dtype, shape) in wire.items()
+    }
+
+
+def leaves_nbytes(leaves: dict) -> int:
+    """Payload bytes of an extracted/decoded leaf dict (the transfer-vs-
+    recompute policy's ``bytes`` side)."""
+    return int(sum(a.nbytes for a in leaves.values()))
 
 
 def _quantize_rows(x: jnp.ndarray):

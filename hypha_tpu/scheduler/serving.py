@@ -33,7 +33,20 @@ same elastic-recovery shape the training orchestrator uses for replicas
     backend that owns it (rendezvous hash over backend names), so the
     pool's automatic prefix cache (executor.pool ``prefix_cache``)
     stays warm where the traffic lands — with a load-skew guard so a
-    hot prefix never becomes a hot spot.
+    hot prefix never becomes a hot spot;
+  * ``fleet_cache`` upgrades affinity from a guess to a directory:
+    backends piggyback a bounded digest of their hottest cached chain
+    hashes on the same heartbeats, the router folds them into a
+    block-hash → holders map, routes to the backend that ACTUALLY
+    holds the deepest chain of the prompt (same skew guard; rendezvous
+    is the fallback when nobody advertises it), and when load forces
+    the request elsewhere it stamps a pull-from-holder instruction
+    (``pull_peer``/``pull_serve``) so the landing worker fetches the
+    KV blocks over ``/hypha-blocks`` instead of re-prefilling;
+  * ``kv_migration`` piggybacks a migration target (the least-loaded
+    OTHER backend) on each heartbeat ack, so a worker preempting a
+    request can ship its KV blocks + cursor there — admission skips
+    the transferred positions — instead of recomputing from scratch.
 
 ``num_workers=1`` (the default) keeps the exact single-deployment
 behavior this class always had: no router registration, the one backend
@@ -68,6 +81,7 @@ from ..messages import (
     ServeLoadAck,
     WorkerSpec,
 )
+from ..executor.block_cache import chain_hashes
 from ..network.node import Node, RequestError
 from ..resources import Resources
 from ..telemetry import SERVE_METRICS, instrument_node, global_telemetry
@@ -123,6 +137,9 @@ class ServingSupervisor:
         pool_ragged: bool = False,
         pool_kv_quant: str = "",
         pool_spec_layers: int = 0,
+        fleet_cache: bool = False,
+        kv_migration: bool = False,
+        fleet_digest_k: int = 32,
         prefix_affinity: bool = False,
         affinity_tokens: int = 64,
         affinity_skew: int = 4,
@@ -162,6 +179,12 @@ class ServingSupervisor:
             pool_ragged=pool_ragged,
             pool_kv_quant=pool_kv_quant,
             pool_spec_layers=pool_spec_layers,
+            # Fleet prefix cache / KV migration: None (the default) keeps
+            # the dispatched config byte-identical — additive fields are
+            # omitted from the wire, like serve_follow_rounds below.
+            pool_fleet_cache=True if fleet_cache else None,
+            pool_kv_migration=True if kv_migration else None,
+            fleet_digest_k=int(fleet_digest_k) if fleet_cache else None,
             queue_limit=queue_limit,
             eos_token_id=eos_token_id,
             load_report_s=load_report_s if self.route else 0.0,
@@ -185,6 +208,14 @@ class ServingSupervisor:
         self.prefix_affinity = bool(prefix_affinity)
         self._affinity_tokens = max(int(affinity_tokens), 1)
         self._affinity_skew = max(int(affinity_skew), 0)
+        # Fleet prefix cache directory: backend name -> {chain_hash:
+        # hit count}, rebuilt wholesale from each heartbeat's bounded
+        # digest (so staleness is at most one heartbeat interval plus
+        # whatever evicted since — admission re-checks on the holder,
+        # a miss degrades to recompute).
+        self.fleet_cache = bool(fleet_cache)
+        self.kv_migration = bool(kv_migration)
+        self._digests: dict[str, dict] = {}
         self.queue_limit = max(int(queue_limit), 0)
         self._resources = resources or Resources(tpu=1.0, memory=100.0)
         self._price = price or PriceRange(bid=1.0, max=10.0)
@@ -341,19 +372,87 @@ class ServingSupervisor:
         Only called on backends whose ``load`` is set (the routable set)."""
         return (dep.load.queue_depth + dep.inflight, -dep.load.free_blocks)
 
+    def _req_hashes(self, req: GenerateRequest) -> list:
+        """Chain hashes of the request's prompt under the pool's block
+        geometry — the keys the fleet-cache directory is indexed by.
+        Empty when the fleet cache is off (or nothing has reported a
+        digest yet), so every directory path below no-ops."""
+        bs = self._config.pool_block_size or 0
+        if (
+            not self.fleet_cache
+            or bs <= 0
+            or not self._digests
+            or not req.prompts
+        ):
+            return []
+        return chain_hashes(list(req.prompts[0]), bs)
+
+    def _chain_depth(self, backend_name: str, hashes: list) -> int:
+        """How many leading blocks of ``hashes`` this backend advertises
+        (deepest digest entry wins — chain hash j implies the whole
+        prefix up to block j is cached there)."""
+        dig = self._digests.get(backend_name)
+        if not dig:
+            return 0
+        for i in range(len(hashes), 0, -1):
+            if hashes[i - 1] in dig:
+                return i
+        return 0
+
+    def _directory_owner(self, backends: list, hashes: list):
+        """The backend ACTUALLY holding the deepest cached chain of this
+        prompt per the heartbeat digests — ties broken by load. None
+        when nobody advertises a matching chain (rendezvous fallback)."""
+        best, best_depth = None, 0
+        for d in backends:
+            depth = self._chain_depth(d.backend_name, hashes)
+            if depth > best_depth or (
+                depth == best_depth
+                and depth > 0
+                and self._score(d) < self._score(best)
+            ):
+                best, best_depth = d, depth
+        return best
+
+    def _pull_source(self, dep: _Deployment, hashes: list):
+        """A backend other than ``dep`` holding a strictly deeper chain
+        of this prompt — the router's pull-from-holder instruction when
+        load forces the request off the holder. ``(peer_id,
+        backend_name)`` or None (no holder, or ``dep`` is already the
+        deepest — pulling would gain nothing)."""
+        if not hashes:
+            return None
+        best, best_depth = None, self._chain_depth(dep.backend_name, hashes)
+        for d in self._live_backends():
+            if d is dep or d.load is None:
+                continue
+            depth = self._chain_depth(d.backend_name, hashes)
+            if depth > best_depth:
+                best, best_depth = d, depth
+        if best is None:
+            return None
+        return best.handle.peer_id, best.backend_name
+
     def _apply_affinity(self, backends: list, req: GenerateRequest) -> list:
         """Prefix-affinity: move the backend that OWNS this prompt prefix
-        (rendezvous hash of the first ``affinity_tokens`` ids over the
-        backend names — stable under membership churn) to the front of
-        the least-loaded order, so shared-prefix traffic lands where the
-        prefix cache is warm. Load guard: if the owner is more than
+        to the front of the least-loaded order, so shared-prefix traffic
+        lands where the prefix cache is warm. With the fleet cache on,
+        the owner is the ACTUAL holder of the prompt's deepest cached
+        chain (heartbeat digest directory); otherwise — or when nobody
+        advertises it — the rendezvous hash of the first
+        ``affinity_tokens`` ids over the backend names (stable under
+        membership churn). Load guard: if the owner is more than
         ``affinity_skew`` queued+in-flight requests deeper than the best
         backend, keep the least-loaded order — affinity must never turn
         a hot prefix into a hot spot."""
-        if not self.prefix_affinity or len(backends) < 2 or not req.prompts:
+        if len(backends) < 2 or not req.prompts:
             return backends
-        key = tuple(req.prompts[0][: self._affinity_tokens])
-        owner = max(backends, key=lambda d: hash((key, d.backend_name)))
+        owner = self._directory_owner(backends, self._req_hashes(req))
+        if owner is None:
+            if not self.prefix_affinity:
+                return backends
+            key = tuple(req.prompts[0][: self._affinity_tokens])
+            owner = max(backends, key=lambda d: hash((key, d.backend_name)))
         best = backends[0]  # already sorted by _score
         depth = lambda d: d.load.queue_depth + d.inflight  # noqa: E731
         if depth(owner) - depth(best) > self._affinity_skew:
@@ -404,11 +503,19 @@ class ServingSupervisor:
             parent=getattr(req, "traceparent", None),
             attrs={"serve_name": req.serve_name, "prompts": len(req.prompts)},
         )
+        req_hashes = self._req_hashes(req)
         try:
             for dep in backends:
+                # Fleet prefix cache: when the chosen backend is not the
+                # deepest holder of this prompt's chain, tell it where to
+                # PULL the KV blocks from instead of re-prefilling. None
+                # (no holder / fleet cache off) adds no wire fields.
+                pull = self._pull_source(dep, req_hashes)
                 fwd = dataclasses.replace(
                     req,
                     serve_name=dep.backend_name,
+                    pull_peer=pull[0] if pull else None,
+                    pull_serve=pull[1] if pull else None,
                     traceparent=trace.traceparent_of(route_span)
                     or req.traceparent,
                 )
@@ -465,8 +572,43 @@ class ServingSupervisor:
                         float(load.queue_depth),
                         float(load.free_blocks),
                     )
-                return ServeLoadAck(ok=True)
+                if load.cache_digest is not None:
+                    # Fleet cache directory: fold the bounded digest in
+                    # wholesale (the backend already top-K'd it), so a
+                    # hash evicted there ages out of the directory at
+                    # the next heartbeat.
+                    self._digests[dep.backend_name] = {
+                        int(h): int(c) for h, c in load.cache_digest
+                    }
+                    SERVE_METRICS.directory_state(
+                        sum(len(d) for d in self._digests.values())
+                    )
+                return self._ack(dep)
         return ServeLoadAck(ok=False)  # stale job (already torn down)
+
+    def _ack(self, dep: _Deployment) -> ServeLoadAck:
+        """Heartbeat ack; with KV migration on it piggybacks the router's
+        migration-target pick (the least-loaded OTHER fresh backend), so
+        a worker preempting a request already knows where to send the
+        blocks — no RPC on the preemption critical path."""
+        if not self.kv_migration:
+            return ServeLoadAck(ok=True)
+        now = time.monotonic()
+        others = [
+            d
+            for d in self._live_backends()
+            if d is not dep
+            and d.load is not None
+            and now - d.load_at <= self._eject_grace_s
+        ]
+        if not others:
+            return ServeLoadAck(ok=True)
+        target = min(others, key=self._score)
+        return ServeLoadAck(
+            ok=True,
+            migrate_peer=target.handle.peer_id,
+            migrate_serve=target.backend_name,
+        )
 
     async def _eject_loop(self) -> None:
         """Health-based ejection: a backend whose ServeLoad heartbeats (or
@@ -623,6 +765,9 @@ class ServingSupervisor:
         if dep.status_wait is not None:
             dep.status_wait.cancel()
         self._detector.remove(dep.handle.peer_id)
+        # A torn-down backend's cached chains are gone with it — drop its
+        # directory entry so the router stops naming it as a pull source.
+        self._digests.pop(dep.backend_name, None)
         dep.task.close()
         try:  # stop serving now; lease expiry backstops a dead worker
             await self.node.request(

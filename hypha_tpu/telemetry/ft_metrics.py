@@ -276,6 +276,15 @@ class ServeMetrics:
       stamped at each hot swap, applied/deferred/rolled-back swap
       counters, and a stage→flip swap-latency reservoir (same
       quantile treatment as request latency; what SWAPBENCH asserts).
+    * ``fleet cache`` — cross-worker prefix reuse: ``remote_prefix_hits``
+      / ``remote_prefix_misses`` count KV blocks pulled from a peer vs
+      pulls that fell back to recompute; ``blocks_shipped`` /
+      ``block_bytes_shipped`` meter the holder side of every transfer
+      (pulls and migrations); ``migrations`` counts preempted requests
+      resumed on another worker; ``transfer_chosen`` /
+      ``recompute_chosen`` record each side of the bandwidth-aware
+      transfer-vs-recompute policy; ``directory_chains`` gauges the
+      router's block-hash directory size (sum of backend digests).
     """
 
     _RESERVOIR = 2048
@@ -303,6 +312,18 @@ class ServeMetrics:
         self.spec_proposed = Counter("hypha.serve.spec_proposed")
         self.spec_accepted = Counter("hypha.serve.spec_accepted")
         self.affinity_routed = Counter("hypha.serve.affinity_routed")
+        self.remote_prefix_hits = Counter("hypha.serve.remote_prefix_hits")
+        self.remote_prefix_misses = Counter(
+            "hypha.serve.remote_prefix_misses"
+        )
+        self.blocks_shipped = Counter("hypha.serve.blocks_shipped")
+        self.block_bytes_shipped = Counter(
+            "hypha.serve.block_bytes_shipped"
+        )
+        self.migrations = Counter("hypha.serve.migrations")
+        self.transfer_chosen = Counter("hypha.serve.transfer_chosen")
+        self.recompute_chosen = Counter("hypha.serve.recompute_chosen")
+        self._directory_chains = 0.0
         self.swap_applied = Counter("hypha.serve.swap_applied")
         self.swap_deferred = Counter("hypha.serve.swap_deferred")
         self.swap_rolled_back = Counter("hypha.serve.swap_rolled_back")
@@ -405,6 +426,21 @@ class ServeMetrics:
         total = hit + self.prefix_miss_blocks.value()
         return hit / total if total else 0.0
 
+    def directory_state(self, chains: float) -> None:
+        """Size of the router's fleet-cache directory (total chain hashes
+        across all backend digests) — last-writer gauge, like pool_state."""
+        with self._lock:
+            self._directory_chains = float(chains)
+
+    def directory_chains(self) -> float:
+        with self._lock:
+            return self._directory_chains
+
+    def remote_prefix_hit_rate(self) -> float:
+        hit = self.remote_prefix_hits.value()
+        total = hit + self.remote_prefix_misses.value()
+        return hit / total if total else 0.0
+
     def spec_accept_rate(self) -> float:
         proposed = self.spec_proposed.value()
         return self.spec_accepted.value() / proposed if proposed else 0.0
@@ -456,6 +492,15 @@ class ServeMetrics:
             "spec_accepted": self.spec_accepted.value(),
             "spec_accept_rate": self.spec_accept_rate(),
             "affinity_routed": self.affinity_routed.value(),
+            "remote_prefix_hits": self.remote_prefix_hits.value(),
+            "remote_prefix_misses": self.remote_prefix_misses.value(),
+            "remote_prefix_hit_rate": self.remote_prefix_hit_rate(),
+            "blocks_shipped": self.blocks_shipped.value(),
+            "block_bytes_shipped": self.block_bytes_shipped.value(),
+            "migrations": self.migrations.value(),
+            "transfer_chosen": self.transfer_chosen.value(),
+            "recompute_chosen": self.recompute_chosen.value(),
+            "directory_chains": self.directory_chains(),
             "request_latency_ms_count": hist["count"],
             "request_latency_ms_sum": hist["sum"],
             "request_latency_ms_p50": self._quantile(0.50),
@@ -938,6 +983,28 @@ def register_on(
     )
     meter.observable_gauge(
         "hypha.serve.affinity_routed", serve.affinity_routed.value
+    )
+    meter.observable_gauge(
+        "hypha.serve.remote_prefix_hits", serve.remote_prefix_hits.value
+    )
+    meter.observable_gauge(
+        "hypha.serve.remote_prefix_misses", serve.remote_prefix_misses.value
+    )
+    meter.observable_gauge(
+        "hypha.serve.blocks_shipped", serve.blocks_shipped.value
+    )
+    meter.observable_gauge(
+        "hypha.serve.block_bytes_shipped", serve.block_bytes_shipped.value
+    )
+    meter.observable_gauge("hypha.serve.migrations", serve.migrations.value)
+    meter.observable_gauge(
+        "hypha.serve.transfer_chosen", serve.transfer_chosen.value
+    )
+    meter.observable_gauge(
+        "hypha.serve.recompute_chosen", serve.recompute_chosen.value
+    )
+    meter.observable_gauge(
+        "hypha.serve.directory_chains", serve.directory_chains
     )
     meter.observable_gauge("hypha.serve.weight_round", serve.weight_round)
     meter.observable_gauge(

@@ -53,6 +53,9 @@ class PoolServer:
         ragged: bool = False,
         kv_quant: str = "",
         spec_layers: int = 0,
+        fleet_cache: bool = False,
+        kv_migration: bool = False,
+        digest_k: int = 32,
     ) -> None:
         self.pool = DecodePool(
             model,
@@ -71,7 +74,11 @@ class PoolServer:
             ragged=ragged,
             kv_quant=kv_quant,
             spec_layers=spec_layers,
+            fleet_cache=fleet_cache,
+            kv_migration=kv_migration,
+            digest_k=digest_k,
         )
+        self.fleet_cache = bool(fleet_cache)
         self._run_fallback = run_fallback
         # Bounded one-shot decode concurrency: each distinct fallback shape
         # compiles its own program, so a burst of oversized/sampled
@@ -97,7 +104,7 @@ class PoolServer:
         swapped — None otherwise, so a non-following server's heartbeat
         wire stays byte-identical (None fields are omitted)."""
         weight_round, weight_generation = self.pool.weight_state()
-        return {
+        out = {
             "queue_depth": self.pool.queue_depth(),
             "free_blocks": self.pool.free_blocks(),
             "live_requests": self.pool.live_rows(),
@@ -106,6 +113,12 @@ class PoolServer:
             "weight_round": weight_round,
             "weight_generation": weight_generation,
         }
+        if self.fleet_cache:
+            # Bounded digest (top-K hot chains) for the router's
+            # block-hash -> holders directory; None (fleet cache off)
+            # keeps the heartbeat byte-identical.
+            out["cache_digest"] = self.pool.fleet_digest or None
+        return out
 
     def weight_state(self) -> tuple:
         """(round, generation) currently being SERVED — None pair until

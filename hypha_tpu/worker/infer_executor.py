@@ -21,17 +21,24 @@ from dataclasses import dataclass, field
 from pathlib import Path
 
 from .. import aio
-from ..executor.pool import PoolBusy
+from ..executor.block_cache import chain_hashes
+from ..executor.pool import PoolBusy, StaleBlockGeneration
 from ..messages import (
+    PROTOCOL_BLOCKS,
     PROTOCOL_GENERATE,
     PROTOCOL_SERVE,
+    BlockChain,
+    BlockPull,
     GenerateRequest,
     GenerateResponse,
     JobSpec,
+    MigrateAck,
+    MigrateRequest,
     ServeLoad,
 )
 from ..network.node import Node, RequestError
-from ..telemetry import trace
+from ..ops.kvcache import leaves_from_wire, leaves_nbytes, leaves_to_wire
+from ..telemetry import SERVE_METRICS, trace
 from .batcher import RequestBatcher
 from .job_manager import Execution, JobExecutor
 
@@ -98,6 +105,25 @@ class InProcessInferExecutor(JobExecutor):
                         req.prompts, n_new, temperature, top_k, req.seed,
                     )
                 else:
+                    if (
+                        getattr(req, "pull_peer", None)
+                        and loaded.get("link") is not None
+                        and getattr(
+                            getattr(batcher, "pool", None),
+                            "fleet_cache",
+                            False,
+                        )
+                        and len(req.prompts) == 1
+                        and temperature == 0.0
+                    ):
+                        # Router says this prompt's longest cached prefix
+                        # lives elsewhere: pull the chain before admission
+                        # so the local prefix-hit path skips its prefill.
+                        # Any failure is a miss — admission recomputes,
+                        # today's behavior.
+                        await self._fleet_pull(
+                            req, batcher.pool, loaded["link"]
+                        )
                     try:
                         tokens = await batcher.submit(
                             req.prompts, n_new, temperature, top_k, req.seed,
@@ -218,6 +244,9 @@ class InProcessInferExecutor(JobExecutor):
                     ragged=cfg.pool_ragged,
                     kv_quant=cfg.pool_kv_quant,
                     spec_layers=cfg.pool_spec_layers,
+                    fleet_cache=bool(cfg.pool_fleet_cache),
+                    kv_migration=bool(cfg.pool_kv_migration),
+                    digest_k=cfg.fleet_digest_k or 32,
                 )
             elif cfg.batch_window_ms >= 0:
                 loaded["batcher"] = self.batchers[job_id] = RequestBatcher(
@@ -245,6 +274,136 @@ class InProcessInferExecutor(JobExecutor):
                     work_dir=self.work_root / job_id / "weight-stream",
                 )
                 sub.start()
+            pool = getattr(loaded.get("batcher"), "pool", None)
+            if pool is not None and (pool.fleet_cache or pool.kv_migration):
+                # One LinkTable per serving job: the fleet-pull RPC feeds
+                # its EWMA (transfer-dominated round trips), and both the
+                # pull pre-check and the migration policy read it.
+                from ..ft.adaptive import LinkTable
+
+                loaded["link"] = link = LinkTable()
+
+                async def handle_pull(peer: str, m: BlockPull) -> BlockChain:
+                    wr, wg = pool.weight_state()
+                    if (m.weight_round, m.weight_generation) != (wr, wg):
+                        # Blocks this pool holds were computed under ITS
+                        # weights; a puller on different weights must
+                        # recompute (msg-block-needs-generation contract).
+                        return BlockChain(
+                            ok=False, error="stale-generation",
+                            weight_round=wr, weight_generation=wg,
+                        )
+                    try:
+                        res = await asyncio.wrap_future(
+                            pool.serve_chain(m.chain_hashes or [])
+                        )
+                    except Exception as e:  # noqa: BLE001 — RPC boundary
+                        return BlockChain(
+                            ok=False, error=str(e),
+                            weight_round=wr, weight_generation=wg,
+                        )
+                    if not res:
+                        return BlockChain(
+                            ok=False, error="not-cached",
+                            weight_round=wr, weight_generation=wg,
+                        )
+                    SERVE_METRICS.blocks_shipped.add(len(res["hashes"]))
+                    SERVE_METRICS.block_bytes_shipped.add(
+                        leaves_nbytes(res["leaves"])
+                    )
+                    return BlockChain(
+                        ok=True,
+                        chain_hash=res["hashes"][-1],
+                        hashes=res["hashes"],
+                        block_size=pool.block_size,
+                        leaves=leaves_to_wire(res["leaves"]),
+                        weight_round=wr,
+                        weight_generation=wg,
+                    )
+
+                registration["blocks"] = (
+                    self.node.on(PROTOCOL_BLOCKS, BlockPull)
+                    .match(lambda m: m.serve_name == cfg.serve_name)
+                    .concurrency(8)
+                    .respond_with(handle_pull)
+                )
+            if pool is not None and pool.kv_migration:
+                loaded["hints"] = hints = {}
+                loop = asyncio.get_running_loop()
+
+                async def handle_migrate(
+                    peer: str, m: MigrateRequest
+                ) -> MigrateAck:
+                    if m.block_size != pool.block_size:
+                        return MigrateAck(ok=False, error="geometry-mismatch")
+                    try:
+                        await asyncio.wrap_future(
+                            pool.inject_chain(
+                                m.chain_hashes or [],
+                                leaves_from_wire(m.leaves or {}),
+                                m.weight_round,
+                                m.weight_generation,
+                            )
+                        )
+                    except StaleBlockGeneration:
+                        return MigrateAck(ok=False, error="stale-generation")
+                    except Exception as e:  # noqa: BLE001 — RPC boundary
+                        return MigrateAck(ok=False, error=str(e))
+                    resume = list(m.prompt or []) + list(m.emitted or [])
+                    try:
+                        toks = await asyncio.wrap_future(
+                            pool.submit([resume], int(m.budget or 0))
+                        )
+                    except PoolBusy as busy:
+                        return MigrateAck(
+                            ok=False, error="busy",
+                            retry_after_ms=busy.retry_after_s * 1e3,
+                        )
+                    except Exception as e:  # noqa: BLE001 — RPC boundary
+                        return MigrateAck(ok=False, error=str(e))
+                    return MigrateAck(ok=True, tokens=toks[0])
+
+                registration["migrate"] = (
+                    self.node.on(PROTOCOL_BLOCKS, MigrateRequest)
+                    .match(lambda m: m.serve_name == cfg.serve_name)
+                    .concurrency(4)
+                    .respond_with(handle_migrate)
+                )
+
+                def migrate_policy(est_bytes: int, resume_tokens: int):
+                    # Serve-thread hook: ship when the measured link moves
+                    # the bytes faster than local prefill recomputes the
+                    # tokens. An unmeasured link ships optimistically (the
+                    # transfer seeds the EWMA); a bw-capped link loses the
+                    # comparison and degrades to recompute-resume.
+                    target = hints.get("peer")
+                    if not target:
+                        return None
+                    bw = link.bandwidth_bps(target)
+                    cost = pool.prefill_cost_s(resume_tokens)
+                    if (
+                        bw is not None
+                        and cost is not None
+                        and est_bytes * 8.0 / bw >= cost
+                    ):
+                        SERVE_METRICS.recompute_chosen.add(1)
+                        return None
+                    SERVE_METRICS.transfer_chosen.add(1)
+                    return (target, hints.get("serve"))
+
+                def migrate_send(ticket: dict) -> None:
+                    # Serve-thread -> event-loop handoff; the async sender
+                    # owns the group from here (ack resolves it, failure
+                    # requeues it).
+                    loop.call_soon_threadsafe(
+                        lambda: aio.spawn(
+                            self._migrate_out(ticket, pool, link),
+                            what="kv migration",
+                            logger=log,
+                        )
+                    )
+
+                pool.set_migrate_hooks(migrate_policy, migrate_send)
             registration["reg"] = (
                 self.node.on(PROTOCOL_GENERATE, GenerateRequest)
                 .match(lambda m: m.serve_name == cfg.serve_name)
@@ -257,7 +416,8 @@ class InProcessInferExecutor(JobExecutor):
                 # registered), so reporting must not depend on the pool.
                 registration["load"] = aio.spawn(
                     self._report_load(
-                        job_id, cfg, loaded.get("batcher"), scheduler_peer
+                        job_id, cfg, loaded.get("batcher"), scheduler_peer,
+                        loaded.get("hints"),
                     ),
                     what="serve load reporter",
                     logger=log,
@@ -285,6 +445,9 @@ class InProcessInferExecutor(JobExecutor):
             cancelled.set()
             if registration.get("reg") is not None:
                 registration["reg"].close()
+            for extra in ("blocks", "migrate"):
+                if registration.get(extra) is not None:
+                    registration[extra].close()
             await aio.reap(registration.get("load"))
             if registration.get("weights") is not None:
                 await registration["weights"].stop()
@@ -308,7 +471,8 @@ class InProcessInferExecutor(JobExecutor):
         return execution
 
     async def _report_load(
-        self, job_id: str, cfg, batcher, scheduler_peer: str
+        self, job_id: str, cfg, batcher, scheduler_peer: str,
+        hints: dict | None = None,
     ) -> None:
         """Heartbeat the pool's admission headroom to the request router
         (scheduler.serving): queue depth + free blocks ride the liveness
@@ -331,7 +495,7 @@ class InProcessInferExecutor(JobExecutor):
                     "rejections": 0,
                 }
             try:
-                await self.node.request(
+                ack = await self.node.request(
                     scheduler_peer,
                     PROTOCOL_SERVE,
                     ServeLoad(
@@ -346,11 +510,123 @@ class InProcessInferExecutor(JobExecutor):
                         # for non-following servers) — omitted on the wire.
                         weight_round=stats.get("weight_round"),
                         weight_generation=stats.get("weight_generation"),
+                        # Fleet cache digest (None = off, omitted).
+                        cache_digest=stats.get("cache_digest"),
                     ),
                     timeout=max(cfg.load_report_s, 2.0),
                 )
+                if hints is not None and getattr(ack, "migrate_peer", None):
+                    # Router-named migration target, refreshed every
+                    # heartbeat: the serve-thread policy reads it when a
+                    # preemption hits, no extra RPC on the critical path.
+                    hints["peer"] = ack.migrate_peer
+                    hints["serve"] = ack.migrate_serve
             except (RequestError, asyncio.TimeoutError, OSError) as e:
                 log.debug("serve load report for %s failed: %s", job_id, e)
+
+    async def _fleet_pull(self, req: GenerateRequest, pool, link) -> None:
+        """Pull the prompt's chain from the router-named holder into the
+        local prefix cache before admission. Every failure mode — policy
+        says recompute, holder evicted the chain, stale weight stamp,
+        link error — is a remote MISS and admission re-prefills exactly
+        as it does today."""
+        prompt = list(req.prompts[0])
+        hashes = chain_hashes(prompt, pool.block_size)
+        if not hashes:
+            return
+        # Transfer-vs-recompute pre-check on the measured link: a
+        # bw-capped holder link loses to local prefill and degrades to
+        # re-prefilling. Unmeasured links pull (the RPC seeds the EWMA).
+        bw = link.bandwidth_bps(req.pull_peer)
+        cost = pool.prefill_cost_s(len(prompt))
+        est = len(hashes) * pool._block_nbytes()
+        if bw is not None and cost is not None and est * 8.0 / bw >= cost:
+            SERVE_METRICS.recompute_chosen.add(1)
+            SERVE_METRICS.remote_prefix_misses.add(1)
+            return
+        SERVE_METRICS.transfer_chosen.add(1)
+        wr, wg = pool.weight_state()
+        loop = asyncio.get_running_loop()
+        t0 = loop.time()
+        try:
+            resp = await self.node.request(
+                req.pull_peer,
+                PROTOCOL_BLOCKS,
+                BlockPull(
+                    serve_name=req.pull_serve or "",
+                    chain_hashes=hashes,
+                    weight_round=wr,
+                    weight_generation=wg,
+                ),
+                timeout=10.0,
+            )
+        except (RequestError, asyncio.TimeoutError, OSError) as e:
+            log.debug("fleet pull from %s failed: %s", req.pull_peer, e)
+            SERVE_METRICS.remote_prefix_misses.add(1)
+            return
+        if (
+            not getattr(resp, "ok", False)
+            or not resp.hashes
+            or resp.block_size != pool.block_size
+        ):
+            SERVE_METRICS.remote_prefix_misses.add(1)
+            return
+        leaves = leaves_from_wire(resp.leaves or {})
+        link.observe(
+            req.pull_peer, leaves_nbytes(leaves), max(loop.time() - t0, 1e-6)
+        )
+        try:
+            injected = await asyncio.wrap_future(
+                pool.inject_chain(
+                    resp.hashes, leaves,
+                    resp.weight_round, resp.weight_generation,
+                )
+            )
+        except StaleBlockGeneration:
+            SERVE_METRICS.remote_prefix_misses.add(1)
+            return
+        except Exception as e:  # noqa: BLE001 — pull is best-effort
+            log.debug("fleet inject failed: %s", e)
+            SERVE_METRICS.remote_prefix_misses.add(1)
+            return
+        if injected > 0:
+            SERVE_METRICS.remote_prefix_hits.add(injected)
+        else:
+            SERVE_METRICS.remote_prefix_misses.add(1)
+
+    async def _migrate_out(self, ticket: dict, pool, link) -> None:
+        """Ship one preempted request to the router-named target and
+        resolve (or requeue) its original future. The source stays the
+        client-facing endpoint: the client protocol never changes."""
+        group = ticket["group"]
+        peer, serve = ticket["target"]
+        msg = MigrateRequest(
+            serve_name=serve or "",
+            prompt=ticket["prompt"],
+            emitted=ticket["emitted"],
+            budget=ticket["budget"],
+            chain_hashes=ticket["hashes"],
+            block_size=ticket["block_size"],
+            leaves=leaves_to_wire(ticket["leaves"]),
+            weight_round=ticket["weight_round"],
+            weight_generation=ticket["weight_generation"],
+        )
+        try:
+            ack = await self.node.request(
+                peer, PROTOCOL_BLOCKS, msg, timeout=120.0
+            )
+        except (RequestError, asyncio.TimeoutError, OSError) as e:
+            log.debug("migration to %s failed: %s", peer, e)
+            pool.requeue_migrated(group)
+            return
+        if not getattr(ack, "ok", False) or ack.tokens is None:
+            log.debug("migration refused by %s: %s", peer, ack.error)
+            pool.requeue_migrated(group)
+            return
+        SERVE_METRICS.migrations.add(1)
+        SERVE_METRICS.blocks_shipped.add(len(ticket["hashes"]))
+        SERVE_METRICS.block_bytes_shipped.add(leaves_nbytes(ticket["leaves"]))
+        pool.complete_migrated(group, ack.tokens)
 
     # -- blocking helpers (run in worker threads) ---------------------------
 

@@ -1,0 +1,36 @@
+"""Seeded fixture pair for hypha-lint's ``msg-block-needs-generation`` rule.
+
+Deliberately NOT registered with hypha_tpu.messages (registration would
+leak into the live registry other tests lint); tests/test_lint.py passes
+these classes to ``proto_rules.check_block_tags`` as an explicit registry.
+``BlockBad`` must trip the rule — a chain hash addresses token CONTENT,
+but the K/V blocks it names were computed under specific weights, so a
+block transfer without its (weight_round, weight_generation) stamp would
+ship pre-swap activations into a post-swap pool as silently wrong tokens.
+``BlockGood`` is the clean twin: the stamp pair travels with the hashes.
+"""
+
+# No `from __future__ import annotations`: stringified annotations make
+# dataclasses.fields() resolve against sys.modules[cls.__module__], which
+# an exec'd fixture module is deliberately absent from.
+from dataclasses import dataclass, field
+
+
+@dataclass(slots=True)
+class BlockBad:
+    """Chain hashes with NO weight stamp: the rule must fire (both
+    halves missing)."""
+
+    chain_hashes: list = field(default_factory=list)
+    note: str = ""
+
+
+@dataclass(slots=True)
+class BlockGood:
+    """Chain hashes stamped with the full (round, generation) pair: the
+    rule stays quiet."""
+
+    chain_hashes: list = field(default_factory=list)
+    weight_round: int = 0
+    weight_generation: int = 0
+    note: str = ""

@@ -1,0 +1,657 @@
+"""Fleet-scale serving (ISSUE-19 tentpole): distributed prefix cache +
+KV block migration — cross-pool block shipping at bit parity (f32 and
+int8 with scale rows), weight-stamp admission gates, migration token
+identity vs the uncontended run, router directory + holder routing +
+pull stamping, bounded heartbeat digests, and byte-identical wire with
+the subsystem off."""
+
+from __future__ import annotations
+
+import asyncio
+import dataclasses
+import json
+import time
+import types
+from concurrent.futures import Future
+
+import jax
+import numpy as np
+import pytest
+
+from hypha_tpu import codec, messages
+from hypha_tpu.executor.block_cache import PrefixBlockCache, chain_hashes
+from hypha_tpu.executor.generate import generate
+from hypha_tpu.executor.pool import DecodePool, StaleBlockGeneration, _Group
+from hypha_tpu.ft.adaptive import LinkTable
+from hypha_tpu.messages import (
+    BlockChain,
+    BlockPull,
+    GenerateRequest,
+    GenerateResponse,
+    MigrateAck,
+    MigrateRequest,
+    ServeLoad,
+    ServeLoadAck,
+)
+from hypha_tpu.models import Llama, LlamaConfig
+from hypha_tpu.network import MemoryTransport, Node
+from hypha_tpu.ops.kvcache import (
+    leaves_from_wire,
+    leaves_nbytes,
+    leaves_to_wire,
+)
+from hypha_tpu.scheduler.serving import ServingSupervisor, _Deployment
+from hypha_tpu.telemetry import SERVE_METRICS
+
+
+@pytest.fixture(scope="module")
+def tiny_llama():
+    cfg = dataclasses.replace(LlamaConfig.tiny(), dtype="float32")
+    model = Llama(cfg)
+    ids = np.zeros((1, 8), np.int32)
+    params = model.init(jax.random.key(0), ids)
+    return model, params, cfg
+
+
+def _ref(model, params, prompt, n_new):
+    return np.asarray(
+        generate(model, params, np.asarray([prompt], np.int32), n_new)
+    )[0].tolist()
+
+
+def _pool(model, params, **kw):
+    base = dict(
+        slots=4, max_len=128, steps_per_call=4, block_size=8,
+        num_blocks=48, prefill_chunk=8, prefix_cache=True,
+        fleet_cache=True,
+    )
+    base.update(kw)
+    return DecodePool(model, params, **base)
+
+
+_MODEL = {
+    "family": "gpt2",
+    "config": {
+        "vocab_size": 64, "n_positions": 48, "n_embd": 32,
+        "n_layer": 1, "n_head": 2, "dtype": "float32",
+    },
+    "seed": 3,
+}
+
+
+def run(coro):
+    return asyncio.run(asyncio.wait_for(coro, timeout=240))
+
+
+# ------------------------------------------------------------------- wire
+
+
+def test_defaults_off_wire_bytes_golden():
+    """The subsystem off ships today's exact bytes: every new field is
+    None-default and omitted, pinned against hand-built CBOR plains."""
+    assert messages.encode(ServeLoadAck()) == codec.dumps(
+        {"_t": "ServeLoadAck", "ok": True}
+    )
+    load = ServeLoad(
+        job_id="j1", serve_name="s", queue_depth=2, free_blocks=5,
+        live_requests=1, requests=3,
+    )
+    assert messages.encode(load) == codec.dumps({
+        "_t": "ServeLoad", "job_id": "j1", "serve_name": "s",
+        "queue_depth": 2, "free_blocks": 5, "live_requests": 1,
+        "requests": 3, "rejections": 0,
+    })
+    req = GenerateRequest(serve_name="s", prompts=[[1, 2]], seed=7)
+    assert messages.encode(req) == codec.dumps({
+        "_t": "GenerateRequest", "serve_name": "s", "prompts": [[1, 2]],
+        "max_new_tokens": 64, "seed": 7,
+    })
+    for name in (
+        "cache_digest", "pull_peer", "migrate_peer", "pool_fleet_cache",
+    ):
+        cfg = messages.InferExecutorConfig(model={}, serve_name="s")
+        blob = messages.encode(cfg) + messages.encode(load)
+        blob += messages.encode(req) + messages.encode(ServeLoadAck())
+        assert name.encode() not in blob, f"{name} leaked with defaults off"
+
+
+def test_fleet_wire_roundtrip_with_payload():
+    """The /hypha-blocks vocabulary round-trips with bytes payloads and
+    carries the (weight_round, weight_generation) stamp pair."""
+    leaves = {"['k']": [b"\x00\x01", "float32", [2]]}
+    for msg in (
+        BlockPull(serve_name="s", chain_hashes=[1, -2], weight_round=3,
+                  weight_generation=1),
+        BlockChain(ok=True, hashes=[1], block_size=8, leaves=leaves,
+                   weight_round=3, weight_generation=1),
+        MigrateRequest(serve_name="s", prompt=[1, 2], emitted=[3],
+                       budget=4, chain_hashes=[5], block_size=8,
+                       leaves=leaves, weight_round=None,
+                       weight_generation=None),
+        MigrateAck(ok=False, error="busy", retry_after_ms=50.0),
+    ):
+        assert messages.decode(messages.encode(msg)) == msg
+
+
+# ----------------------------------------------------------------- digest
+
+
+def test_hot_chains_bounded_and_hit_ordered():
+    """The heartbeat digest is top-K by hit count, includes 0-hit
+    registered chains (bootstrap: a fresh holder must advertise what it
+    holds), and prunes tallies for evicted content."""
+    alloc = PrefixBlockCache(8, 2, caching=True)
+    hashes = chain_hashes([1, 2, 3, 4, 5, 6], 2)
+    blocks = [alloc.alloc() for _ in range(3)]
+    for b, h in zip(blocks, hashes):
+        alloc.register(b, h)
+    for b in blocks:
+        alloc.release(b)
+    # two lookups of the 2-block prefix: those chains out-rank the third
+    for _ in range(2):
+        hit = alloc.lookup(hashes[:2])
+        for b in hit:
+            alloc.release(b)
+    top = alloc.hot_chains(2)
+    assert len(top) == 2
+    assert {h for h, _ in top} == set(hashes[:2])
+    assert all(c == 2 for _, c in top)
+    # 0-hit chains still advertised when K allows
+    assert {h for h, _ in alloc.hot_chains(10)} == set(hashes)
+    assert alloc.hot_chains(0) == []
+    # eviction prunes: alloc pressure drops the LRU'd registrations
+    for _ in range(8):
+        alloc.alloc()
+    assert alloc.hot_chains(10) == []
+
+
+def test_digest_heartbeat_encoded_size_budget():
+    """Satellite pin: a full K=32 digest of worst-case 64-bit hashes
+    stays under a fixed heartbeat budget — the load report must never
+    balloon into a block manifest."""
+    alloc = PrefixBlockCache(64, 2, caching=True)
+    for i in range(50):
+        b = alloc.alloc()
+        alloc.register(b, hash(("fleet-digest-entry", i, 0x9E3779B97F4A7C15)))
+        alloc.release(b)
+    digest = alloc.hot_chains(32)
+    assert len(digest) == 32
+    bare = len(messages.encode(ServeLoad(job_id="j", serve_name="s")))
+    full = len(messages.encode(
+        ServeLoad(job_id="j", serve_name="s", cache_digest=digest)
+    ))
+    assert full - bare <= 32 * (9 + 9 + 2) + 32  # CBOR int heads + slack
+    assert full <= 1024
+
+
+# --------------------------------------------------- cross-pool transfer
+
+
+def test_cross_pool_transfer_bit_parity_f32(tiny_llama):
+    """The tentpole data plane: pool A serves its cached chain, the rows
+    ship through the wire helpers bit-exactly, pool B lands them as
+    cache entries, and B's admission of the same prefix is an ordinary
+    hit (one tail prefill chunk) with token-identical output."""
+    model, params, _ = tiny_llama
+    prompt = [(i * 7 + 3) % 50 + 1 for i in range(24)]  # 3 full blocks
+    a = _pool(model, params)
+    b = _pool(model, params)
+    try:
+        assert a.submit([list(prompt)], 6).result(timeout=300) == [
+            _ref(model, params, prompt, 6)
+        ]
+        hashes = chain_hashes(prompt, 8)
+        served = a.serve_chain(hashes).result(timeout=60)
+        assert served is not None and served["hashes"] == hashes
+        # wire roundtrip is bit-exact for every leaf (k and v rows)
+        wire = leaves_to_wire(served["leaves"])
+        landed = leaves_from_wire(wire)
+        assert set(landed) == set(served["leaves"])
+        for key, arr in served["leaves"].items():
+            assert np.array_equal(landed[key], arr), key
+        assert leaves_nbytes(served["leaves"]) > 0
+        n = b.inject_chain(hashes, landed, None, None).result(timeout=60)
+        assert n == len(hashes)
+        # re-serving from B returns the same bits: full transfer parity
+        again = b.serve_chain(hashes).result(timeout=60)
+        assert again is not None and again["hashes"] == hashes
+        for key, arr in served["leaves"].items():
+            assert np.array_equal(again["leaves"][key], arr), key
+        # ...and admission on B is a prefix hit: ONE tail chunk
+        warm = prompt + [9, 9]
+        before = b.prefill_chunks
+        assert b.submit([list(warm)], 6).result(timeout=300) == [
+            _ref(model, params, warm, 6)
+        ]
+        assert b.prefill_chunks - before == 1, (
+            "pulled chain did not admit as a prefix hit"
+        )
+        # double-inject is idempotent: already-cached hashes are skipped
+        assert b.inject_chain(
+            hashes, landed, None, None
+        ).result(timeout=60) == 0
+    finally:
+        a.close()
+        b.close()
+
+
+def test_cross_pool_transfer_int8_ships_scale_rows(tiny_llama):
+    """int8 pools ship quantized payload AND per-position scale rows
+    verbatim — B's warm decode matches A's warm decode bit-for-bit
+    (identical int8 blocks, identical dequantization)."""
+    model, params, _ = tiny_llama
+    prompt = [(i * 5 + 2) % 50 + 1 for i in range(16)]  # 2 full blocks
+    a = _pool(model, params, kv_quant="int8")
+    b = _pool(model, params, kv_quant="int8")
+    try:
+        a.submit([list(prompt)], 6).result(timeout=300)
+        hashes = chain_hashes(prompt, 8)
+        served = a.serve_chain(hashes).result(timeout=60)
+        assert served is not None
+        keys = set(served["leaves"])
+        assert any("k_scale" in k for k in keys), keys
+        assert any("v_scale" in k for k in keys), keys
+        landed = leaves_from_wire(leaves_to_wire(served["leaves"]))
+        assert b.inject_chain(
+            hashes, landed, None, None
+        ).result(timeout=60) == len(hashes)
+        warm = prompt + [3, 1]
+        got_a = a.submit([list(warm)], 6).result(timeout=300)
+        before = b.prefill_chunks
+        got_b = b.submit([list(warm)], 6).result(timeout=300)
+        assert got_b == got_a, "shipped int8 blocks decoded differently"
+        assert b.prefill_chunks - before == 1
+    finally:
+        a.close()
+        b.close()
+
+
+def test_stale_generation_injection_rejected(tiny_llama):
+    """The admission gate: blocks stamped with a different
+    (weight_round, weight_generation) than the pool serves must be
+    refused — stale activations never enter a fresh-weights cache."""
+    model, params, _ = tiny_llama
+    b = _pool(model, params)
+    try:
+        with pytest.raises(StaleBlockGeneration):
+            b.inject_chain([123], {}, 5, 1).result(timeout=60)
+        # matching stamp (both sides never swapped) passes the gate
+        assert b.inject_chain([], {}, None, None).result(timeout=60) == 0
+    finally:
+        b.close()
+
+
+def test_serve_chain_miss_after_eviction_recompute_fallback(tiny_llama):
+    """Directory staleness: the holder evicted the advertised chain
+    between heartbeat and pull — serve_chain resolves None (a clean
+    miss, not an error) and the puller's plain recompute still serves
+    token-identically."""
+    model, params, _ = tiny_llama
+    a = _pool(model, params, slots=2, max_len=64, block_size=4,
+              num_blocks=8, prefill_chunk=4)
+    try:
+        prompt = [(i * 7 + 1) % 50 + 1 for i in range(8)]
+        a.submit([list(prompt)], 4).result(timeout=300)
+        hashes = chain_hashes(prompt, 4)
+        assert a.serve_chain(hashes).result(timeout=60) is not None
+        for i in range(6):  # pressure the 8-block pool: evict the chain
+            other = [(i * 13 + j) % 50 + 2 for j in range(8)]
+            a.submit([list(other)], 4).result(timeout=300)
+        assert a.serve_chain(hashes).result(timeout=60) is None
+        # recompute fallback: a plain submit still answers correctly
+        assert a.submit([list(prompt)], 4).result(timeout=300) == [
+            _ref(model, params, prompt, 4)
+        ]
+    finally:
+        a.close()
+
+
+def test_pool_close_fails_pending_ops(tiny_llama):
+    model, params, _ = tiny_llama
+    a = _pool(model, params)
+    a.close()
+    with pytest.raises(RuntimeError):
+        a.serve_chain([1]).result(timeout=10)
+
+
+# -------------------------------------------------------------- migration
+
+
+def _park_group(pool, prompt, n_new):
+    g = _Group([list(prompt)], int(n_new), Future())
+    with pool._submit_lock:
+        pool._backlog += 1
+    pool._waiting.append(g)
+    return g
+
+
+def test_migration_token_identity_vs_uncontended(tiny_llama):
+    """The migration headline: a preempted request's KV blocks + cursor
+    + emitted tokens land on pool B, B decodes the remaining budget, and
+    the client future resolves with EXACTLY the uncontended run's
+    tokens. Pool A is stepped synchronously (deterministic preemption);
+    the ticket handoff emulates the worker's MigrateRequest round
+    trip."""
+    model, params, _ = tiny_llama
+    p1 = [(i * 7 + 5) % 50 + 1 for i in range(9)]
+    p2 = [(i * 11 + 2) % 50 + 1 for i in range(9)]
+    n_new = 24
+    ref1 = _ref(model, params, p1, n_new)
+    ref2 = _ref(model, params, p2, n_new)
+    a = DecodePool(
+        model, params, slots=2, max_len=64, steps_per_call=4,
+        block_size=4, num_blocks=15, prefill_chunk=4, reserve_blocks=0,
+        prefix_cache=True, fleet_cache=True, kv_migration=True,
+    )
+    b = DecodePool(
+        model, params, slots=4, max_len=64, steps_per_call=4,
+        block_size=4, num_blocks=64, prefill_chunk=4,
+        prefix_cache=True, fleet_cache=True,
+    )
+    tickets: list = []
+    a.set_migrate_hooks(lambda est, toks: "peer-b", tickets.append)
+    try:
+        g1 = _park_group(a, p1, n_new)
+        g2 = _park_group(a, p2, n_new)
+        deadline = time.time() + 300
+        while not (g1.fut.done() and g2.fut.done()):
+            assert time.time() < deadline
+            a._step_paged()
+            while tickets:
+                t = tickets.pop(0)
+                # the target side, exactly what handle_migrate does:
+                # inject the shipped chain, admit the resume, return the
+                # continuation
+                assert t["target"] == "peer-b"
+                assert t["budget"] > 0
+                b.inject_chain(
+                    t["hashes"], t["leaves"],
+                    t["weight_round"], t["weight_generation"],
+                ).result(timeout=60)
+                cont = b.submit(
+                    [list(t["prompt"]) + list(t["emitted"])], t["budget"]
+                ).result(timeout=300)
+                a.complete_migrated(t["group"], cont[0])
+        assert a.migrated_out >= 1, "pool never migrated"
+        assert g1.fut.result(timeout=1) == [ref1]
+        assert g2.fut.result(timeout=1) == [ref2]
+        a._alloc.check_conservation(
+            [r.blocks for r in a._lane_rows.values()]
+        )
+    finally:
+        a.close()
+        b.close()
+
+
+def test_migration_send_failure_requeues_recompute(tiny_llama):
+    """Any sender failure (link died, target busy) falls back to today's
+    recompute-resume: the group re-enters the queue and both requests
+    still stream token-identically — migration can lose work, never
+    correctness."""
+    model, params, _ = tiny_llama
+    p1 = [(i * 7 + 5) % 50 + 1 for i in range(9)]
+    p2 = [(i * 11 + 2) % 50 + 1 for i in range(9)]
+    n_new = 24
+    a = DecodePool(
+        model, params, slots=2, max_len=64, steps_per_call=4,
+        block_size=4, num_blocks=15, prefill_chunk=4, reserve_blocks=0,
+        prefix_cache=True, fleet_cache=True, kv_migration=True,
+    )
+
+    def bad_send(ticket):
+        raise RuntimeError("link down")
+
+    a.set_migrate_hooks(lambda est, toks: "peer-b", bad_send)
+    try:
+        f1 = a.submit([list(p1)], n_new)
+        f2 = a.submit([list(p2)], n_new)
+        assert f1.result(timeout=300) == [_ref(model, params, p1, n_new)]
+        assert f2.result(timeout=300) == [_ref(model, params, p2, n_new)]
+        assert a.migrated_out >= 1, "pool never attempted migration"
+    finally:
+        a.close()
+
+
+def test_policy_none_keeps_recompute_resume(tiny_llama):
+    """policy -> None (recompute wins, or no router hint yet) preserves
+    the pre-migration preemption path bit-for-bit."""
+    model, params, _ = tiny_llama
+    p1 = [(i * 7 + 5) % 50 + 1 for i in range(9)]
+    p2 = [(i * 11 + 2) % 50 + 1 for i in range(9)]
+    n_new = 24
+    a = DecodePool(
+        model, params, slots=2, max_len=64, steps_per_call=4,
+        block_size=4, num_blocks=15, prefill_chunk=4, reserve_blocks=0,
+        prefix_cache=True, fleet_cache=True, kv_migration=True,
+    )
+    a.set_migrate_hooks(lambda est, toks: None, lambda t: None)
+    try:
+        f1 = a.submit([list(p1)], n_new)
+        f2 = a.submit([list(p2)], n_new)
+        assert f1.result(timeout=300) == [_ref(model, params, p1, n_new)]
+        assert f2.result(timeout=300) == [_ref(model, params, p2, n_new)]
+        assert a.migrated_out == 0
+        assert a.preemptions >= 1
+    finally:
+        a.close()
+
+
+def test_transfer_vs_recompute_policy_math(tiny_llama):
+    """The LinkTable side of the policy: ship when transfer time beats
+    the measured prefill cost, recompute when a bw-capped link makes the
+    wire slower — and optimistic transfer while the link is unmeasured."""
+    model, params, _ = tiny_llama
+    a = _pool(model, params)
+    try:
+        assert a.prefill_cost_s(100) is None  # no prefill timed yet
+        prompt = [(i * 3 + 1) % 50 + 1 for i in range(16)]
+        a.submit([list(prompt)], 4).result(timeout=300)
+        cost = a.prefill_cost_s(1000)
+        assert cost is not None and cost > 0
+        assert a._block_nbytes() > 0
+        link = LinkTable()
+        assert link.bandwidth_bps("peer") is None  # unmeasured: ship
+        est_bytes = 2 * a._block_nbytes()
+        # a fat link: transfer beats recompute
+        link.observe("peer", est_bytes, 1e-6)
+        bw = link.bandwidth_bps("peer")
+        assert est_bytes * 8.0 / bw < a.prefill_cost_s(1000)
+        # a bw-capped link (chaos bw-cap shape): recompute wins
+        capped = LinkTable()
+        capped.observe("peer", est_bytes, 3600.0)
+        bw = capped.bandwidth_bps("peer")
+        assert est_bytes * 8.0 / bw >= a.prefill_cost_s(1000)
+    finally:
+        a.close()
+
+
+# ----------------------------------------------------------------- router
+
+
+def _fake_dep(slot, depth, serve="fc", now=None):
+    async def _release():
+        return None
+
+    return _Deployment(
+        slot=slot,
+        handle=types.SimpleNamespace(
+            peer_id=f"w{slot}", failed=None, lease_id=f"l{slot}",
+            release=_release,
+        ),
+        task=types.SimpleNamespace(close=lambda: None),
+        job_id=f"j{slot}",
+        backend_name=f"{serve}@{slot}",
+        load=ServeLoad(
+            job_id=f"j{slot}", serve_name=f"{serve}@{slot}",
+            queue_depth=depth,
+        ),
+        load_at=now if now is not None else time.monotonic(),
+    )
+
+
+def test_router_directory_holder_routing_and_pull_stamping():
+    """Satellite pin: heartbeat digests build the directory, requests
+    route to the ACTUAL holder, the skew guard still wins under load —
+    and when it does, the forwarded request carries a pull-from-holder
+    instruction instead of silently recomputing."""
+
+    async def main():
+        hub = MemoryTransport()
+        node = Node(hub.shared(), peer_id="sched")
+        await node.start()
+        SERVE_METRICS.reset()
+        sup = ServingSupervisor(
+            node, _MODEL, "fc", num_workers=3,
+            fleet_cache=True, kv_migration=True, prefix_affinity=True,
+            affinity_skew=2, pool_prefix_cache=True, pool_block_size=4,
+        )
+        # config plumbing: the knobs reach the dispatched executor
+        # config as None-unless-on additive fields
+        assert sup._config.pool_fleet_cache is True
+        assert sup._config.pool_kv_migration is True
+        assert sup._config.fleet_digest_k == 32
+        sup._deployments = [_fake_dep(s, 0) for s in range(3)]
+        prompt = [7, 7, 7, 7, 1, 2, 3, 4, 9, 9]
+        hashes = chain_hashes(prompt, 4)
+        # heartbeat with a digest: directory ingests, gauge tracks, and
+        # the ack names the least-loaded OTHER backend as migrate target
+        sup._deployments[0].load = ServeLoad(job_id="j0", queue_depth=3)
+        ack = await sup._on_load(
+            "w1",
+            ServeLoad(
+                job_id="j1", serve_name="fc@1",
+                cache_digest=[[hashes[1], 3], [hashes[0], 1]],
+            ),
+        )
+        assert ack.ok
+        assert ack.migrate_peer == "w2"  # w0 is deeper, w1 is self
+        assert ack.migrate_serve == "fc@2"
+        assert sup._digests["fc@1"] == {hashes[1]: 3, hashes[0]: 1}
+        assert SERVE_METRICS.snapshot()["directory_chains"] == 2.0
+        sup._deployments[0].load = ServeLoad(job_id="j0", queue_depth=0)
+        # a heartbeat from a torn-down job is still refused
+        assert not (await sup._on_load("wx", ServeLoad(job_id="zz"))).ok
+        calls: list = []
+
+        async def fake_request(peer, proto, msg, timeout=None):
+            calls.append((peer, msg))
+            return GenerateResponse(tokens=[[0]])
+
+        sup.node.request = fake_request  # type: ignore[method-assign]
+        req = GenerateRequest(serve_name="fc", prompts=[list(prompt)])
+        # equal load: the request routes to the actual holder, no pull
+        for _ in range(3):
+            assert (await sup._route_request("c", req)).ok
+        for _, msg in calls:
+            assert msg.serve_name == "fc@1"
+            assert msg.pull_peer is None and msg.pull_serve is None
+        assert SERVE_METRICS.snapshot()["affinity_routed"] >= 3
+        # skew guard: the holder goes deep -> least-loaded wins, and the
+        # forwarded request names the holder as the pull source
+        sup._deployments[1].load = ServeLoad(job_id="j1", queue_depth=50)
+        calls.clear()
+        assert (await sup._route_request("c", req)).ok
+        peer, fwd = calls[0]
+        assert fwd.serve_name != "fc@1"
+        assert fwd.pull_peer == "w1" and fwd.pull_serve == "fc@1"
+        # an unknown prompt falls back to rendezvous affinity: stable
+        # owner, never a pull instruction
+        other = GenerateRequest(serve_name="fc", prompts=[[9, 1, 4, 4]])
+        calls.clear()
+        for _ in range(3):
+            await sup._route_request("c", other)
+        assert len({m.serve_name for _, m in calls}) == 1
+        assert all(m.pull_peer is None for _, m in calls)
+        # teardown forgets the dead backend's chains
+        await sup._teardown(sup._deployments[1])
+        assert "fc@1" not in sup._digests
+        sup._router.close()
+        await node.stop()
+
+    run(main())
+
+
+def test_router_defaults_off_no_directory_paths():
+    """fleet_cache off: no digest ingestion, no pull stamping, config
+    fields stay None (byte-identical dispatch), affinity unchanged."""
+
+    async def main():
+        hub = MemoryTransport()
+        node = Node(hub.shared(), peer_id="sched")
+        await node.start()
+        sup = ServingSupervisor(node, _MODEL, "off", num_workers=2)
+        assert sup._config.pool_fleet_cache is None
+        assert sup._config.pool_kv_migration is None
+        assert sup._config.fleet_digest_k is None
+        sup._deployments = [_fake_dep(s, 0, serve="off") for s in range(2)]
+        ack = await sup._on_load(
+            "w0", ServeLoad(job_id="j0", serve_name="off@0")
+        )
+        assert ack.ok and ack.migrate_peer is None
+        assert sup._digests == {}
+        calls: list = []
+
+        async def fake_request(peer, proto, msg, timeout=None):
+            calls.append(msg)
+            return GenerateResponse(tokens=[[0]])
+
+        sup.node.request = fake_request  # type: ignore[method-assign]
+        req = GenerateRequest(serve_name="off", prompts=[[1, 2, 3, 4]])
+        assert (await sup._route_request("c", req)).ok
+        assert calls[0].pull_peer is None
+        sup._router.close()
+        await node.stop()
+
+    run(main())
+
+
+# ---------------------------------------------------------------- metrics
+
+
+def test_serve_metrics_fleet_bundle():
+    """Satellite pin: the fleet counters + directory gauge land in
+    snapshot() JSON-safe and export through register_on."""
+    SERVE_METRICS.reset()
+    SERVE_METRICS.remote_prefix_hits.add(3)
+    SERVE_METRICS.remote_prefix_misses.add(1)
+    SERVE_METRICS.blocks_shipped.add(5)
+    SERVE_METRICS.block_bytes_shipped.add(4096)
+    SERVE_METRICS.migrations.add(1)
+    SERVE_METRICS.transfer_chosen.add(2)
+    SERVE_METRICS.recompute_chosen.add(1)
+    SERVE_METRICS.directory_state(7)
+    snap = SERVE_METRICS.snapshot()
+    json.dumps(snap)  # JSON-safety: every value is a plain number
+    assert snap["remote_prefix_hits"] == 3
+    assert snap["remote_prefix_misses"] == 1
+    assert snap["remote_prefix_hit_rate"] == pytest.approx(0.75)
+    assert snap["blocks_shipped"] == 5
+    assert snap["block_bytes_shipped"] == 4096
+    assert snap["migrations"] == 1
+    assert snap["transfer_chosen"] == 2
+    assert snap["recompute_chosen"] == 1
+    assert snap["directory_chains"] == 7.0
+
+    from hypha_tpu.telemetry.ft_metrics import register_on
+
+    class SpyMeter:
+        def __init__(self):
+            self.gauges = {}
+
+        def observable_gauge(self, name, callback, unit=""):
+            self.gauges[name] = callback
+
+    meter = SpyMeter()
+    register_on(meter)
+    for name, want in (
+        ("hypha.serve.remote_prefix_hits", 3),
+        ("hypha.serve.remote_prefix_misses", 1),
+        ("hypha.serve.blocks_shipped", 5),
+        ("hypha.serve.block_bytes_shipped", 4096),
+        ("hypha.serve.migrations", 1),
+        ("hypha.serve.transfer_chosen", 2),
+        ("hypha.serve.recompute_chosen", 1),
+        ("hypha.serve.directory_chains", 7.0),
+    ):
+        assert meter.gauges[name]() == want, name
+    SERVE_METRICS.reset()

@@ -485,6 +485,36 @@ def test_proto_swap_rule_live_registry_clean():
     assert proto_rules.check_swap_tags() == []
 
 
+def test_proto_block_rule_on_fixture_pair():
+    """The seeded fixture pair: BlockBad (chain hashes with no weight
+    stamp) fires the rule, clean twin BlockGood (hashes next to the full
+    (weight_round, weight_generation) pair) stays quiet. Unregistered
+    fixtures, explicit registry."""
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "proto_block", FIXTURES / "proto_block.py"
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    bad = proto_rules.check_block_tags(
+        registry={"BlockBad": mod.BlockBad, "BlockGood": mod.BlockGood}
+    )
+    assert [v.rule for v in bad] == ["msg-block-needs-generation"]
+    assert "BlockBad" in bad[0].message
+    assert "generation" in bad[0].message
+    assert proto_rules.check_block_tags(
+        registry={"BlockGood": mod.BlockGood}
+    ) == []
+
+
+def test_proto_block_rule_live_registry_clean():
+    """The shipping registry satisfies the rule at zero new suppressions:
+    the fleet-cache wire (BlockPull/BlockChain/MigrateRequest) carries
+    chain hashes NEXT TO the (weight_round, weight_generation) stamp."""
+    assert proto_rules.check_block_tags() == []
+
+
 def test_proto_tree_rule_on_fixture_pair():
     """The seeded fixture pair: TreeBad (tree_depth/parent placement, no
     round tag) fires the rule, clean twin TreeGood stays quiet.
